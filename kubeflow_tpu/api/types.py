@@ -1,0 +1,185 @@
+"""Platform API types (the CRD schema layer, SURVEY.md L1).
+
+API surface parity with the reference, TPU-first extensions marked:
+
+- ``Notebook``  (ref: ``notebook-controller/api/v1beta1/notebook_types.go:27-76``)
+  spec.template.spec = PodSpec, status = {conditions, readyReplicas,
+  containerState}. **New**: ``spec.tpu = {accelerator, topology, multislice?}``
+  — the first-class slice request (SURVEY.md §7 stage 1).
+- ``Profile``   (ref: ``profile-controller/api/v1/profile_types.go:36-45``)
+  cluster-scoped; owner Subject, plugins, resourceQuotaSpec.
+- ``PodDefault``(ref: ``admission-webhook/pkg/apis/settings/v1alpha1/poddefault_types.go:27-81``)
+- ``Tensorboard``(ref: ``tensorboard-controller/api/v1alpha1``): spec.logspath.
+
+Objects travel as wire-format dicts; these helpers construct/validate them and
+emit the CRD manifests (``manifests/crds.py`` renders to YAML).
+"""
+from __future__ import annotations
+
+from typing import Mapping
+
+from kubeflow_tpu.tpu.topology import SliceTopology, parse_topology
+
+GROUP = "kubeflow.org"
+NOTEBOOK_API_VERSION = f"{GROUP}/v1beta1"
+PROFILE_API_VERSION = f"{GROUP}/v1"
+PODDEFAULT_API_VERSION = f"{GROUP}/v1alpha1"
+TENSORBOARD_API_VERSION = f"tensorboard.{GROUP}/v1alpha1"
+
+# Annotation contract (kept name-compatible with the reference so existing
+# Kubeflow tooling keeps working against this platform):
+STOP_ANNOTATION = "kubeflow-resource-stopped"          # culler.go:46
+LAST_ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"  # culler.go:39
+LAST_ACTIVITY_CHECK_TS = "notebooks.kubeflow.org/last_activity_check_timestamp"
+SERVER_TYPE_ANNOTATION = "notebooks.kubeflow.org/server-type"
+CREATOR_ANNOTATION = "notebooks.kubeflow.org/creator"
+OWNER_ANNOTATION = "owner"                              # profile_controller.go namespace owner
+
+
+def notebook(
+    name: str,
+    namespace: str,
+    *,
+    image: str = "kubeflow-tpu/jupyter-jax:latest",
+    cpu: str = "0.5",
+    memory: str = "1Gi",
+    tpu_accelerator: str | None = None,
+    tpu_topology: str | None = None,
+    env: list | None = None,
+    volumes: list | None = None,
+    volume_mounts: list | None = None,
+    annotations: Mapping | None = None,
+    labels: Mapping | None = None,
+) -> dict:
+    """Build a Notebook CR (what the spawner backend assembles from the form;
+    ref template: ``apps/common/yaml/notebook_template.yaml:1-24``)."""
+    container: dict = {
+        "name": name,
+        "image": image,
+        "resources": {
+            "requests": {"cpu": cpu, "memory": memory},
+            "limits": {"cpu": cpu, "memory": memory},
+        },
+    }
+    if env:
+        container["env"] = list(env)
+    if volume_mounts:
+        container["volumeMounts"] = list(volume_mounts)
+    spec: dict = {"template": {"spec": {"containers": [container]}}}
+    if volumes:
+        spec["template"]["spec"]["volumes"] = list(volumes)
+    if tpu_accelerator or tpu_topology:
+        if not (tpu_accelerator and tpu_topology):
+            raise ValueError("spec.tpu requires both accelerator and topology")
+        parse_topology(tpu_accelerator, tpu_topology)  # validate early
+        spec["tpu"] = {"accelerator": tpu_accelerator, "topology": tpu_topology}
+    return {
+        "apiVersion": NOTEBOOK_API_VERSION,
+        "kind": "Notebook",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": dict(labels or {}),
+            "annotations": dict(annotations or {}),
+        },
+        "spec": spec,
+    }
+
+
+def notebook_topology(nb: Mapping) -> SliceTopology | None:
+    """The validated slice a Notebook requests, or None for CPU-only."""
+    tpu = nb.get("spec", {}).get("tpu")
+    if not tpu:
+        return None
+    return parse_topology(tpu.get("accelerator", ""), tpu.get("topology", ""))
+
+
+def validate_notebook(nb: Mapping) -> list[str]:
+    """Admission-time validation; returns user-facing error strings."""
+    errors = []
+    spec = nb.get("spec", {})
+    containers = (
+        spec.get("template", {}).get("spec", {}).get("containers") or []
+    )
+    if not containers:
+        errors.append("spec.template.spec.containers must have at least one container")
+    if spec.get("tpu"):
+        try:
+            parse_topology(
+                spec["tpu"].get("accelerator", ""),
+                spec["tpu"].get("topology", ""),
+            )
+        except ValueError as e:
+            errors.append(f"spec.tpu: {e}")
+    return errors
+
+
+def profile(
+    name: str,
+    owner_name: str,
+    owner_kind: str = "User",
+    plugins: list | None = None,
+    resource_quota: Mapping | None = None,
+) -> dict:
+    spec: dict = {"owner": {"kind": owner_kind, "name": owner_name}}
+    if plugins:
+        spec["plugins"] = list(plugins)
+    if resource_quota:
+        spec["resourceQuotaSpec"] = dict(resource_quota)
+    return {
+        "apiVersion": PROFILE_API_VERSION,
+        "kind": "Profile",
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def pod_default(
+    name: str,
+    namespace: str,
+    *,
+    selector: Mapping,
+    desc: str = "",
+    env: list | None = None,
+    env_from: list | None = None,
+    volumes: list | None = None,
+    volume_mounts: list | None = None,
+    tolerations: list | None = None,
+    labels: Mapping | None = None,
+    annotations: Mapping | None = None,
+    service_account_name: str | None = None,
+    image_pull_secrets: list | None = None,
+    command: list | None = None,
+    args: list | None = None,
+) -> dict:
+    spec: dict = {"selector": dict(selector), "desc": desc}
+    for key, val in (
+        ("env", env),
+        ("envFrom", env_from),
+        ("volumes", volumes),
+        ("volumeMounts", volume_mounts),
+        ("tolerations", tolerations),
+        ("labels", dict(labels) if labels else None),
+        ("annotations", dict(annotations) if annotations else None),
+        ("serviceAccountName", service_account_name),
+        ("imagePullSecrets", image_pull_secrets),
+        ("command", command),
+        ("args", args),
+    ):
+        if val:
+            spec[key] = val
+    return {
+        "apiVersion": PODDEFAULT_API_VERSION,
+        "kind": "PodDefault",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec,
+    }
+
+
+def tensorboard(name: str, namespace: str, logspath: str) -> dict:
+    return {
+        "apiVersion": TENSORBOARD_API_VERSION,
+        "kind": "Tensorboard",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"logspath": logspath},
+    }

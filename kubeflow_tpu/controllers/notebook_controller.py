@@ -1,0 +1,398 @@
+"""Notebook reconciler: Notebook CR → StatefulSet + Service(s) + VirtualService.
+
+Functional parity with the reference reconciler
+(``notebook-controller/controllers/notebook_controller.go:90-282``), redesigned
+around a first-class TPU slice:
+
+- CPU notebook: StatefulSet replicas 1/0, Service :80→:8888, VirtualService
+  prefix ``/notebook/<ns>/<name>/`` — matching the reference's contract so the
+  image/UI ecosystem carries over (``generateStatefulSet`` go:418-481,
+  ``generateService`` go:483-510, ``generateVirtualService`` go:516-610).
+- TPU notebook (``spec.tpu``): **replicas == num_hosts** (the reference pins 1,
+  go:419-421), one pod per TPU host; ``google.com/tpu`` chip limits +
+  GKE topology nodeSelectors; a headless Service giving every host a stable
+  DNS name; pod-0 is the JAX coordinator. Worker identity env is injected at
+  admission (``webhooks/tpu_env.py``), keeping this reconciler declarative.
+- Status: conditions mirrored from the coordinator pod (ref go:284-359) plus
+  TPU aggregation — readyReplicas across the gang and a ``TPUSliceReady``
+  condition that is True only when *all* hosts are Ready (SURVEY.md §7 hard
+  part #4: all-or-nothing semantics).
+- Events on owned Pods/StatefulSets are re-emitted onto the CR (ref go:94-118)
+  so the spawner UI can show scheduling failures.
+- Culling: requeues every idleness-check period; kernel idleness on the
+  coordinator stops the whole gang (SURVEY.md §7 stage 4).
+"""
+from __future__ import annotations
+
+import logging
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.culler.culler import Culler, set_stop_annotation, stop_annotation_is_set
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime import reconcilehelper as helper
+from kubeflow_tpu.runtime.fake import FakeCluster, NotFound
+from kubeflow_tpu.runtime.manager import Reconciler, Result
+from kubeflow_tpu.tpu import topology as tputopo
+from kubeflow_tpu.utils.config import ControllerConfig
+
+log = logging.getLogger(__name__)
+
+PREFIX_ENV = "NB_PREFIX"
+REWRITE_ANNOTATION = "notebooks.kubeflow.org/http-rewrite-uri"
+HEADERS_ANNOTATION = "notebooks.kubeflow.org/http-headers-request-set"
+
+
+class NotebookReconciler(Reconciler):
+    kind = "Notebook"
+
+    def __init__(
+        self,
+        config: ControllerConfig | None = None,
+        culler: Culler | None = None,
+        metrics=None,
+    ) -> None:
+        self.config = config or ControllerConfig()
+        self.culler = culler
+        self.metrics = metrics
+
+    def watches(self):
+        return [
+            self.owns("StatefulSet"),
+            self.owns("Service"),
+            self.owns("VirtualService"),
+            ("Pod", _map_pod_to_notebook),
+            ("Event", _map_event_to_notebook),
+        ]
+
+    # ------------------------------------------------------------------ main
+
+    def reconcile(self, cluster: FakeCluster, namespace: str, name: str) -> Result | None:
+        nb = cluster.try_get("Notebook", name, namespace)
+        if nb is None:
+            return None  # deleted; GC cascades owned objects
+
+        topo = api.notebook_topology(nb)
+
+        sts = self.generate_statefulset(nb, topo)
+        helper.reconcile_object(
+            cluster, sts, owner=nb, copy_fields=helper.copy_statefulset_fields
+        )
+        helper.reconcile_object(
+            cluster,
+            self.generate_service(nb),
+            owner=nb,
+            copy_fields=helper.copy_service_fields,
+        )
+        if topo is not None and topo.is_multi_host:
+            helper.reconcile_object(
+                cluster,
+                self.generate_headless_service(nb, topo),
+                owner=nb,
+                copy_fields=helper.copy_service_fields,
+            )
+        if self.config.use_istio:
+            helper.reconcile_object(
+                cluster, self.generate_virtual_service(nb), owner=nb
+            )
+
+        self._reemit_child_events(cluster, nb)
+        self._update_status(cluster, nb, topo)
+
+        requeue = None
+        if self.culler is not None:
+            requeue = self._maybe_cull(cluster, namespace, name)
+        return Result(requeue_after=requeue)
+
+    # ------------------------------------------------------------ generators
+
+    def generate_statefulset(self, nb: dict, topo: tputopo.SliceTopology | None) -> dict:
+        cfg = self.config
+        name, ns = ko.name(nb), ko.namespace(nb)
+        if stop_annotation_is_set(nb):
+            replicas = 0
+        elif topo is not None:
+            replicas = topo.num_hosts
+        else:
+            replicas = 1
+
+        pod_spec = ko.deep_copy(nb["spec"]["template"]["spec"])
+        pod_labels = {"statefulset": name, "notebook-name": name}
+        pod_labels.update(ko.labels(nb))  # carry PodDefault selector labels (ref go:444-448)
+
+        container = pod_spec["containers"][0]
+        container.setdefault("workingDir", cfg.workspace_dir)
+        container.setdefault(
+            "ports",
+            [
+                {
+                    "containerPort": cfg.container_port,
+                    "name": "notebook-port",
+                    "protocol": "TCP",
+                }
+            ],
+        )
+        _set_env(container, PREFIX_ENV, f"/notebook/{ns}/{name}")
+        if cfg.add_fsgroup:
+            pod_spec.setdefault("securityContext", {"fsGroup": cfg.default_fs_group})
+
+        if topo is not None:
+            sel = pod_spec.setdefault("nodeSelector", {})
+            sel.update(topo.node_selectors())
+            limits = container.setdefault("resources", {}).setdefault("limits", {})
+            limits.update(topo.resource_limits())
+            # Chips are host-bound: requests must equal limits for device plugins.
+            container["resources"].setdefault("requests", {}).update(
+                topo.resource_limits()
+            )
+            pod_labels["tpu-slice"] = topo.slice_name
+            # TPU initialization is latency-sensitive; give the gang a parallel
+            # (not ordered) rollout so all hosts start simultaneously.
+            pod_management_policy = "Parallel"
+        else:
+            pod_management_policy = "OrderedReady"
+
+        sts = {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "replicas": replicas,
+                "podManagementPolicy": pod_management_policy,
+                "selector": {"matchLabels": {"statefulset": name}},
+                "template": {
+                    "metadata": {
+                        "labels": pod_labels,
+                        "annotations": _tpu_pod_annotations(nb, topo),
+                    },
+                    "spec": pod_spec,
+                },
+            },
+        }
+        if topo is not None and topo.is_multi_host:
+            # Stable per-host DNS: <name>-<ordinal>.<headless-svc>.<ns>.svc
+            sts["spec"]["serviceName"] = tputopo.headless_service_name(name)
+        return sts
+
+    def generate_service(self, nb: dict) -> dict:
+        name, ns = ko.name(nb), ko.namespace(nb)
+        ports = (
+            nb["spec"]["template"]["spec"]["containers"][0].get("ports") or []
+        )
+        target = ports[0]["containerPort"] if ports else self.config.container_port
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {"statefulset": name},
+                "ports": [
+                    {
+                        # Istio-managed port naming convention (ref go:497-500)
+                        "name": f"http-{name}",
+                        "port": self.config.serving_port,
+                        "targetPort": target,
+                        "protocol": "TCP",
+                    }
+                ],
+            },
+        }
+
+    def generate_headless_service(self, nb: dict, topo: tputopo.SliceTopology) -> dict:
+        """Per-host stable DNS + coordinator discovery for the JAX mesh.
+
+        ``publishNotReadyAddresses`` is required: every worker must resolve the
+        coordinator *before* any of them is Ready (jax.distributed.initialize
+        blocks until all hosts join — a readiness deadlock otherwise).
+        """
+        name, ns = ko.name(nb), ko.namespace(nb)
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": tputopo.headless_service_name(name),
+                "namespace": ns,
+                "labels": {"notebook-name": name, "role": "tpu-worker-dns"},
+            },
+            "spec": {
+                "clusterIP": "None",
+                "publishNotReadyAddresses": True,
+                "selector": {"statefulset": name},
+                "ports": [
+                    {
+                        "name": "coordinator",
+                        "port": self.config.tpu_coordinator_port,
+                        "protocol": "TCP",
+                    }
+                ],
+            },
+        }
+
+    def generate_virtual_service(self, nb: dict) -> dict:
+        cfg = self.config
+        name, ns = ko.name(nb), ko.namespace(nb)
+        anns = ko.annotations(nb)
+        prefix = f"/notebook/{ns}/{name}/"
+        rewrite = anns.get(REWRITE_ANNOTATION) or prefix
+        headers_set = {}
+        raw = anns.get(HEADERS_ANNOTATION)
+        if raw:
+            import json
+
+            try:
+                headers_set = json.loads(raw)
+            except ValueError:
+                headers_set = {}
+        return {
+            "apiVersion": "networking.istio.io/v1alpha3",
+            "kind": "VirtualService",
+            "metadata": {"name": f"notebook-{ns}-{name}", "namespace": ns},
+            "spec": {
+                "hosts": [cfg.istio_host],
+                "gateways": [cfg.istio_gateway],
+                "http": [
+                    {
+                        "headers": {"request": {"set": headers_set}},
+                        "match": [{"uri": {"prefix": prefix}}],
+                        "rewrite": {"uri": rewrite},
+                        "route": [
+                            {
+                                "destination": {
+                                    "host": f"{name}.{ns}.svc.{cfg.cluster_domain}",
+                                    "port": {"number": cfg.serving_port},
+                                }
+                            }
+                        ],
+                    }
+                ],
+            },
+        }
+
+    # ---------------------------------------------------------------- status
+
+    def _update_status(self, cluster: FakeCluster, nb: dict, topo) -> None:
+        name, ns = ko.name(nb), ko.namespace(nb)
+        sts = cluster.try_get("StatefulSet", name, ns)
+        ready = (sts or {}).get("status", {}).get("readyReplicas", 0)
+        expected = (sts or {}).get("spec", {}).get("replicas", 0)
+
+        pods = {
+            ko.name(p): p
+            for p in cluster.list("Pod", ns, {"matchLabels": {"statefulset": name}})
+        }
+        coordinator = pods.get(f"{name}-0")
+
+        conditions: list[dict] = []
+        container_state: dict = {}
+        if coordinator is not None:
+            for pc in coordinator.get("status", {}).get("conditions", []):
+                conditions.append(
+                    {"type": pc.get("type"), "status": pc.get("status")}
+                )
+            cs = coordinator.get("status", {}).get("containerStatuses", [])
+            if cs:
+                container_state = cs[0].get("state", {})
+        if topo is not None:
+            all_ready = expected > 0 and ready >= expected
+            conditions.append(
+                {
+                    "type": "TPUSliceReady",
+                    "status": "True" if all_ready else "False",
+                    "reason": f"{ready}/{expected} hosts ready",
+                }
+            )
+
+        status = {
+            "readyReplicas": ready,
+            "conditions": conditions,
+            "containerState": container_state,
+        }
+        if topo is not None:
+            status["tpu"] = topo.to_dict()
+        current = cluster.try_get("Notebook", name, ns)
+        if current is not None and current.get("status") != status:
+            current["status"] = status
+            cluster.update(current)
+        if self.metrics is not None:
+            self.metrics.observe_notebooks(cluster)
+
+    def _reemit_child_events(self, cluster: FakeCluster, nb: dict) -> None:
+        """Mirror Warning events from owned Pods/StatefulSets onto the CR
+        (ref go:94-118) so users see scheduling/pull failures in the UI."""
+        name, ns = ko.name(nb), ko.namespace(nb)
+        mirrored = {
+            (e.get("reason"), e.get("message"))
+            for e in cluster.events_for(nb)
+        }
+        children = [(p["metadata"]["name"], "Pod") for p in cluster.list(
+            "Pod", ns, {"matchLabels": {"statefulset": name}}
+        )] + [(name, "StatefulSet")]
+        for child_name, child_kind in children:
+            for ev in cluster.list("Event", ns):
+                io = ev.get("involvedObject", {})
+                if (
+                    io.get("kind") == child_kind
+                    and io.get("name") == child_name
+                    and ev.get("type") == "Warning"
+                    and (ev.get("reason"), ev.get("message")) not in mirrored
+                ):
+                    cluster.emit_event(
+                        nb, ev.get("reason", ""), ev.get("message", ""), "Warning"
+                    )
+                    mirrored.add((ev.get("reason"), ev.get("message")))
+
+    # --------------------------------------------------------------- culling
+
+    def _maybe_cull(self, cluster: FakeCluster, namespace: str, name: str) -> float:
+        nb = cluster.try_get("Notebook", name, namespace)
+        period = self.culler.check_period_s
+        if nb is None:
+            return period
+        changed = self.culler.update_last_activity(nb)
+        if self.culler.needs_culling(nb):
+            set_stop_annotation(nb, self.culler.clock())
+            changed = True
+            if self.metrics is not None:
+                self.metrics.notebook_culled(ko.namespace(nb))
+            log.info("culling idle notebook %s/%s", namespace, name)
+        if changed:
+            try:
+                cluster.update(nb)
+            except Exception:
+                pass  # conflict: next requeue retries with fresh object
+        return period
+
+
+def _tpu_pod_annotations(nb: dict, topo) -> dict:
+    anns = {}
+    if topo is not None:
+        # Consumed by the TPU env-injection webhook (webhooks/tpu_env.py).
+        anns["tpu.kubeflow.org/accelerator"] = topo.accelerator.name
+        anns["tpu.kubeflow.org/topology"] = topo.topology_str
+        anns["tpu.kubeflow.org/notebook"] = ko.name(nb)
+    return anns
+
+
+def _set_env(container: dict, name: str, value: str) -> None:
+    env = container.setdefault("env", [])
+    for e in env:
+        if e.get("name") == name:
+            e["value"] = value
+            return
+    env.append({"name": name, "value": value})
+
+
+def _map_pod_to_notebook(pod: dict):
+    nb = ko.labels(pod).get("notebook-name")
+    if nb:
+        yield (ko.namespace(pod), nb)
+
+
+def _map_event_to_notebook(event: dict):
+    io = event.get("involvedObject", {})
+    if io.get("kind") in ("Pod", "StatefulSet") and io.get("name"):
+        # sts shares the notebook name; pods are <name>-<ordinal>
+        name = io["name"]
+        if io["kind"] == "Pod" and "-" in name:
+            name = name.rsplit("-", 1)[0]
+        yield (event.get("metadata", {}).get("namespace", ""), name)

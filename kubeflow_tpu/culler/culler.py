@@ -1,0 +1,164 @@
+"""Idle-notebook culling.
+
+Behavioral parity with the reference culler (``notebook-controller/pkg/culler/
+culler.go``): track Jupyter kernel activity via the server's ``/api/kernels``
+endpoint, persist ``last-activity`` on the CR, and set the stop annotation when
+idle longer than CULL_IDLE_TIME. TPU generalization (SURVEY.md §7 stage 4 and
+hard part #3): for a multi-host slice, idleness is decided at the *coordinator*
+(host 0 — the only host running the kernel manager), and stopping scales the
+whole gang N→0; restart re-derives the identical topology so the ICI mesh
+re-forms with the same worker IDs.
+
+Kernel probing is injected (``KernelFetcher``) so tests can run against a fake
+kernel API — the fixture the reference lacks (SURVEY.md §4 takeaway).
+"""
+from __future__ import annotations
+
+import datetime as _dt
+import time
+from typing import Callable, Mapping, Protocol
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.runtime import objects as ko
+
+KERNEL_EXECUTION_STATES = ("busy", "idle", "starting")
+TIME_FORMAT = "%Y-%m-%dT%H:%M:%SZ"
+
+# fetch(namespace, name) -> list of kernel dicts
+# [{"execution_state": "idle", "last_activity": "..."}] or None if unreachable.
+KernelFetcher = Callable[[str, str], list | None]
+
+
+def format_time(ts: float) -> str:
+    return _dt.datetime.fromtimestamp(ts, _dt.timezone.utc).strftime(TIME_FORMAT)
+
+
+def parse_time(s: str) -> float:
+    return (
+        _dt.datetime.strptime(s, TIME_FORMAT)
+        .replace(tzinfo=_dt.timezone.utc)
+        .timestamp()
+    )
+
+
+def stop_annotation_is_set(nb: Mapping) -> bool:
+    return api.STOP_ANNOTATION in ko.annotations(nb)
+
+
+def set_stop_annotation(nb: dict, now: float) -> None:
+    ko.set_annotation(nb, api.STOP_ANNOTATION, format_time(now))
+    # Drop last-activity so a later restart re-initializes the idle clock
+    # instead of instantly re-culling (ref: SetStopAnnotation culler.go:130-134).
+    ko.remove_annotation(nb, api.LAST_ACTIVITY_ANNOTATION)
+
+
+def remove_stop_annotation(nb: dict) -> None:
+    ko.remove_annotation(nb, api.STOP_ANNOTATION)
+
+
+def all_kernels_idle(kernels: list) -> bool:
+    """True iff every kernel reports execution_state == idle
+    (ref: ``allKernelsAreIdle`` culler.go:187-204)."""
+    return all(k.get("execution_state") == "idle" for k in kernels)
+
+
+def latest_kernel_activity(kernels: list) -> str | None:
+    """Most recent kernel ``last_activity`` (ref: culler.go:257-279)."""
+    best = None
+    for k in kernels:
+        la = k.get("last_activity")
+        if not la:
+            continue
+        try:
+            t = parse_time(la)
+        except ValueError:
+            continue
+        if best is None or t > best:
+            best = t
+    return format_time(best) if best is not None else None
+
+
+class Culler:
+    def __init__(
+        self,
+        *,
+        enabled: bool,
+        cull_idle_minutes: float,
+        check_period_minutes: float,
+        fetch_kernels: KernelFetcher | None = None,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self.enabled = enabled
+        self.cull_idle_s = cull_idle_minutes * 60.0
+        self.check_period_s = check_period_minutes * 60.0
+        self.fetch_kernels = fetch_kernels
+        self.clock = clock
+
+    # -- annotation maintenance (ref: UpdateNotebookLastActivityAnnotation
+    #    culler.go:207-237) ---------------------------------------------------
+
+    def needs_check(self, nb: Mapping) -> bool:
+        anns = ko.annotations(nb)
+        last_check = anns.get(api.LAST_ACTIVITY_CHECK_TS)
+        if last_check is None:
+            return True
+        try:
+            return self.clock() - parse_time(last_check) >= self.check_period_s
+        except ValueError:
+            return True
+
+    def update_last_activity(self, nb: dict) -> bool:
+        """Probe the coordinator's kernel API and refresh annotations in place.
+
+        Returns True if annotations changed. An unreachable server leaves
+        last-activity untouched (the server may be culled or still starting;
+        ref behavior at culler.go:217-226).
+        """
+        now = self.clock()
+        anns = ko.annotations(nb)
+        if api.LAST_ACTIVITY_ANNOTATION not in anns:
+            ko.set_annotation(nb, api.LAST_ACTIVITY_ANNOTATION, format_time(now))
+            ko.set_annotation(nb, api.LAST_ACTIVITY_CHECK_TS, format_time(now))
+            return True
+        if not self.needs_check(nb):
+            return False
+        if stop_annotation_is_set(nb):
+            ko.set_annotation(nb, api.LAST_ACTIVITY_CHECK_TS, format_time(now))
+            return True
+        kernels = (
+            self.fetch_kernels(ko.namespace(nb), ko.name(nb))
+            if self.fetch_kernels
+            else None
+        )
+        if kernels is not None:
+            if not kernels:
+                # A server with zero kernels is idle by definition; keep the
+                # existing last-activity so the idle clock keeps running.
+                pass
+            elif not all_kernels_idle(kernels):
+                ko.set_annotation(
+                    nb, api.LAST_ACTIVITY_ANNOTATION, format_time(now)
+                )
+            else:
+                recent = latest_kernel_activity(kernels)
+                if recent:
+                    ko.set_annotation(nb, api.LAST_ACTIVITY_ANNOTATION, recent)
+        # The check timestamp always advances once the period elapsed.
+        ko.set_annotation(nb, api.LAST_ACTIVITY_CHECK_TS, format_time(now))
+        return True
+
+    # -- culling decision (ref: NotebookNeedsCulling culler.go:303-318) ------
+
+    def needs_culling(self, nb: Mapping) -> bool:
+        if not self.enabled:
+            return False
+        if stop_annotation_is_set(nb):
+            return False
+        la = ko.annotations(nb).get(api.LAST_ACTIVITY_ANNOTATION)
+        if not la:
+            return False
+        try:
+            idle_for = self.clock() - parse_time(la)
+        except ValueError:
+            return False
+        return idle_for >= self.cull_idle_s

@@ -1,0 +1,1 @@
+"""TPU-native notebook platform."""

@@ -1,0 +1,152 @@
+"""Reconciler manager: watch wiring + deduplicating workqueue.
+
+The controller-runtime analog (reference: ``notebook-controller/main.go:84-131``
+builds a manager; ``SetupWithManager`` at
+``controllers/notebook_controller.go:726-774`` wires For/Owns/Watches sources).
+Same model here: each reconciler owns a primary kind; secondary watches map
+events back to primary keys; a queue deduplicates keys; one reconcile runs per
+key at a time (the structural concurrency-safety argument the reference relies
+on, SURVEY.md §5 "race detection").
+"""
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import logging
+import threading
+import time
+from typing import Callable, Iterable
+
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster
+
+log = logging.getLogger(__name__)
+
+MapFn = Callable[[dict], Iterable[tuple[str, str]]]  # obj -> (ns, name) keys
+
+
+@dataclasses.dataclass
+class Result:
+    requeue_after: float | None = None  # seconds
+
+
+class Reconciler:
+    """Base class. Subclasses set ``kind`` and implement ``reconcile``."""
+
+    kind: str = ""
+
+    def reconcile(self, cluster: FakeCluster, namespace: str, name: str) -> Result | None:
+        raise NotImplementedError
+
+    # Secondary sources: list of (kind, map_fn). Default maps an owned object
+    # back to its controller owner of our kind (the Owns() idiom).
+    def watches(self) -> list[tuple[str, MapFn]]:
+        return []
+
+    def owns(self, kind: str) -> tuple[str, MapFn]:
+        def map_owner(obj: dict) -> Iterable[tuple[str, str]]:
+            ref = ko.controller_owner(obj)
+            if ref and ref.get("kind") == self.kind:
+                yield (ko.namespace(obj), ref["name"])
+
+        return (kind, map_owner)
+
+
+class Manager:
+    """Runs reconcilers against a cluster.
+
+    Test-mode execution model: watch events enqueue keys synchronously;
+    ``run_until_idle`` drains the queue, honoring ``requeue_after`` via a
+    virtual clock (``advance``) so culling-period behavior is testable without
+    sleeping (the reference's envtest suites poll with Eventually; we get
+    determinism instead).
+    """
+
+    def __init__(self, cluster: FakeCluster, *, clock: Callable[[], float] | None = None) -> None:
+        self.cluster = cluster
+        self._reconcilers: list[Reconciler] = []
+        self._queue: list[tuple[Reconciler, str, str]] = []
+        self._queued: set[tuple[int, str, str]] = set()
+        self._timers: list[tuple[float, int, Reconciler, str, str]] = []
+        self._timer_seq = 0
+        self._lock = threading.RLock()
+        self._now = 0.0
+        self._clock = clock
+
+    # ------------------------------------------------------------- wiring
+
+    def register(self, rec: Reconciler) -> None:
+        self._reconcilers.append(rec)
+        self.cluster.watch(rec.kind, self._primary_handler(rec))
+        for kind, map_fn in rec.watches():
+            self.cluster.watch(kind, self._secondary_handler(rec, map_fn))
+
+    def _primary_handler(self, rec: Reconciler):
+        def handle(event: str, obj: dict) -> None:
+            self.enqueue(rec, ko.namespace(obj), ko.name(obj))
+
+        return handle
+
+    def _secondary_handler(self, rec: Reconciler, map_fn: MapFn):
+        def handle(event: str, obj: dict) -> None:
+            for ns, name in map_fn(obj):
+                self.enqueue(rec, ns, name)
+
+        return handle
+
+    # -------------------------------------------------------------- queue
+
+    def enqueue(self, rec: Reconciler, namespace: str, name: str) -> None:
+        with self._lock:
+            key = (id(rec), namespace, name)
+            if key in self._queued:
+                return
+            self._queued.add(key)
+            self._queue.append((rec, namespace, name))
+
+    def now(self) -> float:
+        return self._clock() if self._clock else self._now
+
+    def advance(self, seconds: float) -> None:
+        """Advance the virtual clock and fire due requeue timers."""
+        self._now += seconds
+        self._fire_due_timers()
+
+    def _fire_due_timers(self) -> None:
+        with self._lock:
+            due = [t for t in self._timers if t[0] <= self.now()]
+            self._timers = [t for t in self._timers if t[0] > self.now()]
+        for _, _, rec, ns, name in due:
+            self.enqueue(rec, ns, name)
+
+    def run_until_idle(self, max_iterations: int = 1000) -> int:
+        """Drain the workqueue; returns number of reconciles executed."""
+        executed = 0
+        for _ in range(max_iterations):
+            with self._lock:
+                if not self._queue:
+                    break
+                rec, ns, name = self._queue.pop(0)
+                self._queued.discard((id(rec), ns, name))
+            try:
+                result = rec.reconcile(self.cluster, ns, name)
+            except Exception:  # reconcile errors requeue, like controller-runtime
+                log.exception("reconcile %s %s/%s failed", rec.kind, ns, name)
+                result = Result(requeue_after=1.0)
+            executed += 1
+            if result and result.requeue_after is not None:
+                with self._lock:
+                    self._timer_seq += 1
+                    heapq.heappush(
+                        self._timers,
+                        (
+                            self.now() + result.requeue_after,
+                            self._timer_seq,
+                            rec,
+                            ns,
+                            name,
+                        ),
+                    )
+        else:
+            raise RuntimeError("reconcile loop did not settle (hot loop?)")
+        return executed

@@ -1,0 +1,121 @@
+"""Kubernetes-object plumbing shared by the runtime and controllers.
+
+Objects are plain dicts in wire format (what the reference manipulates through
+client-go typed structs). Working in wire format keeps the store, admission
+patches, and manifests in one representation and mirrors how the reference's
+Python web apps already handle resources (``crud_backend/api/*.py``).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Iterable, Mapping
+
+GROUP = "kubeflow.org"
+TPU_GROUP = "tpu.kubeflow.org"
+
+
+def gvk(obj: Mapping) -> tuple[str, str]:
+    return obj.get("apiVersion", ""), obj.get("kind", "")
+
+
+def meta(obj: Mapping) -> dict:
+    return obj.setdefault("metadata", {})  # type: ignore[union-attr]
+
+
+def name(obj: Mapping) -> str:
+    return obj.get("metadata", {}).get("name", "")
+
+
+def namespace(obj: Mapping) -> str:
+    return obj.get("metadata", {}).get("namespace", "")
+
+
+def labels(obj: Mapping) -> dict:
+    return obj.get("metadata", {}).get("labels", {}) or {}
+
+
+def annotations(obj: Mapping) -> dict:
+    return obj.get("metadata", {}).get("annotations", {}) or {}
+
+
+def set_annotation(obj: Mapping, key: str, value: str) -> None:
+    meta(obj).setdefault("annotations", {})[key] = value
+
+
+def remove_annotation(obj: Mapping, key: str) -> None:
+    meta(obj).setdefault("annotations", {}).pop(key, None)
+
+
+def deep_copy(obj: Any) -> Any:
+    return copy.deepcopy(obj)
+
+
+def matches_selector(obj: Mapping, selector: Mapping | None) -> bool:
+    """LabelSelector match: matchLabels + matchExpressions (In/NotIn/Exists/
+    DoesNotExist), the subset the reference's PodDefault filter uses
+    (``admission-webhook/main.go:70-95``)."""
+    if not selector:
+        return True
+    obj_labels = labels(obj)
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if obj_labels.get(k) != v:
+            return False
+    for expr in selector.get("matchExpressions") or []:
+        key, op = expr.get("key"), expr.get("operator")
+        values = expr.get("values") or []
+        present = key in obj_labels
+        if op == "Exists" and not present:
+            return False
+        if op == "DoesNotExist" and present:
+            return False
+        if op == "In" and (not present or obj_labels[key] not in values):
+            return False
+        if op == "NotIn" and present and obj_labels[key] in values:
+            return False
+    return True
+
+
+def owner_reference(owner: Mapping, *, controller: bool = True) -> dict:
+    return {
+        "apiVersion": owner.get("apiVersion"),
+        "kind": owner.get("kind"),
+        "name": name(owner),
+        "uid": meta(owner).get("uid"),
+        "controller": controller,
+        "blockOwnerDeletion": True,
+    }
+
+
+def set_controller_reference(obj: Mapping, owner: Mapping) -> None:
+    refs = meta(obj).setdefault("ownerReferences", [])
+    ref = owner_reference(owner)
+    for existing in refs:
+        if existing.get("uid") == ref["uid"]:
+            existing.update(ref)
+            return
+    refs.append(ref)
+
+
+def controller_owner(obj: Mapping) -> dict | None:
+    for ref in meta(obj).get("ownerReferences", []) or []:
+        if ref.get("controller"):
+            return ref
+    return None
+
+
+def strategic_merge(base: Any, patch: Any) -> Any:
+    """JSON-merge-patch-style dict merge (``None`` deletes), sufficient for the
+    PATCH verbs our web apps expose (reference: ``apps/common/routes/patch.py``)."""
+    if not isinstance(patch, Mapping) or not isinstance(base, Mapping):
+        return deep_copy(patch)
+    out = dict(base)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = strategic_merge(out.get(k), v)
+    return out
+
+
+def sort_env(env: Iterable[Mapping]) -> list:
+    return sorted(env, key=lambda e: e.get("name", ""))

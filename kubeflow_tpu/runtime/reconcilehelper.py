@@ -1,0 +1,82 @@
+"""Create-or-update idiom shared by every controller.
+
+Behavioral equivalent of the reference's ``common/reconcilehelper/util.go:18-219``:
+ensure an object exists, and if it does, copy only the fields the controller
+owns — never clobbering cluster-managed fields (the reference is careful not to
+overwrite ``spec.clusterIP``, ``util.go:182``; here, update functions receive
+(existing, desired) and return the merged object or None for "no change").
+"""
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster
+
+CopyFn = Callable[[dict, dict], dict | None]
+
+
+def reconcile_object(
+    cluster: FakeCluster,
+    desired: Mapping,
+    owner: Mapping | None = None,
+    copy_fields: CopyFn | None = None,
+) -> dict:
+    desired = ko.deep_copy(dict(desired))
+    if owner is not None:
+        ko.set_controller_reference(desired, owner)
+    existing = cluster.try_get(
+        desired["kind"], ko.name(desired), ko.namespace(desired)
+    )
+    if existing is None:
+        return cluster.create(desired)
+    merged = (copy_fields or copy_spec_fields)(existing, desired)
+    if merged is None:
+        return existing
+    return cluster.update(merged)
+
+
+def copy_spec_fields(existing: dict, desired: dict) -> dict | None:
+    """Default copier: own labels/annotations/spec, keep everything else."""
+    changed = False
+    out = ko.deep_copy(existing)
+    for field in ("labels", "annotations"):
+        want = desired.get("metadata", {}).get(field)
+        if want is not None and out["metadata"].get(field) != want:
+            out["metadata"][field] = want
+            changed = True
+    if desired.get("spec") is not None and out.get("spec") != desired["spec"]:
+        out["spec"] = ko.deep_copy(desired["spec"])
+        changed = True
+    return out if changed else None
+
+
+def copy_service_fields(existing: dict, desired: dict) -> dict | None:
+    """Service copier: preserve clusterIP and nodePorts the cluster assigned
+    (reference: ``CopyServiceFields`` ``util.go:166-195``)."""
+    out = copy_spec_fields(existing, desired)
+    if out is None:
+        return None
+    for k in ("clusterIP", "clusterIPs"):
+        if k in (existing.get("spec") or {}):
+            out["spec"][k] = existing["spec"][k]
+    return out
+
+
+def copy_statefulset_fields(existing: dict, desired: dict) -> dict | None:
+    """StatefulSet copier: replicas + template + labels/annotations only
+    (reference: ``CopyStatefulSetFields`` ``util.go:107-134`` — volumeClaimTemplates
+    are immutable and must not be diffed)."""
+    changed = False
+    out = ko.deep_copy(existing)
+    for field in ("labels", "annotations"):
+        want = desired.get("metadata", {}).get(field)
+        if want is not None and out["metadata"].get(field) != want:
+            out["metadata"][field] = want
+            changed = True
+    espec, dspec = out.setdefault("spec", {}), desired.get("spec", {})
+    for field in ("replicas", "template"):
+        if field in dspec and espec.get(field) != dspec[field]:
+            espec[field] = ko.deep_copy(dspec[field])
+            changed = True
+    return out if changed else None

@@ -1,0 +1,247 @@
+"""TPU slice topology math.
+
+The reference platform's only accelerator awareness is an opaque resource-limit
+string (``nvidia.com/gpu`` injected by the spawner form,
+``crud-web-apps/jupyter/backend/apps/common/form.py:226-250`` in the reference).
+This module instead makes the accelerator *topology* a first-class, validated
+object: a ``Notebook`` CR carries ``spec.tpu = {accelerator, topology}`` and every
+downstream decision — StatefulSet replica count, ``google.com/tpu`` chip limits,
+GKE nodeSelectors, worker-env fan-out, and the JAX device-mesh shape inside the
+image — is *derived* from it, so the scheduler-level view and the XLA-level view
+of the slice can never disagree.
+
+Hardware model (public TPU system architecture):
+
+- A slice is an N-d torus of chips (3-d for v4/v5p, 2-d for v5e/v6e).
+- Chips are grouped onto hosts; each host exposes its local chips to exactly one
+  pod via the ``google.com/tpu`` resource, so ``replicas == num_hosts``.
+- ICI connects chips within the slice; DCN connects slices (multislice).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Mapping, Sequence
+
+__all__ = [
+    "TpuAccelerator",
+    "SliceTopology",
+    "ACCELERATORS",
+    "parse_topology",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuAccelerator:
+    """A TPU generation as the platform schedules it.
+
+    ``host_block`` is the shape of the sub-torus owned by one host: the topology
+    must tile by it (all-or-nothing gang semantics start here — a topology that
+    does not tile onto whole hosts is rejected at admission time, not discovered
+    at schedule time).
+    """
+
+    name: str                 # short name used in CRs, e.g. "v4"
+    gke_accelerator: str      # cloud.google.com/gke-tpu-accelerator label value
+    dims: int                 # torus rank: 3 for v4/v5p, 2 for v5e/v6e
+    host_block: tuple[int, ...]   # chips-per-host sub-torus shape
+    cores_per_chip: int       # TensorCores per chip (2 for v4/v5p, 1 for v5e/v6e)
+    hbm_gib_per_chip: int     # for quota accounting / spawner display
+    supports_single_host_sub_blocks: tuple[tuple[int, ...], ...] = ()
+    # Small single-host shapes allowed even though they don't tile host_block
+    # (e.g. v5e 1x1 and 2x2 single-host offerings).
+
+    @property
+    def chips_per_host(self) -> int:
+        return math.prod(self.host_block)
+
+
+ACCELERATORS: Mapping[str, TpuAccelerator] = {
+    a.name: a
+    for a in (
+        TpuAccelerator(
+            name="v4",
+            gke_accelerator="tpu-v4-podslice",
+            dims=3,
+            host_block=(2, 2, 1),
+            cores_per_chip=2,
+            hbm_gib_per_chip=32,
+        ),
+        TpuAccelerator(
+            name="v5p",
+            gke_accelerator="tpu-v5p-slice",
+            dims=3,
+            host_block=(2, 2, 1),
+            cores_per_chip=2,
+            hbm_gib_per_chip=95,
+        ),
+        TpuAccelerator(
+            name="v5e",
+            gke_accelerator="tpu-v5-lite-podslice",
+            dims=2,
+            host_block=(2, 4),
+            cores_per_chip=1,
+            hbm_gib_per_chip=16,
+            supports_single_host_sub_blocks=((1, 1), (2, 2), (2, 4), (1, 2)),
+        ),
+        TpuAccelerator(
+            name="v6e",
+            gke_accelerator="tpu-v6e-slice",
+            dims=2,
+            host_block=(2, 4),
+            cores_per_chip=1,
+            hbm_gib_per_chip=32,
+            supports_single_host_sub_blocks=((1, 1), (2, 2), (2, 4), (1, 2)),
+        ),
+    )
+}
+
+_TOPOLOGY_RE = re.compile(r"^\d+(x\d+)*$")
+
+
+def parse_topology(accelerator: str, topology: str) -> "SliceTopology":
+    """Parse and validate ``spec.tpu`` fields from a CR.
+
+    Raises ``ValueError`` with a user-facing message (surfaced by the admission
+    layer as an HTTP 400, the analog of the reference webhook's admission deny,
+    ``admission-webhook/main.go:601-608``).
+    """
+    accel = ACCELERATORS.get(accelerator)
+    if accel is None:
+        raise ValueError(
+            f"unknown TPU accelerator {accelerator!r}; "
+            f"supported: {sorted(ACCELERATORS)}"
+        )
+    if not _TOPOLOGY_RE.match(topology or ""):
+        raise ValueError(
+            f"malformed topology {topology!r}; expected e.g. "
+            + ("'2x2x2'" if accel.dims == 3 else "'2x4'")
+        )
+    shape = tuple(int(d) for d in topology.split("x"))
+    if len(shape) != accel.dims:
+        raise ValueError(
+            f"{accelerator} topologies are {accel.dims}-d; got {topology!r}"
+        )
+    if any(d < 1 for d in shape):
+        raise ValueError(f"topology dimensions must be >= 1; got {topology!r}")
+    tiles = all(d % b == 0 for d, b in zip(shape, accel.host_block))
+    if not tiles and shape not in accel.supports_single_host_sub_blocks:
+        raise ValueError(
+            f"topology {topology!r} does not tile the {accelerator} host block "
+            f"{'x'.join(map(str, accel.host_block))}; the slice cannot be "
+            "mapped onto whole hosts"
+        )
+    return SliceTopology(accelerator=accel, shape=shape)
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """A concrete, validated slice: the single source of truth for fan-out."""
+
+    accelerator: TpuAccelerator
+    shape: tuple[int, ...]
+
+    @property
+    def topology_str(self) -> str:
+        return "x".join(str(d) for d in self.shape)
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.shape)
+
+    @property
+    def chips_per_host(self) -> int:
+        # Sub-host single-host offerings (v5e 1x1/2x2) expose only their chips.
+        return min(self.num_chips, self.accelerator.chips_per_host)
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_chips // self.accelerator.chips_per_host)
+
+    @property
+    def num_cores(self) -> int:
+        return self.num_chips * self.accelerator.cores_per_chip
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def slice_name(self) -> str:
+        """Marketing-style name, e.g. v4-16 (cores) or v5e-8 (chips)."""
+        n = (
+            self.num_cores
+            if self.accelerator.cores_per_chip > 1
+            else self.num_chips
+        )
+        return f"{self.accelerator.name}-{n}"
+
+    # ---- Kubernetes projections -------------------------------------------
+
+    def node_selectors(self) -> dict[str, str]:
+        """NodeSelectors that pin pods to the right TPU node pool.
+
+        The TPU-native replacement for the reference's GPU vendor limit string
+        (``spawner_ui_config.yaml:113-126``): topology is matched by the
+        scheduler, not free-typed by the user.
+        """
+        return {
+            "cloud.google.com/gke-tpu-accelerator": self.accelerator.gke_accelerator,
+            "cloud.google.com/gke-tpu-topology": self.topology_str,
+        }
+
+    def resource_limits(self) -> dict[str, str]:
+        """Per-pod chip limits. One pod per host ⇒ chips_per_host each."""
+        return {"google.com/tpu": str(self.chips_per_host)}
+
+    def worker_hostnames(self, notebook: str, namespace: str, cluster_domain: str = "cluster.local") -> list[str]:
+        """Stable per-host DNS names via the headless Service.
+
+        The coordinator (host 0) address that ``jax.distributed.initialize``
+        needs is ``worker_hostnames()[0]``; reference analog: none — the
+        reference pins replicas to 1 (``notebook_controller.go:419-421``).
+        """
+        svc = headless_service_name(notebook)
+        return [
+            f"{notebook}-{i}.{svc}.{namespace}.svc.{cluster_domain}"
+            for i in range(self.num_hosts)
+        ]
+
+    def mesh_devices_per_host(self) -> int:
+        """JAX local device count each worker should see (sanity check knob)."""
+        return self.chips_per_host
+
+    def to_dict(self) -> dict:
+        return {
+            "accelerator": self.accelerator.name,
+            "topology": self.topology_str,
+            "numChips": self.num_chips,
+            "numHosts": self.num_hosts,
+            "chipsPerHost": self.chips_per_host,
+        }
+
+
+def headless_service_name(notebook: str) -> str:
+    """Headless Service backing per-host stable DNS for a multi-host slice."""
+    return f"{notebook}-tpu"
+
+
+def validate_against_node_capacity(
+    topo: SliceTopology, nodes: Sequence[Mapping]
+) -> bool:
+    """Does any node pool in the cluster satisfy this topology?
+
+    Generalizes the reference's GPU vendor discovery — intersecting requested
+    vendors with node capacity keys (``apps/common/routes/get.py:99-120``) — to
+    topology-label matching.
+    """
+    want = topo.node_selectors()
+    for node in nodes:
+        labels = node.get("metadata", {}).get("labels", {})
+        capacity = node.get("status", {}).get("capacity", {})
+        if all(labels.get(k) == v for k, v in want.items()) and int(
+            capacity.get("google.com/tpu", "0")
+        ) >= topo.chips_per_host:
+            return True
+    return False

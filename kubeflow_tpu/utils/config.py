@@ -1,0 +1,63 @@
+"""Env-var-first configuration, the controllers' flag idiom.
+
+Mirrors the reference's knob surface so operators migrate without relearning
+names (``notebook-controller/README.md:44-49``, ``pkg/culler/culler.go:26-30``):
+USE_ISTIO, ISTIO_GATEWAY, CLUSTER_DOMAIN, ADD_FSGROUP, ENABLE_CULLING,
+CULL_IDLE_TIME (minutes), IDLENESS_CHECK_PERIOD (minutes), DEV.
+TPU-native additions are namespaced ``TPU_*``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    try:
+        return float(v) if v is not None else default
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class ControllerConfig:
+    use_istio: bool = True
+    istio_gateway: str = "kubeflow/kubeflow-gateway"
+    istio_host: str = "*"
+    cluster_domain: str = "cluster.local"
+    add_fsgroup: bool = True
+    default_fs_group: int = 100
+    workspace_dir: str = "/home/jovyan"
+    container_port: int = 8888
+    serving_port: int = 80
+    # Culling (minutes, matching reference units at culler.go:26-27)
+    enable_culling: bool = False
+    cull_idle_minutes: float = 1440.0
+    idleness_check_minutes: float = 1.0
+    dev: bool = False
+    # TPU-native
+    tpu_coordinator_port: int = 8476  # jax.distributed default coordinator port
+    tpu_gang_schedule: bool = True    # all-or-nothing pod-slice admission
+
+    @classmethod
+    def from_env(cls) -> "ControllerConfig":
+        return cls(
+            use_istio=_env_bool("USE_ISTIO", True),
+            istio_gateway=os.environ.get("ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"),
+            istio_host=os.environ.get("ISTIO_HOST", "*"),
+            cluster_domain=os.environ.get("CLUSTER_DOMAIN", "cluster.local"),
+            add_fsgroup=_env_bool("ADD_FSGROUP", True),
+            enable_culling=_env_bool("ENABLE_CULLING", False),
+            cull_idle_minutes=_env_float("CULL_IDLE_TIME", 1440.0),
+            idleness_check_minutes=_env_float("IDLENESS_CHECK_PERIOD", 1.0),
+            dev=_env_bool("DEV", False),
+            tpu_gang_schedule=_env_bool("TPU_GANG_SCHEDULE", True),
+        )

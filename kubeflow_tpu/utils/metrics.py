@@ -1,0 +1,133 @@
+"""Prometheus-style metrics with text exposition.
+
+The platform's observability contract mirrors the reference's
+(``notebook-controller/pkg/metrics/metrics.go:13-99``): a live-scraped
+``notebook_running`` gauge plus create/cull counters, exposed in Prometheus
+text format at ``/metrics`` by the web layer. Implemented standalone (no
+prometheus_client in the image) — exposition format is stable and tiny.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Mapping
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, kind: str) -> None:
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self._values: dict[tuple, float] = {}
+        self._label_names: tuple[str, ...] = ()
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Mapping[str, str]) -> tuple:
+        names = tuple(sorted(labels))
+        if not self._label_names:
+            self._label_names = names
+        return tuple(labels[n] for n in self._label_names)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = value
+
+    def get(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def expose(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        with self._lock:
+            if not self._values:
+                lines.append(f"{self.name} 0")
+            for key, val in sorted(self._values.items()):
+                if key:
+                    lbl = ",".join(
+                        f'{n}="{v}"' for n, v in zip(self._label_names, key)
+                    )
+                    lines.append(f"{self.name}{{{lbl}}} {val:g}")
+                else:
+                    lines.append(f"{self.name} {val:g}")
+        return "\n".join(lines)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: list[_Metric] = []
+
+    def counter(self, name: str, help_: str) -> _Metric:
+        return self._add(_Metric(name, help_, "counter"))
+
+    def gauge(self, name: str, help_: str) -> _Metric:
+        return self._add(_Metric(name, help_, "gauge"))
+
+    def _add(self, m: _Metric) -> _Metric:
+        self._metrics.append(m)
+        return m
+
+    def expose(self) -> str:
+        return "\n".join(m.expose() for m in self._metrics) + "\n"
+
+
+class NotebookMetrics:
+    """Reference collector parity (metrics.go:13-64): running gauge scraped
+    live from StatefulSets, create/fail/cull counters."""
+
+    def __init__(self, registry: Registry | None = None) -> None:
+        self.registry = registry or Registry()
+        self.running = self.registry.gauge(
+            "notebook_running", "Current running notebooks in the cluster"
+        )
+        self.tpu_chips_in_use = self.registry.gauge(
+            "notebook_tpu_chips_in_use", "TPU chips held by running notebooks"
+        )
+        self.created = self.registry.counter(
+            "notebook_create_total", "Total notebooks created"
+        )
+        self.create_failed = self.registry.counter(
+            "notebook_create_failed_total", "Total notebook create failures"
+        )
+        self.culled = self.registry.counter(
+            "notebook_cull_total", "Total notebooks culled"
+        )
+
+    def observe_notebooks(self, cluster) -> None:
+        by_ns: dict[str, int] = {}
+        chips: dict[str, int] = {}
+        for sts in cluster.list("StatefulSet"):
+            ns = sts["metadata"].get("namespace", "")
+            ready = sts.get("status", {}).get("readyReplicas", 0)
+            if ready:
+                by_ns[ns] = by_ns.get(ns, 0) + 1
+                tmpl = sts["spec"]["template"]["spec"]
+                for c in tmpl.get("containers", []):
+                    n = int(
+                        c.get("resources", {})
+                        .get("limits", {})
+                        .get("google.com/tpu", 0)
+                    )
+                    chips[ns] = chips.get(ns, 0) + n * ready
+        self.running.clear()
+        self.tpu_chips_in_use.clear()
+        for ns, n in by_ns.items():
+            self.running.set(n, namespace=ns)
+        for ns, n in chips.items():
+            self.tpu_chips_in_use.set(n, namespace=ns)
+
+    def notebook_created(self, namespace: str) -> None:
+        self.created.inc(namespace=namespace)
+
+    def notebook_culled(self, namespace: str) -> None:
+        self.culled.inc(namespace=namespace)

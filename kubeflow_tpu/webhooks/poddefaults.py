@@ -1,0 +1,142 @@
+"""PodDefault admission mutator.
+
+Behavioral parity with the reference admission-webhook
+(``admission-webhook/main.go``): on pod CREATE, select the namespace's
+PodDefault CRs whose label selector matches the pod, check that they can be
+applied without conflicting with each other or the pod, then merge
+env/envFrom/volumes/volumeMounts/tolerations/imagePullSecrets/labels/
+annotations/serviceAccountName/command/args into the pod. The applied set is
+recorded as ``poddefault.admission.kubeflow.org/<name>: <resourceVersion>``
+annotations (ref: ``applyPodDefaultsOnPod`` main.go:422-486).
+
+TPU-native detail: sidecar-ish containers (``istio-proxy``) are skipped for
+command/args exactly as the reference does (main.go:514); additionally the TPU
+worker env injected by ``tpu_env.py`` is protected — a PodDefault may not
+shadow ``TPU_*``/``JAX_*`` worker identity variables (conflict → deny), since
+a mesh with two pods disagreeing about TPU_WORKER_ID is undebuggable.
+"""
+from __future__ import annotations
+
+from typing import Mapping
+
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import AdmissionDenied, FakeCluster
+
+ANNOTATION_PREFIX = "poddefault.admission.kubeflow.org/"
+PROTECTED_ENV_PREFIXES = ("TPU_", "JAX_COORDINATOR", "JAX_PROCESS", "JAX_NUM")
+SKIP_CONTAINERS = ("istio-proxy",)
+
+
+def filter_pod_defaults(pod: Mapping, pod_defaults: list[dict]) -> list[dict]:
+    """PodDefaults whose selector matches the pod (ref main.go:70-95)."""
+    return [
+        pd
+        for pd in pod_defaults
+        if ko.matches_selector(pod, pd.get("spec", {}).get("selector"))
+    ]
+
+
+def _merge_named(existing: list, incoming: list, what: str, key: str = "name") -> list:
+    """Merge lists of named items; identical duplicates are dropped, same-name
+    different-content items conflict (ref safeToApplyPodDefaults main.go:99-139)."""
+    out = list(existing or [])
+    index = {item.get(key): item for item in out}
+    for item in incoming or []:
+        cur = index.get(item.get(key))
+        if cur is None:
+            out.append(item)
+            index[item.get(key)] = item
+        elif cur != item:
+            raise AdmissionDenied(
+                f"conflicting {what} {item.get(key)!r} from PodDefaults"
+            )
+    return out
+
+
+def check_safe(pod: Mapping, pds: list[dict]) -> None:
+    """Raise AdmissionDenied if the PodDefault set conflicts with itself or the
+    pod. Runs the same merges apply will run, against scratch copies."""
+    merged_env = list(
+        pod.get("spec", {}).get("containers", [{}])[0].get("env") or []
+    )
+    merged_vols = list(pod.get("spec", {}).get("volumes") or [])
+    merged_mounts = list(
+        pod.get("spec", {}).get("containers", [{}])[0].get("volumeMounts") or []
+    )
+    for pd in pds:
+        spec = pd.get("spec", {})
+        for e in spec.get("env") or []:
+            if any(e["name"].startswith(p) for p in PROTECTED_ENV_PREFIXES):
+                existing = {x.get("name") for x in merged_env}
+                if e["name"] in existing:
+                    raise AdmissionDenied(
+                        f"PodDefault {ko.name(pd)} would override protected TPU "
+                        f"worker env {e['name']!r}"
+                    )
+        merged_env = _merge_named(merged_env, spec.get("env"), "env var")
+        merged_vols = _merge_named(merged_vols, spec.get("volumes"), "volume")
+        merged_mounts = _merge_named(
+            merged_mounts, spec.get("volumeMounts"), "volumeMount"
+        )
+
+
+def apply(pod: dict, pds: list[dict]) -> dict:
+    """Merge PodDefaults into the pod (ref main.go:422-527). Mutates a copy."""
+    pod = ko.deep_copy(pod)
+    spec = pod.setdefault("spec", {})
+    for pd in pds:
+        pdspec = pd.get("spec", {})
+        spec["volumes"] = _merge_named(
+            spec.get("volumes"), pdspec.get("volumes"), "volume"
+        )
+        for secret in pdspec.get("imagePullSecrets") or []:
+            if secret not in (spec.get("imagePullSecrets") or []):
+                spec.setdefault("imagePullSecrets", []).append(secret)
+        if pdspec.get("serviceAccountName") and not spec.get("serviceAccountName"):
+            spec["serviceAccountName"] = pdspec["serviceAccountName"]
+        for tol in pdspec.get("tolerations") or []:
+            if tol not in (spec.get("tolerations") or []):
+                spec.setdefault("tolerations", []).append(tol)
+        for c in spec.get("containers", []) + spec.get("initContainers", []):
+            c["env"] = _merge_named(c.get("env"), pdspec.get("env"), "env var")
+            c["envFrom"] = (c.get("envFrom") or []) + list(pdspec.get("envFrom") or [])
+            c["volumeMounts"] = _merge_named(
+                c.get("volumeMounts"), pdspec.get("volumeMounts"), "volumeMount"
+            )
+            if not c["envFrom"]:
+                del c["envFrom"]
+            if c.get("name") not in SKIP_CONTAINERS:
+                # ref setCommandAndArgs main.go:512-527: only set when unset
+                if pdspec.get("command") and not c.get("command"):
+                    c["command"] = list(pdspec["command"])
+                if pdspec.get("args") and not c.get("args"):
+                    c["args"] = list(pdspec["args"])
+        meta = pod.setdefault("metadata", {})
+        for k, v in (pdspec.get("labels") or {}).items():
+            meta.setdefault("labels", {}).setdefault(k, v)
+        for k, v in (pdspec.get("annotations") or {}).items():
+            meta.setdefault("annotations", {}).setdefault(k, v)
+        ko.set_annotation(
+            pod,
+            ANNOTATION_PREFIX + ko.name(pd),
+            pd.get("metadata", {}).get("resourceVersion", "0"),
+        )
+    return pod
+
+
+def mutator(pod: dict, cluster: FakeCluster) -> dict:
+    """The webhook entrypoint registered on Pod CREATE
+    (ref HTTP handler main.go:685-702, mutatePods main.go:529-634)."""
+    ns = ko.namespace(pod)
+    if not ns:
+        return pod
+    pds = filter_pod_defaults(pod, cluster.list("PodDefault", ns))
+    if not pds:
+        return pod
+    pds.sort(key=ko.name)
+    check_safe(pod, pds)
+    return apply(pod, pds)
+
+
+def install(cluster: FakeCluster) -> None:
+    cluster.register_mutator("Pod", mutator)

@@ -1,0 +1,90 @@
+"""TPU worker-identity env injection (admission-time).
+
+The piece with **no reference analog** (SURVEY.md §5 "distributed communication
+backend: none in-repo"): the reference's GPU images get NCCL implicitly from
+CUDA wheels and never coordinate across pods. Here, a multi-host slice needs
+every pod to know (a) which host it is, (b) who its peers are, and (c) where
+the coordinator lives — *before* user code runs, so
+``jax.distributed.initialize()`` (driven by ``kubeflow_tpu.parallel.bootstrap``
+inside the image) forms the ICI/DCN mesh with zero user configuration.
+
+The reconciler cannot put per-pod values in a shared pod template; admission
+can, because each pod CREATE carries its ordinal in the name. This mirrors how
+the reference solves per-pod concerns at admission time rather than reconcile
+time (PodDefaults, ``admission-webhook/main.go:529-634``).
+
+Injected contract (read by ``parallel/bootstrap.py``):
+  TPU_WORKER_ID         ordinal of this host in the slice (0..N-1)
+  TPU_WORKER_HOSTNAMES  comma-separated stable DNS names of all hosts
+  TPU_ACCELERATOR_TYPE  e.g. v4-16
+  TPU_TOPOLOGY          e.g. 2x2x2
+  JAX_COORDINATOR_ADDRESS  host0-dns:8476
+  JAX_NUM_PROCESSES / JAX_PROCESS_ID
+  TPU_SKIP_MDS_QUERY    skip GCE metadata lookups inside k8s pods
+"""
+from __future__ import annotations
+
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.tpu.topology import parse_topology
+from kubeflow_tpu.utils.config import ControllerConfig
+
+ACCEL_ANNOTATION = "tpu.kubeflow.org/accelerator"
+TOPOLOGY_ANNOTATION = "tpu.kubeflow.org/topology"
+NOTEBOOK_ANNOTATION = "tpu.kubeflow.org/notebook"
+
+
+def _ordinal(pod_name: str) -> int | None:
+    base, _, tail = pod_name.rpartition("-")
+    return int(tail) if base and tail.isdigit() else None
+
+
+def make_mutator(config: ControllerConfig | None = None):
+    cfg = config or ControllerConfig()
+
+    def mutate(pod: dict, cluster: FakeCluster) -> dict:
+        anns = ko.annotations(pod)
+        accel = anns.get(ACCEL_ANNOTATION)
+        topo_str = anns.get(TOPOLOGY_ANNOTATION)
+        notebook = anns.get(NOTEBOOK_ANNOTATION)
+        if not (accel and topo_str and notebook):
+            return pod
+        ordinal = _ordinal(ko.name(pod))
+        if ordinal is None:
+            return pod
+        topo = parse_topology(accel, topo_str)
+        pod = ko.deep_copy(pod)
+        hostnames = topo.worker_hostnames(
+            notebook, ko.namespace(pod), cfg.cluster_domain
+        )
+        if topo.num_hosts == 1:
+            # Single-host slice: no coordination needed; localhost identity.
+            hostnames = ["localhost"]
+        env = {
+            "TPU_WORKER_ID": str(ordinal),
+            "TPU_WORKER_HOSTNAMES": ",".join(hostnames),
+            "TPU_ACCELERATOR_TYPE": topo.slice_name,
+            "TPU_TOPOLOGY": topo.topology_str,
+            "TPU_CHIPS_PER_HOST_BOUNDS": "x".join(
+                map(str, topo.accelerator.host_block)
+            ),
+            "TPU_SKIP_MDS_QUERY": "true",
+            "JAX_COORDINATOR_ADDRESS": f"{hostnames[0]}:{cfg.tpu_coordinator_port}",
+            "JAX_NUM_PROCESSES": str(topo.num_hosts),
+            "JAX_PROCESS_ID": str(ordinal),
+        }
+        for c in pod.get("spec", {}).get("containers", []):
+            if c.get("name") in ("istio-proxy",):
+                continue
+            existing = c.setdefault("env", [])
+            have = {e.get("name") for e in existing}
+            for k in sorted(env):
+                if k not in have:  # user-set values win (explicit override)
+                    existing.append({"name": k, "value": env[k]})
+        return pod
+
+    return mutate
+
+
+def install(cluster: FakeCluster, config: ControllerConfig | None = None) -> None:
+    cluster.register_mutator("Pod", make_mutator(config))

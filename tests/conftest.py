@@ -1,0 +1,26 @@
+"""Test harness configuration.
+
+Mirrors the reference's envtest strategy (SURVEY.md §4): controllers are exercised
+against a real-ish in-memory API server, and all JAX/sharding tests run on a virtual
+8-device CPU mesh so multi-host TPU logic is testable without TPU hardware
+(reference analog: envtest runs a real apiserver without a kubelet).
+"""
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def cluster():
+    """A fresh in-memory cluster (our envtest) with the platform CRDs installed."""
+    from kubeflow_tpu.runtime.fake import FakeCluster
+
+    return FakeCluster()

@@ -1,0 +1,110 @@
+"""Culler unit tests (ref: notebook-controller/pkg/culler/culler_test.go)."""
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.culler import culler as c
+
+
+def _nb(annotations=None):
+    return {
+        "apiVersion": api.NOTEBOOK_API_VERSION,
+        "kind": "Notebook",
+        "metadata": {"name": "n", "namespace": "ns", "annotations": dict(annotations or {})},
+        "spec": {},
+    }
+
+
+def _culler(now, fetch=None, enabled=True, idle_min=10, period_min=1):
+    return c.Culler(
+        enabled=enabled,
+        cull_idle_minutes=idle_min,
+        check_period_minutes=period_min,
+        fetch_kernels=fetch,
+        clock=lambda: now,
+    )
+
+
+class TestKernelLogic:
+    def test_all_idle(self):
+        assert c.all_kernels_idle([{"execution_state": "idle"}] * 3)
+        assert not c.all_kernels_idle(
+            [{"execution_state": "idle"}, {"execution_state": "busy"}]
+        )
+        assert c.all_kernels_idle([])
+
+    def test_latest_activity_picks_most_recent(self):
+        ks = [
+            {"last_activity": "2026-01-01T00:00:00Z"},
+            {"last_activity": "2026-01-01T05:00:00Z"},
+            {"last_activity": "bogus"},
+            {},
+        ]
+        assert c.latest_kernel_activity(ks) == "2026-01-01T05:00:00Z"
+        assert c.latest_kernel_activity([{}]) is None
+
+
+class TestAnnotations:
+    def test_first_touch_initializes(self):
+        nb = _nb()
+        cul = _culler(now=1000.0)
+        assert cul.update_last_activity(nb)
+        anns = nb["metadata"]["annotations"]
+        assert anns[api.LAST_ACTIVITY_ANNOTATION] == c.format_time(1000.0)
+        assert anns[api.LAST_ACTIVITY_CHECK_TS] == c.format_time(1000.0)
+
+    def test_check_period_gating(self):
+        nb = _nb()
+        cul = _culler(now=1000.0)
+        cul.update_last_activity(nb)
+        cul.clock = lambda: 1030.0  # 30s < 1min period
+        assert not cul.update_last_activity(nb)
+
+    def test_busy_kernels_refresh_activity(self):
+        nb = _nb()
+        cul = _culler(now=0.0, fetch=lambda ns, n: [{"execution_state": "busy"}])
+        cul.update_last_activity(nb)
+        cul.clock = lambda: 120.0
+        cul.update_last_activity(nb)
+        assert nb["metadata"]["annotations"][api.LAST_ACTIVITY_ANNOTATION] == c.format_time(120.0)
+
+    def test_idle_kernels_keep_kernel_reported_activity(self):
+        ts = "2026-01-01T00:00:00Z"
+        nb = _nb()
+        cul = _culler(
+            now=0.0,
+            fetch=lambda ns, n: [{"execution_state": "idle", "last_activity": ts}],
+        )
+        cul.update_last_activity(nb)
+        cul.clock = lambda: 120.0
+        cul.update_last_activity(nb)
+        assert nb["metadata"]["annotations"][api.LAST_ACTIVITY_ANNOTATION] == ts
+
+
+class TestNeedsCulling:
+    def test_disabled_never_culls(self):
+        nb = _nb({api.LAST_ACTIVITY_ANNOTATION: c.format_time(0.0)})
+        assert not _culler(now=1e9, enabled=False).needs_culling(nb)
+
+    def test_already_stopped_never_culls(self):
+        nb = _nb(
+            {
+                api.LAST_ACTIVITY_ANNOTATION: c.format_time(0.0),
+                api.STOP_ANNOTATION: c.format_time(0.0),
+            }
+        )
+        assert not _culler(now=1e9).needs_culling(nb)
+
+    def test_idle_past_threshold_culls(self):
+        nb = _nb({api.LAST_ACTIVITY_ANNOTATION: c.format_time(0.0)})
+        assert _culler(now=601.0).needs_culling(nb)
+        assert not _culler(now=599.0).needs_culling(nb)
+
+    def test_no_activity_annotation_no_cull(self):
+        assert not _culler(now=1e9).needs_culling(_nb())
+
+
+def test_stop_annotation_roundtrip():
+    nb = _nb()
+    assert not c.stop_annotation_is_set(nb)
+    c.set_stop_annotation(nb, 100.0)
+    assert c.stop_annotation_is_set(nb)
+    c.remove_stop_annotation(nb)
+    assert not c.stop_annotation_is_set(nb)

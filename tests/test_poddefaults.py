@@ -1,0 +1,116 @@
+"""PodDefault admission mutator (ref: admission-webhook/main_test.go cases)."""
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.runtime.fake import AdmissionDenied
+from kubeflow_tpu.webhooks import poddefaults
+
+
+def _pod(ns="user-ns", labels=None, env=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p-0", "namespace": ns, "labels": labels or {}},
+        "spec": {"containers": [{"name": "main", "env": env or []}]},
+    }
+
+
+def test_selector_filtering(cluster):
+    cluster.create(
+        api.pod_default(
+            "gcs", "user-ns",
+            selector={"matchLabels": {"add-gcs": "true"}},
+            env=[{"name": "GOOGLE_APPLICATION_CREDENTIALS", "value": "/secret/key.json"}],
+        )
+    )
+    poddefaults.install(cluster)
+    plain = cluster.create(_pod(labels={}))
+    assert not plain["spec"]["containers"][0]["env"]
+    matched = cluster.create(
+        {**_pod(labels={"add-gcs": "true"}), "metadata": {"name": "p-1", "namespace": "user-ns", "labels": {"add-gcs": "true"}}}
+    )
+    env = {e["name"] for e in matched["spec"]["containers"][0]["env"]}
+    assert "GOOGLE_APPLICATION_CREDENTIALS" in env
+    anns = matched["metadata"]["annotations"]
+    assert any(k.startswith(poddefaults.ANNOTATION_PREFIX + "gcs") for k in anns)
+
+
+def test_merges_volumes_mounts_tolerations(cluster):
+    cluster.create(
+        api.pod_default(
+            "ds", "user-ns",
+            selector={"matchLabels": {"ds": "y"}},
+            volumes=[{"name": "data", "persistentVolumeClaim": {"claimName": "data"}}],
+            volume_mounts=[{"name": "data", "mountPath": "/data"}],
+            tolerations=[{"key": "tpu", "operator": "Exists"}],
+            service_account_name="data-sa",
+        )
+    )
+    poddefaults.install(cluster)
+    pod = cluster.create(_pod(labels={"ds": "y"}))
+    assert pod["spec"]["volumes"][0]["name"] == "data"
+    assert pod["spec"]["containers"][0]["volumeMounts"][0]["mountPath"] == "/data"
+    assert pod["spec"]["tolerations"] == [{"key": "tpu", "operator": "Exists"}]
+    assert pod["spec"]["serviceAccountName"] == "data-sa"
+
+
+def test_identical_duplicate_env_is_ok_conflict_denied(cluster):
+    sel = {"matchLabels": {"x": "y"}}
+    cluster.create(api.pod_default("a", "user-ns", selector=sel, env=[{"name": "E", "value": "1"}]))
+    cluster.create(api.pod_default("b", "user-ns", selector=sel, env=[{"name": "E", "value": "1"}]))
+    poddefaults.install(cluster)
+    pod = cluster.create(_pod(labels={"x": "y"}))
+    assert [e for e in pod["spec"]["containers"][0]["env"] if e["name"] == "E"] == [
+        {"name": "E", "value": "1"}
+    ]
+
+    cluster.create(api.pod_default("c", "user-ns", selector=sel, env=[{"name": "E", "value": "2"}]))
+    with pytest.raises(AdmissionDenied, match="conflicting env var"):
+        cluster.create({**_pod(labels={"x": "y"}), "metadata": {"name": "p-2", "namespace": "user-ns", "labels": {"x": "y"}}})
+
+
+def test_protected_tpu_env_cannot_be_shadowed(cluster):
+    cluster.create(
+        api.pod_default(
+            "evil", "user-ns",
+            selector={"matchLabels": {"t": "y"}},
+            env=[{"name": "TPU_WORKER_ID", "value": "0"}],
+        )
+    )
+    poddefaults.install(cluster)
+    with pytest.raises(AdmissionDenied, match="protected TPU worker env"):
+        cluster.create(_pod(labels={"t": "y"}, env=[{"name": "TPU_WORKER_ID", "value": "3"}]))
+
+
+def test_command_args_only_when_unset(cluster):
+    cluster.create(
+        api.pod_default(
+            "cmd", "user-ns",
+            selector={"matchLabels": {"c": "y"}},
+            command=["jupyter"], args=["lab"],
+        )
+    )
+    poddefaults.install(cluster)
+    pod = cluster.create(_pod(labels={"c": "y"}))
+    c = pod["spec"]["containers"][0]
+    assert c["command"] == ["jupyter"] and c["args"] == ["lab"]
+
+    preset = _pod(labels={"c": "y"})
+    preset["metadata"]["name"] = "p-3"
+    preset["spec"]["containers"][0]["command"] = ["mine"]
+    pod2 = cluster.create(preset)
+    assert pod2["spec"]["containers"][0]["command"] == ["mine"]
+
+
+def test_istio_proxy_container_skipped_for_command(cluster):
+    cluster.create(
+        api.pod_default(
+            "cmd", "user-ns", selector={"matchLabels": {"c": "y"}}, command=["x"]
+        )
+    )
+    poddefaults.install(cluster)
+    pod = _pod(labels={"c": "y"})
+    pod["spec"]["containers"].append({"name": "istio-proxy"})
+    out = cluster.create(pod)
+    sidecar = [c for c in out["spec"]["containers"] if c["name"] == "istio-proxy"][0]
+    assert "command" not in sidecar

@@ -1,0 +1,99 @@
+"""TPU slice topology math (kubeflow_tpu/tpu/topology.py)."""
+import pytest
+
+from kubeflow_tpu.tpu.topology import (
+    ACCELERATORS,
+    parse_topology,
+    validate_against_node_capacity,
+)
+
+
+class TestParse:
+    def test_v4_single_host(self):
+        t = parse_topology("v4", "2x2x1")
+        assert t.num_chips == 4
+        assert t.num_hosts == 1
+        assert t.chips_per_host == 4
+        assert t.slice_name == "v4-8"  # 2 cores/chip
+        assert not t.is_multi_host
+
+    def test_v4_multi_host(self):
+        t = parse_topology("v4", "2x2x2")
+        assert t.num_chips == 8
+        assert t.num_hosts == 2
+        assert t.slice_name == "v4-16"
+
+    def test_v4_128(self):
+        t = parse_topology("v4", "4x4x4")
+        assert t.num_chips == 64
+        assert t.num_hosts == 16
+        assert t.slice_name == "v4-128"
+
+    def test_v5e_shapes(self):
+        assert parse_topology("v5e", "2x4").num_hosts == 1
+        assert parse_topology("v5e", "4x4").num_hosts == 2
+        t = parse_topology("v5e", "4x8")
+        assert t.num_hosts == 4
+        assert t.slice_name == "v5e-32"  # 1 core/chip
+
+    def test_v5e_sub_host(self):
+        t = parse_topology("v5e", "2x2")
+        assert t.num_hosts == 1
+        assert t.chips_per_host == 4  # only its own chips
+
+    def test_rejects_unknown_accelerator(self):
+        with pytest.raises(ValueError, match="unknown TPU accelerator"):
+            parse_topology("v99", "2x2")
+
+    def test_rejects_wrong_rank(self):
+        with pytest.raises(ValueError, match="3-d"):
+            parse_topology("v4", "2x2")
+        with pytest.raises(ValueError, match="2-d"):
+            parse_topology("v5e", "2x2x2")
+
+    def test_rejects_non_tiling(self):
+        with pytest.raises(ValueError, match="does not tile"):
+            parse_topology("v4", "3x3x3")
+
+    def test_rejects_garbage(self):
+        for bad in ("", "2x", "x2", "axb", "2x-1x2"):
+            with pytest.raises(ValueError):
+                parse_topology("v4", bad)
+
+
+class TestProjections:
+    def test_node_selectors(self):
+        t = parse_topology("v4", "2x2x2")
+        sel = t.node_selectors()
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v4-podslice"
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x2x2"
+
+    def test_resource_limits(self):
+        assert parse_topology("v4", "2x2x2").resource_limits() == {
+            "google.com/tpu": "4"
+        }
+        assert parse_topology("v5e", "2x2").resource_limits() == {
+            "google.com/tpu": "4"
+        }
+
+    def test_worker_hostnames(self):
+        t = parse_topology("v4", "2x2x2")
+        hosts = t.worker_hostnames("nb", "user-ns")
+        assert hosts == [
+            "nb-0.nb-tpu.user-ns.svc.cluster.local",
+            "nb-1.nb-tpu.user-ns.svc.cluster.local",
+        ]
+
+    def test_capacity_validation(self, cluster):
+        cluster.add_tpu_node_pool("v4", "2x2x2")
+        t_ok = parse_topology("v4", "2x2x2")
+        t_missing = parse_topology("v4", "4x4x4")
+        nodes = cluster.list("Node")
+        assert validate_against_node_capacity(t_ok, nodes)
+        assert not validate_against_node_capacity(t_missing, nodes)
+
+
+def test_all_accelerators_have_consistent_host_blocks():
+    for accel in ACCELERATORS.values():
+        assert len(accel.host_block) == accel.dims
+        assert accel.chips_per_host >= 1

@@ -1,0 +1,107 @@
+"""Headline benchmark: spawned-notebook ResNet-50 training throughput.
+
+Prints ONE JSON line:
+    {"metric": "resnet50_train_imgs_per_sec_per_chip", "value": N,
+     "unit": "img/s/chip", "vs_baseline": R}
+
+The reference publishes no numbers (BASELINE.md: `published: {}`), so the
+baseline is self-established per BASELINE.md's north star: a notebook workload
+should reach >=90% of bare-metal MFU, with 40% MFU taken as the bare-metal
+ResNet-50 training target on TPU. vs_baseline = measured_MFU / (0.90 * 0.40):
+1.0 means the north-star bar is met exactly; higher is better.
+
+Runs on whatever single accelerator is attached (the platform images run the
+identical code; this is the "reference ResNet-50 cell" of BASELINE.md).
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kubeflow_tpu.models.resnet import ResNet50, flops_per_image
+from kubeflow_tpu.parallel import mesh as meshlib
+from kubeflow_tpu.parallel.train import make_classifier_train_step
+
+# bf16 peak FLOP/s per chip by TPU generation (public specs)
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+}
+
+BATCH = 128
+IMAGE = 224
+WARMUP = 3
+STEPS = 10
+
+
+def chip_peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12  # conservative default
+
+
+def main() -> None:
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = meshlib.create_mesh(
+        meshlib.MeshPlan(data=n_chips), devices=devices
+    )
+    model = ResNet50(num_classes=1000)
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    bundle = make_classifier_train_step(model, tx, mesh)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(
+            rng.standard_normal((BATCH * n_chips, IMAGE, IMAGE, 3)), jnp.bfloat16
+        ),
+        "label": jnp.asarray(
+            rng.integers(0, 1000, BATCH * n_chips), jnp.int32
+        ),
+    }
+    sh = {k: meshlib.batch_sharding(mesh) for k in batch}
+    batch = jax.device_put(batch, sh)
+
+    state = bundle.init(jax.random.PRNGKey(0), batch)
+    for _ in range(WARMUP):
+        state, metrics = bundle.step(state, batch)
+    # Hard host readback: on tunneled/remote TPU runtimes block_until_ready on
+    # sharded arrays can return before the device work drains; fetching the
+    # scalar is the only sync point that is honest everywhere.
+    float(metrics["loss"])
+
+    start = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = bundle.step(state, batch)
+    float(metrics["loss"])
+    elapsed = time.perf_counter() - start
+
+    imgs_per_sec = BATCH * n_chips * STEPS / elapsed
+    per_chip = imgs_per_sec / n_chips
+    train_flops = 3.0 * flops_per_image(IMAGE)  # fwd + bwd ~= 3x fwd
+    mfu = per_chip * train_flops / chip_peak_flops(devices[0])
+    vs_baseline = mfu / (0.90 * 0.40)
+
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_imgs_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "img/s/chip",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

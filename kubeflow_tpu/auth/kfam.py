@@ -1,0 +1,156 @@
+"""Access management (kfam): contributor bindings + profile CRUD.
+
+Behavioral parity with the reference access-management service
+(``access-management/kfam/bindings.go``, ``profiles.go``, ``routers.go``):
+each contributor grant is a paired {RoleBinding + Istio AuthorizationPolicy}
+named ``<userkind>-<user>-<rolekind>-<role>`` (sanitized), annotated with
+``user``/``role`` so List() can filter by annotation; the display role names
+(kubeflow-admin/edit/view) map to K8s ClusterRoles (admin/edit/view) and back.
+The REST surface lives in ``webapps/kfam_app.py``; this module is the logic.
+"""
+from __future__ import annotations
+
+import re
+
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster, NotFound
+
+# display name <-> cluster role (ref bindings.go:39-46)
+ROLE_MAP = {
+    "kubeflow-admin": "admin",
+    "kubeflow-edit": "edit",
+    "kubeflow-view": "view",
+    "admin": "kubeflow-admin",
+    "edit": "kubeflow-edit",
+    "view": "kubeflow-view",
+}
+
+_SANITIZE = re.compile(r"[^a-zA-Z0-9]+")
+
+
+def binding_name(user_kind: str, user_name: str, role_kind: str, role_name: str) -> str:
+    """Deterministic binding name (ref getBindingName bindings.go:61-78)."""
+    raw = "-".join(
+        [user_kind, _SANITIZE.sub("-", user_name), role_kind, role_name]
+    ).lower()
+    return _SANITIZE.sub("-", raw)
+
+
+class BindingClient:
+    def __init__(self, cluster: FakeCluster, *, userid_header: str = "kubeflow-userid", userid_prefix: str = "") -> None:
+        self.cluster = cluster
+        self.userid_header = userid_header
+        self.userid_prefix = userid_prefix
+
+    def create(self, user: dict, namespace: str, role: str) -> dict:
+        """Grant ``role`` (display name, e.g. kubeflow-edit) in ``namespace``."""
+        if role not in ROLE_MAP:
+            raise ValueError(f"unknown role {role!r}")
+        name = binding_name(user.get("kind", "User"), user["name"], "ClusterRole", role)
+        annotations = {"user": user["name"], "role": role}
+        rb = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "annotations": annotations,
+            },
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": ROLE_MAP[role],
+            },
+            "subjects": [dict(user)],
+        }
+        authz = {
+            "apiVersion": "security.istio.io/v1beta1",
+            "kind": "AuthorizationPolicy",
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "annotations": annotations,
+            },
+            "spec": {
+                "rules": [
+                    {
+                        "when": [
+                            {
+                                "key": f"request.headers[{self.userid_header}]",
+                                "values": [self.userid_prefix + user["name"]],
+                            }
+                        ]
+                    }
+                ]
+            },
+        }
+        created = self.cluster.create(rb)
+        self.cluster.create(authz)
+        return created
+
+    def delete(self, user: dict, namespace: str, role: str) -> None:
+        name = binding_name(user.get("kind", "User"), user["name"], "ClusterRole", role)
+        # existence check first, like the reference (bindings.go:141-155)
+        self.cluster.get("RoleBinding", name, namespace)
+        self.cluster.get("AuthorizationPolicy", name, namespace)
+        self.cluster.delete("RoleBinding", name, namespace)
+        self.cluster.delete("AuthorizationPolicy", name, namespace)
+
+    def list(self, user: str = "", namespaces: list[str] | None = None, role: str = "") -> list[dict]:
+        """Bindings filtered by user/role annotations (ref bindings.go:179-222)."""
+        out = []
+        for ns in namespaces if namespaces is not None else [None]:
+            for rb in self.cluster.list("RoleBinding", ns):
+                anns = ko.annotations(rb)
+                if "user" not in anns or "role" not in anns:
+                    continue
+                if user and anns["user"] != user:
+                    continue
+                if role and anns["role"] != role:
+                    continue
+                if len(rb.get("subjects", [])) != 1:
+                    continue
+                out.append(
+                    {
+                        "user": rb["subjects"][0],
+                        "referredNamespace": ko.namespace(rb),
+                        "roleRef": {
+                            "kind": "ClusterRole",
+                            "name": ROLE_MAP.get(
+                                rb["roleRef"]["name"], rb["roleRef"]["name"]
+                            ),
+                        },
+                    }
+                )
+        return out
+
+
+class ProfileClient:
+    """Profile CRUD (ref profiles.go:38-95) + cluster-admin check."""
+
+    def __init__(self, cluster: FakeCluster, *, cluster_admins: set[str] | None = None) -> None:
+        self.cluster = cluster
+        self.cluster_admins = cluster_admins or set()
+
+    def create(self, profile: dict) -> dict:
+        return self.cluster.create(profile)
+
+    def get(self, name: str) -> dict:
+        return self.cluster.get("Profile", name)
+
+    def delete(self, name: str) -> None:
+        self.cluster.delete("Profile", name)
+
+    def is_cluster_admin(self, user: str) -> bool:
+        return user in self.cluster_admins
+
+    def namespaces_for_user(self, user: str, binding_client: BindingClient) -> list[str]:
+        owned = [
+            ko.name(p)
+            for p in self.cluster.list("Profile")
+            if p.get("spec", {}).get("owner", {}).get("name") == user
+        ]
+        contributed = [
+            b["referredNamespace"] for b in binding_client.list(user=user)
+        ]
+        return sorted(set(owned + contributed))

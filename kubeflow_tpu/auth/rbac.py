@@ -1,0 +1,98 @@
+"""Header authn + SubjectAccessReview-style authz.
+
+The shared auth plane of every backend (reference:
+``crud_backend/authn.py:12-67`` header identity and
+``crud_backend/authz.py:25-132`` per-verb SubjectAccessReview). The evaluator
+implements the subset of K8s RBAC the platform emits: namespaced RoleBindings
+to the well-known ClusterRoles (admin/edit/view + kubeflow-* aliases), which is
+exactly what profile-controller and kfam create.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster
+
+USERID_HEADER = "kubeflow-userid"
+
+READ_VERBS = {"get", "list", "watch"}
+WRITE_VERBS = {"create", "update", "patch", "delete"}
+
+# ClusterRole rule sets the platform grants (kubeflow-edit may not touch RBAC,
+# matching the reference's comment at profile_controller.go:215-217).
+ROLE_RULES = {
+    "admin": {"*": READ_VERBS | WRITE_VERBS},
+    "edit": {
+        "*": READ_VERBS | WRITE_VERBS,
+        "rolebindings": set(),
+        "authorizationpolicies": set(),
+    },
+    "view": {"*": READ_VERBS},
+}
+ROLE_ALIASES = {
+    "kubeflow-admin": "admin",
+    "kubeflow-edit": "edit",
+    "kubeflow-view": "view",
+}
+
+
+class AuthError(Exception):
+    status = 401
+
+
+class Forbidden(AuthError):
+    status = 403
+
+
+@dataclasses.dataclass(frozen=True)
+class User:
+    name: str
+    groups: tuple[str, ...] = ()
+
+
+def authenticate(headers, *, userid_header: str = USERID_HEADER, userid_prefix: str = "") -> User:
+    """Trusted-header authn (the Istio gateway sets the header upstream;
+    ref authn.py:12-67 + settings.py:5)."""
+    raw = headers.get(userid_header) if hasattr(headers, "get") else None
+    if not raw:
+        raise AuthError(f"no {userid_header} header present")
+    if userid_prefix and raw.startswith(userid_prefix):
+        raw = raw[len(userid_prefix):]
+    return User(name=raw)
+
+
+class Authorizer:
+    """SubjectAccessReview against the cluster's RoleBindings
+    (ref authz.py:46-80 posts a SAR to the API server; here the evaluator and
+    the store live in-process)."""
+
+    def __init__(self, cluster: FakeCluster, *, cluster_admins: set[str] | None = None) -> None:
+        self.cluster = cluster
+        self.cluster_admins = set(cluster_admins or ())
+
+    def allowed(self, user: User, verb: str, resource: str, namespace: str) -> bool:
+        if user.name in self.cluster_admins:
+            return True
+        for rb in self.cluster.list("RoleBinding", namespace):
+            if not any(
+                s.get("name") == user.name for s in rb.get("subjects", [])
+            ):
+                continue
+            role = rb.get("roleRef", {}).get("name", "")
+            rules = ROLE_RULES.get(ROLE_ALIASES.get(role, role))
+            if rules is None:
+                continue
+            verbs = rules.get(resource.lower(), rules.get("*", set()))
+            if verb in verbs:
+                return True
+        return False
+
+    def ensure(self, user: User, verb: str, resource: str, namespace: str) -> None:
+        """Raise Forbidden with the reference's message shape
+        (authz.py:81-95) when denied."""
+        if not self.allowed(user, verb, resource, namespace):
+            raise Forbidden(
+                f"User '{user.name}' is not authorized to {verb} {resource} "
+                f"in namespace '{namespace}'"
+            )

@@ -327,8 +327,9 @@ class NotebookReconciler(Reconciler):
         children = [(p["metadata"]["name"], "Pod") for p in cluster.list(
             "Pod", ns, {"matchLabels": {"statefulset": name}}
         )] + [(name, "StatefulSet")]
+        all_events = cluster.list("Event", ns)
         for child_name, child_kind in children:
-            for ev in cluster.list("Event", ns):
+            for ev in all_events:
                 io = ev.get("involvedObject", {})
                 if (
                     io.get("kind") == child_kind

@@ -1,0 +1,216 @@
+"""Tensorboard reconciler: Tensorboard CR → Deployment + Service + VirtualService.
+
+Behavioral parity with the reference
+(``tensorboard-controller/controllers/tensorboard_controller.go:67-459``):
+``spec.logspath`` scheme dispatch — ``pvc://<claim>/<sub/path>`` mounts the
+claim, ``gs://`` paths run against object storage (with optional GCP creds
+secret mount, ref go:232-247), ``s3://`` passes through env credentials; RWO
+PVC co-scheduling pins the viewer onto the node already mounting the claim via
+node affinity (ref generateNodeAffinity go:416-459); VirtualService route
+``/tensorboard/<ns>/<name>/`` with the reference's 300 s timeout (go:358).
+
+TPU-native: ``gs://`` logdirs are the *primary* path (XLA/TPU profiler traces
+written by the in-image ``kubeflow_tpu.utils.profiling`` capture), and the
+viewer container gets ``--load_fast=false`` plus the profiler plugin enabled so
+device traces from a pod slice render (SURVEY.md §5 "tracing" gap).
+"""
+from __future__ import annotations
+
+import os
+
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime import reconcilehelper as helper
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.runtime.manager import Reconciler, Result
+from kubeflow_tpu.utils.config import ControllerConfig
+
+DEFAULT_IMAGE = "tensorflow/tensorflow:2.5.1"
+ROUTE_TIMEOUT = "300s"  # ref go:358
+
+
+def parse_logspath(logspath: str) -> tuple[str, str]:
+    """-> (scheme, rest); scheme in {pvc, gs, s3, unknown}."""
+    for scheme in ("pvc", "gs", "s3"):
+        prefix = scheme + "://"
+        if logspath.startswith(prefix):
+            return scheme, logspath[len(prefix):]
+    return "unknown", logspath
+
+
+class TensorboardReconciler(Reconciler):
+    kind = "Tensorboard"
+
+    def __init__(self, config: ControllerConfig | None = None, *,
+                 image: str | None = None,
+                 rwo_pvc_scheduling: bool = True,
+                 gcp_creds_secret: str | None = None) -> None:
+        self.config = config or ControllerConfig()
+        # TENSORBOARD_IMAGE env knob, ref go:172
+        self.image = image or os.environ.get("TENSORBOARD_IMAGE", DEFAULT_IMAGE)
+        # RWO_PVC_SCHEDULING env knob, ref go:464-474
+        self.rwo_pvc_scheduling = rwo_pvc_scheduling
+        self.gcp_creds_secret = gcp_creds_secret
+
+    def watches(self):
+        return [self.owns("Deployment"), self.owns("Service"),
+                self.owns("VirtualService")]
+
+    def reconcile(self, cluster: FakeCluster, namespace: str, name: str) -> Result | None:
+        tb = cluster.try_get("Tensorboard", name, namespace)
+        if tb is None:
+            return None
+        helper.reconcile_object(
+            cluster, self.generate_deployment(cluster, tb), owner=tb
+        )
+        helper.reconcile_object(
+            cluster, self.generate_service(tb), owner=tb,
+            copy_fields=helper.copy_service_fields,
+        )
+        if self.config.use_istio:
+            helper.reconcile_object(
+                cluster, self.generate_virtual_service(tb), owner=tb
+            )
+        self._update_status(cluster, tb)
+        return None
+
+    # ------------------------------------------------------------ generators
+
+    def generate_deployment(self, cluster: FakeCluster, tb: dict) -> dict:
+        name, ns = ko.name(tb), ko.namespace(tb)
+        logspath = tb.get("spec", {}).get("logspath", "")
+        scheme, rest = parse_logspath(logspath)
+
+        container: dict = {
+            "name": "tensorboard",
+            "image": self.image,
+            "command": ["/usr/local/bin/tensorboard"],
+            "args": [
+                f"--logdir={logspath if scheme != 'pvc' else '/tensorboard_logs'}",
+                "--bind_all",
+                "--load_fast=false",  # profiler plugin needs the slow loader
+            ],
+            "ports": [{"containerPort": 6006, "name": "http"}],
+        }
+        pod_spec: dict = {"containers": [container]}
+
+        if scheme == "pvc":
+            claim, _, subpath = rest.partition("/")
+            mount: dict = {"name": "logs", "mountPath": "/tensorboard_logs"}
+            if subpath:
+                mount["subPath"] = subpath
+            container["volumeMounts"] = [mount]
+            pod_spec["volumes"] = [
+                {"name": "logs",
+                 "persistentVolumeClaim": {"claimName": claim}}
+            ]
+            if self.rwo_pvc_scheduling:
+                affinity = self._rwo_affinity(cluster, ns, claim)
+                if affinity:
+                    pod_spec["affinity"] = affinity
+        elif scheme == "gs" and self.gcp_creds_secret:
+            # ref go:232-247: user-gcp-sa style secret mount
+            container["volumeMounts"] = [
+                {"name": "gcp-creds", "mountPath": "/secret/gcp", "readOnly": True}
+            ]
+            container.setdefault("env", []).append(
+                {"name": "GOOGLE_APPLICATION_CREDENTIALS",
+                 "value": "/secret/gcp/key.json"}
+            )
+            pod_spec["volumes"] = [
+                {"name": "gcp-creds", "secret": {"secretName": self.gcp_creds_secret}}
+            ]
+
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "replicas": 1,  # viewer is single-replica, ref go:255
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": pod_spec,
+                },
+            },
+        }
+
+    def _rwo_affinity(self, cluster: FakeCluster, namespace: str, claim: str) -> dict | None:
+        """Pin to the node of a pod already mounting the RWO claim
+        (ref generateNodeAffinity go:416-459)."""
+        pvc = cluster.try_get("PersistentVolumeClaim", claim, namespace)
+        if pvc is None or "ReadWriteOnce" not in (
+            pvc.get("spec", {}).get("accessModes") or []
+        ):
+            return None
+        for pod in cluster.list("Pod", namespace):
+            node = pod.get("spec", {}).get("nodeName")
+            if not node:
+                continue
+            for vol in pod.get("spec", {}).get("volumes", []):
+                if vol.get("persistentVolumeClaim", {}).get("claimName") == claim:
+                    return {
+                        "nodeAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": {
+                                "nodeSelectorTerms": [
+                                    {"matchFields": [
+                                        {"key": "metadata.name",
+                                         "operator": "In",
+                                         "values": [node]}
+                                    ]}
+                                ]
+                            }
+                        }
+                    }
+        return None
+
+    def generate_service(self, tb: dict) -> dict:
+        name, ns = ko.name(tb), ko.namespace(tb)
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {"app": name},
+                "ports": [{"name": "http", "port": 80, "targetPort": 6006}],
+            },
+        }
+
+    def generate_virtual_service(self, tb: dict) -> dict:
+        cfg = self.config
+        name, ns = ko.name(tb), ko.namespace(tb)
+        prefix = f"/tensorboard/{ns}/{name}/"
+        return {
+            "apiVersion": "networking.istio.io/v1alpha3",
+            "kind": "VirtualService",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "hosts": [cfg.istio_host],
+                "gateways": [cfg.istio_gateway],
+                "http": [
+                    {
+                        "match": [{"uri": {"prefix": prefix}}],
+                        "rewrite": {"uri": "/"},
+                        "route": [
+                            {
+                                "destination": {
+                                    "host": f"{name}.{ns}.svc.{cfg.cluster_domain}",
+                                    "port": {"number": 80},
+                                }
+                            }
+                        ],
+                        "timeout": ROUTE_TIMEOUT,
+                    }
+                ],
+            },
+        }
+
+    def _update_status(self, cluster: FakeCluster, tb: dict) -> None:
+        name, ns = ko.name(tb), ko.namespace(tb)
+        dep = cluster.try_get("Deployment", name, ns)
+        ready = (dep or {}).get("status", {}).get("readyReplicas", 0)
+        status = {"readyReplicas": ready}
+        fresh = cluster.try_get("Tensorboard", name, ns)
+        if fresh is not None and fresh.get("status") != status:
+            fresh["status"] = status
+            cluster.update(fresh)

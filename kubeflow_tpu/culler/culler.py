@@ -116,15 +116,20 @@ class Culler:
         """
         now = self.clock()
         anns = ko.annotations(nb)
+        if stop_annotation_is_set(nb):
+            # Stopped: never (re-)seed last-activity — set_stop_annotation
+            # removed it deliberately so a restart re-initializes the idle
+            # clock (would instantly re-cull otherwise).
+            if not self.needs_check(nb):
+                return False
+            ko.set_annotation(nb, api.LAST_ACTIVITY_CHECK_TS, format_time(now))
+            return True
         if api.LAST_ACTIVITY_ANNOTATION not in anns:
             ko.set_annotation(nb, api.LAST_ACTIVITY_ANNOTATION, format_time(now))
             ko.set_annotation(nb, api.LAST_ACTIVITY_CHECK_TS, format_time(now))
             return True
         if not self.needs_check(nb):
             return False
-        if stop_annotation_is_set(nb):
-            ko.set_annotation(nb, api.LAST_ACTIVITY_CHECK_TS, format_time(now))
-            return True
         kernels = (
             self.fetch_kernels(ko.namespace(nb), ko.name(nb))
             if self.fetch_kernels

@@ -1,0 +1,104 @@
+"""ResNet-50 — the platform's reference notebook workload.
+
+BASELINE.md's north-star metric is "spawned-notebook JAX ResNet-50 img/s/chip"
+(the TPU-native stand-in for the reference images' torch/cuda workloads,
+``jupyter-pytorch/cuda-requirements.txt:2``). TPU-first choices:
+
+- bfloat16 activations/compute, float32 params and batch-norm statistics
+  (MXU-native mixed precision; casts fuse into the convs).
+- NHWC layout throughout — XLA:TPU's native conv layout, keeps the channel
+  dim on the 128-lane axis.
+- No data-dependent Python control flow: the whole step traces once.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(
+            self.filters, (3, 3), (self.strides, self.strides),
+            use_bias=False, name="conv2",
+        )(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1), use_bias=False, name="conv3")(y)
+        # zero-init gamma on the last BN of each block: residual branch starts
+        # as identity, the standard large-batch training recipe
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), (self.strides, self.strides),
+                use_bias=False, name="proj_conv",
+            )(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype, param_dtype=jnp.float32)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 use_bias=False, name="stem_conv")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                x = BottleneckBlock(
+                    filters=self.width * 2 ** i,
+                    strides=2 if i > 0 and j == 0 else 1,
+                    conv=conv,
+                    norm=norm,
+                    name=f"stage{i + 1}_block{j + 1}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        # classifier head in fp32 for a numerically stable softmax
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2])   # (basic-block depths reused
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3])   # as bottlenecks: test-scale)
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3])
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])
+
+
+def flops_per_image(image_size: int = 224) -> float:
+    """Approx fwd-pass FLOPs for ResNet-50 (2 * MACs); training ≈ 3x this."""
+    # 4.09 GMACs at 224x224 scales quadratically with resolution.
+    return 2 * 4.09e9 * (image_size / 224) ** 2

@@ -1,0 +1,177 @@
+"""Decoder-only transformer LM — the platform's long-context notebook workload.
+
+Companion flagship to ResNet-50 (BASELINE.md configs): exercises the attention
+stack (``ops/attention.py``, ``ops/pallas_attention.py``,
+``parallel/ring_attention.py``) and the tensor/sequence-parallel sharding rules
+(``parallel/mesh.py`` — param names ``q_proj``/``o_proj``/``up_proj``/
+``down_proj`` are the TP rule's contract).
+
+TPU-first: bf16 activations, fp32 params/norms; RoPE; SwiGLU; all loops traced
+(no Python control flow under jit); attention implementation selected
+statically per config:
+
+    "xla"    naive materialized scores (small contexts, maximal fusion)
+    "block"  blockwise streaming softmax (long context, single host)
+    "flash"  Pallas TPU kernel
+    "ring"   ring attention over the ``seq`` mesh axis (multi-host contexts)
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops import attention as att
+from kubeflow_tpu.ops.pallas_attention import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: int | None = None      # grouped-query attention; None = MHA
+    embed_dim: int = 768
+    mlp_dim: int = 3072
+    max_seq_len: int = 2048
+    rope_theta: float = 10_000.0
+    attention_impl: str = "block"        # xla | block | flash | ring
+    attention_block_size: int = 512
+    dtype: Any = jnp.bfloat16
+    mesh: Any = None                     # required for attention_impl == "ring"
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+
+def rope(x, positions, theta: float):
+    """Rotary embeddings; x [B, S, H, D], positions [S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.epsilon
+        )
+        return (normed * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        B, S, E = x.shape
+        H, KV, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        dense = partial(
+            nn.DenseGeneral, dtype=cfg.dtype, param_dtype=jnp.float32,
+            use_bias=False,
+        )
+        q = dense(features=(H, D), name="q_proj")(x)
+        k = dense(features=(KV, D), name="k_proj")(x)
+        v = dense(features=(KV, D), name="v_proj")(x)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        if KV != H:  # GQA: expand kv heads to query heads
+            reps = H // KV
+            k = jnp.repeat(k, reps, axis=2)
+            v = jnp.repeat(v, reps, axis=2)
+
+        if cfg.attention_impl == "xla":
+            o = att.naive_attention(q, k, v, causal=True)
+        elif cfg.attention_impl == "block":
+            o = att.blockwise_attention(
+                q, k, v, causal=True, block_size=cfg.attention_block_size
+            )
+        elif cfg.attention_impl == "flash":
+            o = flash_attention(
+                q, k, v, True, cfg.attention_block_size, cfg.attention_block_size
+            )
+        elif cfg.attention_impl == "ring":
+            if cfg.mesh is None:
+                raise ValueError("attention_impl='ring' requires cfg.mesh")
+            from kubeflow_tpu.parallel.ring_attention import ring_attention
+
+            o = ring_attention(q, k, v, cfg.mesh, axis_name="seq", causal=True)
+        else:
+            raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
+        o = o.reshape(B, S, H * D)
+        return dense(features=E, axis=-1, name="o_proj")(o)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = partial(
+            nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32, use_bias=False
+        )
+        gate = dense(cfg.mlp_dim, name="gate_proj")(x)
+        up = dense(cfg.mlp_dim, name="up_proj")(x)
+        return dense(cfg.embed_dim, name="down_proj")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = x + Attention(self.cfg, name="attn")(
+            RMSNorm(name="attn_norm")(x), positions
+        )
+        x = x + MLP(self.cfg, name="mlp")(RMSNorm(name="mlp_norm")(x))
+        return x
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        cfg = self.cfg
+        B, S = tokens.shape
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.embed_dim,
+            dtype=cfg.dtype, param_dtype=jnp.float32, name="embed",
+        )
+        x = embed(tokens)
+        positions = jnp.arange(S)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(name="final_norm")(x)
+        # tied output head via embed attend (fp32 logits)
+        logits = embed.attend(x.astype(jnp.float32))
+        return logits
+
+
+def lm_loss(logits, tokens):
+    """Next-token cross entropy (shift inside; tokens [B, S])."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

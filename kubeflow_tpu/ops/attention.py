@@ -1,0 +1,111 @@
+"""Attention ops: streaming-softmax primitives shared by the XLA blockwise
+path, the Pallas TPU kernel, and ring attention.
+
+No reference analog (the reference ships no model code, SURVEY.md §2); these
+ops exist so the platform's notebook images and benchmark models have a
+long-context-capable attention that is TPU-shaped end to end:
+
+- math in float32 accumulators, inputs/outputs bfloat16;
+- blockwise streaming softmax (online max/normalizer) so memory is
+  O(block²) not O(seq²) — the same recurrence ring attention extends
+  across hosts (``parallel/ring_attention.py``);
+- every loop is ``lax.scan`` over static block counts: one trace, MXU-sized
+  matmuls inside.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def naive_attention(q, k, v, *, causal: bool = True, scale: float | None = None):
+    """Materialized-scores attention; the correctness oracle for everything else.
+
+    Shapes: q [B, Sq, H, D], k/v [B, Sk, H, D] -> [B, Sq, H, D].
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        qpos = jnp.arange(q.shape[1])[:, None]
+        kpos = jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v
+    ).astype(q.dtype)
+
+
+def _block_update(carry, s, v_blk):
+    """One streaming-softmax step: fold scores s [B,H,q,k] and values v_blk
+    into (o, m, l). Numerics in fp32."""
+    o, m, l = carry
+    m_blk = jnp.max(s, axis=-1)                       # [B,H,q]
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows: keep m_new finite so exp() stays 0, not NaN
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])                 # [B,H,q,k]
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+    )
+    o_new = o * correction[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def blockwise_scores(q, k, scale, q_offset, k_offset, causal):
+    """Scaled (+ causally masked) scores for one (q-block, k-block) pair with
+    *global* position offsets — the piece ring attention reuses across hosts."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])[:, None]
+        kpos = k_offset + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+    return s
+
+
+def _init_carry(batch, heads, q_len, dim):
+    return (
+        jnp.zeros((batch, heads, q_len, dim), jnp.float32),
+        jnp.full((batch, heads, q_len), NEG_INF, jnp.float32),
+        jnp.zeros((batch, heads, q_len), jnp.float32),
+    )
+
+
+def finalize(o, m, l):
+    """Normalize the accumulator; fully-masked rows (l==0) produce zeros."""
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return o / l_safe[..., None]
+
+
+@partial(jax.jit, static_argnames=("causal", "block_size"))
+def blockwise_attention(q, k, v, *, causal: bool = True, block_size: int = 512):
+    """Memory-efficient attention: O(S·block) memory, identical math to
+    ``naive_attention``. Differentiable (pure lax ops; XLA rematerializes)."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    bs = min(block_size, Sk)
+    if Sk % bs:
+        raise ValueError(f"sequence {Sk} must divide block_size {bs}")
+    n_blocks = Sk // bs
+    scale = D ** -0.5
+
+    k_blocks = k.reshape(B, n_blocks, bs, H, D).transpose(1, 0, 2, 3, 4)
+    v_blocks = v.reshape(B, n_blocks, bs, H, D).transpose(1, 0, 2, 3, 4)
+
+    def scan_kv(carry, xs):
+        idx, k_blk, v_blk = xs
+        s = blockwise_scores(q, k_blk, scale, 0, idx * bs, causal)
+        return _block_update(carry, s, v_blk), None
+
+    carry = _init_carry(B, H, Sq, D)
+    (o, m, l), _ = lax.scan(
+        scan_kv, carry, (jnp.arange(n_blocks), k_blocks, v_blocks)
+    )
+    return finalize(o, m, l).transpose(0, 2, 1, 3).astype(q.dtype)

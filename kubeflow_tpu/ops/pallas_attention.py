@@ -1,0 +1,160 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the transformer family gets a hand-tiled kernel (SURVEY.md has no
+reference analog — the reference's compute lives in opaque CUDA wheels; this is
+the platform's native-kernel layer, per the Pallas TPU guide):
+
+- grid (B, H, q_blocks, k_blocks): q/k/v blocks staged HBM→VMEM by BlockSpecs,
+  k as the innermost (sequential) dimension so VMEM scratch carries the
+  streaming-softmax state (acc, m, l) across k-iterations;
+- scores on the MXU via ``jnp.dot(..., preferred_element_type=f32)``,
+  softmax bookkeeping on the VPU in fp32, output written once on the last
+  k-block;
+- lane-replicated (bq, 128) m/l scratch to respect the fp32 (8,128) tile.
+
+Backward pass: recompute via the XLA blockwise path (``ops/attention.py``)
+under ``jax.custom_vjp`` — O(S·block) memory like the forward. A fused Pallas
+bwd kernel is a later-round optimization.
+
+Runs in interpreter mode off-TPU (tests), compiled Mosaic on TPU.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas extras are absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from kubeflow_tpu.ops.attention import NEG_INF, blockwise_attention
+
+LANES = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, causal, bq, bk, nk):
+    ik = pl.program_id(3)
+    iq = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
+    k = k_ref[0, 0].astype(jnp.float32)          # [bk, D]
+    v = v_ref[0, 0].astype(jnp.float32)          # [bk, D]
+
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale  # [bq, bk]
+    if causal:
+        qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+
+    m_prev = m_ref[:, :1]                         # [bq, 1] (lane-replicated)
+    l_prev = l_ref[:, :1]
+    m_blk = jnp.max(s, axis=-1, keepdims=True)    # [bq, 1]
+    m_new = jnp.maximum(m_prev, m_blk)
+    p = jnp.exp(s - m_new)                        # [bq, bk]
+    corr = jnp.exp(m_prev - m_new)                # [bq, 1]
+    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * corr + jnp.dot(
+        p, v, preferred_element_type=jnp.float32
+    )
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, ...] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    if Sq % bq or Sk % bk:
+        raise ValueError(f"seq lengths ({Sq},{Sk}) must divide blocks ({bq},{bk})")
+    nq, nk = Sq // bq, Sk // bk
+    scale = D ** -0.5
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+    )
+    scratch = [
+        pltpu.VMEM((bq, D), jnp.float32) if _HAS_PLTPU else pl.MemorySpace.ANY,
+        pltpu.VMEM((bq, LANES), jnp.float32) if _HAS_PLTPU else pl.MemorySpace.ANY,
+        pltpu.VMEM((bq, LANES), jnp.float32) if _HAS_PLTPU else pl.MemorySpace.ANY,
+    ]
+    grid = (B, H, nq, nk)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        scratch_shapes=scratch,
+        compiler_params=(
+            pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+            )
+            if _HAS_PLTPU and not interpret
+            else None
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return out
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512,
+    interpret: bool | None = None,
+):
+    """Fused attention. Layout [B, S, H, D] (matching ops/attention.py)."""
+    if interpret is None:
+        interpret = _auto_interpret()
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = _flash_forward(
+        qt, kt, vt, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out.transpose(0, 2, 1, 3)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    return flash_attention(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # memory-efficient recompute through the XLA blockwise path
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(
+            q, k, v, causal=causal, block_size=block_k
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,79 @@
+"""In-image distributed bootstrap: env contract → JAX mesh, zero user code.
+
+This is the workload half of the platform's distributed backend (the control
+half is ``webhooks/tpu_env.py``, which injects the env at pod admission). The
+reference ships NCCL opaquely inside CUDA wheels and has no coordination code
+at all (SURVEY.md §5 "Distributed communication backend"); here the contract is
+explicit and testable:
+
+    TPU_WORKER_ID / TPU_WORKER_HOSTNAMES / JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID
+
+``auto_initialize()`` is called by the image's sitecustomize (or the first
+``kubeflow_tpu`` import inside a notebook): single-host slices skip
+``jax.distributed`` entirely; multi-host slices join the coordinator that
+admission pointed them at, forming the ICI/DCN mesh before user code runs.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def env_worker_context() -> dict | None:
+    """Parse the injected worker-identity env; None when not on a slice."""
+    if "TPU_WORKER_ID" not in os.environ:
+        return None
+    hostnames = [
+        h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h
+    ]
+    return {
+        "worker_id": int(os.environ["TPU_WORKER_ID"]),
+        "hostnames": hostnames,
+        "num_processes": int(
+            os.environ.get("JAX_NUM_PROCESSES", str(max(1, len(hostnames))))
+        ),
+        "process_id": int(
+            os.environ.get("JAX_PROCESS_ID", os.environ["TPU_WORKER_ID"])
+        ),
+        "coordinator": os.environ.get("JAX_COORDINATOR_ADDRESS"),
+        "topology": os.environ.get("TPU_TOPOLOGY"),
+        "accelerator_type": os.environ.get("TPU_ACCELERATOR_TYPE"),
+    }
+
+
+def auto_initialize(*, force: bool = False) -> dict | None:
+    """Join the slice-wide JAX runtime if (and only if) this is a multi-host pod.
+
+    Idempotent; safe to call from notebook kernels that restart (the culler
+    restart path re-forms the identical mesh because admission re-injects the
+    same identity, ``webhooks/tpu_env.py``).
+    """
+    global _initialized
+    ctx = env_worker_context()
+    if ctx is None:
+        return None
+    if ctx["num_processes"] <= 1:
+        return ctx  # single host: local runtime is already the whole mesh
+    if _initialized and not force:
+        return ctx
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=ctx["coordinator"],
+        num_processes=ctx["num_processes"],
+        process_id=ctx["process_id"],
+    )
+    _initialized = True
+    log.info(
+        "joined TPU slice %s as process %d/%d (coordinator %s)",
+        ctx["topology"],
+        ctx["process_id"],
+        ctx["num_processes"],
+        ctx["coordinator"],
+    )
+    return ctx

@@ -1,0 +1,157 @@
+"""Device-mesh construction and sharding rules.
+
+The platform's scaling model (SURVEY.md §7, "How to Scale Your Model" recipe):
+pick a mesh, annotate shardings, let XLA insert the collectives over ICI.
+Axis vocabulary used across the framework:
+
+    data     pure data parallelism (batch split, psum'd grads over DCN/ICI)
+    fsdp     data parallelism with parameter/optimizer sharding (ZeRO-3 style:
+             params all-gathered per layer, grads reduce-scattered)
+    tensor   tensor/model parallelism (matmul column/row splits)
+    seq      sequence/context parallelism (ring attention, blockwise KV)
+    expert   expert parallelism (MoE; placeholder axis until the MoE family lands)
+
+Meshes are constructed so the fastest-varying axes map to the tightest ICI
+neighborhoods (tensor innermost), matching TPU torus locality.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("data", "fsdp", "seq", "expert", "tensor")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A named parallelism layout, e.g. MeshPlan(data=2, fsdp=2, tensor=2)."""
+
+    data: int = 1
+    fsdp: int = 1
+    seq: int = 1
+    expert: int = 1
+    tensor: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.data * self.fsdp * self.seq * self.expert * self.tensor
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in AXES}
+
+
+def create_mesh(plan: MeshPlan, devices: Sequence | None = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if plan.size != len(devices):
+        raise ValueError(
+            f"mesh plan needs {plan.size} devices "
+            f"({plan.axis_sizes()}), have {len(devices)}"
+        )
+    shape = tuple(plan.axis_sizes()[a] for a in AXES)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def auto_plan(n_devices: int, *, tensor: int = 1, seq: int = 1) -> MeshPlan:
+    """Default layout: requested tensor/seq degree, rest goes to fsdp."""
+    rest, rem = divmod(n_devices, tensor * seq)
+    if rem:
+        raise ValueError(
+            f"{n_devices} devices not divisible by tensor={tensor} * seq={seq}"
+        )
+    return MeshPlan(fsdp=rest, tensor=tensor, seq=seq)
+
+
+def batch_spec() -> P:
+    """Batch dims shard over every data-ish axis (data × fsdp)."""
+    return P(("data", "fsdp"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec())
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+# ---------------------------------------------------------------- param rules
+
+
+def fsdp_param_spec(path: tuple[str, ...], value) -> P:
+    """ZeRO-3-style parameter sharding rule.
+
+    Shard the largest dim of every >=2-d parameter over ``fsdp`` (XLA turns the
+    per-layer use into all-gather, and grad accumulation into reduce-scatter).
+    1-d params (biases, norm scales) stay replicated — sharding them buys
+    nothing and costs collective launches.
+    """
+    shape = getattr(value, "shape", ())
+    if len(shape) < 2:
+        return P()
+    largest = int(np.argmax(shape))
+    if shape[largest] < 128:  # don't shard tiny dims below tile size
+        return P()
+    spec: list = [None] * len(shape)
+    spec[largest] = "fsdp"
+    return P(*spec)
+
+
+def tensor_param_spec(path: tuple[str, ...], value) -> P:
+    """Megatron-style TP rule for transformer blocks, composed with fsdp.
+
+    Column-parallel for QKV/up projections (last dim over ``tensor``),
+    row-parallel for output/down projections (first dim over ``tensor``).
+    Identified by path naming convention: *_col / *_row markers set by the
+    model code (models/transformer.py).
+    """
+    shape = getattr(value, "shape", ())
+    joined = "/".join(path)
+    if len(shape) < 2:
+        return P()
+    if any(m in joined for m in ("q_proj", "k_proj", "v_proj", "up_proj", "gate_proj")):
+        return P("fsdp", "tensor")
+    if any(m in joined for m in ("o_proj", "down_proj")):
+        return P("tensor", "fsdp")
+    if "embed" in joined:
+        return P(None, "fsdp")
+    return fsdp_param_spec(path, value)
+
+
+def _legalize(spec: P, shape: tuple, mesh: Mesh) -> P:
+    """Drop axis assignments a dim can't honor (size not divisible by the mesh
+    axis product) — odd mesh degrees degrade to replication, never error."""
+    out = []
+    for i, entry in enumerate(spec):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        degree = math.prod(mesh.shape[a] for a in axes)
+        out.append(entry if shape[i] % degree == 0 else None)
+    return P(*out)
+
+
+def param_shardings(mesh: Mesh, params, rule=fsdp_param_spec):
+    """Map a param pytree to NamedShardings via a rule(path, value) -> P."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def path_str(kp):
+        return tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+
+    specs = {
+        jax.tree_util.keystr(kp): NamedSharding(
+            mesh, _legalize(rule(path_str(kp), v), getattr(v, "shape", ()), mesh)
+        )
+        for kp, v in flat
+    }
+
+    def lookup(kp, v):
+        return specs[jax.tree_util.keystr(kp)]
+
+    return jax.tree_util.tree_map_with_path(lookup, params)

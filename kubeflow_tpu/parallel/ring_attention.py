@@ -1,0 +1,81 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+Long-context is first-class in this platform (SURVEY.md §5: the reference has
+no model/SP code at all; the north star requires the *infrastructure* analog —
+here is the compute analog). Sequences shard over the ``seq`` mesh axis; K/V
+blocks rotate around the ring with ``lax.ppermute`` over ICI neighbors while
+every host's queries accumulate the streaming softmax
+(``ops/attention.py``), overlapping the permute with the local matmul. Memory
+per host is O(S/n · block), total communication is the classic ring all-gather
+cost paid incrementally — ICI-bandwidth-bound, never materializing S×S.
+
+Public pattern: Ring Attention (Liu et al. 2023) / blockwise transformers,
+re-expressed with shard_map + ppermute so XLA schedules the overlap.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.ops.attention import (
+    _block_update,
+    _init_carry,
+    blockwise_scores,
+    finalize,
+)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+    """Per-shard body (runs under shard_map): q/k/v are the local sequence
+    chunk [B, S_local, H, D]."""
+    B, S_local, H, D = q.shape
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    scale = D ** -0.5
+    # device i sends its current K/V to i+1: after r steps we hold the chunk
+    # originally living on (my_idx - r) mod n
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, r):
+        o, m, l, k_cur, v_cur = carry
+        src = (my_idx - r) % n
+        s = blockwise_scores(
+            q, k_cur, scale, my_idx * S_local, src * S_local, causal
+        )
+        o, m, l = _block_update((o, m, l), s, v_cur)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt), None
+
+    o, m, l = _init_carry(B, H, S_local, D)
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o, m, l, k, v), jnp.arange(n)
+    )
+    return finalize(o, m, l).transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis_name", "causal"))
+def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "seq", causal: bool = True):
+    """Exact attention with sequences sharded over ``axis_name``.
+
+    q/k/v: [B, S, H, D] global shape, S sharded over the ring axis; batch
+    sharded over data axes as usual. Output sharding matches q.
+    """
+    spec = P(("data", "fsdp"), axis_name, None, None)
+    fn = shard_map_attention(mesh, axis_name=axis_name, causal=causal, spec=spec)
+    return fn(q, k, v)
+
+
+def shard_map_attention(mesh: Mesh, *, axis_name: str, causal: bool, spec: P):
+    body = partial(_ring_attention_local, axis_name=axis_name, causal=causal)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )

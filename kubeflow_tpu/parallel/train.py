@@ -1,0 +1,165 @@
+"""Sharded training-step builder.
+
+One jitted SPMD program per (model, mesh-plan): params/optimizer sharded by the
+mesh rules (``parallel/mesh.py``), batch sharded over data axes, XLA inserting
+all-gather/reduce-scatter/psum over ICI. No pmap, no per-device Python loops —
+the scaling-book recipe (SURVEY.md §7). State is donated so params update
+in-place in HBM.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kubeflow_tpu.parallel import mesh as meshlib
+
+
+@dataclasses.dataclass
+class TrainStepBundle:
+    """Everything a notebook (or bench harness) needs to run training."""
+
+    init: Callable  # (rng, sample_batch) -> state (sharded)
+    step: Callable  # (state, batch) -> (state, metrics); jitted
+    state_shardings: Any = None
+
+
+def cross_entropy_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def make_classifier_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    param_rule=meshlib.fsdp_param_spec,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+) -> TrainStepBundle:
+    """Build a sharded train step for a flax classifier with BatchNorm state.
+
+    The returned ``step`` consumes batches of ``{"image": [B,H,W,C],
+    "label": [B]}`` with B sharded over (data, fsdp).
+    """
+    batch_sh = meshlib.batch_sharding(mesh)
+    repl = meshlib.replicated(mesh)
+
+    def init(rng, sample_batch):
+        def init_fn(rng, image):
+            variables = model.init(rng, image, train=False)
+            params = variables["params"]
+            batch_stats = variables.get("batch_stats", {})
+            return {
+                "params": params,
+                "batch_stats": batch_stats,
+                "opt_state": tx.init(params),
+                "step": jnp.zeros((), jnp.int32),
+            }
+
+        abstract = jax.eval_shape(init_fn, rng, sample_batch["image"])
+        shardings = _state_shardings(abstract, mesh, param_rule)
+        state = jax.jit(init_fn, out_shardings=shardings)(
+            rng, sample_batch["image"]
+        )
+        return state, shardings
+
+    def train_step(state, batch):
+        def compute_loss(params):
+            logits, updates = model.apply(
+                {"params": params, "batch_stats": state["batch_stats"]},
+                batch["image"],
+                train=True,
+                mutable=["batch_stats"],
+            )
+            return loss_fn(logits, batch["label"]), (logits, updates)
+
+        (loss, (logits, updates)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(state["params"])
+        updates_tx, new_opt_state = tx.update(
+            grads, state["opt_state"], state["params"]
+        )
+        new_params = optax.apply_updates(state["params"], updates_tx)
+        new_state = {
+            "params": new_params,
+            "batch_stats": updates["batch_stats"],
+            "opt_state": new_opt_state,
+            "step": state["step"] + 1,
+        }
+        accuracy = jnp.mean(
+            (jnp.argmax(logits, axis=-1) == batch["label"]).astype(jnp.float32)
+        )
+        return new_state, {"loss": loss, "accuracy": accuracy}
+
+    def make(state_shardings):
+        return jax.jit(
+            train_step,
+            in_shardings=(state_shardings, {"image": batch_sh, "label": batch_sh}),
+            out_shardings=(state_shardings, {"loss": repl, "accuracy": repl}),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    bundle = TrainStepBundle(init=None, step=None)
+
+    def bundled_init(rng, sample_batch):
+        state, shardings = init(rng, sample_batch)
+        bundle.state_shardings = shardings
+        bundle.step = make(shardings)
+        return state
+
+    bundle.init = bundled_init
+    return bundle
+
+
+def _state_shardings(abstract_state, mesh, param_rule):
+    """Shard params and matching optimizer slots by the rule; replicate rest."""
+    param_sh = meshlib.param_shardings(mesh, abstract_state["params"], param_rule)
+    repl = meshlib.replicated(mesh)
+
+    def map_opt(tree):
+        # Anything in opt_state whose treedef matches params (momentum, nu, …)
+        # follows the param shardings; everything else is replicated.
+        params_treedef = jax.tree_util.tree_structure(abstract_state["params"])
+
+        def assign(subtree):
+            try:
+                if jax.tree_util.tree_structure(subtree) == params_treedef:
+                    return param_sh
+            except Exception:
+                pass
+            return None
+
+        return _map_matching_subtrees(tree, assign, repl)
+
+    return {
+        "params": param_sh,
+        "batch_stats": jax.tree_util.tree_map(
+            lambda _: repl, abstract_state["batch_stats"]
+        ),
+        "opt_state": map_opt(abstract_state["opt_state"]),
+        "step": repl,
+    }
+
+
+def _map_matching_subtrees(tree, assign, default):
+    """Replace subtrees for which assign() returns non-None; leaves -> default."""
+    hit = assign(tree)
+    if hit is not None:
+        return hit
+    if isinstance(tree, (list, tuple)):
+        mapped = [ _map_matching_subtrees(t, assign, default) for t in tree ]
+        return type(tree)(mapped) if not hasattr(tree, "_fields") else type(tree)(*mapped)
+    if isinstance(tree, dict):
+        return {k: _map_matching_subtrees(v, assign, default) for k, v in tree.items()}
+    if dataclasses.is_dataclass(tree):
+        kwargs = {
+            f.name: _map_matching_subtrees(getattr(tree, f.name), assign, default)
+            for f in dataclasses.fields(tree)
+        }
+        return type(tree)(**kwargs)
+    return default

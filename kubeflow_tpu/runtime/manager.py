@@ -11,10 +11,8 @@ on, SURVEY.md §5 "race detection").
 from __future__ import annotations
 
 import dataclasses
-import heapq
 import logging
 import threading
-import time
 from typing import Callable, Iterable
 
 from kubeflow_tpu.runtime import objects as ko
@@ -109,6 +107,11 @@ class Manager:
 
     def advance(self, seconds: float) -> None:
         """Advance the virtual clock and fire due requeue timers."""
+        if self._clock is not None:
+            raise RuntimeError(
+                "advance() requires the built-in virtual clock; this manager "
+                "was constructed with an external clock"
+            )
         self._now += seconds
         self._fire_due_timers()
 
@@ -137,15 +140,14 @@ class Manager:
             if result and result.requeue_after is not None:
                 with self._lock:
                     self._timer_seq += 1
-                    heapq.heappush(
-                        self._timers,
+                    self._timers.append(
                         (
                             self.now() + result.requeue_after,
                             self._timer_seq,
                             rec,
                             ns,
                             name,
-                        ),
+                        )
                     )
         else:
             raise RuntimeError("reconcile loop did not settle (hot loop?)")

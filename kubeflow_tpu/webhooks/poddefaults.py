@@ -66,13 +66,16 @@ def check_safe(pod: Mapping, pds: list[dict]) -> None:
     for pd in pds:
         spec = pd.get("spec", {})
         for e in spec.get("env") or []:
+            # A PodDefault may neither override NOR introduce worker-identity
+            # env: with N gang pods sharing one PodDefault, any TPU_*/JAX_*
+            # value it sets is necessarily identical on every host — a broken
+            # mesh regardless of webhook ordering.
             if any(e["name"].startswith(p) for p in PROTECTED_ENV_PREFIXES):
-                existing = {x.get("name") for x in merged_env}
-                if e["name"] in existing:
-                    raise AdmissionDenied(
-                        f"PodDefault {ko.name(pd)} would override protected TPU "
-                        f"worker env {e['name']!r}"
-                    )
+                raise AdmissionDenied(
+                    f"PodDefault {ko.name(pd)} sets protected TPU worker env "
+                    f"{e['name']!r}; worker identity is injected per-pod by "
+                    "the platform"
+                )
         merged_env = _merge_named(merged_env, spec.get("env"), "env var")
         merged_vols = _merge_named(merged_vols, spec.get("volumes"), "volume")
         merged_mounts = _merge_named(

@@ -15,6 +15,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+# The TPU image's sitecustomize force-registers the TPU backend regardless of
+# JAX_PLATFORMS; config wins over env, so pin the test platform here.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

@@ -101,6 +101,27 @@ class TestNeedsCulling:
         assert not _culler(now=1e9).needs_culling(_nb())
 
 
+def test_restart_after_long_stop_does_not_instantly_recull():
+    """Regression: while stopped, last-activity must never be re-seeded —
+    otherwise a restart 24h later computes idle_for from the stop time and
+    instantly re-culls the freshly started notebook."""
+    nb = _nb()
+    cul = _culler(now=0.0)
+    cul.update_last_activity(nb)
+    c.set_stop_annotation(nb, 100.0)
+    assert api.LAST_ACTIVITY_ANNOTATION not in nb["metadata"]["annotations"]
+    # many check periods pass while stopped
+    for t in (200.0, 400.0, 100_000.0):
+        cul.clock = lambda t=t: t
+        cul.update_last_activity(nb)
+        assert api.LAST_ACTIVITY_ANNOTATION not in nb["metadata"]["annotations"]
+    # user restarts a day later
+    c.remove_stop_annotation(nb)
+    cul.clock = lambda: 100_000.0
+    cul.update_last_activity(nb)
+    assert not cul.needs_culling(nb)  # idle clock restarted from now
+
+
 def test_stop_annotation_roundtrip():
     nb = _nb()
     assert not c.stop_annotation_is_set(nb)

@@ -1,0 +1,120 @@
+"""Mesh plans, sharding rules, distributed bootstrap env contract."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel import bootstrap, mesh as meshlib
+
+
+class TestMeshPlan:
+    def test_auto_plan_defaults_to_fsdp(self):
+        plan = meshlib.auto_plan(8)
+        assert plan.fsdp == 8 and plan.size == 8
+
+    def test_auto_plan_with_tensor_seq(self):
+        plan = meshlib.auto_plan(8, tensor=2, seq=2)
+        assert (plan.fsdp, plan.tensor, plan.seq) == (2, 2, 2)
+
+    def test_auto_plan_indivisible(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            meshlib.auto_plan(8, tensor=3)
+
+    def test_create_mesh_wrong_size(self):
+        with pytest.raises(ValueError, match="needs 4 devices"):
+            meshlib.create_mesh(meshlib.MeshPlan(data=4))
+
+    def test_mesh_axes(self):
+        mesh = meshlib.create_mesh(meshlib.MeshPlan(data=2, fsdp=2, tensor=2))
+        assert mesh.axis_names == meshlib.AXES
+        assert mesh.shape["data"] == 2 and mesh.shape["tensor"] == 2
+
+
+class TestShardingRules:
+    def test_fsdp_rule_shards_largest_big_dim(self):
+        spec = meshlib.fsdp_param_spec(("x",), jnp.zeros((256, 64)))
+        assert spec == P("fsdp", None)
+        spec = meshlib.fsdp_param_spec(("x",), jnp.zeros((64, 512)))
+        assert spec == P(None, "fsdp")
+
+    def test_fsdp_rule_replicates_small_and_1d(self):
+        assert meshlib.fsdp_param_spec(("b",), jnp.zeros((64,))) == P()
+        assert meshlib.fsdp_param_spec(("w",), jnp.zeros((64, 64))) == P()
+
+    def test_tensor_rule_megatron_split(self):
+        q = meshlib.tensor_param_spec(("layer_0", "attn", "q_proj", "kernel"), jnp.zeros((256, 4, 64)))
+        assert q == P("fsdp", "tensor")
+        o = meshlib.tensor_param_spec(("layer_0", "attn", "o_proj", "kernel"), jnp.zeros((4, 64, 256)))
+        assert o == P("tensor", "fsdp")
+        emb = meshlib.tensor_param_spec(("embed", "embedding"), jnp.zeros((1000, 256)))
+        assert emb == P(None, "fsdp")
+
+    def test_param_shardings_tree(self):
+        mesh = meshlib.create_mesh(meshlib.auto_plan(8))
+        params = {"dense": {"kernel": jnp.zeros((256, 128)), "bias": jnp.zeros((128,))}}
+        sh = meshlib.param_shardings(mesh, params)
+        assert sh["dense"]["kernel"].spec == P("fsdp", None)
+        assert sh["dense"]["bias"].spec == P()
+
+
+class TestBootstrap:
+    def test_no_env_returns_none(self, monkeypatch):
+        monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+        assert bootstrap.env_worker_context() is None
+
+    def test_parses_injected_contract(self, monkeypatch):
+        monkeypatch.setenv("TPU_WORKER_ID", "1")
+        monkeypatch.setenv(
+            "TPU_WORKER_HOSTNAMES",
+            "nb-0.nb-tpu.ns.svc.cluster.local,nb-1.nb-tpu.ns.svc.cluster.local",
+        )
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+        monkeypatch.setenv("JAX_PROCESS_ID", "1")
+        monkeypatch.setenv(
+            "JAX_COORDINATOR_ADDRESS", "nb-0.nb-tpu.ns.svc.cluster.local:8476"
+        )
+        monkeypatch.setenv("TPU_TOPOLOGY", "2x2x2")
+        ctx = bootstrap.env_worker_context()
+        assert ctx["worker_id"] == 1
+        assert ctx["num_processes"] == 2
+        assert ctx["coordinator"].endswith(":8476")
+        assert len(ctx["hostnames"]) == 2
+
+    def test_single_host_skips_distributed_init(self, monkeypatch):
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+        ctx = bootstrap.auto_initialize()
+        assert ctx is not None and ctx["num_processes"] == 1
+        # jax.distributed was NOT initialized (would raise on re-init attempt)
+
+
+def test_end_to_end_env_matches_bootstrap(cluster, monkeypatch):
+    """The webhook-injected env parses into the exact mesh the CR requested —
+    control plane and compute plane agree via the shared topology module."""
+    from kubeflow_tpu.api import types as api
+    from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+    from kubeflow_tpu.runtime.manager import Manager
+    from kubeflow_tpu.webhooks import tpu_env
+
+    m = Manager(cluster)
+    m.register(NotebookReconciler())
+    tpu_env.install(cluster)
+    cluster.create(
+        api.notebook("nb", "ns", tpu_accelerator="v4", tpu_topology="4x4x4")
+    )
+    m.run_until_idle()
+    cluster.settle(m)
+    pod = cluster.get("Pod", "nb-7", "ns")
+    env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+    for k, v in env.items():
+        if k.startswith(("TPU_", "JAX_")):
+            monkeypatch.setenv(k, v)
+    ctx = bootstrap.env_worker_context()
+    assert ctx["worker_id"] == 7
+    assert ctx["num_processes"] == 16  # 64 chips / 4 per host
+    assert ctx["hostnames"][0] == "nb-0.nb-tpu.ns.svc.cluster.local"
+    assert ctx["topology"] == "4x4x4"

@@ -69,7 +69,7 @@ def test_identical_duplicate_env_is_ok_conflict_denied(cluster):
         cluster.create({**_pod(labels={"x": "y"}), "metadata": {"name": "p-2", "namespace": "user-ns", "labels": {"x": "y"}}})
 
 
-def test_protected_tpu_env_cannot_be_shadowed(cluster):
+def test_protected_tpu_env_cannot_be_set_at_all(cluster):
     cluster.create(
         api.pod_default(
             "evil", "user-ns",
@@ -78,8 +78,13 @@ def test_protected_tpu_env_cannot_be_shadowed(cluster):
         )
     )
     poddefaults.install(cluster)
+    # overriding an existing worker identity: denied
     with pytest.raises(AdmissionDenied, match="protected TPU worker env"):
         cluster.create(_pod(labels={"t": "y"}, env=[{"name": "TPU_WORKER_ID", "value": "3"}]))
+    # introducing one where none exists: equally denied — a shared PodDefault
+    # would stamp the same worker id on every gang pod
+    with pytest.raises(AdmissionDenied, match="protected TPU worker env"):
+        cluster.create(_pod(labels={"t": "y"}))
 
 
 def test_command_args_only_when_unset(cluster):

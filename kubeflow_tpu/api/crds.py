@@ -1,0 +1,225 @@
+"""CRD manifest generation (the codegen step, SURVEY.md §7 stage 1).
+
+The reference checks in generated CRD YAML
+(``notebook-controller/config/crd/bases/kubeflow.org_notebooks.yaml``); here
+the schemas are emitted from the API-type definitions so schema and code can't
+drift. ``python -m kubeflow_tpu.api.crds manifests/crds`` renders them.
+"""
+from __future__ import annotations
+
+import sys
+
+import yaml
+
+from kubeflow_tpu.tpu.topology import ACCELERATORS
+
+
+def _obj(props: dict | None = None, **kw) -> dict:
+    out: dict = {"type": "object", **kw}
+    if props is not None:
+        out["properties"] = props
+    return out
+
+
+_TPU_SPEC = _obj(
+    {
+        "accelerator": {
+            "type": "string",
+            "enum": sorted(ACCELERATORS),
+            "description": "TPU generation of the requested slice.",
+        },
+        "topology": {
+            "type": "string",
+            "pattern": r"^\d+(x\d+)*$",
+            "description": "Chip torus shape, e.g. 2x2x2 (v4/v5p) or 2x4 (v5e/v6e). "
+            "Must tile onto whole hosts; one pod per host is created.",
+        },
+    },
+    required=["accelerator", "topology"],
+    description="First-class TPU slice request. Drives StatefulSet replicas, "
+    "google.com/tpu limits, GKE topology nodeSelectors, and per-pod worker "
+    "identity injection.",
+)
+
+# x-kubernetes-preserve-unknown-fields for PodSpec (matching the pragmatic
+# schema the reference ships, which embeds the full PodSpec).
+_POD_SPEC = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+
+
+def crd(
+    *,
+    group: str,
+    kind: str,
+    plural: str,
+    versions: list[tuple[str, bool, dict]],
+    scope: str = "Namespaced",
+    short_names: list[str] | None = None,
+) -> dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{group}"},
+        "spec": {
+            "group": group,
+            "scope": scope,
+            "names": {
+                "kind": kind,
+                "listKind": f"{kind}List",
+                "plural": plural,
+                "singular": kind.lower(),
+                **({"shortNames": short_names} if short_names else {}),
+            },
+            "versions": [
+                {
+                    "name": name,
+                    "served": True,
+                    "storage": storage,
+                    "schema": {"openAPIV3Schema": schema},
+                    "subresources": {"status": {}},
+                }
+                for name, storage, schema in versions
+            ],
+        },
+    }
+
+
+def notebook_crd() -> dict:
+    schema = _obj(
+        {
+            "spec": _obj(
+                {
+                    "template": _obj({"spec": _POD_SPEC}),
+                    "tpu": _TPU_SPEC,
+                }
+            ),
+            "status": _obj(
+                {
+                    "conditions": {"type": "array", "items": _obj(None, **{"x-kubernetes-preserve-unknown-fields": True})},
+                    "readyReplicas": {"type": "integer"},
+                    "containerState": _obj(None, **{"x-kubernetes-preserve-unknown-fields": True}),
+                    "tpu": _obj(None, **{"x-kubernetes-preserve-unknown-fields": True}),
+                }
+            ),
+        }
+    )
+    # v1alpha1/v1beta1/v1 mirror the reference's served versions
+    # (notebook-controller/api/{v1alpha1,v1beta1,v1}); identical schemas here,
+    # conversion is a no-op passthrough.
+    return crd(
+        group="kubeflow.org",
+        kind="Notebook",
+        plural="notebooks",
+        versions=[
+            ("v1alpha1", False, schema),
+            ("v1beta1", True, schema),
+            ("v1", False, schema),
+        ],
+        short_names=["nb"],
+    )
+
+
+def profile_crd() -> dict:
+    schema = _obj(
+        {
+            "spec": _obj(
+                {
+                    "owner": _obj(
+                        {"kind": {"type": "string"}, "name": {"type": "string"}}
+                    ),
+                    "plugins": {
+                        "type": "array",
+                        "items": _obj(
+                            {
+                                "kind": {"type": "string"},
+                                "spec": _obj(None, **{"x-kubernetes-preserve-unknown-fields": True}),
+                            }
+                        ),
+                    },
+                    "resourceQuotaSpec": _obj(None, **{"x-kubernetes-preserve-unknown-fields": True}),
+                    "tpu": _obj({"maxChips": {"type": "integer"}}),
+                }
+            ),
+            "status": _obj(None, **{"x-kubernetes-preserve-unknown-fields": True}),
+        }
+    )
+    return crd(
+        group="kubeflow.org",
+        kind="Profile",
+        plural="profiles",
+        scope="Cluster",
+        versions=[("v1beta1", False, schema), ("v1", True, schema)],
+    )
+
+
+def poddefault_crd() -> dict:
+    schema = _obj(
+        {
+            "spec": _obj(
+                {
+                    "selector": _obj(None, **{"x-kubernetes-preserve-unknown-fields": True}),
+                    "desc": {"type": "string"},
+                    **{
+                        k: {"type": "array", "items": _obj(None, **{"x-kubernetes-preserve-unknown-fields": True})}
+                        for k in ("env", "envFrom", "volumes", "volumeMounts",
+                                  "tolerations", "imagePullSecrets")
+                    },
+                    "labels": _obj(None, **{"x-kubernetes-preserve-unknown-fields": True}),
+                    "annotations": _obj(None, **{"x-kubernetes-preserve-unknown-fields": True}),
+                    "serviceAccountName": {"type": "string"},
+                    "command": {"type": "array", "items": {"type": "string"}},
+                    "args": {"type": "array", "items": {"type": "string"}},
+                },
+                required=["selector"],
+            )
+        }
+    )
+    return crd(
+        group="kubeflow.org",
+        kind="PodDefault",
+        plural="poddefaults",
+        versions=[("v1alpha1", True, schema)],
+    )
+
+
+def tensorboard_crd() -> dict:
+    schema = _obj(
+        {
+            "spec": _obj(
+                {"logspath": {"type": "string"}}, required=["logspath"]
+            ),
+            "status": _obj(None, **{"x-kubernetes-preserve-unknown-fields": True}),
+        }
+    )
+    return crd(
+        group="tensorboard.kubeflow.org",
+        kind="Tensorboard",
+        plural="tensorboards",
+        versions=[("v1alpha1", True, schema)],
+    )
+
+
+ALL_CRDS = {
+    "kubeflow.org_notebooks.yaml": notebook_crd,
+    "kubeflow.org_profiles.yaml": profile_crd,
+    "kubeflow.org_poddefaults.yaml": poddefault_crd,
+    "tensorboard.kubeflow.org_tensorboards.yaml": tensorboard_crd,
+}
+
+
+def render_all(outdir: str) -> list[str]:
+    import os
+
+    os.makedirs(outdir, exist_ok=True)
+    written = []
+    for filename, fn in ALL_CRDS.items():
+        path = os.path.join(outdir, filename)
+        with open(path, "w") as f:
+            yaml.safe_dump(fn(), f, sort_keys=False)
+        written.append(path)
+    return written
+
+
+if __name__ == "__main__":
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "manifests/crds"
+    for path in render_all(outdir):
+        print(path)

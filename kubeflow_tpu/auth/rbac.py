@@ -10,6 +10,7 @@ exactly what profile-controller and kfam create.
 from __future__ import annotations
 
 import dataclasses
+from typing import Mapping
 
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import FakeCluster
@@ -75,9 +76,7 @@ class Authorizer:
         if user.name in self.cluster_admins:
             return True
         for rb in self.cluster.list("RoleBinding", namespace):
-            if not any(
-                s.get("name") == user.name for s in rb.get("subjects", [])
-            ):
+            if not any(self._subject_matches(s, user) for s in rb.get("subjects", [])):
                 continue
             role = rb.get("roleRef", {}).get("name", "")
             rules = ROLE_RULES.get(ROLE_ALIASES.get(role, role))
@@ -86,6 +85,18 @@ class Authorizer:
             verbs = rules.get(resource.lower(), rules.get("*", set()))
             if verb in verbs:
                 return True
+        return False
+
+    @staticmethod
+    def _subject_matches(subject: Mapping, user: User) -> bool:
+        """Kind-aware subject match: header identities are Users/Groups only —
+        a ServiceAccount subject must never match a header-authenticated name
+        (e.g. a user literally named 'default-editor')."""
+        kind = subject.get("kind", "User")
+        if kind == "User":
+            return subject.get("name") == user.name
+        if kind == "Group":
+            return subject.get("name") in user.groups
         return False
 
     def ensure(self, user: User, verb: str, resource: str, namespace: str) -> None:

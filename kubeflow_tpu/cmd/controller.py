@@ -1,0 +1,98 @@
+"""Controller-manager process (ref: each Go controller's ``main.go``).
+
+Hosts every reconciler on one manager against the in-cluster API server, with
+Prometheus metrics + probes on the ports the manifests wire up
+(``manifests/base/controller.yaml``). Set ``STANDALONE=true`` to run against an
+in-memory cluster (demo / kind-less smoke tests — the platform's own envtest).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from wsgiref.simple_server import make_server
+
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.controllers.profile_controller import ProfileReconciler
+from kubeflow_tpu.controllers.tensorboard_controller import TensorboardReconciler
+from kubeflow_tpu.culler.culler import Culler
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import NotebookMetrics
+from kubeflow_tpu.webapps.base import App
+
+log = logging.getLogger("controller")
+
+
+def fetch_kernels_http(namespace: str, name: str):
+    """Culler probe over the cluster network (ref culler.go:149-185; DEV mode
+    uses the proxy URL shape from culler.go:156-160)."""
+    import requests
+
+    cfg = ControllerConfig.from_env()
+    if cfg.dev:
+        url = f"http://127.0.0.1:8001/api/v1/namespaces/{namespace}/services/{name}:80/proxy/notebook/{namespace}/{name}/api/kernels"
+    else:
+        url = (
+            f"http://{name}.{namespace}.svc.{cfg.cluster_domain}"
+            f"/notebook/{namespace}/{name}/api/kernels"
+        )
+    try:
+        resp = requests.get(url, timeout=5)
+        if resp.status_code != 200:
+            return None
+        return resp.json()
+    except Exception:
+        return None
+
+
+def build_manager(cluster, config: ControllerConfig | None = None) -> tuple[Manager, NotebookMetrics]:
+    cfg = config or ControllerConfig.from_env()
+    metrics = NotebookMetrics()
+    culler = Culler(
+        enabled=cfg.enable_culling,
+        cull_idle_minutes=cfg.cull_idle_minutes,
+        check_period_minutes=cfg.idleness_check_minutes,
+        fetch_kernels=fetch_kernels_http,
+        clock=time.time,
+    )
+    manager = Manager(cluster, clock=time.time)
+    manager.register(NotebookReconciler(cfg, culler=culler, metrics=metrics))
+    manager.register(ProfileReconciler())
+    manager.register(TensorboardReconciler(cfg))
+    return manager, metrics
+
+
+def serve_ops(metrics: NotebookMetrics, port: int = 8081) -> threading.Thread:
+    app = App("controller-ops", csrf_protect=False,
+              metrics_registry=metrics.registry)
+    server = make_server("0.0.0.0", port, app)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return t
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    if os.environ.get("STANDALONE", "").lower() in ("1", "true"):
+        from kubeflow_tpu.runtime.fake import FakeCluster
+
+        cluster = FakeCluster()
+    else:
+        from kubeflow_tpu.runtime.kubeclient import KubeClient
+
+        cluster = KubeClient()
+    manager, metrics = build_manager(cluster)
+    serve_ops(metrics)
+    log.info("controller manager running")
+    while True:
+        # Watches enqueue keys; drain continuously. Requeue timers fire off
+        # the wall clock (Manager(clock=time.time)).
+        manager._fire_due_timers()
+        manager.run_until_idle()
+        time.sleep(1.0)
+
+
+if __name__ == "__main__":
+    main()

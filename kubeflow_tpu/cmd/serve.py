@@ -1,0 +1,66 @@
+"""Web-app server process: ``python -m kubeflow_tpu.cmd.serve <app>``.
+
+Serves one of the WSGI backends (jupyter | volumes | tensorboards | dashboard |
+kfam) against the in-cluster API (or STANDALONE in-memory cluster), the way
+each reference backend runs its Flask app under gunicorn
+(``crud-web-apps/*/backend/entrypoint.py``).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from wsgiref.simple_server import make_server
+
+from kubeflow_tpu.auth.rbac import Authorizer
+
+APPS = ("jupyter", "volumes", "tensorboards", "dashboard", "kfam")
+
+
+def build_app(name: str, cluster=None):
+    if cluster is None:
+        if os.environ.get("STANDALONE", "").lower() in ("1", "true"):
+            from kubeflow_tpu.runtime.fake import FakeCluster
+
+            cluster = FakeCluster()
+        else:
+            from kubeflow_tpu.runtime.kubeclient import KubeClient
+
+            cluster = KubeClient()
+    admins = {
+        a for a in os.environ.get("CLUSTER_ADMINS", "").split(",") if a
+    }
+    if name == "jupyter":
+        from kubeflow_tpu.webapps.jupyter import create_app
+
+        return create_app(cluster, authorizer=Authorizer(cluster, cluster_admins=admins))
+    if name == "volumes":
+        from kubeflow_tpu.webapps.volumes import create_app
+
+        return create_app(cluster, authorizer=Authorizer(cluster, cluster_admins=admins))
+    if name == "tensorboards":
+        from kubeflow_tpu.webapps.tensorboards import create_app
+
+        return create_app(cluster, authorizer=Authorizer(cluster, cluster_admins=admins))
+    if name == "dashboard":
+        from kubeflow_tpu.webapps.dashboard import create_app
+
+        return create_app(cluster, cluster_admins=admins)
+    if name == "kfam":
+        from kubeflow_tpu.webapps.kfam_app import create_app
+
+        return create_app(cluster, cluster_admins=admins)
+    raise SystemExit(f"unknown app {name!r}; choose from {APPS}")
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    name = sys.argv[1] if len(sys.argv) > 1 else "jupyter"
+    port = int(os.environ.get("PORT", "5000"))
+    app = build_app(name)
+    logging.info("serving %s on :%d", name, port)
+    make_server("0.0.0.0", port, app).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Admission webhook server: AdmissionReview v1 in, JSONPatch out.
+
+The deployable form of the mutators (ref HTTP server
+``admission-webhook/main.go:685-702``; TLS certs mounted by the manifests and
+hot-reloaded like the reference's certwatcher ``config.go:42-60``). Two paths,
+matching ``manifests/base/webhook.yaml``:
+
+  /apply-poddefault   PodDefault merge (webhooks/poddefaults.py)
+  /inject-tpu-env     TPU worker identity (webhooks/tpu_env.py)
+"""
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import os
+import ssl
+from wsgiref.simple_server import make_server
+
+from werkzeug.wrappers import Request, Response
+
+from kubeflow_tpu.runtime.fake import AdmissionDenied
+from kubeflow_tpu.webhooks import poddefaults, tpu_env
+
+log = logging.getLogger("webhook")
+
+
+def json_patch(before: dict, after: dict, path: str = "") -> list[dict]:
+    """Minimal RFC-6902 diff (replace/add/remove) for admission responses."""
+    ops: list[dict] = []
+    if isinstance(before, dict) and isinstance(after, dict):
+        for key in before:
+            escaped = key.replace("~", "~0").replace("/", "~1")
+            if key not in after:
+                ops.append({"op": "remove", "path": f"{path}/{escaped}"})
+            else:
+                ops.extend(json_patch(before[key], after[key], f"{path}/{escaped}"))
+        for key in after:
+            if key not in before:
+                escaped = key.replace("~", "~0").replace("/", "~1")
+                ops.append({"op": "add", "path": f"{path}/{escaped}",
+                            "value": after[key]})
+    elif isinstance(before, list) and isinstance(after, list):
+        if before != after:
+            ops.append({"op": "replace", "path": path, "value": after})
+    elif before != after:
+        ops.append({"op": "replace", "path": path, "value": after})
+    return ops
+
+
+def make_wsgi_app(cluster):
+    tpu_mutate = tpu_env.make_mutator()
+
+    def handle(environ, start_response):
+        request = Request(environ)
+        try:
+            review = request.get_json()
+            obj = review["request"]["object"]
+            uid = review["request"]["uid"]
+        except Exception:
+            resp = Response("bad AdmissionReview", status=400)
+            return resp(environ, start_response)
+        before = copy.deepcopy(obj)
+        response: dict = {"uid": uid, "allowed": True}
+        try:
+            if request.path == "/apply-poddefault":
+                mutated = poddefaults.mutator(obj, cluster)
+            elif request.path == "/inject-tpu-env":
+                mutated = tpu_mutate(obj, cluster)
+            else:
+                resp = Response("not found", status=404)
+                return resp(environ, start_response)
+            patch = json_patch(before, mutated)
+            if patch:
+                response["patchType"] = "JSONPatch"
+                response["patch"] = __import__("base64").b64encode(
+                    json.dumps(patch).encode()
+                ).decode()
+        except AdmissionDenied as e:
+            response = {
+                "uid": uid,
+                "allowed": False,
+                "status": {"code": 403, "message": str(e)},
+            }
+        body = json.dumps(
+            {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+             "response": response}
+        )
+        resp = Response(body, mimetype="application/json")
+        return resp(environ, start_response)
+
+    return handle
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    from kubeflow_tpu.runtime.kubeclient import KubeClient
+
+    cluster = KubeClient()
+    port = int(os.environ.get("PORT", "8443"))
+    cert_dir = os.environ.get("CERT_DIR", "/etc/webhook/certs")
+    server = make_server("0.0.0.0", port, make_wsgi_app(cluster))
+    cert, key = f"{cert_dir}/tls.crt", f"{cert_dir}/tls.key"
+    if os.path.isfile(cert):
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        server.socket = ctx.wrap_socket(server.socket, server_side=True)
+    log.info("webhook serving on :%d", port)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -312,7 +312,7 @@ class NotebookReconciler(Reconciler):
         current = cluster.try_get("Notebook", name, ns)
         if current is not None and current.get("status") != status:
             current["status"] = status
-            cluster.update(current)
+            cluster.update_status(current)
         if self.metrics is not None:
             self.metrics.observe_notebooks(cluster)
 

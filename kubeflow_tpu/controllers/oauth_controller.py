@@ -1,0 +1,157 @@
+"""OAuth companion controller (odh-notebook-controller analog).
+
+The fork-added component of the reference
+(``components/odh-notebook-controller``): for clusters that front notebooks
+with an OAuth proxy instead of an Istio gateway, a Notebook-mutating webhook
+injects an oauth-proxy sidecar (ref ``notebook_webhook.go:227-266``,
+``InjectOAuthProxy`` webhook helpers), and a companion reconciler materializes
+the external Route, the proxy's session Secret, ServiceAccount (annotated as an
+OAuth redirect reference) and a TLS Service (ref ``notebook_oauth.go:46-263``,
+``notebook_route.go:34-64``). A reconciliation-lock annotation delays the first
+reconcile until cluster credentials are ready (ref
+``notebook_controller.go:81-120``).
+
+Opt-in per notebook via the reference-compatible annotation
+``notebooks.opendatahub.io/inject-oauth: "true"``.
+"""
+from __future__ import annotations
+
+import base64
+import secrets
+
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime import reconcilehelper as helper
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.runtime.manager import Reconciler, Result
+
+INJECT_ANNOTATION = "notebooks.opendatahub.io/inject-oauth"
+LOCK_ANNOTATION = "odh.kubeflow.org/reconciliation-lock"
+OAUTH_PROXY_IMAGE = "registry/oauth-proxy:latest"
+OAUTH_PORT = 8443
+
+
+def oauth_enabled(nb: dict) -> bool:
+    return ko.annotations(nb).get(INJECT_ANNOTATION) == "true"
+
+
+def inject_oauth_proxy(nb: dict, cluster: FakeCluster) -> dict:
+    """Notebook-mutating webhook: add the oauth-proxy sidecar
+    (ref notebook_webhook.go Handle + InjectOAuthProxy)."""
+    if nb.get("kind") != "Notebook" or not oauth_enabled(nb):
+        return nb
+    nb = ko.deep_copy(nb)
+    name = ko.name(nb)
+    pod_spec = nb["spec"]["template"]["spec"]
+    containers = pod_spec.setdefault("containers", [])
+    sidecar = {
+        "name": "oauth-proxy",
+        "image": OAUTH_PROXY_IMAGE,
+        "args": [
+            f"--upstream=http://localhost:8888",
+            f"--https-address=:{OAUTH_PORT}",
+            f"--openshift-service-account={name}",
+            "--cookie-secret-file=/etc/oauth/config/cookie_secret",
+            "--tls-cert=/etc/tls/private/tls.crt",
+            "--tls-key=/etc/tls/private/tls.key",
+        ],
+        "ports": [{"containerPort": OAUTH_PORT, "name": "oauth-proxy", "protocol": "TCP"}],
+        "volumeMounts": [
+            {"name": "oauth-config", "mountPath": "/etc/oauth/config"},
+            {"name": "tls-certificates", "mountPath": "/etc/tls/private"},
+        ],
+    }
+    for i, c in enumerate(containers):
+        if c.get("name") == "oauth-proxy":
+            containers[i] = sidecar
+            break
+    else:
+        containers.append(sidecar)
+    vols = pod_spec.setdefault("volumes", [])
+    for vol in (
+        {"name": "oauth-config", "secret": {"secretName": f"{name}-oauth-config"}},
+        {"name": "tls-certificates", "secret": {"secretName": f"{name}-tls"}},
+    ):
+        if vol not in vols:
+            vols.append(vol)
+    return nb
+
+
+def install_webhook(cluster: FakeCluster) -> None:
+    cluster.register_mutator("Notebook", inject_oauth_proxy)
+
+
+class OAuthReconciler(Reconciler):
+    kind = "Notebook"
+
+    def __init__(self, *, cluster_domain: str = "cluster.local",
+                 pull_secret_ready: bool = True) -> None:
+        self.cluster_domain = cluster_domain
+        # reconciliation-lock gate (ref notebook_controller.go:81-120)
+        self.pull_secret_ready = pull_secret_ready
+
+    def reconcile(self, cluster: FakeCluster, namespace: str, name: str) -> Result | None:
+        nb = cluster.try_get("Notebook", name, namespace)
+        if nb is None or not oauth_enabled(nb):
+            return None
+        if not self.pull_secret_ready:
+            if LOCK_ANNOTATION not in ko.annotations(nb):
+                ko.set_annotation(nb, LOCK_ANNOTATION, "true")
+                cluster.update(nb)
+            return Result(requeue_after=3.0)
+        if LOCK_ANNOTATION in ko.annotations(nb):
+            ko.remove_annotation(nb, LOCK_ANNOTATION)
+            cluster.update(nb)
+            nb = cluster.get("Notebook", name, namespace)
+
+        # Random per-notebook session secret; the create-once copy_fields noop
+        # below keeps it stable across reconciles.
+        cookie = base64.b64encode(secrets.token_bytes(24)).decode()
+        helper.reconcile_object(cluster, {
+            "apiVersion": "v1",
+            "kind": "Secret",
+            "metadata": {"name": f"{name}-oauth-config", "namespace": namespace},
+            "type": "Opaque",
+            "stringData": {"cookie_secret": cookie},
+        }, owner=nb, copy_fields=lambda e, d: None)  # secret is create-once
+        helper.reconcile_object(cluster, {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "annotations": {
+                    "serviceaccounts.openshift.io/oauth-redirectreference.first": (
+                        '{"kind":"OAuthRedirectReference","apiVersion":"v1",'
+                        f'"reference":{{"kind":"Route","name":"{name}"}}}}'
+                    )
+                },
+            },
+        }, owner=nb)
+        helper.reconcile_object(cluster, {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": f"{name}-tls",
+                "namespace": namespace,
+                "annotations": {
+                    "service.beta.openshift.io/serving-cert-secret-name": f"{name}-tls"
+                },
+            },
+            "spec": {
+                "ports": [{"name": "oauth-proxy", "port": OAUTH_PORT,
+                           "targetPort": OAUTH_PORT}],
+                "selector": {"statefulset": name},
+            },
+        }, owner=nb, copy_fields=helper.copy_service_fields)
+        helper.reconcile_object(cluster, {
+            "apiVersion": "route.openshift.io/v1",
+            "kind": "Route",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {
+                "to": {"kind": "Service", "name": f"{name}-tls"},
+                "port": {"targetPort": "oauth-proxy"},
+                "tls": {"termination": "reencrypt",
+                        "insecureEdgeTerminationPolicy": "Redirect"},
+            },
+        }, owner=nb)
+        return None

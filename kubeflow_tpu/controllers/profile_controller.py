@@ -254,7 +254,7 @@ class ProfileReconciler(Reconciler):
         conditions = fresh.setdefault("status", {}).setdefault("conditions", [])
         if not conditions or conditions[-1] != cond:
             conditions.append(cond)
-            cluster.update(fresh)
+            cluster.update_status(fresh)
 
 
 def _role_binding(*, name: str, namespace: str, role: str, subject: Mapping,

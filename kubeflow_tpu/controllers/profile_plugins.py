@@ -54,6 +54,9 @@ def _annotate_ksa(cluster: FakeCluster, namespace: str, key: str, value: str | N
     sa = cluster.try_get("ServiceAccount", DEFAULT_EDITOR, namespace)
     if sa is None:
         return
+    current = ko.annotations(sa).get(key)
+    if current == value or (value is None and current is None):
+        return  # idempotent: don't bump resourceVersion (would hot-loop watches)
     if value is None:
         ko.remove_annotation(sa, key)
     else:

@@ -213,4 +213,4 @@ class TensorboardReconciler(Reconciler):
         fresh = cluster.try_get("Tensorboard", name, ns)
         if fresh is not None and fresh.get("status") != status:
             fresh["status"] = status
-            cluster.update(fresh)
+            cluster.update_status(fresh)

@@ -129,6 +129,23 @@ class FakeCluster:
         self._notify("MODIFIED", stored)
         return stored
 
+    def update_status(self, obj: Mapping) -> dict:
+        """Status-subresource write: persists ONLY .status (the CRDs declare
+        the status subresource, so real API servers ignore .status on the main
+        path — controllers must use this method for status)."""
+        k = _key(obj)
+        with self._lock:
+            current = self._objects.get(k)
+            if current is None:
+                raise NotFound(f"{k}")
+            merged = ko.deep_copy(current)
+            merged["status"] = ko.deep_copy(obj.get("status", {}))
+            merged["metadata"]["resourceVersion"] = str(next(self._rv))
+            self._objects[k] = merged
+            stored = ko.deep_copy(merged)
+        self._notify("MODIFIED", stored)
+        return stored
+
     def patch(self, kind: str, name: str, namespace: str, patch: Mapping) -> dict:
         with self._lock:
             current = self.get(kind, name, namespace)

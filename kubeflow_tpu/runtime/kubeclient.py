@@ -1,0 +1,256 @@
+"""Real Kubernetes API client, same interface as ``runtime.fake.FakeCluster``.
+
+The controllers and web apps are written against a small client surface
+(create/get/list/update/patch/delete/watch + events). In tests that surface is
+the in-memory store; in a cluster it is this REST client — direct HTTP to the
+API server (the kubernetes python package is not in the image; the API is
+plain REST and this keeps the dependency footprint at ``requests``).
+
+In-cluster config discovery matches client-go: service-account token +
+namespace + CA from ``/var/run/secrets/kubernetes.io/serviceaccount``,
+API server from ``KUBERNETES_SERVICE_HOST/PORT`` (what the reference's Go
+controllers get from ``rest.InClusterConfig``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Mapping
+
+try:
+    import requests
+except ImportError:  # pragma: no cover
+    requests = None
+
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import AlreadyExists, Conflict, NotFound
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# kind -> (api prefix, group/version, plural, namespaced)
+RESOURCES: dict[str, tuple[str, str, str, bool]] = {
+    "Pod": ("api", "v1", "pods", True),
+    "Service": ("api", "v1", "services", True),
+    "Namespace": ("api", "v1", "namespaces", False),
+    "Event": ("api", "v1", "events", True),
+    "Secret": ("api", "v1", "secrets", True),
+    "ServiceAccount": ("api", "v1", "serviceaccounts", True),
+    "ResourceQuota": ("api", "v1", "resourcequotas", True),
+    "PersistentVolumeClaim": ("api", "v1", "persistentvolumeclaims", True),
+    "Node": ("api", "v1", "nodes", False),
+    "StatefulSet": ("apis", "apps/v1", "statefulsets", True),
+    "Deployment": ("apis", "apps/v1", "deployments", True),
+    "RoleBinding": ("apis", "rbac.authorization.k8s.io/v1", "rolebindings", True),
+    "Notebook": ("apis", "kubeflow.org/v1beta1", "notebooks", True),
+    "Profile": ("apis", "kubeflow.org/v1", "profiles", False),
+    "PodDefault": ("apis", "kubeflow.org/v1alpha1", "poddefaults", True),
+    "Tensorboard": ("apis", "tensorboard.kubeflow.org/v1alpha1", "tensorboards", True),
+    "VirtualService": ("apis", "networking.istio.io/v1alpha3", "virtualservices", True),
+    "AuthorizationPolicy": ("apis", "security.istio.io/v1beta1", "authorizationpolicies", True),
+    "Route": ("apis", "route.openshift.io/v1", "routes", True),
+}
+
+
+def resource_path(kind: str, namespace: str | None = None, name: str | None = None) -> str:
+    """API path for a kind (exported for tests)."""
+    prefix, gv, plural, namespaced = RESOURCES[kind]
+    parts = [prefix, gv]
+    if namespaced and namespace:
+        parts += ["namespaces", namespace]
+    parts.append(plural)
+    if name:
+        parts.append(name)
+    return "/" + "/".join(parts)
+
+
+class KubeClient:
+    """Same call surface the controllers use on FakeCluster."""
+
+    def __init__(
+        self,
+        base_url: str | None = None,
+        token: str | None = None,
+        ca_cert: str | bool | None = None,
+        session=None,
+    ) -> None:
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            base_url = f"https://{host}:{port}"
+        self.base_url = base_url.rstrip("/")
+        if token is None and os.path.isfile(f"{SA_DIR}/token"):
+            with open(f"{SA_DIR}/token") as f:
+                token = f.read().strip()
+        if ca_cert is None:
+            ca_cert = f"{SA_DIR}/ca.crt" if os.path.isfile(f"{SA_DIR}/ca.crt") else True
+        self.verify = ca_cert
+        self.session = session or requests.Session()
+        if token:
+            self.session.headers["Authorization"] = f"Bearer {token}"
+        self._watch_threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------ http
+
+    def _request(self, method: str, path: str, **kw):
+        resp = self.session.request(
+            method, self.base_url + path, verify=self.verify, **kw
+        )
+        if resp.status_code == 404:
+            raise NotFound(path)
+        if resp.status_code == 409:
+            body = resp.text
+            if "AlreadyExists" in body:
+                raise AlreadyExists(path)
+            raise Conflict(body)
+        resp.raise_for_status()
+        return resp.json() if resp.content else {}
+
+    # ------------------------------------------------------------------ CRUD
+
+    def create(self, obj: Mapping) -> dict:
+        kind = obj["kind"]
+        return self._request(
+            "POST", resource_path(kind, ko.namespace(obj)), json=dict(obj)
+        )
+
+    def get(self, kind: str, name: str, namespace: str = "") -> dict:
+        return self._request("GET", resource_path(kind, namespace, name))
+
+    def try_get(self, kind: str, name: str, namespace: str = "") -> dict | None:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def list(self, kind: str, namespace: str | None = None, selector: Mapping | None = None) -> list[dict]:
+        params = {}
+        if selector and selector.get("matchLabels"):
+            params["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in selector["matchLabels"].items()
+            )
+        out = self._request("GET", resource_path(kind, namespace), params=params)
+        items = out.get("items", [])
+        for item in items:  # list items omit kind/apiVersion; restore them
+            item.setdefault("kind", kind)
+        # client-side matchExpressions (server handles matchLabels)
+        if selector and selector.get("matchExpressions"):
+            items = [i for i in items if ko.matches_selector(i, selector)]
+        return items
+
+    def update(self, obj: Mapping) -> dict:
+        kind = obj["kind"]
+        return self._request(
+            "PUT",
+            resource_path(kind, ko.namespace(obj), ko.name(obj)),
+            json=dict(obj),
+        )
+
+    def update_status(self, obj: Mapping) -> dict:
+        """PUT to the /status subresource (the CRDs enable it, so .status on
+        the main path would be silently discarded by the API server)."""
+        kind = obj["kind"]
+        return self._request(
+            "PUT",
+            resource_path(kind, ko.namespace(obj), ko.name(obj)) + "/status",
+            json=dict(obj),
+        )
+
+    def patch(self, kind: str, name: str, namespace: str, patch: Mapping) -> dict:
+        return self._request(
+            "PATCH",
+            resource_path(kind, namespace, name),
+            json=dict(patch),
+            headers={"Content-Type": "application/merge-patch+json"},
+        )
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        self._request("DELETE", resource_path(kind, namespace, name))
+
+    def finalize(self, obj: Mapping) -> None:
+        # real API server completes deletes once finalizers empty; nothing to do
+        pass
+
+    # ----------------------------------------------------------------- watch
+
+    def watch(self, kind: str | None, fn: Callable[[str, dict], None]) -> None:
+        """Streaming watch with automatic re-list on disconnect (the informer
+        loop controller-runtime gives the reference for free)."""
+        if kind is None:
+            raise ValueError("KubeClient.watch requires a concrete kind")
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    listing = self._request("GET", resource_path(kind))
+                    rv = listing.get("metadata", {}).get("resourceVersion", "0")
+                    for item in listing.get("items", []):
+                        item.setdefault("kind", kind)
+                        fn("ADDED", item)
+                    resp = self.session.get(
+                        self.base_url + resource_path(kind),
+                        params={"watch": "true", "resourceVersion": rv,
+                                "allowWatchBookmarks": "true"},
+                        stream=True,
+                        verify=self.verify,
+                        timeout=330,
+                    )
+                    resp.raise_for_status()  # 403 etc. → backoff path, not a busy loop
+                    for line in resp.iter_lines():
+                        if self._stop.is_set():
+                            return
+                        if not line:
+                            continue
+                        event = json.loads(line)
+                        if event.get("type") == "BOOKMARK":
+                            continue
+                        obj = event.get("object", {})
+                        obj.setdefault("kind", kind)
+                        fn(event.get("type", "MODIFIED"), obj)
+                except Exception:
+                    time.sleep(2.0)  # re-list after transient failures
+
+        t = threading.Thread(target=run, daemon=True, name=f"watch-{kind}")
+        self._watch_threads.append(t)
+        t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ---------------------------------------------------------------- events
+
+    def emit_event(self, involved: Mapping, reason: str, message: str,
+                   type_: str = "Normal", count: int = 1) -> dict:
+        import uuid
+
+        ns = ko.namespace(involved) or "default"
+        return self.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {
+                    "name": f"{ko.name(involved)}.{uuid.uuid4().hex[:10]}",
+                    "namespace": ns,
+                },
+                "involvedObject": {
+                    "kind": involved.get("kind"),
+                    "name": ko.name(involved),
+                    "namespace": ns,
+                    "uid": involved.get("metadata", {}).get("uid"),
+                },
+                "reason": reason,
+                "message": message,
+                "type": type_,
+                "count": count,
+            }
+        )
+
+    def events_for(self, involved: Mapping) -> list[dict]:
+        ns = ko.namespace(involved)
+        return [
+            e for e in self.list("Event", ns)
+            if e.get("involvedObject", {}).get("name") == ko.name(involved)
+            and e.get("involvedObject", {}).get("kind") == involved.get("kind")
+        ]

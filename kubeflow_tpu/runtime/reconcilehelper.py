@@ -36,16 +36,35 @@ def reconcile_object(
     return cluster.update(merged)
 
 
+def subset_matches(desired, existing) -> bool:
+    """Is every field the controller *declares* already present in the live
+    object? API servers default many fields the controller never set
+    (Service sessionAffinity, pod-template defaults, ...); diffing full specs
+    against them would make every reconcile dirty and loop update→watch→
+    reconcile forever. So dirtiness is judged only on declared fields."""
+    if isinstance(desired, dict):
+        if not isinstance(existing, dict):
+            return False
+        return all(subset_matches(v, existing.get(k)) for k, v in desired.items())
+    if isinstance(desired, list):
+        if not isinstance(existing, list) or len(desired) != len(existing):
+            return False
+        return all(subset_matches(d, e) for d, e in zip(desired, existing))
+    return desired == existing
+
+
 def copy_spec_fields(existing: dict, desired: dict) -> dict | None:
     """Default copier: own labels/annotations/spec, keep everything else."""
     changed = False
     out = ko.deep_copy(existing)
     for field in ("labels", "annotations"):
         want = desired.get("metadata", {}).get(field)
-        if want is not None and out["metadata"].get(field) != want:
+        if want is not None and not subset_matches(want, out["metadata"].get(field)):
             out["metadata"][field] = want
             changed = True
-    if desired.get("spec") is not None and out.get("spec") != desired["spec"]:
+    if desired.get("spec") is not None and not subset_matches(
+        desired["spec"], out.get("spec")
+    ):
         out["spec"] = ko.deep_copy(desired["spec"])
         changed = True
     return out if changed else None
@@ -76,7 +95,7 @@ def copy_statefulset_fields(existing: dict, desired: dict) -> dict | None:
             changed = True
     espec, dspec = out.setdefault("spec", {}), desired.get("spec", {})
     for field in ("replicas", "template"):
-        if field in dspec and espec.get(field) != dspec[field]:
+        if field in dspec and not subset_matches(dspec[field], espec.get(field)):
             espec[field] = ko.deep_copy(dspec[field])
             changed = True
     return out if changed else None

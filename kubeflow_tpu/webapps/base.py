@@ -1,0 +1,165 @@
+"""Shared web-app backend library (the crud_backend analog, SURVEY.md L5).
+
+Everything the reference's ``crud-web-apps/common/backend/kubeflow/kubeflow/
+crud_backend`` package provides, on Werkzeug instead of Flask (which isn't in
+the TPU image): header authn (``authn.py``), per-verb authz
+(``authz.py:25-132``), CSRF double-submit cookie (``csrf.py:57-90``),
+success/error JSON envelope, liveness/readiness probes (``probes.py:8-17``),
+Prometheus text metrics, and SPA serving with a no-cache index
+(``serving.py:18-31``).
+
+Apps are plain WSGI callables — servable by any WSGI server and testable with
+``werkzeug.test.Client`` (no socket needed).
+"""
+from __future__ import annotations
+
+import json
+import secrets
+import traceback
+from typing import Any, Callable
+
+from werkzeug.exceptions import HTTPException, NotFound
+from werkzeug.routing import Map, Rule
+from werkzeug.wrappers import Request, Response
+
+from kubeflow_tpu.auth.rbac import AuthError, Authorizer, Forbidden, User, authenticate
+from kubeflow_tpu.runtime.fake import AdmissionDenied, AlreadyExists
+from kubeflow_tpu.runtime.fake import NotFound as ClusterNotFound
+from kubeflow_tpu.utils.metrics import Registry
+
+CSRF_COOKIE = "XSRF-TOKEN"
+CSRF_HEADER = "X-XSRF-TOKEN"
+SAFE_METHODS = {"GET", "HEAD", "OPTIONS"}
+
+
+def success(key: str | None = None, value: Any = None, **extra) -> Response:
+    """The crud_backend success envelope (``api.success_response``)."""
+    body = {"success": True, "status": 200}
+    if key is not None:
+        body[key] = value
+    body.update(extra)
+    return Response(json.dumps(body), mimetype="application/json")
+
+
+def error(status: int, log_text: str) -> Response:
+    body = {"success": False, "status": status, "log": log_text}
+    return Response(json.dumps(body), status=status, mimetype="application/json")
+
+
+class App:
+    """Minimal routed WSGI app with the platform's auth/CSRF/probe plumbing."""
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        authorizer: Authorizer | None = None,
+        userid_header: str = "kubeflow-userid",
+        userid_prefix: str = "",
+        csrf_protect: bool = True,
+        metrics_registry: Registry | None = None,
+    ) -> None:
+        self.name = name
+        self.authorizer = authorizer
+        self.userid_header = userid_header
+        self.userid_prefix = userid_prefix
+        self.csrf_protect = csrf_protect
+        self.metrics_registry = metrics_registry
+        self.url_map = Map()
+        self.endpoints: dict[str, Callable] = {}
+        # probes (ref probes.py:8-17)
+        self.route("/healthz/liveness")(lambda req: success("message", "alive"))
+        self.route("/healthz/readiness")(lambda req: success("message", "ready"))
+        if metrics_registry is not None:
+            self.route("/metrics")(
+                lambda req: Response(
+                    metrics_registry.expose(), mimetype="text/plain"
+                )
+            )
+
+    def route(self, rule: str, methods: tuple[str, ...] = ("GET",)):
+        def deco(fn):
+            endpoint = f"{fn.__name__}:{rule}:{','.join(methods)}"
+            self.url_map.add(Rule(rule, endpoint=endpoint, methods=list(methods)))
+            self.endpoints[endpoint] = fn
+            return fn
+
+        return deco
+
+    # ----------------------------------------------------------------- auth
+
+    def current_user(self, request: Request) -> User:
+        return authenticate(
+            request.headers,
+            userid_header=self.userid_header,
+            userid_prefix=self.userid_prefix,
+        )
+
+    def ensure(self, request: Request, verb: str, resource: str, namespace: str) -> User:
+        """authn + authz in one call (the reference's @needs_authorization)."""
+        user = self.current_user(request)
+        if self.authorizer is not None:
+            self.authorizer.ensure(user, verb, resource, namespace)
+        return user
+
+    # ----------------------------------------------------------------- wsgi
+
+    def _check_csrf(self, request: Request) -> Response | None:
+        """Double-submit cookie (ref csrf.py:57-90): mutating requests must
+        echo the cookie token in the header."""
+        if not self.csrf_protect or request.method in SAFE_METHODS:
+            return None
+        cookie = request.cookies.get(CSRF_COOKIE)
+        header = request.headers.get(CSRF_HEADER)
+        # Missing cookie is a Forbidden, like the reference (csrf.py:96-98):
+        # a browser that never loaded the app must not be able to mutate.
+        if not cookie or header != cookie:
+            return error(403, "CSRF token missing or incorrect")
+        return None
+
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        adapter = self.url_map.bind_to_environ(environ)
+        try:
+            csrf_fail = self._check_csrf(request)
+            if csrf_fail is not None:
+                return csrf_fail(environ, start_response)
+            endpoint, args = adapter.match()
+            response = self.endpoints[endpoint](request, **args)
+            if isinstance(response, dict):
+                response = success(**response)
+        except AuthError as e:
+            response = error(getattr(e, "status", 401), str(e))
+        except (ClusterNotFound, NotFound) as e:
+            response = error(404, str(e))
+        except AlreadyExists as e:
+            response = error(409, str(e))
+        except AdmissionDenied as e:
+            response = error(403, str(e))
+        except ValueError as e:
+            response = error(400, str(e))
+        except HTTPException as e:
+            response = error(e.code or 500, e.description or str(e))
+        except Exception:
+            response = error(500, traceback.format_exc(limit=3))
+        # seed the CSRF cookie on safe responses (double-submit bootstrap)
+        if (
+            self.csrf_protect
+            and request.method in SAFE_METHODS
+            and CSRF_COOKIE not in request.cookies
+        ):
+            response.set_cookie(
+                CSRF_COOKIE, secrets.token_urlsafe(16), samesite="Strict"
+            )
+        return response(environ, start_response)
+
+
+def get_json(request: Request, *required: str) -> dict:
+    """request_is_json_type + required_body_params (ref decorators.py)."""
+    if not request.is_json:
+        raise ValueError("Request must be application/json")
+    body = request.get_json()
+    missing = [p for p in required if p not in body]
+    if missing:
+        raise ValueError(f"Missing required body params: {missing}")
+    return body

@@ -1,0 +1,146 @@
+"""Central dashboard backend: platform aggregation API.
+
+Parity with the reference's Express server endpoints
+(``centraldashboard/app/api.ts:31-95`` and ``api_workgroup.ts:254-388``):
+
+  GET  /api/workgroup/env-info      namespaces + platform + user + registration
+  GET  /api/workgroup/exists        has the user a profile?
+  POST /api/workgroup/create        self-serve registration
+  GET  /api/namespaces              all namespaces
+  GET  /api/activities/<namespace>  recent events (ref activities endpoint)
+  GET  /api/dashboard-links         configurable menu/link set
+  GET  /api/metrics/<type>          cluster metrics; the reference only ships a
+       Stackdriver impl (metrics_service_factory.ts:24) — here the default
+       impl reads the platform's own Prometheus registries (TPU-first:
+       chips-in-use is a first-class series)
+"""
+from __future__ import annotations
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.auth.kfam import BindingClient, ProfileClient
+from kubeflow_tpu.auth.rbac import Authorizer
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.utils.metrics import NotebookMetrics
+from kubeflow_tpu.webapps.base import App, get_json, success
+
+DEFAULT_LINKS = {
+    "menuLinks": [
+        {"type": "item", "link": "/jupyter/", "text": "Notebooks", "icon": "book"},
+        {"type": "item", "link": "/tensorboards/", "text": "TensorBoards", "icon": "assessment"},
+        {"type": "item", "link": "/volumes/", "text": "Volumes", "icon": "device:storage"},
+    ],
+    "externalLinks": [],
+    "documentationItems": [
+        {
+            "text": "TPU Notebook Platform",
+            "desc": "Run JAX/XLA notebooks on TPU pod slices",
+            "link": "/docs/",
+        }
+    ],
+}
+
+
+def create_app(
+    cluster: FakeCluster,
+    *,
+    userid_header: str = "kubeflow-userid",
+    userid_prefix: str = "",
+    cluster_admins: set[str] | None = None,
+    metrics: NotebookMetrics | None = None,
+    links: dict | None = None,
+) -> App:
+    app = App(
+        "centraldashboard",
+        userid_header=userid_header,
+        userid_prefix=userid_prefix,
+        authorizer=Authorizer(cluster, cluster_admins=cluster_admins),
+    )
+    bindings = BindingClient(cluster)
+    profiles = ProfileClient(cluster, cluster_admins=cluster_admins)
+    metrics = metrics or NotebookMetrics()
+
+    @app.route("/api/workgroup/env-info")
+    def env_info(request):
+        user = app.current_user(request)
+        namespaces = profiles.namespaces_for_user(user.name, bindings)
+        return success(
+            "user", user.name,
+            platform={"kind": "tpu-native", "provider": "gke"},
+            namespaces=[
+                {"namespace": ns, "role": "owner" if _owns(ns, user.name) else "contributor"}
+                for ns in namespaces
+            ],
+            hasWorkgroup=any(_owns(ns, user.name) for ns in namespaces),
+            isClusterAdmin=profiles.is_cluster_admin(user.name),
+        )
+
+    def _owns(ns: str, user: str) -> bool:
+        prof = cluster.try_get("Profile", ns)
+        return bool(
+            prof and prof.get("spec", {}).get("owner", {}).get("name") == user
+        )
+
+    @app.route("/api/workgroup/exists")
+    def exists(request):
+        user = app.current_user(request)
+        owned = [
+            p for p in cluster.list("Profile")
+            if p.get("spec", {}).get("owner", {}).get("name") == user.name
+        ]
+        return success("hasAuth", True, hasWorkgroup=bool(owned), user=user.name)
+
+    @app.route("/api/workgroup/create", methods=("POST",))
+    def create_workgroup(request):
+        user = app.current_user(request)
+        body = request.get_json(silent=True) or {}
+        name = body.get("namespace") or user.name.split("@")[0]
+        cluster.create(api.profile(name, user.name))
+        return success("message", f"Profile {name} created")
+
+    @app.route("/api/namespaces")
+    def namespaces(request):
+        app.current_user(request)
+        return success("namespaces", [ko.name(n) for n in cluster.list("Namespace")])
+
+    @app.route("/api/activities/<namespace>")
+    def activities(request, namespace):
+        # per-namespace authz: events leak tenant activity (object names,
+        # failure messages) — same guard as JWA's events endpoint
+        app.ensure(request, "list", "events", namespace)
+        events = cluster.list("Event", namespace)
+        return success(
+            "activities",
+            [
+                {
+                    "event": e.get("reason"),
+                    "message": e.get("message"),
+                    "type": e.get("type"),
+                    "involved": e.get("involvedObject", {}).get("name"),
+                }
+                for e in events[-50:]
+            ],
+        )
+
+    @app.route("/api/dashboard-links")
+    def dashboard_links(request):
+        return success(None, **(links or DEFAULT_LINKS))
+
+    @app.route("/api/metrics/<metric_type>")
+    def cluster_metrics(request, metric_type):
+        app.current_user(request)
+        metrics.observe_notebooks(cluster)
+        if metric_type == "notebooks":
+            return success("values", _series(metrics.running))
+        if metric_type == "tpus":
+            return success("values", _series(metrics.tpu_chips_in_use))
+        raise ValueError(f"unknown metric type {metric_type!r}")
+
+    def _series(metric):
+        with metric._lock:
+            return [
+                {"labels": dict(zip(metric._label_names, k)), "value": v}
+                for k, v in sorted(metric._values.items())
+            ]
+
+    return app

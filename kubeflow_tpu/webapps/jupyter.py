@@ -1,0 +1,295 @@
+"""Jupyter web app (JWA) backend: spawner + notebook management REST.
+
+Route/behavior parity with the reference
+(``crud-web-apps/jupyter/backend/apps``):
+
+  GET    /api/config                                   (get.py:14-17)
+  GET    /api/namespaces/<ns>/notebooks                (get.py:52-57)
+  GET    /api/namespaces/<ns>/notebooks/<name>         (get.py:59-62)
+  GET    /api/namespaces/<ns>/notebooks/<name>/pod     (get.py:64-77)
+  GET    /api/namespaces/<ns>/notebooks/<name>/events  (get.py:89-95)
+  GET    /api/namespaces/<ns>/pvcs                     (get.py:20-27)
+  GET    /api/namespaces/<ns>/poddefaults              (get.py:29-49)
+  GET    /api/tpus                 ← generalizes /api/gpus (get.py:99-120):
+         TPU availability = node pools matching (accelerator, topology)
+  POST   /api/namespaces/<ns>/notebooks  — form → CR with readOnly guard +
+         dry-run-first semantics (post.py:11-73)
+  PATCH  /api/namespaces/<ns>/notebooks/<name>  stop/start via the
+         kubeflow-resource-stopped annotation (patch.py:18-76)
+  DELETE /api/namespaces/<ns>/notebooks/<name>  (delete.py)
+
+Status derivation for the index table follows the reference's CR+events logic
+(``apps/common/status.py:9-99``).
+"""
+from __future__ import annotations
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.auth.rbac import Authorizer
+from kubeflow_tpu.culler.culler import format_time
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.tpu.topology import ACCELERATORS, parse_topology, validate_against_node_capacity
+from kubeflow_tpu.utils.metrics import NotebookMetrics
+from kubeflow_tpu.webapps import spawner_config
+from kubeflow_tpu.webapps.base import App, get_json, success
+
+import time
+
+
+def notebook_status(nb: dict, events: list[dict]) -> dict:
+    """Derive UI status (ref status.py:9-99)."""
+    anns = ko.annotations(nb)
+    ready = nb.get("status", {}).get("readyReplicas", 0)
+    topo = api.notebook_topology(nb)
+    expected = topo.num_hosts if topo else 1
+    if api.STOP_ANNOTATION in anns:
+        if ready == 0:
+            return {"phase": "stopped", "message": "No Pods are currently running."}
+        return {"phase": "terminating", "message": "Notebook Server is stopping."}
+    if ready >= expected:
+        return {"phase": "ready", "message": "Running"}
+    warnings = [e for e in events if e.get("type") == "Warning"]
+    if warnings:
+        return {"phase": "warning", "message": warnings[-1].get("message", "")}
+    return {"phase": "waiting", "message": "Starting Notebook Server."}
+
+
+def notebook_summary(nb: dict, events: list[dict]) -> dict:
+    """Index-table row (ref utils.notebook_dict_from_k8s_obj)."""
+    # guard: CRs created out-of-band (kubectl) may omit containers entirely;
+    # one malformed CR must not 500 the whole namespace listing
+    pod_spec = nb.get("spec", {}).get("template", {}).get("spec", {})
+    container = (pod_spec.get("containers") or [{}])[0]
+    topo = api.notebook_topology(nb)
+    return {
+        "name": ko.name(nb),
+        "namespace": ko.namespace(nb),
+        "serverType": ko.annotations(nb).get(api.SERVER_TYPE_ANNOTATION, "jupyter"),
+        "image": container.get("image"),
+        "cpu": container.get("resources", {}).get("requests", {}).get("cpu"),
+        "memory": container.get("resources", {}).get("requests", {}).get("memory"),
+        "tpu": topo.to_dict() if topo else None,
+        "status": notebook_status(nb, events),
+        "volumes": [v.get("name") for v in pod_spec.get("volumes", [])],
+        "lastActivity": ko.annotations(nb).get(api.LAST_ACTIVITY_ANNOTATION, ""),
+    }
+
+
+def create_app(
+    cluster: FakeCluster,
+    *,
+    authorizer: Authorizer | None = None,
+    config_path: str | None = None,
+    metrics: NotebookMetrics | None = None,
+) -> App:
+    metrics = metrics or NotebookMetrics()
+    app = App(
+        "jupyter-web-app",
+        authorizer=authorizer or Authorizer(cluster),
+        metrics_registry=metrics.registry,
+    )
+
+    @app.route("/api/config")
+    def get_config(request):
+        return success("config", spawner_config.load_config(config_path))
+
+    @app.route("/api/tpus")
+    def get_tpus(request):
+        """Available (accelerator, topology) pairs probed from node capacity —
+        the TPU generalization of the reference's GPU vendor intersection."""
+        nodes = cluster.list("Node")
+        config = spawner_config.load_config(config_path)
+        tpu_cfg = config["spawnerFormDefaults"].get("tpu", {})
+        available = []
+        for accel in tpu_cfg.get("accelerators", []):
+            topologies = [
+                t for t in accel.get("topologies", [])
+                if validate_against_node_capacity(
+                    parse_topology(accel["name"], t), nodes
+                )
+            ]
+            if topologies:
+                available.append(
+                    {"name": accel["name"], "topologies": topologies}
+                )
+        return success("tpus", available)
+
+    @app.route("/api/namespaces/<namespace>/notebooks")
+    def list_notebooks(request, namespace):
+        app.ensure(request, "list", "notebooks", namespace)
+        out = []
+        for nb in cluster.list("Notebook", namespace):
+            out.append(notebook_summary(nb, cluster.events_for(nb)))
+        return success("notebooks", out)
+
+    @app.route("/api/namespaces/<namespace>/notebooks/<name>")
+    def get_notebook(request, namespace, name):
+        app.ensure(request, "get", "notebooks", namespace)
+        return success("notebook", cluster.get("Notebook", name, namespace))
+
+    @app.route("/api/namespaces/<namespace>/notebooks/<name>/pod")
+    def get_notebook_pod(request, namespace, name):
+        app.ensure(request, "get", "pods", namespace)
+        pods = cluster.list(
+            "Pod", namespace, {"matchLabels": {"notebook-name": name}}
+        )
+        if not pods:
+            from werkzeug.exceptions import NotFound
+
+            raise NotFound("No pod detected.")
+        return success("pod", pods[0], pods=pods)  # all gang pods for TPU view
+
+    @app.route("/api/namespaces/<namespace>/notebooks/<name>/events")
+    def get_notebook_events(request, namespace, name):
+        app.ensure(request, "list", "events", namespace)
+        nb = cluster.get("Notebook", name, namespace)
+        return success("events", cluster.events_for(nb))
+
+    @app.route("/api/namespaces/<namespace>/pvcs")
+    def list_pvcs(request, namespace):
+        app.ensure(request, "list", "persistentvolumeclaims", namespace)
+        out = [
+            {
+                "name": ko.name(pvc),
+                "size": pvc.get("spec", {}).get("resources", {}).get("requests", {}).get("storage"),
+                "mode": (pvc.get("spec", {}).get("accessModes") or [None])[0],
+            }
+            for pvc in cluster.list("PersistentVolumeClaim", namespace)
+        ]
+        return success("pvcs", out)
+
+    @app.route("/api/namespaces/<namespace>/poddefaults")
+    def list_poddefaults(request, namespace):
+        app.ensure(request, "list", "poddefaults", namespace)
+        out = []
+        for pd in cluster.list("PodDefault", namespace):
+            labels = pd["spec"].get("selector", {}).get("matchLabels", {})
+            pd = ko.deep_copy(pd)
+            pd["label"] = next(iter(labels), "")
+            pd["desc"] = pd["spec"].get("desc") or ko.name(pd)
+            out.append(pd)
+        return success("poddefaults", out)
+
+    @app.route("/api/namespaces/<namespace>/notebooks", methods=("POST",))
+    def post_notebook(request, namespace):
+        user = app.ensure(request, "create", "notebooks", namespace)
+        body = get_json(request, "name")
+        defaults = spawner_config.load_config(config_path)
+        nb, new_pvcs = build_notebook(body, namespace, defaults, user.name)
+
+        # dry-run everything first (ref post.py:48-54): all-or-nothing UX
+        api_errors = api.validate_notebook(nb)
+        if api_errors:
+            raise ValueError("; ".join(api_errors))
+        if cluster.try_get("Notebook", ko.name(nb), namespace):
+            raise ValueError(f"Notebook {ko.name(nb)} already exists")
+        for pvc in new_pvcs:
+            if cluster.try_get("PersistentVolumeClaim", ko.name(pvc), namespace):
+                raise ValueError(f"PVC {ko.name(pvc)} already exists")
+
+        for pvc in new_pvcs:
+            cluster.create(pvc)
+        cluster.create(nb)
+        metrics.notebook_created(namespace)
+        return success("message", "Notebook created successfully.")
+
+    @app.route(
+        "/api/namespaces/<namespace>/notebooks/<name>", methods=("PATCH",)
+    )
+    def patch_notebook(request, namespace, name):
+        app.ensure(request, "patch", "notebooks", namespace)
+        body = get_json(request)
+        nb = cluster.get("Notebook", name, namespace)
+        if "stopped" in body:
+            # ref patch.py:18-76
+            if body["stopped"]:
+                ko.set_annotation(nb, api.STOP_ANNOTATION, format_time(time.time()))
+                ko.remove_annotation(nb, api.LAST_ACTIVITY_ANNOTATION)
+            else:
+                ko.remove_annotation(nb, api.STOP_ANNOTATION)
+            cluster.update(nb)
+        return success("message", "Notebook updated")
+
+    @app.route(
+        "/api/namespaces/<namespace>/notebooks/<name>", methods=("DELETE",)
+    )
+    def delete_notebook(request, namespace, name):
+        app.ensure(request, "delete", "notebooks", namespace)
+        cluster.delete("Notebook", name, namespace)
+        return success("message", "Notebook deleted")
+
+    return app
+
+
+def build_notebook(body: dict, namespace: str, defaults: dict, creator: str) -> tuple[dict, list[dict]]:
+    """Assemble the Notebook CR from the form (ref form.py + post.py flow),
+    honoring readOnly config fields, plus TPU topology validation."""
+    fv = spawner_config.form_value
+    name = body["name"]
+
+    tpu = fv(body, defaults, "tpu") or {}
+    accelerator = tpu.get("accelerator") or "none"
+    tpu_kwargs = {}
+    if accelerator != "none":
+        tpu_kwargs = {
+            "tpu_accelerator": accelerator,
+            "tpu_topology": tpu.get("topology", ""),
+        }
+
+    nb = api.notebook(
+        name,
+        namespace,
+        image=fv(body, defaults, "image"),
+        cpu=str(fv(body, defaults, "cpu")),
+        memory=str(fv(body, defaults, "memory")),
+        annotations={
+            api.CREATOR_ANNOTATION: creator,
+            api.SERVER_TYPE_ANNOTATION: fv(body, defaults, "serverType"),
+        },
+        labels={c: "true" for c in fv(body, defaults, "configurations") or []},
+        **tpu_kwargs,
+    )
+    nb["spec"]["template"]["spec"]["serviceAccountName"] = "default-editor"
+
+    pod_spec = nb["spec"]["template"]["spec"]
+    container = pod_spec["containers"][0]
+    new_pvcs: list[dict] = []
+    volumes = []
+    mounts = []
+
+    # Missing form fields fall back to the config default (the spawner UI
+    # pre-fills them from /api/config; API callers get the same defaults).
+    workspace = fv(body, defaults, "workspace", "workspaceVolume")
+    if body.get("workspace") is None and "workspace" in body:
+        workspace = None  # explicit null = "no workspace volume"
+    datavols = fv(body, defaults, "datavols", "dataVolumes") or []
+    for vol in ([workspace] if workspace else []) + list(datavols):
+        vol = ko.deep_copy(vol)
+        new_pvc = vol.get("newPvc")
+        if new_pvc:
+            pvc_name = (
+                new_pvc.get("metadata", {}).get("name", f"{name}-vol")
+                .replace("{notebook-name}", name)
+            )
+            pvc = {
+                "apiVersion": "v1",
+                "kind": "PersistentVolumeClaim",
+                "metadata": {"name": pvc_name, "namespace": namespace},
+                "spec": ko.deep_copy(new_pvc.get("spec", {})),
+            }
+            new_pvcs.append(pvc)
+        else:
+            pvc_name = vol.get("existingSource", vol.get("name", ""))
+        vol_name = pvc_name
+        volumes.append(
+            {"name": vol_name, "persistentVolumeClaim": {"claimName": pvc_name}}
+        )
+        mounts.append({"name": vol_name, "mountPath": vol.get("mount", "/data")})
+
+    if fv(body, defaults, "shm"):
+        volumes.append({"name": "dshm", "emptyDir": {"medium": "Memory"}})
+        mounts.append({"name": "dshm", "mountPath": "/dev/shm"})
+    if volumes:
+        pod_spec["volumes"] = volumes
+        container["volumeMounts"] = mounts
+    return nb, new_pvcs

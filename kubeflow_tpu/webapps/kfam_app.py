@@ -1,0 +1,116 @@
+"""Access-management REST service (kfam).
+
+Route parity with the reference (``access-management/kfam/routers.go:32-88``):
+
+  GET/POST/DELETE /kfam/v1/bindings
+  GET/POST/DELETE /kfam/v1/profiles[/<name>]
+  GET             /kfam/v1/role/clusteradmin
+
+Contributor management rule (ref api_default.go): only the profile owner or a
+cluster admin may add/remove contributors in a namespace.
+"""
+from __future__ import annotations
+
+from kubeflow_tpu.auth.kfam import BindingClient, ProfileClient
+from kubeflow_tpu.auth.rbac import Forbidden
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.webapps.base import App, get_json, success
+
+
+def create_app(
+    cluster: FakeCluster,
+    *,
+    userid_header: str = "kubeflow-userid",
+    userid_prefix: str = "",
+    cluster_admins: set[str] | None = None,
+) -> App:
+    app = App(
+        "kfam", userid_header=userid_header, userid_prefix=userid_prefix
+    )
+    bindings = BindingClient(
+        cluster, userid_header=userid_header, userid_prefix=userid_prefix
+    )
+    profiles = ProfileClient(cluster, cluster_admins=cluster_admins)
+
+    def _can_manage(user: str, namespace: str) -> bool:
+        if profiles.is_cluster_admin(user):
+            return True
+        prof = cluster.try_get("Profile", namespace)
+        return (
+            prof is not None
+            and prof.get("spec", {}).get("owner", {}).get("name") == user
+        )
+
+    @app.route("/kfam/v1/bindings")
+    def list_bindings(request):
+        app.current_user(request)
+        ns = request.args.get("namespace")
+        return success(
+            "bindings",
+            bindings.list(
+                user=request.args.get("user", ""),
+                namespaces=[ns] if ns else None,
+                role=request.args.get("role", ""),
+            ),
+        )
+
+    @app.route("/kfam/v1/bindings", methods=("POST",))
+    def create_binding(request):
+        user = app.current_user(request)
+        body = get_json(request, "user", "referredNamespace", "roleRef")
+        ns = body["referredNamespace"]
+        if not _can_manage(user.name, ns):
+            raise Forbidden(
+                f"User '{user.name}' may not manage contributors in '{ns}'"
+            )
+        bindings.create(body["user"], ns, body["roleRef"]["name"])
+        return success("message", "Binding created")
+
+    @app.route("/kfam/v1/bindings", methods=("DELETE",))
+    def delete_binding(request):
+        user = app.current_user(request)
+        body = get_json(request, "user", "referredNamespace", "roleRef")
+        ns = body["referredNamespace"]
+        if not _can_manage(user.name, ns):
+            raise Forbidden(
+                f"User '{user.name}' may not manage contributors in '{ns}'"
+            )
+        bindings.delete(body["user"], ns, body["roleRef"]["name"])
+        return success("message", "Binding deleted")
+
+    @app.route("/kfam/v1/profiles", methods=("POST",))
+    def create_profile(request):
+        user = app.current_user(request)
+        body = get_json(request, "metadata", "spec")
+        profile = {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "Profile",
+            "metadata": {"name": body["metadata"]["name"]},
+            "spec": body["spec"],
+        }
+        owner = profile["spec"].get("owner", {}).get("name")
+        if owner != user.name and not profiles.is_cluster_admin(user.name):
+            raise Forbidden("may only create a profile owned by yourself")
+        profiles.create(profile)
+        return success("message", "Profile created")
+
+    @app.route("/kfam/v1/profiles/<name>")
+    def get_profile(request, name):
+        app.current_user(request)
+        return success("profile", profiles.get(name))
+
+    @app.route("/kfam/v1/profiles/<name>", methods=("DELETE",))
+    def delete_profile(request, name):
+        user = app.current_user(request)
+        if not _can_manage(user.name, name):
+            raise Forbidden(f"User '{user.name}' may not delete profile '{name}'")
+        profiles.delete(name)
+        return success("message", "Profile deleted")
+
+    @app.route("/kfam/v1/role/clusteradmin")
+    def cluster_admin(request):
+        user = app.current_user(request)
+        return success("role", profiles.is_cluster_admin(user.name))
+
+    return app

@@ -1,0 +1,108 @@
+"""Spawner form configuration with value/readOnly semantics.
+
+The reference drives its spawner form from a ConfigMap-mounted YAML where
+every field carries ``value`` + ``readOnly``
+(``apps/common/yaml/spawner_ui_config.yaml:1-17``; loader fallback chain
+``apps/common/utils.py:22-53``). Same contract here, with the GPU vendor
+section (``spawner_ui_config.yaml:113-126``) replaced by a first-class **TPU
+topology picker**: the form offers validated (accelerator, topology) pairs and
+the backend cross-checks them against live node capacity — no free-typed
+resource-limit strings.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping
+
+import yaml
+
+from kubeflow_tpu.tpu.topology import ACCELERATORS
+
+CONFIG_PATH_ENV = "SPAWNER_UI_CONFIG"
+DEFAULT_CONFIG_PATH = "/etc/config/spawner_ui_config.yaml"
+
+DEFAULT_CONFIG: dict = {
+    "spawnerFormDefaults": {
+        "image": {
+            "value": "kubeflow-tpu/jupyter-jax:latest",
+            "options": [
+                "kubeflow-tpu/jupyter-scipy:latest",
+                "kubeflow-tpu/jupyter-jax:latest",
+                "kubeflow-tpu/jupyter-jax-full:latest",
+                "kubeflow-tpu/jupyter-pytorch-xla:latest",
+            ],
+            "readOnly": False,
+        },
+        "imagePullPolicy": {"value": "IfNotPresent", "readOnly": False},
+        "cpu": {"value": "0.5", "limitFactor": "1.2", "readOnly": False},
+        "memory": {"value": "1.0Gi", "limitFactor": "1.2", "readOnly": False},
+        "workspaceVolume": {
+            "value": {
+                "mount": "/home/jovyan",
+                "newPvc": {
+                    "metadata": {"name": "{notebook-name}-workspace"},
+                    "spec": {
+                        "resources": {"requests": {"storage": "10Gi"}},
+                        "accessModes": ["ReadWriteOnce"],
+                    },
+                },
+            },
+            "readOnly": False,
+        },
+        "dataVolumes": {"value": [], "readOnly": False},
+        # TPU replaces the reference's `gpus` vendor dropdown
+        "tpu": {
+            "value": {"accelerator": "none", "topology": ""},
+            "accelerators": [
+                {
+                    "name": name,
+                    "displayName": f"TPU {name}",
+                    "topologies": _topos,
+                }
+                for name, _topos in (
+                    ("v4", ["2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4"]),
+                    ("v5e", ["1x1", "2x2", "2x4", "4x4", "4x8", "8x8"]),
+                    ("v5p", ["2x2x1", "2x2x2", "2x4x4", "4x4x4"]),
+                    ("v6e", ["1x1", "2x2", "2x4", "4x4", "4x8", "8x8"]),
+                )
+            ],
+            "readOnly": False,
+        },
+        "tolerationGroup": {"value": "none", "options": [], "readOnly": False},
+        "affinityConfig": {"value": "none", "options": [], "readOnly": False},
+        "configurations": {"value": [], "readOnly": False},
+        "shm": {"value": True, "readOnly": False},
+        "serverType": {"value": "jupyter", "readOnly": False},
+    }
+}
+
+
+def load_config(path: str | None = None) -> dict:
+    """Fallback chain: explicit path → env → mounted ConfigMap → in-tree
+    default (ref utils.py:22-53)."""
+    candidates = [
+        p for p in (path, os.environ.get(CONFIG_PATH_ENV), DEFAULT_CONFIG_PATH)
+        if p
+    ]
+    for candidate in candidates:
+        if os.path.isfile(candidate):
+            with open(candidate) as f:
+                loaded = yaml.safe_load(f) or {}
+            if "spawnerFormDefaults" in loaded:
+                return loaded
+    return DEFAULT_CONFIG
+
+
+def form_value(body: Mapping, defaults: Mapping, body_field: str,
+               config_field: str | None = None, optional: bool = False) -> Any:
+    """readOnly enforcement (ref form.py:16-60): a readOnly field always takes
+    the configured value; otherwise the user's value, falling back to config."""
+    config_field = config_field or body_field
+    section = defaults.get("spawnerFormDefaults", {}).get(config_field, {})
+    if section.get("readOnly"):
+        return section.get("value")
+    if body_field in body:
+        return body[body_field]
+    if optional:
+        return None
+    return section.get("value")

@@ -1,0 +1,52 @@
+"""Tensorboards web app (TWA) backend.
+
+Parity with ``crud-web-apps/tensorboards/backend/app/routes``
+(get.py:9-23, post.py:14, delete.py:8): Tensorboard CR CRUD with status.
+"""
+from __future__ import annotations
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.auth.rbac import Authorizer
+from kubeflow_tpu.controllers.tensorboard_controller import parse_logspath
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.webapps.base import App, get_json, success
+
+
+def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) -> App:
+    app = App("tensorboards-web-app", authorizer=authorizer or Authorizer(cluster))
+
+    @app.route("/api/namespaces/<namespace>/tensorboards")
+    def list_tensorboards(request, namespace):
+        app.ensure(request, "list", "tensorboards", namespace)
+        out = []
+        for tb in cluster.list("Tensorboard", namespace):
+            scheme, _ = parse_logspath(tb["spec"].get("logspath", ""))
+            ready = tb.get("status", {}).get("readyReplicas", 0)
+            out.append(
+                {
+                    "name": ko.name(tb),
+                    "namespace": namespace,
+                    "logspath": tb["spec"].get("logspath"),
+                    "storage": scheme,
+                    "phase": "ready" if ready else "waiting",
+                }
+            )
+        return success("tensorboards", out)
+
+    @app.route("/api/namespaces/<namespace>/tensorboards", methods=("POST",))
+    def post_tensorboard(request, namespace):
+        app.ensure(request, "create", "tensorboards", namespace)
+        body = get_json(request, "name", "logspath")
+        cluster.create(api.tensorboard(body["name"], namespace, body["logspath"]))
+        return success("message", "Tensorboard created successfully.")
+
+    @app.route(
+        "/api/namespaces/<namespace>/tensorboards/<name>", methods=("DELETE",)
+    )
+    def delete_tensorboard(request, namespace, name):
+        app.ensure(request, "delete", "tensorboards", namespace)
+        cluster.delete("Tensorboard", name, namespace)
+        return success("message", "Tensorboard deleted")
+
+    return app

@@ -1,0 +1,77 @@
+"""Volumes web app (VWA) backend: PVC CRUD + used-by view.
+
+Parity with ``crud-web-apps/volumes/backend/apps/default/routes`` — list PVCs
+with the pods mounting them (the "used by" column), create from a simple form
+(``apps/common/form.py pvc_from_dict``), delete with in-use protection.
+"""
+from __future__ import annotations
+
+from kubeflow_tpu.auth.rbac import Authorizer
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.webapps.base import App, get_json, success
+
+
+def pods_using_pvc(cluster: FakeCluster, namespace: str, claim: str) -> list[str]:
+    out = []
+    for pod in cluster.list("Pod", namespace):
+        for vol in pod.get("spec", {}).get("volumes", []):
+            if vol.get("persistentVolumeClaim", {}).get("claimName") == claim:
+                out.append(ko.name(pod))
+    return out
+
+
+def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) -> App:
+    app = App("volumes-web-app", authorizer=authorizer or Authorizer(cluster))
+
+    @app.route("/api/namespaces/<namespace>/pvcs")
+    def list_pvcs(request, namespace):
+        app.ensure(request, "list", "persistentvolumeclaims", namespace)
+        out = []
+        for pvc in cluster.list("PersistentVolumeClaim", namespace):
+            out.append(
+                {
+                    "name": ko.name(pvc),
+                    "namespace": namespace,
+                    "capacity": pvc.get("spec", {})
+                    .get("resources", {})
+                    .get("requests", {})
+                    .get("storage"),
+                    "modes": pvc.get("spec", {}).get("accessModes", []),
+                    "class": pvc.get("spec", {}).get("storageClassName"),
+                    "usedBy": pods_using_pvc(cluster, namespace, ko.name(pvc)),
+                    "status": pvc.get("status", {}).get("phase", "Bound"),
+                }
+            )
+        return success("pvcs", out)
+
+    @app.route("/api/namespaces/<namespace>/pvcs", methods=("POST",))
+    def post_pvc(request, namespace):
+        app.ensure(request, "create", "persistentvolumeclaims", namespace)
+        body = get_json(request, "name", "size", "mode")
+        pvc = {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": body["name"], "namespace": namespace},
+            "spec": {
+                "accessModes": [body["mode"]],
+                "resources": {"requests": {"storage": body["size"]}},
+            },
+        }
+        if body.get("class"):
+            pvc["spec"]["storageClassName"] = body["class"]
+        cluster.create(pvc)
+        return success("message", "PVC created successfully.")
+
+    @app.route("/api/namespaces/<namespace>/pvcs/<name>", methods=("DELETE",))
+    def delete_pvc(request, namespace, name):
+        app.ensure(request, "delete", "persistentvolumeclaims", namespace)
+        users = pods_using_pvc(cluster, namespace, name)
+        if users:
+            raise ValueError(
+                f"PVC {name} is in use by pods: {', '.join(users)}"
+            )
+        cluster.delete("PersistentVolumeClaim", name, namespace)
+        return success("message", "PVC deleted")
+
+    return app

@@ -1,0 +1,133 @@
+"""Deployable surfaces: AdmissionReview webhook server, kube REST paths,
+CRD rendering, entrypoint wiring."""
+import base64
+import json
+
+import yaml
+from werkzeug.test import Client
+
+from kubeflow_tpu.api import crds, types as api
+from kubeflow_tpu.cmd.controller import build_manager
+from kubeflow_tpu.cmd.serve import build_app
+from kubeflow_tpu.cmd.webhook import json_patch, make_wsgi_app
+from kubeflow_tpu.runtime.kubeclient import resource_path
+
+
+class TestAdmissionReviewServer:
+    def _review(self, pod):
+        return {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {"uid": "u1", "object": pod},
+        }
+
+    def test_tpu_env_patch_roundtrip(self, cluster):
+        client = Client(make_wsgi_app(cluster))
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "mesh-1",
+                "namespace": "alice",
+                "annotations": {
+                    "tpu.kubeflow.org/accelerator": "v4",
+                    "tpu.kubeflow.org/topology": "2x2x2",
+                    "tpu.kubeflow.org/notebook": "mesh",
+                },
+            },
+            "spec": {"containers": [{"name": "mesh", "env": []}]},
+        }
+        r = client.post("/inject-tpu-env", json=self._review(pod))
+        resp = r.get_json()["response"]
+        assert resp["allowed"] is True
+        patch = json.loads(base64.b64decode(resp["patch"]))
+        # list diffs are atomic replaces: the containers op carries the env
+        ops = [op for op in patch if op["path"] == "/spec/containers"]
+        env = {e["name"]: e["value"] for e in ops[0]["value"][0]["env"]}
+        assert env["TPU_WORKER_ID"] == "1"
+        assert env["JAX_NUM_PROCESSES"] == "2"
+
+    def test_poddefault_denial(self, cluster):
+        cluster.create(
+            api.pod_default(
+                "evil", "alice", selector={"matchLabels": {"x": "y"}},
+                env=[{"name": "TPU_WORKER_ID", "value": "9"}],
+            )
+        )
+        client = Client(make_wsgi_app(cluster))
+        pod = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p", "namespace": "alice", "labels": {"x": "y"}},
+            "spec": {"containers": [{"name": "c"}]},
+        }
+        r = client.post("/apply-poddefault", json=self._review(pod))
+        resp = r.get_json()["response"]
+        assert resp["allowed"] is False
+        assert "protected TPU worker env" in resp["status"]["message"]
+
+    def test_no_mutation_no_patch(self, cluster):
+        client = Client(make_wsgi_app(cluster))
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p", "namespace": "alice"},
+               "spec": {"containers": [{"name": "c"}]}}
+        r = client.post("/apply-poddefault", json=self._review(pod))
+        assert "patch" not in r.get_json()["response"]
+
+
+class TestJsonPatch:
+    def test_add_remove_replace(self):
+        before = {"a": 1, "b": {"c": 2}, "d": [1]}
+        after = {"a": 2, "b": {"c": 2, "e": 3}, "d": [1, 2]}
+        ops = {(op["op"], op["path"]) for op in json_patch(before, after)}
+        assert ops == {("replace", "/a"), ("add", "/b/e"), ("replace", "/d")}
+
+    def test_escapes_slashes_in_keys(self):
+        ops = json_patch({}, {"a/b": {"x~y": 1}})
+        assert ops[0]["path"] == "/a~1b"
+
+
+class TestKubeResourcePaths:
+    def test_core_and_group_paths(self):
+        assert resource_path("Pod", "ns", "p") == "/api/v1/namespaces/ns/pods/p"
+        assert resource_path("Notebook", "ns") == (
+            "/apis/kubeflow.org/v1beta1/namespaces/ns/notebooks"
+        )
+        assert resource_path("Profile", None, "alice") == (
+            "/apis/kubeflow.org/v1/profiles/alice"
+        )
+        assert resource_path("Node") == "/api/v1/nodes"
+
+
+class TestCrdRendering:
+    def test_all_crds_render_valid_yaml(self, tmp_path):
+        paths = crds.render_all(str(tmp_path))
+        assert len(paths) == 4
+        for p in paths:
+            doc = yaml.safe_load(open(p))
+            assert doc["kind"] == "CustomResourceDefinition"
+            for v in doc["spec"]["versions"]:
+                assert "openAPIV3Schema" in v["schema"]
+
+    def test_notebook_crd_has_tpu_schema(self):
+        doc = crds.notebook_crd()
+        v1beta1 = [v for v in doc["spec"]["versions"] if v["name"] == "v1beta1"][0]
+        tpu = v1beta1["schema"]["openAPIV3Schema"]["properties"]["spec"][
+            "properties"]["tpu"]
+        assert set(tpu["required"]) == {"accelerator", "topology"}
+        assert "v5e" in tpu["properties"]["accelerator"]["enum"]
+        storage = [v["name"] for v in doc["spec"]["versions"] if v["storage"]]
+        assert storage == ["v1beta1"]
+
+
+class TestEntrypoints:
+    def test_build_manager_standalone(self, cluster):
+        manager, metrics = build_manager(cluster)
+        cluster.create(api.notebook("nb", "ns"))
+        manager.run_until_idle()
+        assert cluster.get("StatefulSet", "nb", "ns")
+
+    def test_build_app_all_names(self, cluster):
+        for name in ("jupyter", "volumes", "tensorboards", "dashboard", "kfam"):
+            app = build_app(name, cluster)
+            client = Client(app)
+            assert client.get("/healthz/liveness").status_code == 200

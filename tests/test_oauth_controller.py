@@ -1,0 +1,79 @@
+"""OAuth companion controller (odh-notebook-controller analog)."""
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.controllers.oauth_controller import (
+    INJECT_ANNOTATION,
+    LOCK_ANNOTATION,
+    OAuthReconciler,
+    install_webhook,
+)
+from kubeflow_tpu.runtime.manager import Manager
+
+
+def _oauth_nb(name="nb", ns="alice"):
+    return api.notebook(name, ns, annotations={INJECT_ANNOTATION: "true"})
+
+
+def test_webhook_injects_sidecar(cluster):
+    install_webhook(cluster)
+    nb = cluster.create(_oauth_nb())
+    containers = nb["spec"]["template"]["spec"]["containers"]
+    names = [c["name"] for c in containers]
+    assert "oauth-proxy" in names
+    sidecar = containers[names.index("oauth-proxy")]
+    assert "--openshift-service-account=nb" in sidecar["args"]
+    vols = {v["name"] for v in nb["spec"]["template"]["spec"]["volumes"]}
+    assert {"oauth-config", "tls-certificates"} <= vols
+
+
+def test_webhook_skips_unannotated(cluster):
+    install_webhook(cluster)
+    nb = cluster.create(api.notebook("plain", "alice"))
+    names = [c["name"] for c in nb["spec"]["template"]["spec"]["containers"]]
+    assert "oauth-proxy" not in names
+
+
+def test_reconciler_materializes_oauth_objects(cluster):
+    m = Manager(cluster)
+    m.register(OAuthReconciler())
+    cluster.create(_oauth_nb())
+    m.run_until_idle()
+    assert cluster.get("Secret", "nb-oauth-config", "alice")["stringData"]["cookie_secret"]
+    sa = cluster.get("ServiceAccount", "nb", "alice")
+    assert "oauth-redirectreference" in str(sa["metadata"]["annotations"])
+    svc = cluster.get("Service", "nb-tls", "alice")
+    assert svc["spec"]["ports"][0]["port"] == 8443
+    route = cluster.get("Route", "nb", "alice")
+    assert route["spec"]["tls"]["termination"] == "reencrypt"
+
+
+def test_reconciliation_lock_until_pull_secret_ready(cluster):
+    m = Manager(cluster)
+    rec = OAuthReconciler(pull_secret_ready=False)
+    m.register(rec)
+    cluster.create(_oauth_nb())
+    m.run_until_idle()
+    nb = cluster.get("Notebook", "nb", "alice")
+    assert nb["metadata"]["annotations"][LOCK_ANNOTATION] == "true"
+    assert cluster.try_get("Route", "nb", "alice") is None
+    # credentials arrive: lock released on the requeue
+    rec.pull_secret_ready = True
+    m.advance(5.0)
+    m.run_until_idle()
+    nb = cluster.get("Notebook", "nb", "alice")
+    assert LOCK_ANNOTATION not in nb["metadata"]["annotations"]
+    assert cluster.get("Route", "nb", "alice")
+
+
+def test_composes_with_notebook_reconciler(cluster):
+    m = Manager(cluster)
+    m.register(NotebookReconciler())
+    m.register(OAuthReconciler())
+    install_webhook(cluster)
+    cluster.create(_oauth_nb())
+    m.run_until_idle()
+    sts = cluster.get("StatefulSet", "nb", "alice")
+    names = [c["name"] for c in sts["spec"]["template"]["spec"]["containers"]]
+    assert "oauth-proxy" in names  # sidecar flows CR -> pod template

@@ -1,0 +1,216 @@
+"""Profile reconciler + plugins + kfam + RBAC evaluator.
+
+Mirrors the reference envtest suite (profile-controller/controllers/
+profile_controller_test.go) plus TPU quota and plugin revocation flows.
+"""
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.auth import kfam
+from kubeflow_tpu.auth.rbac import Authorizer, AuthError, Forbidden, User, authenticate
+from kubeflow_tpu.controllers.profile_controller import (
+    DEFAULT_EDITOR,
+    DEFAULT_VIEWER,
+    ProfileReconciler,
+    QUOTA_NAME,
+)
+from kubeflow_tpu.controllers.profile_plugins import (
+    GCP_SA_ANNOTATION,
+    RecordingIamClient,
+    WorkloadIdentityPlugin,
+)
+from kubeflow_tpu.runtime.manager import Manager
+
+
+@pytest.fixture()
+def manager(cluster):
+    m = Manager(cluster)
+    m.register(ProfileReconciler())
+    return m
+
+
+class TestProfileReconcile:
+    def test_creates_namespace_rbac_and_policy(self, cluster, manager):
+        cluster.create(api.profile("alice", "alice@example.com"))
+        manager.run_until_idle()
+
+        ns = cluster.get("Namespace", "alice")
+        assert ns["metadata"]["annotations"]["owner"] == "alice@example.com"
+        assert ns["metadata"]["labels"]["istio-injection"] == "enabled"
+
+        for sa in (DEFAULT_EDITOR, DEFAULT_VIEWER):
+            assert cluster.get("ServiceAccount", sa, "alice")
+            assert cluster.get("RoleBinding", sa, "alice")
+        admin_rb = cluster.get("RoleBinding", "namespaceAdmin", "alice")
+        assert admin_rb["subjects"][0]["name"] == "alice@example.com"
+        assert admin_rb["roleRef"]["name"] == "kubeflow-admin"
+
+        policy = cluster.get("AuthorizationPolicy", "ns-owner-access-istio", "alice")
+        rules = policy["spec"]["rules"]
+        assert any(
+            "alice@example.com" in r.get("when", [{}])[0].get("values", [])
+            for r in rules if r.get("when")
+        )
+        # the culler probe rule exists (what lets kernel polling through istio)
+        assert any(
+            "/notebook/*/*/api/kernels" in str(r.get("to", "")) for r in rules
+        )
+
+        prof = cluster.get("Profile", "alice")
+        assert prof["status"]["conditions"][-1]["type"] == "Successful"
+
+    def test_ownership_guard_rejects_takeover(self, cluster, manager):
+        cluster.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Namespace",
+                "metadata": {"name": "victim", "annotations": {"owner": "bob"}},
+            }
+        )
+        cluster.create(api.profile("victim", "mallory"))
+        manager.run_until_idle()
+        prof = cluster.get("Profile", "victim")
+        conds = prof["status"]["conditions"]
+        assert conds[-1]["type"] == "Failed"
+        assert "not owned by profile creator" in conds[-1]["message"]
+        # namespace untouched
+        assert cluster.get("Namespace", "victim")["metadata"]["annotations"]["owner"] == "bob"
+
+    def test_tpu_quota_from_spec(self, cluster, manager):
+        prof = api.profile("bob", "bob@x.io", resource_quota={"hard": {"cpu": "10"}})
+        prof["spec"]["tpu"] = {"maxChips": 32}
+        cluster.create(prof)
+        manager.run_until_idle()
+        quota = cluster.get("ResourceQuota", QUOTA_NAME, "bob")
+        assert quota["spec"]["hard"]["cpu"] == "10"
+        assert quota["spec"]["hard"]["requests.google.com/tpu"] == "32"
+
+    def test_default_labels_hot_reload(self, cluster, manager):
+        rec = ProfileReconciler()
+        m = Manager(cluster)
+        m.register(rec)
+        cluster.create(api.profile("carol", "carol@x.io"))
+        m.run_until_idle()
+        rec.set_default_labels({"pool": "research"}, manager=m, cluster=cluster)
+        m.run_until_idle()
+        assert cluster.get("Namespace", "carol")["metadata"]["labels"]["pool"] == "research"
+
+
+class TestPlugins:
+    def test_workload_identity_apply_and_revoke(self, cluster):
+        iam = RecordingIamClient()
+        plugin = WorkloadIdentityPlugin("my-project", iam)
+        m = Manager(cluster)
+        m.register(ProfileReconciler(plugins={plugin.kind: plugin}))
+        prof = api.profile(
+            "alice", "alice@x.io",
+            plugins=[{"kind": "WorkloadIdentity",
+                      "spec": {"gcpServiceAccount": "train@my-project.iam.gserviceaccount.com"}}],
+        )
+        cluster.create(prof)
+        m.run_until_idle()
+
+        assert iam.bindings == [
+            (
+                "train@my-project.iam.gserviceaccount.com",
+                "roles/iam.workloadIdentityUser",
+                "serviceAccount:my-project.svc.id.goog[alice/default-editor]",
+            )
+        ]
+        sa = cluster.get("ServiceAccount", DEFAULT_EDITOR, "alice")
+        assert sa["metadata"]["annotations"][GCP_SA_ANNOTATION].startswith("train@")
+        # finalizer registered; delete revokes cloud IAM
+        assert "profile-finalizer" in cluster.get("Profile", "alice")["metadata"]["finalizers"]
+        cluster.delete("Profile", "alice")
+        m.run_until_idle()
+        assert iam.bindings == []
+        assert cluster.try_get("Profile", "alice") is None
+        assert cluster.try_get("Namespace", "alice") is None  # GC cascades
+
+
+class TestKfam:
+    def test_binding_create_makes_rb_and_policy_pair(self, cluster):
+        bc = kfam.BindingClient(cluster)
+        bc.create({"kind": "User", "name": "bob@x.io"}, "alice", "kubeflow-edit")
+        name = kfam.binding_name("User", "bob@x.io", "ClusterRole", "kubeflow-edit")
+        rb = cluster.get("RoleBinding", name, "alice")
+        assert rb["roleRef"]["name"] == "edit"  # display name mapped to k8s role
+        pol = cluster.get("AuthorizationPolicy", name, "alice")
+        assert pol["spec"]["rules"][0]["when"][0]["values"] == ["bob@x.io"]
+
+    def test_binding_name_sanitization(self):
+        assert kfam.binding_name("User", "bob@x.io", "ClusterRole", "kubeflow-edit") == (
+            "user-bob-x-io-clusterrole-kubeflow-edit"
+        )
+
+    def test_list_filters_by_user_and_role(self, cluster):
+        bc = kfam.BindingClient(cluster)
+        bc.create({"kind": "User", "name": "bob"}, "ns1", "kubeflow-edit")
+        bc.create({"kind": "User", "name": "bob"}, "ns2", "kubeflow-view")
+        bc.create({"kind": "User", "name": "eve"}, "ns1", "kubeflow-view")
+        assert len(bc.list(user="bob")) == 2
+        assert [b["referredNamespace"] for b in bc.list(user="bob", role="kubeflow-view")] == ["ns2"]
+        # rolebindings without kfam annotations (e.g. profile-owned) are ignored
+        assert all(b["user"]["name"] in ("bob", "eve") for b in bc.list())
+
+    def test_delete_removes_pair(self, cluster):
+        bc = kfam.BindingClient(cluster)
+        bc.create({"kind": "User", "name": "bob"}, "ns1", "kubeflow-edit")
+        bc.delete({"kind": "User", "name": "bob"}, "ns1", "kubeflow-edit")
+        name = kfam.binding_name("User", "bob", "ClusterRole", "kubeflow-edit")
+        assert cluster.try_get("RoleBinding", name, "ns1") is None
+        assert cluster.try_get("AuthorizationPolicy", name, "ns1") is None
+
+    def test_namespaces_for_user(self, cluster, manager):
+        cluster.create(api.profile("alice", "alice@x.io"))
+        manager.run_until_idle()
+        bc = kfam.BindingClient(cluster)
+        bc.create({"kind": "User", "name": "alice@x.io"}, "shared", "kubeflow-view")
+        pc = kfam.ProfileClient(cluster)
+        assert pc.namespaces_for_user("alice@x.io", bc) == ["alice", "shared"]
+
+
+class TestAuth:
+    def test_authenticate_header(self):
+        user = authenticate({"kubeflow-userid": "alice@x.io"})
+        assert user.name == "alice@x.io"
+        with pytest.raises(AuthError):
+            authenticate({})
+
+    def test_authenticate_prefix_strip(self):
+        user = authenticate(
+            {"kubeflow-userid": "accounts.google.com:alice@x.io"},
+            userid_prefix="accounts.google.com:",
+        )
+        assert user.name == "alice@x.io"
+
+    def test_authorizer_paths(self, cluster, manager):
+        cluster.create(api.profile("alice", "alice@x.io"))
+        manager.run_until_idle()
+        bc = kfam.BindingClient(cluster)
+        bc.create({"kind": "User", "name": "viewer@x.io"}, "alice", "kubeflow-view")
+
+        authz = Authorizer(cluster)
+        owner = User("alice@x.io")
+        viewer = User("viewer@x.io")
+        stranger = User("eve@x.io")
+        assert authz.allowed(owner, "create", "notebooks", "alice")
+        assert authz.allowed(viewer, "list", "notebooks", "alice")
+        assert not authz.allowed(viewer, "create", "notebooks", "alice")
+        assert not authz.allowed(stranger, "list", "notebooks", "alice")
+        with pytest.raises(Forbidden, match="not authorized to create"):
+            authz.ensure(viewer, "create", "notebooks", "alice")
+
+    def test_edit_role_cannot_touch_rbac(self, cluster, manager):
+        cluster.create(api.profile("alice", "alice@x.io"))
+        manager.run_until_idle()
+        bc = kfam.BindingClient(cluster)
+        bc.create({"kind": "User", "name": "ed@x.io"}, "alice", "kubeflow-edit")
+        authz = Authorizer(cluster)
+        ed = User("ed@x.io")
+        assert authz.allowed(ed, "create", "notebooks", "alice")
+        assert not authz.allowed(ed, "create", "rolebindings", "alice")
+
+    def test_cluster_admin_bypasses(self, cluster):
+        authz = Authorizer(cluster, cluster_admins={"root@x.io"})
+        assert authz.allowed(User("root@x.io"), "delete", "profiles", "anywhere")

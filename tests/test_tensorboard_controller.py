@@ -1,0 +1,101 @@
+"""Tensorboard reconciler (ref: tensorboard-controller envtest behaviors)."""
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.controllers.tensorboard_controller import (
+    TensorboardReconciler,
+    parse_logspath,
+)
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.utils.config import ControllerConfig
+
+
+@pytest.fixture()
+def manager(cluster):
+    m = Manager(cluster)
+    m.register(TensorboardReconciler(ControllerConfig(), gcp_creds_secret="user-gcp-sa"))
+    return m
+
+
+def test_parse_logspath():
+    assert parse_logspath("pvc://claim/sub/dir") == ("pvc", "claim/sub/dir")
+    assert parse_logspath("gs://bucket/run1") == ("gs", "bucket/run1")
+    assert parse_logspath("s3://bucket/x") == ("s3", "bucket/x")
+    assert parse_logspath("/local/path")[0] == "unknown"
+
+
+def test_gcs_logdir_deployment(cluster, manager):
+    cluster.create(api.tensorboard("tb", "alice", "gs://bucket/experiments/run1"))
+    manager.run_until_idle()
+    dep = cluster.get("Deployment", "tb", "alice")
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert "--logdir=gs://bucket/experiments/run1" in c["args"]
+    assert "--load_fast=false" in c["args"]  # XLA profiler plugin path
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["GOOGLE_APPLICATION_CREDENTIALS"] == "/secret/gcp/key.json"
+
+    svc = cluster.get("Service", "tb", "alice")
+    assert svc["spec"]["ports"][0]["targetPort"] == 6006
+
+    vs = cluster.get("VirtualService", "tb", "alice")
+    http = vs["spec"]["http"][0]
+    assert http["match"][0]["uri"]["prefix"] == "/tensorboard/alice/tb/"
+    assert http["timeout"] == "300s"
+
+
+def test_pvc_logdir_mounts_claim(cluster, manager):
+    cluster.create(api.tensorboard("tb", "alice", "pvc://workspace/logs"))
+    manager.run_until_idle()
+    spec = cluster.get("Deployment", "tb", "alice")["spec"]["template"]["spec"]
+    c = spec["containers"][0]
+    assert "--logdir=/tensorboard_logs" in c["args"]
+    assert c["volumeMounts"][0]["subPath"] == "logs"
+    assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "workspace"
+
+
+def test_rwo_pvc_coscheduling_affinity(cluster, manager):
+    cluster.create(
+        {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": "workspace", "namespace": "alice"},
+            "spec": {"accessModes": ["ReadWriteOnce"]},
+        }
+    )
+    cluster.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "nb-0", "namespace": "alice"},
+            "spec": {
+                "nodeName": "node-7",
+                "containers": [],
+                "volumes": [
+                    {"name": "w", "persistentVolumeClaim": {"claimName": "workspace"}}
+                ],
+            },
+        }
+    )
+    cluster.create(api.tensorboard("tb", "alice", "pvc://workspace/logs"))
+    manager.run_until_idle()
+    spec = cluster.get("Deployment", "tb", "alice")["spec"]["template"]["spec"]
+    terms = spec["affinity"]["nodeAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ]["nodeSelectorTerms"]
+    assert terms[0]["matchFields"][0]["values"] == ["node-7"]
+
+
+def test_status_mirrors_deployment(cluster, manager):
+    cluster.create(api.tensorboard("tb", "alice", "gs://b/r"))
+    manager.run_until_idle()
+    cluster.patch("Deployment", "tb", "alice", {"status": {"readyReplicas": 1}})
+    manager.run_until_idle()
+    assert cluster.get("Tensorboard", "tb", "alice")["status"]["readyReplicas"] == 1
+
+
+def test_owned_objects_gc_on_delete(cluster, manager):
+    cluster.create(api.tensorboard("tb", "alice", "gs://b/r"))
+    manager.run_until_idle()
+    cluster.delete("Tensorboard", "tb", "alice")
+    assert cluster.try_get("Deployment", "tb", "alice") is None
+    assert cluster.try_get("VirtualService", "tb", "alice") is None

@@ -1,0 +1,305 @@
+"""Web-app backends over real WSGI requests (werkzeug test client).
+
+Covers the reference's backend behaviors (SURVEY.md §2 L5) plus the TPU
+spawner flow: form → CR → reconciler → ready status → UI table.
+"""
+import json
+
+import pytest
+from werkzeug.test import Client
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.auth.kfam import BindingClient
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.controllers.profile_controller import ProfileReconciler
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.webapps import dashboard, jupyter, kfam_app, tensorboards, volumes
+from kubeflow_tpu.webhooks import poddefaults, tpu_env
+
+ALICE = {"kubeflow-userid": "alice@x.io"}
+
+
+@pytest.fixture()
+def platform(cluster):
+    """Cluster with controllers + a provisioned profile for alice."""
+    m = Manager(cluster)
+    m.register(NotebookReconciler())
+    m.register(ProfileReconciler())
+    tpu_env.install(cluster)
+    poddefaults.install(cluster)
+    cluster.create(api.profile("alice", "alice@x.io"))
+    m.run_until_idle()
+    return cluster, m
+
+
+def get_json_body(resp):
+    return json.loads(resp.get_data(as_text=True))
+
+
+def auth(client, headers=ALICE):
+    """Request headers incl. the CSRF double-submit echo (what the Angular
+    frontend does with the XSRF-TOKEN cookie; CSRF is strict — a browser that
+    never loaded the app cannot mutate, ref csrf.py:96-98)."""
+    cookie = client.get_cookie("XSRF-TOKEN")
+    if cookie is None:
+        client.get("/healthz/liveness")  # seed, like loading the SPA
+        cookie = client.get_cookie("XSRF-TOKEN")
+    return {**headers, "X-XSRF-TOKEN": cookie.value}
+
+
+class TestJupyterApp:
+    def test_spawn_flow_end_to_end(self, platform):
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "my-nb", "cpu": "1", "memory": "2Gi"},
+            headers=auth(client),
+        )
+        assert get_json_body(r)["success"], r.get_data()
+        m.run_until_idle()
+        cluster.settle(m)
+
+        r = client.get("/api/namespaces/alice/notebooks", headers=ALICE)
+        nbs = get_json_body(r)["notebooks"]
+        assert len(nbs) == 1
+        assert nbs[0]["name"] == "my-nb"
+        assert nbs[0]["status"]["phase"] == "ready"
+        # workspace PVC was created from the config default
+        r = client.get("/api/namespaces/alice/pvcs", headers=ALICE)
+        assert get_json_body(r)["pvcs"][0]["name"] == "my-nb-workspace"
+
+    def test_tpu_spawn(self, platform):
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={
+                "name": "mesh",
+                "tpu": {"accelerator": "v4", "topology": "2x2x2"},
+            },
+            headers=auth(client),
+        )
+        assert get_json_body(r)["success"], r.get_data()
+        m.run_until_idle()
+        sts = cluster.get("StatefulSet", "mesh", "alice")
+        assert sts["spec"]["replicas"] == 2
+
+    def test_invalid_tpu_topology_is_400(self, platform):
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "bad", "tpu": {"accelerator": "v4", "topology": "9x9x9"}},
+            headers=auth(client),
+        )
+        body = get_json_body(r)
+        assert r.status_code == 400 and not body["success"]
+        assert "does not tile" in body["log"]
+
+    def test_authz_denied_without_binding(self, platform):
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.get(
+            "/api/namespaces/alice/notebooks",
+            headers={"kubeflow-userid": "eve@x.io"},
+        )
+        assert r.status_code == 403
+
+    def test_unauthenticated_is_401(self, platform):
+        cluster, _ = platform
+        client = Client(jupyter.create_app(cluster))
+        assert client.get("/api/namespaces/alice/notebooks").status_code == 401
+
+    def test_stop_start_roundtrip(self, platform):
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        client.post("/api/namespaces/alice/notebooks", json={"name": "nb"}, headers=auth(client))
+        m.run_until_idle()
+        r = client.patch(
+            "/api/namespaces/alice/notebooks/nb", json={"stopped": True}, headers=auth(client)
+        )
+        assert get_json_body(r)["success"]
+        m.run_until_idle()
+        assert cluster.get("StatefulSet", "nb", "alice")["spec"]["replicas"] == 0
+        client.patch(
+            "/api/namespaces/alice/notebooks/nb", json={"stopped": False}, headers=auth(client)
+        )
+        m.run_until_idle()
+        assert cluster.get("StatefulSet", "nb", "alice")["spec"]["replicas"] == 1
+
+    def test_readonly_config_field_wins(self, platform, tmp_path):
+        cluster, m = platform
+        cfg = {
+            "spawnerFormDefaults": {
+                "image": {"value": "locked/image:1", "readOnly": True},
+            }
+        }
+        import yaml
+
+        path = tmp_path / "cfg.yaml"
+        path.write_text(yaml.safe_dump(cfg))
+        client = Client(jupyter.create_app(cluster, config_path=str(path)))
+        client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "nb", "image": "evil/image:666"},
+            headers=auth(client),
+        )
+        nb = cluster.get("Notebook", "nb", "alice")
+        assert nb["spec"]["template"]["spec"]["containers"][0]["image"] == "locked/image:1"
+
+    def test_tpu_availability_endpoint(self, platform):
+        cluster, _ = platform
+        cluster.add_tpu_node_pool("v4", "2x2x2")
+        client = Client(jupyter.create_app(cluster))
+        r = client.get("/api/tpus", headers=ALICE)
+        tpus = get_json_body(r)["tpus"]
+        assert tpus == [{"name": "v4", "topologies": ["2x2x2"]}]
+
+    def test_events_and_pod_endpoints(self, platform):
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        client.post("/api/namespaces/alice/notebooks", json={"name": "nb"}, headers=auth(client))
+        m.run_until_idle()
+        cluster.settle(m)
+        r = client.get("/api/namespaces/alice/notebooks/nb/pod", headers=ALICE)
+        assert get_json_body(r)["pod"]["metadata"]["name"] == "nb-0"
+        pod = cluster.get("Pod", "nb-0", "alice")
+        cluster.emit_event(pod, "Pulled", "image pulled", "Normal")
+        m.run_until_idle()
+        r = client.get("/api/namespaces/alice/notebooks/nb/events", headers=ALICE)
+        assert get_json_body(r)["success"]
+
+    def test_csrf_rejects_mismatched_token(self, platform):
+        cluster, _ = platform
+        client = Client(jupyter.create_app(cluster))
+        client.get("/api/config", headers=ALICE)  # seeds cookie
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "nb"},
+            headers={**ALICE, "X-XSRF-TOKEN": "wrong"},
+        )
+        assert r.status_code == 403
+        assert "CSRF" in get_json_body(r)["log"]
+
+
+class TestVolumesApp:
+    def test_pvc_lifecycle_and_in_use_guard(self, platform):
+        cluster, m = platform
+        client = Client(volumes.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/pvcs",
+            json={"name": "data", "size": "5Gi", "mode": "ReadWriteOnce"},
+            headers=auth(client),
+        )
+        assert get_json_body(r)["success"]
+        cluster.create(
+            {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "user-pod", "namespace": "alice"},
+                "spec": {"containers": [], "volumes": [
+                    {"name": "d", "persistentVolumeClaim": {"claimName": "data"}}
+                ]},
+            }
+        )
+        r = client.get("/api/namespaces/alice/pvcs", headers=ALICE)
+        pvc = get_json_body(r)["pvcs"][0]
+        assert pvc["usedBy"] == ["user-pod"]
+        r = client.delete("/api/namespaces/alice/pvcs/data", headers=auth(client))
+        assert r.status_code == 400 and "in use" in get_json_body(r)["log"]
+        cluster.delete("Pod", "user-pod", "alice")
+        r = client.delete("/api/namespaces/alice/pvcs/data", headers=auth(client))
+        assert get_json_body(r)["success"]
+
+
+class TestTensorboardsApp:
+    def test_crud(self, platform):
+        cluster, m = platform
+        client = Client(tensorboards.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/tensorboards",
+            json={"name": "tb", "logspath": "gs://bucket/run"},
+            headers=auth(client),
+        )
+        assert get_json_body(r)["success"]
+        r = client.get("/api/namespaces/alice/tensorboards", headers=ALICE)
+        tbs = get_json_body(r)["tensorboards"]
+        assert tbs[0]["storage"] == "gs"
+        r = client.delete("/api/namespaces/alice/tensorboards/tb", headers=auth(client))
+        assert get_json_body(r)["success"]
+
+
+class TestKfamApp:
+    def test_owner_manages_contributors(self, platform):
+        cluster, _ = platform
+        client = Client(kfam_app.create_app(cluster))
+        binding = {
+            "user": {"kind": "User", "name": "bob@x.io"},
+            "referredNamespace": "alice",
+            "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+        }
+        r = client.post("/kfam/v1/bindings", json=binding, headers=auth(client))
+        assert get_json_body(r)["success"]
+        r = client.get("/kfam/v1/bindings?namespace=alice&role=kubeflow-edit", headers=ALICE)
+        assert len(get_json_body(r)["bindings"]) == 1
+        # (unfiltered list also shows the profile-owner admin binding, matching
+        # the reference's annotation-based List at bindings.go:179-222)
+        # non-owner cannot manage
+        r = client.post(
+            "/kfam/v1/bindings", json=binding,
+            headers=auth(client, {"kubeflow-userid": "eve@x.io"}),
+        )
+        assert r.status_code == 403
+        r = client.delete("/kfam/v1/bindings", json=binding, headers=auth(client))
+        assert get_json_body(r)["success"]
+
+    def test_profile_self_service(self, cluster):
+        client = Client(kfam_app.create_app(cluster))
+        r = client.post(
+            "/kfam/v1/profiles",
+            json={"metadata": {"name": "bob"},
+                  "spec": {"owner": {"kind": "User", "name": "bob@x.io"}}},
+            headers=auth(client, {"kubeflow-userid": "bob@x.io"}),
+        )
+        assert get_json_body(r)["success"]
+        # cannot create a profile owned by someone else
+        r = client.post(
+            "/kfam/v1/profiles",
+            json={"metadata": {"name": "steal"},
+                  "spec": {"owner": {"kind": "User", "name": "victim@x.io"}}},
+            headers=auth(client, {"kubeflow-userid": "mallory@x.io"}),
+        )
+        assert r.status_code == 403
+
+
+class TestDashboardApp:
+    def test_env_info_aggregates(self, platform):
+        cluster, _ = platform
+        bc = BindingClient(cluster)
+        bc.create({"kind": "User", "name": "alice@x.io"}, "shared", "kubeflow-view")
+        client = Client(dashboard.create_app(cluster))
+        r = client.get("/api/workgroup/env-info", headers=ALICE)
+        body = get_json_body(r)
+        assert body["user"] == "alice@x.io"
+        roles = {n["namespace"]: n["role"] for n in body["namespaces"]}
+        assert roles == {"alice": "owner", "shared": "contributor"}
+        assert body["hasWorkgroup"] is True
+
+    def test_metrics_endpoint(self, platform):
+        cluster, m = platform
+        cluster.create(api.notebook("nb", "alice"))
+        m.run_until_idle()
+        cluster.settle(m)
+        client = Client(dashboard.create_app(cluster))
+        r = client.get("/api/metrics/notebooks", headers=ALICE)
+        values = get_json_body(r)["values"]
+        assert values == [{"labels": {"namespace": "alice"}, "value": 1.0}]
+
+    def test_dashboard_links(self, platform):
+        cluster, _ = platform
+        client = Client(dashboard.create_app(cluster))
+        r = client.get("/api/dashboard-links", headers=ALICE)
+        assert any(
+            l["link"] == "/jupyter/" for l in get_json_body(r)["menuLinks"]
+        )

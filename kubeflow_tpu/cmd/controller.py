@@ -89,8 +89,7 @@ def main() -> None:
     while True:
         # Watches enqueue keys; drain continuously. Requeue timers fire off
         # the wall clock (Manager(clock=time.time)).
-        manager._fire_due_timers()
-        manager.run_until_idle()
+        manager.tick()
         time.sleep(1.0)
 
 

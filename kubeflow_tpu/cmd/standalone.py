@@ -1,0 +1,107 @@
+"""Single-process platform demo: every app + controllers on one port.
+
+``python -m kubeflow_tpu.cmd.standalone`` boots the whole platform against the
+in-memory cluster — the runnable analog of the reference's KinD smoke tests
+(SURVEY.md §4 "kind tests"), with a fake kubelet driving pods to Ready:
+
+    /            central dashboard (iframes the child apps, like the reference)
+    /jupyter/    spawner + notebook management
+    /volumes/    PVC management
+    /tensorboards/
+    /kfam/       access management REST
+
+An authenticating-gateway middleware injects the identity header (the role
+Istio plays in production). Seeded with a demo profile and TPU node pools so
+the spawner's topology picker is live.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from wsgiref.simple_server import WSGIRequestHandler, make_server
+
+from werkzeug.middleware.dispatcher import DispatcherMiddleware
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.auth.rbac import Authorizer
+from kubeflow_tpu.cmd.controller import build_manager
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webapps import dashboard, jupyter, kfam_app, tensorboards, volumes
+from kubeflow_tpu.webhooks import poddefaults, tpu_env
+
+log = logging.getLogger("standalone")
+
+
+def build_platform(demo_user: str = "demo@example.com"):
+    cluster = FakeCluster()
+    tpu_env.install(cluster)
+    poddefaults.install(cluster)
+    manager, metrics = build_manager(cluster, ControllerConfig())
+
+    # seed: demo tenant + schedulable TPU node pools
+    cluster.add_tpu_node_pool("v4", "2x2x2")
+    cluster.add_tpu_node_pool("v4", "2x2x1")
+    cluster.add_tpu_node_pool("v5e", "4x4")
+    cluster.create(api.profile(demo_user.split("@")[0], demo_user))
+    manager.run_until_idle()
+
+    admins = {demo_user}
+    wsgi = DispatcherMiddleware(
+        dashboard.create_app(cluster, cluster_admins=admins, metrics=metrics),
+        {
+            "/jupyter": jupyter.create_app(
+                cluster,
+                authorizer=Authorizer(cluster, cluster_admins=admins),
+                metrics=metrics,
+            ),
+            "/volumes": volumes.create_app(
+                cluster, authorizer=Authorizer(cluster, cluster_admins=admins)
+            ),
+            "/tensorboards": tensorboards.create_app(
+                cluster, authorizer=Authorizer(cluster, cluster_admins=admins)
+            ),
+            "/kfam": kfam_app.create_app(cluster, cluster_admins=admins),
+        },
+    )
+
+    def gateway(environ, start_response):
+        # the Istio-gateway role: a trusted identity header on every request
+        environ.setdefault("HTTP_KUBEFLOW_USERID", demo_user)
+        return wsgi(environ, start_response)
+
+    def control_loop(stop: threading.Event):
+        while not stop.is_set():
+            try:
+                cluster.step_kubelet()
+                manager.tick()
+            except Exception:
+                log.exception("control loop iteration failed")
+            stop.wait(0.5)
+
+    return gateway, cluster, manager, control_loop
+
+
+class QuietHandler(WSGIRequestHandler):
+    def log_message(self, *args):  # keep the demo console readable
+        pass
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    port = int(os.environ.get("PORT", "8000"))
+    user = os.environ.get("DEMO_USER", "demo@example.com")
+    gateway, _, manager, control_loop = build_platform(user)
+    stop = threading.Event()
+    threading.Thread(target=control_loop, args=(stop,), daemon=True).start()
+    log.info("platform demo on http://127.0.0.1:%d (user %s)", port, user)
+    try:
+        make_server("0.0.0.0", port, gateway, handler_class=QuietHandler).serve_forever()
+    finally:
+        stop.set()
+
+
+if __name__ == "__main__":
+    main()

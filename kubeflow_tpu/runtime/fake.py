@@ -273,106 +273,112 @@ class FakeCluster:
 
     # ------------------------------------------------------- fake kubelet
 
+    def _create_workload_pod(self, owner: Mapping, pod_name: str, owner_kind: str) -> dict | None:
+        """Materialize one pod from a workload's template, through admission."""
+        ns = ko.namespace(owner)
+        template = ko.deep_copy(owner["spec"].get("template", {}))
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": pod_name,
+                "namespace": ns,
+                "labels": dict(template.get("metadata", {}).get("labels", {})),
+                "annotations": dict(
+                    template.get("metadata", {}).get("annotations", {})
+                ),
+                "ownerReferences": [
+                    {
+                        "apiVersion": owner["apiVersion"],
+                        "kind": owner_kind,
+                        "name": ko.name(owner),
+                        "uid": owner["metadata"]["uid"],
+                        "controller": True,
+                    }
+                ],
+            },
+            "spec": ko.deep_copy(template.get("spec", {})),
+            "status": {"phase": "Pending", "conditions": []},
+        }
+        try:
+            return self.create(pod)
+        except AdmissionDenied:
+            return None
+
+    def _promote_pod(self, pod: Mapping) -> None:
+        """Pending → Running/Ready with container statuses."""
+        self.patch(
+            "Pod",
+            ko.name(pod),
+            ko.namespace(pod),
+            {
+                "status": {
+                    "phase": "Running",
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                    "containerStatuses": [
+                        {
+                            "name": c.get("name", ""),
+                            "ready": True,
+                            "state": {
+                                "running": {"startedAt": "1970-01-01T00:00:02Z"}
+                            },
+                        }
+                        for c in pod["spec"].get("containers", [])
+                    ],
+                }
+            },
+        )
+
+    def _drive_workload(self, owner: Mapping, owner_kind: str, pod_name_fn) -> None:
+        """Two-tick pod drive shared by StatefulSets and Deployments:
+        tick 1 creates missing pods (Pending) and promotes Pending→Running;
+        tick 2 counts them Ready into the workload status."""
+        ns, base = ko.namespace(owner), ko.name(owner)
+        want = owner.get("spec", {}).get("replicas", 1)
+        uid = owner["metadata"]["uid"]
+        pods = {
+            ko.name(p): p
+            for p in self.list("Pod", ns)
+            if any(r.get("uid") == uid
+                   for r in p["metadata"].get("ownerReferences", []))
+        }
+        wanted_names = {pod_name_fn(i) for i in range(want)}
+        # scale down surplus pods (highest ordinals first, like the real
+        # StatefulSet controller)
+        for pod_name in sorted(set(pods) - wanted_names, reverse=True):
+            self.delete("Pod", pod_name, ns)
+        ready = 0
+        for i in range(want):
+            pod_name = pod_name_fn(i)
+            pod = pods.get(pod_name)
+            if pod is None:
+                pod = self._create_workload_pod(owner, pod_name, owner_kind)
+                if pod is None:
+                    continue
+            if pod["status"].get("phase") != "Running":
+                self._promote_pod(pod)
+            else:
+                ready += 1
+        self.patch(
+            owner_kind, base, ns,
+            {"status": {"replicas": want, "readyReplicas": ready}},
+        )
+
     def step_kubelet(self) -> None:
-        """Materialize pods for every StatefulSet and drive them Ready.
+        """Materialize pods for every StatefulSet/Deployment and drive them
+        Ready.
 
         envtest never runs pods (SURVEY.md §4); this closes that gap so
         controllers' status-mirroring and culling paths are testable
         end-to-end. Pod creation goes through admission, exactly like the real
-        flow (StatefulSet controller → webhook → kubelet).
+        flow (workload controller → webhook → kubelet).
         """
         for sts in self.list("StatefulSet"):
-            ns = ko.namespace(sts)
-            want = sts.get("spec", {}).get("replicas", 1)
             base = ko.name(sts)
-            pods = {
-                ko.name(p): p
-                for p in self.list("Pod", ns)
-                if ko.name(p).startswith(base + "-")
-                and any(
-                    r.get("uid") == sts["metadata"]["uid"]
-                    for r in p["metadata"].get("ownerReferences", [])
-                )
-            }
-            # Scale down: delete surplus ordinals (highest first, like the real
-            # StatefulSet controller).
-            for pod_name, pod in sorted(pods.items(), reverse=True):
-                ordinal = int(pod_name.rsplit("-", 1)[1])
-                if ordinal >= want:
-                    self.delete("Pod", pod_name, ns)
-            ready = 0
-            for i in range(want):
-                pod_name = f"{base}-{i}"
-                if pod_name not in pods:
-                    template = ko.deep_copy(
-                        sts.get("spec", {}).get("template", {})
-                    )
-                    pod = {
-                        "apiVersion": "v1",
-                        "kind": "Pod",
-                        "metadata": {
-                            "name": pod_name,
-                            "namespace": ns,
-                            "labels": dict(
-                                template.get("metadata", {}).get("labels", {})
-                            ),
-                            "annotations": dict(
-                                template.get("metadata", {}).get("annotations", {})
-                            ),
-                            "ownerReferences": [
-                                {
-                                    "apiVersion": sts["apiVersion"],
-                                    "kind": "StatefulSet",
-                                    "name": base,
-                                    "uid": sts["metadata"]["uid"],
-                                    "controller": True,
-                                }
-                            ],
-                        },
-                        "spec": ko.deep_copy(template.get("spec", {})),
-                        "status": {"phase": "Pending", "conditions": []},
-                    }
-                    try:
-                        self.create(pod)
-                    except AdmissionDenied:
-                        continue
-                else:
-                    pod = pods[pod_name]
-                # Second tick: Pending -> Running/Ready.
-                if pod["status"].get("phase") != "Running":
-                    self.patch(
-                        "Pod",
-                        pod_name,
-                        ns,
-                        {
-                            "status": {
-                                "phase": "Running",
-                                "conditions": [
-                                    {"type": "Ready", "status": "True"}
-                                ],
-                                "containerStatuses": [
-                                    {
-                                        "name": c.get("name", ""),
-                                        "ready": True,
-                                        "state": {
-                                            "running": {
-                                                "startedAt": "1970-01-01T00:00:02Z"
-                                            }
-                                        },
-                                    }
-                                    for c in pod["spec"].get("containers", [])
-                                ],
-                            }
-                        },
-                    )
-                else:
-                    ready += 1
-            self.patch(
-                "StatefulSet",
-                base,
-                ns,
-                {"status": {"replicas": want, "readyReplicas": ready}},
-            )
+            self._drive_workload(sts, "StatefulSet", lambda i: f"{base}-{i}")
+        for dep in self.list("Deployment"):
+            base = ko.name(dep)
+            self._drive_workload(dep, "Deployment", lambda i: f"{base}-rs-{i}")
 
     def settle(self, manager=None, rounds: int = 6) -> None:
         """Alternate kubelet ticks and reconciles until nothing changes."""

@@ -122,6 +122,12 @@ class Manager:
         for _, _, rec, ns, name in due:
             self.enqueue(rec, ns, name)
 
+    def tick(self) -> int:
+        """One production control-loop turn: fire due requeue timers, then
+        drain the queue. The public idiom for long-running entrypoints."""
+        self._fire_due_timers()
+        return self.run_until_idle()
+
     def run_until_idle(self, max_iterations: int = 1000) -> int:
         """Drain the workqueue; returns number of reconciles executed."""
         executed = 0

@@ -44,6 +44,14 @@ class _Metric:
         with self._lock:
             self._values.clear()
 
+    def samples(self) -> list[dict]:
+        """Public sample view: [{"labels": {...}, "value": v}, ...]."""
+        with self._lock:
+            return [
+                {"labels": dict(zip(self._label_names, k)), "value": v}
+                for k, v in sorted(self._values.items())
+            ]
+
     def expose(self) -> str:
         lines = [
             f"# HELP {self.name} {self.help}",

@@ -19,7 +19,7 @@ import traceback
 from typing import Any, Callable
 
 from werkzeug.exceptions import HTTPException, NotFound
-from werkzeug.routing import Map, Rule
+from werkzeug.routing import Map, RequestRedirect, Rule
 from werkzeug.wrappers import Request, Response
 
 from kubeflow_tpu.auth.rbac import AuthError, Authorizer, Forbidden, User, authenticate
@@ -117,6 +117,36 @@ class App:
             return error(403, "CSRF token missing or incorrect")
         return None
 
+    def attach_frontend(self, app_dir_name: str) -> None:
+        """Serve the app's SPA: shared assets under /static/, the app's
+        index.html at /, index served no-cache (ref serving.py:18-31 — a stale
+        index must never pin old bundles)."""
+        import mimetypes
+        import os
+
+        static_root = os.path.join(os.path.dirname(__file__), "static")
+
+        def send(target: str, *, index: bool = False) -> Response:
+            real = os.path.realpath(target)
+            root = os.path.realpath(static_root)
+            # trailing-sep containment: 'static_dev' must not pass as 'static'
+            if not real.startswith(root + os.sep) or not os.path.isfile(real):
+                return error(404, "not found")
+            with open(real, "rb") as f:
+                data = f.read()
+            mime = mimetypes.guess_type(real)[0] or "application/octet-stream"
+            resp = Response(data, mimetype=mime)
+            resp.headers["Cache-Control"] = (
+                "no-store, must-revalidate" if index else "max-age=300"
+            )
+            return resp
+
+        index_path = os.path.join(static_root, app_dir_name, "index.html")
+        self.route("/")(lambda request: send(index_path, index=True))
+        self.route("/static/<path:path>")(
+            lambda request, path: send(os.path.join(static_root, path))
+        )
+
     def __call__(self, environ, start_response):
         request = Request(environ)
         adapter = self.url_map.bind_to_environ(environ)
@@ -128,6 +158,8 @@ class App:
             response = self.endpoints[endpoint](request, **args)
             if isinstance(response, dict):
                 response = success(**response)
+        except RequestRedirect as e:
+            response = e.get_response(environ)  # URL normalization redirect
         except AuthError as e:
             response = error(getattr(e, "status", 401), str(e))
         except (ClusterNotFound, NotFound) as e:

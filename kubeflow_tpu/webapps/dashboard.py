@@ -60,6 +60,8 @@ def create_app(
     profiles = ProfileClient(cluster, cluster_admins=cluster_admins)
     metrics = metrics or NotebookMetrics()
 
+    app.attach_frontend("dashboard")
+
     @app.route("/api/workgroup/env-info")
     def env_info(request):
         user = app.current_user(request)
@@ -131,16 +133,9 @@ def create_app(
         app.current_user(request)
         metrics.observe_notebooks(cluster)
         if metric_type == "notebooks":
-            return success("values", _series(metrics.running))
+            return success("values", metrics.running.samples())
         if metric_type == "tpus":
-            return success("values", _series(metrics.tpu_chips_in_use))
+            return success("values", metrics.tpu_chips_in_use.samples())
         raise ValueError(f"unknown metric type {metric_type!r}")
-
-    def _series(metric):
-        with metric._lock:
-            return [
-                {"labels": dict(zip(metric._label_names, k)), "value": v}
-                for k, v in sorted(metric._values.items())
-            ]
 
     return app

@@ -89,14 +89,18 @@ def create_app(
         metrics_registry=metrics.registry,
     )
 
+    app.attach_frontend("jupyter")
+
     @app.route("/api/config")
     def get_config(request):
+        app.current_user(request)  # authn like every sibling route
         return success("config", spawner_config.load_config(config_path))
 
     @app.route("/api/tpus")
     def get_tpus(request):
         """Available (accelerator, topology) pairs probed from node capacity —
         the TPU generalization of the reference's GPU vendor intersection."""
+        app.current_user(request)  # node capacity is cluster-internal info
         nodes = cluster.list("Node")
         config = spawner_config.load_config(config_path)
         tpu_cfg = config["spawnerFormDefaults"].get("tpu", {})
@@ -117,9 +121,17 @@ def create_app(
     @app.route("/api/namespaces/<namespace>/notebooks")
     def list_notebooks(request, namespace):
         app.ensure(request, "list", "notebooks", namespace)
-        out = []
-        for nb in cluster.list("Notebook", namespace):
-            out.append(notebook_summary(nb, cluster.events_for(nb)))
+        # one Events list per render, grouped by object — not one per notebook
+        # (N+1 against the real API server at the UI's poll cadence)
+        events_by_name: dict[str, list] = {}
+        for ev in cluster.list("Event", namespace):
+            io = ev.get("involvedObject", {})
+            if io.get("kind") == "Notebook":
+                events_by_name.setdefault(io.get("name", ""), []).append(ev)
+        out = [
+            notebook_summary(nb, events_by_name.get(ko.name(nb), []))
+            for nb in cluster.list("Notebook", namespace)
+        ]
         return success("notebooks", out)
 
     @app.route("/api/namespaces/<namespace>/notebooks/<name>")

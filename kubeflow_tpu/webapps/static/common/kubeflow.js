@@ -1,0 +1,135 @@
+/* kubeflow-common-lib analog: shared frontend runtime for every app
+ * (reference: crud-web-apps/common/frontend/kubeflow-common-lib — resource
+ * table, status icons, namespace selector, polling service, snack bar,
+ * confirm dialog). No framework: custom elements + fetch, so the platform
+ * images need no node toolchain. */
+(function () {
+  "use strict";
+
+  // ---- api client with CSRF double-submit echo ---------------------------
+  function csrfToken() {
+    const m = document.cookie.match(/(?:^|;\s*)XSRF-TOKEN=([^;]*)/);
+    return m ? decodeURIComponent(m[1]) : null;
+  }
+
+  async function api(method, url, body) {
+    const headers = { "Content-Type": "application/json" };
+    const token = csrfToken();
+    if (token) headers["X-XSRF-TOKEN"] = token;
+    const resp = await fetch(url, {
+      method: method,
+      headers: headers,
+      body: body === undefined ? undefined : JSON.stringify(body),
+      credentials: "same-origin",
+    });
+    const data = await resp.json().catch(() => ({}));
+    if (!resp.ok || data.success === false) {
+      throw new Error(data.log || resp.statusText);
+    }
+    return data;
+  }
+
+  // ---- snack bar (kubeflow-common-lib snack-bar module) ------------------
+  function snack(message, isError) {
+    let el = document.getElementById("kf-snack");
+    if (!el) {
+      el = document.createElement("div");
+      el.id = "kf-snack";
+      document.body.appendChild(el);
+    }
+    el.textContent = message;
+    el.className = "show" + (isError ? " error" : "");
+    setTimeout(() => (el.className = ""), 4000);
+  }
+
+  // ---- status icon (status-icon module) ----------------------------------
+  const STATUS_ICONS = {
+    ready: "✔",
+    running: "✔",
+    waiting: "⏳",
+    warning: "⚠",
+    stopped: "⏹",
+    terminating: "…",
+  };
+  function statusIcon(phase) {
+    const span = document.createElement("span");
+    span.className = "status status-" + phase;
+    span.textContent = (STATUS_ICONS[phase] || "•") + " " + phase;
+    return span;
+  }
+
+  // ---- resource table (resource-table module) ----------------------------
+  // columns: [{key, label, render?(row) -> Node|string}]
+  function renderTable(container, columns, rows, actions) {
+    container.textContent = "";
+    const table = document.createElement("table");
+    table.className = "kf-table";
+    const thead = table.createTHead();
+    const hr = thead.insertRow();
+    columns.forEach((c) => {
+      const th = document.createElement("th");
+      th.textContent = c.label;
+      hr.appendChild(th);
+    });
+    if (actions) hr.appendChild(document.createElement("th"));
+    const tbody = table.createTBody();
+    rows.forEach((row) => {
+      const tr = tbody.insertRow();
+      columns.forEach((c) => {
+        const td = tr.insertCell();
+        const v = c.render ? c.render(row) : row[c.key];
+        if (v instanceof Node) td.appendChild(v);
+        else td.textContent = v == null ? "" : String(v);
+      });
+      if (actions) {
+        const td = tr.insertCell();
+        actions(row).forEach((btn) => td.appendChild(btn));
+      }
+    });
+    container.appendChild(table);
+  }
+
+  function button(label, onClick, danger) {
+    const b = document.createElement("button");
+    b.textContent = label;
+    b.className = "kf-btn" + (danger ? " danger" : "");
+    b.addEventListener("click", onClick);
+    return b;
+  }
+
+  // ---- confirm dialog (confirm-dialog module) ----------------------------
+  function confirmDialog(message) {
+    return Promise.resolve(window.confirm(message));
+  }
+
+  // ---- namespace selector (namespace-select module) ----------------------
+  function currentNamespace() {
+    return (
+      new URLSearchParams(location.search).get("ns") ||
+      localStorage.getItem("kf-namespace") ||
+      ""
+    );
+  }
+  function setNamespace(ns) {
+    localStorage.setItem("kf-namespace", ns);
+  }
+
+  // ---- polling service (poller module) -----------------------------------
+  function poll(fn, intervalMs) {
+    fn();
+    const id = setInterval(fn, intervalMs || 10000);
+    return () => clearInterval(id);
+  }
+
+  window.kf = {
+    api: api,
+    snack: snack,
+    statusIcon: statusIcon,
+    renderTable: renderTable,
+    button: button,
+    confirmDialog: confirmDialog,
+    currentNamespace: currentNamespace,
+    setNamespace: setNamespace,
+    poll: poll,
+  };
+})();

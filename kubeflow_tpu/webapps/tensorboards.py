@@ -16,6 +16,8 @@ from kubeflow_tpu.webapps.base import App, get_json, success
 def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) -> App:
     app = App("tensorboards-web-app", authorizer=authorizer or Authorizer(cluster))
 
+    app.attach_frontend("tensorboards")
+
     @app.route("/api/namespaces/<namespace>/tensorboards")
     def list_tensorboards(request, namespace):
         app.ensure(request, "list", "tensorboards", namespace)

@@ -24,6 +24,8 @@ def pods_using_pvc(cluster: FakeCluster, namespace: str, claim: str) -> list[str
 def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) -> App:
     app = App("volumes-web-app", authorizer=authorizer or Authorizer(cluster))
 
+    app.attach_frontend("volumes")
+
     @app.route("/api/namespaces/<namespace>/pvcs")
     def list_pvcs(request, namespace):
         app.ensure(request, "list", "persistentvolumeclaims", namespace)

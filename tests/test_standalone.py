@@ -1,0 +1,55 @@
+"""Single-process platform composition (cmd/standalone.py) — the in-repo
+analog of the reference's KinD manifest smoke tests (SURVEY.md §4)."""
+import json
+
+from werkzeug.test import Client
+
+from kubeflow_tpu.cmd.standalone import build_platform
+
+
+def body(resp):
+    return json.loads(resp.get_data(as_text=True))
+
+
+def test_full_platform_spawn_flow():
+    gateway, cluster, manager, _ = build_platform("demo@example.com")
+    client = Client(gateway)
+
+    # dashboard shell + env-info through the gateway identity middleware
+    assert b"Central dashboard shell" in client.get("/").get_data()
+    info = body(client.get("/api/workgroup/env-info"))
+    assert info["user"] == "demo@example.com"
+    assert info["namespaces"][0]["namespace"] == "demo"
+
+    # spawner availability reflects the seeded node pools
+    tpus = body(client.get("/jupyter/api/tpus"))["tpus"]
+    assert {"name": "v4", "topologies": ["2x2x1", "2x2x2"]} in tpus
+
+    # spawn through the mounted app with the CSRF echo
+    client.get("/jupyter/")
+    token = client.get_cookie("XSRF-TOKEN").value
+    r = client.post(
+        "/jupyter/api/namespaces/demo/notebooks",
+        json={"name": "nb", "tpu": {"accelerator": "v4", "topology": "2x2x2"}},
+        headers={"X-XSRF-TOKEN": token},
+    )
+    assert body(r)["success"], r.get_data()
+
+    # one control-loop turn: reconcile + kubelet to Ready
+    manager.run_until_idle()
+    cluster.settle(manager)
+    rows = body(client.get("/jupyter/api/namespaces/demo/notebooks"))["notebooks"]
+    assert rows[0]["status"]["phase"] == "ready"
+    assert rows[0]["tpu"]["numHosts"] == 2
+
+    # chips-in-use visible on the dashboard metrics API
+    vals = body(client.get("/api/metrics/tpus"))["values"]
+    assert vals == [{"labels": {"namespace": "demo"}, "value": 8.0}]
+
+
+def test_child_apps_mounted():
+    gateway, *_ = build_platform()
+    client = Client(gateway)
+    for prefix in ("/jupyter/", "/volumes/", "/tensorboards/"):
+        assert client.get(prefix).status_code == 200
+    assert body(client.get("/kfam/kfam/v1/role/clusteradmin"))["role"] is True

@@ -16,10 +16,12 @@ the spawner's topology picker is live.
 """
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 import threading
 import time
+from typing import Callable
 from wsgiref.simple_server import WSGIRequestHandler, make_server
 
 from werkzeug.middleware.dispatcher import DispatcherMiddleware
@@ -35,7 +37,27 @@ from kubeflow_tpu.webhooks import poddefaults, tpu_env
 log = logging.getLogger("standalone")
 
 
-def build_platform(demo_user: str = "demo@example.com"):
+@dataclasses.dataclass
+class Platform:
+    wsgi: Callable
+    cluster: FakeCluster
+    manager: object
+    tick: Callable[[], None]   # one control-loop turn: kubelet + reconciles
+
+    # tuple-compat with earlier call sites: (gateway, cluster, manager, loop)
+    def __iter__(self):
+        return iter((self.wsgi, self.cluster, self.manager, self._control_loop))
+
+    def _control_loop(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            try:
+                self.tick()
+            except Exception:
+                log.exception("control loop iteration failed")
+            stop.wait(0.5)
+
+
+def build_platform(demo_user: str = "demo@example.com") -> Platform:
     cluster = FakeCluster()
     tpu_env.install(cluster)
     poddefaults.install(cluster)
@@ -68,20 +90,17 @@ def build_platform(demo_user: str = "demo@example.com"):
     )
 
     def gateway(environ, start_response):
-        # the Istio-gateway role: a trusted identity header on every request
-        environ.setdefault("HTTP_KUBEFLOW_USERID", demo_user)
+        # the Istio-gateway role: OVERWRITE any inbound identity header (real
+        # gateways strip client-supplied identity; honoring it would let any
+        # network peer impersonate any user)
+        environ["HTTP_KUBEFLOW_USERID"] = demo_user
         return wsgi(environ, start_response)
 
-    def control_loop(stop: threading.Event):
-        while not stop.is_set():
-            try:
-                cluster.step_kubelet()
-                manager.tick()
-            except Exception:
-                log.exception("control loop iteration failed")
-            stop.wait(0.5)
+    def tick() -> None:
+        cluster.step_kubelet()
+        manager.tick()
 
-    return gateway, cluster, manager, control_loop
+    return Platform(wsgi=gateway, cluster=cluster, manager=manager, tick=tick)
 
 
 class QuietHandler(WSGIRequestHandler):
@@ -92,13 +111,18 @@ class QuietHandler(WSGIRequestHandler):
 def main() -> None:
     logging.basicConfig(level=logging.INFO)
     port = int(os.environ.get("PORT", "8000"))
+    # loopback by default: the demo gateway grants a fixed admin identity, so
+    # exposing it beyond the host must be an explicit operator choice
+    host = os.environ.get("HOST", "127.0.0.1")
     user = os.environ.get("DEMO_USER", "demo@example.com")
-    gateway, _, manager, control_loop = build_platform(user)
+    platform = build_platform(user)
     stop = threading.Event()
-    threading.Thread(target=control_loop, args=(stop,), daemon=True).start()
-    log.info("platform demo on http://127.0.0.1:%d (user %s)", port, user)
+    threading.Thread(
+        target=platform._control_loop, args=(stop,), daemon=True
+    ).start()
+    log.info("platform demo on http://%s:%d (user %s)", host, port, user)
     try:
-        make_server("0.0.0.0", port, gateway, handler_class=QuietHandler).serve_forever()
+        make_server(host, port, platform.wsgi, handler_class=QuietHandler).serve_forever()
     finally:
         stop.set()
 

@@ -1,0 +1,88 @@
+"""Sharded checkpoint/resume for notebook training state.
+
+The reference's only persistence notion is stop/restart with durable volumes
+(SURVEY.md §5 "Checkpoint / resume"); training state checkpointing does not
+exist there. This module adds it TPU-natively on orbax:
+
+- saves arrive sharded: each host writes its own param shards (no gather
+  through one host's RAM — mandatory at pod-slice scale);
+- restore takes the target mesh/shardings, so a notebook culled on a 4x4x4
+  slice resumes onto the re-formed mesh (same topology guaranteed by the
+  reconciler) or even a *different* plan (orbax reshards);
+- the culling convention: workspace PVC (or GCS path) + ``latest_step`` make
+  stop → cull → restart lossless for long-running cells.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+
+class CheckpointManager:
+    """Thin policy layer over orbax's CheckpointManager."""
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3, save_interval_steps: int = 1) -> None:
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = directory
+        self.manager = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Async sharded save; returns True if a save was started."""
+        saved = self.manager.save(
+            step, args=self._ocp.args.StandardSave(state), force=force
+        )
+        return bool(saved)
+
+    def restore(self, state_like: Any, step: int | None = None) -> Any:
+        """Restore into the sharding/structure of ``state_like`` (an abstract
+        or concrete state pytree on the *current* mesh)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            if hasattr(x, "shape")
+            else x,
+            state_like,
+        )
+        return self.manager.restore(
+            step, args=self._ocp.args.StandardRestore(abstract)
+        )
+
+    def latest_step(self) -> int | None:
+        return self.manager.latest_step()
+
+    def wait(self) -> None:
+        """Block until async saves land (call before letting a cull proceed)."""
+        self.manager.wait_until_finished()
+
+    def close(self) -> None:
+        self.manager.close()
+
+
+def resume_or_init(directory: str, init_fn, *args, **kwargs):
+    """The notebook-friendly entrypoint: restore the latest checkpoint if one
+    exists, else build fresh state. Combined with the platform's stop/restart
+    (same topology re-formed by the reconciler), this makes culling lossless:
+
+        state = resume_or_init("/home/jovyan/ckpt", bundle.init, rng, batch)
+    """
+    state = init_fn(*args, **kwargs)
+    mgr = CheckpointManager(directory)
+    try:
+        if mgr.latest_step() is not None:
+            state = mgr.restore(state)
+    finally:
+        mgr.close()
+    return state

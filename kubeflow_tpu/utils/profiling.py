@@ -1,0 +1,77 @@
+"""XLA/TPU profiler trace capture.
+
+The reference has **no tracing** (SURVEY.md §5: "Tracing / profiling: none");
+its tensorboard-controller merely serves whatever a logdir holds. This module
+is the producer side the platform adds: notebooks capture device traces into
+the same logdir convention the tensorboard-controller ingests
+(``gs://…/<run>/plugins/profile/...`` — BASELINE.json config 5), so profiles
+from a pod slice render in the platform's TensorBoard with zero setup.
+
+Usage in a notebook cell:
+
+    from kubeflow_tpu.utils.profiling import trace
+    with trace("gs://bucket/experiments/run1"):
+        state, metrics = train_step(state, batch)
+
+Multi-host: every worker captures (JAX requires all hosts in the trace);
+host 0's trace carries the ICI collectives timeline.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, host_only_on_coordinator: bool = False) -> Iterator[None]:
+    """Capture an XLA profiler trace around a block."""
+    import jax
+
+    worker = int(os.environ.get("TPU_WORKER_ID", "0"))
+    if host_only_on_coordinator and worker != 0:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def trace_n_steps(logdir: str, step_fn, state, batch, *, steps: int = 3):
+    """Convenience: warm up one step (compile outside the trace), then capture
+    ``steps`` steps — the standard recipe for a clean device timeline."""
+    state, metrics = step_fn(state, batch)  # compile + warm outside trace
+    _block(metrics)
+    with trace(logdir):
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch)
+        _block(metrics)
+    return state, metrics
+
+
+def annotate(name: str):
+    """Named region in the trace (shows on the TraceViewer timeline)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def _block(tree) -> None:
+    import jax
+
+    # Hard host sync: tunneled runtimes may early-return block_until_ready on
+    # sharded arrays (see bench.py); fetching a leaf is reliable everywhere.
+    leaves = jax.tree_util.tree_leaves(tree)
+    if leaves:
+        float(leaves[0].sum() if hasattr(leaves[0], "sum") else leaves[0])
+
+
+def server(port: int = 9012) -> None:
+    """Start the live profiler server (attach from TensorBoard's profile tab;
+    the capture-on-demand path for a running mesh)."""
+    import jax
+
+    jax.profiler.start_server(port)

@@ -1,0 +1,138 @@
+"""Notebook-CR → ready latency driver (the BASELINE.md north-star metric #2).
+
+Upgrades the reference's loadtest (``notebook-controller/loadtest/
+start_notebooks.py:1-46`` — spawn N CRs, no measurement) into a measuring
+harness: creates N Notebook CRs (optionally TPU slices), polls status until
+``readyReplicas`` matches, and reports p50/p90/max creation→ready latency.
+
+Modes:
+- ``--in-memory``: run against the in-process platform (controllers + fake
+  kubelet) — a control-plane micro-benchmark with no cluster.
+- default: against a live API server via KubeClient (in-cluster or
+  ``kubectl proxy`` with --server).
+
+Prints one JSON line, same contract as bench.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+from kubeflow_tpu.api import types as api
+
+
+def wait_ready(cluster, name: str, namespace: str, expected: int, timeout_s: float) -> float | None:
+    start = time.perf_counter()
+    deadline = start + timeout_s
+    while time.perf_counter() < deadline:
+        nb = cluster.try_get("Notebook", name, namespace)
+        if nb and nb.get("status", {}).get("readyReplicas", 0) >= expected:
+            return time.perf_counter() - start
+        time.sleep(0.05)
+    return None
+
+
+def percentile(values: list[float], q: float) -> float:
+    values = sorted(values)
+    idx = min(len(values) - 1, int(q * len(values)))
+    return values[idx]
+
+
+def run(cluster, *, n: int, namespace: str, tpu: str | None, timeout_s: float,
+        tick=None) -> dict:
+    topo = None
+    if tpu:
+        accel, _, topology = tpu.partition(":")
+        from kubeflow_tpu.tpu.topology import parse_topology
+
+        topo = parse_topology(accel, topology)
+    latencies, failed = [], 0
+    for i in range(n):
+        name = f"loadtest-{i}"
+        nb = api.notebook(
+            name, namespace,
+            **({"tpu_accelerator": tpu.split(":")[0],
+                "tpu_topology": tpu.split(":")[1]} if tpu else {}),
+        )
+        t0 = time.perf_counter()
+        cluster.create(nb)
+        expected = topo.num_hosts if topo else 1
+        if tick is not None:
+            # in-memory mode: drive the control loop synchronously
+            became_ready = False
+            for _ in range(50):
+                tick()
+                fresh = cluster.get("Notebook", name, namespace)
+                if fresh.get("status", {}).get("readyReplicas", 0) >= expected:
+                    became_ready = True
+                    break
+            if became_ready:
+                latencies.append(time.perf_counter() - t0)
+            else:
+                failed += 1
+        else:
+            latency = wait_ready(cluster, name, namespace, expected, timeout_s)
+            if latency is None:
+                failed += 1
+            else:
+                latencies.append(latency)
+    for i in range(n):  # cleanup
+        try:
+            cluster.delete("Notebook", f"loadtest-{i}", namespace)
+        except Exception:
+            pass
+    if not latencies:
+        return {"metric": "notebook_cr_to_ready_p50", "value": -1,
+                "unit": "s", "vs_baseline": 0, "failed": failed}
+    return {
+        "metric": "notebook_cr_to_ready_p50",
+        "value": round(percentile(latencies, 0.5), 4),
+        "unit": "s",
+        "p90": round(percentile(latencies, 0.9), 4),
+        "max": round(max(latencies), 4),
+        "n": len(latencies),
+        "failed": failed,
+        "vs_baseline": 1.0,  # self-established baseline (reference has none)
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("-n", type=int, default=3)  # reference default N=3
+    p.add_argument("--namespace", default="loadtest")
+    p.add_argument("--tpu", help="accelerator:topology, e.g. v4:2x2x2")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--in-memory", action="store_true")
+    p.add_argument("--server", help="API server URL (else in-cluster config)")
+    p.add_argument("--max-p50", type=float,
+                   help="fail (exit 1) if p50 exceeds this many seconds")
+    args = p.parse_args()
+
+    if args.in_memory:
+        from kubeflow_tpu.cmd.standalone import build_platform
+
+        platform = build_platform()
+        cluster = platform.cluster
+        cluster.create({"apiVersion": "v1", "kind": "Namespace",
+                        "metadata": {"name": args.namespace}})
+        result = run(cluster, n=args.n, namespace=args.namespace,
+                     tpu=args.tpu, timeout_s=args.timeout, tick=platform.tick)
+    else:
+        from kubeflow_tpu.runtime.kubeclient import KubeClient
+
+        cluster = KubeClient(base_url=args.server)
+        result = run(cluster, n=args.n, namespace=args.namespace,
+                     tpu=args.tpu, timeout_s=args.timeout)
+    print(json.dumps(result))
+    # this IS a gate: broken spawns or a blown latency budget must fail CI
+    if result["failed"] or result["value"] < 0:
+        raise SystemExit(1)
+    if args.max_p50 is not None and result["value"] > args.max_p50:
+        raise SystemExit(
+            f"p50 {result['value']}s exceeds budget {args.max_p50}s"
+        )
+
+
+if __name__ == "__main__":
+    main()

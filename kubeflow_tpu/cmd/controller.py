@@ -16,6 +16,7 @@ from wsgiref.simple_server import make_server
 from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
 from kubeflow_tpu.controllers.profile_controller import ProfileReconciler
 from kubeflow_tpu.controllers.tensorboard_controller import TensorboardReconciler
+from kubeflow_tpu.culler import probe
 from kubeflow_tpu.culler.culler import Culler
 from kubeflow_tpu.runtime.manager import Manager
 from kubeflow_tpu.utils.config import ControllerConfig
@@ -25,36 +26,86 @@ from kubeflow_tpu.webapps.base import App
 log = logging.getLogger("controller")
 
 
-def fetch_kernels_http(namespace: str, name: str):
-    """Culler probe over the cluster network (ref culler.go:149-185; DEV mode
-    uses the proxy URL shape from culler.go:156-160)."""
-    import requests
-
-    cfg = ControllerConfig.from_env()
+def _kernel_target(cfg: ControllerConfig, namespace: str, name: str) -> tuple[str, int, str]:
+    """(host, port, path) for a notebook's Jupyter kernels endpoint
+    (ref culler.go:149-185; DEV mode uses the kubectl-proxy URL shape from
+    culler.go:156-160)."""
     if cfg.dev:
-        url = f"http://127.0.0.1:8001/api/v1/namespaces/{namespace}/services/{name}:80/proxy/notebook/{namespace}/{name}/api/kernels"
-    else:
-        url = (
-            f"http://{name}.{namespace}.svc.{cfg.cluster_domain}"
-            f"/notebook/{namespace}/{name}/api/kernels"
+        return (
+            "127.0.0.1",
+            8001,
+            f"/api/v1/namespaces/{namespace}/services/{name}:80/proxy"
+            f"/notebook/{namespace}/{name}/api/kernels",
         )
-    try:
-        resp = requests.get(url, timeout=5)
-        if resp.status_code != 200:
-            return None
-        return resp.json()
-    except Exception:
-        return None
+    return (
+        f"{name}.{namespace}.svc.{cfg.cluster_domain}",
+        80,
+        f"/notebook/{namespace}/{name}/api/kernels",
+    )
 
 
-def build_manager(cluster, config: ControllerConfig | None = None) -> tuple[Manager, NotebookMetrics]:
+def fetch_kernels_http(namespace: str, name: str):
+    """Single-notebook culler probe (cache-miss path of the fleet prober)."""
+    cfg = ControllerConfig.from_env()
+    results = probe.probe_many([_kernel_target(cfg, namespace, name)], timeout=5.0)
+    return results[0].kernels()
+
+
+class FleetKernelFetcher:
+    """Fleet-wide kernel probing through the native parallel prober.
+
+    Where the reference blocks one reconcile per HTTP GET
+    (``culler.go:149-185``), this probes every running notebook in one
+    native pass (``native/culler_probe.cc``) and serves the culler from the
+    cache; misses (notebooks created between refreshes) fall back to a
+    single probe.
+    """
+
+    def __init__(self, cluster, cfg: ControllerConfig, *, timeout: float = 5.0) -> None:
+        self.cluster = cluster
+        self.cfg = cfg
+        self.timeout = timeout
+        self._cache: dict[tuple[str, str], list | None] = {}
+        self._lock = threading.Lock()
+
+    def refresh(self) -> int:
+        notebooks = self.cluster.list("Notebook")
+        keys, targets = [], []
+        for nb in notebooks:
+            ns = nb.get("metadata", {}).get("namespace", "")
+            name = nb.get("metadata", {}).get("name", "")
+            keys.append((ns, name))
+            targets.append(_kernel_target(self.cfg, ns, name))
+        results = probe.probe_many(targets, timeout=self.timeout)
+        with self._lock:
+            self._cache = {
+                k: r.kernels() for k, r in zip(keys, results)
+            }
+        return len(keys)
+
+    def __call__(self, namespace: str, name: str):
+        with self._lock:
+            if (namespace, name) in self._cache:
+                return self._cache[(namespace, name)]
+        results = probe.probe_many(
+            [_kernel_target(self.cfg, namespace, name)], timeout=self.timeout
+        )
+        return results[0].kernels()
+
+
+def build_manager(
+    cluster,
+    config: ControllerConfig | None = None,
+    *,
+    fetch_kernels=fetch_kernels_http,
+) -> tuple[Manager, NotebookMetrics]:
     cfg = config or ControllerConfig.from_env()
     metrics = NotebookMetrics()
     culler = Culler(
         enabled=cfg.enable_culling,
         cull_idle_minutes=cfg.cull_idle_minutes,
         check_period_minutes=cfg.idleness_check_minutes,
-        fetch_kernels=fetch_kernels_http,
+        fetch_kernels=fetch_kernels,
         clock=time.time,
     )
     manager = Manager(cluster, clock=time.time)
@@ -64,7 +115,19 @@ def build_manager(cluster, config: ControllerConfig | None = None) -> tuple[Mana
     return manager, metrics
 
 
-def serve_ops(metrics: NotebookMetrics, port: int = 8081) -> threading.Thread:
+def serve_ops(
+    metrics: NotebookMetrics, port: int = 8081, manager: Manager | None = None
+) -> threading.Thread:
+    if manager is not None:
+        wq_gauge = metrics.registry.gauge(
+            "workqueue_stat", "Reconcile workqueue counters (native core)"
+        )
+
+        def observe_queue():
+            for k, v in manager.queue_metrics().items():
+                wq_gauge.set(float(v), stat=k)
+
+        metrics.registry.pre_expose(observe_queue)
     app = App("controller-ops", csrf_protect=False,
               metrics_registry=metrics.registry)
     server = make_server("0.0.0.0", port, app)
@@ -83,14 +146,23 @@ def main() -> None:
         from kubeflow_tpu.runtime.kubeclient import KubeClient
 
         cluster = KubeClient()
-    manager, metrics = build_manager(cluster)
-    serve_ops(metrics)
-    log.info("controller manager running")
+    cfg = ControllerConfig.from_env()
+    fleet = FleetKernelFetcher(cluster, cfg)
+    manager, metrics = build_manager(cluster, cfg, fetch_kernels=fleet)
+    serve_ops(metrics, manager=manager)
+    stop = threading.Event()
+    n_workers = int(os.environ.get("RECONCILE_WORKERS", "4"))
+    manager.run_workers(n_workers, stop)
+    log.info("controller manager running with %d workers", n_workers)
+    probe_period = max(10.0, cfg.idleness_check_minutes * 60.0 / 2)
     while True:
-        # Watches enqueue keys; drain continuously. Requeue timers fire off
-        # the wall clock (Manager(clock=time.time)).
-        manager.tick()
-        time.sleep(1.0)
+        # Workers drain the queue continuously; this loop keeps the fleet
+        # kernel cache warm ahead of the culler's idleness checks.
+        try:
+            fleet.refresh()
+        except Exception:
+            log.exception("fleet kernel refresh failed")
+        time.sleep(probe_period)
 
 
 if __name__ == "__main__":

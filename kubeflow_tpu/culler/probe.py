@@ -1,0 +1,107 @@
+"""Parallel kernel-activity probing — binding to the native prober.
+
+The reference probes one notebook per reconcile with a blocking Go HTTP GET
+(``notebook-controller/pkg/culler/culler.go:149-185``). Here the controller
+probes the whole fleet in one native pass (``native/culler_probe.cc``): raw
+sockets, a thread pool, one deadline. Falls back to ``urllib`` threads when
+the compiled library is absent so behavior is identical everywhere.
+"""
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import json
+from concurrent.futures import ThreadPoolExecutor
+from typing import Sequence
+
+from kubeflow_tpu.runtime import workqueue as _wq
+
+_BODY_BUFLEN = 1 << 20  # 1 MiB per body; kernels JSON is tiny
+
+
+@dataclasses.dataclass
+class ProbeResult:
+    status: int  # HTTP status; -1 connect fail, -2 timeout, -3 malformed
+    body: str
+
+    @property
+    def ok(self) -> bool:
+        return self.status == 200
+
+    def kernels(self) -> list | None:
+        """Parsed /api/kernels payload, or None when the probe failed."""
+        if not self.ok:
+            return None
+        try:
+            parsed = json.loads(self.body)
+        except ValueError:
+            return None
+        return parsed if isinstance(parsed, list) else None
+
+
+def probe_many(
+    targets: Sequence[tuple[str, int, str]],
+    *,
+    timeout: float = 5.0,
+    max_concurrency: int = 64,
+) -> list[ProbeResult]:
+    """HTTP GET every (host, port, path) target concurrently."""
+    if not targets:
+        return []
+    lib = _wq._load_library()
+    if lib is not None:
+        return _probe_native(lib, targets, timeout, max_concurrency)
+    return _probe_python(targets, timeout, max_concurrency)
+
+
+def _probe_native(lib, targets, timeout, max_concurrency):
+    if not hasattr(lib.probe_http_many, "_kf_typed"):
+        lib.probe_http_many.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int,
+            ctypes.c_double,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int,
+        ]
+        lib.probe_http_many._kf_typed = True
+    n = len(targets)
+    hosts = (ctypes.c_char_p * n)(*[t[0].encode() for t in targets])
+    ports = (ctypes.c_int * n)(*[int(t[1]) for t in targets])
+    paths = (ctypes.c_char_p * n)(*[t[2].encode() for t in targets])
+    statuses = (ctypes.c_int * n)()
+    bufs = [ctypes.create_string_buffer(_BODY_BUFLEN) for _ in range(n)]
+    bodies = (ctypes.c_char_p * n)(
+        *[ctypes.cast(b, ctypes.c_char_p) for b in bufs]
+    )
+    lib.probe_http_many(
+        hosts, ports, paths, n,
+        ctypes.c_double(timeout), int(max_concurrency),
+        statuses, bodies, _BODY_BUFLEN,
+    )
+    return [
+        ProbeResult(status=statuses[i], body=bufs[i].value.decode(errors="replace"))
+        for i in range(n)
+    ]
+
+
+def _probe_python(targets, timeout, max_concurrency):
+    import urllib.error
+    import urllib.request
+
+    def one(target):
+        host, port, path = target
+        url = f"http://{host}:{port}{path}"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return ProbeResult(resp.status, resp.read().decode(errors="replace"))
+        except urllib.error.HTTPError as e:
+            return ProbeResult(e.code, "")
+        except Exception:
+            return ProbeResult(-1, "")
+
+    with ThreadPoolExecutor(max_workers=max_concurrency) as pool:
+        return list(pool.map(one, targets))

@@ -45,7 +45,17 @@ class MeshPlan:
         return {a: getattr(self, a) for a in AXES}
 
 
-def create_mesh(plan: MeshPlan, devices: Sequence | None = None) -> Mesh:
+def create_mesh(
+    plan: MeshPlan,
+    devices: Sequence | None = None,
+    *,
+    physical_topology: Sequence[int] | None = None,
+) -> Mesh:
+    """Build the named Mesh; with ``physical_topology`` (the slice's torus
+    shape, e.g. ``(4, 4, 4)``), devices are ordered by the native placement
+    solver (``tpu/placement.py``) so high-traffic logical axes ride
+    contiguous ICI rings instead of whatever order ``jax.devices()`` returns.
+    """
     devices = list(devices if devices is not None else jax.devices())
     if plan.size != len(devices):
         raise ValueError(
@@ -53,8 +63,45 @@ def create_mesh(plan: MeshPlan, devices: Sequence | None = None) -> Mesh:
             f"({plan.axis_sizes()}), have {len(devices)}"
         )
     shape = tuple(plan.axis_sizes()[a] for a in AXES)
-    arr = np.array(devices).reshape(shape)
+    if physical_topology is not None and len(devices) > 1:
+        from kubeflow_tpu.tpu import placement
+
+        order = placement.mesh_device_order(
+            physical_topology,
+            shape,
+            weights=[placement.DEFAULT_WEIGHTS[a] for a in AXES],
+        )
+        # The solver's indices are row-major torus coordinates; jax.devices()
+        # enumerates by (process, local id), which need not match. Sort by
+        # device.coords when the runtime exposes it (TPU does).
+        devices = _torus_row_major(devices, physical_topology)
+        arr = np.asarray(devices, dtype=object)[order.ravel()].reshape(shape)
+    else:
+        arr = np.array(devices).reshape(shape)
     return Mesh(arr, AXES)
+
+
+def _torus_row_major(devices: Sequence, phys_dims: Sequence[int]) -> list:
+    """Order devices by row-major physical torus coordinates.
+
+    TPU devices expose ``.coords`` (chip position in the torus) and
+    ``.core_on_chip``; backends without coords (CPU fixtures) keep their
+    enumeration order, which tests treat as the torus order by construction.
+    """
+    if not all(
+        getattr(d, "coords", None) is not None
+        and len(getattr(d, "coords") or ()) == len(phys_dims)
+        for d in devices
+    ):
+        return list(devices)
+
+    def key(d):
+        idx = 0
+        for c, dim in zip(d.coords, phys_dims):
+            idx = idx * dim + int(c)
+        return (idx, getattr(d, "core_on_chip", 0))
+
+    return sorted(devices, key=key)
 
 
 def auto_plan(n_devices: int, *, tensor: int = 1, seq: int = 1) -> MeshPlan:

@@ -1,12 +1,22 @@
-"""Reconciler manager: watch wiring + deduplicating workqueue.
+"""Reconciler manager: watch wiring over the native workqueue.
 
 The controller-runtime analog (reference: ``notebook-controller/main.go:84-131``
 builds a manager; ``SetupWithManager`` at
 ``controllers/notebook_controller.go:726-774`` wires For/Owns/Watches sources).
 Same model here: each reconciler owns a primary kind; secondary watches map
-events back to primary keys; a queue deduplicates keys; one reconcile runs per
-key at a time (the structural concurrency-safety argument the reference relies
-on, SURVEY.md §5 "race detection").
+events back to primary keys; the deduplicating workqueue
+(``native/workqueue.cc`` via ``runtime/workqueue.py``) guarantees one
+reconcile per key at a time — the structural concurrency-safety argument the
+reference relies on (SURVEY.md §5 "race detection"). Failed reconciles back
+off exponentially per key; successful ones reset the counter, exactly the
+client-go rate-limiter contract.
+
+Two execution modes share the code path:
+
+- deterministic (tests, the platform's envtest): virtual clock, ``advance()``
+  fires requeue timers, ``run_until_idle`` drains synchronously;
+- production (``cmd/controller.py``): an external wall clock synced on every
+  ``tick()``, or ``run_workers()`` fanning N threads over the blocking queue.
 """
 from __future__ import annotations
 
@@ -17,10 +27,13 @@ from typing import Callable, Iterable
 
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.runtime.workqueue import make_workqueue
 
 log = logging.getLogger(__name__)
 
 MapFn = Callable[[dict], Iterable[tuple[str, str]]]  # obj -> (ns, name) keys
+
+_SEP = "\x1f"  # key separator; never appears in k8s names
 
 
 @dataclasses.dataclass
@@ -51,25 +64,26 @@ class Reconciler:
 
 
 class Manager:
-    """Runs reconcilers against a cluster.
+    """Runs reconcilers against a cluster on the shared workqueue."""
 
-    Test-mode execution model: watch events enqueue keys synchronously;
-    ``run_until_idle`` drains the queue, honoring ``requeue_after`` via a
-    virtual clock (``advance``) so culling-period behavior is testable without
-    sleeping (the reference's envtest suites poll with Eventually; we get
-    determinism instead).
-    """
-
-    def __init__(self, cluster: FakeCluster, *, clock: Callable[[], float] | None = None) -> None:
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        *,
+        clock: Callable[[], float] | None = None,
+        error_backoff_base: float = 1.0,
+        error_backoff_max: float = 64.0,
+    ) -> None:
         self.cluster = cluster
         self._reconcilers: list[Reconciler] = []
-        self._queue: list[tuple[Reconciler, str, str]] = []
-        self._queued: set[tuple[int, str, str]] = set()
-        self._timers: list[tuple[float, int, Reconciler, str, str]] = []
-        self._timer_seq = 0
-        self._lock = threading.RLock()
-        self._now = 0.0
+        self._wq = make_workqueue(
+            virtual_clock=True,
+            backoff_base=error_backoff_base,
+            backoff_max=error_backoff_max,
+        )
         self._clock = clock
+        self._epoch = clock() if clock else 0.0
+        self._sync_lock = threading.Lock()
 
     # ------------------------------------------------------------- wiring
 
@@ -94,16 +108,20 @@ class Manager:
 
     # -------------------------------------------------------------- queue
 
+    def _key(self, rec: Reconciler, namespace: str, name: str) -> str:
+        return f"{self._reconcilers.index(rec)}{_SEP}{namespace}{_SEP}{name}"
+
+    def _unkey(self, key: str) -> tuple[Reconciler, str, str]:
+        idx, ns, name = key.split(_SEP, 2)
+        return self._reconcilers[int(idx)], ns, name
+
     def enqueue(self, rec: Reconciler, namespace: str, name: str) -> None:
-        with self._lock:
-            key = (id(rec), namespace, name)
-            if key in self._queued:
-                return
-            self._queued.add(key)
-            self._queue.append((rec, namespace, name))
+        self._wq.add(self._key(rec, namespace, name))
 
     def now(self) -> float:
-        return self._clock() if self._clock else self._now
+        if self._clock is not None:
+            return self._clock()
+        return self._wq.now()
 
     def advance(self, seconds: float) -> None:
         """Advance the virtual clock and fire due requeue timers."""
@@ -112,49 +130,77 @@ class Manager:
                 "advance() requires the built-in virtual clock; this manager "
                 "was constructed with an external clock"
             )
-        self._now += seconds
-        self._fire_due_timers()
+        self._wq.advance(seconds)
 
-    def _fire_due_timers(self) -> None:
-        with self._lock:
-            due = [t for t in self._timers if t[0] <= self.now()]
-            self._timers = [t for t in self._timers if t[0] > self.now()]
-        for _, _, rec, ns, name in due:
-            self.enqueue(rec, ns, name)
+    def _sync_external_clock(self) -> None:
+        if self._clock is None:
+            return
+        with self._sync_lock:
+            delta = (self._clock() - self._epoch) - self._wq.now()
+            if delta > 0:
+                self._wq.advance(delta)
+
+    def queue_metrics(self) -> dict:
+        """Workqueue counters (depth/adds/requeues/backoff), for /metrics."""
+        return self._wq.metrics()
+
+    # ----------------------------------------------------------- execution
+
+    def _execute(self, key: str) -> None:
+        rec, ns, name = self._unkey(key)
+        try:
+            result = rec.reconcile(self.cluster, ns, name)
+        except Exception:
+            log.exception("reconcile %s %s/%s failed", rec.kind, ns, name)
+            self._wq.done(key)
+            self._wq.add_rate_limited(key)  # per-key exponential backoff
+            return
+        self._wq.forget(key)
+        self._wq.done(key)
+        if result and result.requeue_after is not None:
+            self._wq.add_after(key, result.requeue_after)
 
     def tick(self) -> int:
-        """One production control-loop turn: fire due requeue timers, then
-        drain the queue. The public idiom for long-running entrypoints."""
-        self._fire_due_timers()
+        """One production control-loop turn: sync the wall clock (firing due
+        requeue timers), then drain the queue."""
+        self._sync_external_clock()
         return self.run_until_idle()
 
     def run_until_idle(self, max_iterations: int = 1000) -> int:
         """Drain the workqueue; returns number of reconciles executed."""
         executed = 0
         for _ in range(max_iterations):
-            with self._lock:
-                if not self._queue:
-                    break
-                rec, ns, name = self._queue.pop(0)
-                self._queued.discard((id(rec), ns, name))
-            try:
-                result = rec.reconcile(self.cluster, ns, name)
-            except Exception:  # reconcile errors requeue, like controller-runtime
-                log.exception("reconcile %s %s/%s failed", rec.kind, ns, name)
-                result = Result(requeue_after=1.0)
+            self._sync_external_clock()
+            key = self._wq.get(0)
+            if key is None:
+                return executed
+            self._execute(key)
             executed += 1
-            if result and result.requeue_after is not None:
-                with self._lock:
-                    self._timer_seq += 1
-                    self._timers.append(
-                        (
-                            self.now() + result.requeue_after,
-                            self._timer_seq,
-                            rec,
-                            ns,
-                            name,
-                        )
-                    )
-        else:
-            raise RuntimeError("reconcile loop did not settle (hot loop?)")
-        return executed
+        raise RuntimeError("reconcile loop did not settle (hot loop?)")
+
+    def run_workers(
+        self, n_workers: int, stop: threading.Event, *, poll_interval: float = 0.2
+    ) -> list[threading.Thread]:
+        """Long-running mode: N threads block on the queue; a pacer thread
+        syncs the external clock so ``add_after`` requeues fire."""
+
+        def worker():
+            while not stop.is_set():
+                key = self._wq.get(poll_interval)
+                if key is None:
+                    continue
+                self._execute(key)
+
+        def pacer():
+            while not stop.is_set():
+                self._sync_external_clock()
+                stop.wait(poll_interval)
+
+        threads = [
+            threading.Thread(target=worker, daemon=True, name=f"reconcile-{i}")
+            for i in range(n_workers)
+        ]
+        threads.append(threading.Thread(target=pacer, daemon=True, name="clock-pacer"))
+        for t in threads:
+            t.start()
+        return threads

@@ -1,0 +1,357 @@
+"""Deduplicating, rate-limited workqueue — binding to the native core.
+
+Every controller in the reference rides on client-go's workqueue via
+controller-runtime (``notebook-controller/main.go:84-131``); its guarantees —
+one worker per key at a time, re-adds during processing deferred to Done,
+delayed requeues, per-key exponential backoff — are what make level-triggered
+reconciliation safe without locks in the reconcilers (SURVEY.md §5 "race
+detection"). Here that core is native C++ (``native/workqueue.cc``) loaded via
+ctypes, with :class:`PyWorkQueue` as a drop-in pure-Python fallback so the
+platform runs (and tests run) on machines without the compiled library.
+
+Both implementations share the contract:
+
+- ``add(key)``: enqueue with dedup; if ``key`` is mid-processing it is marked
+  dirty and re-enqueued when ``done(key)`` is called.
+- ``get(timeout)``: block for the next key, move it to the processing set.
+- ``done(key)``: finish processing (fires the deferred re-add if dirty).
+- ``add_after(key, delay)``: timer-driven enqueue (the culling requeue,
+  ref ``notebook_controller.go:279-281``).
+- ``add_rate_limited(key)`` / ``forget(key)``: per-key exponential backoff,
+  ``base * 2^failures`` capped at ``maximum``.
+- virtual-clock mode + ``advance(seconds)`` for deterministic tests.
+"""
+from __future__ import annotations
+
+import ctypes
+import heapq
+import math
+import os
+import subprocess
+import threading
+import time
+from typing import Optional
+
+_MAX_KEY = 4096
+
+_lib = None
+_lib_err: Optional[str] = None
+
+
+def _load_library():
+    """Load (building if necessary) the native runtime library."""
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    here = os.path.dirname(os.path.abspath(__file__))
+    so_path = os.path.join(here, "libkfruntime.so")
+    native_dir = os.path.join(here, os.pardir, os.pardir, "native")
+    makefile = os.path.join(native_dir, "Makefile")
+    if os.path.exists(makefile):
+        # Always invoke make: it no-ops when the .so is fresh and rebuilds
+        # when native/*.cc changed (a stale binary would silently win
+        # otherwise).
+        try:
+            subprocess.run(
+                ["make", "-C", native_dir],
+                capture_output=True,
+                timeout=120,
+                check=True,
+            )
+        except Exception as exc:  # toolchain absent: fall back to Python
+            if not os.path.exists(so_path):
+                _lib_err = f"native build failed: {exc}"
+                return None
+    if not os.path.exists(so_path):
+        _lib_err = "libkfruntime.so not found"
+        return None
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError as exc:  # pragma: no cover
+        _lib_err = str(exc)
+        return None
+    lib.wq_new.restype = ctypes.c_void_p
+    lib.wq_new.argtypes = [ctypes.c_int, ctypes.c_double, ctypes.c_double]
+    lib.wq_free.argtypes = [ctypes.c_void_p]
+    lib.wq_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.wq_add_after.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double]
+    lib.wq_add_rate_limited.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.wq_forget.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.wq_failures.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.wq_failures.restype = ctypes.c_int
+    lib.wq_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_double,
+    ]
+    lib.wq_get.restype = ctypes.c_int
+    lib.wq_done.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.wq_advance.argtypes = [ctypes.c_void_p, ctypes.c_double]
+    lib.wq_now.argtypes = [ctypes.c_void_p]
+    lib.wq_now.restype = ctypes.c_double
+    lib.wq_next_deadline.argtypes = [ctypes.c_void_p]
+    lib.wq_next_deadline.restype = ctypes.c_double
+    lib.wq_len.argtypes = [ctypes.c_void_p]
+    lib.wq_len.restype = ctypes.c_int
+    lib.wq_timer_count.argtypes = [ctypes.c_void_p]
+    lib.wq_timer_count.restype = ctypes.c_int
+    lib.wq_shutdown.argtypes = [ctypes.c_void_p]
+    lib.wq_metrics.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load_library() is not None
+
+
+class NativeWorkQueue:
+    """ctypes wrapper over ``native/workqueue.cc``."""
+
+    def __init__(
+        self,
+        *,
+        virtual_clock: bool = False,
+        backoff_base: float = 0.005,
+        backoff_max: float = 1000.0,
+    ) -> None:
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError(f"native workqueue unavailable: {_lib_err}")
+        self._lib = lib
+        self._q = lib.wq_new(
+            1 if virtual_clock else 0,
+            ctypes.c_double(backoff_base),
+            ctypes.c_double(backoff_max),
+        )
+
+    def __del__(self):  # pragma: no cover
+        try:
+            if getattr(self, "_q", None):
+                self._lib.wq_free(self._q)
+                self._q = None
+        except Exception:
+            pass
+
+    def add(self, key: str) -> None:
+        self._lib.wq_add(self._q, key.encode())
+
+    def add_after(self, key: str, delay: float) -> None:
+        self._lib.wq_add_after(self._q, key.encode(), ctypes.c_double(delay))
+
+    def add_rate_limited(self, key: str) -> None:
+        self._lib.wq_add_rate_limited(self._q, key.encode())
+
+    def forget(self, key: str) -> None:
+        self._lib.wq_forget(self._q, key.encode())
+
+    def failures(self, key: str) -> int:
+        return self._lib.wq_failures(self._q, key.encode())
+
+    def get(self, timeout: float | None = 0.0) -> str | None:
+        """Next key, or None on timeout / shutdown-drained."""
+        t = -1.0 if timeout is None else float(timeout)
+        # get() can block; a separate buffer per call keeps it thread-safe.
+        buf = ctypes.create_string_buffer(_MAX_KEY)
+        rc = self._lib.wq_get(self._q, buf, _MAX_KEY, ctypes.c_double(t))
+        if rc != 1:
+            return None
+        return buf.value.decode()
+
+    def done(self, key: str) -> None:
+        self._lib.wq_done(self._q, key.encode())
+
+    def advance(self, seconds: float) -> None:
+        self._lib.wq_advance(self._q, ctypes.c_double(seconds))
+
+    def now(self) -> float:
+        return self._lib.wq_now(self._q)
+
+    def next_deadline(self) -> float | None:
+        d = self._lib.wq_next_deadline(self._q)
+        return None if d < 0 else d
+
+    def __len__(self) -> int:
+        return self._lib.wq_len(self._q)
+
+    def timer_count(self) -> int:
+        return self._lib.wq_timer_count(self._q)
+
+    def shutdown(self) -> None:
+        self._lib.wq_shutdown(self._q)
+
+    def metrics(self) -> dict:
+        out = (ctypes.c_uint64 * 6)()
+        self._lib.wq_metrics(self._q, out)
+        return {
+            "adds": out[0],
+            "gets": out[1],
+            "requeues": out[2],
+            "rate_limited": out[3],
+            "timer_fires": out[4],
+            "max_depth": out[5],
+        }
+
+
+class PyWorkQueue:
+    """Pure-Python fallback with identical semantics."""
+
+    def __init__(
+        self,
+        *,
+        virtual_clock: bool = False,
+        backoff_base: float = 0.005,
+        backoff_max: float = 1000.0,
+    ) -> None:
+        self._virtual = virtual_clock
+        self._base = backoff_base
+        self._max = backoff_max
+        self._vnow = 0.0
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: list[str] = []
+        self._dirty: set[str] = set()
+        self._processing: set[str] = set()
+        self._timers: list[tuple[float, int, str]] = []
+        self._seq = 0
+        self._failures: dict[str, int] = {}
+        self._shutdown = False
+        self._m = {
+            "adds": 0, "gets": 0, "requeues": 0,
+            "rate_limited": 0, "timer_fires": 0, "max_depth": 0,
+        }
+
+    def _now(self) -> float:
+        return self._vnow if self._virtual else time.monotonic()
+
+    def _add_locked(self, key: str) -> None:
+        if self._shutdown:
+            return
+        self._m["adds"] += 1
+        if key in self._dirty:
+            return
+        self._dirty.add(key)
+        if key in self._processing:
+            return
+        self._queue.append(key)
+        self._m["max_depth"] = max(self._m["max_depth"], len(self._queue))
+
+    def _fire_due_locked(self) -> None:
+        now = self._now()
+        while self._timers and self._timers[0][0] <= now:
+            _, _, key = heapq.heappop(self._timers)
+            self._m["timer_fires"] += 1
+            self._add_locked(key)
+
+    def add(self, key: str) -> None:
+        with self._cv:
+            self._add_locked(key)
+            self._cv.notify()
+
+    def add_after(self, key: str, delay: float) -> None:
+        with self._cv:
+            if self._shutdown:
+                return
+            if delay <= 0:
+                self._add_locked(key)
+            else:
+                self._seq += 1
+                heapq.heappush(
+                    self._timers, (self._now() + delay, self._seq, key)
+                )
+            self._cv.notify()
+
+    def add_rate_limited(self, key: str) -> None:
+        with self._cv:
+            if self._shutdown:
+                return
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+            delay = min(self._base * math.pow(2.0, n), self._max)
+            self._m["rate_limited"] += 1
+            self._seq += 1
+            heapq.heappush(self._timers, (self._now() + delay, self._seq, key))
+            self._cv.notify()
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._failures.pop(key, None)
+
+    def failures(self, key: str) -> int:
+        with self._lock:
+            return self._failures.get(key, 0)
+
+    def get(self, timeout: float | None = 0.0) -> str | None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                self._fire_due_locked()
+                if self._queue:
+                    key = self._queue.pop(0)
+                    self._dirty.discard(key)
+                    self._processing.add(key)
+                    self._m["gets"] += 1
+                    return key
+                if self._shutdown:
+                    return None
+                waits = []
+                if deadline is not None:
+                    remain = deadline - time.monotonic()
+                    if remain <= 0:
+                        return None
+                    waits.append(remain)
+                if not self._virtual and self._timers:
+                    until = self._timers[0][0] - self._now()
+                    if until > 0:
+                        waits.append(until)
+                self._cv.wait(min(waits) if waits else None)
+
+    def done(self, key: str) -> None:
+        with self._cv:
+            self._processing.discard(key)
+            if key in self._dirty:
+                # Key stays dirty across the re-add (dirty == queued-or-
+                # pending); clearing it would let a later add() enqueue a
+                # duplicate and break one-worker-per-key.
+                self._queue.append(key)
+                self._m["requeues"] += 1
+                self._m["max_depth"] = max(
+                    self._m["max_depth"], len(self._queue)
+                )
+                self._cv.notify()
+
+    def advance(self, seconds: float) -> None:
+        with self._cv:
+            self._vnow += seconds
+            self._fire_due_locked()
+            self._cv.notify_all()
+
+    def now(self) -> float:
+        with self._lock:
+            return self._now()
+
+    def next_deadline(self) -> float | None:
+        with self._lock:
+            return self._timers[0][0] if self._timers else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def timer_count(self) -> int:
+        with self._lock:
+            return len(self._timers)
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return dict(self._m)
+
+
+def make_workqueue(**kwargs):
+    """Native queue when the library loads, Python fallback otherwise."""
+    if native_available():
+        return NativeWorkQueue(**kwargs)
+    return PyWorkQueue(**kwargs)

@@ -1,0 +1,203 @@
+"""Logical-mesh → physical-torus placement (binding to the native solver).
+
+Maps a :class:`~kubeflow_tpu.parallel.mesh.MeshPlan`'s logical axes onto a
+slice's physical ICI torus so the heaviest collectives ride contiguous
+nearest-neighbor rings (``native/topology_solver.cc``; no reference analog —
+the reference's accelerator awareness stops at resource-limit strings,
+SURVEY.md §5). The result is a device ordering for
+``jax.sharding.Mesh``: logical neighbors on high-traffic axes are physical
+ICI neighbors.
+
+Traffic weights default to the scaling-book cost model: tensor-parallel
+all-reduces run per layer (heaviest), fsdp all-gather/reduce-scatter per
+step, sequence-parallel ring hops per attention block, pure data parallelism
+one grad psum per step (lightest).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from kubeflow_tpu.runtime import workqueue as _wq
+
+DEFAULT_WEIGHTS: Mapping[str, float] = {
+    "tensor": 100.0,
+    "seq": 30.0,
+    "fsdp": 10.0,
+    "expert": 10.0,
+    "data": 1.0,
+}
+
+
+def solve_axis_assignment(
+    phys_dims: Sequence[int],
+    logical_sizes: Sequence[int],
+    weights: Sequence[float],
+    *,
+    wrap: Sequence[bool] | None = None,
+) -> list[tuple[int, int, int]]:
+    """(logical_idx, phys_axis, factor) triples covering the torus factors.
+
+    Triples appear in physical factorization order (per dim, primes in the
+    solver's emission order); that order is the contract
+    :func:`mesh_device_order` reshapes by.
+    """
+    phys_dims = [int(d) for d in phys_dims]
+    logical_sizes = [int(s) for s in logical_sizes]
+    if int(np.prod(phys_dims)) != int(np.prod(logical_sizes)):
+        raise ValueError(
+            f"physical torus {phys_dims} has {int(np.prod(phys_dims))} chips "
+            f"but logical mesh {logical_sizes} needs {int(np.prod(logical_sizes))}"
+        )
+    wrap_list = [1] * len(phys_dims) if wrap is None else [int(bool(w)) for w in wrap]
+
+    lib = _wq._load_library()
+    if lib is not None:
+        return _solve_native(lib, phys_dims, wrap_list, logical_sizes, list(weights))
+    return _solve_python(phys_dims, wrap_list, logical_sizes, list(weights))
+
+
+def mesh_device_order(
+    phys_dims: Sequence[int],
+    logical_sizes: Sequence[int],
+    *,
+    weights: Sequence[float] | None = None,
+    wrap: Sequence[bool] | None = None,
+) -> np.ndarray:
+    """Device-index array shaped ``logical_sizes``.
+
+    Entry ``[i, j, ...]`` is the physical device index (row-major torus
+    coordinates) that logical mesh position ``(i, j, ...)`` should use. Feed
+    ``np.asarray(devices)[order.ravel()].reshape(order.shape)`` to ``Mesh``.
+    """
+    if weights is None:
+        weights = [1.0] * len(logical_sizes)
+    triples = solve_axis_assignment(
+        phys_dims, logical_sizes, weights, wrap=wrap
+    )
+    n = int(np.prod(phys_dims))
+    if not triples:  # single-device
+        return np.arange(n).reshape(tuple(int(s) for s in logical_sizes))
+
+    # Split each physical dim into its factor units (solver emission order =
+    # major -> minor within the dim), giving a fine-grained reshape of the
+    # row-major device array.
+    per_phys: list[list[tuple[int, int]]] = [[] for _ in phys_dims]  # (log, f)
+    for log_idx, phys_axis, factor in triples:
+        per_phys[phys_axis].append((log_idx, factor))
+    fine_shape = [f for units in per_phys for (_, f) in units]
+    unit_logical = [log for units in per_phys for (log, _) in units]
+
+    arr = np.arange(n).reshape(fine_shape)
+    # Transpose units into logical-axis grouping order (stable within axis).
+    perm = sorted(range(len(unit_logical)), key=lambda u: (unit_logical[u], u))
+    arr = arr.transpose(perm)
+    return arr.reshape(tuple(int(s) for s in logical_sizes))
+
+
+def _solve_native(lib, phys_dims, wrap, logical_sizes, weights):
+    if not hasattr(lib.solve_topology, "_kf_typed"):
+        lib.solve_topology.argtypes = [
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int,
+        ]
+        lib.solve_topology.restype = ctypes.c_int
+        lib.solve_topology._kf_typed = True
+    max_units = 64
+    out = (ctypes.c_int * (3 * max_units))()
+    rc = lib.solve_topology(
+        (ctypes.c_int * len(phys_dims))(*phys_dims),
+        (ctypes.c_int * len(wrap))(*wrap),
+        len(phys_dims),
+        (ctypes.c_longlong * len(logical_sizes))(*logical_sizes),
+        (ctypes.c_double * len(weights))(*weights),
+        len(logical_sizes),
+        out,
+        max_units,
+    )
+    if rc < 0:
+        raise ValueError(
+            f"no placement of logical {logical_sizes} onto torus {phys_dims}"
+        )
+    return [(out[i * 3], out[i * 3 + 1], out[i * 3 + 2]) for i in range(rc)]
+
+
+def _solve_python(phys_dims, wrap, logical_sizes, weights):
+    """Same DFS as the native solver (fallback when the .so is absent)."""
+    units: list[tuple[int, int]] = []
+    for axis, dim in enumerate(phys_dims):
+        d = dim
+        p = 2
+        while p * p <= d:
+            while d % p == 0:
+                units.append((axis, p))
+                d //= p
+            p += 1
+        if d > 1:
+            units.append((axis, d))
+
+    best: dict = {"cost": float("inf"), "assign": None}
+    remaining = list(logical_sizes)
+    assign = [-1] * len(units)
+
+    def score(a):
+        cost = 0.0
+        for ax in range(len(logical_sizes)):
+            phys_used: list[int] = []
+            per_phys = [1] * len(phys_dims)
+            size = 1
+            for u, (paxis, f) in enumerate(units):
+                if a[u] != ax:
+                    continue
+                size *= f
+                per_phys[paxis] *= f
+                if paxis not in phys_used:
+                    phys_used.append(paxis)
+            if size <= 1:
+                continue
+            cost += weights[ax] * (len(phys_used) - 1)
+            for p in phys_used:
+                if per_phys[p] != phys_dims[p] or not wrap[p]:
+                    cost += 0.5 * weights[ax]
+        return cost
+
+    def dfs(u):
+        if u == len(units):
+            if all(r == 1 for r in remaining):
+                c = score(assign)
+                if c < best["cost"]:
+                    best["cost"] = c
+                    best["assign"] = list(assign)
+            return
+        tried: list[tuple[int, float]] = []
+        for ax in range(len(logical_sizes)):
+            if remaining[ax] % units[u][1] != 0:
+                continue
+            if (remaining[ax], weights[ax]) in tried:
+                continue
+            tried.append((remaining[ax], weights[ax]))
+            remaining[ax] //= units[u][1]
+            assign[u] = ax
+            dfs(u + 1)
+            remaining[ax] *= units[u][1]
+            assign[u] = -1
+
+    dfs(0)
+    if best["assign"] is None:
+        if not units:
+            return []
+        raise ValueError(
+            f"no placement of logical {logical_sizes} onto torus {phys_dims}"
+        )
+    return [
+        (best["assign"][u], units[u][0], units[u][1])
+        for u in range(len(units))
+    ]

@@ -74,6 +74,12 @@ class _Metric:
 class Registry:
     def __init__(self) -> None:
         self._metrics: list[_Metric] = []
+        self._pre_expose: list = []
+
+    def pre_expose(self, fn) -> None:
+        """Register a live-scrape hook run before each exposition (the
+        reference's custom-collector idiom, metrics.go:82-99)."""
+        self._pre_expose.append(fn)
 
     def counter(self, name: str, help_: str) -> _Metric:
         return self._add(_Metric(name, help_, "counter"))
@@ -86,6 +92,8 @@ class Registry:
         return m
 
     def expose(self) -> str:
+        for fn in self._pre_expose:
+            fn()
         return "\n".join(m.expose() for m in self._metrics) + "\n"
 
 

@@ -1,0 +1,193 @@
+// Native parallel kernel-activity prober for the culler.
+//
+// The reference culler issues one blocking HTTP GET per Notebook per
+// reconcile to the pod's Jupyter /api/kernels endpoint
+// (notebook-controller/pkg/culler/culler.go:149-185), which serializes the
+// scaling-sensitive requeue loop (SURVEY.md §3.1). The TPU platform probes
+// every notebook in one native pass: a thread pool fans the GETs out over
+// raw POSIX sockets with a hard deadline, so a 500-notebook fleet costs one
+// round-trip, not 500. Cluster traffic is plain HTTP inside the mesh, as in
+// the reference (the Istio sidecar does TLS).
+//
+// C ABI (ctypes-bound by kubeflow_tpu/culler/probe.py):
+//   probe_http_many(hosts, ports, paths, n, timeout_s, max_conc,
+//                   status_out, bodies_out, body_buflen)
+// status_out[i]: HTTP status, or -1 connect/resolve failure, -2 timeout,
+// -3 malformed response. bodies_out[i]: response body (NUL-terminated,
+// truncated to body_buflen-1).
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+double remaining(Clock::time_point deadline) {
+  return std::chrono::duration<double>(deadline - Clock::now()).count();
+}
+
+// One HTTP/1.1 GET with Connection: close. Returns status code or negative
+// error (see header comment).
+int http_get(const char* host, int port, const char* path, double timeout_s,
+             char* body_out, int body_buflen) {
+  const auto deadline =
+      Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                         std::chrono::duration<double>(timeout_s));
+  body_out[0] = '\0';
+
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  char portbuf[16];
+  std::snprintf(portbuf, sizeof(portbuf), "%d", port);
+  if (getaddrinfo(host, portbuf, &hints, &res) != 0 || res == nullptr) {
+    return -1;
+  }
+
+  int fd = socket(res->ai_family, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) {
+    freeaddrinfo(res);
+    return -1;
+  }
+
+  int rc = connect(fd, res->ai_addr, res->ai_addrlen);
+  freeaddrinfo(res);
+  if (rc < 0 && errno != EINPROGRESS) {
+    close(fd);
+    return -1;
+  }
+  if (rc < 0) {
+    struct pollfd pfd = {fd, POLLOUT, 0};
+    double rem = remaining(deadline);
+    if (rem <= 0 || poll(&pfd, 1, static_cast<int>(rem * 1000)) <= 0) {
+      close(fd);
+      return -2;
+    }
+    int err = 0;
+    socklen_t len = sizeof(err);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) < 0 || err != 0) {
+      close(fd);
+      return -1;
+    }
+  }
+
+  std::string req = std::string("GET ") + path + " HTTP/1.1\r\nHost: " + host +
+                    "\r\nAccept: application/json\r\nConnection: close\r\n\r\n";
+  size_t sent = 0;
+  while (sent < req.size()) {
+    struct pollfd pfd = {fd, POLLOUT, 0};
+    double rem = remaining(deadline);
+    if (rem <= 0 || poll(&pfd, 1, static_cast<int>(rem * 1000)) <= 0) {
+      close(fd);
+      return -2;
+    }
+    ssize_t n = send(fd, req.data() + sent, req.size() - sent, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      close(fd);
+      return -1;
+    }
+    sent += static_cast<size_t>(n);
+  }
+
+  std::string resp;
+  char buf[8192];
+  for (;;) {
+    struct pollfd pfd = {fd, POLLIN, 0};
+    double rem = remaining(deadline);
+    if (rem <= 0 || poll(&pfd, 1, static_cast<int>(rem * 1000)) <= 0) {
+      close(fd);
+      return -2;
+    }
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      close(fd);
+      return -1;
+    }
+    if (n == 0) break;  // server closed: response complete
+    resp.append(buf, static_cast<size_t>(n));
+    if (resp.size() > (1u << 22)) break;  // 4 MiB cap
+  }
+  close(fd);
+
+  if (resp.rfind("HTTP/", 0) != 0) return -3;
+  int status = 0;
+  {
+    size_t sp = resp.find(' ');
+    if (sp == std::string::npos) return -3;
+    status = std::atoi(resp.c_str() + sp + 1);
+    if (status < 100 || status > 599) return -3;
+  }
+  size_t body_at = resp.find("\r\n\r\n");
+  std::string body =
+      body_at == std::string::npos ? "" : resp.substr(body_at + 4);
+  // De-chunk if transfer-encoding: chunked (Jupyter serves kernels JSON
+  // either way depending on proxy in the middle).
+  size_t hend = body_at == std::string::npos ? resp.size() : body_at;
+  std::string headers = resp.substr(0, hend);
+  for (auto& c : headers) c = static_cast<char>(tolower(c));
+  if (headers.find("transfer-encoding: chunked") != std::string::npos) {
+    std::string out;
+    size_t pos = 0;
+    while (pos < body.size()) {
+      size_t eol = body.find("\r\n", pos);
+      if (eol == std::string::npos) break;
+      long sz = std::strtol(body.c_str() + pos, nullptr, 16);
+      if (sz <= 0) break;
+      pos = eol + 2;
+      if (pos + static_cast<size_t>(sz) > body.size()) break;
+      out.append(body, pos, static_cast<size_t>(sz));
+      pos += static_cast<size_t>(sz) + 2;
+    }
+    body.swap(out);
+  }
+  std::snprintf(body_out, static_cast<size_t>(body_buflen), "%s",
+                body.c_str());
+  return status;
+}
+
+}  // namespace
+
+extern "C" {
+
+void probe_http_many(const char** hosts, const int* ports, const char** paths,
+                     int n, double timeout_s, int max_conc, int* status_out,
+                     char** bodies_out, int body_buflen) {
+  if (n <= 0) return;
+  if (max_conc <= 0) max_conc = 64;
+  std::atomic<int> next{0};
+  int workers = std::min(n, max_conc);
+  std::vector<std::thread> pool;
+  pool.reserve(static_cast<size_t>(workers));
+  for (int w = 0; w < workers; ++w) {
+    pool.emplace_back([&]() {
+      for (;;) {
+        int i = next.fetch_add(1);
+        if (i >= n) return;
+        status_out[i] = http_get(hosts[i], ports[i], paths[i], timeout_s,
+                                 bodies_out[i], body_buflen);
+      }
+    });
+  }
+  for (auto& t : pool) t.join();
+}
+
+}  // extern "C"

@@ -1,0 +1,166 @@
+// TPU mesh-axis placement solver.
+//
+// TPU-native component with no reference analog (the reference's accelerator
+// awareness stops at resource-limit strings, SURVEY.md §5 "distributed
+// communication backend"): given a physical ICI torus (e.g. a v4 4x4x4 cube)
+// and a logical parallelism mesh (data/fsdp/tensor/seq axis sizes with
+// per-axis traffic weights), choose which physical torus factors carry which
+// logical axis so that the heaviest collectives (tensor-parallel
+// all-reduces, fsdp all-gathers) ride contiguous nearest-neighbor rings and
+// never span torus dimensions. This is the native core behind
+// kubeflow_tpu/tpu/topology.py's mesh ordering; the controller uses the same
+// answer to lay out TPU_WORKER_ID assignment across the pod slice.
+//
+// Method: factor each torus dim into prime units, exhaustively assign units
+// to logical axes (DFS, bounded), score assignments by
+//   sum_axis weight * (distinct phys dims spanned - 1 severity
+//                      + wrap penalty when the axis uses a strict subset of
+//                        a dim, losing the wraparound link)
+// and return the best assignment as (logical_idx, phys_axis, factor)
+// triples. Search space is tiny (<= ~16 prime units even for 4096 chips).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Unit {
+  int phys;
+  int factor;
+};
+
+struct Solver {
+  std::vector<Unit> units;
+  std::vector<long long> remaining;  // per logical axis
+  std::vector<double> weights;
+  std::vector<int> phys_dims;
+  std::vector<int> wrap;
+  std::vector<int> assign;       // unit -> logical axis
+  std::vector<int> best_assign;
+  double best_cost = 1e300;
+  long long nodes = 0;
+  static constexpr long long kMaxNodes = 2000000;
+
+  double Score(const std::vector<int>& a) const {
+    double cost = 0.0;
+    for (size_t ax = 0; ax < remaining.size(); ++ax) {
+      // collect units of this axis
+      double w = weights[ax];
+      std::vector<int> phys_used;
+      std::vector<long long> per_phys(phys_dims.size(), 1);
+      long long size = 1;
+      for (size_t u = 0; u < units.size(); ++u) {
+        if (a[u] != static_cast<int>(ax)) continue;
+        size *= units[u].factor;
+        per_phys[static_cast<size_t>(units[u].phys)] *= units[u].factor;
+        if (std::find(phys_used.begin(), phys_used.end(), units[u].phys) ==
+            phys_used.end()) {
+          phys_used.push_back(units[u].phys);
+        }
+      }
+      if (size <= 1) continue;
+      // spanning multiple torus dims: each extra dim doubles the average
+      // hop count for a logical-ring step.
+      cost += w * static_cast<double>(phys_used.size() - 1);
+      // partial use of a dim loses the wraparound link: a ring becomes a
+      // line whose end-to-end hop costs ~2x. Full use of a wrapped dim is
+      // a perfect ring (no penalty).
+      for (int p : phys_used) {
+        size_t ps = static_cast<size_t>(p);
+        if (per_phys[ps] != phys_dims[ps] || !wrap[ps]) {
+          cost += 0.5 * w;
+        }
+      }
+    }
+    return cost;
+  }
+
+  void Dfs(size_t u) {
+    if (++nodes > kMaxNodes) return;
+    if (u == units.size()) {
+      for (long long r : remaining) {
+        if (r != 1) return;
+      }
+      double c = Score(assign);
+      if (c < best_cost) {
+        best_cost = c;
+        best_assign = assign;
+      }
+      return;
+    }
+    int tried_prev = -1;
+    for (size_t ax = 0; ax < remaining.size(); ++ax) {
+      if (remaining[ax] % units[u].factor != 0) continue;
+      // symmetry pruning: identical remaining sizes are interchangeable
+      // only when weights differ the score differs, so key on both.
+      if (tried_prev >= 0 &&
+          remaining[static_cast<size_t>(tried_prev)] == remaining[ax] &&
+          weights[static_cast<size_t>(tried_prev)] == weights[ax]) {
+        continue;
+      }
+      tried_prev = static_cast<int>(ax);
+      remaining[ax] /= units[u].factor;
+      assign[u] = static_cast<int>(ax);
+      Dfs(u + 1);
+      remaining[ax] *= units[u].factor;
+      assign[u] = -1;
+    }
+  }
+};
+
+void factorize(int n, int phys, std::vector<Unit>* out) {
+  for (int p = 2; p * p <= n; ++p) {
+    while (n % p == 0) {
+      out->push_back(Unit{phys, p});
+      n /= p;
+    }
+  }
+  if (n > 1) out->push_back(Unit{phys, n});
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of (logical_idx, phys_axis, factor) triples written to
+// out_triples (3 ints each), or -1 if sizes are infeasible / buffer too
+// small. Triples are ordered by physical axis then major->minor factor, the
+// order kubeflow_tpu/tpu/topology.py uses to reshape the device array.
+int solve_topology(const int* phys_dims, const int* wrap, int n_phys,
+                   const long long* log_sizes, const double* log_weights,
+                   int n_log, int* out_triples, int max_units) {
+  if (n_phys <= 0 || n_log <= 0) return -1;
+  long long phys_total = 1, log_total = 1;
+  Solver s;
+  for (int i = 0; i < n_phys; ++i) {
+    phys_total *= phys_dims[i];
+    s.phys_dims.push_back(phys_dims[i]);
+    s.wrap.push_back(wrap ? wrap[i] : 1);
+    factorize(phys_dims[i], i, &s.units);
+  }
+  for (int i = 0; i < n_log; ++i) {
+    log_total *= log_sizes[i];
+    s.remaining.push_back(log_sizes[i]);
+    s.weights.push_back(log_weights[i]);
+  }
+  if (phys_total != log_total) return -1;
+  if (static_cast<int>(s.units.size()) > max_units) return -1;
+  s.assign.assign(s.units.size(), -1);
+  s.Dfs(0);
+  if (s.best_assign.empty()) {
+    if (s.units.empty()) return 0;  // single-device trivial mesh
+    return -1;
+  }
+  int k = 0;
+  for (size_t u = 0; u < s.units.size(); ++u) {
+    out_triples[k * 3 + 0] = s.best_assign[u];
+    out_triples[k * 3 + 1] = s.units[u].phys;
+    out_triples[k * 3 + 2] = s.units[u].factor;
+    ++k;
+  }
+  return k;
+}
+
+}  // extern "C"

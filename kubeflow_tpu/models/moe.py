@@ -1,0 +1,249 @@
+"""Mixture-of-Experts transformer LM — the expert-parallel notebook workload.
+
+The reference platform ships no model code at all (SURVEY.md §2 note); this is
+part of the compute path the TPU framework adds. Design follows the GShard /
+Switch lineage the TPU was built for, expressed the XLA way:
+
+- static shapes everywhere: capacity-based routing (tokens over capacity are
+  dropped, their residual stream passes through untouched);
+- routing, dispatch and combine are einsums over one-hot tensors — no gather /
+  scatter, so the MXU does the work and GSPMD can insert ``all_to_all``
+  collectives from sharding constraints alone;
+- expert weight tables carry a leading expert dim sharded over the ``expert``
+  mesh axis (rule: ``parallel/mesh.moe_param_spec``), composed with
+  tensor-parallel column/row splits of the hidden dim;
+- router math in fp32 (gating is precision-sensitive), expert matmuls in bf16.
+
+Reused pieces: attention stack + norms from ``models/transformer.py``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.models.transformer import (
+    Attention,
+    RMSNorm,
+    TransformerConfig,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32_000
+    num_layers: int = 4
+    num_heads: int = 8
+    embed_dim: int = 512
+    expert_hidden_dim: int = 1024
+    num_experts: int = 8
+    experts_per_token: int = 2          # top-k routing
+    capacity_factor: float = 1.25
+    max_seq_len: int = 2048
+    aux_loss_weight: float = 1e-2
+    attention_impl: str = "block"
+    attention_block_size: int = 512
+    dtype: Any = jnp.bfloat16
+    mesh: Any = None
+
+    def attention_cfg(self) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=self.vocab_size,
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            embed_dim=self.embed_dim,
+            mlp_dim=self.expert_hidden_dim,
+            max_seq_len=self.max_seq_len,
+            attention_impl=self.attention_impl,
+            attention_block_size=self.attention_block_size,
+            dtype=self.dtype,
+            mesh=self.mesh,
+        )
+
+    def capacity(self, seq_len: int) -> int:
+        """Per-expert token budget; multiple of 8 for TPU-friendly tiling."""
+        raw = seq_len * self.experts_per_token / self.num_experts
+        cap = int(math.ceil(raw * self.capacity_factor))
+        return max(8, -(-cap // 8) * 8)
+
+
+def top_k_routing(
+    router_logits: jnp.ndarray, k: int, capacity: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Capacity-constrained top-k gating.
+
+    Args:
+        router_logits: [B, S, E] fp32.
+        k: experts per token (static).
+        capacity: per-expert slots C (static).
+
+    Returns:
+        combine: [B, S, E, C] fp32 — combine[b,s,e,c] is the gate weight with
+            which token (b,s) contributes to slot c of expert e (0 if dropped).
+        aux_loss: scalar load-balancing loss (Switch-style, over choice-0).
+    """
+    B, S, E = router_logits.shape
+    if k > E:
+        raise ValueError(
+            f"experts_per_token={k} exceeds num_experts={E}: after E rounds "
+            "the argmax would re-select experts with duplicate gates"
+        )
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    masks, gates = [], []
+    remaining = probs
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)                       # [B,S]
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)           # [B,S,E]
+        gates.append(jnp.sum(probs * mask, axis=-1))               # [B,S]
+        masks.append(mask)
+        remaining = remaining * (1.0 - mask)
+
+    # k > 1: renormalize gates over the selected experts (GShard). k == 1
+    # keeps the raw softmax prob (Switch) — a renormalized top-1 gate is the
+    # constant 1 and starves the router of gradient signal.
+    if k > 1:
+        denom = sum(gates) + 1e-9
+        gates = [g / denom for g in gates]
+
+    # Slot assignment: all choice-0 picks take positions before any choice-1
+    # pick (GShard priority), positions within a choice by sequence order.
+    combine = jnp.zeros((B, S, E, capacity), jnp.float32)
+    offset = jnp.zeros((B, E), jnp.float32)
+    for mask, gate in zip(masks, gates):
+        pos_in_expert = (
+            jnp.cumsum(mask, axis=1) - mask + offset[:, None, :]
+        )                                                          # [B,S,E]
+        offset = offset + jnp.sum(mask, axis=1)
+        pos = jnp.sum(pos_in_expert * mask, axis=-1)               # [B,S]
+        keep = (pos < capacity).astype(jnp.float32) * jnp.sum(mask, axis=-1)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+        combine = combine + (
+            (gate * keep)[..., None, None] * mask[..., None] * slot[:, :, None, :]
+        )
+
+    # Load-balance aux: E * Σ_e fraction_dispatched(e) * mean_prob(e).
+    frac = jnp.mean(masks[0], axis=(0, 1))                         # [E]
+    mean_prob = jnp.mean(probs, axis=(0, 1))                       # [E]
+    aux_loss = E * jnp.sum(frac * mean_prob)
+    return combine, aux_loss
+
+
+class MoEMLP(nn.Module):
+    """Expert-parallel FFN: route → all_to_all dispatch → expert matmul →
+    all_to_all combine, with every data movement expressed as an einsum whose
+    sharding constraints make GSPMD insert the collectives."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, M = x.shape
+        E, H = cfg.num_experts, cfg.expert_hidden_dim
+        C = cfg.capacity(S)
+
+        router = self.param(
+            "router", nn.initializers.lecun_normal(), (M, E), jnp.float32
+        )
+        logits = jnp.einsum("bsm,me->bse", x.astype(jnp.float32), router)
+        combine, aux_loss = top_k_routing(
+            logits, cfg.experts_per_token, C
+        )
+        dispatch = (combine > 0).astype(cfg.dtype)
+        combine = combine.astype(cfg.dtype)
+
+        wi = self.param(
+            "experts_wi",
+            nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal"),
+            (E, M, H), jnp.float32,
+        ).astype(cfg.dtype)
+        wo = self.param(
+            "experts_wo",
+            nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal"),
+            (E, H, M), jnp.float32,
+        ).astype(cfg.dtype)
+
+        # Dispatch: [B,S,E,C] x [B,S,M] -> [E,B,C,M]; constraining the result
+        # to the expert axis (tokens stay batch-sharded) is the all_to_all.
+        expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch, x.astype(cfg.dtype))
+        expert_in = _constrain(expert_in, P("expert", ("data", "fsdp"), None, None))
+        h = nn.gelu(jnp.einsum("ebcm,emh->ebch", expert_in, wi))
+        h = _constrain(h, P("expert", ("data", "fsdp"), None, "tensor"))
+        out = jnp.einsum("ebch,ehm->ebcm", h, wo)
+        # Combine: weighted return trip — the reverse all_to_all.
+        y = jnp.einsum("bsec,ebcm->bsm", combine, out)
+        y = _constrain(y, P(("data", "fsdp"), None, None))
+        self.sow("intermediates", "aux_loss", aux_loss)
+        return y.astype(cfg.dtype)
+
+
+def _constrain(x, spec: P):
+    """Apply a sharding constraint under a mesh context; no-op with no mesh
+    at all (unsharded unit tests). A mesh whose axes don't match the spec is
+    a real misconfiguration and raises (ValueError) — swallowing it would
+    silently replicate every expert on every device."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except RuntimeError:  # "requires a non-empty mesh in context"
+        return x
+
+
+class MoEBlock(nn.Module):
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        att_cfg = self.cfg.attention_cfg()
+        x = x + Attention(att_cfg, name="attn")(
+            RMSNorm(name="attn_norm")(x), positions
+        )
+        x = x + MoEMLP(self.cfg, name="moe")(RMSNorm(name="moe_norm")(x))
+        return x
+
+
+class MoETransformerLM(nn.Module):
+    """Decoder-only LM with an MoE FFN in every block.
+
+    ``apply(..., mutable=["intermediates"])`` exposes the per-layer aux losses;
+    ``moe_lm_loss`` folds them into the objective.
+    """
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        cfg = self.cfg
+        B, S = tokens.shape
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.embed_dim,
+            dtype=cfg.dtype, param_dtype=jnp.float32, name="embed",
+        )
+        x = embed(tokens)
+        positions = jnp.arange(S)
+        for i in range(cfg.num_layers):
+            x = MoEBlock(cfg, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(name="final_norm")(x)
+        logits = embed.attend(x.astype(jnp.float32))
+        return logits
+
+
+def moe_lm_loss(model: MoETransformerLM, params, tokens):
+    """Next-token cross entropy + weighted load-balance aux losses."""
+    logits, inter = model.apply(
+        {"params": params}, tokens, mutable=["intermediates"]
+    )
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    aux = jnp.mean(
+        jnp.asarray(
+            jax.tree_util.tree_leaves(inter["intermediates"]), jnp.float32
+        )
+    )
+    return jnp.mean(nll) + model.cfg.aux_loss_weight * aux

@@ -4,12 +4,15 @@ The platform's scaling model (SURVEY.md §7, "How to Scale Your Model" recipe):
 pick a mesh, annotate shardings, let XLA insert the collectives over ICI.
 Axis vocabulary used across the framework:
 
+    stage    pipeline parallelism (layer groups; ppermute'd activations —
+             parallel/pipeline.py)
     data     pure data parallelism (batch split, psum'd grads over DCN/ICI)
     fsdp     data parallelism with parameter/optimizer sharding (ZeRO-3 style:
              params all-gathered per layer, grads reduce-scattered)
     tensor   tensor/model parallelism (matmul column/row splits)
     seq      sequence/context parallelism (ring attention, blockwise KV)
-    expert   expert parallelism (MoE; placeholder axis until the MoE family lands)
+    expert   expert parallelism (MoE expert-dim sharding + all_to_all
+             dispatch — models/moe.py)
 
 Meshes are constructed so the fastest-varying axes map to the tightest ICI
 neighborhoods (tensor innermost), matching TPU torus locality.
@@ -24,13 +27,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("data", "fsdp", "seq", "expert", "tensor")
+AXES = ("stage", "data", "fsdp", "seq", "expert", "tensor")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshPlan:
     """A named parallelism layout, e.g. MeshPlan(data=2, fsdp=2, tensor=2)."""
 
+    stage: int = 1
     data: int = 1
     fsdp: int = 1
     seq: int = 1
@@ -39,7 +43,10 @@ class MeshPlan:
 
     @property
     def size(self) -> int:
-        return self.data * self.fsdp * self.seq * self.expert * self.tensor
+        return (
+            self.stage * self.data * self.fsdp
+            * self.seq * self.expert * self.tensor
+        )
 
     def axis_sizes(self) -> dict[str, int]:
         return {a: getattr(self, a) for a in AXES}
@@ -168,6 +175,27 @@ def tensor_param_spec(path: tuple[str, ...], value) -> P:
     if "embed" in joined:
         return P(None, "fsdp")
     return fsdp_param_spec(path, value)
+
+
+def moe_param_spec(path: tuple[str, ...], value) -> P:
+    """Expert-parallel rule for MoE models, composed with the TP rule.
+
+    Contract (leaf names set by models/moe.py, same idea as the *_proj
+    convention in tensor_param_spec): expert tables are 3-d params whose leaf
+    is named ``experts_wi`` / ``experts_wo`` — dim 0 shards over ``expert``,
+    the hidden dim over ``tensor`` (column-parallel wi, row-parallel wo).
+    ``router`` leaves are tiny and stay replicated so every device computes
+    identical gating. Everything else follows the transformer TP rule.
+    """
+    shape = getattr(value, "shape", ())
+    leaf = path[-1] if path else ""
+    if len(shape) == 3 and leaf == "experts_wi":
+        return P("expert", "fsdp", "tensor")
+    if len(shape) == 3 and leaf == "experts_wo":
+        return P("expert", "tensor", "fsdp")
+    if leaf == "router":
+        return P()
+    return tensor_param_spec(path, value)
 
 
 def _legalize(spec: P, shape: tuple, mesh: Mesh) -> P:

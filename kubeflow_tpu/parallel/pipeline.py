@@ -1,0 +1,222 @@
+"""Pipeline parallelism over the ``stage`` mesh axis (GPipe schedule).
+
+The reference has no training fan-out at all (SURVEY.md §2 note — its
+StatefulSets are pinned to one replica); pipeline parallelism is part of the
+distributed compute path this framework adds. Expressed the TPU way:
+
+- transformer blocks are grouped into ``n_stages`` stages whose parameters are
+  stacked on a leading stage dim sharded over ``stage`` — every device holds
+  only its own stage's weights;
+- the schedule is a single ``lax.scan`` over ``n_micro + n_stages - 1`` ticks
+  inside one ``shard_map``: each tick runs every stage in parallel on its
+  in-flight microbatch, then rotates activations to the next stage with
+  ``lax.ppermute`` (ICI neighbor traffic, no host round-trips);
+- backward is plain ``jax.grad`` through the scan — the transpose of
+  ``ppermute`` is the reverse rotation, so AD derives the reverse-pipeline
+  schedule automatically;
+- each stage step is ``jax.checkpoint``-ed (GPipe rematerialization), so live
+  activation memory is one microbatch per stage, not the whole batch.
+
+Composes with data parallelism (batch dims sharded over ``data``/``fsdp``
+inside the same shard_map). Tensor/sequence parallelism inside a stage would
+need manual collectives in the stage body and lives in the non-pipelined
+configs for now (``parallel/train.py``).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models.transformer import (
+    Block,
+    RMSNorm,
+    TransformerConfig,
+    lm_loss,
+)
+
+
+class PipelineStage(nn.Module):
+    """``num_blocks`` consecutive transformer blocks — one pipeline stage."""
+
+    cfg: TransformerConfig
+    num_blocks: int
+
+    @nn.compact
+    def __call__(self, x, positions):
+        for i in range(self.num_blocks):
+            x = Block(self.cfg, name=f"block_{i}")(x, positions)
+        return x
+
+
+def init_pipeline_lm(cfg: TransformerConfig, mesh: Mesh, rng, tokens):
+    """Initialize {embed, stages, final_norm} with stage weights stacked on a
+    leading dim and placed shard-per-device over the ``stage`` axis."""
+    n_stages = mesh.shape["stage"]
+    if cfg.num_layers % n_stages != 0:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} not divisible by "
+            f"{n_stages} pipeline stages"
+        )
+    blocks_per_stage = cfg.num_layers // n_stages
+    B, S = tokens.shape
+    rng_e, rng_s, rng_n = jax.random.split(rng, 3)
+
+    embed = _embed(cfg)
+    embed_params = embed.init(rng_e, tokens)["params"]
+
+    stage = PipelineStage(cfg, blocks_per_stage)
+    x = jnp.zeros((B, S, cfg.embed_dim), cfg.dtype)
+    positions = jnp.arange(S)
+    stage_params = jax.vmap(
+        lambda r: stage.init(r, x, positions)["params"]
+    )(jax.random.split(rng_s, n_stages))
+
+    norm_params = RMSNorm().init(rng_n, x)["params"]
+
+    repl = NamedSharding(mesh, P())
+    params = {
+        "embed": jax.device_put(embed_params, repl),
+        "stages": jax.device_put(
+            stage_params, NamedSharding(mesh, P("stage"))
+        ),
+        "final_norm": jax.device_put(norm_params, repl),
+    }
+    return params
+
+
+def pipeline_forward(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    params,
+    tokens,
+    *,
+    num_microbatches: int,
+):
+    """Full forward: embed → pipelined stages → final norm → tied logits."""
+    n_stages = mesh.shape["stage"]
+    B, S = tokens.shape
+    if B % num_microbatches != 0:
+        raise ValueError(
+            f"batch {B} not divisible by {num_microbatches} microbatches"
+        )
+    mb = B // num_microbatches
+
+    embed = _embed(cfg)
+    x = embed.apply({"params": params["embed"]}, tokens)
+    xs = x.reshape(num_microbatches, mb, S, cfg.embed_dim)
+    positions = jnp.arange(S)
+
+    stage = PipelineStage(cfg, cfg.num_layers // n_stages)
+
+    @jax.checkpoint
+    def stage_fn(p, x, positions):
+        return stage.apply({"params": p}, x, positions)
+
+    ys = _pipelined(stage_fn, mesh, n_stages, num_microbatches)(
+        params["stages"], xs, positions
+    )
+    y = ys.reshape(B, S, cfg.embed_dim)
+    y = RMSNorm().apply({"params": params["final_norm"]}, y)
+    return embed.apply(
+        {"params": params["embed"]},
+        y.astype(jnp.float32),
+        method=nn.Embed.attend,
+    )
+
+
+def _pipelined(stage_fn, mesh: Mesh, n_stages: int, n_micro: int):
+    """shard_map wrapper running the GPipe tick loop on every stage at once."""
+    batch_axes = ("data", "fsdp")
+
+    def body(stage_params, xs, positions):
+        # Each device sees its stage's slice with a leading dim of 1.
+        local = jax.tree_util.tree_map(
+            lambda p: jnp.squeeze(p, 0), stage_params
+        )
+        idx = lax.axis_index("stage")
+        rotate = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # Stage 0 feeds microbatch t (clamped — bubble ticks recompute the
+            # last microbatch and write nothing); others take the rotated
+            # activations from their predecessor.
+            feed = lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+            )
+            x_in = jnp.where(idx == 0, feed, state)
+            y = stage_fn(local, x_in, positions)
+            out_t = t - (n_stages - 1)
+            written = lax.dynamic_update_index_in_dim(
+                outputs, y, jnp.clip(out_t, 0, n_micro - 1), 0
+            )
+            outputs = jnp.where(
+                (idx == n_stages - 1) & (out_t >= 0), written, outputs
+            )
+            state = lax.ppermute(y, "stage", rotate)
+            return (state, outputs), None
+
+        zeros = jnp.zeros_like(xs)
+        (state, outputs), _ = lax.scan(
+            tick,
+            (jnp.zeros_like(xs[0]), zeros),
+            jnp.arange(n_micro + n_stages - 1),
+        )
+        # Only the last stage holds real outputs; broadcast them to every
+        # stage so the result is stage-replicated for the code outside.
+        return lax.psum(
+            jnp.where(idx == n_stages - 1, outputs, zeros), "stage"
+        )
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("stage"), P(None, batch_axes), P(None)),
+        out_specs=P(None, batch_axes),
+        check_vma=False,
+    )
+
+
+def make_pipeline_train_step(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    tx,
+    *,
+    num_microbatches: int,
+):
+    """(init, step): a jitted LM training step over the pipelined forward."""
+
+    def init(rng, tokens):
+        params = init_pipeline_lm(cfg, mesh, rng, tokens)
+        opt_state = tx.init(params)
+        return params, opt_state
+
+    forward = partial(
+        pipeline_forward, cfg, mesh, num_microbatches=num_microbatches
+    )
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        def loss_fn(p):
+            return lm_loss(forward(p, tokens), tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state_ = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state_, loss
+
+    return init, step
+
+
+def _embed(cfg: TransformerConfig) -> nn.Embed:
+    return nn.Embed(
+        cfg.vocab_size,
+        cfg.embed_dim,
+        dtype=cfg.dtype,
+        param_dtype=jnp.float32,
+    )

@@ -27,6 +27,7 @@ DEFAULT_WEIGHTS: Mapping[str, float] = {
     "seq": 30.0,
     "fsdp": 10.0,
     "expert": 10.0,
+    "stage": 3.0,
     "data": 1.0,
 }
 

@@ -1,0 +1,169 @@
+"""MoE family: routing invariants, expert FFN math, expert-parallel execution.
+
+Sharded cases run on the virtual 8-CPU mesh (conftest), mirroring how the
+reference tests multi-component behavior without a cluster (SURVEY.md §4).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models.moe import (
+    MoEConfig,
+    MoEMLP,
+    MoETransformerLM,
+    moe_lm_loss,
+    top_k_routing,
+)
+from kubeflow_tpu.parallel import mesh as meshlib
+
+
+def small_cfg(**kw) -> MoEConfig:
+    base = dict(
+        vocab_size=64,
+        num_layers=2,
+        num_heads=4,
+        embed_dim=64,
+        expert_hidden_dim=128,
+        num_experts=4,
+        experts_per_token=2,
+        max_seq_len=32,
+        attention_impl="xla",
+        dtype=jnp.float32,
+    )
+    base.update(kw)
+    return MoEConfig(**base)
+
+
+class TestRouting:
+    def test_combine_shape_and_gate_bounds(self):
+        logits = jnp.asarray(
+            np.random.default_rng(0).standard_normal((2, 16, 4)), jnp.float32
+        )
+        combine, aux = top_k_routing(logits, k=2, capacity=8)
+        assert combine.shape == (2, 16, 4, 8)
+        # Per-token total gate weight is <= 1 (== 1 when nothing is dropped).
+        totals = jnp.sum(combine, axis=(2, 3))
+        assert float(jnp.max(totals)) <= 1.0 + 1e-5
+        assert float(aux) > 0
+
+    def test_each_slot_used_at_most_once(self):
+        logits = jnp.asarray(
+            np.random.default_rng(1).standard_normal((1, 32, 4)), jnp.float32
+        )
+        combine, _ = top_k_routing(logits, k=2, capacity=4)
+        # A given (expert, slot) pair receives at most one token.
+        occupancy = jnp.sum((combine > 0).astype(jnp.int32), axis=(0, 1))
+        assert int(jnp.max(occupancy)) <= 1
+
+    def test_capacity_drops_overflow(self):
+        # All tokens prefer expert 0; only `capacity` of them may land there.
+        logits = jnp.zeros((1, 16, 4)).at[..., 0].set(10.0)
+        combine, _ = top_k_routing(logits, k=1, capacity=4)
+        routed = jnp.sum((combine[..., 0, :] > 0).astype(jnp.int32))
+        assert int(routed) == 4
+
+    def test_k_exceeding_experts_rejected(self):
+        logits = jnp.zeros((1, 4, 2))
+        with pytest.raises(ValueError, match="exceeds num_experts"):
+            top_k_routing(logits, k=4, capacity=8)
+
+    def test_top1_gate_is_softmax_prob(self):
+        logits = jnp.asarray([[[2.0, 0.0, 0.0, 0.0]]], jnp.float32)
+        combine, _ = top_k_routing(logits, k=1, capacity=8)
+        expected = jax.nn.softmax(logits[0, 0])[0]
+        assert np.isclose(float(jnp.sum(combine)), float(expected), atol=1e-6)
+
+
+class TestMoEMLP:
+    def test_single_expert_equals_dense_ffn(self):
+        # One expert + top-1 + ample capacity == plain gelu FFN (gate = 1).
+        cfg = small_cfg(num_experts=1, experts_per_token=1, capacity_factor=2.0)
+        x = jnp.asarray(
+            np.random.default_rng(2).standard_normal((2, 8, 64)), jnp.float32
+        )
+        layer = MoEMLP(cfg)
+        variables = layer.init(jax.random.PRNGKey(0), x)
+        y = layer.apply(variables, x)
+        p = variables["params"]
+        expected = (
+            jax.nn.gelu(x @ p["experts_wi"][0]) @ p["experts_wo"][0]
+        )
+        np.testing.assert_allclose(np.asarray(y), np.asarray(expected), atol=1e-4)
+
+    def test_grads_flow_to_router_and_experts(self):
+        cfg = small_cfg()
+        x = jnp.asarray(
+            np.random.default_rng(3).standard_normal((2, 16, 64)), jnp.float32
+        )
+        layer = MoEMLP(cfg)
+        variables = layer.init(jax.random.PRNGKey(0), x)
+
+        def loss(params):
+            return jnp.sum(layer.apply({"params": params}, x) ** 2)
+
+        grads = jax.grad(loss)(variables["params"])
+        for path in ("router", "experts_wi", "experts_wo"):
+            g = grads[path]
+            assert float(jnp.sum(jnp.abs(g))) > 0, f"no grad reached {path}"
+
+
+class TestMoELM:
+    def test_forward_and_loss(self):
+        cfg = small_cfg()
+        model = MoETransformerLM(cfg)
+        tokens = jnp.asarray(
+            np.random.default_rng(4).integers(0, 64, (2, 16)), jnp.int32
+        )
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        logits = model.apply(
+            {"params": variables["params"]}, tokens,
+            mutable=["intermediates"],
+        )[0]
+        assert logits.shape == (2, 16, 64)
+        loss = moe_lm_loss(model, variables["params"], tokens)
+        assert np.isfinite(float(loss))
+
+    def test_expert_parallel_matches_single_device(self):
+        cfg = small_cfg()
+        model = MoETransformerLM(cfg)
+        tokens = jnp.asarray(
+            np.random.default_rng(5).integers(0, 64, (4, 16)), jnp.int32
+        )
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        reference = float(moe_lm_loss(model, params, tokens))
+
+        mesh = meshlib.create_mesh(
+            meshlib.MeshPlan(data=2, expert=2, tensor=2)
+        )
+        shardings = meshlib.param_shardings(
+            mesh, params, meshlib.moe_param_spec
+        )
+        sharded_params = jax.device_put(params, shardings)
+        token_sh = NamedSharding(mesh, P(("data", "fsdp")))
+        sharded_tokens = jax.device_put(tokens, token_sh)
+
+        @jax.jit
+        def loss_and_grad(p, t):
+            return jax.value_and_grad(
+                lambda q: moe_lm_loss(model, q, t)
+            )(p)
+
+        with mesh:
+            loss, grads = loss_and_grad(sharded_params, sharded_tokens)
+        assert np.isclose(float(loss), reference, atol=1e-3)
+        flat = jax.tree_util.tree_leaves(grads)
+        assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+
+    def test_expert_weights_actually_sharded(self):
+        cfg = small_cfg()
+        mesh = meshlib.create_mesh(meshlib.MeshPlan(data=4, expert=2))
+        spec = meshlib.moe_param_spec(
+            ("layer_0", "moe", "experts_wi"), jnp.zeros((4, 64, 128))
+        )
+        assert spec == P("expert", "fsdp", "tensor")
+        spec = meshlib.moe_param_spec(
+            ("layer_0", "moe", "router"), jnp.zeros((64, 4))
+        )
+        assert spec == P()

@@ -152,16 +152,40 @@ def main() -> None:
     serve_ops(metrics, manager=manager)
     stop = threading.Event()
     n_workers = int(os.environ.get("RECONCILE_WORKERS", "4"))
-    manager.run_workers(n_workers, stop)
-    log.info("controller manager running with %d workers", n_workers)
+
+    reconciling = threading.Event()
+
+    def start_workers():
+        manager.run_workers(n_workers, stop)
+        reconciling.set()
+        log.info("controller manager running with %d workers", n_workers)
+
+    if os.environ.get("LEADER_ELECT", "").lower() in ("1", "true"):
+        # ref main.go:84-91: only the lease holder reconciles; standbys wait.
+        from kubeflow_tpu.runtime.leader import LeaderElector
+
+        elector = LeaderElector(
+            cluster,
+            name="kubeflow-tpu-controller",
+            namespace=os.environ.get("POD_NAMESPACE", "kubeflow-system"),
+        )
+        threading.Thread(
+            target=elector.run, args=(start_workers,), daemon=True
+        ).start()
+    else:
+        start_workers()
     probe_period = max(10.0, cfg.idleness_check_minutes * 60.0 / 2)
     while True:
         # Workers drain the queue continuously; this loop keeps the fleet
-        # kernel cache warm ahead of the culler's idleness checks.
-        try:
-            fleet.refresh()
-        except Exception:
-            log.exception("fleet kernel refresh failed")
+        # kernel cache warm ahead of the culler's idleness checks. Standby
+        # replicas (leader election, not elected) don't probe — nothing on
+        # them consumes the cache, and N× probing every user notebook is
+        # pure waste.
+        if reconciling.is_set():
+            try:
+                fleet.refresh()
+            except Exception:
+                log.exception("fleet kernel refresh failed")
         time.sleep(probe_period)
 
 

@@ -58,6 +58,8 @@ class FakeCluster:
         self._watchers: list[tuple[str | None, WatchFn]] = []
         # kind-pattern -> mutator, the MutatingWebhookConfiguration analog
         self._mutators: list[tuple[str, MutatorFn]] = []
+        # (namespace, pod) -> "[container] line" entries, the kubelet log store
+        self._pod_logs: dict[tuple[str, str], list[str]] = {}
 
     # ------------------------------------------------------------------ CRUD
 
@@ -172,6 +174,8 @@ class FakeCluster:
                     return
             else:
                 del self._objects[k]
+                if kind == "Pod":
+                    self._pod_logs.pop((namespace, name), None)
                 stored = ko.deep_copy(obj)
                 self._notify("DELETED", stored)
                 self._garbage_collect(stored)
@@ -305,8 +309,50 @@ class FakeCluster:
         except AdmissionDenied:
             return None
 
+    def pod_logs(
+        self,
+        name: str,
+        namespace: str,
+        *,
+        container: str | None = None,
+        tail_lines: int | None = None,
+    ) -> str:
+        """Pod log text (ref: JWA GET .../pod/<pod>/logs → read_namespaced_pod_log).
+
+        The fake kubelet writes startup lines on promotion; tests and the
+        standalone demo append more via ``append_pod_log``.
+        """
+        self.get("Pod", name, namespace)  # NotFound propagates like the API
+        lines = self._pod_logs.get((namespace, name), [])
+        if container:
+            prefix = f"[{container}] "
+            lines = [l[len(prefix):] for l in lines if l.startswith(prefix)]
+        else:
+            lines = [l.split("] ", 1)[-1] for l in lines]
+        if tail_lines is not None:
+            lines = lines[-tail_lines:]
+        return "\n".join(lines)
+
+    def append_pod_log(
+        self, name: str, namespace: str, line: str, container: str = ""
+    ) -> None:
+        self._pod_logs.setdefault((namespace, name), []).append(
+            f"[{container}] {line}"
+        )
+
     def _promote_pod(self, pod: Mapping) -> None:
         """Pending → Running/Ready with container statuses."""
+        for c in pod["spec"].get("containers", []):
+            cname = c.get("name", "")
+            image = c.get("image", "")
+            self.append_pod_log(
+                ko.name(pod), ko.namespace(pod),
+                f"Pulled image {image}", cname,
+            )
+            self.append_pod_log(
+                ko.name(pod), ko.namespace(pod),
+                f"Started container {cname}", cname,
+            )
         self.patch(
             "Pod",
             ko.name(pod),

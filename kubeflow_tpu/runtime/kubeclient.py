@@ -50,6 +50,7 @@ RESOURCES: dict[str, tuple[str, str, str, bool]] = {
     "VirtualService": ("apis", "networking.istio.io/v1alpha3", "virtualservices", True),
     "AuthorizationPolicy": ("apis", "security.istio.io/v1beta1", "authorizationpolicies", True),
     "Route": ("apis", "route.openshift.io/v1", "routes", True),
+    "Lease": ("apis", "coordination.k8s.io/v1", "leases", True),
 }
 
 
@@ -94,7 +95,7 @@ class KubeClient:
 
     # ------------------------------------------------------------------ http
 
-    def _request(self, method: str, path: str, **kw):
+    def _request(self, method: str, path: str, *, raw: bool = False, **kw):
         resp = self.session.request(
             method, self.base_url + path, verify=self.verify, **kw
         )
@@ -106,6 +107,8 @@ class KubeClient:
                 raise AlreadyExists(path)
             raise Conflict(body)
         resp.raise_for_status()
+        if raw:  # pod logs: the API returns text, not JSON
+            return resp.text
         return resp.json() if resp.content else {}
 
     # ------------------------------------------------------------------ CRUD
@@ -124,6 +127,27 @@ class KubeClient:
             return self.get(kind, name, namespace)
         except NotFound:
             return None
+
+    def pod_logs(
+        self,
+        name: str,
+        namespace: str,
+        *,
+        container: str | None = None,
+        tail_lines: int | None = None,
+    ) -> str:
+        """GET /api/v1/.../pods/<name>/log (ref: read_namespaced_pod_log)."""
+        params: dict = {}
+        if container:
+            params["container"] = container
+        if tail_lines is not None:
+            params["tailLines"] = tail_lines
+        return self._request(
+            "GET",
+            f"/api/v1/namespaces/{namespace}/pods/{name}/log",
+            params=params,
+            raw=True,
+        )
 
     def list(self, kind: str, namespace: str | None = None, selector: Mapping | None = None) -> list[dict]:
         params = {}

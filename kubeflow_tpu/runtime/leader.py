@@ -1,0 +1,174 @@
+"""Lease-based leader election for controller replicas.
+
+Reference parity: the Go controllers enable controller-runtime leader election
+(``notebook-controller/main.go:84-91``) so only one replica reconciles. Same
+protocol here: a ``coordination.k8s.io/v1 Lease`` object is the lock — the
+holder renews it, challengers take over when ``renewTime`` is older than the
+lease duration. Works against both the in-memory cluster (tests) and the real
+API server (optimistic-concurrency conflicts on update mean we lost a race).
+"""
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Callable
+
+from kubeflow_tpu.runtime.fake import AlreadyExists, Conflict, NotFound
+
+log = logging.getLogger("leader")
+
+_FMT = "%Y-%m-%dT%H:%M:%S.%fZ"
+
+
+def _format(ts: float) -> str:
+    return datetime.datetime.fromtimestamp(
+        ts, tz=datetime.timezone.utc
+    ).strftime(_FMT)
+
+
+def _parse(s: str) -> float:
+    return (
+        datetime.datetime.strptime(s, _FMT)
+        .replace(tzinfo=datetime.timezone.utc)
+        .timestamp()
+    )
+
+
+def default_identity() -> str:
+    return f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+
+
+class LeaderElector:
+    """Acquire/renew loop over one Lease; callbacks mirror controller-runtime's
+    ``OnStartedLeading``/``OnStoppedLeading``."""
+
+    def __init__(
+        self,
+        cluster,
+        *,
+        name: str,
+        namespace: str = "kubeflow-system",
+        identity: str | None = None,
+        lease_duration: float = 15.0,
+        retry_period: float = 2.0,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self.cluster = cluster
+        self.name = name
+        self.namespace = namespace
+        self.identity = identity or default_identity()
+        self.lease_duration = lease_duration
+        self.retry_period = retry_period
+        self.clock = clock
+        self.is_leader = False
+
+    # ---------------------------------------------------------------- step
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election step; updates ``is_leader`` and returns it."""
+        now = self.clock()
+        try:
+            lease = self.cluster.get("Lease", self.name, self.namespace)
+        except NotFound:
+            lease = self._new_lease(now)
+            try:
+                self.cluster.create(lease)
+                self.is_leader = True
+                log.info("%s acquired lease %s (created)", self.identity, self.name)
+                return True
+            except (AlreadyExists, Conflict):
+                self.is_leader = False
+                return False
+
+        spec = lease.setdefault("spec", {})
+        holder = spec.get("holderIdentity")
+        renew = _parse(spec["renewTime"]) if spec.get("renewTime") else 0.0
+
+        if holder == self.identity:
+            spec["renewTime"] = _format(now)
+            try:
+                self.cluster.update(lease)
+                self.is_leader = True
+                return True
+            except (Conflict, NotFound):
+                self.is_leader = False
+                return False
+
+        if now < renew + float(spec.get("leaseDurationSeconds", self.lease_duration)):
+            self.is_leader = False  # healthy holder elsewhere
+            return False
+
+        # Expired — challenge.
+        spec["holderIdentity"] = self.identity
+        spec["acquireTime"] = _format(now)
+        spec["renewTime"] = _format(now)
+        spec["leaseTransitions"] = int(spec.get("leaseTransitions", 0)) + 1
+        try:
+            self.cluster.update(lease)
+            log.info(
+                "%s took over lease %s from %s", self.identity, self.name, holder
+            )
+            self.is_leader = True
+            return True
+        except (Conflict, NotFound):
+            self.is_leader = False
+            return False
+
+    def _new_lease(self, now: float) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self.lease_duration),
+                "acquireTime": _format(now),
+                "renewTime": _format(now),
+                "leaseTransitions": 0,
+            },
+        }
+
+    # ---------------------------------------------------------------- loop
+
+    def run(
+        self,
+        on_started_leading: Callable[[], None],
+        *,
+        on_stopped_leading: Callable[[], None] | None = None,
+        stop: threading.Event | None = None,
+    ) -> None:
+        """Block until leadership, fire the callback, keep renewing; on loss
+        fire ``on_stopped_leading`` (default: hard exit, the controller-runtime
+        behavior — a stale leader must not keep reconciling)."""
+        stop = stop or threading.Event()
+        was_leader = False
+        last_step_ok = self.clock()
+        while not stop.is_set():
+            try:
+                leading = self.try_acquire_or_renew()
+                last_step_ok = self.clock()
+            except Exception:
+                # Transient API error (connection blip, 5xx): keep retrying —
+                # dying here while workers run would be silent split-brain.
+                # A leader that can't reach the API for a full lease duration
+                # must assume the lease expired and someone else holds it.
+                log.exception("election step failed for %s", self.name)
+                leading = was_leader and (
+                    self.clock() - last_step_ok < self.lease_duration
+                )
+            if leading and not was_leader:
+                on_started_leading()
+            elif was_leader and not leading:
+                log.error("%s lost lease %s", self.identity, self.name)
+                self.is_leader = False
+                if on_stopped_leading is not None:
+                    on_stopped_leading()
+                else:  # pragma: no cover - process exit
+                    os._exit(1)
+            was_leader = leading
+            stop.wait(self.retry_period)

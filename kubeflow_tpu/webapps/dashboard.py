@@ -100,6 +100,32 @@ def create_app(
         cluster.create(api.profile(name, user.name))
         return success("message", f"Profile {name} created")
 
+    @app.route("/api/workgroup/nuke-self", methods=("POST", "DELETE"))
+    def nuke_self(request):
+        # ref api_workgroup.ts:254-388 "nuke-self": self-serve teardown of the
+        # user's own profile (namespace + RBAC fan into the profile
+        # controller's finalizer-driven cleanup)
+        user = app.current_user(request)
+        owned = [
+            p for p in cluster.list("Profile")
+            if p.get("spec", {}).get("owner", {}).get("name") == user.name
+        ]
+        if not owned:
+            from werkzeug.exceptions import NotFound
+
+            raise NotFound(f"{user.name} has no profile to delete.")
+        for p in owned:
+            for b in bindings.list(namespaces=[ko.name(p)]):
+                if b["user"].get("name") == user.name:
+                    # the owner RoleBinding is the profile controller's (its
+                    # own naming scheme) and dies with the profile below
+                    continue
+                bindings.delete(b["user"], ko.name(p), b["roleRef"]["name"])
+            profiles.delete(ko.name(p))
+        return success(
+            "message", f"Deleted {len(owned)} profile(s) for {user.name}"
+        )
+
     @app.route("/api/namespaces")
     def namespaces(request):
         app.current_user(request)

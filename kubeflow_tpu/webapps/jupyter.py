@@ -151,6 +151,22 @@ def create_app(
             raise NotFound("No pod detected.")
         return success("pod", pods[0], pods=pods)  # all gang pods for TPU view
 
+    @app.route(
+        "/api/namespaces/<namespace>/notebooks/<name>/pod/<pod>/logs"
+    )
+    def get_pod_logs(request, namespace, name, pod):
+        # ref: jupyter get.py pod logs route → read_namespaced_pod_log
+        app.ensure(request, "get", "pods", namespace)
+        pods = cluster.list(
+            "Pod", namespace, {"matchLabels": {"notebook-name": name}}
+        )
+        if not any(ko.name(p) == pod for p in pods):
+            from werkzeug.exceptions import NotFound
+
+            raise NotFound(f"Pod {pod} is not part of notebook {name}.")
+        text = cluster.pod_logs(pod, namespace)
+        return success("logs", text.splitlines())
+
     @app.route("/api/namespaces/<namespace>/notebooks/<name>/events")
     def get_notebook_events(request, namespace, name):
         app.ensure(request, "list", "events", namespace)

@@ -1,0 +1,108 @@
+"""Lease-based leader election (ref: controller-runtime leader election,
+notebook-controller main.go:84-91)."""
+import threading
+
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.runtime.leader import LeaderElector
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make(cluster, ident, clock):
+    return LeaderElector(
+        cluster, name="test-lock", identity=ident,
+        lease_duration=15.0, retry_period=0.01, clock=clock,
+    )
+
+
+class TestElection:
+    def test_first_caller_acquires(self):
+        cluster, clock = FakeCluster(), FakeClock()
+        a = make(cluster, "a", clock)
+        assert a.try_acquire_or_renew() is True
+        lease = cluster.get("Lease", "test-lock", "kubeflow-system")
+        assert lease["spec"]["holderIdentity"] == "a"
+
+    def test_second_caller_blocked_while_lease_fresh(self):
+        cluster, clock = FakeCluster(), FakeClock()
+        a, b = make(cluster, "a", clock), make(cluster, "b", clock)
+        assert a.try_acquire_or_renew()
+        clock.t += 5
+        assert b.try_acquire_or_renew() is False
+        assert b.is_leader is False
+
+    def test_takeover_after_expiry_increments_transitions(self):
+        cluster, clock = FakeCluster(), FakeClock()
+        a, b = make(cluster, "a", clock), make(cluster, "b", clock)
+        assert a.try_acquire_or_renew()
+        clock.t += 20  # past the 15 s lease duration, no renewal from a
+        assert b.try_acquire_or_renew() is True
+        lease = cluster.get("Lease", "test-lock", "kubeflow-system")
+        assert lease["spec"]["holderIdentity"] == "b"
+        assert lease["spec"]["leaseTransitions"] == 1
+
+    def test_renewal_keeps_leadership(self):
+        cluster, clock = FakeCluster(), FakeClock()
+        a, b = make(cluster, "a", clock), make(cluster, "b", clock)
+        assert a.try_acquire_or_renew()
+        for _ in range(4):
+            clock.t += 10  # renew well within each lease window
+            assert a.try_acquire_or_renew() is True
+            assert b.try_acquire_or_renew() is False
+
+    def test_run_fires_started_callback_and_stops(self):
+        cluster, clock = FakeCluster(), FakeClock()
+        a = make(cluster, "a", clock)
+        started = threading.Event()
+        stop = threading.Event()
+        t = threading.Thread(
+            target=a.run, args=(started.set,), kwargs={"stop": stop},
+            daemon=True,
+        )
+        t.start()
+        assert started.wait(timeout=5)
+        stop.set()
+        t.join(timeout=5)
+        assert not t.is_alive()
+
+    def test_lost_leadership_fires_stop_callback(self):
+        cluster, clock = FakeCluster(), FakeClock()
+        a = make(cluster, "a", clock)
+        started = threading.Event()
+        stop = threading.Event()
+        stopped = []
+
+        def on_stop():
+            stopped.append(True)
+            stop.set()
+
+        t = threading.Thread(
+            target=a.run, args=(started.set,),
+            kwargs={"on_stopped_leading": on_stop, "stop": stop},
+            daemon=True,
+        )
+        t.start()
+        assert started.wait(timeout=5)
+        # Another replica steals the lock out from under a (fresh renewTime,
+        # so a cannot reclaim it) — a's next step must fire on_stop.
+        from kubeflow_tpu.runtime.fake import Conflict
+        from kubeflow_tpu.runtime.leader import _format
+
+        for _ in range(100):  # retry around a's concurrent renewals
+            try:
+                lease = cluster.get("Lease", "test-lock", "kubeflow-system")
+                lease["spec"]["holderIdentity"] = "b"
+                lease["spec"]["renewTime"] = _format(clock() + 1000)
+                cluster.update(lease)
+                break
+            except Conflict:
+                continue
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert stopped == [True]

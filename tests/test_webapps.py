@@ -171,6 +171,28 @@ class TestJupyterApp:
         r = client.get("/api/namespaces/alice/notebooks/nb/events", headers=ALICE)
         assert get_json_body(r)["success"]
 
+    def test_pod_logs_endpoint(self, platform):
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "nb"},
+            headers=auth(client),
+        )
+        m.run_until_idle()
+        cluster.settle(m)
+        r = client.get(
+            "/api/namespaces/alice/notebooks/nb/pod/nb-0/logs", headers=ALICE
+        )
+        logs = get_json_body(r)["logs"]
+        assert any("Started container" in line for line in logs)
+        # a pod that isn't part of the notebook is a 404, not a leak
+        r = client.get(
+            "/api/namespaces/alice/notebooks/nb/pod/other-pod/logs",
+            headers=ALICE,
+        )
+        assert r.status_code == 404
+
     def test_csrf_rejects_mismatched_token(self, platform):
         cluster, _ = platform
         client = Client(jupyter.create_app(cluster))
@@ -274,6 +296,20 @@ class TestKfamApp:
 
 
 class TestDashboardApp:
+    def test_nuke_self_deletes_profile_and_bindings(self, platform):
+        cluster, m = platform
+        bc = BindingClient(cluster)
+        bc.create({"kind": "User", "name": "bob@x.io"}, "alice", "kubeflow-edit")
+        client = Client(dashboard.create_app(cluster))
+        r = client.post("/api/workgroup/nuke-self", headers=auth(client))
+        assert get_json_body(r)["success"]
+        m.run_until_idle()
+        assert cluster.try_get("Profile", "alice") is None
+        assert bc.list(namespaces=["alice"]) == []
+        # nothing left to nuke → 404
+        r = client.post("/api/workgroup/nuke-self", headers=auth(client))
+        assert r.status_code == 404
+
     def test_env_info_aggregates(self, platform):
         cluster, _ = platform
         bc = BindingClient(cluster)

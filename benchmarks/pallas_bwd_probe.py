@@ -1,0 +1,148 @@
+"""Standalone probe: Pallas fused (BN+relu)-backward + 1x1-conv dgrad/wgrad.
+
+Computes, in one pass over the activations (tiled over rows):
+    db   = dr * relu_mask            (relu mask from bn-out recomputed)
+    dy   = (gamma*inv) * (db - mean_db - xhat * mean_db_xhat)
+    dX   = dy @ W.T   (+ optional residual-grad add-in)
+    dW   = X.T @ dy   (accumulated in VMEM f32)
+vs the same math in plain XLA ops. Shapes: the bench's hottest unit
+(stage2_block1/conv1: N=256*56*56, Ci=256, Co=128).
+"""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 256 * 56 * 56
+CI = 256
+CO = 128
+TN = 2048
+
+
+def bwd_kernel(dr_ref, y_ref, x_ref, wt_ref, scal_ref, dx_ref, dw_ref, acc_ref):
+    # scal_ref rows: 0 gamma*inv, 1 mean, 2 inv, 3 beta_eff(gamma,beta),
+    #               4 mean_db, 5 mean_db_xhat, 6 gamma   (all f32 [7, CO])
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    y = y_ref[:].astype(jnp.float32)
+    xhat = (y - scal_ref[1, :]) * scal_ref[2, :]
+    mask = (xhat * scal_ref[6, :] + scal_ref[3, :]) > 0
+    db = jnp.where(mask, dr_ref[:].astype(jnp.float32), 0.0)
+    dy = scal_ref[0, :] * (db - scal_ref[4, :] - xhat * scal_ref[5, :])
+    dy16 = dy.astype(jnp.bfloat16)
+    dx_ref[:] = jnp.dot(
+        dy16, wt_ref[:], preferred_element_type=jnp.float32
+    ).astype(jnp.bfloat16)
+    acc_ref[:] += jax.lax.dot_general(
+        x_ref[:], dy16,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        dw_ref[:] = acc_ref[:]
+
+
+@jax.jit
+def pallas_bwd(dr, y, x, wt, scal):
+    return pl.pallas_call(
+        bwd_kernel,
+        grid=(N // TN,),
+        in_specs=[
+            pl.BlockSpec((TN, CO), lambda i: (i, 0)),
+            pl.BlockSpec((TN, CO), lambda i: (i, 0)),
+            pl.BlockSpec((TN, CI), lambda i: (i, 0)),
+            pl.BlockSpec((CO, CI), lambda i: (0, 0)),
+            pl.BlockSpec((7, CO), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((TN, CI), lambda i: (i, 0)),
+            pl.BlockSpec((CI, CO), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, CI), jnp.bfloat16),
+            jax.ShapeDtypeStruct((CI, CO), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((CI, CO), jnp.float32)],
+    )(dr, y, x, wt, scal)
+
+
+@jax.jit
+def xla_bwd(dr, y, x, wt, scal):
+    yf = y.astype(jnp.float32)
+    xhat = (yf - scal[1, :]) * scal[2, :]
+    db = jnp.where(xhat * scal[6, :] + scal[3, :] > 0, dr.astype(jnp.float32), 0.0)
+    dy = scal[0, :] * (db - scal[4, :] - xhat * scal[5, :])
+    dy16 = dy.astype(jnp.bfloat16)
+    dx = jnp.dot(dy16, wt)
+    dw = jax.lax.dot_general(
+        x, dy16, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return dx, dw
+
+
+def timeit(f, args, label):
+    """Chain the op inside a device-side scan so each iteration differs and
+    per-call host effects cancel; subtract two scan lengths to drop the fixed
+    sync cost. The chain adds one identical slice per iter to both variants."""
+    dr, y, x, wt, scal = args
+
+    def make_loop(steps):
+        @jax.jit
+        def loop(dr, y, x, wt, scal):
+            def body(yc, _):
+                dx, dw = f(dr, yc, x, wt, scal)
+                return dx[:, :CO], dw[0, 0]
+            yout, dws = jax.lax.scan(body, y, None, length=steps)
+            return dws[-1]
+
+        return loop
+
+    short, long_ = make_loop(3), make_loop(13)
+    float(short(dr, y, x, wt, scal))
+    float(long_(dr, y, x, wt, scal))
+    best = float("inf")
+    for _ in range(3):
+        t = time.perf_counter()
+        float(short(dr, y, x, wt, scal))
+        t3 = time.perf_counter() - t
+        t = time.perf_counter()
+        float(long_(dr, y, x, wt, scal))
+        t13 = time.perf_counter() - t
+        best = min(best, (t13 - t3) / 10)
+    gb = (N * (CO + CO + CI) * 2 + N * CI * 2) / 1e9
+    print(f"{label}: {best*1000:.2f} ms  {gb/best:.0f} GB/s effective (incl chain slice)")
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    dr = jnp.asarray(rng.standard_normal((N, CO)), jnp.bfloat16)
+    y = jnp.asarray(rng.standard_normal((N, CO)), jnp.bfloat16)
+    x = jnp.asarray(rng.standard_normal((N, CI)), jnp.bfloat16)
+    wt = jnp.asarray(rng.standard_normal((CO, CI)), jnp.bfloat16)
+    scal = jnp.asarray(rng.standard_normal((7, CO)), jnp.float32)
+
+    # correctness
+    dx_p, dw_p = pallas_bwd(dr, y, x, wt, scal)
+    dx_x, dw_x = xla_bwd(dr, y, x, wt, scal)
+    err_dx = float(jnp.max(jnp.abs(dx_p.astype(jnp.float32) - dx_x.astype(jnp.float32))))
+    err_dw = float(jnp.max(jnp.abs(dw_p - dw_x))) / float(jnp.max(jnp.abs(dw_x)))
+    print(f"max|dX err|={err_dx:.4f}  rel|dW err|={err_dw:.6f}")
+
+    timeit(pallas_bwd, (dr, y, x, wt, scal), "pallas fused bwd")
+    timeit(xla_bwd, (dr, y, x, wt, scal), "xla same math   ")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,133 @@
+"""Perf probe for the ResNet-50 bench: measures variants to find lost MFU.
+
+Run: python benchmarks/perf_probe.py [variant ...]
+Variants: pyloop pyloop512 scan scan128 scan512
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kubeflow_tpu.models.resnet import ResNet50, flops_per_image
+from kubeflow_tpu.parallel import mesh as meshlib
+from kubeflow_tpu.parallel.train import make_classifier_train_step
+
+IMAGE = 224
+STEPS = 10
+PEAK = 197e12
+
+
+def make_batch(batch, image=IMAGE):
+    rng = np.random.default_rng(0)
+    return {
+        "image": jnp.asarray(
+            rng.standard_normal((batch, image, image, 3)), jnp.bfloat16
+        ),
+        "label": jnp.asarray(rng.integers(0, 1000, batch), jnp.int32),
+    }
+
+
+def report(name, batch_size, elapsed, steps=STEPS):
+    imgs = batch_size * steps / elapsed
+    mfu = imgs * 3 * flops_per_image(IMAGE) / PEAK
+    print(f"{name}: {imgs:.1f} img/s  MFU={mfu:.4f}  vs_baseline={mfu/0.36:.4f}",
+          flush=True)
+
+
+def run_pyloop(batch_size=256):
+    mesh = meshlib.create_mesh(meshlib.MeshPlan(data=1))
+    model = ResNet50(num_classes=1000)
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    bundle = make_classifier_train_step(model, tx, mesh)
+    batch = make_batch(batch_size)
+    sh = {k: meshlib.batch_sharding(mesh) for k in batch}
+    batch = jax.device_put(batch, sh)
+    state = bundle.init(jax.random.PRNGKey(0), batch)
+    for _ in range(3):
+        state, metrics = bundle.step(state, batch)
+    float(metrics["loss"])
+    best = float("inf")
+    for _ in range(3):
+        t = time.perf_counter()
+        for _ in range(STEPS):
+            state, metrics = bundle.step(state, batch)
+        float(metrics["loss"])
+        best = min(best, time.perf_counter() - t)
+    report(f"pyloop b{batch_size}", batch_size, best)
+
+
+def run_scan(batch_size=256):
+    mesh = meshlib.create_mesh(meshlib.MeshPlan(data=1))
+    model = ResNet50(num_classes=1000)
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    bundle = make_classifier_train_step(model, tx, mesh)
+    batch = make_batch(batch_size)
+    sh = {k: meshlib.batch_sharding(mesh) for k in batch}
+    batch = jax.device_put(batch, sh)
+    state = bundle.init(jax.random.PRNGKey(0), batch)
+
+    # one jitted program running STEPS train steps back-to-back on-device
+    import functools
+
+    from kubeflow_tpu.parallel.train import cross_entropy_loss
+
+    def one_step(state, batch):
+        def compute_loss(params):
+            logits, updates = model.apply(
+                {"params": params, "batch_stats": state["batch_stats"]},
+                batch["image"], train=True, mutable=["batch_stats"],
+            )
+            return cross_entropy_loss(logits, batch["label"]), updates
+
+        (loss, updates), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(state["params"])
+        u, new_opt = tx.update(grads, state["opt_state"], state["params"])
+        return {
+            "params": optax.apply_updates(state["params"], u),
+            "batch_stats": updates["batch_stats"],
+            "opt_state": new_opt,
+            "step": state["step"] + 1,
+        }, loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi_step(state, batch):
+        def body(s, _):
+            s, loss = one_step(s, batch)
+            return s, loss
+        state, losses = jax.lax.scan(body, state, None, length=STEPS)
+        return state, losses[-1]
+
+    state, loss = multi_step(state, batch)
+    float(loss)
+    best = float("inf")
+    for _ in range(3):
+        t = time.perf_counter()
+        state, loss = multi_step(state, batch)
+        float(loss)
+        best = min(best, time.perf_counter() - t)
+    report(f"scan b{batch_size}", batch_size, best)
+
+
+def main():
+    variants = sys.argv[1:] or ["pyloop", "scan"]
+    for v in variants:
+        if v == "pyloop":
+            run_pyloop(256)
+        elif v == "pyloop512":
+            run_pyloop(512)
+        elif v == "scan":
+            run_scan(256)
+        elif v == "scan512":
+            run_scan(512)
+        elif v == "scan128":
+            run_scan(128)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Capture an XLA trace of the bench train step and dump the op breakdown."""
+import glob
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.models.resnet import ResNet50
+from kubeflow_tpu.parallel import mesh as meshlib
+from kubeflow_tpu.parallel.train import make_classifier_train_step
+
+LOGDIR = "/tmp/bench_trace"
+BATCH = 32
+
+
+def main():
+    mesh = meshlib.create_mesh(meshlib.MeshPlan(data=1))
+    model = ResNet50(num_classes=1000)
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    bundle = make_classifier_train_step(model, tx, mesh)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(rng.standard_normal((BATCH, 224, 224, 3)), jnp.bfloat16),
+        "label": jnp.asarray(rng.integers(0, 1000, BATCH), jnp.int32),
+    }
+    sh = {k: meshlib.batch_sharding(mesh) for k in batch}
+    batch = jax.device_put(batch, sh)
+    state = bundle.init(jax.random.PRNGKey(0), batch)
+    for _ in range(3):
+        state, metrics = bundle.step(state, batch)
+    float(metrics["loss"])
+
+    jax.profiler.start_trace(LOGDIR)
+    for _ in range(3):
+        state, metrics = bundle.step(state, batch)
+    float(metrics["loss"])
+    jax.profiler.stop_trace()
+
+    files = glob.glob(f"{LOGDIR}/**/*.xplane.pb", recursive=True)
+    print("TRACE FILES:", files)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,52 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
+class SpaceToDepthStem(nn.Module):
+    """The 7x7/s2 stem conv, computed in space-to-depth form.
+
+    A 7x7 stride-2 conv on [B,224,224,3] keeps only 3 of the MXU's 128 input
+    lanes busy. Reindexing the input into 2x2 pixel cells ([B,112,112,12]) and
+    zero-padding the kernel to 8x8 turns it into an *exactly equivalent* 4x4
+    stride-1 conv with 12 input channels (the MLPerf ResNet trick). Parameters
+    stay in canonical [7,7,3,width] layout so the model is still ResNet-50;
+    the relayout below is a param-sized reshape that XLA folds away.
+    """
+
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (7, 7, 3, self.width),
+            jnp.float32,
+        )
+        # pad taps at the front: out[i] = sum_k w[k] in[2i-3+k]
+        #                              = sum_m w8[m] in[2i-4+m], w8[0] = 0
+        w8 = jnp.pad(w, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        # [8,8,3,C] -> [4(cell_h),2(ph),4(cell_w),2(pw),3,C] -> [4,4,12,C]
+        w_s2d = (
+            w8.reshape(4, 2, 4, 2, 3, self.width)
+            .transpose(0, 2, 1, 3, 4, 5)
+            .reshape(4, 4, 12, self.width)
+        ).astype(self.dtype)
+        b, h, wdt, c = x.shape
+        x = (
+            x.reshape(b, h // 2, 2, wdt // 2, 2, c)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(b, h // 2, wdt // 2, 4 * c)
+        )
+        return jax.lax.conv_general_dilated(
+            x.astype(self.dtype),
+            w_s2d,
+            window_strides=(1, 1),
+            padding=((2, 1), (2, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: int = 1
@@ -58,6 +104,7 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     dtype: Any = jnp.bfloat16
+    s2d_stem: bool = False  # space-to-depth stem (same math, MXU-friendly)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -71,8 +118,13 @@ class ResNet(nn.Module):
             param_dtype=jnp.float32,
         )
         x = x.astype(self.dtype)
-        x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                 use_bias=False, name="stem_conv")(x)
+        if self.s2d_stem and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+            x = SpaceToDepthStem(
+                width=self.width, dtype=self.dtype, name="stem_conv"
+            )(x)
+        else:
+            x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                     use_bias=False, name="stem_conv")(x)
         x = norm(name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

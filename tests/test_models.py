@@ -47,6 +47,32 @@ class TestResNet:
         assert float(metrics["loss"]) < first
         assert int(state["step"]) == 5
 
+    def test_s2d_stem_is_exact_7x7s2_equivalent(self):
+        """SpaceToDepthStem must compute the identical function as the
+        canonical 7x7/s2 stem conv (MLPerf space-to-depth reindexing)."""
+        from kubeflow_tpu.models.resnet import SpaceToDepthStem
+
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (2, 32, 32, 3), jnp.float32)
+        stem = SpaceToDepthStem(width=8, dtype=jnp.float32)
+        vars_ = stem.init(rng, x)
+        w = vars_["params"]["kernel"]
+        y_s2d = stem.apply(vars_, x)
+        y_ref = jax.lax.conv_general_dilated(
+            x, w, window_strides=(2, 2), padding=((3, 3), (3, 3)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        assert y_s2d.shape == y_ref.shape
+        np.testing.assert_allclose(
+            np.asarray(y_s2d), np.asarray(y_ref), atol=2e-5)
+
+    def test_s2d_model_forward(self):
+        model = ResNet(stage_sizes=[1, 1], num_classes=10, width=16,
+                       s2d_stem=True)
+        x = jnp.ones((2, 32, 32, 3))
+        vars_ = model.init(jax.random.PRNGKey(0), x, train=False)
+        logits = model.apply(vars_, x, train=False)
+        assert logits.shape == (2, 10)
+
     def test_flops_estimate(self):
         assert 7e9 < flops_per_image(224) < 9e9
         assert flops_per_image(112) == pytest.approx(flops_per_image(224) / 4)

@@ -30,7 +30,7 @@ from kubeflow_tpu.api import types as api
 from kubeflow_tpu.culler.culler import Culler, set_stop_annotation, stop_annotation_is_set
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime import reconcilehelper as helper
-from kubeflow_tpu.runtime.fake import FakeCluster, NotFound
+from kubeflow_tpu.runtime.fake import Conflict, FakeCluster, NotFound
 from kubeflow_tpu.runtime.manager import Reconciler, Result
 from kubeflow_tpu.tpu import topology as tputopo
 from kubeflow_tpu.utils.config import ControllerConfig
@@ -324,16 +324,30 @@ class NotebookReconciler(Reconciler):
             (e.get("reason"), e.get("message"))
             for e in cluster.events_for(nb)
         }
-        children = [(p["metadata"]["name"], "Pod") for p in cluster.list(
-            "Pod", ns, {"matchLabels": {"statefulset": name}}
-        )] + [(name, "StatefulSet")]
+        children = [
+            (p["metadata"]["name"], "Pod", p["metadata"].get("uid"))
+            for p in cluster.list(
+                "Pod", ns, {"matchLabels": {"statefulset": name}}
+            )
+        ]
+        sts = cluster.try_get("StatefulSet", name, ns)
+        if sts is not None:
+            children.append((name, "StatefulSet", sts["metadata"].get("uid")))
         all_events = cluster.list("Event", ns)
-        for child_name, child_kind in children:
+        for child_name, child_kind, child_uid in children:
             for ev in all_events:
                 io = ev.get("involvedObject", {})
+                # uid match (when both sides carry one) keeps events from a
+                # previous incarnation of a recreated child from being
+                # mirrored onto the new CR (ref go:94-118 is uid-correct).
+                uid_ok = (
+                    not io.get("uid") or not child_uid
+                    or io["uid"] == child_uid
+                )
                 if (
                     io.get("kind") == child_kind
                     and io.get("name") == child_name
+                    and uid_ok
                     and ev.get("type") == "Warning"
                     and (ev.get("reason"), ev.get("message")) not in mirrored
                 ):
@@ -359,8 +373,10 @@ class NotebookReconciler(Reconciler):
         if changed:
             try:
                 cluster.update(nb)
-            except Exception:
-                pass  # conflict: next requeue retries with fresh object
+            except (Conflict, NotFound):
+                # conflict: next requeue retries with a fresh object;
+                # not-found: deleted underneath us, nothing left to cull
+                pass
         return period
 
 
@@ -392,8 +408,15 @@ def _map_pod_to_notebook(pod: dict):
 def _map_event_to_notebook(event: dict):
     io = event.get("involvedObject", {})
     if io.get("kind") in ("Pod", "StatefulSet") and io.get("name"):
-        # sts shares the notebook name; pods are <name>-<ordinal>
+        # sts shares the notebook name; pods are <name>-<ordinal>. Only a
+        # decimal ordinal suffix maps back — an unrelated pod "foo-bar" must
+        # NOT trigger reconciles of a notebook "foo" (ref go:703-723 filters
+        # by object, not name surgery).
         name = io["name"]
-        if io["kind"] == "Pod" and "-" in name:
-            name = name.rsplit("-", 1)[0]
+        if io["kind"] == "Pod":
+            if "-" not in name:
+                return
+            name, suffix = name.rsplit("-", 1)
+            if not suffix.isdigit():
+                return
         yield (event.get("metadata", {}).get("namespace", ""), name)

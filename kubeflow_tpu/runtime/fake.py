@@ -465,9 +465,16 @@ class FakeCluster:
 
     def events_for(self, involved: Mapping) -> list[dict]:
         ns = ko.namespace(involved)
-        return [
-            e
-            for e in self.list("Event", ns)
-            if e.get("involvedObject", {}).get("name") == ko.name(involved)
-            and e.get("involvedObject", {}).get("kind") == involved.get("kind")
-        ]
+        uid = involved.get("metadata", {}).get("uid")
+
+        def matches(e: Mapping) -> bool:
+            io = e.get("involvedObject", {})
+            if io.get("name") != ko.name(involved) or io.get("kind") != involved.get("kind"):
+                return False
+            # uid-aware (kubectl describe semantics): events from a previous
+            # incarnation of a recreated object are not "its" events.
+            if uid and io.get("uid") and io["uid"] != uid:
+                return False
+            return True
+
+        return [e for e in self.list("Event", ns) if matches(e)]

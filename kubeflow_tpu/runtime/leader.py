@@ -10,6 +10,7 @@ API server (optimistic-concurrency conflicts on update mean we lost a race).
 from __future__ import annotations
 
 import datetime
+import math
 import logging
 import os
 import socket
@@ -55,6 +56,7 @@ class LeaderElector:
         namespace: str = "kubeflow-system",
         identity: str | None = None,
         lease_duration: float = 15.0,
+        renew_deadline: float | None = None,
         retry_period: float = 2.0,
         clock: Callable[[], float] = time.time,
     ) -> None:
@@ -63,6 +65,20 @@ class LeaderElector:
         self.namespace = namespace
         self.identity = identity or default_identity()
         self.lease_duration = lease_duration
+        # client-go discipline (main.go:84-91 uses its defaults 15s/10s/2s):
+        # a leader that hasn't successfully renewed within renew_deadline
+        # stands down, strictly before the lease can expire for challengers —
+        # the gap absorbs clock skew and the retry-period detection lag.
+        self.renew_deadline = (
+            renew_deadline
+            if renew_deadline is not None
+            else lease_duration * (2.0 / 3.0)
+        )
+        if not (0 < self.renew_deadline < lease_duration):
+            raise ValueError(
+                f"renew_deadline ({self.renew_deadline}) must be positive and "
+                f"strictly less than lease_duration ({lease_duration})"
+            )
         self.retry_period = retry_period
         self.clock = clock
         self.is_leader = False
@@ -126,7 +142,9 @@ class LeaderElector:
             "metadata": {"name": self.name, "namespace": self.namespace},
             "spec": {
                 "holderIdentity": self.identity,
-                "leaseDurationSeconds": int(self.lease_duration),
+                # ceil: the advertised (integer) duration must never undercut
+                # the float the renew_deadline ordering was validated against
+                "leaseDurationSeconds": math.ceil(self.lease_duration),
                 "acquireTime": _format(now),
                 "renewTime": _format(now),
                 "leaseTransitions": 0,
@@ -147,20 +165,27 @@ class LeaderElector:
         behavior — a stale leader must not keep reconciling)."""
         stop = stop or threading.Event()
         was_leader = False
-        last_step_ok = self.clock()
+        last_renew_ok = self.clock()
         while not stop.is_set():
+            # Stamp BEFORE the API call: the lease's renewTime is also taken
+            # before the call, so the stand-down clock and the challengers'
+            # expiry clock start from the same instant.
+            t_step = self.clock()
             try:
                 leading = self.try_acquire_or_renew()
-                last_step_ok = self.clock()
+                if leading:
+                    last_renew_ok = t_step
             except Exception:
                 # Transient API error (connection blip, 5xx): keep retrying —
                 # dying here while workers run would be silent split-brain.
-                # A leader that can't reach the API for a full lease duration
-                # must assume the lease expired and someone else holds it.
+                # A leader that can't renew within renew_deadline must stand
+                # down while the lease is still unexpired for challengers
+                # (renew_deadline < lease_duration guarantees the ordering).
                 log.exception("election step failed for %s", self.name)
                 leading = was_leader and (
-                    self.clock() - last_step_ok < self.lease_duration
+                    self.clock() - last_renew_ok < self.renew_deadline
                 )
+                self.is_leader = leading
             if leading and not was_leader:
                 on_started_leading()
             elif was_leader and not leading:

@@ -100,31 +100,52 @@ def create_app(
         cluster.create(api.profile(name, user.name))
         return success("message", f"Profile {name} created")
 
-    @app.route("/api/workgroup/nuke-self", methods=("POST", "DELETE"))
+    @app.route("/api/workgroup/nuke-self", methods=("DELETE",))
     def nuke_self(request):
         # ref api_workgroup.ts:254-388 "nuke-self": self-serve teardown of the
-        # user's own profile (namespace + RBAC fan into the profile
-        # controller's finalizer-driven cleanup)
+        # user's PRIMARY profile only (namespace == username, ts:329), via
+        # DELETE only. A user who owns additional shared namespaces keeps
+        # them — destroying every owned namespace in one call is not what
+        # "remove my workgroup" means. An explicit ?namespace= targets one
+        # other owned profile.
         user = app.current_user(request)
-        owned = [
-            p for p in cluster.list("Profile")
-            if p.get("spec", {}).get("owner", {}).get("name") == user.name
-        ]
-        if not owned:
-            from werkzeug.exceptions import NotFound
+        body = request.get_json(silent=True) or {}
+        target = request.args.get("namespace") or body.get("namespace")
+        from werkzeug.exceptions import Conflict, Forbidden, NotFound
 
-            raise NotFound(f"{user.name} has no profile to delete.")
-        for p in owned:
-            for b in bindings.list(namespaces=[ko.name(p)]):
-                if b["user"].get("name") == user.name:
-                    # the owner RoleBinding is the profile controller's (its
-                    # own naming scheme) and dies with the profile below
-                    continue
-                bindings.delete(b["user"], ko.name(p), b["roleRef"]["name"])
-            profiles.delete(ko.name(p))
-        return success(
-            "message", f"Deleted {len(owned)} profile(s) for {user.name}"
-        )
+        if not target:
+            # primary = the username-derived name; if the user registered
+            # under a custom namespace (create_workgroup accepts one) and
+            # owns exactly one profile, that one is unambiguous. Several
+            # owned profiles with no explicit target is a 409, never a
+            # delete-them-all.
+            target = user.name.split("@")[0]
+            if cluster.try_get("Profile", target) is None:
+                owned = [
+                    p for p in cluster.list("Profile")
+                    if p.get("spec", {}).get("owner", {}).get("name")
+                    == user.name
+                ]
+                if len(owned) == 1:
+                    target = ko.name(owned[0])
+                elif len(owned) > 1:
+                    raise Conflict(
+                        f"{user.name} owns several profiles; pass "
+                        "?namespace= to pick one."
+                    )
+        profile = cluster.try_get("Profile", target)
+        if profile is None:
+            raise NotFound(f"{user.name} has no profile {target} to delete.")
+        if profile.get("spec", {}).get("owner", {}).get("name") != user.name:
+            raise Forbidden(f"{user.name} does not own profile {target}.")
+        for b in bindings.list(namespaces=[target]):
+            if b["user"].get("name") == user.name:
+                # the owner RoleBinding is the profile controller's (its
+                # own naming scheme) and dies with the profile below
+                continue
+            bindings.delete(b["user"], target, b["roleRef"]["name"])
+        profiles.delete(target)
+        return success("message", f"Deleted profile {target} for {user.name}")
 
     @app.route("/api/namespaces")
     def namespaces(request):

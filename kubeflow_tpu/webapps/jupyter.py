@@ -155,8 +155,10 @@ def create_app(
         "/api/namespaces/<namespace>/notebooks/<name>/pod/<pod>/logs"
     )
     def get_pod_logs(request, namespace, name, pod):
-        # ref: jupyter get.py pod logs route → read_namespaced_pod_log
-        app.ensure(request, "get", "pods", namespace)
+        # ref crud_backend/api/pod.py: authorize the pods/log subresource
+        # (not just pod read) and return only the notebook container's logs —
+        # sidecar (istio-proxy/oauth-proxy) logs must not leak to users.
+        app.ensure(request, "get", "pods/log", namespace)
         pods = cluster.list(
             "Pod", namespace, {"matchLabels": {"notebook-name": name}}
         )
@@ -164,7 +166,7 @@ def create_app(
             from werkzeug.exceptions import NotFound
 
             raise NotFound(f"Pod {pod} is not part of notebook {name}.")
-        text = cluster.pod_logs(pod, namespace)
+        text = cluster.pod_logs(pod, namespace, container=name)
         return success("logs", text.splitlines())
 
     @app.route("/api/namespaces/<namespace>/notebooks/<name>/events")

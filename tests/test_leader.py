@@ -106,3 +106,65 @@ class TestElection:
         t.join(timeout=5)
         assert not t.is_alive()
         assert stopped == [True]
+
+    def test_api_errors_stand_down_at_renew_deadline_not_lease_duration(self):
+        """ADVICE r1: a leader that cannot reach the API must stand down once
+        renew_deadline (default 2/3 of lease_duration) has passed since its
+        last successful renew — strictly before a challenger can acquire at
+        renewTime + lease_duration."""
+        cluster, clock = FakeCluster(), FakeClock()
+        a = make(cluster, "a", clock)
+        assert a.renew_deadline == 10.0  # 2/3 of 15
+
+        started = threading.Event()
+        stop = threading.Event()
+        stopped = []
+
+        def on_stop():
+            stopped.append(clock())
+            stop.set()
+
+        class Dying:
+            """Proxy that starts failing all Lease calls after cutover."""
+
+            def __init__(self, inner):
+                self.inner = inner
+                self.dead = False
+
+            def __getattr__(self, attr):
+                def call(*args, **kwargs):
+                    if self.dead:
+                        raise ConnectionError("apiserver unreachable")
+                    return getattr(self.inner, attr)(*args, **kwargs)
+
+                return call
+
+        a.cluster = Dying(cluster)
+        t = threading.Thread(
+            target=a.run, args=(started.set,),
+            kwargs={"on_stopped_leading": on_stop, "stop": stop},
+            daemon=True,
+        )
+        t.start()
+        assert started.wait(timeout=5)
+        acquired_at = clock()
+        a.cluster.dead = True
+        # before the renew deadline: still leading (no flapping on blips)
+        clock.t = acquired_at + 5.0
+        import time as _t
+        _t.sleep(0.1)
+        assert not stopped
+        # past renew deadline but before lease expiry: MUST have stood down
+        clock.t = acquired_at + a.renew_deadline + 0.5
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert stopped and stopped[0] < acquired_at + a.lease_duration
+
+    def test_renew_deadline_must_be_less_than_lease_duration(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            LeaderElector(
+                FakeCluster(), name="x", identity="a",
+                lease_duration=10.0, renew_deadline=10.0,
+            )

@@ -181,11 +181,17 @@ class TestJupyterApp:
         )
         m.run_until_idle()
         cluster.settle(m)
+        # sidecar logs must not leak (ADVICE r1; ref crud_backend/api/pod.py
+        # passes container=notebook name)
+        cluster.append_pod_log(
+            "nb-0", "alice", "oauth cookie secret", "istio-proxy"
+        )
         r = client.get(
             "/api/namespaces/alice/notebooks/nb/pod/nb-0/logs", headers=ALICE
         )
         logs = get_json_body(r)["logs"]
         assert any("Started container" in line for line in logs)
+        assert not any("oauth cookie secret" in line for line in logs)
         # a pod that isn't part of the notebook is a 404, not a leak
         r = client.get(
             "/api/namespaces/alice/notebooks/nb/pod/other-pod/logs",
@@ -301,14 +307,42 @@ class TestDashboardApp:
         bc = BindingClient(cluster)
         bc.create({"kind": "User", "name": "bob@x.io"}, "alice", "kubeflow-edit")
         client = Client(dashboard.create_app(cluster))
-        r = client.post("/api/workgroup/nuke-self", headers=auth(client))
+        r = client.delete("/api/workgroup/nuke-self", headers=auth(client))
         assert get_json_body(r)["success"]
         m.run_until_idle()
         assert cluster.try_get("Profile", "alice") is None
         assert bc.list(namespaces=["alice"]) == []
         # nothing left to nuke → 404
-        r = client.post("/api/workgroup/nuke-self", headers=auth(client))
+        r = client.delete("/api/workgroup/nuke-self", headers=auth(client))
         assert r.status_code == 404
+
+    def test_nuke_self_is_delete_only_and_scoped_to_primary(self, platform):
+        """ref api_workgroup.ts:329 — DELETE-only, tears down exactly the
+        user's primary profile; other owned (shared) namespaces survive."""
+        cluster, m = platform
+        cluster.create(api.profile("shared-team", "alice@x.io"))
+        client = Client(dashboard.create_app(cluster))
+        # POST must no longer trigger teardown
+        r = client.post("/api/workgroup/nuke-self", headers=auth(client))
+        assert r.status_code == 405
+        r = client.delete("/api/workgroup/nuke-self", headers=auth(client))
+        assert get_json_body(r)["success"]
+        m.run_until_idle()
+        assert cluster.try_get("Profile", "alice") is None
+        assert cluster.try_get("Profile", "shared-team") is not None
+        # explicit namespace targets one owned profile; non-owner forbidden
+        r = client.delete(
+            "/api/workgroup/nuke-self?namespace=shared-team",
+            headers=auth(client, {"kubeflow-userid": "mallory@x.io"}),
+        )
+        assert r.status_code == 403
+        r = client.delete(
+            "/api/workgroup/nuke-self?namespace=shared-team",
+            headers=auth(client),
+        )
+        assert get_json_body(r)["success"]
+        m.run_until_idle()
+        assert cluster.try_get("Profile", "shared-team") is None
 
     def test_env_info_aggregates(self, platform):
         cluster, _ = platform

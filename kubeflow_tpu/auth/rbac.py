@@ -37,6 +37,20 @@ ROLE_ALIASES = {
     "kubeflow-view": "view",
 }
 
+# plural resource -> API group, for SubjectAccessReview ResourceAttributes
+# (the reference's callers pass group/version explicitly per call site,
+# e.g. api/notebook.py:15-17; the web apps here name resources by plural)
+RESOURCE_GROUPS = {
+    "notebooks": "kubeflow.org",
+    "profiles": "kubeflow.org",
+    "poddefaults": "kubeflow.org",
+    "tensorboards": "tensorboard.kubeflow.org",
+    "rolebindings": "rbac.authorization.k8s.io",
+    "authorizationpolicies": "security.istio.io",
+    "virtualservices": "networking.istio.io",
+    # core ("") group: pods, events, persistentvolumeclaims, namespaces, ...
+}
+
 
 class AuthError(Exception):
     status = 401
@@ -64,9 +78,16 @@ def authenticate(headers, *, userid_header: str = USERID_HEADER, userid_prefix: 
 
 
 class Authorizer:
-    """SubjectAccessReview against the cluster's RoleBindings
-    (ref authz.py:46-80 posts a SAR to the API server; here the evaluator and
-    the store live in-process)."""
+    """Per-verb authorization, SubjectAccessReview-first.
+
+    On a real cluster (any client exposing ``subject_access_review``, i.e.
+    ``runtime.kubeclient.KubeClient``) every check is delegated to the API
+    server via a SAR — the only correct answer in the presence of
+    ClusterRoleBindings, aggregated roles, and authz webhooks
+    (ref crud_backend/authz.py:46-80). Against the in-memory FakeCluster the
+    local evaluator below answers from RoleBindings — it implements exactly
+    the subset of RBAC the platform itself emits, which is what tests need.
+    """
 
     def __init__(self, cluster: FakeCluster, *, cluster_admins: set[str] | None = None) -> None:
         self.cluster = cluster
@@ -75,6 +96,18 @@ class Authorizer:
     def allowed(self, user: User, verb: str, resource: str, namespace: str) -> bool:
         if user.name in self.cluster_admins:
             return True
+        sar = getattr(self.cluster, "subject_access_review", None)
+        if sar is not None:
+            plural, _, subresource = resource.partition("/")
+            return sar(
+                user=user.name,
+                groups=user.groups,
+                verb=verb,
+                group=RESOURCE_GROUPS.get(plural.lower(), ""),
+                resource=plural.lower(),
+                subresource=subresource,
+                namespace=namespace,
+            )
         for rb in self.cluster.list("RoleBinding", namespace):
             if not any(self._subject_matches(s, user) for s in rb.get("subjects", [])):
                 continue

@@ -51,6 +51,11 @@ RESOURCES: dict[str, tuple[str, str, str, bool]] = {
     "AuthorizationPolicy": ("apis", "security.istio.io/v1beta1", "authorizationpolicies", True),
     "Route": ("apis", "route.openshift.io/v1", "routes", True),
     "Lease": ("apis", "coordination.k8s.io/v1", "leases", True),
+    # create-only review resource: the web apps' authz path posts these
+    # (ref crud_backend/authz.py:46-80)
+    "SubjectAccessReview": (
+        "apis", "authorization.k8s.io/v1", "subjectaccessreviews", False,
+    ),
 }
 
 
@@ -197,6 +202,46 @@ class KubeClient:
         # real API server completes deletes once finalizers empty; nothing to do
         pass
 
+    # ------------------------------------------------------------------ authz
+
+    def subject_access_review(
+        self,
+        *,
+        user: str,
+        verb: str,
+        resource: str,
+        namespace: str = "",
+        group: str = "",
+        subresource: str = "",
+        groups: tuple[str, ...] = (),
+    ) -> bool:
+        """POST a SubjectAccessReview and return ``status.allowed``.
+
+        This is THE authz primitive on a real cluster: asking the API server
+        answers for ClusterRoleBindings, aggregated roles, webhooks — anything
+        a local RBAC re-implementation would get wrong
+        (ref crud_backend/authz.py:46-80).
+        """
+        sar = {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": user,
+                "groups": list(groups),
+                "resourceAttributes": {
+                    "group": group,
+                    "resource": resource,
+                    "subresource": subresource,
+                    "verb": verb,
+                    "namespace": namespace,
+                },
+            },
+        }
+        out = self._request(
+            "POST", resource_path("SubjectAccessReview"), json=sar
+        )
+        return bool(out.get("status", {}).get("allowed", False))
+
     # ----------------------------------------------------------------- watch
 
     def watch(self, kind: str | None, fn: Callable[[str, dict], None]) -> None:
@@ -273,8 +318,16 @@ class KubeClient:
 
     def events_for(self, involved: Mapping) -> list[dict]:
         ns = ko.namespace(involved)
-        return [
-            e for e in self.list("Event", ns)
-            if e.get("involvedObject", {}).get("name") == ko.name(involved)
-            and e.get("involvedObject", {}).get("kind") == involved.get("kind")
-        ]
+        uid = involved.get("metadata", {}).get("uid")
+
+        def matches(e: Mapping) -> bool:
+            io = e.get("involvedObject", {})
+            if io.get("name") != ko.name(involved) or io.get("kind") != involved.get("kind"):
+                return False
+            # uid-aware (kubectl describe semantics): events from a previous
+            # incarnation of a recreated object are not "its" events.
+            if uid and io.get("uid") and io["uid"] != uid:
+                return False
+            return True
+
+        return [e for e in self.list("Event", ns) if matches(e)]

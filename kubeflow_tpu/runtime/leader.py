@@ -107,6 +107,11 @@ class LeaderElector:
 
         if holder == self.identity:
             spec["renewTime"] = _format(now)
+            # client-go writes LeaseDurationSeconds on every acquire/renew —
+            # a lease inherited from a differently-configured replica must
+            # not advertise a shorter expiry than our renew_deadline ordering
+            # was validated against.
+            spec["leaseDurationSeconds"] = math.ceil(self.lease_duration)
             try:
                 self.cluster.update(lease)
                 self.is_leader = True
@@ -121,6 +126,7 @@ class LeaderElector:
 
         # Expired — challenge.
         spec["holderIdentity"] = self.identity
+        spec["leaseDurationSeconds"] = math.ceil(self.lease_duration)
         spec["acquireTime"] = _format(now)
         spec["renewTime"] = _format(now)
         spec["leaseTransitions"] = int(spec.get("leaseTransitions", 0)) + 1

@@ -114,13 +114,19 @@ def create_app(
         from werkzeug.exceptions import Conflict, Forbidden, NotFound
 
         if not target:
-            # primary = the username-derived name; if the user registered
-            # under a custom namespace (create_workgroup accepts one) and
-            # owns exactly one profile, that one is unambiguous. Several
-            # owned profiles with no explicit target is a 409, never a
-            # delete-them-all.
+            # primary = the username-derived name IF the user owns it; if
+            # they registered under a custom namespace (create_workgroup
+            # accepts one) and own exactly one profile, that one is
+            # unambiguous. Several owned profiles with no explicit target is
+            # a 409, never a delete-them-all.
             target = user.name.split("@")[0]
-            if cluster.try_get("Profile", target) is None:
+            primary = cluster.try_get("Profile", target)
+            primary_owned = bool(
+                primary
+                and primary.get("spec", {}).get("owner", {}).get("name")
+                == user.name
+            )
+            if not primary_owned:
                 owned = [
                     p for p in cluster.list("Profile")
                     if p.get("spec", {}).get("owner", {}).get("name")

@@ -1,0 +1,131 @@
+"""SubjectAccessReview authz on the real-client path.
+
+Reference contract: ``crud_backend/authz.py:46-80`` — web apps never evaluate
+RBAC themselves against a real cluster; they POST a SubjectAccessReview and
+trust ``status.allowed``.
+"""
+import json
+
+import pytest
+
+from kubeflow_tpu.auth.rbac import Authorizer, Forbidden, User
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.runtime.kubeclient import KubeClient
+
+
+class FakeResponse:
+    def __init__(self, status_code=201, body=None, text=""):
+        self.status_code = status_code
+        self._body = body or {}
+        self.text = text or json.dumps(self._body)
+        self.content = self.text.encode()
+
+    def json(self):
+        return self._body
+
+    def raise_for_status(self):
+        if self.status_code >= 400:
+            raise AssertionError(f"HTTP {self.status_code}")
+
+
+class FakeSession:
+    """requests.Session stand-in recording every call."""
+
+    def __init__(self, responder=None):
+        self.calls = []
+        self.headers = {}
+        self.responder = responder or (lambda m, u, **kw: FakeResponse())
+
+    def request(self, method, url, **kw):
+        self.calls.append((method, url, kw))
+        return self.responder(method, url, **kw)
+
+
+def sar_client(allowed=True):
+    session = FakeSession(
+        lambda m, u, **kw: FakeResponse(
+            201, {"status": {"allowed": allowed}}
+        )
+    )
+    client = KubeClient(base_url="https://api:6443", token="t", session=session)
+    return client, session
+
+
+class TestSubjectAccessReview:
+    def test_posts_documented_sar_shape(self):
+        client, session = sar_client(allowed=True)
+        out = client.subject_access_review(
+            user="alice@x.io",
+            verb="create",
+            resource="notebooks",
+            group="kubeflow.org",
+            namespace="alice",
+        )
+        assert out is True
+        method, url, kw = session.calls[-1]
+        assert method == "POST"
+        assert url.endswith(
+            "/apis/authorization.k8s.io/v1/subjectaccessreviews"
+        )
+        body = kw["json"]
+        assert body["kind"] == "SubjectAccessReview"
+        assert body["spec"]["user"] == "alice@x.io"
+        assert body["spec"]["resourceAttributes"] == {
+            "group": "kubeflow.org",
+            "resource": "notebooks",
+            "subresource": "",
+            "verb": "create",
+            "namespace": "alice",
+        }
+
+    def test_denied(self):
+        client, _ = sar_client(allowed=False)
+        assert (
+            client.subject_access_review(
+                user="bob@x.io", verb="delete", resource="pods", namespace="a"
+            )
+            is False
+        )
+
+
+class TestAuthorizerSarMode:
+    def test_real_client_delegates_to_sar(self):
+        client, session = sar_client(allowed=True)
+        authz = Authorizer(client)
+        assert authz.allowed(User("alice@x.io"), "create", "notebooks", "ns1")
+        body = session.calls[-1][2]["json"]
+        ra = body["spec"]["resourceAttributes"]
+        assert ra["group"] == "kubeflow.org"
+        assert ra["resource"] == "notebooks"
+        assert ra["namespace"] == "ns1"
+
+    def test_subresource_split(self):
+        client, session = sar_client(allowed=True)
+        authz = Authorizer(client)
+        assert authz.allowed(User("alice@x.io"), "get", "pods/log", "ns1")
+        ra = session.calls[-1][2]["json"]["spec"]["resourceAttributes"]
+        assert ra == {
+            "group": "",
+            "resource": "pods",
+            "subresource": "log",
+            "verb": "get",
+            "namespace": "ns1",
+        }
+
+    def test_denied_sar_raises_forbidden_via_ensure(self):
+        client, _ = sar_client(allowed=False)
+        authz = Authorizer(client)
+        with pytest.raises(Forbidden):
+            authz.ensure(User("bob@x.io"), "delete", "notebooks", "ns1")
+
+    def test_cluster_admin_short_circuits_sar(self):
+        client, session = sar_client(allowed=False)
+        authz = Authorizer(client, cluster_admins={"root@x.io"})
+        assert authz.allowed(User("root@x.io"), "delete", "profiles", "")
+        assert session.calls == []  # no SAR posted
+
+    def test_fake_cluster_uses_local_evaluator(self):
+        cluster = FakeCluster()
+        authz = Authorizer(cluster)
+        # no RoleBindings -> denied, and no AttributeError from SAR path
+        assert not authz.allowed(User("alice@x.io"), "get", "notebooks", "ns")

@@ -54,11 +54,40 @@ def crd(
     versions: list[tuple[str, bool, dict]],
     scope: str = "Namespaced",
     short_names: list[str] | None = None,
+    conversion_webhook: bool = False,
 ) -> dict:
+    conversion = (
+        {
+            "conversion": {
+                "strategy": "Webhook",
+                "webhook": {
+                    "conversionReviewVersions": ["v1"],
+                    # no explicit port: the Service exposes 443 (targetPort
+                    # https=8443), matching the admission webhook configs
+                    "clientConfig": {
+                        "service": {
+                            "name": "kubeflow-tpu-webhook",
+                            "namespace": "kubeflow",
+                            "path": "/convert",
+                        }
+                    },
+                },
+            }
+        }
+        if conversion_webhook
+        else {}
+    )
+    metadata: dict = {"name": f"{plural}.{group}"}
+    if conversion_webhook:
+        # apiserver must trust the webhook cert, same injection as the
+        # MutatingWebhookConfiguration (manifests/base/webhook.yaml:43)
+        metadata["annotations"] = {
+            "cert-manager.io/inject-ca-from": "kubeflow/kubeflow-tpu-webhook-cert"
+        }
     return {
         "apiVersion": "apiextensions.k8s.io/v1",
         "kind": "CustomResourceDefinition",
-        "metadata": {"name": f"{plural}.{group}"},
+        "metadata": metadata,
         "spec": {
             "group": group,
             "scope": scope,
@@ -79,6 +108,7 @@ def crd(
                 }
                 for name, storage, schema in versions
             ],
+            **conversion,
         },
     }
 
@@ -103,8 +133,9 @@ def notebook_crd() -> dict:
         }
     )
     # v1alpha1/v1beta1/v1 mirror the reference's served versions
-    # (notebook-controller/api/{v1alpha1,v1beta1,v1}); identical schemas here,
-    # conversion is a no-op passthrough.
+    # (notebook-controller/api/{v1alpha1,v1beta1,v1}); structurally identical
+    # (as in the reference), converted by the /convert webhook
+    # (webhooks/conversion.py, ref notebook_conversion.go).
     return crd(
         group="kubeflow.org",
         kind="Notebook",
@@ -115,6 +146,7 @@ def notebook_crd() -> dict:
             ("v1", False, schema),
         ],
         short_names=["nb"],
+        conversion_webhook=True,
     )
 
 

@@ -7,6 +7,8 @@ matching ``manifests/base/webhook.yaml``:
 
   /apply-poddefault   PodDefault merge (webhooks/poddefaults.py)
   /inject-tpu-env     TPU worker identity (webhooks/tpu_env.py)
+  /convert            CRD multi-version ConversionReview
+                      (webhooks/conversion.py; ref notebook_conversion.go)
 """
 from __future__ import annotations
 
@@ -53,6 +55,17 @@ def make_wsgi_app(cluster):
 
     def handle(environ, start_response):
         request = Request(environ)
+        if request.path == "/convert":
+            from kubeflow_tpu.webhooks import conversion
+
+            try:
+                review = request.get_json()
+            except Exception:
+                resp = Response("bad ConversionReview", status=400)
+                return resp(environ, start_response)
+            body = json.dumps(conversion.convert_review(review or {}))
+            resp = Response(body, mimetype="application/json")
+            return resp(environ, start_response)
         try:
             review = request.get_json()
             obj = review["request"]["object"]

@@ -59,9 +59,20 @@ RESOURCES: dict[str, tuple[str, str, str, bool]] = {
 }
 
 
-def resource_path(kind: str, namespace: str | None = None, name: str | None = None) -> str:
-    """API path for a kind (exported for tests)."""
+def resource_path(
+    kind: str,
+    namespace: str | None = None,
+    name: str | None = None,
+    *,
+    api_version: str | None = None,
+) -> str:
+    """API path for a kind (exported for tests). ``api_version`` overrides
+    the default group/version — dynamic-client behavior for multi-version
+    CRDs (a kubeflow.org/v1 Notebook goes to the v1 endpoint)."""
     prefix, gv, plural, namespaced = RESOURCES[kind]
+    if api_version:
+        gv = api_version
+        prefix = "api" if "/" not in api_version else "apis"
     parts = [prefix, gv]
     if namespaced and namespace:
         parts += ["namespaces", namespace]
@@ -121,7 +132,11 @@ class KubeClient:
     def create(self, obj: Mapping) -> dict:
         kind = obj["kind"]
         return self._request(
-            "POST", resource_path(kind, ko.namespace(obj)), json=dict(obj)
+            "POST",
+            resource_path(
+                kind, ko.namespace(obj), api_version=obj.get("apiVersion")
+            ),
+            json=dict(obj),
         )
 
     def get(self, kind: str, name: str, namespace: str = "") -> dict:
@@ -173,7 +188,10 @@ class KubeClient:
         kind = obj["kind"]
         return self._request(
             "PUT",
-            resource_path(kind, ko.namespace(obj), ko.name(obj)),
+            resource_path(
+                kind, ko.namespace(obj), ko.name(obj),
+                api_version=obj.get("apiVersion"),
+            ),
             json=dict(obj),
         )
 
@@ -183,7 +201,10 @@ class KubeClient:
         kind = obj["kind"]
         return self._request(
             "PUT",
-            resource_path(kind, ko.namespace(obj), ko.name(obj)) + "/status",
+            resource_path(
+                kind, ko.namespace(obj), ko.name(obj),
+                api_version=obj.get("apiVersion"),
+            ) + "/status",
             json=dict(obj),
         )
 

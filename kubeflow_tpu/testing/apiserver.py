@@ -207,6 +207,12 @@ def merge_patch(target, patch):
     return out
 
 
+def _rewrite_api_version(obj: dict, desired: str) -> dict:
+    out = dict(obj)  # only the top-level apiVersion key changes
+    out["apiVersion"] = desired
+    return out
+
+
 class _Status(Exception):
     """HTTP error carrying a Kubernetes Status body."""
 
@@ -231,10 +237,16 @@ class APIServer:
         crd_dir: Path | str = CRD_DIR,
         *,
         sar_policy: Callable[[dict], bool] | None = None,
+        converter: Callable[[dict, str], dict] | None = None,
         gc_interval: float = 0.02,
     ) -> None:
         self.registry = CRDRegistry(crd_dir)
         self.sar_policy = sar_policy or (lambda spec: True)
+        # Multi-version CRDs: objects persist at the storage version and are
+        # converted to the requested version on the way out — on a real
+        # cluster this call goes to the CRD's conversion webhook. Default is
+        # the apiVersion rewrite (the "None" conversion strategy).
+        self.converter = converter or _rewrite_api_version
         self._lock = threading.RLock()
         self._revision = 0
         # (plural, namespace, name) -> object
@@ -354,7 +366,9 @@ class APIServer:
         body = self._read_body(handler)
 
         if method == "GET" and params.get("watch") == "true":
-            return self._serve_watch(handler, plural, namespace, params)
+            return self._serve_watch(
+                handler, info, plural, group, version, namespace, params
+            )
         if subresource == "log" and plural == "pods":
             return self._serve_log(handler, namespace, name, params)
         if plural == "subjectaccessreviews" and method == "POST":
@@ -362,19 +376,23 @@ class APIServer:
 
         with self._lock:
             if method == "POST":
-                out = self._create(info, plural, version, namespace, body)
+                out = self._create(info, plural, group, version, namespace, body)
             elif method == "GET" and name:
-                out = self._get(plural, namespace, name)
+                out = self._out_version(
+                    info, group, version, self._get(plural, namespace, name)
+                )
             elif method == "GET":
-                out = self._list(info, plural, namespace, params)
+                out = self._list(info, plural, group, version, namespace, params)
             elif method == "PUT":
                 out = self._update(
-                    info, plural, version, namespace, name, body, subresource
+                    info, plural, group, version, namespace, name, body,
+                    subresource,
                 )
             elif method == "PATCH":
                 ct = handler.headers.get("Content-Type", "")
                 out = self._patch(
-                    info, plural, version, namespace, name, body, ct, subresource
+                    info, plural, group, version, namespace, name, body, ct,
+                    subresource,
                 )
             elif method == "DELETE":
                 out = self._delete(plural, namespace, name)
@@ -431,7 +449,7 @@ class APIServer:
             return sub.get(version, False)
         return bool(sub)
 
-    def _create(self, info, plural, version, namespace, body) -> dict:
+    def _create(self, info, plural, group, version, namespace, body) -> dict:
         if body is None:
             raise _Status(400, "BadRequest", "missing body")
         name = body.get("metadata", {}).get("name")
@@ -462,8 +480,9 @@ class APIServer:
         meta["generation"] = 1
         if self._has_status_sub(info, version):
             obj.pop("status", None)  # status only writable via the subresource
+        obj = self._storage_version(info, group, obj)
         self._commit("ADDED", plural, key, obj)
-        return copy.deepcopy(obj)
+        return self._out_version(info, group, version, copy.deepcopy(obj))
 
     def _get(self, plural, namespace, name) -> dict:
         obj = self._objects.get((plural, namespace, name))
@@ -471,7 +490,7 @@ class APIServer:
             raise _Status(404, "NotFound", f"{plural} {namespace}/{name} not found")
         return copy.deepcopy(obj)
 
-    def _list(self, info, plural, namespace, params) -> dict:
+    def _list(self, info, plural, group, version, namespace, params) -> dict:
         sel = {}
         for pair in (params.get("labelSelector") or "").split(","):
             if "=" in pair:
@@ -485,7 +504,9 @@ class APIServer:
                 continue
             labels = obj.get("metadata", {}).get("labels", {})
             if all(labels.get(k) == v for k, v in sel.items()):
-                items.append(copy.deepcopy(obj))
+                items.append(
+                    self._out_version(info, group, version, copy.deepcopy(obj))
+                )
         return {
             "apiVersion": "v1",
             "kind": f"{info['kind']}List",
@@ -494,7 +515,7 @@ class APIServer:
         }
 
     def _update(
-        self, info, plural, version, namespace, name, body, subresource
+        self, info, plural, group, version, namespace, name, body, subresource
     ) -> dict:
         if body is None:
             raise _Status(400, "BadRequest", "missing body")
@@ -544,11 +565,30 @@ class APIServer:
             return copy.deepcopy(obj)
         if current["metadata"].get("deletionTimestamp"):
             meta["deletionTimestamp"] = current["metadata"]["deletionTimestamp"]
+        obj = self._storage_version(info, group, obj)
         self._commit("MODIFIED", plural, key, obj)
-        return copy.deepcopy(obj)
+        return self._out_version(info, group, version, copy.deepcopy(obj))
+
+    def _storage_version(self, info, group, obj) -> dict:
+        """Convert an incoming CR to its storage version (webhook call on a
+        real cluster)."""
+        if not info.get("crd"):
+            return obj
+        desired = f"{group}/{info['storage']}" if group else info["storage"]
+        return self.converter(obj, desired)
+
+    def _out_version(self, info, group, version, obj) -> dict:
+        """Convert a stored CR to the request's version on the way out."""
+        if not info.get("crd") or obj is None:
+            return obj
+        desired = f"{group}/{version}" if group else version
+        if obj.get("apiVersion") == desired:
+            return obj
+        return self.converter(obj, desired)
 
     def _patch(
-        self, info, plural, version, namespace, name, body, content_type, subresource
+        self, info, plural, group, version, namespace, name, body, content_type,
+        subresource,
     ) -> dict:
         if "merge-patch" not in content_type and "strategic-merge" not in content_type:
             raise _Status(
@@ -566,7 +606,7 @@ class APIServer:
             "resourceVersion"
         ]
         return self._update(
-            info, plural, version, namespace, name, patched, subresource
+            info, plural, group, version, namespace, name, patched, subresource
         )
 
     def _delete(self, plural, namespace, name) -> dict:
@@ -601,7 +641,9 @@ class APIServer:
 
     # --------------------------------------------------------------- watch
 
-    def _serve_watch(self, handler, plural, namespace, params) -> None:
+    def _serve_watch(
+        self, handler, info, plural, group, version, namespace, params
+    ) -> None:
         since = int(params.get("resourceVersion") or 0)
         handler.send_response(200)
         handler.send_header("Content-Type", "application/json")
@@ -624,6 +666,9 @@ class APIServer:
                         break
                     self._watch_cond.wait(timeout=1.0)
             for rev, ev, obj in batch:
+                # watch events are converted to the request's version, like
+                # every other read path
+                obj = self._out_version(info, group, version, obj)
                 line = (json.dumps({"type": ev, "object": obj}) + "\n").encode()
                 chunk = b"%x\r\n%s\r\n" % (len(line), line)
                 try:

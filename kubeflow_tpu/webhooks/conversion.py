@@ -1,0 +1,69 @@
+"""CRD multi-version conversion (ConversionReview webhook).
+
+Reference parity: the Notebook CRD serves v1alpha1/v1beta1/v1 and the
+controller registers all three in its scheme with conversion between them
+(``notebook-controller/api/v1beta1/notebook_conversion.go``,
+``main.go:46-54``). In the reference — as here — the versions are
+structurally identical, so conversion is the hub-and-spoke boilerplate: the
+object is passed through unchanged except for ``apiVersion``, with a
+transform table for the day a version actually diverges.
+
+The handler implements the apiextensions.k8s.io/v1 ConversionReview protocol
+the API server speaks to conversion webhooks:
+
+    request:  {uid, desiredAPIVersion, objects: [...]}
+    response: {uid, result: {status}, convertedObjects: [...]}
+"""
+from __future__ import annotations
+
+import copy
+from typing import Callable
+
+# (kind, from_version, to_version) -> transform(obj) -> obj.
+# Versions here are the bare version (e.g. "v1alpha1"), group-agnostic.
+# Structural divergence between served versions registers here; identity
+# (apiVersion rewrite only) is the default, as in the reference's generated
+# ConvertTo/ConvertFrom bodies.
+TRANSFORMS: dict[tuple[str, str, str], Callable[[dict], dict]] = {}
+
+
+def convert_object(obj: dict, desired_api_version: str) -> dict:
+    """Convert one object to ``desired_api_version`` (e.g. kubeflow.org/v1)."""
+    out = copy.deepcopy(obj)
+    current = out.get("apiVersion", "")
+    if current == desired_api_version:
+        return out
+    kind = out.get("kind", "")
+    from_v = current.rsplit("/", 1)[-1]
+    to_v = desired_api_version.rsplit("/", 1)[-1]
+    transform = TRANSFORMS.get((kind, from_v, to_v))
+    if transform is not None:
+        out = transform(out)
+    out["apiVersion"] = desired_api_version
+    return out
+
+
+def convert_review(review: dict) -> dict:
+    """Handle a ConversionReview; returns the full response envelope."""
+    request = review.get("request", {})
+    uid = request.get("uid", "")
+    desired = request.get("desiredAPIVersion", "")
+    try:
+        converted = [
+            convert_object(o, desired) for o in request.get("objects", [])
+        ]
+        response = {
+            "uid": uid,
+            "result": {"status": "Success"},
+            "convertedObjects": converted,
+        }
+    except Exception as e:  # a failed conversion must be a clean Failure
+        response = {
+            "uid": uid,
+            "result": {"status": "Failure", "message": str(e)},
+        }
+    return {
+        "apiVersion": review.get("apiVersion", "apiextensions.k8s.io/v1"),
+        "kind": "ConversionReview",
+        "response": response,
+    }

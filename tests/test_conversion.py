@@ -1,0 +1,126 @@
+"""Multi-version Notebook API + conversion webhook.
+
+Reference: Notebook served at v1alpha1/v1beta1/v1 with conversion
+(``api/v1beta1/notebook_conversion.go``, ``main.go:46-54``). Done-criterion
+(VERDICT r1 #5): a v1 CR created via the webhook-converted path is reconciled
+identically to v1beta1.
+"""
+import json
+import time
+
+import pytest
+from werkzeug.test import Client
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cmd.webhook import make_wsgi_app
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.runtime.kubeclient import KubeClient
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.testing.apiserver import APIServer
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhooks import conversion
+
+
+class TestConversionReviewProtocol:
+    def review(self, objects, desired):
+        return {
+            "apiVersion": "apiextensions.k8s.io/v1",
+            "kind": "ConversionReview",
+            "request": {
+                "uid": "u1",
+                "desiredAPIVersion": desired,
+                "objects": objects,
+            },
+        }
+
+    def test_round_trip_is_lossless(self):
+        nb = api.notebook(
+            "nb1", "team-a", tpu_accelerator="v4", tpu_topology="2x2x2"
+        )
+        assert nb["apiVersion"] == "kubeflow.org/v1beta1"
+        to_v1 = conversion.convert_review(
+            self.review([nb], "kubeflow.org/v1")
+        )
+        assert to_v1["response"]["result"]["status"] == "Success"
+        assert to_v1["response"]["uid"] == "u1"
+        [v1_obj] = to_v1["response"]["convertedObjects"]
+        assert v1_obj["apiVersion"] == "kubeflow.org/v1"
+
+        back = conversion.convert_review(
+            self.review([v1_obj], "kubeflow.org/v1beta1")
+        )
+        [round_tripped] = back["response"]["convertedObjects"]
+        assert round_tripped == nb
+
+    def test_all_served_versions_convert(self):
+        nb = api.notebook("nb1", "team-a")
+        for desired in (
+            "kubeflow.org/v1alpha1",
+            "kubeflow.org/v1beta1",
+            "kubeflow.org/v1",
+        ):
+            out = conversion.convert_object(nb, desired)
+            assert out["apiVersion"] == desired
+            assert out["spec"] == nb["spec"]
+
+    def test_webhook_endpoint_serves_convert(self):
+        client = Client(make_wsgi_app(FakeCluster()))
+        nb = api.notebook("nb1", "team-a")
+        r = client.post(
+            "/convert", json=self.review([nb], "kubeflow.org/v1")
+        )
+        body = json.loads(r.get_data(as_text=True))
+        assert body["kind"] == "ConversionReview"
+        assert (
+            body["response"]["convertedObjects"][0]["apiVersion"]
+            == "kubeflow.org/v1"
+        )
+
+
+class TestMultiVersionEndToEnd:
+    """v1-created CR reconciled identically to v1beta1, through the
+    conformance apiserver wired to the product converter (the real
+    apiserver->conversion-webhook dance)."""
+
+    @pytest.fixture()
+    def env(self):
+        server = APIServer(converter=conversion.convert_object)
+        base = server.start()
+        client = KubeClient(base_url=base, token="t")
+        yield server, client
+        client.stop()
+        server.stop()
+
+    def test_v1_create_reconciles_like_v1beta1(self, env):
+        _, client = env
+        m = Manager(client, clock=time.time)
+        m.register(NotebookReconciler(ControllerConfig()))
+
+        v1 = api.notebook("nb-v1", "team-a")
+        v1["apiVersion"] = "kubeflow.org/v1"
+        client.create(v1)  # dynamic-client path: POSTs to the v1 endpoint
+        client.create(api.notebook("nb-beta", "team-a"))
+
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            m.tick()
+            a = client.try_get("StatefulSet", "nb-v1", "team-a")
+            b = client.try_get("StatefulSet", "nb-beta", "team-a")
+            if a and b:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("statefulsets not created")
+
+        # identical reconciliation modulo the name-derived fields
+        def normalize(sts, name):
+            spec = json.dumps(sts["spec"]).replace(name, "NAME")
+            return json.loads(spec)
+
+        assert normalize(a, "nb-v1") == normalize(b, "nb-beta")
+
+        # the v1beta1 watch/read path (the controller's view) serves the
+        # v1-created object converted to v1beta1
+        nb = client.get("Notebook", "nb-v1", "team-a")
+        assert nb["apiVersion"] == "kubeflow.org/v1beta1"

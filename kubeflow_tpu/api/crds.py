@@ -34,6 +34,14 @@ _TPU_SPEC = _obj(
             "description": "Chip torus shape, e.g. 2x2x2 (v4/v5p) or 2x4 (v5e/v6e). "
             "Must tile onto whole hosts; one pod per host is created.",
         },
+        "numSlices": {
+            "type": "integer",
+            "minimum": 1,
+            "default": 1,
+            "description": "Multislice degree: N identical slices joined over "
+            "the data-center network (MEGASCALE_* env injected per pod; one "
+            "StatefulSet per slice).",
+        },
     },
     required=["accelerator", "topology"],
     description="First-class TPU slice request. Drives StatefulSet replicas, "

@@ -45,6 +45,7 @@ def notebook(
     memory: str = "1Gi",
     tpu_accelerator: str | None = None,
     tpu_topology: str | None = None,
+    tpu_num_slices: int = 1,
     env: list | None = None,
     volumes: list | None = None,
     volume_mounts: list | None = None,
@@ -73,6 +74,9 @@ def notebook(
             raise ValueError("spec.tpu requires both accelerator and topology")
         parse_topology(tpu_accelerator, tpu_topology)  # validate early
         spec["tpu"] = {"accelerator": tpu_accelerator, "topology": tpu_topology}
+        if tpu_num_slices > 1:
+            # multislice: N identical slices joined over DCN (MEGASCALE)
+            spec["tpu"]["numSlices"] = int(tpu_num_slices)
     return {
         "apiVersion": NOTEBOOK_API_VERSION,
         "kind": "Notebook",
@@ -92,6 +96,12 @@ def notebook_topology(nb: Mapping) -> SliceTopology | None:
     if not tpu:
         return None
     return parse_topology(tpu.get("accelerator", ""), tpu.get("topology", ""))
+
+
+def notebook_num_slices(nb: Mapping) -> int:
+    """Requested multislice degree (1 = a single slice, the default)."""
+    tpu = nb.get("spec", {}).get("tpu") or {}
+    return max(1, int(tpu.get("numSlices", 1)))
 
 
 def validate_notebook(nb: Mapping) -> list[str]:

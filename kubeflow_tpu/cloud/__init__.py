@@ -1,0 +1,1 @@
+"""Cloud IAM clients (plain REST, no SDKs — matching the repo's stance)."""

@@ -1,0 +1,215 @@
+"""AWS IAM client for IRSA trust-policy maintenance (plain REST + SigV4).
+
+Reference behavior: ``profile-controller/controllers/plugin_iam.go:35-260``
+edits the IAM role's AssumeRolePolicyDocument so the namespace KSA
+(``system:serviceaccount:<ns>:<sa>``) may assume it via the cluster's OIDC
+provider, using aws-sdk-go. No SDK here: the IAM Query API
+(``Action=GetRole`` / ``Action=UpdateAssumeRolePolicy``) is called directly
+with AWS Signature Version 4 request signing (the documented public
+algorithm — HMAC chain over date/region/service).
+
+Credentials come from the standard env variables (or are injected for
+tests); region is irrelevant for IAM (global, us-east-1 signing scope).
+"""
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import json
+import os
+import urllib.parse
+
+try:
+    import requests
+except ImportError:  # pragma: no cover
+    requests = None
+
+IAM_ENDPOINT = "https://iam.amazonaws.com/"
+API_VERSION = "2010-05-08"
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sign_v4(
+    *,
+    method: str,
+    url: str,
+    body: str,
+    access_key: str,
+    secret_key: str,
+    session_token: str | None = None,
+    region: str = "us-east-1",
+    service: str = "iam",
+    now: datetime.datetime | None = None,
+) -> dict:
+    """AWS Signature Version 4 headers for a request (documented algorithm)."""
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    parsed = urllib.parse.urlparse(url)
+    host = parsed.netloc
+    payload_hash = hashlib.sha256(body.encode()).hexdigest()
+
+    headers = {
+        "host": host,
+        "x-amz-date": amz_date,
+        "content-type": "application/x-www-form-urlencoded; charset=utf-8",
+    }
+    if session_token:
+        headers["x-amz-security-token"] = session_token
+    signed_headers = ";".join(sorted(headers))
+    canonical_headers = "".join(
+        f"{k}:{headers[k].strip()}\n" for k in sorted(headers)
+    )
+    canonical_request = "\n".join(
+        [
+            method,
+            parsed.path or "/",
+            parsed.query,
+            canonical_headers,
+            signed_headers,
+            payload_hash,
+        ]
+    )
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            hashlib.sha256(canonical_request.encode()).hexdigest(),
+        ]
+    )
+    key = _hmac(
+        _hmac(
+            _hmac(_hmac(f"AWS4{secret_key}".encode(), datestamp), region),
+            service,
+        ),
+        "aws4_request",
+    )
+    signature = hmac.new(key, string_to_sign.encode(), hashlib.sha256).hexdigest()
+    headers["authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_headers}, Signature={signature}"
+    )
+    return headers
+
+
+class AwsIamClient:
+    """``IamClient`` over the AWS IAM Query API.
+
+    ``resource`` is the IAM role name (or ARN — the trailing name is used);
+    ``member`` the KSA subject ``system:serviceaccount:<ns>:<sa>``. The
+    ``role`` argument (an action like sts:AssumeRoleWithWebIdentity) names
+    the statement action, matching the reference's trust-policy statements.
+    """
+
+    def __init__(
+        self,
+        *,
+        oidc_provider_arn: str | None = None,
+        session=None,
+        access_key: str | None = None,
+        secret_key: str | None = None,
+        session_token: str | None = None,
+        endpoint: str = IAM_ENDPOINT,
+    ) -> None:
+        self.oidc_provider_arn = oidc_provider_arn or os.environ.get(
+            "AWS_OIDC_PROVIDER_ARN", ""
+        )
+        self.session = session or requests.Session()
+        self.access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
+        self.secret_key = secret_key or os.environ.get(
+            "AWS_SECRET_ACCESS_KEY", ""
+        )
+        self.session_token = session_token or os.environ.get(
+            "AWS_SESSION_TOKEN"
+        )
+        self.endpoint = endpoint
+
+    # ------------------------------------------------------------------ http
+
+    def _call(self, action: str, params: dict) -> dict:
+        body = urllib.parse.urlencode(
+            {"Action": action, "Version": API_VERSION, **params}
+        )
+        headers = sign_v4(
+            method="POST",
+            url=self.endpoint,
+            body=body,
+            access_key=self.access_key,
+            secret_key=self.secret_key,
+            session_token=self.session_token,
+        )
+        headers["Accept"] = "application/json"
+        resp = self.session.post(
+            self.endpoint, data=body, headers=headers, timeout=30
+        )
+        resp.raise_for_status()
+        return resp.json() if resp.content else {}
+
+    @staticmethod
+    def _role_name(resource: str) -> str:
+        return resource.rsplit("/", 1)[-1]
+
+    def _get_trust_policy(self, role_name: str) -> dict:
+        out = self._call("GetRole", {"RoleName": role_name})
+        doc = (
+            out.get("GetRoleResponse", {})
+            .get("GetRoleResult", {})
+            .get("Role", {})
+            .get("AssumeRolePolicyDocument", "")
+        )
+        if not doc:
+            return {"Version": "2012-10-17", "Statement": []}
+        return json.loads(urllib.parse.unquote(doc))
+
+    def _update_trust_policy(self, role_name: str, policy: dict) -> None:
+        self._call(
+            "UpdateAssumeRolePolicy",
+            {
+                "RoleName": role_name,
+                "PolicyDocument": json.dumps(policy),
+            },
+        )
+
+    # ------------------------------------------------------------ IamClient
+
+    def _statement(self, action: str, member: str) -> dict:
+        # ref plugin_iam.go: one statement per KSA subject, keyed by the OIDC
+        # provider's :sub condition
+        sub_key = (
+            self.oidc_provider_arn.split("oidc-provider/")[-1] + ":sub"
+            if self.oidc_provider_arn
+            else "oidc:sub"
+        )
+        return {
+            "Effect": "Allow",
+            "Principal": {"Federated": self.oidc_provider_arn},
+            "Action": action,
+            "Condition": {"StringEquals": {sub_key: member}},
+        }
+
+    def add_binding(self, resource: str, role: str, member: str) -> None:
+        name = self._role_name(resource)
+        policy = self._get_trust_policy(name)
+        statements = policy.setdefault("Statement", [])
+        wanted = self._statement(role, member)
+        if any(s == wanted for s in statements):
+            return  # idempotent
+        statements.append(wanted)
+        self._update_trust_policy(name, policy)
+
+    def remove_binding(self, resource: str, role: str, member: str) -> None:
+        name = self._role_name(resource)
+        policy = self._get_trust_policy(name)
+        statements = policy.get("Statement", [])
+        wanted = self._statement(role, member)
+        remaining = [s for s in statements if s != wanted]
+        if len(remaining) == len(statements):
+            return  # idempotent
+        policy["Statement"] = remaining
+        self._update_trust_policy(name, policy)

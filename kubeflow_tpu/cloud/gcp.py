@@ -1,0 +1,136 @@
+"""GCP IAM client for Workload Identity bindings (plain REST).
+
+Reference behavior: ``profile-controller/controllers/plugin_workload_identity.go:85-160``
+read-modify-writes the target service account's IAM policy through
+google.golang.org/api/iam, granting ``roles/iam.workloadIdentityUser`` to the
+namespace KSA member. Same protocol here over the documented REST surface:
+
+    POST /v1/projects/-/serviceAccounts/{email}:getIamPolicy
+    POST /v1/projects/-/serviceAccounts/{email}:setIamPolicy
+
+setIamPolicy is guarded by the policy ``etag``: a concurrent modification
+makes the write fail (409/412), and the client re-reads and retries — the
+same optimistic-concurrency dance the controllers speak to the K8s API.
+
+Auth: a bearer token from the injectable ``token_provider``; the default
+asks the GCE/GKE metadata server (the in-cluster ambient identity — no key
+files, which is the entire point of Workload Identity).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+try:
+    import requests
+except ImportError:  # pragma: no cover
+    requests = None
+
+IAM_BASE = "https://iam.googleapis.com/v1"
+METADATA_TOKEN_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/"
+    "instance/service-accounts/default/token"
+)
+
+
+def metadata_token_provider(session=None) -> Callable[[], str]:
+    """Bearer tokens from the GCE metadata server, cached until near-expiry."""
+    state = {"token": None, "expires": 0.0}
+    http = session or requests.Session()
+
+    def provide() -> str:
+        if state["token"] is None or time.time() > state["expires"] - 60:
+            resp = http.get(
+                METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"},
+                timeout=10,
+            )
+            resp.raise_for_status()
+            body = resp.json()
+            state["token"] = body["access_token"]
+            state["expires"] = time.time() + float(body.get("expires_in", 300))
+        return state["token"]
+
+    return provide
+
+
+class GcpIamClient:
+    """``IamClient`` over the GCP IAM REST API.
+
+    ``resource`` is the target GCP service-account email; ``member`` the
+    Workload Identity principal
+    ``serviceAccount:<project>.svc.id.goog[<ns>/<ksa>]``.
+    """
+
+    def __init__(
+        self,
+        *,
+        session=None,
+        token_provider: Callable[[], str] | None = None,
+        base_url: str = IAM_BASE,
+        max_retries: int = 4,
+    ) -> None:
+        self.session = session or requests.Session()
+        self.token = token_provider or metadata_token_provider(self.session)
+        self.base_url = base_url.rstrip("/")
+        self.max_retries = max_retries
+
+    # ------------------------------------------------------------------ http
+
+    def _post(self, path: str, body: dict) -> requests.Response:
+        return self.session.post(
+            f"{self.base_url}{path}",
+            json=body,
+            headers={"Authorization": f"Bearer {self.token()}"},
+            timeout=30,
+        )
+
+    def _get_policy(self, email: str) -> dict:
+        resp = self._post(
+            f"/projects/-/serviceAccounts/{email}:getIamPolicy", {}
+        )
+        resp.raise_for_status()
+        return resp.json()
+
+    def _set_policy(self, email: str, policy: dict) -> requests.Response:
+        return self._post(
+            f"/projects/-/serviceAccounts/{email}:setIamPolicy",
+            {"policy": policy},
+        )
+
+    # ------------------------------------------------------------ IamClient
+
+    def add_binding(self, resource: str, role: str, member: str) -> None:
+        self._modify(resource, role, member, add=True)
+
+    def remove_binding(self, resource: str, role: str, member: str) -> None:
+        self._modify(resource, role, member, add=False)
+
+    def _modify(self, email: str, role: str, member: str, *, add: bool) -> None:
+        for attempt in range(self.max_retries):
+            policy = self._get_policy(email)
+            bindings = policy.setdefault("bindings", [])
+            binding = next(
+                (b for b in bindings if b.get("role") == role), None
+            )
+            if add:
+                if binding is None:
+                    binding = {"role": role, "members": []}
+                    bindings.append(binding)
+                if member in binding.setdefault("members", []):
+                    return  # idempotent
+                binding["members"].append(member)
+            else:
+                if binding is None or member not in binding.get("members", []):
+                    return  # idempotent
+                binding["members"].remove(member)
+                if not binding["members"]:
+                    bindings.remove(binding)
+            resp = self._set_policy(email, policy)
+            if resp.status_code in (409, 412):  # stale etag: re-read, retry
+                continue
+            resp.raise_for_status()
+            return
+        raise RuntimeError(
+            f"setIamPolicy on {email} kept conflicting after "
+            f"{self.max_retries} retries"
+        )

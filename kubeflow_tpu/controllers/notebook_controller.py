@@ -72,18 +72,27 @@ class NotebookReconciler(Reconciler):
             return None  # deleted; GC cascades owned objects
 
         topo = api.notebook_topology(nb)
+        num_slices = api.notebook_num_slices(nb) if topo is not None else 1
 
-        sts = self.generate_statefulset(nb, topo)
-        helper.reconcile_object(
-            cluster, sts, owner=nb, copy_fields=helper.copy_statefulset_fields
-        )
+        desired_stses = self.generate_statefulsets(nb, topo, num_slices)
+        for sts in desired_stses:
+            helper.reconcile_object(
+                cluster, sts, owner=nb,
+                copy_fields=helper.copy_statefulset_fields,
+            )
+        # scale changes (numSlices edited, multislice toggled) must reap the
+        # gangs no longer desired — their pods hold a stale DCN contract
+        desired_names = {ko.name(sts) for sts in desired_stses}
+        for sts in self._owned_statefulsets(cluster, name, namespace):
+            if ko.name(sts) not in desired_names:
+                cluster.delete("StatefulSet", ko.name(sts), namespace)
         helper.reconcile_object(
             cluster,
-            self.generate_service(nb),
+            self.generate_service(nb, num_slices),
             owner=nb,
             copy_fields=helper.copy_service_fields,
         )
-        if topo is not None and topo.is_multi_host:
+        if topo is not None and (topo.is_multi_host or num_slices > 1):
             helper.reconcile_object(
                 cluster,
                 self.generate_headless_service(nb, topo),
@@ -96,7 +105,7 @@ class NotebookReconciler(Reconciler):
             )
 
         self._reemit_child_events(cluster, nb)
-        self._update_status(cluster, nb, topo)
+        self._update_status(cluster, nb, topo, num_slices)
 
         requeue = None
         if self.culler is not None:
@@ -105,9 +114,32 @@ class NotebookReconciler(Reconciler):
 
     # ------------------------------------------------------------ generators
 
-    def generate_statefulset(self, nb: dict, topo: tputopo.SliceTopology | None) -> dict:
+    def generate_statefulsets(
+        self,
+        nb: dict,
+        topo: tputopo.SliceTopology | None,
+        num_slices: int = 1,
+    ) -> list[dict]:
+        """One StatefulSet per slice (SURVEY.md §7 stage 3: multislice is N
+        identical gangs joined over DCN; slice j's pods are <name>-s<j>-<i>)."""
+        if topo is None or num_slices <= 1:
+            return [self.generate_statefulset(nb, topo)]
+        return [
+            self.generate_statefulset(nb, topo, slice_id=j, num_slices=num_slices)
+            for j in range(num_slices)
+        ]
+
+    def generate_statefulset(
+        self,
+        nb: dict,
+        topo: tputopo.SliceTopology | None,
+        *,
+        slice_id: int | None = None,
+        num_slices: int = 1,
+    ) -> dict:
         cfg = self.config
         name, ns = ko.name(nb), ko.namespace(nb)
+        sts_name = name if slice_id is None else f"{name}-s{slice_id}"
         if stop_annotation_is_set(nb):
             replicas = 0
         elif topo is not None:
@@ -116,7 +148,7 @@ class NotebookReconciler(Reconciler):
             replicas = 1
 
         pod_spec = ko.deep_copy(nb["spec"]["template"]["spec"])
-        pod_labels = {"statefulset": name, "notebook-name": name}
+        pod_labels = {"statefulset": sts_name, "notebook-name": name}
         pod_labels.update(ko.labels(nb))  # carry PodDefault selector labels (ref go:444-448)
 
         container = pod_spec["containers"][0]
@@ -154,38 +186,47 @@ class NotebookReconciler(Reconciler):
         sts = {
             "apiVersion": "apps/v1",
             "kind": "StatefulSet",
-            "metadata": {"name": name, "namespace": ns},
+            "metadata": {
+                "name": sts_name,
+                "namespace": ns,
+                "labels": {"notebook-name": name},
+            },
             "spec": {
                 "replicas": replicas,
                 "podManagementPolicy": pod_management_policy,
-                "selector": {"matchLabels": {"statefulset": name}},
+                "selector": {"matchLabels": {"statefulset": sts_name}},
                 "template": {
                     "metadata": {
                         "labels": pod_labels,
-                        "annotations": _tpu_pod_annotations(nb, topo),
+                        "annotations": _tpu_pod_annotations(
+                            nb, topo, slice_id=slice_id, num_slices=num_slices
+                        ),
                     },
                     "spec": pod_spec,
                 },
             },
         }
-        if topo is not None and topo.is_multi_host:
-            # Stable per-host DNS: <name>-<ordinal>.<headless-svc>.<ns>.svc
+        if topo is not None and (topo.is_multi_host or slice_id is not None):
+            # Stable per-host DNS: <pod>.<headless-svc>.<ns>.svc — one shared
+            # headless Service covers every slice's pods (selector below).
             sts["spec"]["serviceName"] = tputopo.headless_service_name(name)
         return sts
 
-    def generate_service(self, nb: dict) -> dict:
+    def generate_service(self, nb: dict, num_slices: int = 1) -> dict:
         name, ns = ko.name(nb), ko.namespace(nb)
         ports = (
             nb["spec"]["template"]["spec"]["containers"][0].get("ports") or []
         )
         target = ports[0]["containerPort"] if ports else self.config.container_port
+        # the UI lives on the coordinator gang: slice 0 when multislice
+        ui_sts = name if num_slices <= 1 else f"{name}-s0"
         return {
             "apiVersion": "v1",
             "kind": "Service",
             "metadata": {"name": name, "namespace": ns},
             "spec": {
                 "type": "ClusterIP",
-                "selector": {"statefulset": name},
+                "selector": {"statefulset": ui_sts},
                 "ports": [
                     {
                         # Istio-managed port naming convention (ref go:497-500)
@@ -217,7 +258,7 @@ class NotebookReconciler(Reconciler):
             "spec": {
                 "clusterIP": "None",
                 "publishNotReadyAddresses": True,
-                "selector": {"statefulset": name},
+                "selector": {"notebook-name": name},
                 "ports": [
                     {
                         "name": "coordinator",
@@ -270,17 +311,39 @@ class NotebookReconciler(Reconciler):
 
     # ---------------------------------------------------------------- status
 
-    def _update_status(self, cluster: FakeCluster, nb: dict, topo) -> None:
+    @staticmethod
+    def _owned_statefulsets(cluster: FakeCluster, name: str, ns: str) -> list[dict]:
+        """Every StatefulSet belonging to the notebook: the labeled set plus
+        the pre-label single-slice STS (upgrade path)."""
+        stses = cluster.list(
+            "StatefulSet", ns, {"matchLabels": {"notebook-name": name}}
+        )
+        if not any(ko.name(s) == name for s in stses):
+            single = cluster.try_get("StatefulSet", name, ns)
+            if single is not None:
+                stses.append(single)
+        return stses
+
+    def _update_status(
+        self, cluster: FakeCluster, nb: dict, topo, num_slices: int = 1
+    ) -> None:
         name, ns = ko.name(nb), ko.namespace(nb)
-        sts = cluster.try_get("StatefulSet", name, ns)
-        ready = (sts or {}).get("status", {}).get("readyReplicas", 0)
-        expected = (sts or {}).get("spec", {}).get("replicas", 0)
+        stses = self._owned_statefulsets(cluster, name, ns)
+        ready = sum(
+            s.get("status", {}).get("readyReplicas", 0) for s in stses
+        )
+        expected = sum(s.get("spec", {}).get("replicas", 0) for s in stses)
 
         pods = {
             ko.name(p): p
-            for p in cluster.list("Pod", ns, {"matchLabels": {"statefulset": name}})
+            for p in cluster.list(
+                "Pod", ns, {"matchLabels": {"notebook-name": name}}
+            )
         }
-        coordinator = pods.get(f"{name}-0")
+        # slice 0 host 0 is the (megascale) coordinator
+        coordinator = pods.get(
+            f"{name}-s0-0" if num_slices > 1 else f"{name}-0"
+        )
 
         conditions: list[dict] = []
         container_state: dict = {}
@@ -309,6 +372,8 @@ class NotebookReconciler(Reconciler):
         }
         if topo is not None:
             status["tpu"] = topo.to_dict()
+            if num_slices > 1:
+                status["tpu"]["numSlices"] = num_slices
         current = cluster.try_get("Notebook", name, ns)
         if current is not None and current.get("status") != status:
             current["status"] = status
@@ -327,12 +392,13 @@ class NotebookReconciler(Reconciler):
         children = [
             (p["metadata"]["name"], "Pod", p["metadata"].get("uid"))
             for p in cluster.list(
-                "Pod", ns, {"matchLabels": {"statefulset": name}}
+                "Pod", ns, {"matchLabels": {"notebook-name": name}}
             )
         ]
-        sts = cluster.try_get("StatefulSet", name, ns)
-        if sts is not None:
-            children.append((name, "StatefulSet", sts["metadata"].get("uid")))
+        for sts in self._owned_statefulsets(cluster, name, ns):
+            children.append(
+                (ko.name(sts), "StatefulSet", sts["metadata"].get("uid"))
+            )
         all_events = cluster.list("Event", ns)
         for child_name, child_kind, child_uid in children:
             for ev in all_events:
@@ -380,13 +446,18 @@ class NotebookReconciler(Reconciler):
         return period
 
 
-def _tpu_pod_annotations(nb: dict, topo) -> dict:
+def _tpu_pod_annotations(
+    nb: dict, topo, *, slice_id: int | None = None, num_slices: int = 1
+) -> dict:
     anns = {}
     if topo is not None:
         # Consumed by the TPU env-injection webhook (webhooks/tpu_env.py).
         anns["tpu.kubeflow.org/accelerator"] = topo.accelerator.name
         anns["tpu.kubeflow.org/topology"] = topo.topology_str
         anns["tpu.kubeflow.org/notebook"] = ko.name(nb)
+        if num_slices > 1:
+            anns["tpu.kubeflow.org/slice-id"] = str(slice_id or 0)
+            anns["tpu.kubeflow.org/num-slices"] = str(num_slices)
     return anns
 
 

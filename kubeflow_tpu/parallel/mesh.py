@@ -4,6 +4,9 @@ The platform's scaling model (SURVEY.md §7, "How to Scale Your Model" recipe):
 pick a mesh, annotate shardings, let XLA insert the collectives over ICI.
 Axis vocabulary used across the framework:
 
+    dcn      data parallelism across slices over the data-center network
+             (multislice: gradient psum rides DCN, everything else stays
+             inside a slice — SURVEY.md §7 stage 3, MEGASCALE_* env)
     stage    pipeline parallelism (layer groups; ppermute'd activations —
              parallel/pipeline.py)
     data     pure data parallelism (batch split, psum'd grads over DCN/ICI)
@@ -27,13 +30,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("stage", "data", "fsdp", "seq", "expert", "tensor")
+AXES = ("dcn", "stage", "data", "fsdp", "seq", "expert", "tensor")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshPlan:
     """A named parallelism layout, e.g. MeshPlan(data=2, fsdp=2, tensor=2)."""
 
+    dcn: int = 1
     stage: int = 1
     data: int = 1
     fsdp: int = 1
@@ -44,7 +48,7 @@ class MeshPlan:
     @property
     def size(self) -> int:
         return (
-            self.stage * self.data * self.fsdp
+            self.dcn * self.stage * self.data * self.fsdp
             * self.seq * self.expert * self.tensor
         )
 
@@ -122,8 +126,8 @@ def auto_plan(n_devices: int, *, tensor: int = 1, seq: int = 1) -> MeshPlan:
 
 
 def batch_spec() -> P:
-    """Batch dims shard over every data-ish axis (data × fsdp)."""
-    return P(("data", "fsdp"))
+    """Batch dims shard over every data-ish axis (dcn × data × fsdp)."""
+    return P(("dcn", "data", "fsdp"))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

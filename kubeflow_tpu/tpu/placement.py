@@ -23,6 +23,9 @@ import numpy as np
 from kubeflow_tpu.runtime import workqueue as _wq
 
 DEFAULT_WEIGHTS: Mapping[str, float] = {
+    # dcn is not an ICI axis at all: cross-slice traffic rides the DCN, so it
+    # gets the lowest locality priority when packing axes onto the torus
+    "dcn": 0.1,
     "tensor": 100.0,
     "seq": 30.0,
     "fsdp": 10.0,

@@ -195,7 +195,14 @@ class SliceTopology:
         """Per-pod chip limits. One pod per host ⇒ chips_per_host each."""
         return {"google.com/tpu": str(self.chips_per_host)}
 
-    def worker_hostnames(self, notebook: str, namespace: str, cluster_domain: str = "cluster.local") -> list[str]:
+    def worker_hostnames(
+        self,
+        notebook: str,
+        namespace: str,
+        cluster_domain: str = "cluster.local",
+        *,
+        slice_id: int | None = None,
+    ) -> list[str]:
         """Stable per-host DNS names via the headless Service.
 
         The coordinator (host 0) address that ``jax.distributed.initialize``
@@ -203,8 +210,9 @@ class SliceTopology:
         reference pins replicas to 1 (``notebook_controller.go:419-421``).
         """
         svc = headless_service_name(notebook)
+        prefix = notebook if slice_id is None else f"{notebook}-s{slice_id}"
         return [
-            f"{notebook}-{i}.{svc}.{namespace}.svc.{cluster_domain}"
+            f"{prefix}-{i}.{svc}.{namespace}.svc.{cluster_domain}"
             for i in range(self.num_hosts)
         ]
 

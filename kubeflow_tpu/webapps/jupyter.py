@@ -264,6 +264,7 @@ def build_notebook(body: dict, namespace: str, defaults: dict, creator: str) -> 
         tpu_kwargs = {
             "tpu_accelerator": accelerator,
             "tpu_topology": tpu.get("topology", ""),
+            "tpu_num_slices": int(tpu.get("numSlices", 1) or 1),
         }
 
     nb = api.notebook(

@@ -14,13 +14,18 @@ the reference solves per-pod concerns at admission time rather than reconcile
 time (PodDefaults, ``admission-webhook/main.go:529-634``).
 
 Injected contract (read by ``parallel/bootstrap.py``):
-  TPU_WORKER_ID         ordinal of this host in the slice (0..N-1)
-  TPU_WORKER_HOSTNAMES  comma-separated stable DNS names of all hosts
+  TPU_WORKER_ID         ordinal of this host in ITS slice (0..N-1)
+  TPU_WORKER_HOSTNAMES  comma-separated stable DNS names of this slice's hosts
   TPU_ACCELERATOR_TYPE  e.g. v4-16
   TPU_TOPOLOGY          e.g. 2x2x2
-  JAX_COORDINATOR_ADDRESS  host0-dns:8476
-  JAX_NUM_PROCESSES / JAX_PROCESS_ID
+  JAX_COORDINATOR_ADDRESS  global host0-dns:8476 (slice 0's host 0)
+  JAX_NUM_PROCESSES / JAX_PROCESS_ID   GLOBAL across all slices
   TPU_SKIP_MDS_QUERY    skip GCE metadata lookups inside k8s pods
+
+Multislice (``spec.tpu.numSlices`` > 1; SURVEY.md §7 stage 3) adds the
+cross-slice DCN contract:
+  MEGASCALE_COORDINATOR_ADDRESS  slice 0's host 0 DNS
+  MEGASCALE_NUM_SLICES / MEGASCALE_SLICE_ID
 """
 from __future__ import annotations
 
@@ -32,6 +37,8 @@ from kubeflow_tpu.utils.config import ControllerConfig
 ACCEL_ANNOTATION = "tpu.kubeflow.org/accelerator"
 TOPOLOGY_ANNOTATION = "tpu.kubeflow.org/topology"
 NOTEBOOK_ANNOTATION = "tpu.kubeflow.org/notebook"
+SLICE_ANNOTATION = "tpu.kubeflow.org/slice-id"
+NUM_SLICES_ANNOTATION = "tpu.kubeflow.org/num-slices"
 
 
 def _ordinal(pod_name: str) -> int | None:
@@ -53,13 +60,24 @@ def make_mutator(config: ControllerConfig | None = None):
         if ordinal is None:
             return pod
         topo = parse_topology(accel, topo_str)
+        slice_id = int(anns.get(SLICE_ANNOTATION, "0"))
+        num_slices = int(anns.get(NUM_SLICES_ANNOTATION, "1"))
         pod = ko.deep_copy(pod)
-        hostnames = topo.worker_hostnames(
-            notebook, ko.namespace(pod), cfg.cluster_domain
-        )
-        if topo.num_hosts == 1:
-            # Single-host slice: no coordination needed; localhost identity.
+        ns = ko.namespace(pod)
+
+        def slice_hostnames(j: int) -> list[str]:
+            return topo.worker_hostnames(
+                notebook, ns, cfg.cluster_domain,
+                slice_id=None if num_slices == 1 else j,
+            )
+
+        hostnames = slice_hostnames(slice_id)
+        if topo.num_hosts == 1 and num_slices == 1:
+            # Single-host single-slice: no coordination; localhost identity.
             hostnames = ["localhost"]
+        global_host0 = (
+            hostnames[0] if num_slices == 1 else slice_hostnames(0)[0]
+        )
         env = {
             "TPU_WORKER_ID": str(ordinal),
             "TPU_WORKER_HOSTNAMES": ",".join(hostnames),
@@ -69,10 +87,20 @@ def make_mutator(config: ControllerConfig | None = None):
                 map(str, topo.accelerator.host_block)
             ),
             "TPU_SKIP_MDS_QUERY": "true",
-            "JAX_COORDINATOR_ADDRESS": f"{hostnames[0]}:{cfg.tpu_coordinator_port}",
-            "JAX_NUM_PROCESSES": str(topo.num_hosts),
-            "JAX_PROCESS_ID": str(ordinal),
+            # jax.distributed identity is GLOBAL: every host of every slice
+            # is one process; slice 0's host 0 coordinates the whole job.
+            "JAX_COORDINATOR_ADDRESS": f"{global_host0}:{cfg.tpu_coordinator_port}",
+            "JAX_NUM_PROCESSES": str(topo.num_hosts * num_slices),
+            "JAX_PROCESS_ID": str(slice_id * topo.num_hosts + ordinal),
         }
+        if num_slices > 1:
+            env.update(
+                {
+                    "MEGASCALE_COORDINATOR_ADDRESS": global_host0,
+                    "MEGASCALE_NUM_SLICES": str(num_slices),
+                    "MEGASCALE_SLICE_ID": str(slice_id),
+                }
+            )
         for c in pod.get("spec", {}).get("containers", []):
             if c.get("name") in ("istio-proxy",):
                 continue

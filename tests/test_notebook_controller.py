@@ -328,6 +328,121 @@ class TestTpuNotebook:
         assert env["JAX_NUM_PROCESSES"] == "2"
 
 
+class TestMultislice:
+    """spec.tpu.numSlices > 1: N gangs over DCN (SURVEY.md §7 stage 3)."""
+
+    def test_per_slice_statefulsets_and_megascale_env(self, cluster, manager):
+        cluster.add_tpu_node_pool("v4", "2x2x2")
+        cluster.create(
+            api.notebook(
+                "ms", "user-ns",
+                tpu_accelerator="v4", tpu_topology="2x2x2", tpu_num_slices=2,
+            )
+        )
+        manager.run_until_idle()
+        s0 = cluster.get("StatefulSet", "ms-s0", "user-ns")
+        s1 = cluster.get("StatefulSet", "ms-s1", "user-ns")
+        assert s0["spec"]["replicas"] == 2 and s1["spec"]["replicas"] == 2
+        assert (
+            s0["spec"]["serviceName"]
+            == s1["spec"]["serviceName"]
+            == "ms-tpu"
+        )
+        svc = cluster.get("Service", "ms-tpu", "user-ns")
+        assert svc["spec"]["selector"] == {"notebook-name": "ms"}
+
+        cluster.settle(manager)
+        pod = cluster.get("Pod", "ms-s1-1", "user-ns")
+        env = {
+            e["name"]: e["value"]
+            for e in pod["spec"]["containers"][0]["env"]
+        }
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"].startswith("ms-s0-0.ms-tpu.")
+        # global jax identity: slice 1 host 1 of a 2x2-host job
+        assert env["JAX_NUM_PROCESSES"] == "4"
+        assert env["JAX_PROCESS_ID"] == "3"
+        assert env["TPU_WORKER_ID"] == "1"  # per-slice ordinal
+        assert "ms-s1-0." in env["TPU_WORKER_HOSTNAMES"]
+
+    def test_status_aggregates_across_slices(self, cluster, manager):
+        cluster.add_tpu_node_pool("v4", "2x2x2")
+        cluster.create(
+            api.notebook(
+                "ms", "user-ns",
+                tpu_accelerator="v4", tpu_topology="2x2x2", tpu_num_slices=2,
+            )
+        )
+        manager.run_until_idle()
+        cluster.settle(manager)
+        nb = cluster.get("Notebook", "ms", "user-ns")
+        assert nb["status"]["readyReplicas"] == 4
+        assert nb["status"]["tpu"]["numSlices"] == 2
+        types = {c["type"]: c for c in nb["status"]["conditions"]}
+        assert types["TPUSliceReady"]["status"] == "True"
+        assert "4/4" in types["TPUSliceReady"]["reason"]
+
+    def test_scaling_down_num_slices_reaps_stale_gangs(self, cluster, manager):
+        """Editing numSlices must delete no-longer-desired per-slice STSes —
+        orphans would keep a stale MEGASCALE/JAX process-count contract."""
+        cluster.add_tpu_node_pool("v4", "2x2x2")
+        cluster.create(
+            api.notebook(
+                "ms", "user-ns",
+                tpu_accelerator="v4", tpu_topology="2x2x2", tpu_num_slices=3,
+            )
+        )
+        manager.run_until_idle()
+        assert cluster.try_get("StatefulSet", "ms-s2", "user-ns") is not None
+
+        nb = cluster.get("Notebook", "ms", "user-ns")
+        nb["spec"]["tpu"]["numSlices"] = 2
+        cluster.update(nb)
+        manager.run_until_idle()
+        assert cluster.try_get("StatefulSet", "ms-s2", "user-ns") is None
+        assert cluster.try_get("StatefulSet", "ms-s0", "user-ns") is not None
+
+        # toggle multislice off entirely: slice STSes replaced by the single
+        nb = cluster.get("Notebook", "ms", "user-ns")
+        del nb["spec"]["tpu"]["numSlices"]
+        cluster.update(nb)
+        manager.run_until_idle()
+        assert cluster.try_get("StatefulSet", "ms-s0", "user-ns") is None
+        assert cluster.try_get("StatefulSet", "ms-s1", "user-ns") is None
+        assert cluster.get("StatefulSet", "ms", "user-ns")["spec"]["replicas"] == 2
+
+    def test_multislice_ui_service_targets_slice0(self, cluster, manager):
+        cluster.add_tpu_node_pool("v4", "2x2x2")
+        cluster.create(
+            api.notebook(
+                "ms", "user-ns",
+                tpu_accelerator="v4", tpu_topology="2x2x2", tpu_num_slices=2,
+            )
+        )
+        manager.run_until_idle()
+        svc = cluster.get("Service", "ms", "user-ns")
+        # selector must actually match slice-0 pods (labels carry sts name)
+        assert svc["spec"]["selector"] == {"statefulset": "ms-s0"}
+
+    def test_stop_scales_every_slice_to_zero(self, cluster, manager):
+        cluster.add_tpu_node_pool("v4", "2x2x2")
+        cluster.create(
+            api.notebook(
+                "ms", "user-ns",
+                tpu_accelerator="v4", tpu_topology="2x2x2", tpu_num_slices=2,
+            )
+        )
+        manager.run_until_idle()
+        cluster.patch(
+            "Notebook", "ms", "user-ns",
+            {"metadata": {"annotations": {api.STOP_ANNOTATION: "t"}}},
+        )
+        manager.run_until_idle()
+        assert cluster.get("StatefulSet", "ms-s0", "user-ns")["spec"]["replicas"] == 0
+        assert cluster.get("StatefulSet", "ms-s1", "user-ns")["spec"]["replicas"] == 0
+
+
 class TestCulling:
     def _manager_with_culler(self, cluster, fetch, clock):
         m = Manager(cluster)

@@ -83,7 +83,7 @@ class NotebookReconciler(Reconciler):
         # scale changes (numSlices edited, multislice toggled) must reap the
         # gangs no longer desired — their pods hold a stale DCN contract
         desired_names = {ko.name(sts) for sts in desired_stses}
-        for sts in self._owned_statefulsets(cluster, name, namespace):
+        for sts in self._owned_statefulsets(cluster, nb):
             if ko.name(sts) not in desired_names:
                 cluster.delete("StatefulSet", ko.name(sts), namespace)
         helper.reconcile_object(
@@ -99,6 +99,14 @@ class NotebookReconciler(Reconciler):
                 owner=nb,
                 copy_fields=helper.copy_service_fields,
             )
+        else:
+            # scale-down cleanup: a headless Service from a previous
+            # multi-host/multislice shape must not linger
+            stale = cluster.try_get(
+                "Service", tputopo.headless_service_name(name), namespace
+            )
+            if stale is not None and ko.controller_owner(stale):
+                cluster.delete("Service", ko.name(stale), namespace)
         if self.config.use_istio:
             helper.reconcile_object(
                 cluster, self.generate_virtual_service(nb), owner=nb
@@ -312,15 +320,32 @@ class NotebookReconciler(Reconciler):
     # ---------------------------------------------------------------- status
 
     @staticmethod
-    def _owned_statefulsets(cluster: FakeCluster, name: str, ns: str) -> list[dict]:
-        """Every StatefulSet belonging to the notebook: the labeled set plus
-        the pre-label single-slice STS (upgrade path)."""
-        stses = cluster.list(
-            "StatefulSet", ns, {"matchLabels": {"notebook-name": name}}
-        )
+    def _owned_statefulsets(cluster: FakeCluster, nb: dict) -> list[dict]:
+        """Every StatefulSet belonging to THIS notebook: the labeled set plus
+        the pre-label single-slice STS (upgrade path) — both filtered by the
+        controller ownerReference so a same-named unrelated StatefulSet is
+        never adopted (and never reaped/status-counted)."""
+        name, ns = ko.name(nb), ko.namespace(nb)
+        uid = nb.get("metadata", {}).get("uid")
+
+        def owned(sts: dict) -> bool:
+            ref = ko.controller_owner(sts)
+            if ref is None:
+                return False
+            if uid and ref.get("uid"):
+                return ref["uid"] == uid
+            return ref.get("kind") == "Notebook" and ref.get("name") == name
+
+        stses = [
+            s
+            for s in cluster.list(
+                "StatefulSet", ns, {"matchLabels": {"notebook-name": name}}
+            )
+            if owned(s)
+        ]
         if not any(ko.name(s) == name for s in stses):
             single = cluster.try_get("StatefulSet", name, ns)
-            if single is not None:
+            if single is not None and owned(single):
                 stses.append(single)
         return stses
 
@@ -328,7 +353,7 @@ class NotebookReconciler(Reconciler):
         self, cluster: FakeCluster, nb: dict, topo, num_slices: int = 1
     ) -> None:
         name, ns = ko.name(nb), ko.namespace(nb)
-        stses = self._owned_statefulsets(cluster, name, ns)
+        stses = self._owned_statefulsets(cluster, nb)
         ready = sum(
             s.get("status", {}).get("readyReplicas", 0) for s in stses
         )
@@ -395,7 +420,7 @@ class NotebookReconciler(Reconciler):
                 "Pod", ns, {"matchLabels": {"notebook-name": name}}
             )
         ]
-        for sts in self._owned_statefulsets(cluster, name, ns):
+        for sts in self._owned_statefulsets(cluster, nb):
             children.append(
                 (ko.name(sts), "StatefulSet", sts["metadata"].get("uid"))
             )

@@ -143,6 +143,13 @@ class App:
 
         index_path = os.path.join(static_root, app_dir_name, "index.html")
         self.route("/")(lambda request: send(index_path, index=True))
+        # app-local pages (e.g. the notebook detail page) next to index.html
+        self.route("/<page>.html")(
+            lambda request, page: send(
+                os.path.join(static_root, app_dir_name, f"{page}.html"),
+                index=True,
+            )
+        )
         self.route("/static/<path:path>")(
             lambda request, path: send(os.path.join(static_root, path))
         )

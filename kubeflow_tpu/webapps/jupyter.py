@@ -41,7 +41,9 @@ def notebook_status(nb: dict, events: list[dict]) -> dict:
     anns = ko.annotations(nb)
     ready = nb.get("status", {}).get("readyReplicas", 0)
     topo = api.notebook_topology(nb)
-    expected = topo.num_hosts if topo else 1
+    expected = (
+        topo.num_hosts * api.notebook_num_slices(nb) if topo else 1
+    )
     if api.STOP_ANNOTATION in anns:
         if ready == 0:
             return {"phase": "stopped", "message": "No Pods are currently running."}
@@ -61,6 +63,9 @@ def notebook_summary(nb: dict, events: list[dict]) -> dict:
     pod_spec = nb.get("spec", {}).get("template", {}).get("spec", {})
     container = (pod_spec.get("containers") or [{}])[0]
     topo = api.notebook_topology(nb)
+    tpu = topo.to_dict() if topo else None
+    if tpu and api.notebook_num_slices(nb) > 1:
+        tpu["numSlices"] = api.notebook_num_slices(nb)
     return {
         "name": ko.name(nb),
         "namespace": ko.namespace(nb),
@@ -68,7 +73,7 @@ def notebook_summary(nb: dict, events: list[dict]) -> dict:
         "image": container.get("image"),
         "cpu": container.get("resources", {}).get("requests", {}).get("cpu"),
         "memory": container.get("resources", {}).get("requests", {}).get("memory"),
-        "tpu": topo.to_dict() if topo else None,
+        "tpu": tpu,
         "status": notebook_status(nb, events),
         "volumes": [v.get("name") for v in pod_spec.get("volumes", [])],
         "lastActivity": ko.annotations(nb).get(api.LAST_ACTIVITY_ANNOTATION, ""),
@@ -136,8 +141,25 @@ def create_app(
 
     @app.route("/api/namespaces/<namespace>/notebooks/<name>")
     def get_notebook(request, namespace, name):
+        """Detail-page payload: the index summary enriched with the CR's
+        conditions/age (ref notebook-page overview tab) plus the raw CR."""
         app.ensure(request, "get", "notebooks", namespace)
-        return success("notebook", cluster.get("Notebook", name, namespace))
+        nb = cluster.get("Notebook", name, namespace)
+        events = cluster.events_for(nb)
+        summary = notebook_summary(nb, events)
+        summary["status"]["conditions"] = nb.get("status", {}).get(
+            "conditions", []
+        )
+        summary["age"] = nb["metadata"].get("creationTimestamp", "")
+        # keep CR status fields reachable (status.tpu incl. numSlices)
+        summary["status"].update(
+            {
+                k: v
+                for k, v in (nb.get("status") or {}).items()
+                if k not in ("conditions",)
+            }
+        )
+        return success("notebook", summary, raw=nb)
 
     @app.route("/api/namespaces/<namespace>/notebooks/<name>/pod")
     def get_notebook_pod(request, namespace, name):

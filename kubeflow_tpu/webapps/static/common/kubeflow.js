@@ -98,8 +98,136 @@
   }
 
   // ---- confirm dialog (confirm-dialog module) ----------------------------
-  function confirmDialog(message) {
-    return Promise.resolve(window.confirm(message));
+  // A real DOM modal (kubeflow-common-lib confirm-dialog analog), not
+  // window.confirm: styleable, keyboard-dismissable, testable.
+  function confirmDialog(message, opts) {
+    opts = opts || {};
+    return new Promise((resolve) => {
+      const overlay = document.createElement("div");
+      overlay.className = "kf-modal-overlay";
+      const box = document.createElement("div");
+      box.className = "kf-modal";
+      const text = document.createElement("p");
+      text.textContent = message;
+      const row = document.createElement("div");
+      row.className = "kf-modal-actions";
+      const cancel = button("Cancel", () => done(false));
+      const ok = button(opts.okLabel || "Confirm", () => done(true), opts.danger);
+      ok.classList.add("kf-modal-ok");
+      cancel.classList.add("kf-modal-cancel");
+      row.appendChild(cancel);
+      row.appendChild(ok);
+      box.appendChild(text);
+      box.appendChild(row);
+      overlay.appendChild(box);
+      function done(result) {
+        document.removeEventListener("keydown", onKey);
+        overlay.remove();
+        resolve(result);
+      }
+      function onKey(ev) {
+        if (ev.key === "Escape") done(false);
+      }
+      document.addEventListener("keydown", onKey);
+      overlay.addEventListener("click", (ev) => {
+        if (ev.target === overlay) done(false);
+      });
+      document.body.appendChild(overlay);
+      ok.focus();
+    });
+  }
+
+  // ---- tabs (the notebook-page tab strip) --------------------------------
+  // tabs(container, [{id, label, render(panel) -> cleanup?}]) -> {select(id)}
+  // A render may return a cleanup function; it runs before the next tab
+  // renders (so pollers like the logs viewer stop when their tab hides).
+  function tabs(container, defs) {
+    container.textContent = "";
+    const bar = document.createElement("nav");
+    bar.className = "kf-tabs";
+    const panel = document.createElement("div");
+    panel.className = "kf-tab-panel";
+    const buttons = {};
+    let cleanup = null;
+    defs.forEach((def) => {
+      const b = document.createElement("button");
+      b.textContent = def.label;
+      b.className = "kf-tab";
+      b.dataset.tab = def.id;
+      b.addEventListener("click", () => select(def.id));
+      buttons[def.id] = b;
+      bar.appendChild(b);
+    });
+    function select(id) {
+      if (cleanup) {
+        try { cleanup(); } catch (e) {}
+        cleanup = null;
+      }
+      defs.forEach((d) => buttons[d.id].classList.toggle("active", d.id === id));
+      panel.textContent = "";
+      const out = defs.find((d) => d.id === id).render(panel);
+      if (typeof out === "function") cleanup = out;
+    }
+    container.appendChild(bar);
+    container.appendChild(panel);
+    if (defs.length) select(defs[0].id);
+    return { select: select };
+  }
+
+  // ---- logs viewer (kubeflow-common-lib logs-viewer analog) --------------
+  // logsViewer(container, fetchLines: () -> Promise<string[]>)
+  function logsViewer(container, fetchLines) {
+    const bar = document.createElement("div");
+    bar.className = "kf-logs-bar";
+    const follow = document.createElement("label");
+    const followBox = document.createElement("input");
+    followBox.type = "checkbox";
+    followBox.checked = true;
+    follow.appendChild(followBox);
+    follow.appendChild(document.createTextNode(" follow"));
+    const pre = document.createElement("pre");
+    pre.className = "kf-logs";
+    async function refresh() {
+      try {
+        const lines = await fetchLines();
+        pre.textContent = lines.join("\n");
+        if (followBox.checked) pre.scrollTop = pre.scrollHeight;
+      } catch (e) {
+        pre.textContent = "(logs unavailable: " + e.message + ")";
+      }
+    }
+    bar.appendChild(button("Refresh", refresh));
+    bar.appendChild(follow);
+    container.appendChild(bar);
+    container.appendChild(pre);
+    const stop = poll(refresh, 5000);
+    return { refresh: refresh, stop: stop };
+  }
+
+  // ---- events table (notebook-page events tab) ---------------------------
+  function eventsTable(container, events) {
+    renderTable(
+      container,
+      [
+        {
+          key: "type",
+          label: "Type",
+          render: (e) =>
+            statusIcon(e.type === "Warning" ? "warning" : "ready"),
+        },
+        { key: "reason", label: "Reason" },
+        { key: "message", label: "Message" },
+      ],
+      events
+    );
+  }
+
+  // ---- link helper -------------------------------------------------------
+  function link(text, href) {
+    const a = document.createElement("a");
+    a.textContent = text;
+    a.href = href;
+    return a;
   }
 
   // ---- namespace selector (namespace-select module) ----------------------
@@ -128,6 +256,10 @@
     renderTable: renderTable,
     button: button,
     confirmDialog: confirmDialog,
+    tabs: tabs,
+    logsViewer: logsViewer,
+    eventsTable: eventsTable,
+    link: link,
     currentNamespace: currentNamespace,
     setNamespace: setNamespace,
     poll: poll,

@@ -412,6 +412,35 @@ class TestMultislice:
         assert cluster.try_get("StatefulSet", "ms-s1", "user-ns") is None
         assert cluster.get("StatefulSet", "ms", "user-ns")["spec"]["replicas"] == 2
 
+    def test_unowned_same_named_statefulset_is_never_adopted(self, cluster, manager):
+        """A user's unrelated StatefulSet sharing the notebook's name must not
+        be reaped or status-counted (ownership = controller ownerReference)."""
+        cluster.create(
+            {
+                "apiVersion": "apps/v1",
+                "kind": "StatefulSet",
+                "metadata": {"name": "train", "namespace": "user-ns"},
+                "spec": {"replicas": 3, "selector": {"matchLabels": {"app": "x"}},
+                         "template": {"metadata": {"labels": {"app": "x"}},
+                                      "spec": {"containers": [{"name": "x", "image": "x"}]}}},
+            }
+        )
+        cluster.add_tpu_node_pool("v4", "2x2x2")
+        cluster.create(
+            api.notebook(
+                "train", "user-ns",
+                tpu_accelerator="v4", tpu_topology="2x2x2", tpu_num_slices=2,
+            )
+        )
+        manager.run_until_idle()
+        # the unrelated StatefulSet survives the reap untouched
+        orphan = cluster.get("StatefulSet", "train", "user-ns")
+        assert orphan["spec"]["replicas"] == 3
+        assert cluster.try_get("StatefulSet", "train-s0", "user-ns") is not None
+        nb = cluster.get("Notebook", "train", "user-ns")
+        # ...and its replicas don't pollute the notebook's status
+        assert nb["status"]["readyReplicas"] <= 4
+
     def test_multislice_ui_service_targets_slice0(self, cluster, manager):
         cluster.add_tpu_node_pool("v4", "2x2x2")
         cluster.create(

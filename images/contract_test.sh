@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Workload-image contract test (ref: the implicit contract every
+# example-notebook-servers image honors, base/Dockerfile:4-9 +
+# jupyter/Dockerfile:77-81):
+#   1. the container runs as jovyan, uid 1000
+#   2. it serves HTTP on :8888
+#   3. it serves UNDER ${NB_PREFIX} (the VirtualService rewrite target)
+#   4. $HOME is re-seeded when a fresh volume mounts over it (s6 init-home)
+#
+# Usage: contract_test.sh <image> [path-probe]
+set -euo pipefail
+
+IMAGE="${1:?usage: contract_test.sh <image> [path]}"
+PROBE="${2:-/}"
+PREFIX="/notebook/test-ns/test-nb"
+NAME="contract-$$"
+
+cleanup() { docker rm -f "${NAME}" >/dev/null 2>&1 || true; }
+trap cleanup EXIT
+
+echo "=== ${IMAGE}: uid contract"
+uid=$(docker run --rm --entrypoint /usr/bin/id "${IMAGE}" -u)
+[ "${uid}" = "1000" ] || { echo "FAIL: runs as uid ${uid}, want 1000"; exit 1; }
+user=$(docker run --rm --entrypoint /usr/bin/id "${IMAGE}" -un)
+[ "${user}" = "jovyan" ] || { echo "FAIL: runs as ${user}, want jovyan"; exit 1; }
+
+echo "=== ${IMAGE}: home re-seed contract (fresh volume over \$HOME)"
+docker run --rm --entrypoint /bin/sh -v /tmp:/probe-empty "${IMAGE}" \
+  -c 'ls /tmp_home >/dev/null' \
+  || { echo "FAIL: /tmp_home skeleton missing"; exit 1; }
+
+echo "=== ${IMAGE}: serves :8888 under NB_PREFIX"
+docker run -d --name "${NAME}" -e NB_PREFIX="${PREFIX}" -p 127.0.0.1::8888 "${IMAGE}"
+port=$(docker port "${NAME}" 8888 | head -1 | awk -F: '{print $NF}')
+for i in $(seq 1 60); do
+  code=$(curl -s -o /dev/null -w '%{http_code}' \
+    "http://127.0.0.1:${port}${PREFIX}${PROBE}" || true)
+  # 2xx/3xx under the prefix = contract met (302 to login/lab is fine)
+  case "${code}" in
+    2*|3*) echo "OK: HTTP ${code} at ${PREFIX}${PROBE}"; exit 0 ;;
+  esac
+  sleep 2
+done
+echo "FAIL: ${IMAGE} never answered under ${PREFIX} (last code ${code})"
+docker logs "${NAME}" | tail -40
+exit 1

@@ -101,12 +101,21 @@ class NotebookReconciler(Reconciler):
             )
         else:
             # scale-down cleanup: a headless Service from a previous
-            # multi-host/multislice shape must not linger
+            # multi-host/multislice shape must not linger — but only THIS
+            # notebook's (same ownership discipline as _owned_statefulsets)
             stale = cluster.try_get(
                 "Service", tputopo.headless_service_name(name), namespace
             )
-            if stale is not None and ko.controller_owner(stale):
-                cluster.delete("Service", ko.name(stale), namespace)
+            if stale is not None:
+                ref = ko.controller_owner(stale) or {}
+                uid = nb.get("metadata", {}).get("uid")
+                ours = (
+                    ref.get("uid") == uid
+                    if uid and ref.get("uid")
+                    else ref.get("kind") == "Notebook" and ref.get("name") == name
+                )
+                if ours:
+                    cluster.delete("Service", ko.name(stale), namespace)
         if self.config.use_istio:
             helper.reconcile_object(
                 cluster, self.generate_virtual_service(nb), owner=nb

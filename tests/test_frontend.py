@@ -184,31 +184,44 @@ class TestDomContract:
 
     @pytest.mark.parametrize("page", ["index.html", "notebook.html"])
     def test_api_paths_exist_on_backend(self, page, cluster):
+        """Catches JS-to-backend route drift: every URL expression the page
+        passes to kf.api (string concats normalized to X segments) must
+        exactly match a backend route shape."""
         js = _script_of(page)
         app = jupyter.create_app(cluster)
-        rules = [str(r.rule) for r in app.url_map.iter_rules()]
+        rule_shapes = {
+            re.sub(r"<[^>]+>", "X", str(r.rule))
+            for r in app.url_map.iter_rules()
+        }
 
-        def covered(path: str) -> bool:
-            # normalize the JS string-concat into a route shape
-            probe = "/" + path
-            probe = re.sub(r"/(alice|default|[a-z0-9-]+)$", "", probe)
-            return any(rule.startswith("/api/") and _match(rule, probe)
-                       for rule in rules)
+        base_def = re.search(r"const base = ([^;]+);", js)
+        exprs = []
+        for m in re.finditer(r'kf\.api\(\s*"[A-Z]+",\s*(.+)', js):
+            expr = m.group(1)
+            expr = expr.split(", {")[0]  # drop a JSON body argument
+            expr = expr.rstrip(");")
+            exprs.append(expr)
+        if base_def:
+            basis = base_def.group(1)
+            exprs = [e.replace("base", "(" + basis + ")") for e in exprs]
 
-        def _match(rule: str, probe: str) -> bool:
-            rx = re.sub(r"<[^>]+>", "[^/]+", rule)
-            return re.fullmatch(rx, probe) is not None
+        def shape_of(expr: str) -> str | None:
+            expr = expr.replace("(", "").replace(")", "").strip()
+            # "lit" + var + "lit"  ->  "litXlit"
+            expr = re.sub(r'"\s*\+\s*[^"+]+?\s*\+\s*"', "X", expr)
+            # trailing  + var      ->  X inside the literal
+            expr = re.sub(r'"\s*\+\s*[^"+]+$', 'X"', expr)
+            lits = re.findall(r'"([^"]*)"', expr)
+            url = "".join(lits)
+            return "/" + url if url.startswith("api/") else None
 
-        for lit in re.findall(r"\"(api/[\w/\" +-]*?)\"", js):
-            base = lit.split('"')[0].rstrip("/ +")
-            # reconstruct: 'api/namespaces/' + ns + '/notebooks' etc — check
-            # each literal prefix resolves under some API rule
-            assert any(
-                str(r.rule).replace("<namespace>", "X").replace("<name>", "X")
-                .replace("<pod>", "X").startswith("/" + base.replace('" + ns + "', "X").replace('" + name + "', "X"))
-                or ("/" + base).startswith("/api")
-                for r in app.url_map.iter_rules()
-            ), f"{page}: no backend route for {lit!r}"
+        shapes = {u for u in (shape_of(e) for e in exprs) if u}
+        assert shapes, f"{page}: no api URLs extracted (extractor drift?)"
+        for url in sorted(shapes):
+            assert url in rule_shapes, (
+                f"{page}: no backend route for {url!r}; routes: "
+                f"{sorted(rule_shapes)}"
+            )
 
     def test_lib_components_are_self_consistent(self):
         lib = (STATIC / "common" / "kubeflow.js").read_text()

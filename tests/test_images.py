@@ -1,0 +1,96 @@
+"""Static contract checks over the workload-image tree.
+
+Docker is unavailable in the test image, so `images/contract_test.sh` runs
+the live half in CI (`.github/workflows/images.yaml`). These tests pin the
+statically-checkable contract (ref base/Dockerfile:4-9, jupyter/Dockerfile:
+77-81): every leaf serves :8888, honors NB_PREFIX through a SHELL-form CMD
+(exec form cannot expand env vars — a real bug class: the jupyter CMD
+shipped round 1 passed the literal string '${NB_PREFIX}'), the base runs
+uid 1000 jovyan, and layers chain within the platform registry.
+"""
+import re
+from pathlib import Path
+
+import pytest
+
+IMAGES = Path(__file__).resolve().parents[1] / "images"
+LEAVES = [
+    "jupyter", "jupyter-scipy", "jupyter-jax", "jupyter-jax-full",
+    "jupyter-pytorch-xla", "codeserver",
+]
+
+
+def dockerfile(name: str) -> str:
+    return (IMAGES / name / "Dockerfile").read_text()
+
+
+def final_stage_chain(name: str) -> list[str]:
+    """Follow FROM kubeflow-tpu/X chains down to base."""
+    chain = [name]
+    while True:
+        m = re.search(r"^FROM kubeflow-tpu/([\w-]+):", dockerfile(chain[-1]), re.M)
+        if not m:
+            return chain
+        chain.append(m.group(1))
+
+
+class TestImageTree:
+    def test_all_images_exist_with_makefile_targets(self):
+        makefile = (IMAGES / "Makefile").read_text()
+        for leaf in LEAVES + ["base"]:
+            assert (IMAGES / leaf / "Dockerfile").is_file(), leaf
+            assert re.search(rf"^{leaf}:", makefile, re.M), f"{leaf} not in Makefile"
+
+    def test_base_contract_uid_1000_jovyan_s6(self):
+        base = dockerfile("base")
+        assert "NB_UID=1000" in base
+        assert "NB_USER=jovyan" in base
+        assert 'ENTRYPOINT ["/init"]' in base  # s6-overlay supervises
+        assert "s6-overlay" in base
+        assert re.search(r"^USER \$\{NB_UID\}", base, re.M)
+
+    @pytest.mark.parametrize("leaf", LEAVES)
+    def test_leaves_chain_to_base(self, leaf):
+        assert final_stage_chain(leaf)[-1] == "base"
+
+    @pytest.mark.parametrize("leaf", LEAVES)
+    def test_no_root_final_user(self, leaf):
+        """A layer may switch to root for apt but must drop back."""
+        for name in final_stage_chain(leaf):
+            df = dockerfile(name)
+            users = re.findall(r"^USER (.+)$", df, re.M)
+            if users:
+                assert users[-1] != "root", f"{name} ends as root"
+
+    @pytest.mark.parametrize("leaf", LEAVES)
+    def test_serves_8888(self, leaf):
+        chain = final_stage_chain(leaf)
+        assert any("EXPOSE 8888" in dockerfile(n) for n in chain), leaf
+
+    @pytest.mark.parametrize("leaf", LEAVES)
+    def test_nb_prefix_via_shell_form_cmd(self, leaf):
+        """Wherever the serving CMD references NB_PREFIX it must go through
+        a shell — exec-form arrays do not expand env vars."""
+        for name in final_stage_chain(leaf):
+            df = dockerfile(name)
+            for m in re.finditer(r"^CMD (\[.*\])$", df, re.M | re.S):
+                cmd = m.group(1)
+                if "NB_PREFIX" in cmd:
+                    assert re.search(r'\[\s*"(/bin/)?sh"\s*,\s*"-c"', cmd), (
+                        f"{name}: CMD uses NB_PREFIX without a shell"
+                    )
+
+    @pytest.mark.parametrize("leaf", ["jupyter", "codeserver"])
+    def test_home_reseed_s6_script(self, leaf):
+        """Workspace PVCs mount over $HOME; the s6 oneshot re-seeds it."""
+        up = IMAGES / leaf / "s6" / "init-home" / "up"
+        assert up.is_file(), f"{leaf} missing init-home s6 script"
+        assert "/tmp_home" in up.read_text()
+
+    def test_contract_script_and_workflow_wired(self):
+        script = IMAGES / "contract_test.sh"
+        assert script.stat().st_mode & 0o111, "contract_test.sh not executable"
+        wf = (IMAGES.parent / ".github/workflows/images.yaml").read_text()
+        assert "contract_test.sh" in wf
+        for img in ("jupyter-jax", "codeserver"):
+            assert img in wf

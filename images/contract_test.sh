@@ -10,8 +10,11 @@
 # Usage: contract_test.sh <image> [path-probe]
 set -euo pipefail
 
-IMAGE="${1:?usage: contract_test.sh <image> [path]}"
+IMAGE="${1:?usage: contract_test.sh <image> [path] [--rewrite-root]}"
 PROBE="${2:-/}"
+# --rewrite-root: the app serves at / and the platform's VirtualService
+# rewrites the prefix away (codeserver/rstudio; ref JWA rewrite annotations)
+MODE="${3:-}"
 PREFIX="/notebook/test-ns/test-nb"
 NAME="contract-$$"
 
@@ -25,22 +28,37 @@ user=$(docker run --rm --entrypoint /usr/bin/id "${IMAGE}" -un)
 [ "${user}" = "jovyan" ] || { echo "FAIL: runs as ${user}, want jovyan"; exit 1; }
 
 echo "=== ${IMAGE}: home re-seed contract (fresh volume over \$HOME)"
-docker run --rm --entrypoint /bin/sh -v /tmp:/probe-empty "${IMAGE}" \
-  -c 'ls /tmp_home >/dev/null' \
-  || { echo "FAIL: /tmp_home skeleton missing"; exit 1; }
+# boot via /init with an EMPTY volume over $HOME: the s6 init-home oneshot
+# must seed it from /tmp_home with files the uid-1000 workload can write
+vol="contract-home-$$"
+docker volume create "${vol}" >/dev/null
+docker run -d --name "${NAME}-seed" -v "${vol}:/home/jovyan" "${IMAGE}" >/dev/null
+sleep 10
+seeded=$(docker exec "${NAME}-seed" /bin/sh -c \
+  'ls -A /home/jovyan | head -1; stat -c %u /home/jovyan/.[!.]* /home/jovyan/* 2>/dev/null | sort -u | head -3' || true)
+docker rm -f "${NAME}-seed" >/dev/null; docker volume rm "${vol}" >/dev/null
+echo "${seeded}" | grep -q . || { echo "FAIL: \$HOME not re-seeded"; exit 1; }
+if echo "${seeded}" | tail -n +2 | grep -qv '^1000$'; then
+  echo "FAIL: re-seeded files not owned by uid 1000: ${seeded}"; exit 1
+fi
 
-echo "=== ${IMAGE}: serves :8888 under NB_PREFIX"
+echo "=== ${IMAGE}: serves :8888 (${MODE:-under NB_PREFIX})"
 docker run -d --name "${NAME}" -e NB_PREFIX="${PREFIX}" -p 127.0.0.1::8888 "${IMAGE}"
 port=$(docker port "${NAME}" 8888 | head -1 | awk -F: '{print $NF}')
+if [ "${MODE}" = "--rewrite-root" ]; then
+  URL_PATH="${PROBE}"     # platform rewrites the prefix away for this image
+else
+  URL_PATH="${PREFIX}${PROBE}"
+fi
 for i in $(seq 1 60); do
   code=$(curl -s -o /dev/null -w '%{http_code}' \
-    "http://127.0.0.1:${port}${PREFIX}${PROBE}" || true)
+    "http://127.0.0.1:${port}${URL_PATH}" || true)
   # 2xx/3xx under the prefix = contract met (302 to login/lab is fine)
   case "${code}" in
-    2*|3*) echo "OK: HTTP ${code} at ${PREFIX}${PROBE}"; exit 0 ;;
+    2*|3*) echo "OK: HTTP ${code} at ${URL_PATH}"; exit 0 ;;
   esac
   sleep 2
 done
-echo "FAIL: ${IMAGE} never answered under ${PREFIX} (last code ${code})"
+echo "FAIL: ${IMAGE} never answered at ${URL_PATH} (last code ${code})"
 docker logs "${NAME}" | tail -40
 exit 1

@@ -41,6 +41,12 @@ class TransformerConfig:
     rope_theta: float = 10_000.0
     attention_impl: str = "block"        # xla | block | flash | ring
     attention_block_size: int = 512
+    remat: bool = False                  # jax.checkpoint each block: trades
+                                         # recompute FLOPs for activation HBM
+                                         # (long-seq/deep configs need it)
+    remat_policy: str = "full"           # full | dots: "dots" saves matmul
+                                         # outputs and recomputes elementwise
+                                         # (cheaper recompute, more HBM)
     dtype: Any = jnp.bfloat16
     mesh: Any = None                     # required for attention_impl == "ring"
 
@@ -161,8 +167,21 @@ class TransformerLM(nn.Module):
         )
         x = embed(tokens)
         positions = jnp.arange(S)
+        if cfg.remat:
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_saveable
+            elif cfg.remat_policy == "full":
+                policy = None
+            else:
+                raise ValueError(
+                    f"unknown remat_policy {cfg.remat_policy!r}; "
+                    "expected 'full' or 'dots'"
+                )
+            block_cls = nn.remat(Block, policy=policy)
+        else:
+            block_cls = Block
         for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"layer_{i}")(x, positions)
+            x = block_cls(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(name="final_norm")(x)
         # tied output head via embed attend (fp32 logits)
         logits = embed.attend(x.astype(jnp.float32))

@@ -116,32 +116,36 @@ def make_classifier_train_step(
     return bundle
 
 
+def optimizer_state_shardings(abstract_opt_state, abstract_params, param_sh, repl):
+    """Optimizer slots whose treedef matches params (momentum, nu, …) follow
+    the param shardings; everything else (counts, scalars) is replicated.
+    Public: benches/training loops that build their own state need it too —
+    replicating AdamW moments for a sharded model silently wastes HBM."""
+    params_treedef = jax.tree_util.tree_structure(abstract_params)
+
+    def assign(subtree):
+        try:
+            if jax.tree_util.tree_structure(subtree) == params_treedef:
+                return param_sh
+        except Exception:
+            pass
+        return None
+
+    return _map_matching_subtrees(abstract_opt_state, assign, repl)
+
+
 def _state_shardings(abstract_state, mesh, param_rule):
     """Shard params and matching optimizer slots by the rule; replicate rest."""
     param_sh = meshlib.param_shardings(mesh, abstract_state["params"], param_rule)
     repl = meshlib.replicated(mesh)
-
-    def map_opt(tree):
-        # Anything in opt_state whose treedef matches params (momentum, nu, …)
-        # follows the param shardings; everything else is replicated.
-        params_treedef = jax.tree_util.tree_structure(abstract_state["params"])
-
-        def assign(subtree):
-            try:
-                if jax.tree_util.tree_structure(subtree) == params_treedef:
-                    return param_sh
-            except Exception:
-                pass
-            return None
-
-        return _map_matching_subtrees(tree, assign, repl)
-
     return {
         "params": param_sh,
         "batch_stats": jax.tree_util.tree_map(
             lambda _: repl, abstract_state["batch_stats"]
         ),
-        "opt_state": map_opt(abstract_state["opt_state"]),
+        "opt_state": optimizer_state_shardings(
+            abstract_state["opt_state"], abstract_state["params"], param_sh, repl
+        ),
         "step": repl,
     }
 

@@ -289,16 +289,27 @@ def build_notebook(body: dict, namespace: str, defaults: dict, creator: str) -> 
             "tpu_num_slices": int(tpu.get("numSlices", 1) or 1),
         }
 
+    server_type = fv(body, defaults, "serverType")
+    annotations = {
+        api.CREATOR_ANNOTATION: creator,
+        api.SERVER_TYPE_ANNOTATION: server_type,
+    }
+    if server_type in ("codeserver", "rstudio"):
+        # these servers cannot serve under an arbitrary prefix; the
+        # VirtualService rewrites /notebook/<ns>/<name>/ -> / for them
+        # (ref JWA form.py sets the same rewrite annotations)
+        from kubeflow_tpu.controllers.notebook_controller import (
+            REWRITE_ANNOTATION,
+        )
+
+        annotations[REWRITE_ANNOTATION] = "/"
     nb = api.notebook(
         name,
         namespace,
         image=fv(body, defaults, "image"),
         cpu=str(fv(body, defaults, "cpu")),
         memory=str(fv(body, defaults, "memory")),
-        annotations={
-            api.CREATOR_ANNOTATION: creator,
-            api.SERVER_TYPE_ANNOTATION: fv(body, defaults, "serverType"),
-        },
+        annotations=annotations,
         labels={c: "true" for c in fv(body, defaults, "configurations") or []},
         **tpu_kwargs,
     )

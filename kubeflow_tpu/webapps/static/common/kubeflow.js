@@ -24,7 +24,9 @@
     });
     const data = await resp.json().catch(() => ({}));
     if (!resp.ok || data.success === false) {
-      throw new Error(data.log || resp.statusText);
+      const err = new Error(data.log || resp.statusText);
+      err.status = resp.status;  // callers distinguish 404 from transient
+      throw err;
     }
     return data;
   }

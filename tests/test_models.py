@@ -121,6 +121,31 @@ class TestTransformer:
         assert k_kernel.shape == (64, 2, 16)
         assert model.apply(vars_, tokens).shape == (1, 32, 128)
 
+    def test_remat_matches_no_remat(self):
+        """jax.checkpoint must change memory, not math."""
+        import numpy as np
+        kw = dict(vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,
+                  mlp_dim=64, max_seq_len=16, attention_impl="xla",
+                  dtype=jnp.float32)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (2, 16)), jnp.int32)
+        base = TransformerLM(TransformerConfig(**kw))
+        vars_ = base.init(jax.random.PRNGKey(0), tokens)
+        rematted = TransformerLM(TransformerConfig(remat=True, **kw))
+        out_a = base.apply(vars_, tokens)
+        out_b = rematted.apply(vars_, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out_a), np.asarray(out_b), atol=1e-5)
+        # gradients agree too (the bwd pass is where remat rewires things)
+        def loss(m, v):
+            return lm_loss(m.apply(v, tokens), tokens)
+        g_a = jax.grad(lambda v: loss(base, v))(vars_)
+        g_b = jax.grad(lambda v: loss(rematted, v))(vars_)
+        flat_a = jax.tree_util.tree_leaves(g_a)
+        flat_b = jax.tree_util.tree_leaves(g_b)
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
     def test_lm_training_reduces_loss(self):
         cfg = tiny_cfg()
         model = TransformerLM(cfg)

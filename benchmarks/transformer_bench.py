@@ -28,6 +28,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -39,6 +41,7 @@ from kubeflow_tpu.models.transformer import (
     lm_loss,
 )
 from kubeflow_tpu.parallel import mesh as meshlib
+from kubeflow_tpu.parallel.train import optimizer_state_shardings
 
 PEAK_FLOPS = {
     "v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
@@ -94,8 +97,6 @@ def main() -> None:
         mesh, abstract["params"], meshlib.fsdp_param_spec
     )
     repl = meshlib.replicated(mesh)
-    from kubeflow_tpu.parallel.train import optimizer_state_shardings
-
     shardings = {
         "params": param_sh,
         "opt_state": optimizer_state_shardings(
@@ -109,8 +110,6 @@ def main() -> None:
         int(np.prod(p.shape))
         for p in jax.tree_util.tree_leaves(state["params"])
     )
-
-    import functools
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state, tokens):

@@ -34,13 +34,15 @@ vol="contract-home-$$"
 docker volume create "${vol}" >/dev/null
 docker run -d --name "${NAME}-seed" -v "${vol}:/home/jovyan" "${IMAGE}" >/dev/null
 sleep 10
-seeded=$(docker exec "${NAME}-seed" /bin/sh -c \
-  'ls -A /home/jovyan | head -1; stat -c %u /home/jovyan/.[!.]* /home/jovyan/* 2>/dev/null | sort -u | head -3' || true)
+owners=$(docker exec "${NAME}-seed" /bin/sh -c \
+  'stat -c %u /home/jovyan/.[!.]* /home/jovyan/* 2>/dev/null | sort -u' || true)
 docker rm -f "${NAME}-seed" >/dev/null; docker volume rm "${vol}" >/dev/null
-echo "${seeded}" | grep -q . || { echo "FAIL: \$HOME not re-seeded"; exit 1; }
-if echo "${seeded}" | tail -n +2 | grep -qv '^1000$'; then
-  echo "FAIL: re-seeded files not owned by uid 1000: ${seeded}"; exit 1
-fi
+# empty output = nothing seeded OR stat unsupported — both are failures: the
+# ownership contract must be POSITIVELY established
+[ "${owners}" = "1000" ] || {
+  echo "FAIL: re-seeded \$HOME owners '${owners:-<none>}', want exactly 1000"
+  exit 1
+}
 
 echo "=== ${IMAGE}: serves :8888 (${MODE:-under NB_PREFIX})"
 docker run -d --name "${NAME}" -e NB_PREFIX="${PREFIX}" -p 127.0.0.1::8888 "${IMAGE}"

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.auth.rbac import Authorizer
+from kubeflow_tpu.controllers.notebook_controller import REWRITE_ANNOTATION
 from kubeflow_tpu.culler.culler import format_time
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import FakeCluster
@@ -298,10 +299,6 @@ def build_notebook(body: dict, namespace: str, defaults: dict, creator: str) -> 
         # these servers cannot serve under an arbitrary prefix; the
         # VirtualService rewrites /notebook/<ns>/<name>/ -> / for them
         # (ref JWA form.py sets the same rewrite annotations)
-        from kubeflow_tpu.controllers.notebook_controller import (
-            REWRITE_ANNOTATION,
-        )
-
         annotations[REWRITE_ANNOTATION] = "/"
     nb = api.notebook(
         name,

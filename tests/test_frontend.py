@@ -136,6 +136,40 @@ class TestDetailPageFlow:
         )
         assert get_json(r)["success"]
 
+    def test_spawner_full_form_body(self, platform):
+        """The exact body the enriched spawner form posts: TPU + numSlices,
+        explicit no-workspace, PodDefault configurations."""
+        cluster, m = platform
+        cluster.create(api.pod_default(
+            "tpu-creds", "alice",
+            selector={"matchLabels": {"use-tpu-creds": "true"}},
+            env=[{"name": "X", "value": "y"}],
+        ))
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={
+                "name": "nb",
+                "tpu": {"accelerator": "v4", "topology": "2x2x2",
+                        "numSlices": 2},
+                "workspace": None,
+                "configurations": ["use-tpu-creds"],
+            },
+            headers=auth(client),
+        )
+        assert get_json(r)["success"], r.get_data()
+        nb = cluster.get("Notebook", "nb", "alice")
+        assert nb["spec"]["tpu"]["numSlices"] == 2
+        assert nb["metadata"]["labels"]["use-tpu-creds"] == "true"
+        # no workspace PVC (the TPU path's dshm emptyDir is expected)
+        vols = nb["spec"]["template"]["spec"].get("volumes") or []
+        assert not any("persistentVolumeClaim" in v for v in vols)
+        # poddefaults listing feeds the form's checkbox labels
+        pds = get_json(
+            client.get("/api/namespaces/alice/poddefaults", headers=ALICE)
+        )["poddefaults"]
+        assert pds[0]["label"] == "use-tpu-creds"
+
     def test_detail_pages_are_served(self, platform):
         cluster, _ = platform
         client = Client(jupyter.create_app(cluster))

@@ -255,6 +255,7 @@ class APIServer:
         self._events: list[tuple[int, str, str, dict]] = []  # rev, type, plural, obj
         self._pod_logs: dict[tuple[str, str], list[tuple[str, str]]] = {}
         self._stop = threading.Event()
+        self._watch_generation = 0  # bump to sever live watch streams
         self._gc_interval = gc_interval
         self._httpd: ThreadingHTTPServer | None = None
 
@@ -339,6 +340,14 @@ class APIServer:
     def object_count(self) -> int:
         with self._lock:
             return len(self._objects)
+
+    def drop_watches(self) -> None:
+        """Sever every live watch stream (chaos hook: simulates the apiserver
+        closing long-running connections, which real ones do routinely —
+        clients must re-list and resume)."""
+        with self._watch_cond:
+            self._watch_generation += 1
+            self._watch_cond.notify_all()
 
     # -------------------------------------------------------------- routing
 
@@ -651,10 +660,13 @@ class APIServer:
         handler.send_header("Connection", "close")
         handler.end_headers()
         handler.close_connection = True
+        generation = self._watch_generation
         while not self._stop.is_set():
             batch = []
             with self._watch_cond:
                 while True:
+                    if self._watch_generation != generation:
+                        return  # severed: connection closes, client re-lists
                     batch = [
                         (rev, ev, obj)
                         for rev, ev, p, obj in self._events

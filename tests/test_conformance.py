@@ -162,6 +162,26 @@ class TestClientConformance:
         client.delete("Notebook", "nb1", "team-a")
         eventually(lambda: ("DELETED", "nb1") in seen)
 
+    def test_watch_survives_severed_connections(self, env):
+        """Real apiservers routinely close long watch connections; the
+        client's watch loop must re-list and keep delivering events."""
+        server, client = env
+        seen = []
+        client.watch(
+            "Notebook",
+            lambda ev, obj: seen.append((ev, obj["metadata"]["name"])),
+        )
+        client.create(api.notebook("nb1", "team-a"))
+        eventually(lambda: ("ADDED", "nb1") in seen)
+
+        server.drop_watches()
+        # events created while the stream is down arrive after reconnect
+        client.create(api.notebook("nb2", "team-a"))
+        eventually(lambda: ("ADDED", "nb2") in seen)
+        # and live events keep flowing on the new connection
+        client.delete("Notebook", "nb1", "team-a")
+        eventually(lambda: ("DELETED", "nb1") in seen)
+
     def test_sar_round_trip_over_http(self, env):
         server, client = env
         server.sar_policy = lambda spec: spec.get("user") == "alice@x.io"

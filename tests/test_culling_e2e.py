@@ -1,0 +1,209 @@
+"""Culling end-to-end over two real HTTP services.
+
+The full reference loop (``culler.go:149-237`` + requeue at
+``notebook_controller.go:252-281``) with nothing faked at the process
+boundary: the controller reconciles through ``KubeClient`` against the
+conformance apiserver, and kernel idleness is probed from a live Jupyter-like
+``/api/kernels`` HTTP endpoint (the fixture the reference notably lacks —
+SURVEY §4 "no fake notebook servers"). Idle kernels must drive the stop
+annotation through the REAL API server (merge patch, optimistic concurrency)
+and scale the gang to 0; activity must keep it alive; a restart must clear
+last-activity so the notebook is not instantly re-culled.
+"""
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.culler.culler import Culler
+from kubeflow_tpu.culler.probe import probe_many
+from kubeflow_tpu.runtime.kubeclient import KubeClient
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.testing.apiserver import APIServer
+from kubeflow_tpu.utils.config import ControllerConfig
+
+IDLE_MIN = 10
+
+
+class KernelState:
+    """Mutable kernel activity the fake notebook server reports."""
+
+    def __init__(self):
+        self.execution_state = "idle"
+        self.last_activity = "1970-01-01T00:00:00Z"
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    state: KernelState = None  # set by fixture
+
+    def do_GET(self):
+        if self.path.endswith("/api/kernels"):
+            body = json.dumps(
+                [
+                    {
+                        "execution_state": self.state.execution_state,
+                        "last_activity": self.state.last_activity,
+                    }
+                ]
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def stack():
+    state = KernelState()
+    handler = type("H", (_Handler,), {"state": state})
+    kernels = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=kernels.serve_forever, daemon=True).start()
+    apiserver = APIServer()
+    base = apiserver.start()
+    client = KubeClient(base_url=base, token="cull")
+    yield state, kernels.server_address, client
+    client.stop()
+    apiserver.stop()
+    kernels.shutdown()
+
+
+def http_fetch_kernels(addr):
+    """The production probe path (native prober when compiled) as the
+    culler's fetch_kernels hook."""
+    host, port = addr
+
+    def fetch(namespace, notebook):
+        [res] = probe_many(
+            [(host, port, f"/notebook/{namespace}/{notebook}/api/kernels")],
+            timeout=3.0,
+        )
+        return res.kernels()
+
+    return fetch
+
+
+class TestCullingOverHttp:
+    def test_idle_culls_activity_survives_restart_not_reculled(self, stack):
+        state, addr, client = stack
+        clock = {"t": 1_000_000.0}
+        culler = Culler(
+            enabled=True,
+            cull_idle_minutes=IDLE_MIN,
+            check_period_minutes=1,
+            fetch_kernels=http_fetch_kernels(addr),
+            clock=lambda: clock["t"],
+        )
+        m = Manager(client, clock=lambda: clock["t"])
+        m.register(NotebookReconciler(ControllerConfig(), culler=culler))
+        client.create(api.notebook("nb", "team"))
+
+        def until(pred, timeout=8.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                m.tick()
+                try:
+                    if pred():
+                        return
+                except Exception:
+                    pass
+                time.sleep(0.02)
+            raise AssertionError("condition not met")
+
+        def settle(quiet=3):
+            """Drain: keep ticking until several consecutive idle ticks."""
+            zeros = 0
+            deadline = time.time() + 8
+            while zeros < quiet and time.time() < deadline:
+                zeros = zeros + 1 if m.tick() == 0 else 0
+                time.sleep(0.02)
+
+        until(lambda: client.get("StatefulSet", "nb", "team")["spec"]["replicas"] == 1)
+
+        # busy kernel: advance well past the idle window — stays up
+        state.execution_state = "busy"
+        for _ in range(IDLE_MIN + 3):
+            clock["t"] += 60
+            settle()
+        nb = client.get("Notebook", "nb", "team")
+        assert api.STOP_ANNOTATION not in nb["metadata"].get("annotations", {})
+
+        # idle with stale last_activity: culled via the real apiserver
+        state.execution_state = "idle"
+        for _ in range(IDLE_MIN + 3):
+            clock["t"] += 60
+            settle()
+        nb = client.get("Notebook", "nb", "team")
+        assert api.STOP_ANNOTATION in nb["metadata"]["annotations"]
+        assert client.get("StatefulSet", "nb", "team")["spec"]["replicas"] == 0
+
+        # JWA-style restart: remove the annotation with a null merge patch.
+        # The restarted pod's jupyter has FRESH kernels (new server) — the
+        # fixture must reflect that or it would model a server that somehow
+        # kept running while stopped.
+        from kubeflow_tpu.culler.culler import format_time
+
+        state.last_activity = format_time(clock["t"])
+        client.patch(
+            "Notebook", "nb", "team",
+            {"metadata": {"annotations": {api.STOP_ANNOTATION: None}}},
+        )
+        until(lambda: client.get("StatefulSet", "nb", "team")["spec"]["replicas"] == 1)
+        # and it must not be instantly re-culled (last-activity was reset)
+        clock["t"] += 60
+        settle()
+        nb = client.get("Notebook", "nb", "team")
+        assert api.STOP_ANNOTATION not in nb["metadata"].get("annotations", {})
+
+    def test_unreachable_kernel_endpoint_culls_only_after_idle_window(self, stack):
+        state, addr, client = stack
+        clock = {"t": 1_000_000.0}
+        culler = Culler(
+            enabled=True,
+            cull_idle_minutes=IDLE_MIN,
+            check_period_minutes=1,
+            fetch_kernels=http_fetch_kernels(("127.0.0.1", 1)),  # dead port
+            clock=lambda: clock["t"],
+        )
+        m = Manager(client, clock=lambda: clock["t"])
+        m.register(NotebookReconciler(ControllerConfig(), culler=culler))
+        client.create(api.notebook("nb", "team"))
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            m.tick()
+            if client.try_get("StatefulSet", "nb", "team"):
+                break
+            time.sleep(0.02)
+
+        def advance_minutes(n):
+            for _ in range(n):
+                clock["t"] += 60
+                t0 = time.time()
+                zeros = 0
+                while zeros < 3 and time.time() - t0 < 2:
+                    zeros = zeros + 1 if m.tick() == 0 else 0
+                    time.sleep(0.02)
+
+        # unreachable is NOT idleness: within the idle window nothing happens
+        # (ref culler.go:217-226 leaves last-activity untouched on failure)
+        advance_minutes(IDLE_MIN // 2)
+        nb = client.get("Notebook", "nb", "team")
+        assert api.STOP_ANNOTATION not in nb["metadata"].get("annotations", {})
+
+        # ...but a server unreachable past the whole idle window is culled —
+        # the last-activity annotation ages out exactly as in the reference
+        # (a crashed server must not hold its slice forever)
+        advance_minutes(IDLE_MIN)
+        nb = client.get("Notebook", "nb", "team")
+        assert api.STOP_ANNOTATION in nb["metadata"]["annotations"]

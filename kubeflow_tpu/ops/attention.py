@@ -92,7 +92,9 @@ def blockwise_attention(q, k, v, *, causal: bool = True, block_size: int = 512):
     Sk = k.shape[1]
     bs = min(block_size, Sk)
     if Sk % bs:
-        raise ValueError(f"sequence {Sk} must divide block_size {bs}")
+        raise ValueError(
+            f"block_size {bs} must divide the sequence length {Sk}"
+        )
     n_blocks = Sk // bs
     scale = D ** -0.5
 

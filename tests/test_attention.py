@@ -83,3 +83,31 @@ def test_ring_is_differentiable(qkv):
     np.testing.assert_allclose(
         np.asarray(jax.grad(f)(q)), np.asarray(jax.grad(f_ref)(q)), atol=5e-4
     )
+
+
+def test_blockwise_gradients_match_naive(qkv):
+    """The scan body is checkpointed (bwd recomputes block probabilities
+    instead of saving the full S^2 residual set) — math must be unchanged."""
+    q, k, v = qkv
+
+    def f_ref(q, k, v):
+        return jnp.sum(naive_attention(q, k, v, causal=True) ** 2)
+
+    def f_blk(q, k, v):
+        return jnp.sum(
+            blockwise_attention(q, k, v, causal=True, block_size=64) ** 2
+        )
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    g = jax.grad(f_blk, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+# NOTE on the jax.checkpoint in blockwise_attention's scan body: its memory
+# effect is only observable on the TPU backend (CPU XLA compiles to the same
+# temp footprint either way, and the remat primitive is invisible through
+# the jit wrapper in jaxpr text), so the regression evidence lives in the
+# recorded hardware runs: ATTENTION_BENCH_r02.json's 16k/32k rows OOM'd
+# before the fix and run after it. The math is pinned above by
+# test_blockwise_gradients_match_naive.

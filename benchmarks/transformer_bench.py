@@ -71,7 +71,7 @@ def main() -> None:
     # length and OOMs past 8k; see benchmarks/attention_bench.py)
     long_ctx = "--long" in sys.argv
     seq = 8192 if long_ctx else SEQ
-    batch = 2 if long_ctx else BATCH
+    batch = 1 if long_ctx else BATCH
     devices = jax.devices()
     n_chips = len(devices)
     mesh = meshlib.create_mesh(meshlib.MeshPlan(data=n_chips), devices=devices)

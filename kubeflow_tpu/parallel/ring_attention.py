@@ -40,6 +40,12 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     # originally living on (my_idx - r) mod n
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    # checkpointed like blockwise_attention's body: autodiff would otherwise
+    # save per-step f32 probabilities [n, B, H, S_local, S_local] — the local
+    # S^2 chunk stack — defeating ring attention's O(S/n) memory point. The
+    # backward re-runs the ppermute ring to recompute scores, which is the
+    # published ring-attention backward anyway.
+    @partial(jax.checkpoint, prevent_cse=False)
     def step(carry, r):
         o, m, l, k_cur, v_cur = carry
         src = (my_idx - r) % n

@@ -272,6 +272,42 @@ class TestControllersEndToEnd:
             ns["metadata"]["annotations"]["owner"] == "alice@x.io"
         )
 
+    def test_tensorboard_lifecycle(self, env):
+        """Tensorboard CR -> Deployment + Service + VirtualService over real
+        HTTP (ref tensorboard_controller.go:67-157), gs:// logdir flavor."""
+        from kubeflow_tpu.controllers.tensorboard_controller import (
+            TensorboardReconciler,
+        )
+
+        server, client = env
+        m = Manager(client, clock=time.time)
+        m.register(TensorboardReconciler())
+        client.create(
+            api.tensorboard("tb1", "team-a", "gs://bucket/experiments/run1")
+        )
+
+        def ready():
+            m.tick()
+            return (
+                client.try_get("Deployment", "tb1", "team-a") is not None
+                and client.try_get("Service", "tb1", "team-a") is not None
+            )
+
+        eventually(ready)
+        dep = client.get("Deployment", "tb1", "team-a")
+        [container] = dep["spec"]["template"]["spec"]["containers"]
+        assert any(
+            "gs://bucket/experiments/run1" in a
+            for a in container.get("args", []) + container.get("command", [])
+        )
+        client.delete("Tensorboard", "tb1", "team-a")
+
+        def gone():
+            m.tick()
+            return client.try_get("Deployment", "tb1", "team-a") is None
+
+        eventually(gone)
+
     def test_notebook_status_written_via_subresource(self, env):
         """The controller's status aggregation must survive real subresource
         semantics (a fake that let .status ride the main PUT would hide a

@@ -87,7 +87,10 @@ def finalize(o, m, l):
 @partial(jax.jit, static_argnames=("causal", "block_size"))
 def blockwise_attention(q, k, v, *, causal: bool = True, block_size: int = 512):
     """Memory-efficient attention: O(S·block) memory, identical math to
-    ``naive_attention``. Differentiable (pure lax ops; XLA rematerializes)."""
+    ``naive_attention`` — through the BACKWARD pass too: the scan body is
+    checkpointed, so autodiff recomputes each block's probabilities instead
+    of saving [n_blocks, B, H, S, block] f32 residuals (the full S^2 matrix
+    again, which OOM'd the backward at 16k on 16GB HBM)."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     bs = min(block_size, Sk)
@@ -101,6 +104,8 @@ def blockwise_attention(q, k, v, *, causal: bool = True, block_size: int = 512):
     k_blocks = k.reshape(B, n_blocks, bs, H, D).transpose(1, 0, 2, 3, 4)
     v_blocks = v.reshape(B, n_blocks, bs, H, D).transpose(1, 0, 2, 3, 4)
 
+    # prevent_cse=False is the documented-safe setting under scan/jit
+    @partial(jax.checkpoint, prevent_cse=False)
     def scan_kv(carry, xs):
         idx, k_blk, v_blk = xs
         s = blockwise_scores(q, k_blk, scale, 0, idx * bs, causal)

@@ -23,7 +23,18 @@ from kubeflow_tpu.ops import attention as attn
 from kubeflow_tpu.ops import pallas_attention as pattn
 
 B, H, D = 2, 8, 128
-N_SHORT, N_LONG, REPEATS = 3, 13, 3
+REPEATS = 3
+
+
+def windows_for(seq: int) -> tuple[int, int]:
+    """Short/long window sizes: fast (small-seq) steps need many more
+    iterations or the two-window subtraction is dominated by dispatch
+    noise (observed: negative deltas at seq 2048 with 3/13 windows)."""
+    if seq <= 2048:
+        return 20, 120
+    if seq <= 8192:
+        return 5, 25
+    return 3, 13
 
 
 def impls(block: int):
@@ -38,7 +49,9 @@ def impls(block: int):
     }
 
 
-def measure(fn, q, k, v):
+def measure(fn, q, k, v, seq):
+    n_short, n_long = windows_for(seq)
+
     def loss(q, k, v):
         return jnp.sum(fn(q, k, v).astype(jnp.float32))
 
@@ -51,12 +64,12 @@ def measure(fn, q, k, v):
         float(jnp.sum(gq[:1, :1, :1].astype(jnp.float32)))
         return time.perf_counter() - t
 
-    window(N_SHORT)  # compile + warm
+    window(n_short)  # compile + warm
     rates = []
     for _ in range(REPEATS):
-        ts = window(N_SHORT)
-        tl = window(N_LONG)
-        rates.append((tl - ts) / (N_LONG - N_SHORT))
+        ts = window(n_short)
+        tl = window(n_long)
+        rates.append((tl - ts) / (n_long - n_short))
     return statistics.median(rates)
 
 
@@ -74,7 +87,7 @@ def main():
                 # length so an OOM in the record is an observed failure,
                 # not an assumption (it fails compiling the S^2 scores
                 # past 8k on 16GB HBM)
-                sec = measure(fn, q, k, v)
+                sec = measure(fn, q, k, v, seq)
                 results.append(
                     {"impl": name, "seq": seq, "ms": round(sec * 1000, 2)}
                 )

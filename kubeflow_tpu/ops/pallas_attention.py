@@ -1,20 +1,31 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
-The hot op of the transformer family gets a hand-tiled kernel (SURVEY.md has no
+The hot op of the transformer family gets hand-tiled kernels (SURVEY.md has no
 reference analog — the reference's compute lives in opaque CUDA wheels; this is
 the platform's native-kernel layer, per the Pallas TPU guide):
 
-- grid (B, H, q_blocks, k_blocks): q/k/v blocks staged HBM→VMEM by BlockSpecs,
-  k as the innermost (sequential) dimension so VMEM scratch carries the
-  streaming-softmax state (acc, m, l) across k-iterations;
-- scores on the MXU via ``jnp.dot(..., preferred_element_type=f32)``,
-  softmax bookkeeping on the VPU in fp32, output written once on the last
-  k-block;
-- lane-replicated (bq, 128) m/l scratch to respect the fp32 (8,128) tile.
+- forward: grid (B, H, q_blocks, k_blocks), k innermost (sequential) so VMEM
+  scratch carries the streaming-softmax state (acc, m, l) across k-iterations;
+  emits the logsumexp residual ``lse = m + log(l)`` ([B, H, S, 8] — replicated
+  only to the 8 f32 sublanes, not 128 lanes, so the backward's per-iteration
+  residual fetch stays small) when gradients are needed;
+- backward (FlashAttention-2 style): a dq kernel on grid (B, H, nq, nk) and a
+  dk/dv kernel on grid (B, H, nk, nq), each recomputing block scores from the
+  saved (q, k, v, o, lse) — O(S·block) memory, no S^2 residuals; the
+  dp-correction ``delta = rowsum(do*o)`` is computed on the VPU from the o
+  tile already in VMEM instead of being materialized in HBM;
+- all matmuls feed the MXU in the input dtype (bf16) with
+  ``preferred_element_type=f32`` accumulation; softmax/ds bookkeeping on the
+  VPU in fp32;
+- causal runs skip fully-masked blocks: the kernel body is gated by
+  ``pl.when`` and the index maps re-point skipped iterations at the next
+  block that will actually be used, so no DMA is wasted — ~2x for long
+  sequences.
 
-Backward pass: recompute via the XLA blockwise path (``ops/attention.py``)
-under ``jax.custom_vjp`` — O(S·block) memory like the forward. A fused Pallas
-bwd kernel is a later-round optimization.
+The residual/lane-replication conventions follow the public JAX Pallas
+flash-attention op (jax.experimental.pallas.ops.tpu.flash_attention — Apache
+2.0; see SNIPPETS.md); the kernels here are this repo's own, built on
+``ops/attention.py``'s streaming-softmax math.
 
 Runs in interpreter mode off-TPU (tests), compiled Mosaic on TPU.
 """
@@ -24,6 +35,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 
 try:  # TPU-specific pallas extras are absent on CPU-only installs
@@ -34,14 +46,61 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-from kubeflow_tpu.ops.attention import NEG_INF, blockwise_attention
+from kubeflow_tpu.ops.attention import NEG_INF
 
 LANES = 128
+LSE_LANES = 8   # f32 sublane count: the lse residual is replicated to 8
+                # lanes, not 128 — 16x less HBM + fetch bandwidth in bwd
+# dot_general dimension numbers for a @ b.T on 2D blocks
+_TRANS_B = (((1,), (1,)), ((), ()))
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, causal, bq, bk, nk):
-    ik = pl.program_id(3)
-    iq = pl.program_id(2)
+def _causal_mask(s, iq, ik, bq, bk):
+    qpos = iq * bq + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    kpos = ik * bk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(kpos <= qpos, s, NEG_INF)
+
+
+def _when_valid(skip, cond, fn):
+    """Run fn under pl.when(cond) if block skipping is on, else always."""
+    if skip:
+        pl.when(cond)(fn)
+    else:
+        fn()
+
+
+def _major_index(b, h, major, minor):
+    return (b, h, major, 0)
+
+
+def _minor_index(skip, valid, fallback):
+    """BlockSpec index map selecting the MINOR grid axis's block; when causal
+    block skipping is on, re-points skipped iterations (per ``valid(major,
+    minor)``) at ``fallback(major, minor)`` — the next block that will really
+    be fetched — so masked-out blocks cost no DMA."""
+    def index(b, h, major, minor):
+        if skip:
+            minor = lax.select(valid(major, minor), minor, fallback(major, minor))
+        return (b, h, minor, 0)
+    return index
+
+
+def _kv_at_minor(skip):
+    # fwd/dq grids (b, h, iq, ik): k/v blocks walk the minor (ik) axis
+    return _minor_index(skip, lambda iq, ik: ik <= iq, lambda iq, ik: 0)
+
+
+def _q_at_minor(skip):
+    # dkv grid (b, h, ik, iq): q-side blocks walk the minor (iq) axis;
+    # skipped q blocks re-point at the diagonal (first valid for this k)
+    return _minor_index(skip, lambda ik, iq: iq >= ik, lambda ik, iq: ik)
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, skip, bq, bk, nk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
     def _init():
@@ -49,77 +108,260 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, c
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
-    k = k_ref[0, 0].astype(jnp.float32)          # [bk, D]
-    v = v_ref[0, 0].astype(jnp.float32)          # [bk, D]
+    def _body():
+        q = q_ref[0, 0]                               # [bq, D] input dtype
+        k = k_ref[0, 0]                               # [bk, D]
+        v = v_ref[0, 0]                               # [bk, D]
 
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale  # [bq, bk]
-    if causal:
-        qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.where(kpos <= qpos, s, NEG_INF)
+        s = lax.dot_general(
+            q, k, _TRANS_B, preferred_element_type=jnp.float32
+        ) * scale                                     # [bq, bk] f32
+        if causal:
+            s = _causal_mask(s, iq, ik, bq, bk)
 
-    m_prev = m_ref[:, :1]                         # [bq, 1] (lane-replicated)
-    l_prev = l_ref[:, :1]
-    m_blk = jnp.max(s, axis=-1, keepdims=True)    # [bq, 1]
-    m_new = jnp.maximum(m_prev, m_blk)
-    p = jnp.exp(s - m_new)                        # [bq, bk]
-    corr = jnp.exp(m_prev - m_new)                # [bq, 1]
-    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[...] = acc_ref[...] * corr + jnp.dot(
-        p, v, preferred_element_type=jnp.float32
-    )
-    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        m_prev = m_ref[:, :1]                         # [bq, 1] (lane-replicated)
+        l_prev = l_ref[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)    # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)                        # [bq, bk] f32
+        corr = jnp.exp(m_prev - m_new)                # [bq, 1]
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(ik == nk - 1)
+    _when_valid(skip, ik <= iq, _body)
+
+    @pl.when(ik == (iq if skip else nk - 1))
     def _finalize():
+        m = m_ref[:, :1]
         l = l_ref[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0, ...] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # fully-masked rows get +inf so bwd's exp(s - lse) stays 0
+            lse = jnp.where(l == 0.0, jnp.inf, m + jnp.log(l_safe))
+            lse_ref[0, 0, ...] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
-    B, H, Sq, D = q.shape
-    Sk = k.shape[2]
-    bq = min(block_q, Sq)
-    bk = min(block_k, Sk)
+def _block_plan(Sq, Sk, block_q, block_k, causal):
+    bq, bk = min(block_q, Sq), min(block_k, Sk)
     if Sq % bq or Sk % bk:
         raise ValueError(f"seq lengths ({Sq},{Sk}) must divide blocks ({bq},{bk})")
     nq, nk = Sq // bq, Sk // bk
+    # causal block skipping assumes square self-attention tiling
+    skip = causal and Sq == Sk and bq == bk
+    return bq, bk, nq, nk, skip
+
+
+def _scratch(shape):
+    return pltpu.VMEM(shape, jnp.float32) if _HAS_PLTPU else pl.MemorySpace.ANY
+
+
+def _compiler_params(interpret):
+    if _HAS_PLTPU and not interpret:
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        )
+    return None
+
+
+def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
+                   save_residuals=False):
+    """q/k/v in [B, H, S, D]; returns o (and lse [B, H, Sq, LSE_LANES] f32)."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq, bk, nq, nk, skip = _block_plan(Sq, Sk, block_q, block_k, causal)
     scale = D ** -0.5
 
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+        _fwd_kernel, scale=scale, causal=causal, skip=skip,
+        bq=bq, bk=bk, nk=nk,
     )
-    scratch = [
-        pltpu.VMEM((bq, D), jnp.float32) if _HAS_PLTPU else pl.MemorySpace.ANY,
-        pltpu.VMEM((bq, LANES), jnp.float32) if _HAS_PLTPU else pl.MemorySpace.ANY,
-        pltpu.VMEM((bq, LANES), jnp.float32) if _HAS_PLTPU else pl.MemorySpace.ANY,
-    ]
-    grid = (B, H, nq, nk)
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
+    out_shape = [jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype)]
+    out_specs = [pl.BlockSpec((1, 1, bq, D), _major_index)]
+    if save_residuals:
+        out_shape.append(
+            jax.ShapeDtypeStruct((B, H, Sq, LSE_LANES), jnp.float32)
+        )
+        out_specs.append(pl.BlockSpec((1, 1, bq, LSE_LANES), _major_index))
+
+    def wrapped(*refs):
+        if save_residuals:
+            q_ref, k_ref, v_ref, o_ref, lse_ref = refs[:5]
+            scratch = refs[5:]
+        else:
+            q_ref, k_ref, v_ref, o_ref = refs[:4]
+            lse_ref, scratch = None, refs[4:]
+        kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch)
+
+    outs = pl.pallas_call(
+        wrapped,
+        grid=(B, H, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, bq, D), _major_index),
+            pl.BlockSpec((1, 1, bk, D), _kv_at_minor(skip)),
+            pl.BlockSpec((1, 1, bk, D), _kv_at_minor(skip)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
-        scratch_shapes=scratch,
-        compiler_params=(
-            pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
-            )
-            if _HAS_PLTPU and not interpret
-            else None
-        ),
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            _scratch((bq, D)), _scratch((bq, LANES)), _scratch((bq, LANES)),
+        ],
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
     )(q, k, v)
-    return out
+    return tuple(outs) if save_residuals else outs[0]
 
+
+# ---------------------------------------------------------------- backward
+
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, acc_ref,
+               di_ref, *, scale, causal, skip, bq, bk, nk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        # delta_i = rowsum(do * o): depends only on the q block, so compute
+        # it once per row into VMEM scratch (from tiles already resident —
+        # no lane-replicated HBM array is ever materialized)
+        di = jnp.sum(
+            do_ref[0, 0].astype(jnp.float32)
+            * o_ref[0, 0].astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )                                             # [bq, 1] f32
+        di_ref[...] = jnp.broadcast_to(di, di_ref.shape)
+
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, :, :1]                    # [bq, 1] f32
+        di = di_ref[:, :1]                            # [bq, 1] f32
+
+        s = lax.dot_general(
+            q, k, _TRANS_B, preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            s = _causal_mask(s, iq, ik, bq, bk)
+        p = jnp.exp(s - lse)                          # [bq, bk] f32, normalized
+        dp = lax.dot_general(
+            do, v, _TRANS_B, preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - di) * scale                    # [bq, bk] f32
+        acc_ref[...] += lax.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+        )
+
+    _when_valid(skip, ik <= iq, _body)
+
+    @pl.when(ik == (iq if skip else nk - 1))
+    def _write():
+        dq_ref[0, 0, ...] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
+                dk_acc, dv_acc, *, scale, causal, skip, bq, bk, nq):
+    ik, iq = pl.program_id(2), pl.program_id(3)      # note: k major, q minor
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, :, :1]
+        di = jnp.sum(
+            do.astype(jnp.float32) * o_ref[0, 0].astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
+
+        s = lax.dot_general(
+            q, k, _TRANS_B, preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            s = _causal_mask(s, iq, ik, bq, bk)
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        dv_acc[...] += lax.dot(
+            p.T.astype(do.dtype), do, preferred_element_type=jnp.float32
+        )
+        dp = lax.dot_general(
+            do, v, _TRANS_B, preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - di) * scale
+        dk_acc[...] += lax.dot(
+            ds.T.astype(q.dtype), q, preferred_element_type=jnp.float32
+        )
+
+    _when_valid(skip, iq >= ik, _body)
+
+    @pl.when(iq == nq - 1)
+    def _write():
+        dk_ref[0, 0, ...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, ...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k,
+                    interpret):
+    """All operands [B, H, S, D] (lse [B, H, Sq, LSE_LANES]); returns dq/dk/dv."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq, bk, nq, nk, skip = _block_plan(Sq, Sk, block_q, block_k, causal)
+    scale = D ** -0.5
+
+    q_side = pl.BlockSpec((1, 1, bq, D), _major_index)
+    lse_at_major = pl.BlockSpec((1, 1, bq, LSE_LANES), _major_index)
+    kv_minor = pl.BlockSpec((1, 1, bk, D), _kv_at_minor(skip))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, skip=skip,
+            bq=bq, bk=bk, nk=nk,
+        ),
+        grid=(B, H, nq, nk),
+        in_specs=[q_side, kv_minor, kv_minor, q_side, q_side, lse_at_major],
+        out_specs=pl.BlockSpec((1, 1, bq, D), _major_index),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        scratch_shapes=[_scratch((bq, D)), _scratch((bq, LSE_LANES))],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v, o, do, lse)
+
+    q_minor = pl.BlockSpec((1, 1, bq, D), _q_at_minor(skip))
+    lse_at_minor = pl.BlockSpec((1, 1, bq, LSE_LANES), _q_at_minor(skip))
+    kv_major = pl.BlockSpec((1, 1, bk, D), _major_index)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, skip=skip,
+            bq=bq, bk=bk, nq=nq,
+        ),
+        grid=(B, H, nk, nq),
+        in_specs=[q_minor, kv_major, kv_major, q_minor, q_minor, lse_at_minor],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), _major_index),
+            pl.BlockSpec((1, 1, bk, D), _major_index),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[_scratch((bk, D)), _scratch((bk, D))],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v, o, do, lse)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- public op
 
 def _auto_interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
@@ -142,19 +384,26 @@ def flash_attention(
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    return flash_attention(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    if interpret is None:
+        interpret = _auto_interpret()
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out, lse = _flash_forward(
+        qt, kt, vt, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret, save_residuals=True,
+    )
+    return out.transpose(0, 2, 1, 3), (qt, kt, vt, out, lse)
 
 
 def _bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # memory-efficient recompute through the XLA blockwise path
-    _, vjp = jax.vjp(
-        lambda q, k, v: blockwise_attention(
-            q, k, v, causal=causal, block_size=block_k
-        ),
-        q, k, v,
+    if interpret is None:
+        interpret = _auto_interpret()
+    qt, kt, vt, out, lse = res
+    do = g.transpose(0, 2, 1, 3)
+    dq, dk, dv = _flash_backward(
+        qt, kt, vt, out, lse, do, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret,
     )
-    return vjp(g)
+    return tuple(x.transpose(0, 2, 1, 3) for x in (dq, dk, dv))
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -51,6 +51,68 @@ def test_flash_gradients(qkv):
         np.testing.assert_allclose(a, b, atol=5e-4)
 
 
+def test_flash_gradients_noncausal(qkv):
+    """Backward kernels without the causal block-skip fast path."""
+    q, k, v = qkv
+
+    def f_ref(q, k, v):
+        return jnp.sum(naive_attention(q, k, v, causal=False) ** 2)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, False, 64, 64) ** 2)
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    g = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+def test_flash_gradients_bf16(qkv):
+    """bf16 operands reach the MXU un-upcast; grads still track the oracle."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+
+    def f_ref(q, k, v):
+        return jnp.sum(naive_attention(q, k, v, causal=True) ** 2)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, 64, 64) ** 2)
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    g = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=0.15, rtol=0.1,
+        )
+
+
+@pytest.mark.parametrize(
+    "causal,q_len,bq,bk",
+    [
+        (False, 128, 64, 64),   # cross-length, non-causal
+        (True, 128, 64, 64),    # causal with Sq != Sk: skip fast path OFF
+        (True, 256, 32, 64),    # causal with bq != bk: skip fast path OFF
+    ],
+)
+def test_flash_no_skip_paths(qkv, causal, q_len, bq, bk):
+    """Configurations that disable causal block skipping (cross-length or
+    unequal block sizes) run the full-grid masked kernels — fwd and bwd must
+    still match the oracle."""
+    q, k, v = qkv
+    q = q[:, :q_len]
+    ref = naive_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal, bq, bk)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    g_ref = jax.grad(
+        lambda k: jnp.sum(naive_attention(q, k, v, causal=causal) ** 2)
+    )(k)
+    g = jax.grad(
+        lambda k: jnp.sum(flash_attention(q, k, v, causal, bq, bk) ** 2)
+    )(k)
+    np.testing.assert_allclose(g, g_ref, atol=5e-4)
+
+
 def test_blockwise_rejects_indivisible(qkv):
     q, k, v = qkv
     with pytest.raises(ValueError, match="must divide"):

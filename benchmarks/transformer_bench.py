@@ -2,12 +2,13 @@
 
 Round-1 gap (VERDICT Weak #1): nothing measured the transformer path — the
 flagship bench was ResNet only. This measures a GPT-class decoder (435M
-params incl. tied embedding, d=1024, L=24, seq 2048, bf16, XLA attention,
-full per-block remat) and prints one JSON line. Inside the rematted model,
-XLA attention still wins at seq 2048 (the remat'd backward recomputes the
-attention scan twice); standalone, the checkpointed blockwise path is the
-faster one even at 2048 and the only one past 8k — see BASELINE.md and
-``--long`` below:
+params incl. tied embedding, d=1024, L=24, 8 heads x head_dim 128, seq
+2048, bf16) and prints one JSON line. The measured-winning configuration
+(probe grid: benchmarks/transformer_probe.py, BASELINE.md "Round-2 sweep"):
+Pallas flash attention fwd+bwd kernels, dots_saveable remat, the chunked
+tied-head loss (lm_loss_chunked — full fp32 logits never materialize),
+head_dim 128 (a 64-wide head contraction half-fills the 128-wide MXU;
+8x128 is the TPU-native layout for d_model 1024), per-chip batch 4:
 
     {"metric": "transformer_train_tokens_per_sec_per_chip", "value": N,
      "unit": "tok/s/chip", "vs_baseline": R, "mfu": ...}
@@ -40,7 +41,7 @@ import optax
 from kubeflow_tpu.models.transformer import (
     TransformerConfig,
     TransformerLM,
-    lm_loss,
+    lm_loss_chunked,
 )
 from kubeflow_tpu.parallel import mesh as meshlib
 from kubeflow_tpu.parallel.train import optimizer_state_shardings
@@ -50,8 +51,9 @@ PEAK_FLOPS = {
     "v5p": 459e12, "v6e": 918e12, "v6 lite": 918e12,
 }
 
-BATCH = 8           # per-chip sequences
+BATCH = 4           # per-chip sequences (probe: 4 beats 2/6/8/16/32)
 SEQ = 2048
+CHUNK = 1024        # loss chunk (lm_loss_chunked)
 N_SHORT = 5
 N_LONG = 25
 REPEATS = 5
@@ -66,9 +68,9 @@ def chip_peak_flops(device) -> float:
 
 
 def main() -> None:
-    # --long: the long-context configuration (seq 8192, blockwise attention —
-    # the S^2-materializing XLA path is ~6x slower per attention at this
-    # length and OOMs past 8k; see benchmarks/attention_bench.py)
+    # --long: the long-context configuration (seq 8192, per-chip batch 1 —
+    # the S^2-materializing XLA path OOMs past 8k; flash wins at every
+    # measured length, see benchmarks/attention_bench.py)
     long_ctx = "--long" in sys.argv
     seq = 8192 if long_ctx else SEQ
     batch = 1 if long_ctx else BATCH
@@ -78,13 +80,15 @@ def main() -> None:
     cfg = TransformerConfig(
         vocab_size=32_000,
         num_layers=24,
-        num_heads=16,
+        num_heads=8,          # head_dim 128: full-width MXU contractions
         embed_dim=1024,
         mlp_dim=4096,
         max_seq_len=seq,
-        attention_impl="block" if long_ctx else "xla",
+        attention_impl="flash",
         attention_block_size=1024,
-        remat=True,  # activations at 24-layer depth exceed HBM otherwise
+        remat=True,           # activations at 24-layer depth exceed HBM
+        remat_policy="dots",  # fits once flash + chunked loss free the S^2
+                              # scores and fp32 logits; skips the recompute
         dtype=jnp.bfloat16,
     )
     model = TransformerLM(cfg)
@@ -122,8 +126,10 @@ def main() -> None:
     @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state, tokens):
         def loss_fn(params):
-            logits = model.apply({"params": params}, tokens)
-            return lm_loss(logits, tokens)
+            hidden = model.apply({"params": params}, tokens, return_hidden=True)
+            return lm_loss_chunked(
+                hidden, params["embed"]["embedding"], tokens, chunk=CHUNK
+            )
 
         loss, grads = jax.value_and_grad(loss_fn)(state["params"])
         updates, opt_state = tx.update(

@@ -1,0 +1,102 @@
+"""One-config transformer step-time probe (run one config per process so an
+OOM kills only that probe). Usage:
+
+    python benchmarks/transformer_probe.py IMPL REMAT BATCH [SEQ] [CHUNK] [HEADS]
+
+IMPL = xla|block|flash; REMAT = full|dots|none; prints one JSON line with
+median step seconds (two-window subtraction, same methodology as bench.py).
+CHUNK = 0 selects the full (unchunked) lm_loss — the round-1 baseline loss
+and the configuration whose fp32 logits make dots_saveable OOM (the
+"full logits" rows in BASELINE.md's sweep table).
+"""
+import functools
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kubeflow_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    lm_loss,
+    lm_loss_chunked,
+)
+
+
+def main():
+    impl, remat, batch = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    seq = int(sys.argv[4]) if len(sys.argv) > 4 else 2048
+    chunk = int(sys.argv[5]) if len(sys.argv) > 5 else 512
+    heads = int(sys.argv[6]) if len(sys.argv) > 6 else 16
+    cfg = TransformerConfig(
+        vocab_size=32_000,
+        num_layers=24,
+        num_heads=heads,
+        embed_dim=1024,
+        mlp_dim=4096,
+        max_seq_len=seq,
+        attention_impl=impl,
+        attention_block_size=min(1024, seq // 2) if impl != "xla" else 512,
+        remat=remat != "none",
+        remat_policy=remat if remat != "none" else "full",
+        dtype=jnp.bfloat16,
+    )
+    model = TransformerLM(cfg)
+    tx = optax.adamw(3e-4, weight_decay=0.1)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+
+    params = jax.jit(
+        lambda k: model.init(k, tokens)["params"]
+    )(jax.random.PRNGKey(0))
+    state = {"params": params, "opt_state": tx.init(params)}
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, tokens):
+        def loss_fn(p):
+            if chunk == 0:   # full-logits lm_loss (round-1 baseline path)
+                return lm_loss(model.apply({"params": p}, tokens), tokens)
+            hidden = model.apply({"params": p}, tokens, return_hidden=True)
+            return lm_loss_chunked(
+                hidden, p["embed"]["embedding"], tokens, chunk=chunk
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+        updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
+        return {
+            "params": optax.apply_updates(state["params"], updates),
+            "opt_state": opt_state,
+        }, loss
+
+    def window(n, state):
+        t = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            state, loss = step(state, tokens)
+        float(loss)
+        return time.perf_counter() - t, state
+
+    _, state = window(3, state)
+    rates = []
+    for _ in range(3):
+        ts, state = window(3, state)
+        tl, state = window(13, state)
+        rates.append((tl - ts) / 10)
+    sec = statistics.median(rates)
+    print(json.dumps({
+        "impl": impl, "remat": remat, "batch": batch, "seq": seq,
+        "chunk": chunk, "heads": heads, "step_s": round(sec, 4),
+        "tok_per_s": round(batch * seq / sec, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,7 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from kubeflow_tpu.ops import attention as att
 from kubeflow_tpu.ops.pallas_attention import flash_attention
@@ -158,7 +159,7 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True):
+    def __call__(self, tokens, train: bool = True, return_hidden: bool = False):
         cfg = self.cfg
         B, S = tokens.shape
         embed = nn.Embed(
@@ -183,6 +184,10 @@ class TransformerLM(nn.Module):
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(name="final_norm")(x)
+        if return_hidden:
+            # pre-head activations for the chunked loss (lm_loss_chunked):
+            # the [B, S, vocab] fp32 logits never materialize
+            return x
         # tied output head via embed attend (fp32 logits)
         logits = embed.attend(x.astype(jnp.float32))
         return logits
@@ -194,3 +199,45 @@ def lm_loss(logits, tokens):
     tgt = tokens[:, 1:]
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)
+
+
+def lm_loss_chunked(hidden, embedding, tokens, *, chunk: int = 512):
+    """Next-token cross entropy with the tied head folded in, chunked over
+    the sequence so the [B, S, vocab] fp32 logits (and log-softmax residual —
+    ~4 GB at batch 8 / seq 2048 / vocab 32k) never exist at once.
+
+    ``hidden`` is the model's ``return_hidden=True`` output [B, S, E];
+    ``embedding`` the tied [vocab, E] table. Each scan step computes one
+    chunk's logits on the MXU and reduces to scalars under ``jax.checkpoint``,
+    so the backward recomputes per-chunk logits instead of saving them.
+    Identical math to ``lm_loss(embed.attend(hidden), tokens)``.
+    """
+    B, S, E = hidden.shape
+    c = min(chunk, S)
+    if S % c:
+        raise ValueError(f"chunk {c} must divide seq len {S}")
+    # predict token t+1 from position t; the final position has no target
+    tgt = jnp.roll(tokens, -1, axis=1)
+    mask = jnp.concatenate(
+        [jnp.ones((B, S - 1), jnp.float32), jnp.zeros((B, 1), jnp.float32)],
+        axis=1,
+    )
+    n_chunks = S // c
+    h = hidden.reshape(B, n_chunks, c, E).transpose(1, 0, 2, 3)
+    t = tgt.reshape(B, n_chunks, c).transpose(1, 0, 2)
+    m = mask.reshape(B, n_chunks, c).transpose(1, 0, 2)
+
+    @partial(jax.checkpoint, prevent_cse=False)
+    def body(carry, xs):
+        h_c, t_c, m_c = xs                                # [B,c,E] [B,c] [B,c]
+        # upcast per chunk (a whole-sequence fp32 copy would defeat the point)
+        logits = jnp.einsum(
+            "bce,ve->bcv", h_c.astype(jnp.float32), embedding.astype(jnp.float32)
+        )
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)      # [B,c]
+        gold = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+        nll_sum, count = carry
+        return (nll_sum + jnp.sum((logz - gold) * m_c), count + jnp.sum(m_c)), None
+
+    (nll_sum, count), _ = lax.scan(body, (0.0, 0.0), (h, t, m))
+    return nll_sum / count

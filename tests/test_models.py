@@ -146,6 +146,47 @@ class TestTransformer:
         for a, b in zip(flat_a, flat_b):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
+    def test_chunked_loss_matches_full(self):
+        """lm_loss_chunked must be the same math as lm_loss over full logits
+        — value AND gradients (it is a memory optimization, not a new loss)."""
+        from kubeflow_tpu.models.transformer import lm_loss_chunked
+
+        cfg = tiny_cfg(dtype=jnp.float32)
+        model = TransformerLM(cfg)
+        tokens = jnp.asarray(
+            np.random.default_rng(1).integers(0, 128, (2, 64)), jnp.int32
+        )
+        vars_ = model.init(jax.random.PRNGKey(0), tokens)
+
+        def full(p):
+            return lm_loss(model.apply({"params": p}, tokens), tokens)
+
+        def chunked(p):
+            hidden = model.apply({"params": p}, tokens, return_hidden=True)
+            return lm_loss_chunked(
+                hidden, p["embed"]["embedding"], tokens, chunk=16
+            )
+
+        p = vars_["params"]
+        np.testing.assert_allclose(float(full(p)), float(chunked(p)), rtol=1e-6)
+        g_full = jax.grad(full)(p)
+        g_chunk = jax.grad(chunked)(p)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g_full), jax.tree_util.tree_leaves(g_chunk)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            )
+
+    def test_chunked_loss_rejects_indivisible(self):
+        from kubeflow_tpu.models.transformer import lm_loss_chunked
+
+        with pytest.raises(ValueError, match="must divide"):
+            lm_loss_chunked(
+                jnp.zeros((1, 10, 4)), jnp.zeros((8, 4)),
+                jnp.zeros((1, 10), jnp.int32), chunk=3,
+            )
+
     def test_lm_training_reduces_loss(self):
         cfg = tiny_cfg()
         model = TransformerLM(cfg)

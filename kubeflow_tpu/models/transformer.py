@@ -122,7 +122,10 @@ class Attention(nn.Module):
                 raise ValueError("attention_impl='ring' requires cfg.mesh")
             from kubeflow_tpu.parallel.ring_attention import ring_attention
 
-            o = ring_attention(q, k, v, cfg.mesh, axis_name="seq", causal=True)
+            o = ring_attention(
+                q, k, v, cfg.mesh, axis_name="seq", causal=True,
+                block=cfg.attention_block_size,
+            )
         else:
             raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
         o = o.reshape(B, S, H * D)

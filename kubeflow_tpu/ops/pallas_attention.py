@@ -310,12 +310,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
 
 
 def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k,
-                    interpret):
-    """All operands [B, H, S, D] (lse [B, H, Sq, LSE_LANES]); returns dq/dk/dv."""
+                    interpret, grad_dtype=None):
+    """All operands [B, H, S, D] (lse [B, H, Sq, LSE_LANES]); returns dq/dk/dv.
+
+    ``grad_dtype`` overrides the output dtype (default: match the inputs) —
+    callers that go on accumulating partials (the ring backward) request f32
+    so per-chunk quantization noise doesn't grow with ring size."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bq, bk, nq, nk, skip = _block_plan(Sq, Sk, block_q, block_k, causal)
     scale = D ** -0.5
+    dq_t = grad_dtype or q.dtype
+    dk_t = grad_dtype or k.dtype
+    dv_t = grad_dtype or v.dtype
 
     q_side = pl.BlockSpec((1, 1, bq, D), _major_index)
     lse_at_major = pl.BlockSpec((1, 1, bq, LSE_LANES), _major_index)
@@ -329,7 +336,7 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k,
         grid=(B, H, nq, nk),
         in_specs=[q_side, kv_minor, kv_minor, q_side, q_side, lse_at_major],
         out_specs=pl.BlockSpec((1, 1, bq, D), _major_index),
-        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), dq_t),
         scratch_shapes=[_scratch((bq, D)), _scratch((bq, LSE_LANES))],
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
@@ -351,8 +358,8 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k,
             pl.BlockSpec((1, 1, bk, D), _major_index),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, D), dk_t),
+            jax.ShapeDtypeStruct((B, H, Sk, D), dv_t),
         ],
         scratch_shapes=[_scratch((bk, D)), _scratch((bk, D))],
         compiler_params=_compiler_params(interpret),

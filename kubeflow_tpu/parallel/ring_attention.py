@@ -1,16 +1,27 @@
-"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis,
+with the Pallas flash kernels doing the per-chunk work.
 
 Long-context is first-class in this platform (SURVEY.md §5: the reference has
 no model/SP code at all; the north star requires the *infrastructure* analog —
 here is the compute analog). Sequences shard over the ``seq`` mesh axis; K/V
-blocks rotate around the ring with ``lax.ppermute`` over ICI neighbors while
-every host's queries accumulate the streaming softmax
-(``ops/attention.py``), overlapping the permute with the local matmul. Memory
-per host is O(S/n · block), total communication is the classic ring all-gather
-cost paid incrementally — ICI-bandwidth-bound, never materializing S×S.
+chunks rotate around the ring with ``lax.ppermute`` over ICI neighbors while
+every host's queries run the flash-attention kernels on the resident chunk
+(``ops/pallas_attention.py``). Per ring step a 3-way ``lax.switch`` picks:
+
+  * src < my_idx  — fully-visible chunk: non-causal flash kernel;
+  * src == my_idx — the diagonal: causal flash kernel (block skipping on);
+  * src > my_idx  — fully-masked: no kernel at all (zero + empty-lse), so the
+    causal ring does ~half the FLOPs of the non-causal one.
+
+Chunk partials (o_r, lse_r) merge by streaming logsumexp. The whole per-shard
+ring is one ``jax.custom_vjp``: the forward saves only (q, k, v, o, lse) —
+O(S/n) per host, never S×S — and the backward re-runs the ring, calling the
+Pallas dq/dk/dv kernels per chunk with the *global* lse and rotating f32
+dk/dv accumulators together with k/v so each chunk's gradient arrives back at
+its owner after the full circle.
 
 Public pattern: Ring Attention (Liu et al. 2023) / blockwise transformers,
-re-expressed with shard_map + ppermute so XLA schedules the overlap.
+re-expressed with shard_map + ppermute + Pallas so XLA schedules the overlap.
 """
 from __future__ import annotations
 
@@ -19,65 +30,204 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from kubeflow_tpu.ops.attention import (
-    _block_update,
-    _init_carry,
-    blockwise_scores,
-    finalize,
+from kubeflow_tpu.ops.pallas_attention import (
+    LSE_LANES,
+    _auto_interpret,
+    _flash_backward,
+    _flash_forward,
 )
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
-    """Per-shard body (runs under shard_map): q/k/v are the local sequence
-    chunk [B, S_local, H, D]."""
-    B, S_local, H, D = q.shape
+def _merge(o, lse, o_r, lse_r):
+    """Streaming-softmax merge of two normalized partials.
+
+    o/o_r [B,H,S,D] f32; lse/lse_r [B,H,S,1] f32 with +inf meaning "empty"
+    (the kernels' convention for fully-masked rows). Forward-only numerics —
+    the ring's backward never differentiates through this (custom_vjp).
+    """
+    a = jnp.where(jnp.isposinf(lse), -jnp.inf, lse)
+    b = jnp.where(jnp.isposinf(lse_r), -jnp.inf, lse_r)
+    lse_new = jnp.logaddexp(a, b)
+    w_a = jnp.where(jnp.isneginf(a), 0.0, jnp.exp(a - lse_new))
+    w_b = jnp.where(jnp.isneginf(b), 0.0, jnp.exp(b - lse_new))
+    return o * w_a + o_r * w_b, lse_new
+
+
+def _chunk_fwd(q, k, v, causal, block, interpret):
+    """One chunk's flash forward; BHSD operands. Returns (o f32, lse [.,1])."""
+    o, lse = _flash_forward(
+        q, k, v, causal=causal, block_q=block, block_k=block,
+        interpret=interpret, save_residuals=True,
+    )
+    return o.astype(jnp.float32), lse[..., :1]
+
+
+def _ring_fwd_local(q, k, v, *, axis_name, causal, block, interpret):
+    """Forward ring (shard_map body, BHSD layout). Returns (o bf16, lse)."""
+    B, H, S, D = q.shape
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
-    scale = D ** -0.5
-    # device i sends its current K/V to i+1: after r steps we hold the chunk
-    # originally living on (my_idx - r) mod n
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    # checkpointed like blockwise_attention's body: autodiff would otherwise
-    # save per-step f32 probabilities [n, B, H, S_local, S_local] — the local
-    # S^2 chunk stack — defeating ring attention's O(S/n) memory point. The
-    # backward re-runs the ppermute ring to recompute scores, which is the
-    # published ring-attention backward anyway.
-    @partial(jax.checkpoint, prevent_cse=False)
-    def step(carry, r):
-        o, m, l, k_cur, v_cur = carry
-        src = (my_idx - r) % n
-        s = blockwise_scores(
-            q, k_cur, scale, my_idx * S_local, src * S_local, causal
+    def full_chunk(k_cur, v_cur):
+        return _chunk_fwd(q, k_cur, v_cur, False, block, interpret)
+
+    def diag_chunk(k_cur, v_cur):
+        return _chunk_fwd(q, k_cur, v_cur, True, block, interpret)
+
+    def empty_chunk(k_cur, v_cur):
+        return (
+            jnp.zeros((B, H, S, D), jnp.float32),
+            jnp.full((B, H, S, 1), jnp.inf, jnp.float32),
         )
-        o, m, l = _block_update((o, m, l), s, v_cur)
+
+    def step(carry, r):
+        o, lse, k_cur, v_cur = carry
+        src = (my_idx - r) % n
+        if causal:
+            branch = jnp.where(src < my_idx, 0, jnp.where(src == my_idx, 1, 2))
+            o_r, lse_r = lax.switch(
+                branch, (full_chunk, diag_chunk, empty_chunk), k_cur, v_cur
+            )
+        else:
+            o_r, lse_r = full_chunk(k_cur, v_cur)
+        o, lse = _merge(o, lse, o_r, lse_r)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (o, m, l, k_nxt, v_nxt), None
+        return (o, lse, k_nxt, v_nxt), None
 
-    o, m, l = _init_carry(B, H, S_local, D)
-    (o, m, l, _, _), _ = lax.scan(
-        step, (o, m, l, k, v), jnp.arange(n)
+    o0 = jnp.zeros((B, H, S, D), jnp.float32)
+    lse0 = jnp.full((B, H, S, 1), jnp.inf, jnp.float32)  # empty
+    (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype), lse
+
+
+def _ring_bwd_local(q, k, v, o, lse, do, *, axis_name, causal, block,
+                    interpret):
+    """Backward ring (shard_map body, BHSD). Per step the Pallas dq/dkv
+    kernels run against the resident chunk with the GLOBAL lse (so per-chunk
+    probabilities are globally normalized); dk/dv f32 accumulators rotate
+    with k/v and complete the circle back to each chunk's owner."""
+    B, H, S, D = q.shape
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # [B,H,S,1] -> the kernels' LSE_LANES-replicated layout; guard all-empty
+    # rows (only possible non-causally with a fully-masked input, but cheap)
+    lse_k = jnp.broadcast_to(
+        jnp.where(jnp.isneginf(lse), jnp.inf, lse), (B, H, S, LSE_LANES)
     )
-    return finalize(o, m, l).transpose(0, 2, 1, 3).astype(q.dtype)
+
+    def grads(k_cur, v_cur, chunk_causal):
+        # f32 partials: each chunk's grads feed the rotating accumulators,
+        # so rounding to bf16 per chunk would compound with ring size
+        return _flash_backward(
+            q, k_cur, v_cur, o, lse_k, do, causal=chunk_causal,
+            block_q=block, block_k=block, interpret=interpret,
+            grad_dtype=jnp.float32,
+        )
+
+    def full_chunk(k_cur, v_cur):
+        return grads(k_cur, v_cur, False)
+
+    def diag_chunk(k_cur, v_cur):
+        return grads(k_cur, v_cur, True)
+
+    def empty_chunk(k_cur, v_cur):
+        z = jnp.zeros((B, H, S, D), jnp.float32)
+        return z, z, z
+
+    def step(carry, r):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = (my_idx - r) % n
+        if causal:
+            branch = jnp.where(src < my_idx, 0, jnp.where(src == my_idx, 1, 2))
+            dq_r, dk_r, dv_r = lax.switch(
+                branch, (full_chunk, diag_chunk, empty_chunk), k_cur, v_cur
+            )
+        else:
+            dq_r, dk_r, dv_r = full_chunk(k_cur, v_cur)
+        dq += dq_r
+        dk_cur += dk_r
+        dv_cur += dv_r
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = lax.ppermute(dv_cur, axis_name, perm)
+        return (dq, k_nxt, v_nxt, dk_nxt, dv_nxt), None
+
+    dq0 = jnp.zeros((B, H, S, D), jnp.float32)
+    dkv0 = jnp.zeros((B, H, S, D), jnp.float32)
+    (dq, _, _, dk, dv), _ = lax.scan(
+        step, (dq0, k, v, dkv0, dkv0), jnp.arange(n)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@partial(jax.jit, static_argnames=("mesh", "axis_name", "causal"))
-def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "seq", causal: bool = True):
+def _ring_local_factory(axis_name, causal, block, interpret):
+    """Per-shard ring attention as a custom_vjp (BSHD in/out, matching
+    ops/attention.py's layout convention)."""
+
+    @jax.custom_vjp
+    def ring_local(q, k, v):
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        o, _ = _ring_fwd_local(
+            qt, kt, vt, axis_name=axis_name, causal=causal, block=block,
+            interpret=interpret,
+        )
+        return o.transpose(0, 2, 1, 3)
+
+    def fwd(q, k, v):
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        o, lse = _ring_fwd_local(
+            qt, kt, vt, axis_name=axis_name, causal=causal, block=block,
+            interpret=interpret,
+        )
+        return o.transpose(0, 2, 1, 3), (qt, kt, vt, o, lse)
+
+    def bwd(res, g):
+        qt, kt, vt, o, lse = res
+        do = g.transpose(0, 2, 1, 3)
+        dq, dk, dv = _ring_bwd_local(
+            qt, kt, vt, o, lse, do, axis_name=axis_name, causal=causal,
+            block=block, interpret=interpret,
+        )
+        return tuple(x.transpose(0, 2, 1, 3) for x in (dq, dk, dv))
+
+    ring_local.defvjp(fwd, bwd)
+    return ring_local
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "axis_name", "causal", "block", "interpret"),
+)
+def ring_attention(
+    q, k, v, mesh: Mesh, *, axis_name: str = "seq", causal: bool = True,
+    block: int = 512, interpret: bool | None = None,
+):
     """Exact attention with sequences sharded over ``axis_name``.
 
     q/k/v: [B, S, H, D] global shape, S sharded over the ring axis; batch
     sharded over data axes as usual. Output sharding matches q.
     """
     spec = P(("data", "fsdp"), axis_name, None, None)
-    fn = shard_map_attention(mesh, axis_name=axis_name, causal=causal, spec=spec)
+    fn = shard_map_attention(
+        mesh, axis_name=axis_name, causal=causal, spec=spec, block=block,
+        interpret=interpret,
+    )
     return fn(q, k, v)
 
 
-def shard_map_attention(mesh: Mesh, *, axis_name: str, causal: bool, spec: P):
-    body = partial(_ring_attention_local, axis_name=axis_name, causal=causal)
+def shard_map_attention(
+    mesh: Mesh, *, axis_name: str, causal: bool, spec: P, block: int = 512,
+    interpret: bool | None = None,
+):
+    if interpret is None:
+        interpret = _auto_interpret()
+    body = _ring_local_factory(axis_name, causal, block, interpret)
     return jax.shard_map(
         body,
         mesh=mesh,

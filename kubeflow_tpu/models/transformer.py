@@ -102,7 +102,10 @@ class Attention(nn.Module):
         v = dense(features=(KV, D), name="v_proj")(x)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        if KV != H:  # GQA: expand kv heads to query heads
+        if KV != H and cfg.attention_impl != "flash":
+            # GQA: expand kv heads to query heads for the paths that need
+            # per-head alignment; the flash kernels take grouped K/V
+            # directly (head mapping in the BlockSpec index maps)
             reps = H // KV
             k = jnp.repeat(k, reps, axis=2)
             v = jnp.repeat(v, reps, axis=2)

@@ -73,27 +73,51 @@ def _major_index(b, h, major, minor):
     return (b, h, major, 0)
 
 
-def _minor_index(skip, valid, fallback):
-    """BlockSpec index map selecting the MINOR grid axis's block; when causal
-    block skipping is on, re-points skipped iterations (per ``valid(major,
-    minor)``) at ``fallback(major, minor)`` — the next block that will really
-    be fetched — so masked-out blocks cost no DMA."""
+def _grouped_major(group):
+    """K/V-side major index map; ``group`` > 1 = GQA (q head h reads kv head
+    h // group, so grouped K/V never materialize H-expanded copies)."""
+    if group == 1:
+        return _major_index
+
     def index(b, h, major, minor):
-        if skip:
-            minor = lax.select(valid(major, minor), minor, fallback(major, minor))
-        return (b, h, minor, 0)
+        return (b, h // group, major, 0)
     return index
 
 
-def _kv_at_minor(skip):
+def _minor_index(skip, valid, fallback, group=1):
+    """BlockSpec index map selecting the MINOR grid axis's block; when causal
+    block skipping is on, re-points skipped iterations (per ``valid(major,
+    minor)``) at ``fallback(major, minor)`` — the next block that will really
+    be fetched — so masked-out blocks cost no DMA. ``group`` maps q heads
+    onto kv heads for GQA operands."""
+    def index(b, h, major, minor):
+        if skip:
+            minor = lax.select(valid(major, minor), minor, fallback(major, minor))
+        return (b, h if group == 1 else h // group, minor, 0)
+    return index
+
+
+def _kv_at_minor(skip, group=1):
     # fwd/dq grids (b, h, iq, ik): k/v blocks walk the minor (ik) axis
-    return _minor_index(skip, lambda iq, ik: ik <= iq, lambda iq, ik: 0)
+    return _minor_index(skip, lambda iq, ik: ik <= iq, lambda iq, ik: 0, group)
 
 
 def _q_at_minor(skip):
     # dkv grid (b, h, ik, iq): q-side blocks walk the minor (iq) axis;
     # skipped q blocks re-point at the diagonal (first valid for this k)
     return _minor_index(skip, lambda ik, iq: iq >= ik, lambda ik, iq: ik)
+
+
+def _group_of(q, k, v):
+    """GQA group size from BHSD operands; validates head divisibility."""
+    H, KV = q.shape[1], k.shape[1]
+    if v.shape[1] != KV:
+        raise ValueError(
+            f"k and v must carry the same head count, got {KV} vs {v.shape[1]}"
+        )
+    if H % KV:
+        raise ValueError(f"query heads {H} must be a multiple of kv heads {KV}")
+    return H // KV
 
 
 # ---------------------------------------------------------------- forward
@@ -170,9 +194,11 @@ def _compiler_params(interpret):
 
 def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
                    save_residuals=False):
-    """q/k/v in [B, H, S, D]; returns o (and lse [B, H, Sq, LSE_LANES] f32)."""
+    """q/k/v in [B, H, S, D] (k/v may carry fewer heads — GQA); returns o
+    (and lse [B, H, Sq, LSE_LANES] f32)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
+    group = _group_of(q, k, v)
     bq, bk, nq, nk, skip = _block_plan(Sq, Sk, block_q, block_k, causal)
     scale = D ** -0.5
 
@@ -202,8 +228,8 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), _major_index),
-            pl.BlockSpec((1, 1, bk, D), _kv_at_minor(skip)),
-            pl.BlockSpec((1, 1, bk, D), _kv_at_minor(skip)),
+            pl.BlockSpec((1, 1, bk, D), _kv_at_minor(skip, group)),
+            pl.BlockSpec((1, 1, bk, D), _kv_at_minor(skip, group)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -318,6 +344,8 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k,
     so per-chunk quantization noise doesn't grow with ring size."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
+    KV = k.shape[1]
+    group = _group_of(q, k, v)
     bq, bk, nq, nk, skip = _block_plan(Sq, Sk, block_q, block_k, causal)
     scale = D ** -0.5
     dq_t = grad_dtype or q.dtype
@@ -326,7 +354,7 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k,
 
     q_side = pl.BlockSpec((1, 1, bq, D), _major_index)
     lse_at_major = pl.BlockSpec((1, 1, bq, LSE_LANES), _major_index)
-    kv_minor = pl.BlockSpec((1, 1, bk, D), _kv_at_minor(skip))
+    kv_minor = pl.BlockSpec((1, 1, bk, D), _kv_at_minor(skip, group))
 
     dq = pl.pallas_call(
         functools.partial(
@@ -344,8 +372,11 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k,
 
     q_minor = pl.BlockSpec((1, 1, bq, D), _q_at_minor(skip))
     lse_at_minor = pl.BlockSpec((1, 1, bq, LSE_LANES), _q_at_minor(skip))
-    kv_major = pl.BlockSpec((1, 1, bk, D), _major_index)
+    kv_major = pl.BlockSpec((1, 1, bk, D), _grouped_major(group))
 
+    # per-Q-head partials; for GQA they reduce over the group afterwards
+    # (writing [B, KV] blocks from an H-sized grid would race), emitted f32
+    # so the group-sum stays unrounded
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, skip=skip,
@@ -358,13 +389,20 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k,
             pl.BlockSpec((1, 1, bk, D), _major_index),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sk, D), dk_t),
-            jax.ShapeDtypeStruct((B, H, Sk, D), dv_t),
+            jax.ShapeDtypeStruct(
+                (B, H, Sk, D), jnp.float32 if group > 1 else dk_t
+            ),
+            jax.ShapeDtypeStruct(
+                (B, H, Sk, D), jnp.float32 if group > 1 else dv_t
+            ),
         ],
         scratch_shapes=[_scratch((bk, D)), _scratch((bk, D))],
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
     )(q, k, v, o, do, lse)
+    if group > 1:
+        dk = dk.reshape(B, KV, group, Sk, D).sum(axis=2).astype(dk_t)
+        dv = dv.reshape(B, KV, group, Sk, D).sum(axis=2).astype(dv_t)
     return dq, dk, dv
 
 
@@ -379,7 +417,11 @@ def flash_attention(
     q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512,
     interpret: bool | None = None,
 ):
-    """Fused attention. Layout [B, S, H, D] (matching ops/attention.py)."""
+    """Fused attention. Layout [B, S, H, D] (matching ops/attention.py).
+
+    GQA/MQA: pass k/v with fewer heads than q (H % KV == 0) — the kernels
+    map each query head onto its kv group in the BlockSpec index maps, so
+    grouped K/V are never expanded to H heads in HBM."""
     if interpret is None:
         interpret = _auto_interpret()
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))

@@ -173,3 +173,37 @@ def test_blockwise_gradients_match_naive(qkv):
 # recorded hardware runs: ATTENTION_BENCH_r02.json's 16k/32k rows OOM'd
 # before the fix and run after it. The math is pinned above by
 # test_blockwise_gradients_match_naive.
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gqa_grouped_kv(qkv, causal):
+    """GQA: k/v passed with fewer heads than q — the kernels map q heads
+    onto kv groups in the index maps; must equal naive over repeated kv,
+    fwd and bwd (incl. the group-summed dk/dv)."""
+    q, k, v = qkv
+    kg, vg = k[:, :, :2], v[:, :, :2]             # 4 q heads, 2 kv heads
+    k_rep = jnp.repeat(kg, 2, axis=2)
+    v_rep = jnp.repeat(vg, 2, axis=2)
+
+    ref = naive_attention(q, k_rep, v_rep, causal=causal)
+    out = flash_attention(q, kg, vg, causal, 64, 64)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def f_ref(q, kg, vg):
+        kr = jnp.repeat(kg, 2, axis=2)
+        vr = jnp.repeat(vg, 2, axis=2)
+        return jnp.sum(naive_attention(q, kr, vr, causal=causal) ** 2)
+
+    def f_flash(q, kg, vg):
+        return jnp.sum(flash_attention(q, kg, vg, causal, 64, 64) ** 2)
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, kg, vg)
+    g = jax.grad(f_flash, argnums=(0, 1, 2))(q, kg, vg)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+def test_flash_rejects_bad_head_grouping(qkv):
+    q, k, v = qkv
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        flash_attention(q, k[:, :, :3], v[:, :, :3], True, 64, 64)

@@ -16,7 +16,7 @@ import pytest
 IMAGES = Path(__file__).resolve().parents[1] / "images"
 LEAVES = [
     "jupyter", "jupyter-scipy", "jupyter-jax", "jupyter-jax-full",
-    "jupyter-pytorch-xla", "codeserver",
+    "jupyter-pytorch-xla", "codeserver", "rstudio",
 ]
 
 
@@ -80,7 +80,7 @@ class TestImageTree:
                         f"{name}: CMD uses NB_PREFIX without a shell"
                     )
 
-    @pytest.mark.parametrize("leaf", ["jupyter", "codeserver"])
+    @pytest.mark.parametrize("leaf", ["jupyter", "codeserver", "rstudio"])
     def test_home_reseed_s6_script(self, leaf):
         """Workspace PVCs mount over $HOME; the s6 oneshot re-seeds it."""
         up = IMAGES / leaf / "s6" / "init-home" / "up"
@@ -92,5 +92,5 @@ class TestImageTree:
         assert script.stat().st_mode & 0o111, "contract_test.sh not executable"
         wf = (IMAGES.parent / ".github/workflows/images.yaml").read_text()
         assert "contract_test.sh" in wf
-        for img in ("jupyter-jax", "codeserver"):
+        for img in ("jupyter-jax", "codeserver", "rstudio"):
             assert img in wf

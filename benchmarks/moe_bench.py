@@ -1,0 +1,154 @@
+"""MoE-transformer training benchmark (round-2 breadth: the expert-parallel
+family was perf-unmeasured — only dryrun-verified).
+
+Measures a GShard-style top-2 MoE decoder on the attached chip and prints one
+JSON line. Configuration follows the measured-winning dense recipe
+(BASELINE.md "Round-2 sweep") plus the MoE-specific dispatch choice:
+
+- Pallas flash attention, head_dim 128;
+- chunked tied-head loss (moe_lm_loss_chunked);
+- dispatch="gather": index-based dispatch — the one-hot dispatch/combine
+  einsums cost 2*B*S*(E*C)*M FLOPs each (E*C ≈ 2.5*S at this config: as
+  much as the expert matmuls themselves); static-shape scatter/gather moves
+  the tokens with zero matmul FLOPs. The einsum path stays the default for
+  expert-parallel meshes where its sharding constraints induce all_to_all.
+
+MFU accounting: 6 * ACTIVE params per token (embed head + attention + top-k
+of the expert stacks + routers) + the attention S term — the standard MoE
+convention; total params also reported. vs_baseline mirrors the dense bench:
+MFU / (0.90 * 0.40).
+
+Usage: python benchmarks/moe_bench.py [--dispatch einsum|gather] [--remat]
+"""
+import functools
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kubeflow_tpu.models.moe import (
+    MoEConfig,
+    MoETransformerLM,
+    moe_lm_loss_chunked,
+)
+
+PEAK_FLOPS = {
+    "v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
+    "v5p": 459e12, "v6e": 918e12, "v6 lite": 918e12,
+}
+
+BATCH = 4
+SEQ = 2048
+CHUNK = 1024
+N_SHORT, N_LONG, REPEATS = 3, 13, 3
+
+
+def chip_peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main() -> None:
+    dispatch = "gather"
+    if "--dispatch" in sys.argv:
+        dispatch = sys.argv[sys.argv.index("--dispatch") + 1]
+    cfg = MoEConfig(
+        vocab_size=32_000,
+        num_layers=8,
+        num_heads=8,              # head_dim 128
+        embed_dim=1024,
+        expert_hidden_dim=2048,
+        num_experts=8,
+        experts_per_token=2,
+        max_seq_len=SEQ,
+        dispatch=dispatch,
+        attention_impl="flash",
+        attention_block_size=1024,
+        remat="--remat" in sys.argv,
+        dtype=jnp.bfloat16,
+    )
+    model = MoETransformerLM(cfg)
+    tx = optax.adamw(3e-4, weight_decay=0.1)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (BATCH, SEQ)), jnp.int32)
+
+    params = jax.jit(lambda k: model.init(k, tokens)["params"])(
+        jax.random.PRNGKey(0)
+    )
+    state = {"params": params, "opt_state": tx.init(params)}
+
+    n_total = sum(
+        int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
+    )
+    # active per token: total minus the un-routed fraction of expert tables
+    expert_params = sum(
+        int(np.prod(p.shape))
+        for path, p in jax.tree_util.tree_leaves_with_path(params)
+        if "experts_w" in jax.tree_util.keystr(path)
+    )
+    n_active = n_total - expert_params * (
+        1 - cfg.experts_per_token / cfg.num_experts
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: moe_lm_loss_chunked(model, p, tokens, chunk=CHUNK)
+        )(state["params"])
+        updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
+        return {
+            "params": optax.apply_updates(state["params"], updates),
+            "opt_state": opt_state,
+        }, loss
+
+    def window(n, state):
+        t = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            state, loss = step(state, tokens)
+        float(loss)
+        return time.perf_counter() - t, state
+
+    _, state = window(N_SHORT, state)
+    rates = []
+    for _ in range(REPEATS):
+        ts, state = window(N_SHORT, state)
+        tl, state = window(N_LONG, state)
+        rates.append(BATCH * SEQ / ((tl - ts) / (N_LONG - N_SHORT)))
+
+    tok_per_sec = statistics.median(rates)
+    attn = 12 * cfg.num_layers * cfg.embed_dim * SEQ * 0.5
+    mfu = (
+        tok_per_sec * (6 * n_active + attn) / chip_peak_flops(jax.devices()[0])
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "moe_train_tokens_per_sec_per_chip",
+                "value": round(tok_per_sec, 1),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(mfu / (0.90 * 0.40), 4),
+                "mfu": round(mfu, 4),
+                "params_m": round(n_total / 1e6, 1),
+                "active_params_m": round(n_active / 1e6, 1),
+                "dispatch": dispatch,
+                "seq_len": SEQ,
+                "per_chip_batch": BATCH,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

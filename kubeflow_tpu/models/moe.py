@@ -6,9 +6,10 @@ Switch lineage the TPU was built for, expressed the XLA way:
 
 - static shapes everywhere: capacity-based routing (tokens over capacity are
   dropped, their residual stream passes through untouched);
-- routing, dispatch and combine are einsums over one-hot tensors — no gather /
-  scatter, so the MXU does the work and GSPMD can insert ``all_to_all``
-  collectives from sharding constraints alone;
+- two dispatch modes, both static-shaped: ``einsum`` (one-hot matmuls whose
+  sharding constraints let GSPMD insert ``all_to_all`` — the expert-parallel
+  layout) and ``gather`` (index scatter/gather with zero one-hot FLOPs — the
+  measured-faster single-chip/data-parallel path, see BASELINE.md);
 - expert weight tables carry a leading expert dim sharded over the ``expert``
   mesh axis (rule: ``parallel/mesh.moe_param_spec``), composed with
   tensor-parallel column/row splits of the hidden dim;
@@ -31,6 +32,7 @@ from kubeflow_tpu.models.transformer import (
     Attention,
     RMSNorm,
     TransformerConfig,
+    resolve_remat_policy,
 )
 
 
@@ -46,8 +48,19 @@ class MoEConfig:
     capacity_factor: float = 1.25
     max_seq_len: int = 2048
     aux_loss_weight: float = 1e-2
+    dispatch: str = "einsum"            # einsum | gather:
+                                        #  einsum — one-hot matmul dispatch;
+                                        #   sharding constraints induce
+                                        #   all_to_all on expert meshes
+                                        #  gather — index-based dispatch, no
+                                        #   one-hot FLOPs (at S=2048/E=8 the
+                                        #   one-hot einsums cost as much as
+                                        #   the experts themselves); for
+                                        #   single-chip / data-parallel runs
     attention_impl: str = "block"
     attention_block_size: int = 512
+    remat: bool = False                  # jax.checkpoint each block
+    remat_policy: str = "full"           # full | dots (as TransformerConfig)
     dtype: Any = jnp.bfloat16
     mesh: Any = None
 
@@ -72,20 +85,25 @@ class MoEConfig:
         return max(8, -(-cap // 8) * 8)
 
 
-def top_k_routing(
-    router_logits: jnp.ndarray, k: int, capacity: int
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Capacity-constrained top-k gating.
+@dataclasses.dataclass
+class RoutingPlan:
+    """Per-choice routing decisions (k = experts_per_token entries each):
+    ``experts``/``pos`` [k, B, S] int32 (chosen expert; slot within it),
+    ``gates``/``keep`` [k, B, S] f32 (combine weight; 1.0 if within
+    capacity), plus the scalar load-balance ``aux_loss``."""
 
-    Args:
-        router_logits: [B, S, E] fp32.
-        k: experts per token (static).
-        capacity: per-expert slots C (static).
+    experts: jnp.ndarray
+    gates: jnp.ndarray
+    pos: jnp.ndarray
+    keep: jnp.ndarray
+    aux_loss: jnp.ndarray
 
-    Returns:
-        combine: [B, S, E, C] fp32 — combine[b,s,e,c] is the gate weight with
-            which token (b,s) contributes to slot c of expert e (0 if dropped).
-        aux_loss: scalar load-balancing loss (Switch-style, over choice-0).
+
+def route_top_k(router_logits: jnp.ndarray, k: int, capacity: int) -> RoutingPlan:
+    """Capacity-constrained top-k gating → a RoutingPlan (no [B,S,E,C]
+    tensors; both dispatch modes derive from this).
+
+    router_logits: [B, S, E] fp32; k, capacity static.
     """
     B, S, E = router_logits.shape
     if k > E:
@@ -95,12 +113,13 @@ def top_k_routing(
         )
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
 
-    masks, gates = [], []
+    idxs, masks, gates = [], [], []
     remaining = probs
     for _ in range(k):
         idx = jnp.argmax(remaining, axis=-1)                       # [B,S]
         mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)           # [B,S,E]
         gates.append(jnp.sum(probs * mask, axis=-1))               # [B,S]
+        idxs.append(idx)
         masks.append(mask)
         remaining = remaining * (1.0 - mask)
 
@@ -113,25 +132,53 @@ def top_k_routing(
 
     # Slot assignment: all choice-0 picks take positions before any choice-1
     # pick (GShard priority), positions within a choice by sequence order.
-    combine = jnp.zeros((B, S, E, capacity), jnp.float32)
+    poss, keeps = [], []
     offset = jnp.zeros((B, E), jnp.float32)
-    for mask, gate in zip(masks, gates):
+    for mask in masks:
         pos_in_expert = (
             jnp.cumsum(mask, axis=1) - mask + offset[:, None, :]
         )                                                          # [B,S,E]
         offset = offset + jnp.sum(mask, axis=1)
         pos = jnp.sum(pos_in_expert * mask, axis=-1)               # [B,S]
-        keep = (pos < capacity).astype(jnp.float32) * jnp.sum(mask, axis=-1)
-        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
-        combine = combine + (
-            (gate * keep)[..., None, None] * mask[..., None] * slot[:, :, None, :]
+        keeps.append(
+            (pos < capacity).astype(jnp.float32) * jnp.sum(mask, axis=-1)
         )
+        poss.append(pos.astype(jnp.int32))
 
     # Load-balance aux: E * Σ_e fraction_dispatched(e) * mean_prob(e).
     frac = jnp.mean(masks[0], axis=(0, 1))                         # [E]
     mean_prob = jnp.mean(probs, axis=(0, 1))                       # [E]
     aux_loss = E * jnp.sum(frac * mean_prob)
-    return combine, aux_loss
+    return RoutingPlan(
+        experts=jnp.stack(idxs),
+        gates=jnp.stack(gates),
+        pos=jnp.stack(poss),
+        keep=jnp.stack(keeps),
+        aux_loss=aux_loss,
+    )
+
+
+def top_k_routing(
+    router_logits: jnp.ndarray, k: int, capacity: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Capacity-constrained top-k gating as a dense combine tensor.
+
+    Returns:
+        combine: [B, S, E, C] fp32 — combine[b,s,e,c] is the gate weight with
+            which token (b,s) contributes to slot c of expert e (0 if dropped).
+        aux_loss: scalar load-balancing loss (Switch-style, over choice-0).
+    """
+    B, S, E = router_logits.shape
+    plan = route_top_k(router_logits, k, capacity)
+    combine = jnp.zeros((B, S, E, capacity), jnp.float32)
+    for j in range(k):
+        mask = jax.nn.one_hot(plan.experts[j], E, dtype=jnp.float32)
+        slot = jax.nn.one_hot(plan.pos[j], capacity, dtype=jnp.float32)
+        combine = combine + (
+            (plan.gates[j] * plan.keep[j])[..., None, None]
+            * mask[..., None] * slot[:, :, None, :]
+        )
+    return combine, plan.aux_loss
 
 
 class MoEMLP(nn.Module):
@@ -152,11 +199,6 @@ class MoEMLP(nn.Module):
             "router", nn.initializers.lecun_normal(), (M, E), jnp.float32
         )
         logits = jnp.einsum("bsm,me->bse", x.astype(jnp.float32), router)
-        combine, aux_loss = top_k_routing(
-            logits, cfg.experts_per_token, C
-        )
-        dispatch = (combine > 0).astype(cfg.dtype)
-        combine = combine.astype(cfg.dtype)
 
         wi = self.param(
             "experts_wi",
@@ -169,16 +211,75 @@ class MoEMLP(nn.Module):
             (E, H, M), jnp.float32,
         ).astype(cfg.dtype)
 
-        # Dispatch: [B,S,E,C] x [B,S,M] -> [E,B,C,M]; constraining the result
-        # to the expert axis (tokens stay batch-sharded) is the all_to_all.
-        expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch, x.astype(cfg.dtype))
-        expert_in = _constrain(expert_in, P("expert", ("data", "fsdp"), None, None))
-        h = nn.gelu(jnp.einsum("ebcm,emh->ebch", expert_in, wi))
-        h = _constrain(h, P("expert", ("data", "fsdp"), None, "tensor"))
-        out = jnp.einsum("ebch,ehm->ebcm", h, wo)
-        # Combine: weighted return trip — the reverse all_to_all.
-        y = jnp.einsum("bsec,ebcm->bsm", combine, out)
-        y = _constrain(y, P(("data", "fsdp"), None, None))
+        if cfg.dispatch == "einsum":
+            combine, aux_loss = top_k_routing(logits, cfg.experts_per_token, C)
+            dispatch = (combine > 0).astype(cfg.dtype)
+            combine = combine.astype(cfg.dtype)
+            # Dispatch: [B,S,E,C] x [B,S,M] -> [E,B,C,M]; constraining the
+            # result to the expert axis (tokens stay batch-sharded) is the
+            # all_to_all.
+            expert_in = jnp.einsum(
+                "bsec,bsm->ebcm", dispatch, x.astype(cfg.dtype)
+            )
+            expert_in = _constrain(
+                expert_in, P("expert", ("data", "fsdp"), None, None)
+            )
+            h = nn.gelu(jnp.einsum("ebcm,emh->ebch", expert_in, wi))
+            h = _constrain(h, P("expert", ("data", "fsdp"), None, "tensor"))
+            out = jnp.einsum("ebch,ehm->ebcm", h, wo)
+            # Combine: weighted return trip — the reverse all_to_all.
+            y = jnp.einsum("bsec,ebcm->bsm", combine, out)
+            y = _constrain(y, P(("data", "fsdp"), None, None))
+        elif cfg.dispatch == "gather":
+            if cfg.mesh is not None and cfg.mesh.shape.get("expert", 1) > 1:
+                # the gather branch carries no sharding constraints: on an
+                # expert mesh GSPMD would silently replicate every expert
+                # table — the exact failure _constrain exists to prevent
+                raise ValueError(
+                    "dispatch='gather' is the single-chip/data-parallel "
+                    "path; use dispatch='einsum' on expert-parallel meshes"
+                )
+            # Index-based dispatch: the one-hot einsums above cost
+            # 2*B*S*(E*C)*M FLOPs EACH (E*C ≈ k*capacity_factor*S, so
+            # effectively quadratic in S — as much as the expert matmuls at
+            # bench scale); static-shape scatter/gather moves the same
+            # tokens with zero matmul FLOPs. Single-chip / data-parallel
+            # fast path (einsum mode remains the expert-parallel layout).
+            plan = route_top_k(logits, cfg.experts_per_token, C)
+            k_choices = cfg.experts_per_token
+            flat_idx = plan.experts * C + plan.pos                # [k,B,S]
+            valid = plan.keep > 0
+            # slot -> token map via scatter; slots are collision-free by
+            # construction, dropped tokens land in an overflow bucket
+            over = jnp.where(valid, flat_idx, E * C)
+            slot_token = jnp.full((B, E * C + 1), S, jnp.int32)
+            b_idx = jnp.arange(B)[:, None]
+            s_idx = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+            for j in range(k_choices):
+                slot_token = slot_token.at[b_idx, over[j]].set(s_idx)
+            slot_token = slot_token[:, : E * C]                   # [B,EC]
+            # empty slots read a zero row (sentinel index S)
+            x_pad = jnp.concatenate(
+                [x.astype(cfg.dtype), jnp.zeros((B, 1, M), cfg.dtype)], axis=1
+            )
+            expert_in = jnp.take_along_axis(
+                x_pad, slot_token[..., None], axis=1
+            ).reshape(B, E, C, M).transpose(1, 0, 2, 3)           # [E,B,C,M]
+            h = nn.gelu(jnp.einsum("ebcm,emh->ebch", expert_in, wi))
+            out = jnp.einsum("ebch,ehm->ebcm", h, wo)
+            out_flat = out.transpose(1, 0, 2, 3).reshape(B, E * C, M)
+            y = jnp.zeros((B, S, M), jnp.float32)
+            for j in range(k_choices):
+                tok = jnp.take_along_axis(
+                    out_flat,
+                    jnp.minimum(flat_idx[j], E * C - 1)[..., None],
+                    axis=1,
+                )                                                  # [B,S,M]
+                w = (plan.gates[j] * plan.keep[j])[..., None]
+                y = y + w * tok.astype(jnp.float32)
+            aux_loss = plan.aux_loss
+        else:
+            raise ValueError(f"unknown dispatch {cfg.dispatch!r}")
         self.sow("intermediates", "aux_loss", aux_loss)
         return y.astype(cfg.dtype)
 
@@ -217,7 +318,7 @@ class MoETransformerLM(nn.Module):
     cfg: MoEConfig
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True):
+    def __call__(self, tokens, train: bool = True, return_hidden: bool = False):
         cfg = self.cfg
         B, S = tokens.shape
         embed = nn.Embed(
@@ -226,11 +327,27 @@ class MoETransformerLM(nn.Module):
         )
         x = embed(tokens)
         positions = jnp.arange(S)
+        if cfg.remat:
+            block_cls = nn.remat(
+                MoEBlock, policy=resolve_remat_policy(cfg.remat_policy)
+            )
+        else:
+            block_cls = MoEBlock
         for i in range(cfg.num_layers):
-            x = MoEBlock(cfg, name=f"layer_{i}")(x, positions)
+            x = block_cls(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(name="final_norm")(x)
+        if return_hidden:
+            return x
         logits = embed.attend(x.astype(jnp.float32))
         return logits
+
+
+def _mean_aux(inter):
+    return jnp.mean(
+        jnp.asarray(
+            jax.tree_util.tree_leaves(inter["intermediates"]), jnp.float32
+        )
+    )
 
 
 def moe_lm_loss(model: MoETransformerLM, params, tokens):
@@ -241,9 +358,19 @@ def moe_lm_loss(model: MoETransformerLM, params, tokens):
     logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
     tgt = tokens[:, 1:]
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    aux = jnp.mean(
-        jnp.asarray(
-            jax.tree_util.tree_leaves(inter["intermediates"]), jnp.float32
-        )
+    return jnp.mean(nll) + model.cfg.aux_loss_weight * _mean_aux(inter)
+
+
+def moe_lm_loss_chunked(model: MoETransformerLM, params, tokens, *, chunk=512):
+    """moe_lm_loss via the chunked tied head (lm_loss_chunked) — the
+    [B, S, vocab] fp32 logits never materialize."""
+    from kubeflow_tpu.models.transformer import lm_loss_chunked
+
+    hidden, inter = model.apply(
+        {"params": params}, tokens, mutable=["intermediates"],
+        return_hidden=True,
     )
-    return jnp.mean(nll) + model.cfg.aux_loss_weight * aux
+    nll = lm_loss_chunked(
+        hidden, params["embed"]["embedding"], tokens, chunk=chunk
+    )
+    return nll + model.cfg.aux_loss_weight * _mean_aux(inter)

@@ -60,6 +60,18 @@ class TransformerConfig:
         return self.num_kv_heads or self.num_heads
 
 
+def resolve_remat_policy(name: str):
+    """Map a config remat_policy name to a jax.checkpoint policy; raises on
+    unknown names (shared by the dense and MoE model families)."""
+    if name == "dots":
+        return jax.checkpoint_policies.dots_saveable
+    if name == "full":
+        return None
+    raise ValueError(
+        f"unknown remat_policy {name!r}; expected 'full' or 'dots'"
+    )
+
+
 def rope(x, positions, theta: float):
     """Rotary embeddings; x [B, S, H, D], positions [S]."""
     d = x.shape[-1]
@@ -175,16 +187,7 @@ class TransformerLM(nn.Module):
         x = embed(tokens)
         positions = jnp.arange(S)
         if cfg.remat:
-            if cfg.remat_policy == "dots":
-                policy = jax.checkpoint_policies.dots_saveable
-            elif cfg.remat_policy == "full":
-                policy = None
-            else:
-                raise ValueError(
-                    f"unknown remat_policy {cfg.remat_policy!r}; "
-                    "expected 'full' or 'dots'"
-                )
-            block_cls = nn.remat(Block, policy=policy)
+            block_cls = nn.remat(Block, policy=resolve_remat_policy(cfg.remat_policy))
         else:
             block_cls = Block
         for i in range(cfg.num_layers):

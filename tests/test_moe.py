@@ -167,3 +167,112 @@ class TestMoELM:
             ("layer_0", "moe", "router"), jnp.zeros((64, 4))
         )
         assert spec == P()
+
+
+def test_moe_chunked_loss_matches_full():
+    """moe_lm_loss_chunked = moe_lm_loss (memory optimization, same math)."""
+    from kubeflow_tpu.models.moe import (
+        MoEConfig, MoETransformerLM, moe_lm_loss, moe_lm_loss_chunked,
+    )
+    import numpy as np
+
+    cfg = MoEConfig(
+        vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,
+        expert_hidden_dim=64, num_experts=4, experts_per_token=2,
+        max_seq_len=32, attention_impl="xla", dtype=jnp.float32,
+    )
+    model = MoETransformerLM(cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (2, 32)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    full = float(moe_lm_loss(model, params, tokens))
+    chunked = float(moe_lm_loss_chunked(model, params, tokens, chunk=16))
+    np.testing.assert_allclose(full, chunked, rtol=1e-6)
+
+    g_full = jax.grad(lambda p: moe_lm_loss(model, p, tokens))(params)
+    g_chunk = jax.grad(
+        lambda p: moe_lm_loss_chunked(model, p, tokens, chunk=16)
+    )(params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_full), jax.tree_util.tree_leaves(g_chunk)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_moe_remat_matches_no_remat():
+    """Rematted MoE blocks (aux-loss sow included) = same math."""
+    from kubeflow_tpu.models.moe import (
+        MoEConfig, MoETransformerLM, moe_lm_loss,
+    )
+    import numpy as np
+
+    kw = dict(
+        vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,
+        expert_hidden_dim=64, num_experts=4, experts_per_token=2,
+        max_seq_len=32, attention_impl="xla", dtype=jnp.float32,
+    )
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (2, 32)), jnp.int32
+    )
+    base = MoETransformerLM(MoEConfig(**kw))
+    params = base.init(jax.random.PRNGKey(0), tokens)["params"]
+    rematted = MoETransformerLM(MoEConfig(remat=True, **kw))
+    np.testing.assert_allclose(
+        float(moe_lm_loss(base, params, tokens)),
+        float(moe_lm_loss(rematted, params, tokens)),
+        rtol=1e-6,
+    )
+    g_a = jax.grad(lambda p: moe_lm_loss(base, p, tokens))(params)
+    g_b = jax.grad(lambda p: moe_lm_loss(rematted, p, tokens))(params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_a), jax.tree_util.tree_leaves(g_b)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_gather_dispatch_matches_einsum():
+    """dispatch='gather' (index-based, no one-hot FLOPs) must equal the
+    einsum dispatch — forward and gradients."""
+    from kubeflow_tpu.models.moe import MoEConfig, MoETransformerLM, moe_lm_loss
+    import numpy as np
+
+    kw = dict(
+        vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,
+        expert_hidden_dim=64, num_experts=4, experts_per_token=2,
+        max_seq_len=32, attention_impl="xla", dtype=jnp.float32,
+    )
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, 64, (2, 32)), jnp.int32
+    )
+    einsum_m = MoETransformerLM(MoEConfig(dispatch="einsum", **kw))
+    gather_m = MoETransformerLM(MoEConfig(dispatch="gather", **kw))
+    params = einsum_m.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    np.testing.assert_allclose(
+        np.asarray(einsum_m.apply({"params": params}, tokens)),
+        np.asarray(gather_m.apply({"params": params}, tokens)),
+        atol=1e-5,
+    )
+    g_e = jax.grad(lambda p: moe_lm_loss(einsum_m, p, tokens))(params)
+    g_g = jax.grad(lambda p: moe_lm_loss(gather_m, p, tokens))(params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_e), jax.tree_util.tree_leaves(g_g)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_gather_dispatch_rejects_expert_mesh():
+    from kubeflow_tpu.models.moe import MoEConfig, MoETransformerLM
+
+    mesh = meshlib.create_mesh(meshlib.MeshPlan(data=4, expert=2))
+    cfg = MoEConfig(
+        vocab_size=64, num_layers=1, num_heads=2, embed_dim=32,
+        expert_hidden_dim=64, num_experts=4, experts_per_token=2,
+        max_seq_len=32, attention_impl="xla", dispatch="gather",
+        dtype=jnp.float32, mesh=mesh,
+    )
+    model = MoETransformerLM(cfg)
+    tokens = jnp.zeros((2, 32), jnp.int32)
+    with pytest.raises(ValueError, match="expert-parallel"):
+        model.init(jax.random.PRNGKey(0), tokens)

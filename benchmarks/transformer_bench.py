@@ -73,6 +73,9 @@ def main() -> None:
     # measured length, see benchmarks/attention_bench.py)
     long_ctx = "--long" in sys.argv
     seq = 8192 if long_ctx else SEQ
+    if "--seq" in sys.argv:  # explicit context length (e.g. 32768)
+        seq = int(sys.argv[sys.argv.index("--seq") + 1])
+        long_ctx = seq > SEQ
     batch = 1 if long_ctx else BATCH
     devices = jax.devices()
     n_chips = len(devices)
@@ -87,8 +90,11 @@ def main() -> None:
         attention_impl="flash",
         attention_block_size=1024,
         remat=True,           # activations at 24-layer depth exceed HBM
-        remat_policy="dots",  # fits once flash + chunked loss free the S^2
-                              # scores and fp32 logits; skips the recompute
+        # dots_saveable fits (and wins) once flash + chunked loss free the
+        # S^2 scores and fp32 logits — up to seq 8192; at 16k+ even the
+        # saved matmul outputs (~700 MB/layer at 32k) exceed HBM, so very
+        # long contexts fall back to full per-block remat
+        remat_policy="full" if seq > 8192 else "dots",
         dtype=jnp.bfloat16,
     )
     model = TransformerLM(cfg)

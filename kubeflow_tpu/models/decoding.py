@@ -1,0 +1,133 @@
+"""Autoregressive decoding with a KV cache — the inference half of the
+notebook model stack.
+
+The reference ships no model code (SURVEY.md §2); training throughput is
+covered by the benches, and this adds the generation path a notebook user
+expects from the same checkpoint:
+
+- prefill: one forward over the whole prompt fills every layer's KV cache
+  (``TransformerConfig(decode=True)``; grouped KV stays grouped — GQA
+  divides cache memory by H/KV);
+- decode: ``lax.while_loop`` over single-token steps, cache threaded as a
+  jit-carried pytree — one compiled program, no per-step retrace;
+- sampling: greedy (temperature 0), temperature, and top-k, all shape-static;
+- early exit: generation stops when every row has emitted ``eos_id`` (the
+  emitted suffix stays padded with eos).
+
+Decode attention is the cache-masked naive path: at S=1 the score row is
+[1, L] — there is nothing for a flash kernel to tile, and XLA fuses the
+mask+softmax+pv chain into the cache read.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+
+
+def decode_config(cfg: TransformerConfig) -> TransformerConfig:
+    """The decoding twin of a training config (same params, cache on)."""
+    return dataclasses.replace(
+        cfg, decode=True, remat=False, attention_impl="xla", mesh=None
+    )
+
+
+def _sample(logits, temperature, top_k, rng):
+    """logits [B, V] f32 → token ids [B]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k", "eos_id"),
+)
+def generate(
+    model: TransformerLM,
+    params,
+    prompt: jnp.ndarray,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    eos_id: int | None = None,
+    rng: Any = None,
+):
+    """Generate ``max_new_tokens`` continuations of ``prompt`` [B, P].
+
+    ``model`` must be built with ``decode_config(cfg)``; params are the
+    training params unchanged. Returns [B, P + max_new_tokens] tokens.
+    """
+    cfg = model.cfg
+    B, P = prompt.shape
+    if P + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {P} + new {max_new_tokens} exceeds the cache "
+            f"(max_seq_len={cfg.max_seq_len})"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    # prefill: full prompt in one pass, cache initialized + filled
+    logits, state = model.apply(
+        {"params": params}, prompt, positions=jnp.arange(P),
+        mutable=["cache"],
+    )
+    next_tok = _sample(
+        logits[:, -1].astype(jnp.float32), temperature, top_k, rng
+    )
+
+    # pad with eos (not 0 — a real token id) so rows that finish early
+    # carry an eos suffix, per the module contract
+    pad_id = eos_id if eos_id is not None else 0
+    tokens0 = jnp.concatenate(
+        [prompt, jnp.full((B, max_new_tokens), pad_id, prompt.dtype)], axis=1
+    )
+    tokens0 = lax.dynamic_update_slice(tokens0, next_tok[:, None], (0, P))
+    done0 = (
+        next_tok == eos_id if eos_id is not None
+        else jnp.zeros((B,), jnp.bool_)
+    )
+
+    def cond(carry):
+        step, _, _, done, _ = carry
+        return jnp.logical_and(step < max_new_tokens - 1, ~jnp.all(done))
+
+    def body(carry):
+        step, tokens, cache, done, rng = carry
+        pos = P + step
+        cur = lax.dynamic_slice(tokens, (0, pos), (B, 1))
+        logits, new_state = model.apply(
+            {"params": params, "cache": cache}, cur,
+            positions=pos[None], mutable=["cache"],
+        )
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(
+            logits[:, -1].astype(jnp.float32), temperature, top_k, sub
+        )
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+            done = jnp.logical_or(done, nxt == eos_id)
+        tokens = lax.dynamic_update_slice(tokens, nxt[:, None], (0, pos + 1))
+        return step + 1, tokens, new_state["cache"], done, rng
+
+    if max_new_tokens > 1:
+        _, tokens, _, _, _ = lax.while_loop(
+            cond,
+            body,
+            (jnp.asarray(0, jnp.int32), tokens0, state["cache"], done0, rng),
+        )
+    else:
+        tokens = tokens0
+    return tokens

@@ -48,6 +48,9 @@ class TransformerConfig:
     remat_policy: str = "full"           # full | dots: "dots" saves matmul
                                          # outputs and recomputes elementwise
                                          # (cheaper recompute, more HBM)
+    decode: bool = False                 # autoregressive mode: Attention
+                                         # keeps a KV cache (max_seq_len
+                                         # slots) and attends against it
     dtype: Any = jnp.bfloat16
     mesh: Any = None                     # required for attention_impl == "ring"
 
@@ -114,15 +117,22 @@ class Attention(nn.Module):
         v = dense(features=(KV, D), name="v_proj")(x)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        if KV != H and cfg.attention_impl != "flash":
+
+        if not cfg.decode and KV != H and cfg.attention_impl != "flash":
             # GQA: expand kv heads to query heads for the paths that need
-            # per-head alignment; the flash kernels take grouped K/V
-            # directly (head mapping in the BlockSpec index maps)
+            # per-head alignment; the flash kernels (and the KV cache) take
+            # grouped K/V directly
             reps = H // KV
             k = jnp.repeat(k, reps, axis=2)
             v = jnp.repeat(v, reps, axis=2)
 
-        if cfg.attention_impl == "xla":
+        if cfg.decode:
+            # KV-cache attention (prefill writes S slots, decode writes 1);
+            # grouped KV stays grouped in the cache — queries fold into
+            # [KV, H/KV] groups at score time, so GQA shrinks both cache
+            # memory and per-step read traffic by H/KV
+            o = self._cached_attention(q, k, v, positions)
+        elif cfg.attention_impl == "xla":
             o = att.naive_attention(q, k, v, causal=True)
         elif cfg.attention_impl == "block":
             o = att.blockwise_attention(
@@ -145,6 +155,46 @@ class Attention(nn.Module):
             raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
         o = o.reshape(B, S, H * D)
         return dense(features=E, axis=-1, name="o_proj")(o)
+
+    def _cached_attention(self, q, k, v, positions):
+        """Attend q [B,S,H,D] against the rolling cache; new k/v are written
+        at ``positions`` (contiguous, starting at positions[0]). Returns the
+        pre-projection context [B,S,H,D] — the caller applies the shared
+        o_proj so the decode and training paths cannot diverge."""
+        cfg = self.cfg
+        B, S, H, D = q.shape
+        G = cfg.kv_heads
+        R = H // G
+        L = cfg.max_seq_len
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros, (B, L, G, D), cfg.dtype,
+        )
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros, (B, L, G, D), cfg.dtype,
+        )
+        start = positions[0]
+        k_all = lax.dynamic_update_slice(
+            cached_k.value, k.astype(cfg.dtype), (0, start, 0, 0)
+        )
+        v_all = lax.dynamic_update_slice(
+            cached_v.value, v.astype(cfg.dtype), (0, start, 0, 0)
+        )
+        cached_k.value = k_all
+        cached_v.value = v_all
+
+        # fold q into [group, rep] so the cache is read grouped — no
+        # H-expanded [B, L, H, D] copy in the per-token hot loop
+        q_g = q.reshape(B, S, G, R, D)
+        s = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", q_g, k_all,
+            preferred_element_type=jnp.float32,
+        ) * (D ** -0.5)
+        kpos = jnp.arange(L)[None, :]
+        mask = kpos <= positions[:, None]              # [S, L] causal vs cache
+        s = jnp.where(mask[None, None, None], s, att.NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bgrqk,bkgd->bqgrd", p.astype(v_all.dtype), v_all)
+        return o.reshape(B, S, H, D)
 
 
 class MLP(nn.Module):
@@ -177,7 +227,8 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True, return_hidden: bool = False):
+    def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
+                 positions=None):
         cfg = self.cfg
         B, S = tokens.shape
         embed = nn.Embed(
@@ -185,7 +236,8 @@ class TransformerLM(nn.Module):
             dtype=cfg.dtype, param_dtype=jnp.float32, name="embed",
         )
         x = embed(tokens)
-        positions = jnp.arange(S)
+        if positions is None:
+            positions = jnp.arange(S)
         if cfg.remat:
             block_cls = nn.remat(Block, policy=resolve_remat_policy(cfg.remat_policy))
         else:

@@ -1,0 +1,89 @@
+"""Input pipeline: host→device prefetch for training loops.
+
+The reference has no data-loading component at all (its workloads are
+interactive notebooks; SURVEY.md §2) — but a TPU training framework needs
+one: ``jax.device_put`` is asynchronous, so keeping a small queue of batches
+in flight overlaps PCIe/DMA transfer (and host-side batch assembly) with the
+previous step's compute, instead of stalling the chip at every step boundary.
+
+    it = DevicePrefetcher(host_batches(), meshlib.batch_sharding(mesh))
+    for batch in it:            # batch is already on device, sharded
+        state, metrics = step(state, batch)
+
+Design notes (TPU-first):
+- transfers are dispatched ``depth`` batches ahead (default 2 — one being
+  consumed, one in flight; more rarely helps and costs HBM);
+- the sharding is applied at transfer time (``device_put`` with a
+  NamedSharding), so each host only materializes its addressable shards —
+  the multi-host-safe layout, same as the checkpoint layer's;
+- any nested pytree of numpy/jax arrays works as a batch.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Iterable, Iterator
+
+import jax
+import numpy as np
+
+
+class DevicePrefetcher:
+    """Wraps a host batch iterator; yields device-resident, sharded batches
+    while keeping ``depth`` transfers in flight."""
+
+    def __init__(self, batches: Iterable[Any], sharding: Any, *, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._it = iter(batches)
+        self._sharding = sharding
+        self._depth = depth
+        self._queue: collections.deque = collections.deque()
+
+    def _put(self, batch: Any) -> Any:
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self._sharding), batch
+        )
+
+    def _fill(self) -> None:
+        while len(self._queue) < self._depth:
+            try:
+                batch = next(self._it)
+            except StopIteration:
+                return
+            self._queue.append(self._put(batch))
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        self._fill()
+        if not self._queue:
+            raise StopIteration
+        out = self._queue.popleft()
+        self._fill()  # immediately dispatch the replacement transfer
+        return out
+
+
+def synthetic_token_batches(
+    *, batch: int, seq_len: int, vocab_size: int, seed: int = 0,
+    steps: int | None = None,
+) -> Iterator[np.ndarray]:
+    """Endless (or ``steps``-bounded) random token batches — the benchmark
+    and smoke-test data source."""
+    rng = np.random.default_rng(seed)
+    n = 0
+    while steps is None or n < steps:
+        yield rng.integers(
+            0, vocab_size, (batch, seq_len), dtype=np.int32
+        )
+        n += 1
+
+
+def map_batches(
+    batches: Iterable[Any], fn: Callable[[Any], Any]
+) -> Iterator[Any]:
+    """Host-side transform stage (tokenize, augment, pack) applied before
+    transfer; composes with DevicePrefetcher so the transform of batch N+1
+    overlaps the device compute of batch N."""
+    for b in batches:
+        yield fn(b)

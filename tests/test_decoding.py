@@ -1,0 +1,117 @@
+"""KV-cache decoding vs the full-forward oracle."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.decoding import decode_config, generate
+from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+
+
+def cfg_pair(**kw):
+    base = TransformerConfig(
+        vocab_size=97,
+        num_layers=2,
+        num_heads=4,
+        embed_dim=64,
+        mlp_dim=128,
+        max_seq_len=64,
+        attention_impl="xla",
+        dtype=jnp.float32,
+        **kw,
+    )
+    return base, decode_config(base)
+
+
+def greedy_oracle(model, params, prompt, n):
+    """Teacher-free greedy decoding by full re-forward each step."""
+    tokens = prompt
+    for _ in range(n):
+        logits = model.apply({"params": params}, tokens)
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        tokens = jnp.concatenate(
+            [tokens, nxt[:, None].astype(tokens.dtype)], axis=1
+        )
+    return tokens
+
+
+@pytest.mark.parametrize("kv_heads", [None, 2])
+def test_greedy_decode_matches_full_forward(kv_heads):
+    base, dec = cfg_pair(num_kv_heads=kv_heads)
+    train_model = TransformerLM(base)
+    decode_model = TransformerLM(dec)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 97, (2, 7)), jnp.int32
+    )
+    params = train_model.init(jax.random.PRNGKey(0), prompt)["params"]
+
+    want = greedy_oracle(train_model, params, prompt, 9)
+    got = generate(decode_model, params, prompt, max_new_tokens=9)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_prefill_logits_match_full_forward():
+    base, dec = cfg_pair()
+    train_model = TransformerLM(base)
+    decode_model = TransformerLM(dec)
+    prompt = jnp.asarray(
+        np.random.default_rng(1).integers(0, 97, (2, 12)), jnp.int32
+    )
+    params = train_model.init(jax.random.PRNGKey(0), prompt)["params"]
+    full = train_model.apply({"params": params}, prompt)
+    cached, _ = decode_model.apply(
+        {"params": params}, prompt, positions=jnp.arange(12),
+        mutable=["cache"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(cached), np.asarray(full), atol=1e-4
+    )
+
+
+def test_eos_freezes_finished_rows():
+    base, dec = cfg_pair()
+    decode_model = TransformerLM(dec)
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, 97, (2, 4)), jnp.int32
+    )
+    params = TransformerLM(base).init(jax.random.PRNGKey(0), prompt)["params"]
+    # pick the token greedy decoding emits first for row 0 as "eos"
+    first = generate(decode_model, params, prompt, max_new_tokens=1)
+    eos = int(first[0, 4])
+    out = generate(
+        decode_model, params, prompt, max_new_tokens=6, eos_id=eos
+    )
+    row = np.asarray(out[0, 4:])
+    # once eos is hit, the rest of the row stays eos
+    hit = np.argmax(row == eos)
+    assert (row[hit:] == eos).all()
+
+
+def test_temperature_sampling_is_reproducible_and_in_range():
+    base, dec = cfg_pair()
+    decode_model = TransformerLM(dec)
+    prompt = jnp.asarray(
+        np.random.default_rng(3).integers(0, 97, (2, 4)), jnp.int32
+    )
+    params = TransformerLM(base).init(jax.random.PRNGKey(0), prompt)["params"]
+    a = generate(
+        decode_model, params, prompt, max_new_tokens=5,
+        temperature=1.0, top_k=8, rng=jax.random.PRNGKey(7),
+    )
+    b = generate(
+        decode_model, params, prompt, max_new_tokens=5,
+        temperature=1.0, top_k=8, rng=jax.random.PRNGKey(7),
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(jnp.max(a)) < 97 and int(jnp.min(a)) >= 0
+
+
+def test_generate_rejects_cache_overflow():
+    base, dec = cfg_pair()
+    decode_model = TransformerLM(dec)
+    prompt = jnp.zeros((1, 60), jnp.int32)
+    params = TransformerLM(base).init(jax.random.PRNGKey(0), prompt)["params"]
+    with pytest.raises(ValueError, match="exceeds the cache"):
+        generate(decode_model, params, prompt, max_new_tokens=10)

@@ -1,0 +1,75 @@
+"""Autoregressive decode throughput on the flagship transformer (real chip).
+
+Measures generate() — prefill 128-token prompts, then 128 compiled
+while_loop decode steps with temperature/top-k sampling — and prints one
+JSON line. Methodology: the tunneled runtime's fixed readback cost cancels
+in a 1-call vs 3-call window subtraction (BASELINE.md "Methodology");
+sync is a value fetch, never block_until_ready.
+"""
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.decoding import decode_config, generate
+from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+
+BATCH, PROMPT, NEW = 4, 128, 128
+
+
+def main() -> None:
+    base = TransformerConfig(
+        vocab_size=32_000, num_layers=24, num_heads=8, embed_dim=1024,
+        mlp_dim=4096, max_seq_len=2048, num_kv_heads=4,
+        attention_impl="flash", dtype=jnp.bfloat16,
+    )
+    model = TransformerLM(decode_config(base))
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, base.vocab_size, (BATCH, PROMPT)), jnp.int32
+    )
+    params = jax.jit(
+        lambda k: TransformerLM(base).init(k, prompt)["params"]
+    )(jax.random.PRNGKey(0))
+
+    def run(n, seed0):
+        t = time.perf_counter()
+        out = None
+        for i in range(n):
+            out = generate(
+                model, params, prompt, max_new_tokens=NEW,
+                temperature=0.8, top_k=40, rng=jax.random.PRNGKey(seed0 + i),
+            )
+        tok = int(out[0, -1])  # ONE value fetch per window: the fixed
+        return time.perf_counter() - t, tok  # readback cancels in t3 - t1
+
+    run(1, 0)  # compile + warm
+    rates = []
+    for r in range(3):
+        t1, _ = run(1, 10 + r)
+        t3, _ = run(3, 20 + r)
+        per_call = (t3 - t1) / 2
+        rates.append(NEW / per_call)
+    per_row = statistics.median(rates)
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_row",
+        "value": round(per_row, 1),
+        "unit": "tok/s/row",
+        "batch_tok_per_sec": round(per_row * BATCH, 1),
+        "params_m": 435.5,
+        "kv_heads": 4,
+        "batch": BATCH,
+        "prompt_len": PROMPT,
+        "new_tokens": NEW,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,7 +44,9 @@ def _sample(logits, temperature, top_k, rng):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k is not None:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        # lax.top_k for just the threshold — a full vocab sort per decode
+        # step is the expensive way to find one value
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
@@ -84,8 +86,9 @@ def generate(
         {"params": params}, prompt, positions=jnp.arange(P),
         mutable=["cache"],
     )
+    rng, prefill_rng = jax.random.split(rng)  # keys are single-use
     next_tok = _sample(
-        logits[:, -1].astype(jnp.float32), temperature, top_k, rng
+        logits[:, -1].astype(jnp.float32), temperature, top_k, prefill_rng
     )
 
     # pad with eos (not 0 — a real token id) so rows that finish early

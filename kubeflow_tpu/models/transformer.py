@@ -182,18 +182,26 @@ class Attention(nn.Module):
         cached_k.value = k_all
         cached_v.value = v_all
 
+        # prefill (S > 1, writes from slot 0) only needs the first S cache
+        # slots — scoring all L would build [B,G,R,S,L] fp32 scores that are
+        # masked anyway and OOM at long max_seq_len; single-token decode
+        # attends the full cache
+        k_att = k_all[:, :S] if S > 1 else k_all
+        v_att = v_all[:, :S] if S > 1 else v_all
+        L_att = k_att.shape[1]
+
         # fold q into [group, rep] so the cache is read grouped — no
         # H-expanded [B, L, H, D] copy in the per-token hot loop
         q_g = q.reshape(B, S, G, R, D)
         s = jnp.einsum(
-            "bqgrd,bkgd->bgrqk", q_g, k_all,
+            "bqgrd,bkgd->bgrqk", q_g, k_att,
             preferred_element_type=jnp.float32,
         ) * (D ** -0.5)
-        kpos = jnp.arange(L)[None, :]
+        kpos = jnp.arange(L_att)[None, :]
         mask = kpos <= positions[:, None]              # [S, L] causal vs cache
         s = jnp.where(mask[None, None, None], s, att.NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bgrqk,bkgd->bqgrd", p.astype(v_all.dtype), v_all)
+        o = jnp.einsum("bgrqk,bkgd->bqgrd", p.astype(v_att.dtype), v_att)
         return o.reshape(B, S, H, D)
 
 

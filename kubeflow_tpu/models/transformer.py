@@ -42,6 +42,8 @@ class TransformerConfig:
     rope_theta: float = 10_000.0
     attention_impl: str = "block"        # xla | block | flash | ring
     attention_block_size: int = 512
+    attention_window: int | None = None  # sliding-window (local) attention;
+                                         # flash + xla impls only
     remat: bool = False                  # jax.checkpoint each block: trades
                                          # recompute FLOPs for activation HBM
                                          # (long-seq/deep configs need it)
@@ -126,6 +128,13 @@ class Attention(nn.Module):
             k = jnp.repeat(k, reps, axis=2)
             v = jnp.repeat(v, reps, axis=2)
 
+        if cfg.attention_window is not None and cfg.attention_impl not in (
+            "xla", "flash"
+        ):
+            raise ValueError(
+                "attention_window is supported by the 'xla' and 'flash' "
+                f"impls, not {cfg.attention_impl!r}"
+            )
         if cfg.decode:
             # KV-cache attention (prefill writes S slots, decode writes 1);
             # grouped KV stays grouped in the cache — queries fold into
@@ -133,14 +142,17 @@ class Attention(nn.Module):
             # memory and per-step read traffic by H/KV
             o = self._cached_attention(q, k, v, positions)
         elif cfg.attention_impl == "xla":
-            o = att.naive_attention(q, k, v, causal=True)
+            o = att.naive_attention(
+                q, k, v, causal=True, window=cfg.attention_window
+            )
         elif cfg.attention_impl == "block":
             o = att.blockwise_attention(
                 q, k, v, causal=True, block_size=cfg.attention_block_size
             )
         elif cfg.attention_impl == "flash":
             o = flash_attention(
-                q, k, v, True, cfg.attention_block_size, cfg.attention_block_size
+                q, k, v, True, cfg.attention_block_size,
+                cfg.attention_block_size, None, cfg.attention_window,
             )
         elif cfg.attention_impl == "ring":
             if cfg.mesh is None:
@@ -199,6 +211,12 @@ class Attention(nn.Module):
         ) * (D ** -0.5)
         kpos = jnp.arange(L_att)[None, :]
         mask = kpos <= positions[:, None]              # [S, L] causal vs cache
+        if cfg.attention_window is not None:
+            # honor the train-time sliding window at inference (cache still
+            # stores all slots; masking keeps the distributions matched)
+            mask = jnp.logical_and(
+                mask, kpos > positions[:, None] - cfg.attention_window
+            )
         s = jnp.where(mask[None, None, None], s, att.NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bgrqk,bkgd->bqgrd", p.astype(v_att.dtype), v_att)

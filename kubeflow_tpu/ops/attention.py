@@ -23,18 +23,25 @@ from jax import lax
 NEG_INF = -1e30
 
 
-def naive_attention(q, k, v, *, causal: bool = True, scale: float | None = None):
+def naive_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
+                    window: int | None = None):
     """Materialized-scores attention; the correctness oracle for everything else.
 
     Shapes: q [B, Sq, H, D], k/v [B, Sk, H, D] -> [B, Sq, H, D].
+    ``window``: sliding-window mask (causal only) — q attends [q-window+1, q].
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s * scale
     if causal:
         qpos = jnp.arange(q.shape[1])[:, None]
         kpos = jnp.arange(k.shape[1])[None, :]
-        s = jnp.where(kpos <= qpos, s, NEG_INF)
+        keep = kpos <= qpos
+        if window is not None:
+            keep = jnp.logical_and(keep, kpos > qpos - window)
+        s = jnp.where(keep, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum(
         "bhqk,bkhd->bqhd", p.astype(v.dtype), v

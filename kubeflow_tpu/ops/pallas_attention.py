@@ -55,10 +55,14 @@ LSE_LANES = 8   # f32 sublane count: the lse residual is replicated to 8
 _TRANS_B = (((1,), (1,)), ((), ()))
 
 
-def _causal_mask(s, iq, ik, bq, bk):
+def _causal_mask(s, iq, ik, bq, bk, window=None):
     qpos = iq * bq + lax.broadcasted_iota(jnp.int32, s.shape, 0)
     kpos = ik * bk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(kpos <= qpos, s, NEG_INF)
+    keep = kpos <= qpos
+    if window is not None:
+        # sliding window: q attends k in [q - window + 1, q]
+        keep = jnp.logical_and(keep, kpos > qpos - window)
+    return jnp.where(keep, s, NEG_INF)
 
 
 def _when_valid(skip, cond, fn):
@@ -97,15 +101,46 @@ def _minor_index(skip, valid, fallback, group=1):
     return index
 
 
-def _kv_at_minor(skip, group=1):
+def _kv_valid(bq, bk, window):
+    """Validity predicate for k blocks on (iq, ik) grids; with a sliding
+    window, blocks entirely left of [q - window + 1, q] are skipped too."""
+    if window is None:
+        return (lambda iq, ik: ik <= iq), (lambda iq, ik: 0)
+
+    def lo(iq):  # first k block visible to any row of q block iq
+        return jnp.maximum(0, (iq * bq - (window - 1)) // bk)
+
+    return (
+        lambda iq, ik: jnp.logical_and(ik <= iq, ik >= lo(iq)),
+        lambda iq, ik: jnp.clip(ik, lo(iq), iq),
+    )
+
+
+def _q_valid(bq, bk, window, nq):
+    """Validity predicate for q blocks on the (ik, iq) dkv grid."""
+    if window is None:
+        return (lambda ik, iq: iq >= ik), (lambda ik, iq: ik)
+
+    def hi(ik):  # last q block that can see any row of k block ik
+        return jnp.minimum(nq - 1, (ik * bk + bk - 2 + window) // bq)
+
+    return (
+        lambda ik, iq: jnp.logical_and(iq >= ik, iq <= hi(ik)),
+        lambda ik, iq: jnp.clip(iq, ik, hi(ik)),
+    )
+
+
+def _kv_at_minor(skip, group=1, *, bq=1, bk=1, window=None):
     # fwd/dq grids (b, h, iq, ik): k/v blocks walk the minor (ik) axis
-    return _minor_index(skip, lambda iq, ik: ik <= iq, lambda iq, ik: 0, group)
+    valid, fallback = _kv_valid(bq, bk, window)
+    return _minor_index(skip, valid, fallback, group)
 
 
-def _q_at_minor(skip):
+def _q_at_minor(skip, *, bq=1, bk=1, window=None, nq=1):
     # dkv grid (b, h, ik, iq): q-side blocks walk the minor (iq) axis;
-    # skipped q blocks re-point at the diagonal (first valid for this k)
-    return _minor_index(skip, lambda ik, iq: iq >= ik, lambda ik, iq: ik)
+    # skipped q blocks re-point at the nearest valid block for this k
+    valid, fallback = _q_valid(bq, bk, window, nq)
+    return _minor_index(skip, valid, fallback)
 
 
 def _group_of(q, k, v):
@@ -123,7 +158,7 @@ def _group_of(q, k, v):
 # ---------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, skip, bq, bk, nk):
+                *, scale, causal, skip, bq, bk, nk, window=None):
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -141,7 +176,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             q, k, _TRANS_B, preferred_element_type=jnp.float32
         ) * scale                                     # [bq, bk] f32
         if causal:
-            s = _causal_mask(s, iq, ik, bq, bk)
+            s = _causal_mask(s, iq, ik, bq, bk, window)
 
         m_prev = m_ref[:, :1]                         # [bq, 1] (lane-replicated)
         l_prev = l_ref[:, :1]
@@ -156,7 +191,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    _when_valid(skip, ik <= iq, _body)
+    _when_valid(skip, _kv_valid(bq, bk, window)[0](iq, ik), _body)
 
     @pl.when(ik == (iq if skip else nk - 1))
     def _finalize():
@@ -193,18 +228,20 @@ def _compiler_params(interpret):
 
 
 def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
-                   save_residuals=False):
+                   save_residuals=False, window=None):
     """q/k/v in [B, H, S, D] (k/v may carry fewer heads — GQA); returns o
     (and lse [B, H, Sq, LSE_LANES] f32)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     group = _group_of(q, k, v)
     bq, bk, nq, nk, skip = _block_plan(Sq, Sk, block_q, block_k, causal)
+    if window is not None and (window < 1 or not causal):
+        raise ValueError("window requires causal=True and window >= 1")
     scale = D ** -0.5
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, skip=skip,
-        bq=bq, bk=bk, nk=nk,
+        bq=bq, bk=bk, nk=nk, window=window,
     )
     out_shape = [jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype)]
     out_specs = [pl.BlockSpec((1, 1, bq, D), _major_index)]
@@ -228,8 +265,14 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), _major_index),
-            pl.BlockSpec((1, 1, bk, D), _kv_at_minor(skip, group)),
-            pl.BlockSpec((1, 1, bk, D), _kv_at_minor(skip, group)),
+            pl.BlockSpec(
+                (1, 1, bk, D),
+                _kv_at_minor(skip, group, bq=bq, bk=bk, window=window),
+            ),
+            pl.BlockSpec(
+                (1, 1, bk, D),
+                _kv_at_minor(skip, group, bq=bq, bk=bk, window=window),
+            ),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -245,7 +288,7 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
 # ---------------------------------------------------------------- backward
 
 def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, acc_ref,
-               di_ref, *, scale, causal, skip, bq, bk, nk):
+               di_ref, *, scale, causal, skip, bq, bk, nk, window=None):
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -273,7 +316,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, acc_ref,
             q, k, _TRANS_B, preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            s = _causal_mask(s, iq, ik, bq, bk)
+            s = _causal_mask(s, iq, ik, bq, bk, window)
         p = jnp.exp(s - lse)                          # [bq, bk] f32, normalized
         dp = lax.dot_general(
             do, v, _TRANS_B, preferred_element_type=jnp.float32
@@ -283,7 +326,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, acc_ref,
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32
         )
 
-    _when_valid(skip, ik <= iq, _body)
+    _when_valid(skip, _kv_valid(bq, bk, window)[0](iq, ik), _body)
 
     @pl.when(ik == (iq if skip else nk - 1))
     def _write():
@@ -291,7 +334,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, acc_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
-                dk_acc, dv_acc, *, scale, causal, skip, bq, bk, nq):
+                dk_acc, dv_acc, *, scale, causal, skip, bq, bk, nq,
+                window=None):
     ik, iq = pl.program_id(2), pl.program_id(3)      # note: k major, q minor
 
     @pl.when(iq == 0)
@@ -314,7 +358,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
             q, k, _TRANS_B, preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            s = _causal_mask(s, iq, ik, bq, bk)
+            s = _causal_mask(s, iq, ik, bq, bk, window)
         p = jnp.exp(s - lse)                          # [bq, bk]
         dv_acc[...] += lax.dot(
             p.T.astype(do.dtype), do, preferred_element_type=jnp.float32
@@ -327,7 +371,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
             ds.T.astype(q.dtype), q, preferred_element_type=jnp.float32
         )
 
-    _when_valid(skip, iq >= ik, _body)
+    _when_valid(skip, _q_valid(bq, bk, window, nq)[0](ik, iq), _body)
 
     @pl.when(iq == nq - 1)
     def _write():
@@ -336,7 +380,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
 
 
 def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k,
-                    interpret, grad_dtype=None):
+                    interpret, grad_dtype=None, window=None):
     """All operands [B, H, S, D] (lse [B, H, Sq, LSE_LANES]); returns dq/dk/dv.
 
     ``grad_dtype`` overrides the output dtype (default: match the inputs) —
@@ -354,12 +398,14 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k,
 
     q_side = pl.BlockSpec((1, 1, bq, D), _major_index)
     lse_at_major = pl.BlockSpec((1, 1, bq, LSE_LANES), _major_index)
-    kv_minor = pl.BlockSpec((1, 1, bk, D), _kv_at_minor(skip, group))
+    kv_minor = pl.BlockSpec(
+        (1, 1, bk, D), _kv_at_minor(skip, group, bq=bq, bk=bk, window=window)
+    )
 
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal, skip=skip,
-            bq=bq, bk=bk, nk=nk,
+            bq=bq, bk=bk, nk=nk, window=window,
         ),
         grid=(B, H, nq, nk),
         in_specs=[q_side, kv_minor, kv_minor, q_side, q_side, lse_at_major],
@@ -370,8 +416,13 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k,
         interpret=interpret,
     )(q, k, v, o, do, lse)
 
-    q_minor = pl.BlockSpec((1, 1, bq, D), _q_at_minor(skip))
-    lse_at_minor = pl.BlockSpec((1, 1, bq, LSE_LANES), _q_at_minor(skip))
+    q_minor = pl.BlockSpec(
+        (1, 1, bq, D), _q_at_minor(skip, bq=bq, bk=bk, window=window, nq=nq)
+    )
+    lse_at_minor = pl.BlockSpec(
+        (1, 1, bq, LSE_LANES),
+        _q_at_minor(skip, bq=bq, bk=bk, window=window, nq=nq),
+    )
     kv_major = pl.BlockSpec((1, 1, bk, D), _grouped_major(group))
 
     # per-Q-head partials; for GQA they reduce over the group afterwards
@@ -380,7 +431,7 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, skip=skip,
-            bq=bq, bk=bk, nq=nq,
+            bq=bq, bk=bk, nq=nq, window=window,
         ),
         grid=(B, H, nk, nq),
         in_specs=[q_minor, kv_major, kv_major, q_minor, q_minor, lse_at_minor],
@@ -412,45 +463,49 @@ def _auto_interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512,
-    interpret: bool | None = None,
+    interpret: bool | None = None, window: int | None = None,
 ):
     """Fused attention. Layout [B, S, H, D] (matching ops/attention.py).
 
     GQA/MQA: pass k/v with fewer heads than q (H % KV == 0) — the kernels
     map each query head onto its kv group in the BlockSpec index maps, so
-    grouped K/V are never expanded to H heads in HBM."""
+    grouped K/V are never expanded to H heads in HBM.
+
+    ``window``: sliding-window (local) attention — position q attends
+    [q - window + 1, q]; out-of-window blocks are skipped like the causal
+    upper triangle, so compute scales with S*window, not S^2."""
     if interpret is None:
         interpret = _auto_interpret()
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     out = _flash_forward(
         qt, kt, vt, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        interpret=interpret, window=window,
     )
     return out.transpose(0, 2, 1, 3)
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, window):
     if interpret is None:
         interpret = _auto_interpret()
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     out, lse = _flash_forward(
         qt, kt, vt, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret, save_residuals=True,
+        interpret=interpret, save_residuals=True, window=window,
     )
     return out.transpose(0, 2, 1, 3), (qt, kt, vt, out, lse)
 
 
-def _bwd(causal, block_q, block_k, interpret, res, g):
+def _bwd(causal, block_q, block_k, interpret, window, res, g):
     if interpret is None:
         interpret = _auto_interpret()
     qt, kt, vt, out, lse = res
     do = g.transpose(0, 2, 1, 3)
     dq, dk, dv = _flash_backward(
         qt, kt, vt, out, lse, do, causal=causal, block_q=block_q,
-        block_k=block_k, interpret=interpret,
+        block_k=block_k, interpret=interpret, window=window,
     )
     return tuple(x.transpose(0, 2, 1, 3) for x in (dq, dk, dv))
 

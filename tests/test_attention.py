@@ -207,3 +207,87 @@ def test_flash_rejects_bad_head_grouping(qkv):
     q, k, v = qkv
     with pytest.raises(ValueError, match="multiple of kv heads"):
         flash_attention(q, k[:, :, :3], v[:, :, :3], True, 64, 64)
+
+
+@pytest.mark.parametrize("window", [1, 37, 64, 100, 256])
+def test_flash_sliding_window(qkv, window):
+    """Sliding-window flash vs the windowed oracle — fwd and bwd (the
+    window adds a lower block bound to the skip logic on all three grids)."""
+    q, k, v = qkv
+    ref = naive_attention(q, k, v, causal=True, window=window)
+    out = flash_attention(q, k, v, True, 64, 64, None, window)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            naive_attention(q, k, v, causal=True, window=window) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, True, 64, 64, None, window) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+def test_flash_window_requires_causal(qkv):
+    q, k, v = qkv
+    with pytest.raises(ValueError, match="window requires causal"):
+        flash_attention(q, k, v, False, 64, 64, None, 32)
+
+
+def test_flash_window_gqa_and_unequal_blocks(qkv):
+    """Window through the mask-only path (bq != bk disables skipping) and
+    through the GQA grouped index maps."""
+    q, k, v = qkv
+    ref = naive_attention(q, k, v, causal=True, window=50)
+    out = flash_attention(q, k, v, True, 32, 64, None, 50)  # skip OFF
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    kg, vg = k[:, :, :2], v[:, :, :2]
+    ref = naive_attention(
+        q, jnp.repeat(kg, 2, axis=2), jnp.repeat(vg, 2, axis=2),
+        causal=True, window=50,
+    )
+    out = flash_attention(q, kg, vg, True, 64, 64, None, 50)  # GQA + window
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+    g_ref = jax.grad(
+        lambda kg: jnp.sum(naive_attention(
+            q, jnp.repeat(kg, 2, axis=2), jnp.repeat(vg, 2, axis=2),
+            causal=True, window=50) ** 2)
+    )(kg)
+    g = jax.grad(
+        lambda kg: jnp.sum(flash_attention(q, kg, vg, True, 64, 64, None, 50) ** 2)
+    )(kg)
+    np.testing.assert_allclose(g, g_ref, atol=5e-4)
+
+
+def test_decode_honors_attention_window():
+    """A decode config carries the train-time window into cached attention."""
+    from kubeflow_tpu.models.decoding import decode_config, generate
+    from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    kw = dict(vocab_size=97, num_layers=2, num_heads=4, embed_dim=64,
+              mlp_dim=128, max_seq_len=64, dtype=jnp.float32)
+    base = TransformerConfig(attention_impl="xla", attention_window=8, **kw)
+    dec = decode_config(base)
+    assert dec.attention_window == 8
+    train_m, dec_m = TransformerLM(base), TransformerLM(dec)
+    prompt = jnp.asarray(
+        np.random.default_rng(5).integers(0, 97, (2, 12)), jnp.int32
+    )
+    params = train_m.init(jax.random.PRNGKey(0), prompt)["params"]
+    # greedy cached decode must match the windowed full-forward oracle
+    tokens = prompt
+    for _ in range(6):
+        logits = train_m.apply({"params": params}, tokens)
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        tokens = jnp.concatenate(
+            [tokens, nxt[:, None].astype(tokens.dtype)], axis=1
+        )
+    got = generate(dec_m, params, prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(tokens))

@@ -30,8 +30,8 @@ def naive_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
     Shapes: q [B, Sq, H, D], k/v [B, Sk, H, D] -> [B, Sq, H, D].
     ``window``: sliding-window mask (causal only) — q attends [q-window+1, q].
     """
-    if window is not None and not causal:
-        raise ValueError("window requires causal=True")
+    if window is not None and (window < 1 or not causal):
+        raise ValueError("window requires causal=True and window >= 1")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s * scale

@@ -325,3 +325,76 @@ class TestControllersEndToEnd:
         nb = client.get("Notebook", "nb1", "team-a")
         # no kubelet: no pods exist, controller must report 0 ready
         assert nb["status"]["readyReplicas"] == 0
+
+
+class TestOAuthControllerEndToEnd:
+    """OpenShift OAuth companion controller over real HTTP (ref
+    odh-notebook-controller Reconcile, notebook_controller.go:123-190):
+    annotated Notebook -> session Secret + annotated SA + TLS Service +
+    Route, all owner-referenced for GC."""
+
+    def test_oauth_objects_lifecycle(self, env):
+        from kubeflow_tpu.controllers.oauth_controller import (
+            INJECT_ANNOTATION,
+            OAuthReconciler,
+        )
+
+        server, client = env
+        m = Manager(client, clock=time.time)
+        m.register(OAuthReconciler())
+        client.create(
+            api.notebook(
+                "sec-nb", "team-a", annotations={INJECT_ANNOTATION: "true"}
+            )
+        )
+
+        def ready():
+            m.tick()
+            return (
+                client.try_get("Secret", "sec-nb-oauth-config", "team-a")
+                and client.try_get("ServiceAccount", "sec-nb", "team-a")
+                and client.try_get("Service", "sec-nb-tls", "team-a")
+                and client.try_get("Route", "sec-nb", "team-a")
+            )
+
+        eventually(ready)
+        route = client.get("Route", "sec-nb", "team-a")
+        assert route["spec"]["to"] == {"kind": "Service", "name": "sec-nb-tls"}
+        sa = client.get("ServiceAccount", "sec-nb", "team-a")
+        assert "oauth-redirectreference" in str(sa["metadata"]["annotations"])
+        secret = client.get("Secret", "sec-nb-oauth-config", "team-a")
+        nb = client.get("Notebook", "sec-nb", "team-a")
+        for obj in (route, sa, secret):
+            refs = obj["metadata"].get("ownerReferences", [])
+            assert any(
+                r["uid"] == nb["metadata"]["uid"] for r in refs
+            ), f"{obj['kind']} not owner-referenced"
+
+        # delete the Notebook -> async GC reaps the whole OAuth object set
+        client.delete("Notebook", "sec-nb", "team-a")
+
+        def gone():
+            m.tick()
+            return (
+                client.try_get("Route", "sec-nb", "team-a") is None
+                and client.try_get("Secret", "sec-nb-oauth-config", "team-a")
+                is None
+                and client.try_get("Service", "sec-nb-tls", "team-a") is None
+            )
+
+        eventually(gone)
+
+    def test_unannotated_notebook_gets_no_oauth_objects(self, env):
+        from kubeflow_tpu.controllers.oauth_controller import OAuthReconciler
+
+        server, client = env
+        m = Manager(client, clock=time.time)
+        m.register(OAuthReconciler())
+        client.create(api.notebook("plain-nb", "team-a"))
+        for _ in range(10):
+            m.tick()
+            time.sleep(0.02)
+        assert client.try_get("Route", "plain-nb", "team-a") is None
+        assert client.try_get(
+            "Secret", "plain-nb-oauth-config", "team-a"
+        ) is None

@@ -1,7 +1,7 @@
 """One-config transformer step-time probe (run one config per process so an
 OOM kills only that probe). Usage:
 
-    python benchmarks/transformer_probe.py IMPL REMAT BATCH [SEQ] [CHUNK] [HEADS]
+    python benchmarks/transformer_probe.py IMPL REMAT BATCH [SEQ] [CHUNK] [HEADS] [--mu-bf16]
 
 IMPL = xla|block|flash; REMAT = full|dots|none; prints one JSON line with
 median step seconds (two-window subtraction, same methodology as bench.py).
@@ -32,10 +32,11 @@ from kubeflow_tpu.models.transformer import (
 
 
 def main():
-    impl, remat, batch = sys.argv[1], sys.argv[2], int(sys.argv[3])
-    seq = int(sys.argv[4]) if len(sys.argv) > 4 else 2048
-    chunk = int(sys.argv[5]) if len(sys.argv) > 5 else 512
-    heads = int(sys.argv[6]) if len(sys.argv) > 6 else 16
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    impl, remat, batch = args[0], args[1], int(args[2])
+    seq = int(args[3]) if len(args) > 3 else 2048
+    chunk = int(args[4]) if len(args) > 4 else 512
+    heads = int(args[5]) if len(args) > 5 else 16
     cfg = TransformerConfig(
         vocab_size=32_000,
         num_layers=24,
@@ -50,7 +51,11 @@ def main():
         dtype=jnp.bfloat16,
     )
     model = TransformerLM(cfg)
-    tx = optax.adamw(3e-4, weight_decay=0.1)
+    mu_bf16 = "--mu-bf16" in sys.argv
+    tx = optax.adamw(
+        3e-4, weight_decay=0.1,
+        mu_dtype=jnp.bfloat16 if mu_bf16 else None,
+    )
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
 

@@ -274,6 +274,48 @@ def create_app(
     return app
 
 
+def _resolve_option(body: dict, defaults: dict, field: str, id_key: str) -> dict | None:
+    """Look up the form's keyed choice in the config section's options list
+    (shared shape of tolerationGroup and affinityConfig, ref form.py:178-223).
+    Returns None for "none"; raises for a key absent from the config — the
+    reference only logs a warning there, but a silently dropped scheduling
+    constraint is worse than a 400."""
+    key = spawner_config.form_value(body, defaults, field)
+    if not key or key == "none":
+        return None
+    options = (
+        defaults.get("spawnerFormDefaults", {}).get(field, {}).get("options", [])
+    )
+    for option in options:
+        if option.get(id_key) == key:
+            return ko.deep_copy(option)
+    raise ValueError(f"No {field} option with key {key!r} in the config")
+
+
+def set_notebook_tolerations(nb: dict, body: dict, defaults: dict) -> None:
+    """tolerationGroup → pod tolerations (ref form.py:178-198)."""
+    group = _resolve_option(body, defaults, "tolerationGroup", "groupKey")
+    if group is None:
+        return
+    pod_spec = nb["spec"]["template"]["spec"]
+    pod_spec.setdefault("tolerations", []).extend(group.get("tolerations", []))
+
+
+def set_notebook_affinity(nb: dict, body: dict, defaults: dict) -> None:
+    """affinityConfig → pod affinity (ref form.py:201-223). Schema extension
+    over the reference: an option may also carry ``tolerations``, applied
+    together with the affinity — a node-targeting affinity (e.g. TPU pools)
+    is unschedulable without the matching taint toleration, so the two must
+    ship as one choice."""
+    cfg = _resolve_option(body, defaults, "affinityConfig", "configKey")
+    if cfg is None:
+        return
+    pod_spec = nb["spec"]["template"]["spec"]
+    pod_spec["affinity"] = cfg.get("affinity", {})
+    if cfg.get("tolerations"):
+        pod_spec.setdefault("tolerations", []).extend(cfg["tolerations"])
+
+
 def build_notebook(body: dict, namespace: str, defaults: dict, creator: str) -> tuple[dict, list[dict]]:
     """Assemble the Notebook CR from the form (ref form.py + post.py flow),
     honoring readOnly config fields, plus TPU topology validation."""
@@ -314,6 +356,19 @@ def build_notebook(body: dict, namespace: str, defaults: dict, creator: str) -> 
 
     pod_spec = nb["spec"]["template"]["spec"]
     container = pod_spec["containers"][0]
+
+    # imagePullPolicy → container (ref form.py:86-92 set_notebook_image_pull_policy)
+    pull_policy = fv(body, defaults, "imagePullPolicy")
+    if pull_policy:
+        if pull_policy not in ("Always", "IfNotPresent", "Never"):
+            raise ValueError(f"Invalid imagePullPolicy: {pull_policy!r}")
+        container["imagePullPolicy"] = pull_policy
+
+    # tolerationGroup → pod tolerations (ref form.py:178-198): the form carries
+    # a groupKey; the config's options list maps it to concrete tolerations.
+    set_notebook_tolerations(nb, body, defaults)
+    # affinityConfig → pod affinity (ref form.py:201-223)
+    set_notebook_affinity(nb, body, defaults)
     new_pvcs: list[dict] = []
     volumes = []
     mounts = []

@@ -68,8 +68,77 @@ DEFAULT_CONFIG: dict = {
             ],
             "readOnly": False,
         },
-        "tolerationGroup": {"value": "none", "options": [], "readOnly": False},
-        "affinityConfig": {"value": "none", "options": [], "readOnly": False},
+        # TPU node pools carry a google.com/tpu taint; the groups below let the
+        # form opt a CPU-only server onto them (TPU servers get the toleration
+        # from the controller automatically).
+        "tolerationGroup": {
+            "value": "none",
+            "options": [
+                {
+                    "groupKey": "tpu-node-pool",
+                    "displayName": "Schedule on TPU node pools",
+                    "tolerations": [
+                        {
+                            "key": "google.com/tpu",
+                            "operator": "Exists",
+                            "effect": "NoSchedule",
+                        }
+                    ],
+                },
+            ],
+            "readOnly": False,
+        },
+        "affinityConfig": {
+            "value": "none",
+            "options": [
+                {
+                    "configKey": "exclusive__tpu-host",
+                    "displayName": "Exclusive: one notebook per TPU host",
+                    "affinity": {
+                        "nodeAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": {
+                                "nodeSelectorTerms": [
+                                    {
+                                        "matchExpressions": [
+                                            {
+                                                "key": "cloud.google.com/gke-tpu-accelerator",
+                                                "operator": "Exists",
+                                            }
+                                        ]
+                                    }
+                                ]
+                            }
+                        },
+                        "podAntiAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": [
+                                {
+                                    "labelSelector": {
+                                        "matchExpressions": [
+                                            {
+                                                "key": "notebook-name",
+                                                "operator": "Exists",
+                                            }
+                                        ]
+                                    },
+                                    "topologyKey": "kubernetes.io/hostname",
+                                }
+                            ]
+                        },
+                    },
+                    # schema extension (see jupyter.set_notebook_affinity):
+                    # targeting tainted TPU pools requires the toleration too,
+                    # or the pod is permanently unschedulable.
+                    "tolerations": [
+                        {
+                            "key": "google.com/tpu",
+                            "operator": "Exists",
+                            "effect": "NoSchedule",
+                        }
+                    ],
+                },
+            ],
+            "readOnly": False,
+        },
         "configurations": {"value": [], "readOnly": False},
         "shm": {"value": True, "readOnly": False},
         "serverType": {"value": "jupyter", "readOnly": False},

@@ -86,6 +86,100 @@ class TestJupyterApp:
         sts = cluster.get("StatefulSet", "mesh", "alice")
         assert sts["spec"]["replicas"] == 2
 
+    def test_image_pull_policy_reaches_container(self, platform):
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "pp", "imagePullPolicy": "Always"},
+            headers=auth(client),
+        )
+        assert get_json_body(r)["success"], r.get_data()
+        nb = cluster.get("Notebook", "pp", "alice")
+        ctr = nb["spec"]["template"]["spec"]["containers"][0]
+        assert ctr["imagePullPolicy"] == "Always"
+        # and it propagates into the reconciled pod template
+        m.run_until_idle()
+        sts = cluster.get("StatefulSet", "pp", "alice")
+        assert (
+            sts["spec"]["template"]["spec"]["containers"][0]["imagePullPolicy"]
+            == "Always"
+        )
+
+    def test_invalid_image_pull_policy_is_400(self, platform):
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "pp2", "imagePullPolicy": "Sometimes"},
+            headers=auth(client),
+        )
+        assert r.status_code == 400
+        assert "imagePullPolicy" in get_json_body(r)["log"]
+
+    def test_toleration_group_applied(self, platform):
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "tol", "tolerationGroup": "tpu-node-pool"},
+            headers=auth(client),
+        )
+        assert get_json_body(r)["success"], r.get_data()
+        nb = cluster.get("Notebook", "tol", "alice")
+        tols = nb["spec"]["template"]["spec"]["tolerations"]
+        assert {"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"} in tols
+
+    def test_unknown_toleration_group_is_400(self, platform):
+        cluster, _ = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "tol2", "tolerationGroup": "nope"},
+            headers=auth(client),
+        )
+        assert r.status_code == 400
+        assert "tolerationGroup" in get_json_body(r)["log"]
+
+    def test_affinity_config_applied(self, platform):
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "aff", "affinityConfig": "exclusive__tpu-host"},
+            headers=auth(client),
+        )
+        assert get_json_body(r)["success"], r.get_data()
+        nb = cluster.get("Notebook", "aff", "alice")
+        affinity = nb["spec"]["template"]["spec"]["affinity"]
+        terms = affinity["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"]
+        assert terms[0]["matchExpressions"][0]["key"] == (
+            "cloud.google.com/gke-tpu-accelerator"
+        )
+        assert "podAntiAffinity" in affinity
+        # the bundled taint toleration ships with the affinity choice, or the
+        # pod could never schedule onto the tainted TPU pool it targets
+        tols = nb["spec"]["template"]["spec"]["tolerations"]
+        assert any(t.get("key") == "google.com/tpu" for t in tols)
+
+    def test_readonly_toleration_group_ignores_user_value(self, platform):
+        cluster, _ = platform
+        defaults = jupyter.spawner_config.load_config()
+        import copy
+
+        defaults = copy.deepcopy(defaults)
+        sect = defaults["spawnerFormDefaults"]["tolerationGroup"]
+        sect["readOnly"] = True
+        sect["value"] = "tpu-node-pool"
+        nb, _pvcs = jupyter.build_notebook(
+            {"name": "ro", "tolerationGroup": "none"}, "alice", defaults, "alice@x.io"
+        )
+        assert nb["spec"]["template"]["spec"]["tolerations"], (
+            "readOnly group must be applied regardless of the user's value"
+        )
+
     def test_invalid_tpu_topology_is_400(self, platform):
         cluster, m = platform
         client = Client(jupyter.create_app(cluster))

@@ -115,6 +115,42 @@ def build_manager(
     return manager, metrics
 
 
+def watch_namespace_labels(path: str, manager: Manager, cluster):
+    """Hot-reload the profile controller's default namespace labels from a
+    mounted YAML file (ref fsnotify watch, profile_controller.go:356-405 +
+    readDefaultLabelsFromFile :743-758). Loads once eagerly, then returns a
+    FileWatcher (caller starts it; tests drive poll_once)."""
+    import yaml
+
+    from kubeflow_tpu.utils.filewatch import FileWatcher
+
+    profile_rec = manager.reconciler_for("Profile")
+    if profile_rec is None:
+        return None
+
+    def reload():
+        try:
+            with open(path) as f:
+                labels = yaml.safe_load(f) or {}
+        except (OSError, yaml.YAMLError) as e:
+            # unlike the reference's os.Exit(1) on a read error, a transient
+            # mount blip or half-written file shouldn't kill the manager;
+            # keep the previous labels and retry on the next change
+            log.warning("namespace labels file unreadable (%s); keeping", e)
+            return
+        if not isinstance(labels, dict):
+            log.warning("namespace labels file is not a mapping; ignoring")
+            return
+        # bare keys ("team:") parse as None; the reference's map[string]string
+        # unmarshals those to "" — match it
+        labels = {str(k): "" if v is None else str(v) for k, v in labels.items()}
+        log.info("default namespace labels ← %s: %s", path, labels)
+        profile_rec.set_default_labels(labels, manager=manager, cluster=cluster)
+
+    reload()
+    return FileWatcher(path, reload)
+
+
 def serve_ops(
     metrics: NotebookMetrics, port: int = 8081, manager: Manager | None = None
 ) -> threading.Thread:
@@ -150,6 +186,12 @@ def main() -> None:
     fleet = FleetKernelFetcher(cluster, cfg)
     manager, metrics = build_manager(cluster, cfg, fetch_kernels=fleet)
     serve_ops(metrics, manager=manager)
+    if cfg.namespace_labels_path:
+        labels_watch = watch_namespace_labels(
+            cfg.namespace_labels_path, manager, cluster
+        )
+        if labels_watch is not None:
+            labels_watch.start()
     stop = threading.Event()
     n_workers = int(os.environ.get("RECONCILE_WORKERS", "4"))
 

@@ -16,7 +16,6 @@ import copy
 import json
 import logging
 import os
-import ssl
 from wsgiref.simple_server import make_server
 
 from werkzeug.wrappers import Request, Response
@@ -105,6 +104,43 @@ def make_wsgi_app(cluster):
     return handle
 
 
+def wait_for_cert(cert_dir: str, timeout: float | None = None, poll: float = 1.0) -> bool:
+    """Block until both tls.crt and tls.key exist (a webhook pod can start
+    before cert-manager populates the Secret mount; serving plain HTTP in
+    that window — and forever after — would break every admission call, so
+    TLS-required deployments wait here instead)."""
+    import time
+
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while not (
+        os.path.isfile(f"{cert_dir}/tls.crt")
+        and os.path.isfile(f"{cert_dir}/tls.key")
+    ):
+        if deadline is not None and time.monotonic() >= deadline:
+            return False
+        log.info("waiting for TLS cert in %s", cert_dir)
+        time.sleep(poll)
+    return True
+
+
+def make_server_with_tls(cluster, port: int, cert_dir: str):
+    """HTTPS server whose cert hot-reloads on rotation (ref certwatcher,
+    config.go:42-60). Returns (server, cert_watcher|None — None means plain
+    HTTP, for dev runs with no cert dir); caller starts the watcher thread
+    (tests drive poll_once deterministically instead)."""
+    from kubeflow_tpu.utils.filewatch import CertWatcher
+
+    server = make_server("0.0.0.0", port, make_wsgi_app(cluster))
+    cert, key = f"{cert_dir}/tls.crt", f"{cert_dir}/tls.key"
+    watcher = None
+    if os.path.isfile(cert):
+        watcher = CertWatcher(cert, key)
+        server.socket = watcher.context.wrap_socket(
+            server.socket, server_side=True
+        )
+    return server, watcher
+
+
 def main() -> None:
     logging.basicConfig(level=logging.INFO)
     from kubeflow_tpu.runtime.kubeclient import KubeClient
@@ -112,12 +148,14 @@ def main() -> None:
     cluster = KubeClient()
     port = int(os.environ.get("PORT", "8443"))
     cert_dir = os.environ.get("CERT_DIR", "/etc/webhook/certs")
-    server = make_server("0.0.0.0", port, make_wsgi_app(cluster))
-    cert, key = f"{cert_dir}/tls.crt", f"{cert_dir}/tls.key"
-    if os.path.isfile(cert):
-        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        ctx.load_cert_chain(cert, key)
-        server.socket = ctx.wrap_socket(server.socket, server_side=True)
+    # TLS is required whenever a cert dir is deployed (explicit env or the
+    # manifest's mount path exists): wait for the Secret mount to be
+    # populated rather than silently serving plain HTTP forever.
+    if os.environ.get("CERT_DIR") or os.path.isdir(cert_dir):
+        wait_for_cert(cert_dir)
+    server, watcher = make_server_with_tls(cluster, port, cert_dir)
+    if watcher is not None:
+        watcher.start()
     log.info("webhook serving on :%d", port)
     server.serve_forever()
 

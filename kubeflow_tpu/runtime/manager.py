@@ -93,6 +93,14 @@ class Manager:
         for kind, map_fn in rec.watches():
             self.cluster.watch(kind, self._secondary_handler(rec, map_fn))
 
+    def reconciler_for(self, kind: str) -> Reconciler | None:
+        """The registered reconciler for a primary kind (process wiring —
+        e.g. the labels-file watcher needs the ProfileReconciler)."""
+        for rec in self._reconcilers:
+            if rec.kind == kind:
+                return rec
+        return None
+
     def _primary_handler(self, rec: Reconciler):
         def handle(event: str, obj: dict) -> None:
             self.enqueue(rec, ko.namespace(obj), ko.name(obj))

@@ -46,6 +46,9 @@ class ControllerConfig:
     # TPU-native
     tpu_coordinator_port: int = 8476  # jax.distributed default coordinator port
     tpu_gang_schedule: bool = True    # all-or-nothing pod-slice admission
+    # Profile defaults (ref --namespace-labels-path flag, profile-controller
+    # main.go; the mounted file is hot-reloaded, go:356-405)
+    namespace_labels_path: str = ""
 
     @classmethod
     def from_env(cls) -> "ControllerConfig":
@@ -60,4 +63,5 @@ class ControllerConfig:
             idleness_check_minutes=_env_float("IDLENESS_CHECK_PERIOD", 1.0),
             dev=_env_bool("DEV", False),
             tpu_gang_schedule=_env_bool("TPU_GANG_SCHEDULE", True),
+            namespace_labels_path=os.environ.get("NAMESPACE_LABELS_PATH", ""),
         )

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 from typing import Callable, Mapping
@@ -216,6 +217,16 @@ class KubeClient:
             headers={"Content-Type": "application/merge-patch+json"},
         )
 
+    def strategic_patch(self, kind: str, name: str, namespace: str, patch: Mapping) -> dict:
+        """Strategic merge patch: lists with a patchMergeKey merge by key
+        (containers/env/volumes/...) instead of replacing wholesale."""
+        return self._request(
+            "PATCH",
+            resource_path(kind, namespace, name),
+            json=dict(patch),
+            headers={"Content-Type": "application/strategic-merge-patch+json"},
+        )
+
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
         self._request("DELETE", resource_path(kind, namespace, name))
 
@@ -266,19 +277,37 @@ class KubeClient:
     # ----------------------------------------------------------------- watch
 
     def watch(self, kind: str | None, fn: Callable[[str, dict], None]) -> None:
-        """Streaming watch with automatic re-list on disconnect (the informer
-        loop controller-runtime gives the reference for free)."""
+        """Streaming watch with informer-style incremental resume.
+
+        The first connection lists (replaying objects as ADDED — the initial
+        cache sync) and then watches from the list's resourceVersion. On
+        disconnect it resumes the watch *from the last seen revision* —
+        O(changes) per blip, not an O(objects) re-list-and-replay storm —
+        falling back to a fresh list only on 410 Gone (revision compacted
+        away, signalled either as an HTTP status or as an in-stream ERROR
+        event, both of which real apiservers use). Backoff is exponential
+        with full jitter so a fleet of severed watchers doesn't reconnect in
+        lockstep. This is the resume contract controller-runtime's informers
+        give the reference for free (``notebook_controller.go:726-774``).
+        """
         if kind is None:
             raise ValueError("KubeClient.watch requires a concrete kind")
 
         def run():
+            rv: str | None = None  # None → (re-)list before watching
+            backoff = 0.5
             while not self._stop.is_set():
+                error_pause = False
                 try:
-                    listing = self._request("GET", resource_path(kind))
-                    rv = listing.get("metadata", {}).get("resourceVersion", "0")
-                    for item in listing.get("items", []):
-                        item.setdefault("kind", kind)
-                        fn("ADDED", item)
+                    if rv is None:
+                        listing = self._request("GET", resource_path(kind))
+                        for item in listing.get("items", []):
+                            item.setdefault("kind", kind)
+                            fn("ADDED", item)
+                        # only a fully-replayed list advances rv: if fn raised
+                        # mid-replay, rv stays None and the next round re-lists
+                        # (level-triggered self-healing, like before)
+                        rv = listing.get("metadata", {}).get("resourceVersion", "0")
                     resp = self.session.get(
                         self.base_url + resource_path(kind),
                         params={"watch": "true", "resourceVersion": rv,
@@ -287,20 +316,43 @@ class KubeClient:
                         verify=self.verify,
                         timeout=330,
                     )
+                    if resp.status_code == 410:
+                        rv = None
+                        continue
                     resp.raise_for_status()  # 403 etc. → backoff path, not a busy loop
+                    backoff = 0.5  # stream established: reset
                     for line in resp.iter_lines():
                         if self._stop.is_set():
                             return
                         if not line:
                             continue
                         event = json.loads(line)
-                        if event.get("type") == "BOOKMARK":
-                            continue
+                        etype = event.get("type")
                         obj = event.get("object", {})
+                        if etype == "ERROR":
+                            if obj.get("code") == 410:
+                                rv = None  # compacted: full re-list
+                            else:
+                                error_pause = True  # persistent server error:
+                                # reconnect with backoff, not a busy loop
+                            break
+                        if etype == "BOOKMARK":
+                            new_rv = obj.get("metadata", {}).get("resourceVersion")
+                            if new_rv:
+                                rv = new_rv
+                            continue
                         obj.setdefault("kind", kind)
-                        fn(event.get("type", "MODIFIED"), obj)
+                        fn(etype or "MODIFIED", obj)
+                        # advance rv only after the handler succeeded, so an
+                        # event whose handler raised is redelivered on resume
+                        new_rv = obj.get("metadata", {}).get("resourceVersion")
+                        if new_rv:
+                            rv = new_rv
                 except Exception:
-                    time.sleep(2.0)  # re-list after transient failures
+                    error_pause = True
+                if error_pause:
+                    time.sleep(random.uniform(0, backoff))
+                    backoff = min(backoff * 2, 30.0)
 
         t = threading.Thread(target=run, daemon=True, name=f"watch-{kind}")
         self._watch_threads.append(t)

@@ -17,7 +17,14 @@ Semantics implemented (each mirrors documented apiserver behavior):
 - Optimistic concurrency: an update carrying a stale resourceVersion is 409.
 - CREATE fills uid/creationTimestamp/generation and DROPS ``.status`` for
   kinds with the status subresource; ``PUT .../status`` updates only status.
-- ``application/merge-patch+json`` per RFC 7386 (null deletes a key).
+- ``application/merge-patch+json`` per RFC 7386 (null deletes a key);
+  ``application/strategic-merge-patch+json`` with patchMergeKey list merge
+  (containers/env/volumes/..., ``$patch: delete|replace`` directives).
+- Label selectors: full grammar incl. set-based ``in/notin/exists/!key``
+  (apimachinery ``labels.Selector`` semantics), on list and watch.
+- Watch resume from a compacted-away resourceVersion → ERROR event carrying
+  Status 410 Gone (etcd compaction semantics); ``compact()`` is the chaos
+  hook, and the 10k event ring truncation sets the floor organically.
 - CRD schema validation (type/required/enum/pattern) + OpenAPI defaulting,
   loaded from the CRD manifests; unknown CR fields rejected unless the schema
   says ``x-kubernetes-preserve-unknown-fields``.
@@ -207,6 +214,140 @@ def merge_patch(target, patch):
     return out
 
 
+# patchMergeKey per field name, from the k8s API struct tags (types.go
+# ``patchStrategy:"merge" patchMergeKey:"..."``). Keyed by field name rather
+# than full path — the names are unambiguous across the kinds served here.
+STRATEGIC_MERGE_KEYS = {
+    "containers": "name",
+    "initContainers": "name",
+    "ephemeralContainers": "name",
+    "volumes": "name",
+    "volumeMounts": "mountPath",
+    "volumeDevices": "devicePath",
+    "env": "name",
+    "ports": "containerPort",
+    "hostAliases": "ip",
+    "tolerations": "key",
+    "imagePullSecrets": "name",
+    "secrets": "name",
+    "ownerReferences": "uid",
+    "conditions": "type",
+    "readinessGates": "conditionType",
+}
+
+
+def strategic_merge_patch(target, patch, field: str = ""):
+    """Kubernetes strategic merge patch: like RFC 7386, but lists whose field
+    carries a patchMergeKey merge element-wise by that key instead of being
+    replaced wholesale, and ``$patch: delete|replace`` directives are honored
+    (apimachinery strategicpatch semantics)."""
+    if isinstance(patch, dict):
+        directive = patch.get("$patch")
+        if directive == "replace":
+            return copy.deepcopy({k: v for k, v in patch.items() if k != "$patch"})
+        if not isinstance(target, dict):
+            target = {}
+        out = copy.deepcopy(target)
+        for k, v in patch.items():
+            if k == "$patch" or k.startswith("$setElementOrder") or k == "$retainKeys":
+                continue
+            if v is None:
+                out.pop(k, None)
+                continue
+            out[k] = strategic_merge_patch(out.get(k), v, field=k)
+        return out
+    if isinstance(patch, list):
+        if patch and isinstance(patch[0], dict) and patch[0].get("$patch") == "replace":
+            return copy.deepcopy(
+                [e for e in patch if not (isinstance(e, dict) and "$patch" in e)]
+            )
+        key = STRATEGIC_MERGE_KEYS.get(field)
+        if key is None or not all(isinstance(e, dict) for e in patch):
+            return copy.deepcopy(patch)  # atomic list: replace
+        base = [copy.deepcopy(e) for e in target] if isinstance(target, list) else []
+        for entry in patch:
+            if entry.get(key) is None:
+                # apiserver: 422 "map element ... does not contain fields
+                # matching its merge key" — appending would duplicate on
+                # every repeat of the same patch
+                raise ValueError(
+                    f"map element in {field!r} is missing its merge key {key!r}"
+                )
+            if entry.get("$patch") == "delete":
+                base = [
+                    e for e in base
+                    if not (isinstance(e, dict) and e.get(key) == entry.get(key))
+                ]
+                continue
+            for i, existing in enumerate(base):
+                if isinstance(existing, dict) and existing.get(key) == entry.get(key):
+                    base[i] = strategic_merge_patch(existing, entry)
+                    break
+            else:
+                base.append(
+                    copy.deepcopy({k: v for k, v in entry.items() if k != "$patch"})
+                )
+        return base
+    return copy.deepcopy(patch)
+
+
+def _split_selector(sel: str) -> list[str]:
+    """Split a label selector on commas outside parentheses."""
+    parts, depth, cur = [], 0, ""
+    for ch in sel:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    parts.append(cur)
+    return [p.strip() for p in parts if p.strip()]
+
+
+_SET_REQ = re.compile(r"^(\S+)\s+(in|notin)\s+\(([^)]*)\)$")
+
+
+def parse_label_selector(sel: str) -> Callable[[dict], bool]:
+    """Full labelSelector grammar: ``k=v``, ``k==v``, ``k!=v``,
+    ``k in (a,b)``, ``k notin (a,b)``, ``k`` (exists), ``!k`` (not exists).
+    Missing keys match ``!=``/``notin``, per apimachinery ``labels.Selector``.
+    Raises ValueError on an unparseable requirement (apiserver: 400)."""
+    preds: list[Callable[[dict], bool]] = []
+    for part in _split_selector(sel or ""):
+        m = _SET_REQ.match(part)
+        if m:
+            k, op = m.group(1), m.group(2)
+            vals = {v.strip() for v in m.group(3).split(",") if v.strip()}
+            if op == "in":
+                preds.append(lambda labels, k=k, vals=vals: labels.get(k) in vals)
+            else:
+                preds.append(
+                    lambda labels, k=k, vals=vals: labels.get(k) not in vals
+                )
+        elif part.startswith("!"):
+            k = part[1:].strip()
+            if not k or "=" in k:
+                raise ValueError(f"invalid selector requirement {part!r}")
+            preds.append(lambda labels, k=k: k not in labels)
+        elif "!=" in part:
+            k, v = (s.strip() for s in part.split("!=", 1))
+            preds.append(lambda labels, k=k, v=v: labels.get(k) != v)
+        elif "=" in part:
+            k, _, v = part.partition("==" if "==" in part else "=")
+            k, v = k.strip(), v.strip()
+            preds.append(lambda labels, k=k, v=v: labels.get(k) == v)
+        else:
+            k = part.strip()
+            if " " in k:
+                raise ValueError(f"invalid selector requirement {part!r}")
+            preds.append(lambda labels, k=k: k in labels)
+    return lambda labels: all(p(labels) for p in preds)
+
+
 def _rewrite_api_version(obj: dict, desired: str) -> dict:
     out = dict(obj)  # only the top-level apiVersion key changes
     out["apiVersion"] = desired
@@ -253,6 +394,7 @@ class APIServer:
         self._objects: dict[tuple[str, str, str], dict] = {}
         self._watch_cond = threading.Condition(self._lock)
         self._events: list[tuple[int, str, str, dict]] = []  # rev, type, plural, obj
+        self._compacted_rev = 0  # highest revision lost to ring truncation
         self._pod_logs: dict[tuple[str, str], list[tuple[str, str]]] = {}
         self._stop = threading.Event()
         self._watch_generation = 0  # bump to sever live watch streams
@@ -347,6 +489,15 @@ class APIServer:
         clients must re-list and resume)."""
         with self._watch_cond:
             self._watch_generation += 1
+            self._watch_cond.notify_all()
+
+    def compact(self) -> None:
+        """Drop the whole event history (chaos hook: etcd compaction; the
+        same thing the 10k-event ring overflow does). Watches resuming from
+        a pre-compaction revision get 410 Gone and must re-list."""
+        with self._watch_cond:
+            self._events.clear()
+            self._compacted_rev = self._revision
             self._watch_cond.notify_all()
 
     # -------------------------------------------------------------- routing
@@ -500,11 +651,10 @@ class APIServer:
         return copy.deepcopy(obj)
 
     def _list(self, info, plural, group, version, namespace, params) -> dict:
-        sel = {}
-        for pair in (params.get("labelSelector") or "").split(","):
-            if "=" in pair:
-                k, v = pair.split("=", 1)
-                sel[k] = v
+        try:
+            matches = parse_label_selector(params.get("labelSelector") or "")
+        except ValueError as e:
+            raise _Status(400, "BadRequest", str(e))
         items = []
         for (p, ns, _), obj in self._objects.items():
             if p != plural:
@@ -512,7 +662,7 @@ class APIServer:
             if info["namespaced"] and namespace and ns != namespace:
                 continue
             labels = obj.get("metadata", {}).get("labels", {})
-            if all(labels.get(k) == v for k, v in sel.items()):
+            if matches(labels):
                 items.append(
                     self._out_version(info, group, version, copy.deepcopy(obj))
                 )
@@ -603,11 +753,24 @@ class APIServer:
             raise _Status(
                 415, "UnsupportedMediaType", f"unsupported patch type {content_type}"
             )
+        if "strategic-merge" in content_type and info.get("crd"):
+            # real apiservers reject strategic merge on CRs (no Go struct
+            # patch tags): only merge-patch/json-patch/apply work there
+            raise _Status(
+                415, "UnsupportedMediaType",
+                "strategic merge patch is not supported for custom resources",
+            )
         key = (plural, namespace, name)
         current = self._objects.get(key)
         if current is None:
             raise _Status(404, "NotFound", f"{plural} {namespace}/{name} not found")
-        patched = merge_patch(current, body or {})
+        if "strategic-merge" in content_type:
+            try:
+                patched = strategic_merge_patch(current, body or {})
+            except ValueError as e:
+                raise _Status(422, "Invalid", str(e))
+        else:
+            patched = merge_patch(current, body or {})
         # metadata identity is immutable under patch
         patched["metadata"]["uid"] = current["metadata"]["uid"]
         patched["metadata"]["name"] = name
@@ -646,6 +809,9 @@ class APIServer:
         self._events.append((self._revision, event, plural, copy.deepcopy(obj)))
         if len(self._events) > 10000:
             del self._events[:5000]
+            # revisions at/below the compaction floor are gone; a watch asking
+            # to resume from below it must get 410 Gone, not silent loss
+            self._compacted_rev = self._events[0][0] - 1
         self._watch_cond.notify_all()
 
     # --------------------------------------------------------------- watch
@@ -654,39 +820,72 @@ class APIServer:
         self, handler, info, plural, group, version, namespace, params
     ) -> None:
         since = int(params.get("resourceVersion") or 0)
+        try:
+            matches = parse_label_selector(params.get("labelSelector") or "")
+        except ValueError as e:
+            raise _Status(400, "BadRequest", str(e))
         handler.send_response(200)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Transfer-Encoding", "chunked")
         handler.send_header("Connection", "close")
         handler.end_headers()
         handler.close_connection = True
+
+        def send(payload: dict) -> bool:
+            line = (json.dumps(payload) + "\n").encode()
+            chunk = b"%x\r\n%s\r\n" % (len(line), line)
+            try:
+                handler.wfile.write(chunk)
+                handler.wfile.flush()
+                return True
+            except (BrokenPipeError, ConnectionResetError):
+                return False
+
+        if since == 0:
+            # rv unset/0 = "start from current state" (k8s semantics); the
+            # compaction floor doesn't apply — begin past anything compacted
+            since = self._compacted_rev
         generation = self._watch_generation
         while not self._stop.is_set():
             batch = []
+            compacted = False
             with self._watch_cond:
                 while True:
                     if self._watch_generation != generation:
                         return  # severed: connection closes, client re-lists
+                    if since < self._compacted_rev:
+                        # compaction overtook a live watcher mid-stream:
+                        # events in (since, compacted] are gone — loud 410,
+                        # never silent loss
+                        compacted = True
+                        break
                     batch = [
                         (rev, ev, obj)
                         for rev, ev, p, obj in self._events
                         if rev > since and p == plural
                         and (not namespace
                              or obj.get("metadata", {}).get("namespace") == namespace)
+                        and matches(obj.get("metadata", {}).get("labels", {}))
                     ]
                     if batch or self._stop.is_set():
                         break
                     self._watch_cond.wait(timeout=1.0)
+            if compacted:
+                send({
+                    "type": "ERROR",
+                    "object": {
+                        "apiVersion": "v1", "kind": "Status",
+                        "status": "Failure", "reason": "Expired", "code": 410,
+                        "message": f"too old resource version: {since} "
+                                   f"({self._compacted_rev})",
+                    },
+                })
+                return
             for rev, ev, obj in batch:
                 # watch events are converted to the request's version, like
                 # every other read path
                 obj = self._out_version(info, group, version, obj)
-                line = (json.dumps({"type": ev, "object": obj}) + "\n").encode()
-                chunk = b"%x\r\n%s\r\n" % (len(line), line)
-                try:
-                    handler.wfile.write(chunk)
-                    handler.wfile.flush()
-                except (BrokenPipeError, ConnectionResetError):
+                if not send({"type": ev, "object": obj}):
                     return
                 since = max(since, rev)
 

@@ -320,13 +320,17 @@ class KubeClient:
                         rv = None
                         continue
                     resp.raise_for_status()  # 403 etc. → backoff path, not a busy loop
-                    backoff = 0.5  # stream established: reset
                     for line in resp.iter_lines():
                         if self._stop.is_set():
                             return
                         if not line:
                             continue
                         event = json.loads(line)
+                        # a real (non-error) event proves the stream is
+                        # healthy — only then reset the backoff, else a
+                        # 200-then-ERROR server defeats it
+                        if event.get("type") != "ERROR":
+                            backoff = 0.5
                         etype = event.get("type")
                         obj = event.get("object", {})
                         if etype == "ERROR":

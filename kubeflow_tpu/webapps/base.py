@@ -193,6 +193,22 @@ class App:
         return response(environ, start_response)
 
 
+def add_namespaces_route(app: "App", cluster) -> None:
+    """GET /api/namespaces for the shared namespace-select component: names
+    the authenticated user may pick from. The reference's child apps get this
+    from the dashboard via iframe messaging; standalone pages need a backend
+    source (same authenticated-only policy as the dashboard's route)."""
+
+    @app.route("/api/namespaces")
+    def list_namespaces(request):
+        app.current_user(request)
+        names = sorted(
+            ns.get("metadata", {}).get("name", "")
+            for ns in cluster.list("Namespace")
+        )
+        return success("namespaces", [n for n in names if n])
+
+
 def get_json(request: Request, *required: str) -> dict:
     """request_is_json_type + required_body_params (ref decorators.py)."""
     if not request.is_json:

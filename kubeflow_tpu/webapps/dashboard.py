@@ -18,10 +18,11 @@ from __future__ import annotations
 
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.auth.kfam import BindingClient, ProfileClient
-from kubeflow_tpu.auth.rbac import Authorizer
+from kubeflow_tpu.auth.rbac import Authorizer, Forbidden
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import FakeCluster
 from kubeflow_tpu.utils.metrics import NotebookMetrics
+from kubeflow_tpu.webapps import base
 from kubeflow_tpu.webapps.base import App, get_json, success
 
 DEFAULT_LINKS = {
@@ -153,10 +154,56 @@ def create_app(
         profiles.delete(target)
         return success("message", f"Deleted profile {target} for {user.name}")
 
-    @app.route("/api/namespaces")
-    def namespaces(request):
-        app.current_user(request)
-        return success("namespaces", [ko.name(n) for n in cluster.list("Namespace")])
+    # -- contributor management (api_workgroup.ts:254-388: the dashboard
+    # backend fronts kfam so the SPA never crosses the app mount) ----------
+    def _ensure_can_manage(user, namespace: str) -> None:
+        if profiles.is_cluster_admin(user.name) or _owns(namespace, user.name):
+            return
+        raise Forbidden(
+            f"User '{user.name}' may not manage contributors in '{namespace}'"
+        )
+
+    @app.route("/api/workgroup/contributors/<namespace>")
+    def list_contributors(request, namespace):
+        user = app.current_user(request)
+        _ensure_can_manage(user, namespace)
+        return success(
+            "contributors",
+            [
+                {"user": b["user"], "roleRef": b["roleRef"]}
+                for b in bindings.list(namespaces=[namespace])
+            ],
+        )
+
+    @app.route("/api/workgroup/contributors/<namespace>", methods=("POST",))
+    def add_contributor(request, namespace):
+        user = app.current_user(request)
+        _ensure_can_manage(user, namespace)
+        body = get_json(request, "user")
+        subject = body["user"]
+        if isinstance(subject, str):
+            subject = {"kind": "User", "name": subject}
+        role = (body.get("roleRef") or {}).get("name", "edit")
+        bindings.create(subject, namespace, role)
+        return success("message", f"Added {subject['name']} to {namespace}")
+
+    @app.route(
+        "/api/workgroup/contributors/<namespace>", methods=("DELETE",)
+    )
+    def remove_contributor(request, namespace):
+        user = app.current_user(request)
+        _ensure_can_manage(user, namespace)
+        body = get_json(request, "user")
+        subject = body["user"]
+        if isinstance(subject, str):
+            subject = {"kind": "User", "name": subject}
+        role = (body.get("roleRef") or {}).get("name", "edit")
+        bindings.delete(subject, namespace, role)
+        return success("message", f"Removed {subject['name']} from {namespace}")
+
+    # /api/namespaces comes from the shared helper (one implementation for
+    # every app, serving the namespace-select component)
+    base.add_namespaces_route(app, cluster)
 
     @app.route("/api/activities/<namespace>")
     def activities(request, namespace):

@@ -32,6 +32,7 @@ from kubeflow_tpu.runtime.fake import FakeCluster
 from kubeflow_tpu.tpu.topology import ACCELERATORS, parse_topology, validate_against_node_capacity
 from kubeflow_tpu.utils.metrics import NotebookMetrics
 from kubeflow_tpu.webapps import spawner_config
+from kubeflow_tpu.webapps import base
 from kubeflow_tpu.webapps.base import App, get_json, success
 
 import time
@@ -96,6 +97,7 @@ def create_app(
     )
 
     app.attach_frontend("jupyter")
+    base.add_namespaces_route(app, cluster)
 
     @app.route("/api/config")
     def get_config(request):

@@ -251,6 +251,215 @@
     return () => clearInterval(id);
   }
 
+  // ---- date-time module --------------------------------------------------
+  // age("2026-01-02T03:04:05Z") -> "3d" (list-page Age columns)
+  function age(timestamp) {
+    if (!timestamp) return "—";
+    const ms = Date.now() - new Date(timestamp).getTime();
+    if (isNaN(ms) || ms < 0) return "—";
+    const s = Math.floor(ms / 1000);
+    if (s < 60) return s + "s";
+    if (s < 3600) return Math.floor(s / 60) + "m";
+    if (s < 86400) return Math.floor(s / 3600) + "h";
+    return Math.floor(s / 86400) + "d";
+  }
+
+  // ---- form validation (the Angular form-control validators) -------------
+  // RFC 1123 DNS label, the rule the apiserver enforces on metadata.name.
+  // Returns an error string, or null when valid.
+  function validateK8sName(name) {
+    if (!name) return "Name is required.";
+    if (name.length > 63) return "Name must be at most 63 characters.";
+    if (!/^[a-z0-9]([-a-z0-9]*[a-z0-9])?$/.test(name))
+      return "Name must consist of lowercase letters, digits and '-', " +
+             "starting and ending with a letter or digit.";
+    return null;
+  }
+
+  // fieldError(input, msg|null): inline per-field error line (mat-error)
+  function fieldError(input, msg) {
+    let el = input.parentElement.querySelector(".kf-field-error");
+    if (!msg) {
+      if (el) el.remove();
+      input.classList.remove("invalid");
+      return;
+    }
+    if (!el) {
+      el = document.createElement("div");
+      el.className = "kf-field-error";
+      input.parentElement.appendChild(el);
+    }
+    el.textContent = msg;
+    input.classList.add("invalid");
+  }
+
+  // ---- details-list module (detail-page key/value overview) --------------
+  // rows: [{label, value: string|Node}]
+  function detailsList(container, rows) {
+    container.textContent = "";
+    const dl = document.createElement("dl");
+    dl.className = "kf-details";
+    rows.forEach((r) => {
+      const dt = document.createElement("dt");
+      dt.textContent = r.label;
+      const dd = document.createElement("dd");
+      if (r.value instanceof Node) dd.appendChild(r.value);
+      else dd.textContent = r.value == null ? "—" : String(r.value);
+      dl.appendChild(dt);
+      dl.appendChild(dd);
+    });
+    container.appendChild(dl);
+  }
+
+  // ---- conditions-table module (CR status.conditions) --------------------
+  function conditionsTable(container, conditions) {
+    renderTable(
+      container,
+      [
+        {
+          key: "status", label: "Status",
+          render: (c) => statusIcon(c.status === "True" ? "ready" : "warning"),
+        },
+        { key: "type", label: "Type" },
+        { key: "reason", label: "Reason" },
+        { key: "message", label: "Message" },
+        { key: "lastTransitionTime", label: "Last transition",
+          render: (c) => age(c.lastTransitionTime) },
+      ],
+      conditions || []
+    );
+  }
+
+  // ---- editor module (read-only YAML view of the live resource) ----------
+  function toYaml(value, indent) {
+    indent = indent || "";
+    if (value === null || value === undefined) return "null";
+    if (typeof value === "string") {
+      // quote when YAML would reinterpret the scalar
+      if (value === "" || /[:#\[\]{}&*!|>'"%@`,\n]/.test(value) ||
+          /^[\s\-?]/.test(value) || /\s$/.test(value) ||
+          /^(true|false|null|~|yes|no|on|off)$/i.test(value) ||
+          /^[\d.+-]/.test(value))
+        return JSON.stringify(value);
+      return value;
+    }
+    if (typeof value !== "object") return String(value);
+    if (Array.isArray(value)) {
+      if (!value.length) return "[]";
+      return value
+        .map((v) => {
+          const isComposite = typeof v === "object" && v !== null &&
+            (Array.isArray(v) ? v.length : Object.keys(v).length);
+          if (isComposite) {
+            // render at indent+2, then turn the first line's indentation
+            // into "- ": continuation lines already align under the first
+            // key (block-sequence element indentation)
+            const rendered = toYaml(v, indent + "  ");
+            return indent + "- " + rendered.slice(indent.length + 2);
+          }
+          return indent + "- " + toYaml(v, indent);
+        })
+        .join("\n");
+    }
+    const keys = Object.keys(value);
+    if (!keys.length) return "{}";
+    return keys
+      .map((k) => {
+        const v = value[k];
+        const isComposite = typeof v === "object" && v !== null &&
+          (Array.isArray(v) ? v.length : Object.keys(v).length);
+        if (isComposite)
+          return indent + k + ":\n" + toYaml(v, indent + "  ");
+        return indent + k + ": " + toYaml(v, indent);
+      })
+      .join("\n");
+  }
+
+  function yamlView(container, obj) {
+    container.textContent = "";
+    const pre = document.createElement("pre");
+    pre.className = "kf-yaml";
+    pre.textContent = toYaml(obj);
+    container.appendChild(pre);
+  }
+
+  // ---- sparkline (dashboard metrics chart; resource-charts analog) -------
+  // values: number[]; renders an inline SVG polyline
+  function sparkline(container, values, opts) {
+    opts = opts || {};
+    const w = opts.width || 120, h = opts.height || 28;
+    const svg = document.createElementNS("http://www.w3.org/2000/svg", "svg");
+    svg.setAttribute("width", w);
+    svg.setAttribute("height", h);
+    svg.setAttribute("class", "kf-sparkline");
+    if (values && values.length > 1) {
+      const max = Math.max.apply(null, values.concat([1]));
+      const min = Math.min.apply(null, values.concat([0]));
+      const span = max - min || 1;
+      const pts = values.map((v, i) => {
+        const x = (i / (values.length - 1)) * (w - 2) + 1;
+        const y = h - 2 - ((v - min) / span) * (h - 4);
+        return x.toFixed(1) + "," + y.toFixed(1);
+      });
+      const line = document.createElementNS("http://www.w3.org/2000/svg", "polyline");
+      line.setAttribute("points", pts.join(" "));
+      line.setAttribute("fill", "none");
+      line.setAttribute("stroke", opts.stroke || "#1a73e8");
+      line.setAttribute("stroke-width", "1.5");
+      svg.appendChild(line);
+    }
+    container.textContent = "";
+    container.appendChild(svg);
+  }
+
+  // ---- namespace selector (namespace-select module, shared) --------------
+  // Replaces each page's ad-hoc header label. Inside the dashboard iframe
+  // the namespace comes from ?ns= (the dashboard owns the picker, like the
+  // reference); standalone pages get a live <select> fed by fetchNamespaces.
+  function namespaceSelector(container, opts) {
+    opts = opts || {};
+    const ns = currentNamespace() || opts.fallback || "default";
+    if (window.parent !== window || opts.static) {
+      const span = document.createElement("span");
+      span.id = "ns-label";
+      span.textContent = "namespace: " + ns;
+      container.appendChild(span);
+      return ns;
+    }
+    const sel = document.createElement("select");
+    sel.id = "ns-select";
+    (opts.fetchNamespaces
+      ? opts.fetchNamespaces()
+      : api("GET", "api/namespaces").then((d) => d.namespaces)
+    )
+      .then((names) => {
+        names.forEach((n) => {
+          const name = typeof n === "string" ? n : n.namespace;
+          const o = document.createElement("option");
+          o.value = name;
+          o.textContent = typeof n === "string" ? name : name + " (" + n.role + ")";
+          sel.appendChild(o);
+        });
+        if (names.length) {
+          sel.value = ns;
+          if (!sel.value) sel.value = sel.options[0].value;
+          setNamespace(sel.value);
+          if (sel.value !== ns && opts.onChange) opts.onChange(sel.value);
+        }
+      })
+      .catch(() => {
+        const o = document.createElement("option");
+        o.value = o.textContent = ns;
+        sel.appendChild(o);
+      });
+    sel.addEventListener("change", () => {
+      setNamespace(sel.value);
+      if (opts.onChange) opts.onChange(sel.value);
+    });
+    container.appendChild(sel);
+    return ns;
+  }
+
   window.kf = {
     api: api,
     snack: snack,
@@ -265,5 +474,14 @@
     currentNamespace: currentNamespace,
     setNamespace: setNamespace,
     poll: poll,
+    age: age,
+    validateK8sName: validateK8sName,
+    fieldError: fieldError,
+    detailsList: detailsList,
+    conditionsTable: conditionsTable,
+    toYaml: toYaml,
+    yamlView: yamlView,
+    sparkline: sparkline,
+    namespaceSelector: namespaceSelector,
   };
 })();

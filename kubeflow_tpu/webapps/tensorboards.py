@@ -10,6 +10,7 @@ from kubeflow_tpu.auth.rbac import Authorizer
 from kubeflow_tpu.controllers.tensorboard_controller import parse_logspath
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.webapps import base
 from kubeflow_tpu.webapps.base import App, get_json, success
 
 
@@ -17,6 +18,7 @@ def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) ->
     app = App("tensorboards-web-app", authorizer=authorizer or Authorizer(cluster))
 
     app.attach_frontend("tensorboards")
+    base.add_namespaces_route(app, cluster)
 
     @app.route("/api/namespaces/<namespace>/tensorboards")
     def list_tensorboards(request, namespace):

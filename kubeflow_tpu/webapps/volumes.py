@@ -9,6 +9,7 @@ from __future__ import annotations
 from kubeflow_tpu.auth.rbac import Authorizer
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.webapps import base
 from kubeflow_tpu.webapps.base import App, get_json, success
 
 
@@ -25,6 +26,7 @@ def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) ->
     app = App("volumes-web-app", authorizer=authorizer or Authorizer(cluster))
 
     app.attach_frontend("volumes")
+    base.add_namespaces_route(app, cluster)
 
     @app.route("/api/namespaces/<namespace>/pvcs")
     def list_pvcs(request, namespace):

@@ -170,6 +170,72 @@ class TestDetailPageFlow:
         )["poddefaults"]
         assert pds[0]["label"] == "use-tpu-creds"
 
+    def test_spawner_advanced_options_body(self, platform):
+        """The advanced-section fields the round-3 form adds: pull policy,
+        affinity/toleration keys, shm off, data volumes."""
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={
+                "name": "adv",
+                "imagePullPolicy": "Always",
+                "affinityConfig": "exclusive__tpu-host",
+                "tolerationGroup": "tpu-node-pool",
+                "shm": False,
+                "datavols": [{
+                    "mount": "/data/sets",
+                    "newPvc": {
+                        "metadata": {"name": "datasets"},
+                        "spec": {
+                            "resources": {"requests": {"storage": "20Gi"}},
+                            "accessModes": ["ReadWriteOnce"],
+                        },
+                    },
+                }],
+            },
+            headers=auth(client),
+        )
+        assert get_json(r)["success"], r.get_data()
+        nb = cluster.get("Notebook", "adv", "alice")
+        pod_spec = nb["spec"]["template"]["spec"]
+        assert pod_spec["containers"][0]["imagePullPolicy"] == "Always"
+        assert "affinity" in pod_spec
+        assert any(t.get("key") == "google.com/tpu" for t in pod_spec["tolerations"])
+        vols = pod_spec.get("volumes") or []
+        assert not any(v.get("name") == "dshm" for v in vols), "shm=false"
+        assert any(
+            v.get("persistentVolumeClaim", {}).get("claimName") == "datasets"
+            for v in vols
+        )
+        mounts = pod_spec["containers"][0]["volumeMounts"]
+        assert any(mt["mountPath"] == "/data/sets" for mt in mounts)
+        pvc = cluster.get("PersistentVolumeClaim", "datasets", "alice")
+        assert pvc["spec"]["resources"]["requests"]["storage"] == "20Gi"
+
+    def test_name_validation_regex_matches_backend_reality(self):
+        """The JS validator's RFC-1123 regex (extracted from the shipped lib)
+        must agree with the apiserver's rule on a spread of names."""
+        lib = (STATIC / "common" / "kubeflow.js").read_text()
+        m = re.search(r"if \(!(/\^.+?/)\.test\(name\)\)", lib)
+        assert m, "validateK8sName regex not found in kubeflow.js"
+        js_regex = m.group(1).strip("/")
+        cases = {
+            "my-notebook": True,
+            "nb1": True,
+            "a": True,
+            "-bad": False,
+            "bad-": False,
+            "Bad": False,
+            "has.dot": False,
+            "has_underscore": False,
+            "": False,
+        }
+        for name, ok in cases.items():
+            assert bool(re.fullmatch(js_regex, name)) == ok, name
+        # and the length guard exists
+        assert "63" in lib
+
     def test_detail_pages_are_served(self, platform):
         cluster, _ = platform
         client = Client(jupyter.create_app(cluster))
@@ -181,48 +247,73 @@ class TestDetailPageFlow:
         assert client.get("/../common/kubeflow.html").status_code in (404, 301, 308)
 
 
-def _script_of(page: str) -> str:
+# every SPA page, keyed by (static dir, page); the app factory each page's
+# api calls must resolve against
+PAGES = [
+    ("jupyter", "index.html"),
+    ("jupyter", "notebook.html"),
+    ("volumes", "index.html"),
+    ("tensorboards", "index.html"),
+    ("dashboard", "index.html"),
+]
+
+
+def _app_for(app_dir: str, cluster):
+    from kubeflow_tpu.webapps import dashboard, tensorboards, volumes
+
+    return {
+        "jupyter": jupyter.create_app,
+        "volumes": volumes.create_app,
+        "tensorboards": tensorboards.create_app,
+        "dashboard": dashboard.create_app,
+    }[app_dir](cluster)
+
+
+def _script_of(page: str, app_dir: str = "jupyter") -> str:
     soup = BeautifulSoup(
-        (STATIC / "jupyter" / page).read_text(), "html.parser"
+        (STATIC / app_dir / page).read_text(), "html.parser"
     )
     return "\n".join(s.get_text() for s in soup.find_all("script") if not s.get("src"))
 
 
-def _static_ids(page: str) -> set:
+def _static_ids(page: str, app_dir: str = "jupyter") -> set:
     soup = BeautifulSoup(
-        (STATIC / "jupyter" / page).read_text(), "html.parser"
+        (STATIC / app_dir / page).read_text(), "html.parser"
     )
     return {el["id"] for el in soup.find_all(attrs={"id": True})}
 
 
 class TestDomContract:
-    @pytest.mark.parametrize("page", ["index.html", "notebook.html"])
-    def test_kf_calls_are_exported(self, page):
-        js = _script_of(page)
+    @pytest.mark.parametrize("app_dir,page", PAGES)
+    def test_kf_calls_are_exported(self, app_dir, page):
+        js = _script_of(page, app_dir)
         lib = (STATIC / "common" / "kubeflow.js").read_text()
         exported = set(
             re.findall(r"^\s{4}(\w+):", lib.split("window.kf = {")[1], re.M)
         )
         used = set(re.findall(r"\bkf\.(\w+)\(", js))
         missing = used - exported
-        assert not missing, f"{page} calls kf.{missing} not exported"
+        assert not missing, f"{app_dir}/{page} calls kf.{missing} not exported"
 
-    @pytest.mark.parametrize("page", ["index.html", "notebook.html"])
-    def test_get_element_by_id_targets_exist(self, page):
-        js = _script_of(page)
-        ids = _static_ids(page)
+    @pytest.mark.parametrize("app_dir,page", PAGES)
+    def test_get_element_by_id_targets_exist(self, app_dir, page):
+        js = _script_of(page, app_dir)
+        ids = _static_ids(page, app_dir)
         # ids the page's own script creates dynamically
         ids |= set(re.findall(r"\.id = \"([\w-]+)\"", js))
+        # ids the shared lib's components create (e.g. the ns selector)
+        lib = (STATIC / "common" / "kubeflow.js").read_text()
+        ids |= set(re.findall(r"\.id = \"([\w-]+)\"", lib))
         for target in re.findall(r"getElementById\(\"([\w-]+)\"\)", js):
-            assert target in ids, f"{page}: #{target} missing"
+            assert target in ids, f"{app_dir}/{page}: #{target} missing"
 
-    @pytest.mark.parametrize("page", ["index.html", "notebook.html"])
-    def test_api_paths_exist_on_backend(self, page, cluster):
+    @pytest.mark.parametrize("app_dir,page", PAGES)
+    def test_api_paths_exist_on_backend(self, app_dir, page, cluster):
         """Catches JS-to-backend route drift: every URL expression the page
         passes to kf.api (string concats normalized to X segments) must
         exactly match a backend route shape."""
-        js = _script_of(page)
-        app = jupyter.create_app(cluster)
+        js = _script_of(page, app_dir)
+        app = _app_for(app_dir, cluster)
         rule_shapes = {
             re.sub(r"<[^>]+>", "X", str(r.rule))
             for r in app.url_map.iter_rules()
@@ -249,10 +340,24 @@ class TestDomContract:
             url = "".join(lits)
             return "/" + url if url.startswith("api/") else None
 
+        def matches_rule(url: str) -> bool:
+            if url in rule_shapes:
+                return True
+            # a literal segment (e.g. metrics/notebooks) satisfies a route
+            # placeholder (X): compare segment-by-segment
+            for rule in rule_shapes:
+                rsegs = rule.split("/")
+                usegs = url.split("/")
+                if len(rsegs) == len(usegs) and all(
+                    r == "X" or r == u for r, u in zip(rsegs, usegs)
+                ):
+                    return True
+            return False
+
         shapes = {u for u in (shape_of(e) for e in exprs) if u}
         assert shapes, f"{page}: no api URLs extracted (extractor drift?)"
         for url in sorted(shapes):
-            assert url in rule_shapes, (
+            assert matches_rule(url), (
                 f"{page}: no backend route for {url!r}; routes: "
                 f"{sorted(rule_shapes)}"
             )

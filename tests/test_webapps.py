@@ -396,6 +396,58 @@ class TestKfamApp:
 
 
 class TestDashboardApp:
+    def test_contributor_management_flow(self, platform):
+        """The home page's contributors panel: list → add → remove, with
+        owner-only enforcement (api_workgroup.ts:254-388 analog)."""
+        cluster, m = platform
+        client = Client(dashboard.create_app(cluster))
+        r = client.get("/api/workgroup/contributors/alice", headers=ALICE)
+        before = get_json_body(r)["contributors"]
+
+        r = client.post(
+            "/api/workgroup/contributors/alice",
+            json={"user": {"kind": "User", "name": "bob@x.io"},
+                  "roleRef": {"kind": "ClusterRole", "name": "edit"}},
+            headers=auth(client),
+        )
+        assert get_json_body(r)["success"], r.get_data()
+        r = client.get("/api/workgroup/contributors/alice", headers=ALICE)
+        contribs = get_json_body(r)["contributors"]
+        assert len(contribs) == len(before) + 1
+        bob = next(c for c in contribs if c["user"]["name"] == "bob@x.io")
+        assert bob["roleRef"]["name"] == "edit"
+        # the binding is a real RoleBinding + AuthorizationPolicy pair
+        assert BindingClient(cluster).list(user="bob@x.io", namespaces=["alice"])
+
+        # non-owner may not manage
+        r = client.post(
+            "/api/workgroup/contributors/alice",
+            json={"user": "mallory@x.io"},
+            headers=auth(client, {"kubeflow-userid": "eve@x.io"}),
+        )
+        assert r.status_code == 403
+
+        r = client.delete(
+            "/api/workgroup/contributors/alice",
+            json={"user": {"kind": "User", "name": "bob@x.io"},
+                  "roleRef": {"kind": "ClusterRole", "name": "edit"}},
+            headers=auth(client),
+        )
+        assert get_json_body(r)["success"]
+        r = client.get("/api/workgroup/contributors/alice", headers=ALICE)
+        assert len(get_json_body(r)["contributors"]) == len(before)
+
+    def test_namespaces_route_on_child_apps(self, platform):
+        """The shared namespace-select component needs /api/namespaces on
+        every child app backend (standalone pages have no dashboard parent)."""
+        cluster, _ = platform
+        for factory in (jupyter.create_app, volumes.create_app, tensorboards.create_app):
+            client = Client(factory(cluster))
+            r = client.get("/api/namespaces", headers=ALICE)
+            names = get_json_body(r)["namespaces"]
+            assert "alice" in names, factory.__module__
+            assert client.get("/api/namespaces").status_code == 401
+
     def test_nuke_self_deletes_profile_and_bindings(self, platform):
         cluster, m = platform
         bc = BindingClient(cluster)

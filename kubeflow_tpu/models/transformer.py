@@ -44,6 +44,9 @@ class TransformerConfig:
     attention_block_size: int = 512
     attention_window: int | None = None  # sliding-window (local) attention;
                                          # flash + xla impls only
+    decode_block_k: int = 256            # flash-decode KV block: finer than
+                                         # the training tile so cache block
+                                         # skipping tracks the live context
     remat: bool = False                  # jax.checkpoint each block: trades
                                          # recompute FLOPs for activation HBM
                                          # (long-seq/deep configs need it)
@@ -172,41 +175,65 @@ class Attention(nn.Module):
         """Attend q [B,S,H,D] against the rolling cache; new k/v are written
         at ``positions`` (contiguous, starting at positions[0]). Returns the
         pre-projection context [B,S,H,D] — the caller applies the shared
-        o_proj so the decode and training paths cannot diverge."""
+        o_proj so the decode and training paths cannot diverge.
+
+        The cache is laid out **[B, G, L, D]** (group-major) so the
+        flash-decode kernel streams per-group [bk, D] slabs contiguously;
+        grouped KV divides both cache memory and per-step read traffic by
+        H/KV."""
         cfg = self.cfg
         B, S, H, D = q.shape
         G = cfg.kv_heads
         R = H // G
         L = cfg.max_seq_len
         cached_k = self.variable(
-            "cache", "cached_key", jnp.zeros, (B, L, G, D), cfg.dtype,
+            "cache", "cached_key", jnp.zeros, (B, G, L, D), cfg.dtype,
         )
         cached_v = self.variable(
-            "cache", "cached_value", jnp.zeros, (B, L, G, D), cfg.dtype,
+            "cache", "cached_value", jnp.zeros, (B, G, L, D), cfg.dtype,
         )
         start = positions[0]
         k_all = lax.dynamic_update_slice(
-            cached_k.value, k.astype(cfg.dtype), (0, start, 0, 0)
+            cached_k.value, k.astype(cfg.dtype).transpose(0, 2, 1, 3),
+            (0, 0, start, 0),
         )
         v_all = lax.dynamic_update_slice(
-            cached_v.value, v.astype(cfg.dtype), (0, start, 0, 0)
+            cached_v.value, v.astype(cfg.dtype).transpose(0, 2, 1, 3),
+            (0, 0, start, 0),
         )
         cached_k.value = k_all
         cached_v.value = v_all
+
+        q_g = q.reshape(B, S, G, R, D)
+        bk = min(cfg.decode_block_k, L)
+        if S == 1 and cfg.attention_impl == "flash" and L % bk == 0:
+            # flash-decode kernel: KV traffic scales with the live context
+            # (scalar-prefetch block skipping), not max_seq_len. Cache
+            # lengths that don't tile into decode blocks (L % bk != 0) fall
+            # through to the einsum path instead of failing.
+            from kubeflow_tpu.ops.flash_decode import flash_decode
+
+            o = flash_decode(
+                q_g[:, 0],                              # [B, G, R, D]
+                k_all, v_all,
+                jnp.broadcast_to(positions[0], (B,)),
+                window=cfg.attention_window,
+                block_k=bk,
+            )
+            return o.reshape(B, 1, H, D)
 
         # prefill (S > 1, writes from slot 0) only needs the first S cache
         # slots — scoring all L would build [B,G,R,S,L] fp32 scores that are
         # masked anyway and OOM at long max_seq_len; single-token decode
         # attends the full cache
-        k_att = k_all[:, :S] if S > 1 else k_all
-        v_att = v_all[:, :S] if S > 1 else v_all
-        L_att = k_att.shape[1]
+        k_att = k_all[:, :, :S] if S > 1 else k_all
+        v_att = v_all[:, :, :S] if S > 1 else v_all
+        L_att = k_att.shape[2]
 
-        # fold q into [group, rep] so the cache is read grouped — no
+        # q folded into [group, rep] so the cache is read grouped — no
         # H-expanded [B, L, H, D] copy in the per-token hot loop
-        q_g = q.reshape(B, S, G, R, D)
         s = jnp.einsum(
-            "bqgrd,bkgd->bgrqk", q_g, k_att,
+            "bqgrd,bgkd->bgrqk", q_g, k_att,
             preferred_element_type=jnp.float32,
         ) * (D ** -0.5)
         kpos = jnp.arange(L_att)[None, :]
@@ -219,7 +246,7 @@ class Attention(nn.Module):
             )
         s = jnp.where(mask[None, None, None], s, att.NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bgrqk,bkgd->bqgrd", p.astype(v_att.dtype), v_att)
+        o = jnp.einsum("bgrqk,bgkd->bqgrd", p.astype(v_att.dtype), v_att)
         return o.reshape(B, S, H, D)
 
 

@@ -1,0 +1,190 @@
+"""Single-query (flash-decode) attention over the grouped KV cache.
+
+The decode step attends ONE query token per row against the cache. Round-2
+profiling (BASELINE.md, `DECODE_BENCH_r02`) put the XLA einsum path at 64% of
+its own parameter-bandwidth floor: at every step it streamed the FULL
+``max_seq_len`` cache — masked slots included — so a 2048-slot cache cost 8x
+the traffic of a 256-token context. This kernel makes KV traffic scale with
+the *actual* context:
+
+- grid ``(B, nk)``, k-blocks innermost (sequential) carrying the streaming
+  softmax state (acc, m, l) in VMEM scratch like the training kernel
+  (``pallas_attention.py``); all G kv groups ride ONE grid step as a batched
+  ``dot_general`` — decode blocks are tiny, so grid-iteration and
+  DMA-transaction overhead dominate, and fewer/fatter steps win (measured:
+  the (B, G, nk) variant lost to the XLA einsum at 128 steps/layer);
+- the current position is a **scalar-prefetch** operand: BlockSpec index maps
+  clamp the k/v block index into the live ``[lo, hi]`` window, so every
+  masked-out block re-points at an already-fetched block and costs **no DMA**
+  — this is the data-dependent block skipping the training kernel can't need
+  (its masks are static per grid step, the cache mask is not);
+- GQA native: the cache stays grouped ``[B, G, L, D]``; the ``R = H/G`` query
+  heads of a group ride the sublane axis of one ``[R, bk]`` score tile;
+- sliding windows honor the train-time mask AND skip dead blocks left of the
+  window (lo clamp), so long-window decode reads ``window`` keys, not ``pos``.
+
+No reference analog (the reference ships no model/inference code, SURVEY.md
+§2). Runs in interpreter mode off-TPU for tests, compiled Mosaic on TPU.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from kubeflow_tpu.ops.attention import NEG_INF
+from kubeflow_tpu.ops.pallas_attention import (
+    LANES,
+    _TRANS_B,
+    _HAS_PLTPU,
+    _auto_interpret,
+    _scratch,
+    pltpu,
+)
+
+
+# batched a @ b.T / p @ v over the leading group axis
+_G_TRANS_B = (((2,), (2,)), ((0,), (0,)))    # [G,R,D] x [G,bk,D] -> [G,R,bk]
+_G_PV = (((2,), (1,)), ((0,), (0,)))         # [G,R,bk] x [G,bk,D] -> [G,R,D]
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                   *, scale, bk, nk, window):
+    b, ik = pl.program_id(0), pl.program_id(1)
+    pos = pos_ref[b]
+    hi = pos // bk                               # last block with live keys
+    lo = 0 if window is None else jnp.maximum(0, (pos - window + 1) // bk)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(jnp.logical_and(ik >= lo, ik <= hi))
+    def _body():
+        q = q_ref[0]                             # [G, R, D]
+        k = k_ref[0]                             # [G, bk, D]
+        v = v_ref[0]
+        s = lax.dot_general(
+            q, k, _G_TRANS_B, preferred_element_type=jnp.float32
+        ) * scale                                # [G, R, bk] f32
+        kpos = ik * bk + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        mask = kpos <= pos                       # causal vs the cache clock
+        if window is not None:
+            mask = jnp.logical_and(mask, kpos > pos - window)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[..., :1]                  # [G, R, 1]
+        l_prev = l_ref[..., :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + lax.dot_general(
+            p.astype(v.dtype), v, _G_PV, preferred_element_type=jnp.float32
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_ref[..., :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def flash_decode(q, k_cache, v_cache, pos, *, window=None, block_k: int = 256,
+                 interpret: bool | None = None):
+    """Attend one query token per row against the grouped KV cache.
+
+    Args:
+      q: ``[B, G, R, D]`` — this step's queries, grouped (R = H // G).
+      k_cache, v_cache: ``[B, G, L, D]`` — the rolling cache, all slots.
+      pos: ``[B]`` int32 — the current token's position; cache slots
+        ``0..pos`` are live (slot ``pos`` holds this step's own k/v).
+      window: optional sliding-window size (keys ``(pos-window, pos]``).
+    Returns:
+      ``[B, G, R, D]`` context in q's dtype.
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    B, G, R, D = q.shape
+    L = k_cache.shape[2]
+    if k_cache.shape != (B, G, L, D) or v_cache.shape != (B, G, L, D):
+        raise ValueError(
+            f"cache must be [B={B}, G={G}, L, D={D}], got {k_cache.shape}"
+        )
+    bk = min(block_k, L)
+    if L % bk:
+        raise ValueError(
+            f"cache length {L} must be a multiple of block_k {bk}"
+        )
+    nk = L // bk
+    kernel = functools.partial(
+        _decode_kernel, scale=D ** -0.5, bk=bk, nk=nk, window=window,
+    )
+
+    def q_index(b, ik, pos_ref):
+        return (b, 0, 0, 0)
+
+    def kv_index(b, ik, pos_ref):
+        # clamp into the live window: skipped iterations re-point at an
+        # already-resident block, costing no DMA
+        hi = pos_ref[b] // bk
+        ix = jnp.minimum(ik, hi)
+        if window is not None:
+            lo = jnp.maximum(0, (pos_ref[b] - window + 1) // bk)
+            ix = jnp.maximum(ix, lo)
+        return (b, 0, ix, 0)
+
+    grid_kwargs = dict(
+        grid=(B, nk),
+        in_specs=[
+            pl.BlockSpec((1, G, R, D), q_index),
+            pl.BlockSpec((1, G, bk, D), kv_index),
+            pl.BlockSpec((1, G, bk, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, G, R, D), q_index),
+        scratch_shapes=[
+            _scratch((G, R, D)),
+            _scratch((G, R, LANES)),
+            _scratch((G, R, LANES)),
+        ],
+    )
+    pos = pos.astype(jnp.int32)
+    if _HAS_PLTPU:
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, **grid_kwargs
+            ),
+            out_shape=jax.ShapeDtypeStruct((B, G, R, D), q.dtype),
+            compiler_params=None if interpret else pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary"),
+            ),
+            interpret=interpret,
+        )(pos, q, k_cache, v_cache)
+    else:  # pragma: no cover - CPU-only fallback exercised via interpret
+        raise NotImplementedError("flash_decode requires pallas TPU support")
+    return out
+
+
+def decode_attention_reference(q, k_cache, v_cache, pos, *, window=None):
+    """Plain-jnp oracle for tests: same contract as flash_decode."""
+    B, G, R, D = q.shape
+    L = k_cache.shape[2]
+    s = jnp.einsum(
+        "bgrd,bgkd->bgrk", q, k_cache, preferred_element_type=jnp.float32
+    ) * (D ** -0.5)
+    kpos = jnp.arange(L)[None, :]                  # [1, L]
+    mask = kpos <= pos[:, None]
+    if window is not None:
+        mask = jnp.logical_and(mask, kpos > pos[:, None] - window)
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bgrk,bgkd->bgrd", p.astype(v_cache.dtype), v_cache)

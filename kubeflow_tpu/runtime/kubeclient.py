@@ -296,6 +296,7 @@ class KubeClient:
         def run():
             rv: str | None = None  # None → (re-)list before watching
             backoff = 0.5
+            stream_started = 0.0
             while not self._stop.is_set():
                 error_pause = False
                 try:
@@ -320,6 +321,7 @@ class KubeClient:
                         rv = None
                         continue
                     resp.raise_for_status()  # 403 etc. → backoff path, not a busy loop
+                    stream_started = time.monotonic()
                     for line in resp.iter_lines():
                         if self._stop.is_set():
                             return
@@ -355,6 +357,12 @@ class KubeClient:
                 except Exception:
                     error_pause = True
                 if error_pause:
+                    # an idle-but-healthy stream delivers no events before
+                    # the read timeout; if it lived a while, the failure is
+                    # routine churn, not a degraded server — start fresh so
+                    # sporadic blips can't ratchet backoff to the cap
+                    if stream_started and time.monotonic() - stream_started > 60:
+                        backoff = 0.5
                     time.sleep(random.uniform(0, backoff))
                     backoff = min(backoff * 2, 30.0)
 

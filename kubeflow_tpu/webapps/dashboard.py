@@ -175,15 +175,21 @@ def create_app(
             ],
         )
 
+    def _contributor_subject(body) -> tuple[dict, str]:
+        subject = body["user"]
+        if isinstance(subject, str):
+            subject = {"kind": "User", "name": subject}
+        if not isinstance(subject, dict) or not subject.get("name"):
+            raise ValueError(
+                "user must be an email string or a subject with a 'name'"
+            )
+        return subject, (body.get("roleRef") or {}).get("name", "edit")
+
     @app.route("/api/workgroup/contributors/<namespace>", methods=("POST",))
     def add_contributor(request, namespace):
         user = app.current_user(request)
         _ensure_can_manage(user, namespace)
-        body = get_json(request, "user")
-        subject = body["user"]
-        if isinstance(subject, str):
-            subject = {"kind": "User", "name": subject}
-        role = (body.get("roleRef") or {}).get("name", "edit")
+        subject, role = _contributor_subject(get_json(request, "user"))
         bindings.create(subject, namespace, role)
         return success("message", f"Added {subject['name']} to {namespace}")
 
@@ -193,11 +199,7 @@ def create_app(
     def remove_contributor(request, namespace):
         user = app.current_user(request)
         _ensure_can_manage(user, namespace)
-        body = get_json(request, "user")
-        subject = body["user"]
-        if isinstance(subject, str):
-            subject = {"kind": "User", "name": subject}
-        role = (body.get("roleRef") or {}).get("name", "edit")
+        subject, role = _contributor_subject(get_json(request, "user"))
         bindings.delete(subject, namespace, role)
         return success("message", f"Removed {subject['name']} from {namespace}")
 

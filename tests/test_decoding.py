@@ -108,6 +108,56 @@ def test_temperature_sampling_is_reproducible_and_in_range():
     assert int(jnp.max(a)) < 97 and int(jnp.min(a)) >= 0
 
 
+@pytest.mark.parametrize("kv_heads", [None, 2])
+def test_flash_decode_path_matches_xla_decode(kv_heads):
+    """attention_impl='flash' survives decode_config and the flash-decode
+    kernel (interpret mode here) generates the same tokens as the einsum
+    cache path and the full-forward oracle."""
+    base, dec_xla = cfg_pair(num_kv_heads=kv_heads)
+    dec_flash = dataclasses.replace(dec_xla, attention_impl="flash")
+    assert decode_config(
+        dataclasses.replace(base, attention_impl="flash")
+    ).attention_impl == "flash"
+    prompt = jnp.asarray(
+        np.random.default_rng(5).integers(0, 97, (2, 7)), jnp.int32
+    )
+    params = TransformerLM(base).init(jax.random.PRNGKey(0), prompt)["params"]
+    want = greedy_oracle(TransformerLM(base), params, prompt, 9)
+    got_xla = generate(TransformerLM(dec_xla), params, prompt, max_new_tokens=9)
+    got_flash = generate(TransformerLM(dec_flash), params, prompt, max_new_tokens=9)
+    np.testing.assert_array_equal(np.asarray(got_flash), np.asarray(got_xla))
+    np.testing.assert_array_equal(np.asarray(got_flash), np.asarray(want))
+
+
+def test_flash_impl_with_untileable_cache_falls_back():
+    """max_seq_len not a multiple of decode_block_k must decode (einsum
+    fallback), not crash — r02 configs decoded fine via forced-xla."""
+    base, dec_xla = cfg_pair()
+    dec_flash = dataclasses.replace(
+        dec_xla, attention_impl="flash", max_seq_len=96, decode_block_k=64
+    )
+    dec_xla = dataclasses.replace(dec_xla, max_seq_len=96)
+    prompt = jnp.asarray(
+        np.random.default_rng(7).integers(0, 97, (2, 7)), jnp.int32
+    )
+    params = TransformerLM(base).init(jax.random.PRNGKey(0), prompt)["params"]
+    got = generate(TransformerLM(dec_flash), params, prompt, max_new_tokens=5)
+    want = generate(TransformerLM(dec_xla), params, prompt, max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_flash_decode_honors_sliding_window():
+    base, dec_xla = cfg_pair(attention_window=16)
+    dec_flash = dataclasses.replace(dec_xla, attention_impl="flash")
+    prompt = jnp.asarray(
+        np.random.default_rng(6).integers(0, 97, (2, 30)), jnp.int32
+    )
+    params = TransformerLM(base).init(jax.random.PRNGKey(0), prompt)["params"]
+    got_xla = generate(TransformerLM(dec_xla), params, prompt, max_new_tokens=8)
+    got_flash = generate(TransformerLM(dec_flash), params, prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(got_flash), np.asarray(got_xla))
+
+
 def test_generate_rejects_cache_overflow():
     base, dec = cfg_pair()
     decode_model = TransformerLM(dec)

@@ -437,6 +437,17 @@ class TestDashboardApp:
         r = client.get("/api/workgroup/contributors/alice", headers=ALICE)
         assert len(get_json_body(r)["contributors"]) == len(before)
 
+    def test_contributor_malformed_subject_is_400(self, platform):
+        cluster, _ = platform
+        client = Client(dashboard.create_app(cluster))
+        r = client.post(
+            "/api/workgroup/contributors/alice",
+            json={"user": {"kind": "User"}},  # no name
+            headers=auth(client),
+        )
+        assert r.status_code == 400
+        assert "name" in get_json_body(r)["log"]
+
     def test_namespaces_route_on_child_apps(self, platform):
         """The shared namespace-select component needs /api/namespaces on
         every child app backend (standalone pages have no dashboard parent)."""

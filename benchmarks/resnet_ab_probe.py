@@ -1,0 +1,108 @@
+"""In-process A/B probe: two ResNet configs, interleaved windows, so tunnel
+throughput drift (measured 2x between processes) cancels. Usage:
+
+    python benchmarks/resnet_ab_probe.py BATCH_A BATCH_B [--b-mom-bf16]
+"""
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kubeflow_tpu.models.resnet import ResNet50
+from kubeflow_tpu.parallel import mesh as meshlib
+from kubeflow_tpu.parallel.train import make_classifier_train_step
+
+
+def build(batch, mom_bf16):
+    devices = jax.devices()
+    mesh = meshlib.create_mesh(
+        meshlib.MeshPlan(data=len(devices)), devices=devices
+    )
+    model = ResNet50(num_classes=1000)
+    tx = optax.sgd(
+        0.1, momentum=0.9, nesterov=True,
+        accumulator_dtype=jnp.bfloat16 if mom_bf16 else None,
+    )
+    bundle = make_classifier_train_step(model, tx, mesh)
+    rng = np.random.default_rng(0)
+    n = batch * len(devices)
+    data = {
+        "image": jnp.asarray(
+            rng.standard_normal((n, 224, 224, 3)), jnp.bfloat16
+        ),
+        "label": jnp.asarray(rng.integers(0, 1000, n), jnp.int32),
+    }
+    sh = {k: meshlib.batch_sharding(mesh) for k in data}
+    data = jax.device_put(data, sh)
+    state = bundle.init(jax.random.PRNGKey(0), data)
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi_step(state, batch):
+        # 10 steps per dispatch: amortizes tunnel dispatch jitter (bench.py
+        # round-3 methodology) so short-step configs measure honestly
+        def body(s, _):
+            s2, metrics = bundle.step(s, batch)
+            return s2, metrics["loss"]
+
+        s, losses = jax.lax.scan(body, state, None, length=10)
+        return s, losses[-1]
+
+    return multi_step, state, data, n * 10
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    batch_a, batch_b = int(args[0]), int(args[1])
+    b_mom = "--b-mom-bf16" in sys.argv
+    A = build(batch_a, False)
+    B = build(batch_b, b_mom)
+
+    def window(cfg, k):
+        step, state, data, _n = cfg
+        t = time.perf_counter()
+        loss = None
+        for _ in range(k):
+            state, loss = step(state, data)
+        float(loss)
+        cfg[1] = state
+        return time.perf_counter() - t
+
+    A, B = list(A), list(B)
+    window(A, 2); window(B, 2)  # warm both
+
+    def arm(cfg):
+        # short/long subtraction cancels the fixed readback cost; each call
+        # is a 10-step dispatch, so these are 10/90-step windows
+        return (window(cfg, 9) - window(cfg, 1)) / 8
+
+    rates_a, rates_b, ratios = [], [], []
+    for _ in range(4):
+        # palindromic A B B A: linear throughput drift within the round
+        # cancels to first order in the ratio
+        sa1 = arm(A); sb1 = arm(B); sb2 = arm(B); sa2 = arm(A)
+        ra = A[3] / ((sa1 + sa2) / 2)
+        rb = B[3] / ((sb1 + sb2) / 2)
+        rates_a.append(ra)
+        rates_b.append(rb)
+        ratios.append(rb / ra)
+    print(json.dumps({
+        "a": {"batch": batch_a, "imgs_per_sec": round(statistics.median(rates_a), 1)},
+        "b": {"batch": batch_b, "mom_bf16": b_mom,
+              "imgs_per_sec": round(statistics.median(rates_b), 1)},
+        "b_over_a_median_ratio": round(statistics.median(ratios), 4),
+        "ratio_spread": [round(r, 3) for r in sorted(ratios)],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""One-config ResNet-50 step-time probe (one process per config, like
+transformer_probe). Usage:
+
+    python benchmarks/resnet_probe.py BATCH [--mom-bf16] [--no-nesterov]
+
+Prints one JSON line with median img/s (two-window subtraction).
+"""
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kubeflow_tpu.models.resnet import ResNet50
+from kubeflow_tpu.parallel import mesh as meshlib
+from kubeflow_tpu.parallel.train import make_classifier_train_step
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    batch = int(args[0]) if args else 16
+    mom_bf16 = "--mom-bf16" in sys.argv
+    nesterov = "--no-nesterov" not in sys.argv
+    devices = jax.devices()
+    mesh = meshlib.create_mesh(
+        meshlib.MeshPlan(data=len(devices)), devices=devices
+    )
+    model = ResNet50(num_classes=1000)
+    tx = optax.sgd(
+        0.1, momentum=0.9, nesterov=nesterov,
+        accumulator_dtype=jnp.bfloat16 if mom_bf16 else None,
+    )
+    bundle = make_classifier_train_step(model, tx, mesh)
+    rng = np.random.default_rng(0)
+    n = batch * len(devices)
+    batch_data = {
+        "image": jnp.asarray(
+            rng.standard_normal((n, 224, 224, 3)), jnp.bfloat16
+        ),
+        "label": jnp.asarray(rng.integers(0, 1000, n), jnp.int32),
+    }
+    sh = {k: meshlib.batch_sharding(mesh) for k in batch_data}
+    batch_data = jax.device_put(batch_data, sh)
+    state = bundle.init(jax.random.PRNGKey(0), batch_data)
+
+    def window(k, state):
+        t = time.perf_counter()
+        metrics = None
+        for _ in range(k):
+            state, metrics = bundle.step(state, batch_data)
+        float(metrics["loss"])
+        return time.perf_counter() - t, state
+
+    _, state = window(10, state)
+    rates = []
+    for _ in range(3):
+        ts, state = window(10, state)
+        tl, state = window(60, state)
+        rates.append(n / ((tl - ts) / 50))
+    print(json.dumps({
+        "batch": batch, "mom_bf16": mom_bf16, "nesterov": nesterov,
+        "imgs_per_sec": round(statistics.median(rates), 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

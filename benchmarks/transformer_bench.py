@@ -43,6 +43,7 @@ from kubeflow_tpu.models.transformer import (
     TransformerLM,
     lm_loss_chunked,
 )
+from kubeflow_tpu.ops.optimizers import adamw_lowmem
 from kubeflow_tpu.parallel import mesh as meshlib
 from kubeflow_tpu.parallel.train import optimizer_state_shardings
 
@@ -89,19 +90,22 @@ def main() -> None:
         max_seq_len=seq,
         attention_impl="flash",
         attention_block_size=1024,
-        remat=True,           # activations at 24-layer depth exceed HBM
-        # dots_saveable fits (and wins) once flash + chunked loss free the
-        # S^2 scores and fp32 logits — up to seq 8192; at 16k+ even the
-        # saved matmul outputs (~700 MB/layer at 32k) exceed HBM, so very
-        # long contexts fall back to full per-block remat
+        # remat ladder (round-3 sweep, BASELINE.md): at seq 2048 / batch 4
+        # NO remat fits once flash + chunked loss + bf16 Adam moments free
+        # the HBM — and recompute-free backward is worth +10% (40.4k→44.4k
+        # tok/s). Longer contexts re-enable it: dots_saveable to 8192; at
+        # 16k+ even saved matmul outputs (~700 MB/layer at 32k) exceed HBM,
+        # so very long contexts use full per-block remat.
+        remat=seq > SEQ,
         remat_policy="full" if seq > 8192 else "dots",
         dtype=jnp.bfloat16,
     )
     model = TransformerLM(cfg)
-    # bf16 first moment: the roofline analysis (BASELINE.md) shows the step
-    # is HBM-traffic-bound; bf16 mu cuts ~1.7 GB/step of optimizer traffic
-    # (+2% measured). Standard large-scale practice; nu stays f32.
-    tx = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16)
+    # bf16 BOTH Adam moments (ops/optimizers.py): the roofline analysis
+    # (BASELINE.md) shows the step HBM-traffic-bound; bf16 mu+nu cut ~3.4
+    # GB/step of optimizer traffic (+1.6% measured). bf16 nu requires the
+    # b2=0.99 pairing — see the module docstring's rounding-floor analysis.
+    tx = adamw_lowmem(3e-4, b2=0.99, weight_decay=0.1)
 
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(

@@ -52,16 +52,40 @@ def main():
     )
     model = TransformerLM(cfg)
     mu_bf16 = "--mu-bf16" in sys.argv
-    tx = optax.adamw(
-        3e-4, weight_decay=0.1,
-        mu_dtype=jnp.bfloat16 if mu_bf16 else None,
+    opt = next(
+        (a.split("=", 1)[1] for a in sys.argv if a.startswith("--opt=")),
+        "adamw",
     )
+    if opt == "adamw":
+        tx = optax.adamw(
+            3e-4, weight_decay=0.1,
+            mu_dtype=jnp.bfloat16 if mu_bf16 else None,
+        )
+    elif opt == "lowmem":  # bf16 mu AND nu (b2=0.99 pairing rule)
+        from kubeflow_tpu.ops.optimizers import adamw_lowmem
+
+        tx = adamw_lowmem(3e-4, b2=0.99, weight_decay=0.1)
+    elif opt == "master":  # bf16 params + f32 master, f32 moments
+        from kubeflow_tpu.ops.optimizers import with_f32_master
+
+        tx = with_f32_master(optax.adamw(3e-4, weight_decay=0.1))
+    elif opt == "master-lowmem":  # bf16 params + f32 master, bf16 moments
+        # (vs --opt=lowmem this isolates ONLY the param-layout change)
+        from kubeflow_tpu.ops.optimizers import adamw_lowmem, with_f32_master
+
+        tx = with_f32_master(adamw_lowmem(3e-4, b2=0.99, weight_decay=0.1))
+    else:
+        raise SystemExit(f"unknown --opt={opt}")
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
 
-    params = jax.jit(
-        lambda k: model.init(k, tokens)["params"]
-    )(jax.random.PRNGKey(0))
+    def init_params(k):
+        p = model.init(k, tokens)["params"]
+        if opt in ("master", "master-lowmem"):
+            p = jax.tree_util.tree_map(lambda t: t.astype(jnp.bfloat16), p)
+        return p
+
+    params = jax.jit(init_params)(jax.random.PRNGKey(0))
     state = {"params": params, "opt_state": tx.init(params)}
 
     @functools.partial(jax.jit, donate_argnums=(0,))
@@ -98,7 +122,8 @@ def main():
     sec = statistics.median(rates)
     print(json.dumps({
         "impl": impl, "remat": remat, "batch": batch, "seq": seq,
-        "chunk": chunk, "heads": heads, "step_s": round(sec, 4),
+        "chunk": chunk, "heads": heads, "opt": opt,
+        "mu_bf16": mu_bf16, "step_s": round(sec, 4),
         "tok_per_s": round(batch * seq / sec, 1),
     }))
 

@@ -1,0 +1,155 @@
+"""Low-HBM-traffic optimizer variants for traffic-bound training steps.
+
+The round-2 roofline analysis (BASELINE.md) showed the flagship transformer
+step bandwidth-bound, with f32 optimizer state among the addressable traffic:
+per step, Adam reads+writes mu and nu and the f32 params — ~10 GB of the
+measured budget at 435M params. optax exposes ``mu_dtype`` but not
+``nu_dtype``; this module adds it, plus the bf16-params/f32-master layout.
+
+Numerics note (why naive bf16 nu is dangerous): with decay ``b2`` the
+per-step increment to nu is ``(1-b2)*g^2``. bf16 carries 8 mantissa bits, so
+increments below ``nu * 2^-9`` round to nothing and nu silently stops
+tracking the gradient scale. At the default ``b2=0.999`` the steady-state
+increment is ~``nu/1000`` — BELOW the rounding floor. Storing nu in bf16 is
+therefore only sound with ``b2 <= ~0.99`` (increment ~nu/100, comfortably
+representable). ``adamw_lowmem`` enforces this pairing unless overridden.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class ScaleByAdamLowmemState(NamedTuple):
+    count: chex.Array
+    mu: Any
+    nu: Any
+
+
+def _cast_tree(tree, dtype):
+    if dtype is None:
+        return tree
+    return jax.tree_util.tree_map(lambda t: t.astype(dtype), tree)
+
+
+def scale_by_adam_lowmem(
+    b1: float = 0.9,
+    b2: float = 0.99,
+    eps: float = 1e-8,
+    mu_dtype=jnp.bfloat16,
+    nu_dtype=jnp.bfloat16,
+) -> optax.GradientTransformation:
+    """``optax.scale_by_adam`` with BOTH moments storable in low precision.
+
+    Moment math runs in f32 (the stored moments are upcast, updated, and
+    cast back), so precision is lost only at the storage boundary — see the
+    module docstring for the b2/nu_dtype pairing rule.
+    """
+    if nu_dtype == jnp.bfloat16 and b2 > 0.99:
+        raise ValueError(
+            f"bf16 nu with b2={b2}: increments (1-b2)*g^2 fall below bf16's "
+            "rounding floor at steady state and are silently dropped; use "
+            "b2 <= 0.99 or nu_dtype=None (f32)"
+        )
+
+    def init(params):
+        return ScaleByAdamLowmemState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params
+            ),
+            nu=jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=nu_dtype or p.dtype), params
+            ),
+        )
+
+    def update(grads, state, params=None):
+        del params
+        count = state.count + 1
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+        tm = jax.tree_util.tree_map
+        mu32 = tm(
+            lambda g, mu: mu.astype(jnp.float32) * b1
+            + g.astype(jnp.float32) * (1 - b1),
+            grads, state.mu,
+        )
+        nu32 = tm(
+            lambda g, nu: nu.astype(jnp.float32) * b2
+            + jnp.square(g.astype(jnp.float32)) * (1 - b2),
+            grads, state.nu,
+        )
+        updates = tm(
+            lambda m, n: (m / c1) / (jnp.sqrt(n / c2) + eps), mu32, nu32
+        )
+        return updates, ScaleByAdamLowmemState(
+            count=count,
+            mu=_cast_tree(mu32, mu_dtype),
+            nu=_cast_tree(nu32, nu_dtype),
+        )
+
+    return optax.GradientTransformation(init, update)
+
+
+def adamw_lowmem(
+    learning_rate: float,
+    *,
+    b1: float = 0.9,
+    b2: float = 0.99,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    mu_dtype=jnp.bfloat16,
+    nu_dtype=jnp.bfloat16,
+) -> optax.GradientTransformation:
+    """AdamW with low-precision moment storage (see scale_by_adam_lowmem)."""
+    txs = [scale_by_adam_lowmem(b1, b2, eps, mu_dtype, nu_dtype)]
+    if weight_decay:
+        txs.append(optax.add_decayed_weights(weight_decay))
+    txs.append(optax.scale(-learning_rate))
+    return optax.chain(*txs)
+
+
+class MasterParamsState(NamedTuple):
+    master: Any      # f32 master copy
+    inner: Any       # wrapped optimizer state (tracks the master)
+
+
+def with_f32_master(
+    inner: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """bf16-params / f32-master layout as a gradient transformation.
+
+    The MODEL params stay bf16 (no per-step f32→bf16 cast materialization;
+    gradients arrive bf16, halving grad read/write traffic); the f32 master
+    lives in optimizer state and is the only f32 copy touched per step. The
+    emitted update is ``new_master.astype(param.dtype) - param`` so
+    ``optax.apply_updates`` lands the rounded master in the bf16 params.
+
+    Traffic accounting vs f32 params (435M): grads f32→bf16 saves ~1.7
+    GB/step; master r/w equals the old param r/w; the bf16 cast write is
+    unchanged (it becomes the param update write).
+    """
+
+    def init(params):
+        master = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), params
+        )
+        return MasterParamsState(master=master, inner=inner.init(master))
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("with_f32_master requires params")
+        inner_updates, inner_state = inner.update(
+            _cast_tree(grads, jnp.float32), state.inner, state.master
+        )
+        master = optax.apply_updates(state.master, inner_updates)
+        updates = jax.tree_util.tree_map(
+            lambda m, p: m.astype(p.dtype) - p, master, params
+        )
+        return updates, MasterParamsState(master=master, inner=inner_state)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,112 @@
+"""Low-memory optimizer transforms vs optax ground truth."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubeflow_tpu.ops.optimizers import adamw_lowmem, with_f32_master
+
+
+def _trajectory(tx, params, grads_seq):
+    state = tx.init(params)
+    out = []
+    for g in grads_seq:
+        updates, state = tx.update(g, state, params)
+        params = optax.apply_updates(params, updates)
+        out.append(params)
+    return out
+
+
+def _rand_tree(key, dtype=jnp.float32):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w": jax.random.normal(k1, (8, 16), dtype),
+        "b": jax.random.normal(k2, (16,), dtype),
+    }
+
+
+class TestAdamWLowmem:
+    def test_f32_storage_matches_optax(self):
+        params = _rand_tree(jax.random.PRNGKey(0))
+        grads = [_rand_tree(jax.random.PRNGKey(i + 1)) for i in range(5)]
+        ours = _trajectory(
+            adamw_lowmem(1e-2, b2=0.99, weight_decay=0.1,
+                         mu_dtype=None, nu_dtype=None),
+            params, grads,
+        )
+        ref = _trajectory(
+            optax.adamw(1e-2, b2=0.99, weight_decay=0.1), params, grads
+        )
+        for a, b in zip(ours, ref):
+            jax.tree_util.tree_map(
+                lambda x, y: np.testing.assert_allclose(x, y, atol=1e-6), a, b
+            )
+
+    def test_bf16_moments_track_f32_closely(self):
+        params = _rand_tree(jax.random.PRNGKey(0))
+        grads = [_rand_tree(jax.random.PRNGKey(i + 1)) for i in range(20)]
+        lowmem = _trajectory(adamw_lowmem(1e-2, b2=0.99), params, grads)
+        full = _trajectory(
+            adamw_lowmem(1e-2, b2=0.99, mu_dtype=None, nu_dtype=None),
+            params, grads,
+        )
+        # moment rounding perturbs the trajectory but must stay close
+        for a, b in zip(lowmem, full):
+            jax.tree_util.tree_map(
+                lambda x, y: np.testing.assert_allclose(x, y, atol=5e-3), a, b
+            )
+
+    def test_bf16_nu_with_default_b2_is_rejected(self):
+        with pytest.raises(ValueError, match="rounding floor"):
+            adamw_lowmem(1e-2, b2=0.999, nu_dtype=jnp.bfloat16)
+
+    def test_state_dtypes(self):
+        params = _rand_tree(jax.random.PRNGKey(0))
+        tx = adamw_lowmem(1e-2, b2=0.99)
+        state = tx.init(params)
+        adam_state = state[0]  # chain: (scale_by_adam_lowmem, decay, scale)
+        assert adam_state.mu["w"].dtype == jnp.bfloat16
+        assert adam_state.nu["w"].dtype == jnp.bfloat16
+
+
+class TestF32Master:
+    def test_matches_f32_param_training_up_to_bf16_rounding(self):
+        params32 = _rand_tree(jax.random.PRNGKey(0))
+        params16 = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), params32
+        )
+        grads32 = [_rand_tree(jax.random.PRNGKey(i + 1)) for i in range(10)]
+        grads16 = [
+            jax.tree_util.tree_map(lambda g: g.astype(jnp.bfloat16), g)
+            for g in grads32
+        ]
+        ref = _trajectory(optax.adamw(1e-2), params32, grads32)
+        got = _trajectory(
+            with_f32_master(optax.adamw(1e-2)), params16, grads16
+        )
+        for a, b in zip(got, ref):
+            jax.tree_util.tree_map(
+                lambda x, y: np.testing.assert_allclose(
+                    x.astype(jnp.float32), y, atol=2e-2, rtol=2e-2
+                ),
+                a, b,
+            )
+        # params stay bf16 throughout
+        assert got[-1]["w"].dtype == jnp.bfloat16
+
+    def test_master_accumulates_sub_rounding_updates(self):
+        """Updates too small to move a bf16 param must still accumulate in
+        the f32 master (the whole point of keeping one)."""
+        params = {"w": jnp.full((4,), 100.0, jnp.bfloat16)}
+        tx = with_f32_master(optax.sgd(1.0))
+        state = tx.init(params)
+        # one bf16 ulp at 100.0 is 0.5; push 1e-3 per step for 300 steps
+        for _ in range(300):
+            g = {"w": jnp.full((4,), 1e-3, jnp.bfloat16)}
+            updates, state = tx.update(g, state, params)
+            params = optax.apply_updates(params, updates)
+        # 300 * 1e-3 = 0.3 total: master moved, and once the accumulated
+        # delta crossed the bf16 ulp the param followed
+        assert float(state.master["w"][0]) < 99.8
+        assert float(params["w"][0]) < 100.0

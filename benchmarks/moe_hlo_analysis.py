@@ -1,0 +1,148 @@
+"""MoE dispatch HLO analysis on the virtual 8-device mesh (no TPU needed).
+
+Wall-clock on a CPU mesh is meaningless, but the COMPILED program is not:
+GSPMD's collective insertion (all-to-all for the einsum dispatch's expert
+resharding, all-reduce for grads) is decided at compile time from the
+sharding constraints. This tool compiles the MoE train step under each
+(mesh plan, dispatch) combination and reports per-collective op counts and
+output bytes — the traffic model recorded in BASELINE.md.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+     python benchmarks/moe_hlo_analysis.py
+"""
+import json
+import re
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models.moe import MoEConfig, MoETransformerLM, moe_lm_loss
+from kubeflow_tpu.parallel import mesh as meshlib
+
+DTYPE_BYTES = {
+    "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "s8": 1, "u8": 1,
+    "pred": 1, "f64": 8, "s64": 8, "u64": 8,
+}
+
+_OPS = ("all-to-all", "all-reduce", "all-gather", "reduce-scatter",
+        "collective-permute")
+_SHAPE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def collective_stats(compiled) -> dict:
+    """Count collective instructions and their result bytes (tuple-typed
+    all-reduces — XLA's grad-sync combining — sum their element shapes)."""
+    counts: dict = defaultdict(int)
+    bytes_: dict = defaultdict(int)
+    for line in compiled.as_text().splitlines():
+        s = line.strip()
+        if "= " not in s or "get-tuple-element" in s:
+            continue
+        op = next((o for o in _OPS if f" {o}(" in s), None)
+        if op is None:
+            continue
+        result = s.split("= ", 1)[1].split(f" {op}(", 1)[0]
+        total = 0
+        for m in _SHAPE.finditer(result):
+            n = 1
+            for d in m.group(2).split(","):
+                if d:
+                    n *= int(d)
+            total += n * DTYPE_BYTES.get(m.group(1), 4)
+        counts[op] += 1
+        bytes_[op] += total
+    return {
+        op: {"count": counts[op], "out_bytes_per_device": bytes_[op]}
+        for op in sorted(counts)
+    }
+
+
+def compile_step(plan: meshlib.MeshPlan, dispatch: str, *, batch=8, seq=128):
+    mesh = meshlib.create_mesh(plan)
+    cfg = MoEConfig(
+        vocab_size=512,
+        num_layers=2,
+        num_heads=4,
+        embed_dim=256,
+        expert_hidden_dim=512,
+        num_experts=8,
+        experts_per_token=2,
+        max_seq_len=seq,
+        attention_impl="xla",
+        dtype=jnp.bfloat16,
+        dispatch=dispatch,
+        mesh=mesh if dispatch in ("einsum", "a2a") else None,
+    )
+    model = MoETransformerLM(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    params = jax.device_put(
+        params, meshlib.param_shardings(mesh, params, meshlib.moe_param_spec)
+    )
+    # a2a layout: the expert axis doubles as a data axis outside the expert
+    # segment (GShard layout), so tokens shard over it too
+    token_spec = (
+        P(("data", "fsdp", "expert")) if dispatch == "a2a"
+        else P(("data", "fsdp"))
+    )
+    tokens = jax.device_put(tokens, NamedSharding(mesh, token_spec))
+    tx = optax.adamw(1e-3)
+    opt = tx.init(params)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: moe_lm_loss(model, p, tokens)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    with mesh:
+        compiled = jax.jit(step).lower(params, opt, tokens).compile()
+    return compiled
+
+
+def main():
+    results = []
+    for label, plan, dispatch in [
+        ("dp8+gather", meshlib.MeshPlan(data=8), "gather"),
+        ("dp8+einsum", meshlib.MeshPlan(data=8), "einsum"),
+        ("dp4 x ep2 einsum", meshlib.MeshPlan(data=4, expert=2), "einsum"),
+        ("dp2 x ep4 einsum", meshlib.MeshPlan(data=2, expert=4), "einsum"),
+        ("dp1 x ep8 einsum", meshlib.MeshPlan(data=1, expert=8), "einsum"),
+        ("dp4 x ep2 a2a", meshlib.MeshPlan(data=4, expert=2), "a2a"),
+        ("dp2 x ep4 a2a", meshlib.MeshPlan(data=2, expert=4), "a2a"),
+        ("dp1 x ep8 a2a", meshlib.MeshPlan(data=1, expert=8), "a2a"),
+        ("dp2 x ep2 x tp2 a2a", meshlib.MeshPlan(data=2, expert=2, tensor=2), "a2a"),
+    ]:
+        compiled = compile_step(plan, dispatch)
+        stats = collective_stats(compiled)
+        cost = compiled.cost_analysis()
+        cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+        results.append({
+            "config": label,
+            "collectives": stats,
+            "flops": cost.get("flops") if cost else None,
+        })
+        print(json.dumps(results[-1]))
+
+
+if __name__ == "__main__":
+    main()

@@ -6,10 +6,13 @@ Switch lineage the TPU was built for, expressed the XLA way:
 
 - static shapes everywhere: capacity-based routing (tokens over capacity are
   dropped, their residual stream passes through untouched);
-- two dispatch modes, both static-shaped: ``einsum`` (one-hot matmuls whose
-  sharding constraints let GSPMD insert ``all_to_all`` — the expert-parallel
-  layout) and ``gather`` (index scatter/gather with zero one-hot FLOPs — the
-  measured-faster single-chip/data-parallel path, see BASELINE.md);
+- three dispatch modes, all static-shaped: ``gather`` (index scatter/gather
+  with zero one-hot FLOPs — the measured-faster single-chip/data-parallel
+  path), ``a2a`` (gather locally + an explicit shard_map ``all_to_all``
+  expert segment — THE expert-mesh path), and ``einsum`` (one-hot matmuls
+  left to GSPMD — kept as the baseline that round-3 HLO analysis showed
+  lowering to replicated compute + all-reduce, NOT all_to_all, with
+  per-device FLOPs growing with the expert degree; BASELINE.md);
 - expert weight tables carry a leading expert dim sharded over the ``expert``
   mesh axis (rule: ``parallel/mesh.moe_param_spec``), composed with
   tensor-parallel column/row splits of the hidden dim;
@@ -48,15 +51,22 @@ class MoEConfig:
     capacity_factor: float = 1.25
     max_seq_len: int = 2048
     aux_loss_weight: float = 1e-2
-    dispatch: str = "einsum"            # einsum | gather:
-                                        #  einsum — one-hot matmul dispatch;
-                                        #   sharding constraints induce
-                                        #   all_to_all on expert meshes
-                                        #  gather — index-based dispatch, no
-                                        #   one-hot FLOPs (at S=2048/E=8 the
-                                        #   one-hot einsums cost as much as
-                                        #   the experts themselves); for
-                                        #   single-chip / data-parallel runs
+    dispatch: str = "einsum"            # einsum | gather | a2a. The rule
+                                        # (HLO-measured, BASELINE.md r03 +
+                                        # benchmarks/moe_hlo_analysis.py):
+                                        #  gather — zero-FLOP index dispatch;
+                                        #   THE single-chip/data-parallel
+                                        #   choice (one-hot einsums cost as
+                                        #   much as the experts at S=2048)
+                                        #  a2a — gather locally + explicit
+                                        #   shard_map all_to_all over the
+                                        #   expert axis; THE expert-mesh
+                                        #   choice (per-device FLOPs 1/ep)
+                                        #  einsum — one-hot matmul dispatch
+                                        #   left to GSPMD; measured: XLA
+                                        #   inserts all-reduces, NOT a2a,
+                                        #   and per-device FLOPs GROW with
+                                        #   ep; kept as the GSPMD baseline
     attention_impl: str = "block"
     attention_block_size: int = 512
     remat: bool = False                  # jax.checkpoint each block
@@ -182,9 +192,13 @@ def top_k_routing(
 
 
 class MoEMLP(nn.Module):
-    """Expert-parallel FFN: route → all_to_all dispatch → expert matmul →
-    all_to_all combine, with every data movement expressed as an einsum whose
-    sharding constraints make GSPMD insert the collectives."""
+    """Expert FFN: route → dispatch → expert matmul → combine.
+
+    Expert-parallel runs use ``dispatch='a2a'`` (explicit shard_map
+    all_to_all — see ``_expert_compute_a2a`` for why GSPMD can't be left to
+    infer it); ``gather`` is the single-chip/data-parallel fast path;
+    ``einsum`` expresses every movement as one-hot matmuls under sharding
+    constraints and is kept as the GSPMD baseline."""
 
     cfg: MoEConfig
 
@@ -237,51 +251,137 @@ class MoEMLP(nn.Module):
                 # table — the exact failure _constrain exists to prevent
                 raise ValueError(
                     "dispatch='gather' is the single-chip/data-parallel "
-                    "path; use dispatch='einsum' on expert-parallel meshes"
+                    "path; use dispatch='a2a' on expert-parallel meshes"
                 )
-            # Index-based dispatch: the one-hot einsums above cost
-            # 2*B*S*(E*C)*M FLOPs EACH (E*C ≈ k*capacity_factor*S, so
-            # effectively quadratic in S — as much as the expert matmuls at
-            # bench scale); static-shape scatter/gather moves the same
-            # tokens with zero matmul FLOPs. Single-chip / data-parallel
-            # fast path (einsum mode remains the expert-parallel layout).
             plan = route_top_k(logits, cfg.experts_per_token, C)
-            k_choices = cfg.experts_per_token
-            flat_idx = plan.experts * C + plan.pos                # [k,B,S]
-            valid = plan.keep > 0
-            # slot -> token map via scatter; slots are collision-free by
-            # construction, dropped tokens land in an overflow bucket
-            over = jnp.where(valid, flat_idx, E * C)
-            slot_token = jnp.full((B, E * C + 1), S, jnp.int32)
-            b_idx = jnp.arange(B)[:, None]
-            s_idx = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-            for j in range(k_choices):
-                slot_token = slot_token.at[b_idx, over[j]].set(s_idx)
-            slot_token = slot_token[:, : E * C]                   # [B,EC]
-            # empty slots read a zero row (sentinel index S)
-            x_pad = jnp.concatenate(
-                [x.astype(cfg.dtype), jnp.zeros((B, 1, M), cfg.dtype)], axis=1
-            )
-            expert_in = jnp.take_along_axis(
-                x_pad, slot_token[..., None], axis=1
-            ).reshape(B, E, C, M).transpose(1, 0, 2, 3)           # [E,B,C,M]
+            expert_in, flat_idx = _gather_dispatch(x, plan, E, C, cfg.dtype)
             h = nn.gelu(jnp.einsum("ebcm,emh->ebch", expert_in, wi))
             out = jnp.einsum("ebch,ehm->ebcm", h, wo)
-            out_flat = out.transpose(1, 0, 2, 3).reshape(B, E * C, M)
-            y = jnp.zeros((B, S, M), jnp.float32)
-            for j in range(k_choices):
-                tok = jnp.take_along_axis(
-                    out_flat,
-                    jnp.minimum(flat_idx[j], E * C - 1)[..., None],
-                    axis=1,
-                )                                                  # [B,S,M]
-                w = (plan.gates[j] * plan.keep[j])[..., None]
-                y = y + w * tok.astype(jnp.float32)
+            y = _gather_combine(out, plan, flat_idx, S)
+            aux_loss = plan.aux_loss
+        elif cfg.dispatch == "a2a":
+            if cfg.mesh is None or cfg.mesh.shape.get("expert", 1) <= 1:
+                raise ValueError(
+                    "dispatch='a2a' requires cfg.mesh with an expert axis "
+                    "> 1; use 'gather' on single-chip/data-parallel setups"
+                )
+            plan = route_top_k(logits, cfg.experts_per_token, C)
+            expert_in, flat_idx = _gather_dispatch(x, plan, E, C, cfg.dtype)
+            out = _expert_compute_a2a(expert_in, wi, wo, cfg.mesh)
+            y = _gather_combine(out, plan, flat_idx, S)
             aux_loss = plan.aux_loss
         else:
             raise ValueError(f"unknown dispatch {cfg.dispatch!r}")
         self.sow("intermediates", "aux_loss", aux_loss)
         return y.astype(cfg.dtype)
+
+
+def _gather_dispatch(x, plan: RoutingPlan, E: int, C: int, dtype):
+    """Index-based (zero-matmul-FLOP) dispatch: x [B,S,M] → expert slots
+    [E,B,C,M] + the slot indices for the return trip.
+
+    The one-hot einsum dispatch costs 2*B*S*(E*C)*M FLOPs (E*C ≈
+    k*capacity_factor*S, effectively quadratic in S — as much as the expert
+    matmuls at bench scale); static-shape scatter/gather moves the same
+    tokens for free. Slots are collision-free by construction; dropped
+    tokens land in an overflow bucket, empty slots read a zero row."""
+    B, S, M = x.shape
+    k_choices = plan.experts.shape[0]
+    flat_idx = plan.experts * C + plan.pos                    # [k,B,S]
+    valid = plan.keep > 0
+    over = jnp.where(valid, flat_idx, E * C)
+    slot_token = jnp.full((B, E * C + 1), S, jnp.int32)
+    b_idx = jnp.arange(B)[:, None]
+    s_idx = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    for j in range(k_choices):
+        slot_token = slot_token.at[b_idx, over[j]].set(s_idx)
+    slot_token = slot_token[:, : E * C]                       # [B,EC]
+    x_pad = jnp.concatenate(
+        [x.astype(dtype), jnp.zeros((B, 1, M), dtype)], axis=1
+    )
+    expert_in = jnp.take_along_axis(
+        x_pad, slot_token[..., None], axis=1
+    ).reshape(B, E, C, M).transpose(1, 0, 2, 3)               # [E,B,C,M]
+    return expert_in, flat_idx
+
+
+def _gather_combine(out, plan: RoutingPlan, flat_idx, S: int):
+    """Weighted return trip of _gather_dispatch: [E,B,C,M] → [B,S,M] f32."""
+    E, B, C, M = out.shape
+    k_choices = flat_idx.shape[0]
+    out_flat = out.transpose(1, 0, 2, 3).reshape(B, E * C, M)
+    y = jnp.zeros((B, S, M), jnp.float32)
+    for j in range(k_choices):
+        tok = jnp.take_along_axis(
+            out_flat,
+            jnp.minimum(flat_idx[j], E * C - 1)[..., None],
+            axis=1,
+        )                                                      # [B,S,M]
+        w = (plan.gates[j] * plan.keep[j])[..., None]
+        y = y + w * tok.astype(jnp.float32)
+    return y
+
+
+def _expert_compute_a2a(expert_in, wi, wo, mesh):
+    """Explicit expert-parallel segment: all_to_all → local experts →
+    all_to_all back, as a shard_map.
+
+    Why not GSPMD: compiling the einsum dispatch on expert meshes, XLA
+    chooses partial-replication + all-reduce instead of all_to_all — HLO
+    shows zero all-to-all ops and per-device FLOPs GROWING with the expert
+    degree (2.0G at dp8 → 6.3G at ep8 for the same model;
+    ``benchmarks/moe_hlo_analysis.py``). Writing the segment with explicit
+    collectives pins the intended program: per-device FLOPs scale 1/ep and
+    the wire carries exactly the dispatched slots, twice.
+
+    Layout: the batch rides (data, fsdp, **expert**) jointly — expert
+    parallelism borrows the expert axis for data in the non-expert segments
+    (the GShard/DeepSpeed-MoE layout). Sharding tokens over data only would
+    replicate them along the expert axis, and the a2a peers (which exchange
+    within an expert group) would each redo the same experts' work — the
+    first cut of this function did exactly that, measured as per-device
+    FLOPs *growing* with ep.
+
+    Shapes per device: in [E, b, C, M] (all experts, local batch b =
+    B/(dp*fsdp*ep)); first a2a → [E/ep, b*ep, C, M] (local experts, the
+    expert group's batch); local megatron-style FFN (wi column-, wo
+    row-split over ``tensor``, psum); second a2a returns [E, b, C, M]."""
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    tp = mesh.shape.get("tensor", 1)
+    batch_axes = tuple(
+        a for a in ("data", "fsdp", "expert") if a in mesh.axis_names
+    )
+
+    def body(ein, wi_l, wo_l):
+        xx = jax.lax.all_to_all(
+            ein, "expert", split_axis=0, concat_axis=1, tiled=True
+        )
+        h = jax.nn.gelu(jnp.einsum("ebcm,emh->ebch", xx, wi_l))
+        out = jnp.einsum("ebch,ehm->ebcm", h, wo_l)
+        if tp > 1:
+            out = jax.lax.psum(out, "tensor")
+        return jax.lax.all_to_all(
+            out, "expert", split_axis=1, concat_axis=0, tiled=True
+        )
+
+    specs = dict(
+        mesh=mesh,
+        in_specs=(
+            P(None, batch_axes, None, None),
+            P("expert", None, "tensor"),
+            P("expert", "tensor", None),
+        ),
+        out_specs=P(None, batch_axes, None, None),
+    )
+    try:  # jax >= 0.8 renamed the replication-check flag
+        mapped = shard_map(body, check_vma=False, **specs)
+    except TypeError:  # pragma: no cover
+        mapped = shard_map(body, check_rep=False, **specs)
+    return mapped(expert_in, wi, wo)
 
 
 def _constrain(x, spec: P):

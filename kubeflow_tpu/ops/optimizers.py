@@ -49,7 +49,11 @@ def scale_by_adam_lowmem(
     cast back), so precision is lost only at the storage boundary — see the
     module docstring for the b2/nu_dtype pairing rule.
     """
-    if nu_dtype == jnp.bfloat16 and b2 > 0.99:
+    if (
+        nu_dtype is not None
+        and jnp.dtype(nu_dtype) == jnp.dtype(jnp.bfloat16)
+        and b2 > 0.99
+    ):
         raise ValueError(
             f"bf16 nu with b2={b2}: increments (1-b2)*g^2 fall below bf16's "
             "rounding floor at steady state and are silently dropped; use "

@@ -262,6 +262,80 @@ def test_gather_dispatch_matches_einsum():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+class TestA2ADispatch:
+    """dispatch='a2a': explicit shard_map all_to_all over the expert axis
+    (the HLO analysis showed GSPMD lowers the einsum dispatch to replicated
+    compute + all-reduce — benchmarks/moe_hlo_analysis.py)."""
+
+    def _setup(self, plan):
+        mesh = meshlib.create_mesh(plan)
+        cfg = small_cfg(dispatch="a2a", mesh=mesh)
+        model = MoETransformerLM(cfg)
+        tokens = jnp.asarray(
+            np.random.default_rng(7).integers(0, 64, (8, 16)), jnp.int32
+        )
+        ref_model = MoETransformerLM(small_cfg(dispatch="gather"))
+        params = ref_model.init(jax.random.PRNGKey(0), tokens)["params"]
+        reference = float(moe_lm_loss(ref_model, params, tokens))
+        shardings = meshlib.param_shardings(
+            mesh, params, meshlib.moe_param_spec
+        )
+        sharded = jax.device_put(params, shardings)
+        # the a2a layout: batch rides (data, fsdp, expert) jointly — the
+        # expert axis doubles as a data axis outside the expert segment
+        sh_tokens = jax.device_put(
+            tokens, NamedSharding(mesh, P(("data", "fsdp", "expert")))
+        )
+        return mesh, model, sharded, sh_tokens, reference
+
+    @pytest.mark.parametrize(
+        "plan",
+        [
+            meshlib.MeshPlan(data=4, expert=2),
+            meshlib.MeshPlan(data=2, expert=4),
+            meshlib.MeshPlan(data=2, expert=2, tensor=2),
+        ],
+        ids=["ep2", "ep4", "ep2xtp2"],
+    )
+    def test_matches_single_device_gather(self, plan):
+        mesh, model, params, tokens, reference = self._setup(plan)
+
+        @jax.jit
+        def loss_and_grad(p, t):
+            return jax.value_and_grad(lambda q: moe_lm_loss(model, q, t))(p)
+
+        with mesh:
+            loss, grads = loss_and_grad(params, tokens)
+        assert np.isclose(float(loss), reference, atol=1e-3), (
+            f"{float(loss)} != {reference}"
+        )
+        flat = jax.tree_util.tree_leaves(grads)
+        assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+
+    def test_compiled_program_contains_all_to_all(self):
+        """The point of the mode: the compiled step must carry real
+        all-to-all ops (2 per MoE layer per direction pair), unlike the
+        einsum dispatch, whose lowering has none."""
+        mesh, model, params, tokens, _ = self._setup(
+            meshlib.MeshPlan(data=2, expert=4)
+        )
+
+        @jax.jit
+        def loss_fn(p, t):
+            return moe_lm_loss(model, p, t)
+
+        with mesh:
+            txt = loss_fn.lower(params, tokens).compile().as_text()
+        assert "all-to-all" in txt
+
+    def test_a2a_requires_expert_mesh(self):
+        cfg = small_cfg(dispatch="a2a")
+        model = MoETransformerLM(cfg)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        with pytest.raises(ValueError, match="expert axis"):
+            model.init(jax.random.PRNGKey(0), tokens)
+
+
 def test_gather_dispatch_rejects_expert_mesh():
     from kubeflow_tpu.models.moe import MoEConfig, MoETransformerLM
 

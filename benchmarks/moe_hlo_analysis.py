@@ -26,8 +26,6 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-import dataclasses
-
 import jax.numpy as jnp
 import numpy as np
 import optax

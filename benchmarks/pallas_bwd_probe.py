@@ -8,9 +8,7 @@ Computes, in one pass over the activations (tiled over rows):
 vs the same math in plain XLA ops. Shapes: the bench's hottest unit
 (stage2_block1/conv1: N=256*56*56, Ci=256, Co=128).
 """
-import sys
 import time
-from functools import partial
 
 import jax
 import jax.numpy as jnp

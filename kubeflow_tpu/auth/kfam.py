@@ -13,7 +13,7 @@ from __future__ import annotations
 import re
 
 from kubeflow_tpu.runtime import objects as ko
-from kubeflow_tpu.runtime.fake import FakeCluster, NotFound
+from kubeflow_tpu.runtime.fake import FakeCluster
 
 # display name <-> cluster role (ref bindings.go:39-46)
 ROLE_MAP = {

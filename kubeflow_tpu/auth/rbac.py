@@ -12,7 +12,6 @@ from __future__ import annotations
 import dataclasses
 from typing import Mapping
 
-from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import FakeCluster
 
 USERID_HEADER = "kubeflow-userid"

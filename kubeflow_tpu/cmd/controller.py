@@ -185,7 +185,9 @@ def main() -> None:
     cfg = ControllerConfig.from_env()
     fleet = FleetKernelFetcher(cluster, cfg)
     manager, metrics = build_manager(cluster, cfg, fetch_kernels=fleet)
-    serve_ops(metrics, manager=manager)
+    serve_ops(
+        metrics, port=int(os.environ.get("OPS_PORT", "8081")), manager=manager
+    )
     if cfg.namespace_labels_path:
         labels_watch = watch_namespace_labels(
             cfg.namespace_labels_path, manager, cluster

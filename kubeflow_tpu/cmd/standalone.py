@@ -20,7 +20,6 @@ import dataclasses
 import logging
 import os
 import threading
-import time
 from typing import Callable
 from wsgiref.simple_server import WSGIRequestHandler, make_server
 

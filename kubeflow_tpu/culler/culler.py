@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import time
-from typing import Callable, Mapping, Protocol
+from typing import Callable, Mapping
 
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.runtime import objects as ko

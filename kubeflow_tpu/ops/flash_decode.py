@@ -38,7 +38,6 @@ from jax.experimental import pallas as pl
 from kubeflow_tpu.ops.attention import NEG_INF
 from kubeflow_tpu.ops.pallas_attention import (
     LANES,
-    _TRANS_B,
     _HAS_PLTPU,
     _auto_interpret,
     _scratch,

@@ -16,7 +16,7 @@ representable). ``adamw_lowmem`` enforces this pairing unless overridden.
 """
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
+from typing import Any, NamedTuple
 
 import chex
 import jax

@@ -18,7 +18,7 @@ import fnmatch
 import itertools
 import threading
 import uuid
-from typing import Callable, Iterable, Mapping
+from typing import Callable, Mapping
 
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.tpu.topology import ACCELERATORS, parse_topology

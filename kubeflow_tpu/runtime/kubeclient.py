@@ -94,6 +94,11 @@ class KubeClient:
         session=None,
     ) -> None:
         if base_url is None:
+            # KUBE_API_BASE_URL: out-of-cluster/dev hook (kubeconfig analog)
+            # — the deploy-shape smoke points controller processes at the
+            # conformance apiserver with it
+            base_url = os.environ.get("KUBE_API_BASE_URL")
+        if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
             port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
             base_url = f"https://{host}:{port}"

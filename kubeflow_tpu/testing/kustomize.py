@@ -1,0 +1,123 @@
+"""Minimal kustomize renderer (the subset the shipped manifests use).
+
+The deploy-shape smoke needs the RENDERED objects — the exact env/args a
+cluster would run — in environments without a kustomize binary. Supported
+(all this repo's kustomizations use): ``resources`` (files + nested bases),
+``namespace`` injection, ``configMapGenerator`` (files + literals, rendered
+WITHOUT the content-hash name suffix — i.e. ``disableNameSuffixHash``
+semantics, so references match by plain name), and strategic-merge
+``patches`` (reusing the conformance apiserver's patchMergeKey
+implementation). Anything else in a kustomization is a loud error — a
+silently ignored directive would make the smoke test pass on shapes that
+never deploy.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+import yaml
+
+from kubeflow_tpu.testing.apiserver import strategic_merge_patch
+
+SUPPORTED_KEYS = {
+    "apiVersion", "kind", "resources", "namespace", "configMapGenerator",
+    "patches",
+}
+
+CLUSTER_SCOPED_KINDS = {
+    "Namespace", "CustomResourceDefinition", "ClusterRole",
+    "ClusterRoleBinding", "MutatingWebhookConfiguration",
+    "ValidatingWebhookConfiguration", "PriorityClass",
+}
+
+
+def render(path: str | Path) -> list[dict]:
+    """Render the kustomization at ``path`` to a list of objects."""
+    path = Path(path)
+    kfile = path / "kustomization.yaml"
+    kustomization = yaml.safe_load(kfile.read_text())
+    unknown = set(kustomization) - SUPPORTED_KEYS
+    if unknown:
+        raise ValueError(
+            f"{kfile}: unsupported kustomization keys {sorted(unknown)}"
+        )
+
+    objs: list[dict] = []
+    for res in kustomization.get("resources", []):
+        target = path / res
+        if target.is_dir():
+            objs.extend(render(target))
+        else:
+            objs.extend(
+                d for d in yaml.safe_load_all(target.read_text()) if d
+            )
+
+    for gen in kustomization.get("configMapGenerator", []):
+        data: dict = {}
+        for f in gen.get("files", []):
+            data[Path(f).name] = (path / f).read_text()
+        for lit in gen.get("literals", []):
+            k, _, v = lit.partition("=")
+            data[k] = v
+        objs.append({
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": gen["name"]},
+            "data": data,
+        })
+
+    for patch_entry in kustomization.get("patches", []):
+        patch = yaml.safe_load(patch_entry["patch"])
+        kind = patch.get("kind")
+        name = patch.get("metadata", {}).get("name")
+        matched = False
+        for i, obj in enumerate(objs):
+            if (
+                obj.get("kind") == kind
+                and obj.get("metadata", {}).get("name") == name
+            ):
+                objs[i] = strategic_merge_patch(obj, patch)
+                matched = True
+        if not matched:
+            raise ValueError(f"{kfile}: patch target {kind}/{name} not found")
+
+    ns = kustomization.get("namespace")
+    if ns:
+        for obj in objs:
+            if obj.get("kind") not in CLUSTER_SCOPED_KINDS:
+                obj.setdefault("metadata", {}).setdefault("namespace", ns)
+    return objs
+
+
+def find(objs: list[dict], kind: str, name: str) -> dict:
+    for obj in objs:
+        if (
+            obj.get("kind") == kind
+            and obj.get("metadata", {}).get("name") == name
+        ):
+            return obj
+    raise KeyError(f"{kind}/{name} not in rendered objects")
+
+
+def resolve_container_env(objs: list[dict], deployment: dict,
+                          container: str = "") -> dict[str, str]:
+    """The env a kubelet would hand the container: envFrom ConfigMaps
+    (which must EXIST in the rendered set — a dangling ref blocks pod start
+    on a real cluster and is an error here) overlaid by explicit env."""
+    containers = deployment["spec"]["template"]["spec"]["containers"]
+    ctr = next(
+        (c for c in containers if not container or c["name"] == container),
+        None,
+    )
+    if ctr is None:
+        raise KeyError(f"container {container!r} not in deployment")
+    env: dict[str, str] = {}
+    for src in ctr.get("envFrom", []):
+        ref = src.get("configMapRef", {}).get("name")
+        if ref:
+            cm = find(objs, "ConfigMap", ref)  # raises on dangling ref
+            env.update({k: str(v) for k, v in cm.get("data", {}).items()})
+    for item in ctr.get("env", []):
+        if "value" in item:
+            env[item["name"]] = str(item["value"])
+    return env

@@ -14,7 +14,6 @@ exist there. This module adds it TPU-natively on orbax:
 """
 from __future__ import annotations
 
-import os
 from typing import Any
 
 import jax

@@ -22,7 +22,7 @@ from werkzeug.exceptions import HTTPException, NotFound
 from werkzeug.routing import Map, RequestRedirect, Rule
 from werkzeug.wrappers import Request, Response
 
-from kubeflow_tpu.auth.rbac import AuthError, Authorizer, Forbidden, User, authenticate
+from kubeflow_tpu.auth.rbac import AuthError, Authorizer, User, authenticate
 from kubeflow_tpu.runtime.fake import AdmissionDenied, AlreadyExists
 from kubeflow_tpu.runtime.fake import NotFound as ClusterNotFound
 from kubeflow_tpu.utils.metrics import Registry

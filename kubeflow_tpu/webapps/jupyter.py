@@ -29,7 +29,7 @@ from kubeflow_tpu.controllers.notebook_controller import REWRITE_ANNOTATION
 from kubeflow_tpu.culler.culler import format_time
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import FakeCluster
-from kubeflow_tpu.tpu.topology import ACCELERATORS, parse_topology, validate_against_node_capacity
+from kubeflow_tpu.tpu.topology import parse_topology, validate_against_node_capacity
 from kubeflow_tpu.utils.metrics import NotebookMetrics
 from kubeflow_tpu.webapps import spawner_config
 from kubeflow_tpu.webapps import base

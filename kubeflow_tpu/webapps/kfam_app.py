@@ -13,7 +13,6 @@ from __future__ import annotations
 
 from kubeflow_tpu.auth.kfam import BindingClient, ProfileClient
 from kubeflow_tpu.auth.rbac import Forbidden
-from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import FakeCluster
 from kubeflow_tpu.webapps.base import App, get_json, success
 

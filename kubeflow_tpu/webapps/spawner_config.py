@@ -16,7 +16,6 @@ from typing import Any, Mapping
 
 import yaml
 
-from kubeflow_tpu.tpu.topology import ACCELERATORS
 
 CONFIG_PATH_ENV = "SPAWNER_UI_CONFIG"
 DEFAULT_CONFIG_PATH = "/etc/config/spawner_ui_config.yaml"

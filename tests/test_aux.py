@@ -1,5 +1,4 @@
 """Aux subsystems: checkpoint/resume, profiling capture, loadtest driver."""
-import json
 import os
 
 import jax

@@ -7,7 +7,6 @@ Reference: ``plugin_workload_identity.go:85-160``, ``plugin_iam.go:35-260``.
 import json
 import urllib.parse
 
-import pytest
 
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.cloud.aws import AwsIamClient, sign_v4

@@ -1,5 +1,4 @@
 """Input pipeline: device prefetch semantics on the virtual CPU mesh."""
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

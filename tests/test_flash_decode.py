@@ -1,5 +1,4 @@
 """flash_decode kernel vs the plain-jnp oracle (interpret mode, CPU)."""
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

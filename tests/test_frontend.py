@@ -24,7 +24,6 @@ from werkzeug.test import Client
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
 from kubeflow_tpu.controllers.profile_controller import ProfileReconciler
-from kubeflow_tpu.runtime.fake import FakeCluster
 from kubeflow_tpu.runtime.manager import Manager
 from kubeflow_tpu.webapps import jupyter
 from kubeflow_tpu.webhooks import poddefaults, tpu_env

@@ -10,7 +10,6 @@ import ssl
 import subprocess
 import threading
 
-import pytest
 import yaml
 
 from kubeflow_tpu.api import types as api

@@ -13,7 +13,6 @@ import json
 import threading
 import time
 
-import numpy as np
 import pytest
 
 from kubeflow_tpu.culler import probe as probemod

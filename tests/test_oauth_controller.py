@@ -1,5 +1,4 @@
 """OAuth companion controller (odh-notebook-controller analog)."""
-import pytest
 
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler

@@ -1,9 +1,6 @@
 """Mesh plans, sharding rules, distributed bootstrap env contract."""
-import os
 
-import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 

@@ -1,0 +1,123 @@
+"""Stdlib-only lint gate: syntax + unused imports + import shadowing.
+
+The CI lint job (``unit_tests.yaml``) runs ruff/mypy from pip; this tool is
+the zero-dependency first gate that also runs in hermetic environments (this
+repo's own test suite executes it — a lint gate nobody can run locally rots).
+
+Checks per file:
+- the file parses (SyntaxError is a finding, not a crash);
+- every ``import``/``from .. import`` binding is used somewhere in the
+  module (by name-load, attribute chain root, ``__all__`` listing, or
+  re-export via ``import x as x``); ``__future__``, ``_``-prefixed, and
+  side-effect (``import a.b``-style where ``a`` is used) imports exempt;
+- an import is not shadowed by a later top-level def/class of the same name.
+
+Usage: python tools/astlint.py [paths...]  (default: kubeflow_tpu tests
+benchmarks tools) — prints findings, exits 1 if any.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+DEFAULT_PATHS = ["kubeflow_tpu", "tests", "benchmarks", "tools", "bench.py",
+                 "__graft_entry__.py"]
+
+
+def _imported_names(tree: ast.AST):
+    """Yield (binding_name, node, is_reexport) for every import binding."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                yield name, node, False
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                name = alias.asname or alias.name
+                reexport = alias.asname is not None and alias.asname == alias.name
+                yield name, node, reexport
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+    # string references in __all__
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "__all__"
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.List, ast.Tuple))
+        ):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    used.add(elt.value)
+    return used
+
+
+def lint_source(source: str, filename: str) -> list[str]:
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [f"{filename}:{e.lineno}: syntax error: {e.msg}"]
+    findings = []
+    used = _used_names(tree)
+    # doctest/docstring references don't count; conftest/__init__ re-export
+    is_package_surface = filename.endswith("__init__.py") or filename.endswith(
+        "conftest.py"
+    )
+    seen: dict[str, int] = {}
+    for name, node, reexport in _imported_names(tree):
+        if name.startswith("_") or reexport or is_package_surface:
+            continue
+        if name not in used:
+            findings.append(
+                f"{filename}:{node.lineno}: unused import {name!r}"
+            )
+        seen[name] = node.lineno
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if node.name in seen:
+                findings.append(
+                    f"{filename}:{node.lineno}: {node.name!r} shadows the "
+                    f"import at line {seen[node.name]}"
+                )
+    return findings
+
+
+def lint_paths(paths) -> list[str]:
+    findings = []
+    for p in paths:
+        path = Path(p)
+        files = [path] if path.is_file() else sorted(path.rglob("*.py"))
+        for f in files:
+            findings.extend(lint_source(f.read_text(), str(f)))
+    return findings
+
+
+def main() -> int:
+    paths = sys.argv[1:] or DEFAULT_PATHS
+    findings = lint_paths([p for p in paths if Path(p).exists()])
+    for f in findings:
+        print(f)
+    print(f"astlint: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

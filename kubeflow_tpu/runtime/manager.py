@@ -71,7 +71,11 @@ class Manager:
         cluster: FakeCluster,
         *,
         clock: Callable[[], float] | None = None,
-        error_backoff_base: float = 1.0,
+        # controller-runtime's per-item rate limiter starts at 5 ms
+        # (workqueue.DefaultItemBasedRateLimiter); a 1 s base turned every
+        # optimistic-concurrency conflict into a ~1 s latency cliff under
+        # churn (loadtest/churn.py found it: create p50 1.5 s at n=20)
+        error_backoff_base: float = 0.005,
         error_backoff_max: float = 64.0,
     ) -> None:
         self.cluster = cluster
@@ -149,8 +153,11 @@ class Manager:
                 self._wq.advance(delta)
 
     def queue_metrics(self) -> dict:
-        """Workqueue counters (depth/adds/requeues/backoff), for /metrics."""
-        return self._wq.metrics()
+        """Workqueue counters (depth/adds/requeues/backoff), for /metrics.
+        ``depth`` is the LIVE queue length — the raw counters don't carry
+        it, and both the ops gauge and the churn loadtest's stuck-key gate
+        were silently reading 0 without it."""
+        return {"depth": len(self._wq), **self._wq.metrics()}
 
     # ----------------------------------------------------------- execution
 

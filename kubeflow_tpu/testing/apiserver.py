@@ -43,6 +43,7 @@ Semantics implemented (each mirrors documented apiserver behavior):
 """
 from __future__ import annotations
 
+import bisect
 import copy
 import json
 import re
@@ -453,7 +454,13 @@ class APIServer:
             def do_DELETE(self):
                 self._run("DELETE")
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        # listen backlog: HTTPServer's default request_queue_size of 5
+        # refuses connections under churn load (16 reconcile workers +
+        # kubelet + prober + watches all connecting concurrently)
+        class _Server(ThreadingHTTPServer):
+            request_queue_size = 128
+
+        self._httpd = _Server(("127.0.0.1", 0), Handler)
         self._httpd.daemon_threads = True
         threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="apiserver"
@@ -859,16 +866,28 @@ class APIServer:
                         # never silent loss
                         compacted = True
                         break
+                    # scan only the tail past `since` (bisect on the
+                    # monotone rev column) — a full-log rescan per wake per
+                    # watcher made commits O(events x watchers) and showed
+                    # up as seconds of latency in loadtest/churn.py
+                    start = bisect.bisect_right(
+                        self._events, since, key=lambda e: e[0]
+                    )
+                    tail = self._events[start:]
+                    # non-matching entries are inspected once, then skipped
+                    # for good: the cursor advances past everything scanned
+                    scanned_to = tail[-1][0] if tail else since
                     batch = [
                         (rev, ev, obj)
-                        for rev, ev, p, obj in self._events
-                        if rev > since and p == plural
+                        for rev, ev, p, obj in tail
+                        if p == plural
                         and (not namespace
                              or obj.get("metadata", {}).get("namespace") == namespace)
                         and matches(obj.get("metadata", {}).get("labels", {}))
                     ]
                     if batch or self._stop.is_set():
                         break
+                    since = scanned_to
                     self._watch_cond.wait(timeout=1.0)
             if compacted:
                 send({
@@ -888,6 +907,7 @@ class APIServer:
                 if not send({"type": ev, "object": obj}):
                     return
                 since = max(since, rev)
+            since = max(since, scanned_to)
 
     # ----------------------------------------------------------------- misc
 

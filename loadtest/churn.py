@@ -1,0 +1,245 @@
+"""n-CR churn loadtest against the conformance apiserver (VERDICT r2 #10).
+
+Drives the REAL stack end-to-end over HTTP: conformance apiserver ←
+KubeClient ← controller manager with worker threads, the fleet kernel
+prober refreshing throughout, and a fake kubelet marking StatefulSets
+ready. Four churn phases over N Notebook CRs — create → stop → start →
+delete — with per-CR latency measured from a StatefulSet WATCH (event
+timestamps, not poll sweeps), plus workqueue depth sampling and a
+stuck-key check at the end.
+
+    python loadtest/churn.py -n 200
+
+Prints one JSON line (LOADTEST_r03.json contract).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from pathlib import Path
+import sys
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cmd.controller import FleetKernelFetcher, build_manager
+from kubeflow_tpu.runtime.kubeclient import KubeClient
+from kubeflow_tpu.testing.apiserver import APIServer
+from kubeflow_tpu.utils.config import ControllerConfig
+
+NAMESPACE = "loadtest"
+
+
+def with_retries(fn, attempts=5):
+    """Driver-side connection retry (client-go's default behavior): under
+    full churn load a threaded in-process apiserver occasionally drops a
+    connection; the controller's own failures retry via the workqueue, but
+    the DRIVER's mutations need this or one blip aborts the whole run."""
+    import requests
+
+    for i in range(attempts):
+        try:
+            return fn()
+        except requests.exceptions.ConnectionError:
+            if i == attempts - 1:
+                raise
+            time.sleep(0.05 * (i + 1))
+
+
+def percentile(values, q):
+    values = sorted(values)
+    if not values:
+        return None
+    idx = min(len(values) - 1, int(q * len(values)))
+    return values[idx]
+
+
+class StsWatchLog:
+    """Append-only log of StatefulSet watch events with arrival times."""
+
+    def __init__(self, client):
+        self.lock = threading.Lock()
+        self.log: list[tuple[float, str, str, dict]] = []
+        client.watch("StatefulSet", self._on_event)
+
+    def _on_event(self, ev, obj):
+        name = obj.get("metadata", {}).get("name", "")
+        snap = {
+            "deleted": ev == "DELETED",
+            "replicas": obj.get("spec", {}).get("replicas"),
+        }
+        with self.lock:
+            self.log.append((time.perf_counter(), ev, name, snap))
+
+    def wait_all(self, t0_by_name, satisfies, timeout=120.0):
+        """Per-name latency: first event at/after the name's mutation time
+        that satisfies the predicate."""
+        deadline = time.time() + timeout
+        latencies: dict[str, float] = {}
+        while time.time() < deadline and len(latencies) < len(t0_by_name):
+            with self.lock:
+                entries = list(self.log)
+            for t, ev, name, snap in entries:
+                if name in t0_by_name and name not in latencies:
+                    if t >= t0_by_name[name] and satisfies(ev, snap):
+                        latencies[name] = t - t0_by_name[name]
+            time.sleep(0.02)
+        missing = set(t0_by_name) - set(latencies)
+        return latencies, missing
+
+
+def fake_kubelet(client, stop):
+    """Mark every StatefulSet's replicas ready (status subresource), like
+    the conformance apiserver's missing kubelet would."""
+    while not stop.is_set():
+        try:
+            for sts in client.list("StatefulSet", NAMESPACE):
+                want = sts.get("spec", {}).get("replicas", 0)
+                have = sts.get("status", {}).get("readyReplicas")
+                if have != want:
+                    sts.setdefault("status", {})["readyReplicas"] = want
+                    sts["status"]["replicas"] = want
+                    try:
+                        client.update_status(sts)
+                    except Exception:
+                        pass  # conflict with a reconcile: next sweep
+        except Exception:
+            pass
+        stop.wait(0.05)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=200)
+    ap.add_argument("--workers", type=int, default=4)
+    args = ap.parse_args()
+    n = args.n
+
+    server = APIServer()
+    base = server.start()
+    client = KubeClient(base_url=base, token="churn")
+    cfg = ControllerConfig()
+    fleet = FleetKernelFetcher(client, cfg, timeout=0.2)
+    manager, metrics = build_manager(client, cfg, fetch_kernels=fleet)
+    stop = threading.Event()
+    manager.run_workers(args.workers, stop)
+    threading.Thread(target=fake_kubelet, args=(client, stop), daemon=True).start()
+
+    # fleet prober active throughout (probes fail fast: no pods listen, but
+    # the refresh path — list + native parallel probe — runs for real)
+    def prober():
+        while not stop.is_set():
+            try:
+                fleet.refresh()
+            except Exception:
+                pass
+            stop.wait(1.0)
+
+    threading.Thread(target=prober, daemon=True).start()
+
+    depth_samples = []
+
+    def sampler():
+        while not stop.is_set():
+            depth_samples.append(manager.queue_metrics().get("depth", 0))
+            stop.wait(0.1)
+
+    threading.Thread(target=sampler, daemon=True).start()
+
+    watchlog = StsWatchLog(client)
+    client.create({"apiVersion": "v1", "kind": "Namespace",
+                   "metadata": {"name": NAMESPACE}})
+
+    names = [f"churn-{i}" for i in range(n)]
+    phases = {}
+
+    # -- create: CR → StatefulSet exists --------------------------------
+    t0 = {}
+    for name in names:
+        t0[name] = time.perf_counter()
+        with_retries(lambda: client.create(api.notebook(name, NAMESPACE)))
+    lat, missing = watchlog.wait_all(
+        t0, lambda ev, s: not s["deleted"] and s["replicas"] == 1
+    )
+    phases["create"] = (lat, missing)
+
+    # -- stop: annotation → replicas 0 ----------------------------------
+    t0 = {}
+    for name in names:
+        t0[name] = time.perf_counter()
+        with_retries(lambda: client.patch(
+            "Notebook", name, NAMESPACE,
+            {"metadata": {"annotations": {api.STOP_ANNOTATION: "t"}}},
+        ))
+    lat, missing = watchlog.wait_all(
+        t0, lambda ev, s: not s["deleted"] and s["replicas"] == 0
+    )
+    phases["stop"] = (lat, missing)
+
+    # -- start: annotation removed → replicas 1 -------------------------
+    t0 = {}
+    for name in names:
+        t0[name] = time.perf_counter()
+        with_retries(lambda: client.patch(
+            "Notebook", name, NAMESPACE,
+            {"metadata": {"annotations": {api.STOP_ANNOTATION: None}}},
+        ))
+    lat, missing = watchlog.wait_all(
+        t0, lambda ev, s: not s["deleted"] and s["replicas"] == 1
+    )
+    phases["start"] = (lat, missing)
+
+    # -- delete: CR gone → StatefulSet garbage-collected ----------------
+    t0 = {}
+    for name in names:
+        t0[name] = time.perf_counter()
+        with_retries(lambda: client.delete("Notebook", name, NAMESPACE))
+    lat, missing = watchlog.wait_all(
+        t0, lambda ev, s: s["deleted"], timeout=180.0
+    )
+    phases["delete"] = (lat, missing)
+
+    # drain: queue must empty (no stuck keys)
+    deadline = time.time() + 30
+    final = manager.queue_metrics()
+    while time.time() < deadline:
+        final = manager.queue_metrics()
+        if final.get("depth", 0) == 0:
+            break
+        time.sleep(0.2)
+    stop.set()
+    client.stop()
+    server.stop()
+
+    out = {
+        "metric": "notebook_churn_latency",
+        "unit": "s",
+        "n": n,
+        "phases": {},
+        "workqueue": {
+            "max_depth": max(depth_samples or [0]),
+            "final_depth": final.get("depth", 0),
+            "stats": final,
+        },
+        "stuck_keys": final.get("depth", 0) != 0,
+    }
+    ok = True
+    for phase, (lat, missing) in phases.items():
+        vals = list(lat.values())
+        out["phases"][phase] = {
+            "p50": round(percentile(vals, 0.50), 4) if vals else None,
+            "p90": round(percentile(vals, 0.90), 4) if vals else None,
+            "p99": round(percentile(vals, 0.99), 4) if vals else None,
+            "max": round(max(vals), 4) if vals else None,
+            "missing": len(missing),
+        }
+        ok = ok and not missing
+    out["ok"] = ok and not out["stuck_keys"]
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

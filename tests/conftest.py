@@ -30,3 +30,18 @@ def cluster():
     from kubeflow_tpu.runtime.fake import FakeCluster
 
     return FakeCluster()
+
+
+def eventually(fn, timeout=8.0, interval=0.05):
+    """envtest's Eventually(): poll until fn() returns truthy (shared by the
+    conformance/stress/deploy-shape suites)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(interval)
+    raise AssertionError(f"condition not met within {timeout}s (last={last!r})")

@@ -34,16 +34,7 @@ def env():
     server.stop()
 
 
-def eventually(fn, timeout=8.0, interval=0.05):
-    """envtest's Eventually(): poll until fn() returns truthy."""
-    deadline = time.time() + timeout
-    last = None
-    while time.time() < deadline:
-        last = fn()
-        if last:
-            return last
-        time.sleep(interval)
-    raise AssertionError(f"condition not met within {timeout}s (last={last!r})")
+from conftest import eventually  # noqa: E402
 
 
 class TestClientConformance:

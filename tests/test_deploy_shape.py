@@ -11,7 +11,6 @@ kustomize-build alone would stay green.
 import os
 import subprocess
 import sys
-import time
 from pathlib import Path
 
 import pytest
@@ -25,15 +24,7 @@ REPO = Path(__file__).resolve().parents[1]
 OVERLAYS = ["standalone", "istio", "openshift"]
 
 
-def eventually(fn, timeout=20.0, interval=0.1):
-    deadline = time.time() + timeout
-    last = None
-    while time.time() < deadline:
-        last = fn()
-        if last:
-            return last
-        time.sleep(interval)
-    raise AssertionError(f"condition not met within {timeout}s (last={last!r})")
+from conftest import eventually  # noqa: E402
 
 
 class TestRenderedShapes:
@@ -99,21 +90,35 @@ class TestControllerBootsFromRenderedShape:
             stderr=subprocess.STDOUT,
             text=True,
         )
+        # drain stdout continuously: a log-spamming failure mode would fill
+        # the 64 KiB pipe and BLOCK the controller, hiding its own error
+        out_lines: list[str] = []
+        import threading
+
+        def _drain():
+            for line in proc.stdout:
+                out_lines.append(line)
+
+        threading.Thread(target=_drain, daemon=True).start()
         try:
             client.create(api.profile("team-a", "alice@x.io"))
             nb = api.notebook("shape-nb", "team-a")
             client.create(nb)
-            sts = eventually(
-                lambda: client.try_get("StatefulSet", "shape-nb", "team-a")
-                if proc.poll() is None
-                else (_ for _ in ()).throw(
-                    AssertionError(
+            def sts_or_diagnose():
+                if proc.poll() is not None:
+                    raise AssertionError(
                         f"controller exited {proc.returncode}:\n"
-                        + proc.stdout.read()[-2000:]
+                        + "".join(out_lines)[-2000:]
                     )
-                ),
-                timeout=30,
-            )
+                return client.try_get("StatefulSet", "shape-nb", "team-a")
+
+            try:
+                sts = eventually(sts_or_diagnose, timeout=30)
+            except AssertionError:
+                raise AssertionError(
+                    "no StatefulSet within 30s; controller output:\n"
+                    + "".join(out_lines)[-2000:]
+                )
             assert sts["spec"]["replicas"] == 1
             # profile reconcile provisioned the namespace too
             assert eventually(

@@ -36,15 +36,11 @@ def env():
     server.stop()
 
 
+from conftest import eventually as _eventually
+
+
 def eventually(fn, timeout=15.0, interval=0.1):
-    deadline = time.time() + timeout
-    last = None
-    while time.time() < deadline:
-        last = fn()
-        if last:
-            return last
-        time.sleep(interval)
-    raise AssertionError(f"did not converge within {timeout}s (last={last!r})")
+    return _eventually(fn, timeout=timeout, interval=interval)
 
 
 class TestThreadedReconcileStress:

@@ -79,7 +79,11 @@ def main() -> None:
         dtype=jnp.bfloat16,
     )
     model = MoETransformerLM(cfg)
-    tx = optax.adamw(3e-4, weight_decay=0.1)
+    # bf16 both Adam moments (round-3 transformer finding, BASELINE.md);
+    # b2=0.99 pairing per ops/optimizers.py
+    from kubeflow_tpu.ops.optimizers import adamw_lowmem
+
+    tx = adamw_lowmem(3e-4, b2=0.99, weight_decay=0.1)
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (BATCH, SEQ)), jnp.int32)
 

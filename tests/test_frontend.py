@@ -212,6 +212,37 @@ class TestDetailPageFlow:
         pvc = cluster.get("PersistentVolumeClaim", "datasets", "alice")
         assert pvc["spec"]["resources"]["requests"]["storage"] == "20Gi"
 
+    def test_existing_pvc_attaches_without_creating(self, platform):
+        """A data-volume row naming an existing PVC sends existingSource —
+        the backend must mount it and must NOT create a new claim."""
+        cluster, m = platform
+        cluster.create({
+            "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+            "metadata": {"name": "datasets", "namespace": "alice"},
+            "spec": {"resources": {"requests": {"storage": "50Gi"}},
+                     "accessModes": ["ReadWriteOnce"]},
+        })
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={
+                "name": "att",
+                "workspace": None,
+                "datavols": [{"mount": "/data/sets", "existingSource": "datasets"}],
+            },
+            headers=auth(client),
+        )
+        assert get_json(r)["success"], r.get_data()
+        nb = cluster.get("Notebook", "att", "alice")
+        vols = nb["spec"]["template"]["spec"]["volumes"]
+        assert any(
+            v.get("persistentVolumeClaim", {}).get("claimName") == "datasets"
+            for v in vols
+        )
+        # still exactly one PVC: nothing new was created
+        pvcs = cluster.list("PersistentVolumeClaim", "alice")
+        assert [p["metadata"]["name"] for p in pvcs] == ["datasets"]
+
     def test_name_validation_regex_matches_backend_reality(self):
         """The JS validator's RFC-1123 regex (extracted from the shipped lib)
         must agree with the apiserver's rule on a spread of names."""

@@ -80,6 +80,46 @@ class TestCertWatcher:
         assert watcher is None
         server.server_close()
 
+    def test_admission_review_over_https_survives_rotation(self, tmp_path, cluster):
+        """The full deployable path: AdmissionReview over real HTTPS against
+        the TLS server, before AND after a cert rotation."""
+        import requests
+
+        _gen_cert(tmp_path, "cert-one")
+        server, watcher = make_server_with_tls(cluster, 0, str(tmp_path))
+        port = server.server_address[1]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+
+        review = {
+            "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {
+                "uid": "u-1",
+                "object": {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "p", "namespace": "ns"},
+                    "spec": {"containers": [{"name": "c", "image": "x"}]},
+                },
+            },
+        }
+
+        def post():
+            r = requests.post(
+                f"https://127.0.0.1:{port}/apply-poddefault",
+                json=review, verify=False, timeout=5,
+            )
+            r.raise_for_status()
+            return r.json()["response"]
+
+        try:
+            assert post()["allowed"] is True
+            _gen_cert(tmp_path, "cert-two")
+            assert watcher.poll_once()
+            assert post()["allowed"] is True, "service continues on new cert"
+            assert _peer_cn(port) == "cert-two"
+        finally:
+            server.shutdown()
+
 
 class TestFileWatcher:
     def test_fires_on_change_and_reappearance(self, tmp_path):

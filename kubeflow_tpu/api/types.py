@@ -43,6 +43,8 @@ def notebook(
     image: str = "kubeflow-tpu/jupyter-jax:latest",
     cpu: str = "0.5",
     memory: str = "1Gi",
+    cpu_limit: str | None = None,
+    memory_limit: str | None = None,
     tpu_accelerator: str | None = None,
     tpu_topology: str | None = None,
     tpu_num_slices: int = 1,
@@ -59,7 +61,9 @@ def notebook(
         "image": image,
         "resources": {
             "requests": {"cpu": cpu, "memory": memory},
-            "limits": {"cpu": cpu, "memory": memory},
+            # limits default to the requests (Guaranteed QoS); the spawner
+            # passes limitFactor-scaled values (ref form.py:117-175)
+            "limits": {"cpu": cpu_limit or cpu, "memory": memory_limit or memory},
         },
     }
     if env:

@@ -276,6 +276,61 @@ def create_app(
     return app
 
 
+def _cpu_value(s: str) -> float:
+    s = str(s).strip()
+    return float(s[:-1]) / 1000.0 if s.endswith("m") else float(s)
+
+
+# binary suffixes first: "Gi" must match before "G"
+_MEM_UNITS = {
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+    "Ei": 2**60,
+    "k": 10**3, "K": 10**3, "M": 10**6, "G": 10**9, "T": 10**12,
+    "P": 10**15, "E": 10**18,
+}
+
+
+def _mem_bytes(s: str) -> float:
+    s = str(s).strip()
+    for unit, mult in _MEM_UNITS.items():
+        if s.endswith(unit):
+            return float(s[: -len(unit)]) * mult
+    return float(s)
+
+
+def compute_limit(request: str, explicit, factor, *, kind: str) -> str | None:
+    """Resource limit per the reference's set_notebook_cpu/memory
+    (form.py:117-175): an explicit limit wins (a limit below the request is
+    a 400); else request * limitFactor (config), clamped to never round
+    below the request; limitFactor 'none'/absent means no scaling — limits
+    fall back to the request (Guaranteed QoS)."""
+    value = _cpu_value if kind == "cpu" else _mem_bytes
+    if explicit not in (None, ""):
+        if value(explicit) < value(request):
+            raise ValueError(
+                f"{kind} limit {explicit!r} must be at least the request "
+                f"{request!r}"
+            )
+        return str(explicit)
+    if factor in (None, "", "none"):
+        return None
+    f = float(factor)
+    if kind == "cpu":
+        scaled = str(round(_cpu_value(request) * f, 3))
+    else:
+        # preserve the request's unit (ref assumes Gi; we scale in place)
+        s = str(request).strip()
+        for unit in _MEM_UNITS:
+            if s.endswith(unit):
+                scaled = str(round(float(s[: -len(unit)]) * f, 2)) + unit
+                break
+        else:
+            scaled = str(round(float(s) * f))
+    # rounding can land a hair under the request (e.g. factor 1.0 on
+    # 1.555Gi): the request itself is the floor, never an error
+    return str(scaled) if value(scaled) >= value(request) else str(request)
+
+
 def _resolve_option(body: dict, defaults: dict, field: str, id_key: str) -> dict | None:
     """Look up the form's keyed choice in the config section's options list
     (shared shape of tolerationGroup and affinityConfig, ref form.py:178-223).
@@ -344,12 +399,28 @@ def build_notebook(body: dict, namespace: str, defaults: dict, creator: str) -> 
         # VirtualService rewrites /notebook/<ns>/<name>/ -> / for them
         # (ref JWA form.py sets the same rewrite annotations)
         annotations[REWRITE_ANNOTATION] = "/"
+    cpu = str(fv(body, defaults, "cpu"))
+    memory = str(fv(body, defaults, "memory"))
+    sections = defaults.get("spawnerFormDefaults", {})
+    # limits go through form_value too: a readOnly cpuLimit/memoryLimit
+    # config section pins them like any other field (the request being
+    # readOnly while its limit is user-writable would defeat the pin)
+    cpu_limit = compute_limit(
+        cpu, fv(body, defaults, "cpuLimit", optional=True),
+        sections.get("cpu", {}).get("limitFactor"), kind="cpu",
+    )
+    memory_limit = compute_limit(
+        memory, fv(body, defaults, "memoryLimit", optional=True),
+        sections.get("memory", {}).get("limitFactor"), kind="memory",
+    )
     nb = api.notebook(
         name,
         namespace,
         image=fv(body, defaults, "image"),
-        cpu=str(fv(body, defaults, "cpu")),
-        memory=str(fv(body, defaults, "memory")),
+        cpu=cpu,
+        memory=memory,
+        cpu_limit=cpu_limit,
+        memory_limit=memory_limit,
         annotations=annotations,
         labels={c: "true" for c in fv(body, defaults, "configurations") or []},
         **tpu_kwargs,

@@ -35,6 +35,10 @@ DEFAULT_CONFIG: dict = {
         "imagePullPolicy": {"value": "IfNotPresent", "readOnly": False},
         "cpu": {"value": "0.5", "limitFactor": "1.2", "readOnly": False},
         "memory": {"value": "1.0Gi", "limitFactor": "1.2", "readOnly": False},
+        # explicit limit overrides (ref form.py:123-128); empty value =
+        # "derive from limitFactor"; set readOnly to pin alongside cpu/memory
+        "cpuLimit": {"value": "", "readOnly": False},
+        "memoryLimit": {"value": "", "readOnly": False},
         "workspaceVolume": {
             "value": {
                 "mount": "/home/jovyan",

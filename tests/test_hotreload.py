@@ -119,6 +119,7 @@ class TestCertWatcher:
             assert _peer_cn(port) == "cert-two"
         finally:
             server.shutdown()
+            server.server_close()
 
 
 class TestFileWatcher:

@@ -180,6 +180,71 @@ class TestJupyterApp:
             "readOnly group must be applied regardless of the user's value"
         )
 
+    def test_limit_factor_scales_limits(self, platform):
+        """The config's limitFactor (1.2 by default) must reach the
+        container limits (ref form.py:117-175) — it was dead config."""
+        cluster, _ = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "lim", "cpu": "0.5", "memory": "1.0Gi"},
+            headers=auth(client),
+        )
+        assert get_json_body(r)["success"], r.get_data()
+        res = cluster.get("Notebook", "lim", "alice")["spec"]["template"][
+            "spec"]["containers"][0]["resources"]
+        assert res["requests"] == {"cpu": "0.5", "memory": "1.0Gi"}
+        assert res["limits"]["cpu"] == "0.6"
+        assert res["limits"]["memory"] == "1.2Gi"
+
+    def test_explicit_limits_override_factor(self, platform):
+        cluster, _ = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "lim2", "cpu": "500m", "memory": "512Mi",
+                  "cpuLimit": "2", "memoryLimit": "2Gi"},
+            headers=auth(client),
+        )
+        assert get_json_body(r)["success"], r.get_data()
+        res = cluster.get("Notebook", "lim2", "alice")["spec"]["template"][
+            "spec"]["containers"][0]["resources"]
+        assert res["limits"] == {"cpu": "2", "memory": "2Gi"}
+
+    def test_decimal_si_quantities_accepted(self, platform):
+        """k8s decimal-SI forms (1G, 500M) are valid quantities and must not
+        400 under the default limitFactor."""
+        cluster, _ = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "si", "memory": "1G", "memoryLimit": "2G"},
+            headers=auth(client),
+        )
+        assert get_json_body(r)["success"], r.get_data()
+        res = cluster.get("Notebook", "si", "alice")["spec"]["template"][
+            "spec"]["containers"][0]["resources"]
+        assert res["limits"]["memory"] == "2G"
+
+    def test_factor_rounding_never_lands_below_request(self, platform):
+        from kubeflow_tpu.webapps.jupyter import compute_limit
+
+        # round(1.555*1.0, 2) = 1.55 < request: must clamp, not 400
+        assert compute_limit("1.555Gi", None, "1", kind="memory") == "1.555Gi"
+        assert compute_limit("0.5", None, "1.2", kind="cpu") == "0.6"
+        assert compute_limit("1.0Gi", None, "none", kind="memory") is None
+
+    def test_limit_below_request_is_400(self, platform):
+        cluster, _ = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "lim3", "cpu": "1", "cpuLimit": "0.5"},
+            headers=auth(client),
+        )
+        assert r.status_code == 400
+        assert "limit" in get_json_body(r)["log"]
+
     def test_invalid_tpu_topology_is_400(self, platform):
         cluster, m = platform
         client = Client(jupyter.create_app(cluster))

@@ -156,7 +156,9 @@ def main() -> None:
     server, watcher = make_server_with_tls(cluster, port, cert_dir)
     if watcher is not None:
         watcher.start()
-    log.info("webhook serving on :%d", port)
+    # PORT=0 binds an ephemeral port; log the REAL one so harnesses can
+    # parse it (avoids the pick-a-free-port TOCTOU race)
+    log.info("webhook serving on :%d", server.server_address[1])
     server.serve_forever()
 
 

@@ -8,9 +8,11 @@ conformance apiserver over real HTTP, and watch it reconcile. A manifest
 defect (dangling ConfigMap ref, wrong module path, bad env) turns this red;
 kustomize-build alone would stay green.
 """
+import contextlib
 import os
 import subprocess
 import sys
+import threading
 from pathlib import Path
 
 import pytest
@@ -66,50 +68,73 @@ class TestRenderedShapes:
             resolve_container_env(objs, broken, "manager")
 
 
+@contextlib.contextmanager
+def boot_rendered(dep_name: str, container: str, extra_env: dict):
+    """Boot a rendered Deployment's command as a subprocess against a fresh
+    conformance apiserver, with the envFrom-resolved env plus extras.
+
+    Yields (proc, out_lines, client). Guarantees: stdout drained (a
+    log-spamming child can't block on a full pipe), terminate→kill
+    escalation, and server/client teardown even when wait() times out.
+    """
+    objs = render(REPO / "manifests" / "overlays" / "standalone")
+    dep = find(objs, "Deployment", dep_name)
+    ctr = dep["spec"]["template"]["spec"]["containers"][0]
+    assert ctr["name"] == container
+    assert ctr["command"][:2] == ["python", "-m"]
+    env = resolve_container_env(objs, dep, container)
+
+    server = APIServer()
+    base = server.start()
+    client = KubeClient(base_url=base, token="deploy-shape")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", ctr["command"][2]],
+        env={**os.environ, **env, "KUBE_API_BASE_URL": base,
+             "JAX_PLATFORMS": "cpu", **extra_env},
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    out_lines: list[str] = []
+
+    def _drain():
+        for line in proc.stdout:
+            out_lines.append(line)
+
+    threading.Thread(target=_drain, daemon=True).start()
+    try:
+        yield proc, out_lines, client
+    finally:
+        try:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+        finally:
+            client.stop()
+            server.stop()
+
+
+def _diagnose(proc, out_lines, what: str):
+    if proc.poll() is not None:
+        raise AssertionError(
+            f"{what} exited {proc.returncode}:\n" + "".join(out_lines)[-2000:]
+        )
+
+
 class TestControllerBootsFromRenderedShape:
     def test_reconciles_against_conformance_apiserver(self):
-        objs = render(REPO / "manifests" / "overlays" / "standalone")
-        dep = find(objs, "Deployment", "kubeflow-tpu-controller")
-        ctr = dep["spec"]["template"]["spec"]["containers"][0]
-        env = resolve_container_env(objs, dep, "manager")
-
-        server = APIServer()
-        base = server.start()
-        client = KubeClient(base_url=base, token="deploy-shape")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", ctr["command"][2]],
-            env={
-                **os.environ,
-                **env,
-                "KUBE_API_BASE_URL": base,
-                "OPS_PORT": "0",
-                "JAX_PLATFORMS": "cpu",
-            },
-            cwd=REPO,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
-        # drain stdout continuously: a log-spamming failure mode would fill
-        # the 64 KiB pipe and BLOCK the controller, hiding its own error
-        out_lines: list[str] = []
-        import threading
-
-        def _drain():
-            for line in proc.stdout:
-                out_lines.append(line)
-
-        threading.Thread(target=_drain, daemon=True).start()
-        try:
+        with boot_rendered(
+            "kubeflow-tpu-controller", "manager", {"OPS_PORT": "0"}
+        ) as (proc, out_lines, client):
             client.create(api.profile("team-a", "alice@x.io"))
-            nb = api.notebook("shape-nb", "team-a")
-            client.create(nb)
+            client.create(api.notebook("shape-nb", "team-a"))
+
             def sts_or_diagnose():
-                if proc.poll() is not None:
-                    raise AssertionError(
-                        f"controller exited {proc.returncode}:\n"
-                        + "".join(out_lines)[-2000:]
-                    )
+                _diagnose(proc, out_lines, "controller")
                 return client.try_get("StatefulSet", "shape-nb", "team-a")
 
             try:
@@ -121,14 +146,68 @@ class TestControllerBootsFromRenderedShape:
                 )
             assert sts["spec"]["replicas"] == 1
             # profile reconcile provisioned the namespace too
-            assert eventually(
-                lambda: client.try_get("Namespace", "team-a")
-            )
-        finally:
-            proc.terminate()
-            proc.wait(timeout=10)
-            client.stop()
-            server.stop()
+            assert eventually(lambda: client.try_get("Namespace", "team-a"))
+
+
+class TestWebhookBootsFromRenderedShape:
+    def test_serves_admission_over_https(self, tmp_path):
+        """Boot the webhook exactly as its Deployment describes it: same
+        command, the cert mount path from the manifest (CERT_DIR), and an
+        AdmissionReview over real HTTPS. PORT=0 + parsing the logged bound
+        port avoids the pick-a-free-port TOCTOU race."""
+        import json
+        import re
+        import time
+
+        import requests
+
+        objs = render(REPO / "manifests" / "overlays" / "standalone")
+        dep = find(objs, "Deployment", "kubeflow-tpu-webhook")
+        ctr = dep["spec"]["template"]["spec"]["containers"][0]
+        # the manifest mounts the cert Secret here; the test plays kubelet
+        assert ctr["volumeMounts"][0]["mountPath"] == "/etc/webhook/certs"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", f"{tmp_path}/tls.key", "-out", f"{tmp_path}/tls.crt",
+             "-days", "1", "-subj", "/CN=webhook"],
+            check=True, capture_output=True,
+        )
+        review = {
+            "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {
+                "uid": "u-1",
+                "object": {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "p", "namespace": "ns"},
+                    "spec": {"containers": [{"name": "c", "image": "x"}]},
+                },
+            },
+        }
+        with boot_rendered(
+            "kubeflow-tpu-webhook", "webhook",
+            {"CERT_DIR": str(tmp_path), "PORT": "0"},
+        ) as (proc, out_lines, _client):
+            def bound_port():
+                _diagnose(proc, out_lines, "webhook")
+                m = re.search(r"serving on :(\d+)", "".join(out_lines))
+                return int(m.group(1)) if m else None
+
+            port = eventually(bound_port, timeout=30)
+            deadline = time.time() + 30
+            resp = None
+            while time.time() < deadline:
+                _diagnose(proc, out_lines, "webhook")
+                try:
+                    resp = requests.post(
+                        f"https://127.0.0.1:{port}/apply-poddefault",
+                        json=review, verify=False, timeout=3,
+                    )
+                    break
+                except requests.exceptions.ConnectionError:
+                    time.sleep(0.2)
+            assert resp is not None, "webhook never came up"
+            body = resp.json()
+            assert body["response"]["allowed"] is True, json.dumps(body)
 
 
 class TestAstLintGate:

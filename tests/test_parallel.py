@@ -115,3 +115,60 @@ def test_end_to_end_env_matches_bootstrap(cluster, monkeypatch):
     assert ctx["num_processes"] == 16  # 64 chips / 4 per host
     assert ctx["hostnames"][0] == "nb-0.nb-tpu.ns.svc.cluster.local"
     assert ctx["topology"] == "4x4x4"
+
+
+def test_ring_attention_compiles_to_a_true_ring():
+    """The seq-parallel path must move KV chunks by collective-permute (a
+    ring), never all-gather the full sequence — the whole point of ring
+    attention is O(S/P) resident KV (BASELINE.md round-3 HLO evidence)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeflow_tpu.parallel import mesh as meshlib
+    from kubeflow_tpu.parallel.ring_attention import ring_attention
+
+    mesh = meshlib.create_mesh(meshlib.MeshPlan(seq=8))
+    rng = np.random.default_rng(0)
+    B, S, H, D = 2, 1024, 4, 64
+    q, k, v = (
+        jax.device_put(
+            jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32),
+            NamedSharding(mesh, P(None, "seq")),
+        )
+        for _ in range(3)
+    )
+
+    def loss(q, k, v):
+        return ring_attention(
+            q, k, v, mesh, axis_name="seq", causal=True, block=128
+        ).astype(jnp.float32).sum()
+
+    with mesh:
+        txt = (
+            jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            .lower(q, k, v)
+            .compile()
+            .as_text()
+        )
+    # accept sync and async spellings (TPU emits -start/-done pairs)
+    assert "collective-permute" in txt
+    # an all-gather of a [B, S, H, D]-sized operand would defeat the ring;
+    # small bookkeeping gathers are fine, full-sequence ones are not.
+    # Parse EVERY shape in the result (tuple-typed/combined gathers too).
+    full_elems = B * S * H * D
+    import re
+
+    for line in txt.splitlines():
+        s = line.strip()
+        if "get-tuple-element" in s or "= " not in s:
+            continue
+        if not re.search(r" all-gather(-start)?\(", s):
+            continue
+        result = s.split("= ", 1)[1].split(" all-gather", 1)[0]
+        for m in re.finditer(r"\w+\[([\d,]+)\]", result):
+            n = 1
+            for d in m.group(1).split(","):
+                n *= int(d)
+            assert n < full_elems, f"full-sequence all-gather: {s[:160]}"

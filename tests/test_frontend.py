@@ -367,7 +367,7 @@ class TestDomContract:
             # trailing  + var      ->  X inside the literal
             expr = re.sub(r'"\s*\+\s*[^"+]+$', 'X"', expr)
             lits = re.findall(r'"([^"]*)"', expr)
-            url = "".join(lits)
+            url = "".join(lits).split("?", 1)[0]  # routes ignore the query
             return "/" + url if url.startswith("api/") else None
 
         def matches_rule(url: str) -> bool:

@@ -37,6 +37,7 @@ RESOURCES: dict[str, tuple[str, str, str, bool]] = {
     "Namespace": ("api", "v1", "namespaces", False),
     "Event": ("api", "v1", "events", True),
     "Secret": ("api", "v1", "secrets", True),
+    "ConfigMap": ("api", "v1", "configmaps", True),
     "ServiceAccount": ("api", "v1", "serviceaccounts", True),
     "ResourceQuota": ("api", "v1", "resourcequotas", True),
     "PersistentVolumeClaim": ("api", "v1", "persistentvolumeclaims", True),

@@ -67,6 +67,7 @@ NATIVE_KINDS = {
     "namespaces": ("Namespace", "", False, True),
     "events": ("Event", "", True, False),
     "secrets": ("Secret", "", True, False),
+    "configmaps": ("ConfigMap", "", True, False),
     "serviceaccounts": ("ServiceAccount", "", True, False),
     "resourcequotas": ("ResourceQuota", "", True, True),
     "persistentvolumeclaims": ("PersistentVolumeClaim", "", True, True),

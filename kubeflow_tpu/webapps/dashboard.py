@@ -16,6 +16,8 @@ Parity with the reference's Express server endpoints
 """
 from __future__ import annotations
 
+import os
+
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.auth.kfam import BindingClient, ProfileClient
 from kubeflow_tpu.auth.rbac import Authorizer, Forbidden
@@ -39,6 +41,12 @@ DEFAULT_LINKS = {
             "link": "/docs/",
         }
     ],
+}
+
+# ref api.ts:88-101 serves whatever JSON the ConfigMap's "settings" key
+# holds; these are the platform defaults overlaid under it
+DEFAULT_SETTINGS = {
+    "DASHBOARD_FORCE_IFRAME": True,
 }
 
 
@@ -229,6 +237,29 @@ def create_app(
     @app.route("/api/dashboard-links")
     def dashboard_links(request):
         return success(None, **(links or DEFAULT_LINKS))
+
+    @app.route("/api/dashboard-settings")
+    def dashboard_settings(request):
+        """Operator-tunable UI settings (ref api.ts:88-101: JSON under the
+        'settings' key of the dashboard ConfigMap). Absent ConfigMap or key
+        → defaults; malformed JSON → 500, like the reference."""
+        import json as _json
+
+        app.current_user(request)
+        cm = cluster.try_get(
+            "ConfigMap", "centraldashboard-config",
+            os.environ.get("POD_NAMESPACE", "kubeflow"),
+        )
+        raw = (cm or {}).get("data", {}).get("settings")
+        if raw is None:
+            return success(None, DASHBOARD_SETTINGS=dict(DEFAULT_SETTINGS))
+        try:
+            settings = _json.loads(raw)
+        except ValueError:
+            raise RuntimeError("Cannot load dashboard settings")
+        return success(None, DASHBOARD_SETTINGS={
+            **DEFAULT_SETTINGS, **settings
+        })
 
     @app.route("/api/metrics/<metric_type>")
     def cluster_metrics(request, metric_type):

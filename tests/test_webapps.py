@@ -524,6 +524,32 @@ class TestDashboardApp:
             assert "alice" in names, factory.__module__
             assert client.get("/api/namespaces").status_code == 401
 
+    def test_dashboard_settings_from_configmap(self, platform):
+        """ref api.ts:88-101: settings JSON from the dashboard ConfigMap,
+        defaults when absent, 500 on malformed JSON."""
+        cluster, _ = platform
+        client = Client(dashboard.create_app(cluster))
+        r = client.get("/api/dashboard-settings", headers=ALICE)
+        body = get_json_body(r)
+        assert body["DASHBOARD_SETTINGS"]["DASHBOARD_FORCE_IFRAME"] is True
+
+        cluster.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "centraldashboard-config",
+                         "namespace": "kubeflow"},
+            "data": {"settings": '{"theme": "dark"}'},
+        })
+        r = client.get("/api/dashboard-settings", headers=ALICE)
+        body = get_json_body(r)
+        assert body["DASHBOARD_SETTINGS"]["theme"] == "dark"
+        assert body["DASHBOARD_SETTINGS"]["DASHBOARD_FORCE_IFRAME"] is True
+
+        cm = cluster.get("ConfigMap", "centraldashboard-config", "kubeflow")
+        cm["data"]["settings"] = "{not json"
+        cluster.update(cm)
+        r = client.get("/api/dashboard-settings", headers=ALICE)
+        assert r.status_code == 500
+
     def test_nuke_self_deletes_profile_and_bindings(self, platform):
         cluster, m = platform
         bc = BindingClient(cluster)

@@ -16,6 +16,7 @@ Parity with the reference's Express server endpoints
 """
 from __future__ import annotations
 
+import json
 import os
 
 from kubeflow_tpu.api import types as api
@@ -241,20 +242,21 @@ def create_app(
     @app.route("/api/dashboard-settings")
     def dashboard_settings(request):
         """Operator-tunable UI settings (ref api.ts:88-101: JSON under the
-        'settings' key of the dashboard ConfigMap). Absent ConfigMap or key
-        → defaults; malformed JSON → 500, like the reference."""
-        import json as _json
-
+        'settings' key of the dashboard ConfigMap). Absent ConfigMap/key →
+        defaults; malformed-or-non-object JSON → controlled 500, like the
+        reference's invalid_settings error."""
         app.current_user(request)
         cm = cluster.try_get(
             "ConfigMap", "centraldashboard-config",
             os.environ.get("POD_NAMESPACE", "kubeflow"),
         )
-        raw = (cm or {}).get("data", {}).get("settings")
+        raw = ((cm or {}).get("data") or {}).get("settings")
         if raw is None:
             return success(None, DASHBOARD_SETTINGS=dict(DEFAULT_SETTINGS))
         try:
-            settings = _json.loads(raw)
+            settings = json.loads(raw)
+            if not isinstance(settings, dict):
+                raise ValueError("settings must be a JSON object")
         except ValueError:
             raise RuntimeError("Cannot load dashboard settings")
         return success(None, DASHBOARD_SETTINGS={

@@ -550,6 +550,18 @@ class TestDashboardApp:
         r = client.get("/api/dashboard-settings", headers=ALICE)
         assert r.status_code == 500
 
+        # valid-but-non-object JSON is the same controlled 500, and an
+        # explicit null data block falls back to defaults (not a crash)
+        cm = cluster.get("ConfigMap", "centraldashboard-config", "kubeflow")
+        cm["data"]["settings"] = "[1, 2]"
+        cluster.update(cm)
+        assert client.get("/api/dashboard-settings", headers=ALICE).status_code == 500
+        cm = cluster.get("ConfigMap", "centraldashboard-config", "kubeflow")
+        cm["data"] = None
+        cluster.update(cm)
+        r = client.get("/api/dashboard-settings", headers=ALICE)
+        assert get_json_body(r)["DASHBOARD_SETTINGS"]["DASHBOARD_FORCE_IFRAME"] is True
+
     def test_nuke_self_deletes_profile_and_bindings(self, platform):
         cluster, m = platform
         bc = BindingClient(cluster)

@@ -32,6 +32,21 @@ def cross_entropy_loss(logits, labels):
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
 
 
+def _make_bundle(init, make) -> TrainStepBundle:
+    """Shared bundle wiring: init computes (state, shardings); make jits the
+    step for those shardings. One implementation for every step builder."""
+    bundle = TrainStepBundle(init=None, step=None)
+
+    def bundled_init(rng, sample):
+        state, shardings = init(rng, sample)
+        bundle.state_shardings = shardings
+        bundle.step = make(shardings)
+        return state
+
+    bundle.init = bundled_init
+    return bundle
+
+
 def make_classifier_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -104,16 +119,112 @@ def make_classifier_train_step(
             donate_argnums=(0,) if donate else (),
         )
 
-    bundle = TrainStepBundle(init=None, step=None)
+    return _make_bundle(init, make)
 
-    def bundled_init(rng, sample_batch):
-        state, shardings = init(rng, sample_batch)
-        bundle.state_shardings = shardings
-        bundle.step = make(shardings)
-        return state
 
-    bundle.init = bundled_init
-    return bundle
+def make_lm_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    param_rule=meshlib.fsdp_param_spec,
+    loss_fn: Callable | None = None,
+    accum_steps: int = 1,
+    chunk: int = 512,
+    donate: bool = True,
+) -> TrainStepBundle:
+    """Build a sharded LM train step (tokens [B, S] → next-token loss).
+
+    ``loss_fn(params, tokens) -> scalar`` defaults to the chunked tied-head
+    loss for ``TransformerLM``-shaped models (the benches' hand-rolled step,
+    promoted to the library).
+
+    ``accum_steps > 1`` runs gradient accumulation: the global batch is
+    split into A microbatches along dim 0, a ``lax.scan`` accumulates the
+    MEAN gradient in f32 (each microbatch carries equal token count, so the
+    mean of per-microbatch means equals the full-batch gradient), and ONE
+    optimizer update applies. This is how a small chip count trains a large
+    global batch without holding its activations at once — activation
+    memory scales with B/A while optimizer traffic stays per-step.
+    """
+    batch_sh = meshlib.batch_sharding(mesh)
+    repl = meshlib.replicated(mesh)
+
+    if loss_fn is None:
+        from kubeflow_tpu.models.transformer import lm_loss_chunked
+
+        def loss_fn(params, tokens):
+            hidden = model.apply(
+                {"params": params}, tokens, return_hidden=True
+            )
+            return lm_loss_chunked(
+                hidden, params["embed"]["embedding"], tokens, chunk=chunk
+            )
+
+    def init(rng, sample_tokens):
+        def init_fn(rng, tokens):
+            params = model.init(rng, tokens)["params"]
+            return {
+                "params": params,
+                "opt_state": tx.init(params),
+                "step": jnp.zeros((), jnp.int32),
+            }
+
+        abstract = jax.eval_shape(init_fn, rng, sample_tokens)
+        shardings = _state_shardings(abstract, mesh, param_rule)
+        state = jax.jit(init_fn, out_shardings=shardings)(rng, sample_tokens)
+        return state, shardings
+
+    def grads_of(params, tokens):
+        return jax.value_and_grad(loss_fn)(params, tokens)
+
+    def train_step(state, tokens):
+        if accum_steps == 1:
+            loss, grads = grads_of(state["params"], tokens)
+        else:
+            B = tokens.shape[0]
+            if B % accum_steps:
+                raise ValueError(
+                    f"accum_steps {accum_steps} must divide batch {B}"
+                )
+            micro = tokens.reshape(accum_steps, B // accum_steps, *tokens.shape[1:])
+
+            def body(acc, mb):
+                loss_acc, grad_acc = acc
+                loss, grads = grads_of(state["params"], mb)
+                grad_acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32) / accum_steps,
+                    grad_acc, grads,
+                )
+                return (loss_acc + loss / accum_steps, grad_acc), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
+            )
+            (loss, grads), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zeros), micro
+            )
+            grads = jax.tree_util.tree_map(
+                lambda g, p: g.astype(p.dtype), grads, state["params"]
+            )
+        updates, new_opt_state = tx.update(
+            grads, state["opt_state"], state["params"]
+        )
+        return {
+            "params": optax.apply_updates(state["params"], updates),
+            "opt_state": new_opt_state,
+            "step": state["step"] + 1,
+        }, {"loss": loss}
+
+    def make(state_shardings):
+        return jax.jit(
+            train_step,
+            in_shardings=(state_shardings, batch_sh),
+            out_shardings=(state_shardings, {"loss": repl}),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    return _make_bundle(init, make)
 
 
 def optimizer_state_shardings(abstract_opt_state, abstract_params, param_sh, repl):
@@ -135,19 +246,20 @@ def optimizer_state_shardings(abstract_opt_state, abstract_params, param_sh, rep
 
 
 def _state_shardings(abstract_state, mesh, param_rule):
-    """Shard params and matching optimizer slots by the rule; replicate rest."""
+    """Shard params and matching optimizer slots by the rule; replicate rest
+    (any extra slots — batch_stats, step counters — are replicated)."""
     param_sh = meshlib.param_shardings(mesh, abstract_state["params"], param_rule)
     repl = meshlib.replicated(mesh)
-    return {
+    out = {
         "params": param_sh,
-        "batch_stats": jax.tree_util.tree_map(
-            lambda _: repl, abstract_state["batch_stats"]
-        ),
         "opt_state": optimizer_state_shardings(
             abstract_state["opt_state"], abstract_state["params"], param_sh, repl
         ),
-        "step": repl,
     }
+    for key, sub in abstract_state.items():
+        if key not in out:
+            out[key] = jax.tree_util.tree_map(lambda _: repl, sub)
+    return out
 
 
 def _map_matching_subtrees(tree, assign, default):

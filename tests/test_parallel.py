@@ -172,3 +172,73 @@ def test_ring_attention_compiles_to_a_true_ring():
             for d in m.group(1).split(","):
                 n *= int(d)
             assert n < full_elems, f"full-sequence all-gather: {s[:160]}"
+
+
+class TestLMTrainStep:
+    def _setup(self, accum_steps, plan=None):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+        from kubeflow_tpu.parallel import mesh as meshlib
+        from kubeflow_tpu.parallel.train import make_lm_train_step
+
+        mesh = meshlib.create_mesh(plan or meshlib.MeshPlan(data=8))
+        cfg = TransformerConfig(
+            vocab_size=97, num_layers=2, num_heads=4, embed_dim=64,
+            mlp_dim=128, max_seq_len=32, attention_impl="xla",
+            dtype=jnp.float32,
+        )
+        model = TransformerLM(cfg)
+        tx = optax.sgd(0.1)
+        bundle = make_lm_train_step(
+            model, tx, mesh, accum_steps=accum_steps, donate=False
+        )
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 97, (8, 32)), jnp.int32
+        )
+        tokens = jax.device_put(
+            tokens, NamedSharding(mesh, P(("data", "fsdp")))
+        )
+        state = bundle.init(jax.random.PRNGKey(0), tokens)
+        return bundle, state, tokens
+
+    def test_accumulated_grads_match_full_batch(self):
+        import jax
+        import numpy as np
+
+        full_b, state_f, tokens = self._setup(1)
+        accum_b, state_a, _ = self._setup(4)
+        s1, m1 = full_b.step(state_f, tokens)
+        s4, m4 = accum_b.step(state_a, tokens)
+        np.testing.assert_allclose(
+            float(m1["loss"]), float(m4["loss"]), rtol=1e-5
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(s1["params"]),
+            jax.tree_util.tree_leaves(s4["params"]),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            )
+
+    def test_sharded_fsdp_runs(self):
+        from kubeflow_tpu.parallel import mesh as meshlib
+        import numpy as np
+
+        bundle, state, tokens = self._setup(
+            2, plan=meshlib.MeshPlan(data=2, fsdp=4)
+        )
+        state, metrics = bundle.step(state, tokens)
+        assert np.isfinite(float(metrics["loss"]))
+        assert int(state["step"]) == 1
+
+    def test_indivisible_batch_rejected(self):
+        import pytest
+
+        bundle, state, tokens = self._setup(3)  # 8 % 3 != 0
+        with pytest.raises(ValueError, match="divide"):
+            bundle.step(state, tokens)

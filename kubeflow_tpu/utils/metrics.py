@@ -88,6 +88,17 @@ class Registry:
         return self._add(_Metric(name, help_, "gauge"))
 
     def _add(self, m: _Metric) -> _Metric:
+        # same-name registration returns the existing family (two Apps
+        # sharing one registry must not emit duplicate metric families —
+        # strict Prometheus scrapers reject that exposition)
+        for existing in self._metrics:
+            if existing.name == m.name:
+                if existing.kind != m.kind:
+                    raise ValueError(
+                        f"metric {m.name!r} already registered as "
+                        f"{existing.kind}, not {m.kind}"
+                    )
+                return existing
         self._metrics.append(m)
         return m
 

@@ -64,18 +64,26 @@ class App:
         self.userid_header = userid_header
         self.userid_prefix = userid_prefix
         self.csrf_protect = csrf_protect
+        # every app exposes /metrics with request/error counters, like the
+        # reference's per-service prometheus wiring (kfam/monitoring.go:24-45,
+        # profile-controller monitoring.go:25-60); domain registries
+        # (NotebookMetrics) plug in via metrics_registry
+        if metrics_registry is None:
+            metrics_registry = Registry()
         self.metrics_registry = metrics_registry
+        self._requests_total = metrics_registry.counter(
+            "http_requests_total", "HTTP requests served, by method and code"
+        )
         self.url_map = Map()
         self.endpoints: dict[str, Callable] = {}
         # probes (ref probes.py:8-17)
         self.route("/healthz/liveness")(lambda req: success("message", "alive"))
         self.route("/healthz/readiness")(lambda req: success("message", "ready"))
-        if metrics_registry is not None:
-            self.route("/metrics")(
-                lambda req: Response(
-                    metrics_registry.expose(), mimetype="text/plain"
-                )
+        self.route("/metrics")(
+            lambda req: Response(
+                metrics_registry.expose(), mimetype="text/plain"
             )
+        )
 
     def route(self, rule: str, methods: tuple[str, ...] = ("GET",)):
         def deco(fn):
@@ -160,6 +168,11 @@ class App:
         try:
             csrf_fail = self._check_csrf(request)
             if csrf_fail is not None:
+                # count before the early return: CSRF rejections are an
+                # attack-indicating error class /metrics must surface
+                self._requests_total.inc(
+                    method=request.method, code=str(csrf_fail.status_code)
+                )
                 return csrf_fail(environ, start_response)
             endpoint, args = adapter.match()
             response = self.endpoints[endpoint](request, **args)
@@ -181,6 +194,9 @@ class App:
             response = error(e.code or 500, e.description or str(e))
         except Exception:
             response = error(500, traceback.format_exc(limit=3))
+        self._requests_total.inc(
+            method=request.method, code=str(response.status_code)
+        )
         # seed the CSRF cookie on safe responses (double-submit bootstrap)
         if (
             self.csrf_protect

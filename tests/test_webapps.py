@@ -524,6 +524,44 @@ class TestDashboardApp:
             assert "alice" in names, factory.__module__
             assert client.get("/api/namespaces").status_code == 401
 
+    def test_every_app_counts_requests_on_metrics(self, platform):
+        """ref per-service prometheus wiring (kfam/monitoring.go:24-45):
+        each app exposes /metrics with request counters by method/code."""
+        cluster, _ = platform
+        for factory in (jupyter.create_app, volumes.create_app,
+                        tensorboards.create_app, kfam_app.create_app,
+                        dashboard.create_app):
+            client = Client(factory(cluster))
+            client.get("/healthz/liveness")
+            client.get("/no-such-route", headers=ALICE)
+            text = client.get("/metrics").get_data(as_text=True)
+            assert 'http_requests_total{code="200",method="GET"}' in text, (
+                factory.__module__
+            )
+            assert 'code="404"' in text
+
+    def test_csrf_rejections_are_counted(self, platform):
+        cluster, _ = platform
+        client = Client(jupyter.create_app(cluster))
+        client.post(
+            "/api/namespaces/alice/notebooks", json={"name": "x"},
+            headers={**ALICE, "X-XSRF-TOKEN": "wrong"},
+        )
+        text = client.get("/metrics").get_data(as_text=True)
+        assert 'http_requests_total{code="403",method="POST"}' in text
+
+    def test_shared_registry_has_one_request_family(self, platform):
+        from kubeflow_tpu.utils.metrics import Registry
+
+        cluster, _ = platform
+        reg = Registry()
+        # two apps on one registry must not duplicate the family
+        from kubeflow_tpu.webapps.base import App
+
+        App("one", csrf_protect=False, metrics_registry=reg)
+        App("two", csrf_protect=False, metrics_registry=reg)
+        assert reg.expose().count("# TYPE http_requests_total counter") == 1
+
     def test_dashboard_settings_from_configmap(self, platform):
         """ref api.ts:88-101: settings JSON from the dashboard ConfigMap,
         defaults when absent, 500 on malformed JSON."""

@@ -1,1 +1,61 @@
-"""TPU-native notebook platform."""
+"""TPU-native notebook platform.
+
+Two halves, one package:
+
+- **Control plane** (no jax import required): CRD types and reconcilers for
+  Notebook/Profile/Tensorboard, admission webhooks, the web-app backends,
+  and the runtime (manager, workqueue, clients).
+- **Compute plane** (jax/flax/pallas): models, kernels, mesh/sharding rules,
+  training-step builders, decoding.
+
+Top-level names below lazy-import on first access, so importing
+``kubeflow_tpu`` stays cheap for control-plane processes that never touch
+jax — and vice versa.
+"""
+from __future__ import annotations
+
+import importlib
+
+# public name -> defining module (lazy; see __getattr__)
+_EXPORTS = {
+    # control plane
+    "FakeCluster": "kubeflow_tpu.runtime.fake",
+    "KubeClient": "kubeflow_tpu.runtime.kubeclient",
+    "Manager": "kubeflow_tpu.runtime.manager",
+    "NotebookReconciler": "kubeflow_tpu.controllers.notebook_controller",
+    "ProfileReconciler": "kubeflow_tpu.controllers.profile_controller",
+    "TensorboardReconciler": "kubeflow_tpu.controllers.tensorboard_controller",
+    "ControllerConfig": "kubeflow_tpu.utils.config",
+    # compute plane
+    "MeshPlan": "kubeflow_tpu.parallel.mesh",
+    "create_mesh": "kubeflow_tpu.parallel.mesh",
+    "make_classifier_train_step": "kubeflow_tpu.parallel.train",
+    "make_lm_train_step": "kubeflow_tpu.parallel.train",
+    "TransformerConfig": "kubeflow_tpu.models.transformer",
+    "TransformerLM": "kubeflow_tpu.models.transformer",
+    "MoEConfig": "kubeflow_tpu.models.moe",
+    "MoETransformerLM": "kubeflow_tpu.models.moe",
+    "ResNet50": "kubeflow_tpu.models.resnet",
+    "generate": "kubeflow_tpu.models.decoding",
+    "decode_config": "kubeflow_tpu.models.decoding",
+    "flash_attention": "kubeflow_tpu.ops.pallas_attention",
+    "flash_decode": "kubeflow_tpu.ops.flash_decode",
+    "ring_attention": "kubeflow_tpu.parallel.ring_attention",
+    "adamw_lowmem": "kubeflow_tpu.ops.optimizers",
+    "with_f32_master": "kubeflow_tpu.ops.optimizers",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(importlib.import_module(module), name)
+    globals()[name] = value  # cache for subsequent lookups
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

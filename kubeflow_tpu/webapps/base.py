@@ -79,9 +79,12 @@ class App:
         # probes (ref probes.py:8-17)
         self.route("/healthz/liveness")(lambda req: success("message", "alive"))
         self.route("/healthz/readiness")(lambda req: success("message", "ready"))
+        # closes over self, not the constructor local: swapping
+        # app.metrics_registry later would otherwise silently diverge from
+        # what /metrics serves
         self.route("/metrics")(
             lambda req: Response(
-                metrics_registry.expose(), mimetype="text/plain"
+                self.metrics_registry.expose(), mimetype="text/plain"
             )
         )
 

@@ -99,3 +99,28 @@ class TestLoadtest:
         assert result["value"] > 0
         # cleanup happened
         assert cluster.list("Notebook", "demo") == []
+
+
+class TestTopLevelAPI:
+    def test_every_export_resolves(self):
+        import kubeflow_tpu
+
+        for name in kubeflow_tpu.__all__:
+            assert getattr(kubeflow_tpu, name) is not None, name
+
+    def test_control_plane_import_stays_light(self):
+        """Importing the package (or a control-plane symbol) must not drag
+        in the compute stack — controller pods don't ship accelerators.
+        (This image's sitecustomize preloads jax itself, so the probe checks
+        OUR compute modules rather than jax.)"""
+        import subprocess, sys
+
+        code = (
+            "import sys, kubeflow_tpu;"
+            "kubeflow_tpu.ControllerConfig;"
+            "heavy = [m for m in sys.modules"
+            " if m.startswith(('kubeflow_tpu.models', 'kubeflow_tpu.ops',"
+            " 'kubeflow_tpu.parallel'))];"
+            "assert not heavy, heavy"
+        )
+        subprocess.run([sys.executable, "-c", code], check=True)

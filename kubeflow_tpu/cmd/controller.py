@@ -112,6 +112,12 @@ def build_manager(
     manager.register(NotebookReconciler(cfg, culler=culler, metrics=metrics))
     manager.register(ProfileReconciler())
     manager.register(TensorboardReconciler(cfg))
+    if cfg.enable_oauth_controller:
+        # OpenShift companion (ref odh-notebook-controller): the openshift
+        # overlay's ENABLE_OAUTH_CONTROLLER env was dead until this wired it
+        from kubeflow_tpu.controllers.oauth_controller import OAuthReconciler
+
+        manager.register(OAuthReconciler())
     return manager, metrics
 
 

@@ -7,6 +7,8 @@ matching ``manifests/base/webhook.yaml``:
 
   /apply-poddefault   PodDefault merge (webhooks/poddefaults.py)
   /inject-tpu-env     TPU worker identity (webhooks/tpu_env.py)
+  /inject-oauth       OpenShift oauth-proxy sidecar (oauth_controller.py;
+                      registered by the openshift overlay's webhook config)
   /convert            CRD multi-version ConversionReview
                       (webhooks/conversion.py; ref notebook_conversion.go)
 """
@@ -79,6 +81,15 @@ def make_wsgi_app(cluster):
                 mutated = poddefaults.mutator(obj, cluster)
             elif request.path == "/inject-tpu-env":
                 mutated = tpu_mutate(obj, cluster)
+            elif request.path == "/inject-oauth":
+                # OpenShift companion webhook (ref notebook_webhook.go
+                # Handle/InjectOAuthProxy): oauth-proxy sidecar for
+                # annotated Notebooks; registered by the openshift overlay
+                from kubeflow_tpu.controllers.oauth_controller import (
+                    inject_oauth_proxy,
+                )
+
+                mutated = inject_oauth_proxy(obj, cluster)
             else:
                 resp = Response("not found", status=404)
                 return resp(environ, start_response)

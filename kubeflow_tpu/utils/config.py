@@ -49,6 +49,10 @@ class ControllerConfig:
     # Profile defaults (ref --namespace-labels-path flag, profile-controller
     # main.go; the mounted file is hot-reloaded, go:356-405)
     namespace_labels_path: str = ""
+    # OpenShift companion controller (ref odh-notebook-controller): OAuth
+    # sidecar objects for annotated Notebooks; the openshift overlay
+    # enables it via ENABLE_OAUTH_CONTROLLER
+    enable_oauth_controller: bool = False
 
     @classmethod
     def from_env(cls) -> "ControllerConfig":
@@ -64,4 +68,5 @@ class ControllerConfig:
             dev=_env_bool("DEV", False),
             tpu_gang_schedule=_env_bool("TPU_GANG_SCHEDULE", True),
             namespace_labels_path=os.environ.get("NAMESPACE_LABELS_PATH", ""),
+            enable_oauth_controller=_env_bool("ENABLE_OAUTH_CONTROLLER", False),
         )

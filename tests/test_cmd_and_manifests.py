@@ -47,6 +47,29 @@ class TestAdmissionReviewServer:
         assert env["TPU_WORKER_ID"] == "1"
         assert env["JAX_NUM_PROCESSES"] == "2"
 
+    def test_inject_oauth_sidecar_roundtrip(self, cluster):
+        """The OpenShift overlay's /inject-oauth path: annotated Notebooks
+        get the oauth-proxy sidecar patched in (ref notebook_webhook.go)."""
+        from kubeflow_tpu.api import types as api
+        from kubeflow_tpu.controllers.oauth_controller import INJECT_ANNOTATION
+
+        client = Client(make_wsgi_app(cluster))
+        nb = api.notebook(
+            "os-nb", "team-os", annotations={INJECT_ANNOTATION: "true"}
+        )
+        r = client.post("/inject-oauth", json=self._review(nb))
+        resp = r.get_json()["response"]
+        assert resp["allowed"] is True
+        patch = json.loads(base64.b64decode(resp["patch"]))
+        ops = [op for op in patch if op["path"] == "/spec/template/spec/containers"]
+        names = [c["name"] for c in ops[0]["value"]]
+        assert "oauth-proxy" in names
+        # unannotated notebooks pass through untouched (no patch)
+        r = client.post(
+            "/inject-oauth", json=self._review(api.notebook("plain", "ns"))
+        )
+        assert "patch" not in r.get_json()["response"]
+
     def test_poddefault_denial(self, cluster):
         cluster.create(
             api.pod_default(

@@ -69,7 +69,8 @@ class TestRenderedShapes:
 
 
 @contextlib.contextmanager
-def boot_rendered(dep_name: str, container: str, extra_env: dict):
+def boot_rendered(dep_name: str, container: str, extra_env: dict,
+                  overlay: str = "standalone"):
     """Boot a rendered Deployment's command as a subprocess against a fresh
     conformance apiserver, with the envFrom-resolved env plus extras.
 
@@ -77,7 +78,7 @@ def boot_rendered(dep_name: str, container: str, extra_env: dict):
     log-spamming child can't block on a full pipe), terminate→kill
     escalation, and server/client teardown even when wait() times out.
     """
-    objs = render(REPO / "manifests" / "overlays" / "standalone")
+    objs = render(REPO / "manifests" / "overlays" / overlay)
     dep = find(objs, "Deployment", dep_name)
     ctr = dep["spec"]["template"]["spec"]["containers"][0]
     assert ctr["name"] == container
@@ -147,6 +148,31 @@ class TestControllerBootsFromRenderedShape:
             assert sts["spec"]["replicas"] == 1
             # profile reconcile provisioned the namespace too
             assert eventually(lambda: client.try_get("Namespace", "team-a"))
+
+    def test_openshift_overlay_runs_the_oauth_controller(self):
+        """The openshift overlay's ENABLE_OAUTH_CONTROLLER env was dead
+        config until round 3: booting from that rendered shape must
+        reconcile OAuth sidecar objects for an annotated Notebook."""
+        with boot_rendered(
+            "kubeflow-tpu-controller", "manager", {"OPS_PORT": "0"},
+            overlay="openshift",
+        ) as (proc, out_lines, client):
+            from kubeflow_tpu.controllers.oauth_controller import (
+                INJECT_ANNOTATION,
+            )
+
+            client.create(api.profile("team-os", "alice@x.io"))
+            client.create(api.notebook(
+                "os-nb", "team-os", annotations={INJECT_ANNOTATION: "true"}
+            ))
+
+            def route_or_diagnose():
+                _diagnose(proc, out_lines, "controller")
+                return client.try_get("Route", "os-nb", "team-os")
+
+            route = eventually(route_or_diagnose, timeout=30)
+            assert route["spec"]["to"]["name"] == "os-nb-tls"
+            assert client.try_get("Secret", "os-nb-oauth-config", "team-os")
 
 
 class TestWebhookBootsFromRenderedShape:

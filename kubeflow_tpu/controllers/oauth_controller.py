@@ -71,7 +71,14 @@ def inject_oauth_proxy(nb: dict, cluster: FakeCluster) -> dict:
         {"name": "oauth-config", "secret": {"secretName": f"{name}-oauth-config"}},
         {"name": "tls-certificates", "secret": {"secretName": f"{name}-tls"}},
     ):
-        if vol not in vols:
+        # dedup by NAME (like the sidecar): a same-named user volume with
+        # different content must be replaced, not duplicated — duplicate
+        # volume names make the pod spec invalid
+        for i, existing in enumerate(vols):
+            if existing.get("name") == vol["name"]:
+                vols[i] = vol
+                break
+        else:
             vols.append(vol)
     return nb
 
@@ -88,6 +95,12 @@ class OAuthReconciler(Reconciler):
         self.cluster_domain = cluster_domain
         # reconciliation-lock gate (ref notebook_controller.go:81-120)
         self.pull_secret_ready = pull_secret_ready
+
+    def watches(self):
+        # repair deleted OAuth objects (ref SetupWithManager Owns() chain):
+        # their ownerReference maps the event back to the Notebook key
+        return [self.owns("Route"), self.owns("Secret"),
+                self.owns("Service"), self.owns("ServiceAccount")]
 
     def reconcile(self, cluster: FakeCluster, namespace: str, name: str) -> Result | None:
         nb = cluster.try_get("Notebook", name, namespace)

@@ -103,7 +103,10 @@ def resolve_container_env(objs: list[dict], deployment: dict,
                           container: str = "") -> dict[str, str]:
     """The env a kubelet would hand the container: envFrom ConfigMaps
     (which must EXIST in the rendered set — a dangling ref blocks pod start
-    on a real cluster and is an error here) overlaid by explicit env."""
+    on a real cluster and is an error here) overlaid by explicit env.
+    Downward-API fieldRefs are resolved from the Deployment's metadata;
+    any other valueFrom is a loud error — silently dropping one would let
+    the deploy-shape gate boot with env the manifest never produces."""
     containers = deployment["spec"]["template"]["spec"]["containers"]
     ctr = next(
         (c for c in containers if not container or c["name"] == container),
@@ -120,4 +123,18 @@ def resolve_container_env(objs: list[dict], deployment: dict,
     for item in ctr.get("env", []):
         if "value" in item:
             env[item["name"]] = str(item["value"])
+            continue
+        field = (
+            item.get("valueFrom", {}).get("fieldRef", {}).get("fieldPath")
+        )
+        if field == "metadata.namespace":
+            env[item["name"]] = deployment["metadata"].get(
+                "namespace", "default"
+            )
+        elif field == "metadata.name":
+            env[item["name"]] = deployment["metadata"]["name"]
+        else:
+            raise ValueError(
+                f"unsupported env source for {item.get('name')!r}: {item!r}"
+            )
     return env

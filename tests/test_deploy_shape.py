@@ -47,6 +47,24 @@ class TestRenderedShapes:
 
             assert importlib.util.find_spec(cmd[2]) is not None, cmd
 
+    def test_downward_api_fieldrefs_resolve(self):
+        """The controller's POD_NAMESPACE fieldRef must resolve to the
+        rendered namespace (not be silently dropped); unknown valueFrom
+        sources are loud errors."""
+        objs = render(REPO / "manifests" / "overlays" / "standalone")
+        dep = find(objs, "Deployment", "kubeflow-tpu-controller")
+        env = resolve_container_env(objs, dep, "manager")
+        assert env["POD_NAMESPACE"] == "kubeflow"
+        assert env["LEADER_ELECT"] == "true"
+        import copy
+
+        broken = copy.deepcopy(dep)
+        broken["spec"]["template"]["spec"]["containers"][0]["env"].append(
+            {"name": "X", "valueFrom": {"secretKeyRef": {"name": "s", "key": "k"}}}
+        )
+        with pytest.raises(ValueError, match="unsupported env source"):
+            resolve_container_env(objs, broken, "manager")
+
     def test_standalone_overlay_disables_istio(self):
         objs = render(REPO / "manifests" / "overlays" / "standalone")
         dep = find(objs, "Deployment", "kubeflow-tpu-controller")

@@ -76,3 +76,35 @@ def test_composes_with_notebook_reconciler(cluster):
     sts = cluster.get("StatefulSet", "nb", "alice")
     names = [c["name"] for c in sts["spec"]["template"]["spec"]["containers"]]
     assert "oauth-proxy" in names  # sidecar flows CR -> pod template
+
+
+def test_deleted_oauth_objects_are_repaired(cluster):
+    """Owns() watches (round 3): deleting a Route/Secret maps back to the
+    Notebook and the reconciler recreates it — level-triggered repair the
+    reference gets from SetupWithManager's Owns() chain."""
+    m = Manager(cluster)
+    m.register(OAuthReconciler())
+    cluster.create(_oauth_nb())
+    m.run_until_idle()
+    cluster.delete("Route", "nb", "alice")
+    cluster.delete("Secret", "nb-oauth-config", "alice")
+    m.run_until_idle()
+    assert cluster.get("Route", "nb", "alice")
+    assert cluster.get("Secret", "nb-oauth-config", "alice")
+
+
+def test_sidecar_injection_replaces_same_named_volumes(cluster):
+    """A pre-existing user volume named like an injected one is REPLACED by
+    name — duplicating the name would make the pod spec invalid."""
+    from kubeflow_tpu.controllers.oauth_controller import inject_oauth_proxy
+
+    nb = _oauth_nb()
+    nb["spec"]["template"]["spec"]["volumes"] = [
+        {"name": "oauth-config", "secret": {"secretName": "user-supplied"}}
+    ]
+    out = inject_oauth_proxy(nb, cluster)
+    vols = out["spec"]["template"]["spec"]["volumes"]
+    names = [v["name"] for v in vols]
+    assert names.count("oauth-config") == 1
+    oauth_vol = next(v for v in vols if v["name"] == "oauth-config")
+    assert oauth_vol["secret"]["secretName"] == "nb-oauth-config"

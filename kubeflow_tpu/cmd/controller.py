@@ -158,24 +158,47 @@ def watch_namespace_labels(path: str, manager: Manager, cluster):
 
 
 def serve_ops(
-    metrics: NotebookMetrics, port: int = 8081, manager: Manager | None = None
-) -> threading.Thread:
-    if manager is not None:
-        wq_gauge = metrics.registry.gauge(
-            "workqueue_stat", "Reconcile workqueue counters (native core)"
+    metrics: NotebookMetrics,
+    port: int = 8081,
+    manager: Manager | None = None,
+    metrics_port: int = 8080,
+) -> list[threading.Thread]:
+    """Ops listeners, split like the reference's bind addresses (main.go:56:
+    metrics-addr :8080, probe-addr :8081): probes on ``port`` — the
+    Deployment's liveness/readiness target, which must stay alive even when
+    metrics are turned off — and the unauthenticated /metrics on
+    ``metrics_port``. 0 disables either listener independently (without the
+    guard make_server would bind an OS-assigned ephemeral port and a
+    listener the operator turned off would still serve)."""
+    threads: list[threading.Thread] = []
+
+    def _spawn(app: App, p: int) -> None:
+        server = make_server("0.0.0.0", p, app)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        threads.append(t)
+
+    if port:
+        _spawn(App("controller-probes", csrf_protect=False), port)
+    if metrics_port:
+        if manager is not None:
+            wq_gauge = metrics.registry.gauge(
+                "workqueue_stat", "Reconcile workqueue counters (native core)"
+            )
+
+            def observe_queue():
+                for k, v in manager.queue_metrics().items():
+                    wq_gauge.set(float(v), stat=k)
+
+            metrics.registry.pre_expose(observe_queue)
+        # count_requests=False: scrape hits are self-monitoring traffic
+        _spawn(
+            App("controller-metrics", csrf_protect=False,
+                metrics_registry=metrics.registry, metrics_public=True,
+                count_requests=False),
+            metrics_port,
         )
-
-        def observe_queue():
-            for k, v in manager.queue_metrics().items():
-                wq_gauge.set(float(v), stat=k)
-
-        metrics.registry.pre_expose(observe_queue)
-    app = App("controller-ops", csrf_protect=False,
-              metrics_registry=metrics.registry)
-    server = make_server("0.0.0.0", port, app)
-    t = threading.Thread(target=server.serve_forever, daemon=True)
-    t.start()
-    return t
+    return threads
 
 
 def main() -> None:
@@ -191,9 +214,16 @@ def main() -> None:
     cfg = ControllerConfig.from_env()
     fleet = FleetKernelFetcher(cluster, cfg)
     manager, metrics = build_manager(cluster, cfg, fetch_kernels=fleet)
-    serve_ops(
-        metrics, port=int(os.environ.get("OPS_PORT", "8081")), manager=manager
-    )
+    ops_port = int(os.environ.get("OPS_PORT", "8081"))
+    metrics_port_env = os.environ.get("METRICS_PORT")
+    if metrics_port_env is not None:
+        metrics_port = int(metrics_port_env)
+    else:
+        # METRICS_PORT unset: follow OPS_PORT=0's historical "fully headless"
+        # meaning (what the deploy-shape tests pass) instead of surprising
+        # them with a bound 8080
+        metrics_port = 8080 if ops_port else 0
+    serve_ops(metrics, port=ops_port, manager=manager, metrics_port=metrics_port)
     if cfg.namespace_labels_path:
         labels_watch = watch_namespace_labels(
             cfg.namespace_labels_path, manager, cluster

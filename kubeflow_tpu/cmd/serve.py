@@ -58,6 +58,18 @@ def main() -> None:
     name = sys.argv[1] if len(sys.argv) > 1 else "jupyter"
     port = int(os.environ.get("PORT", "5000"))
     app = build_app(name)
+    # unauthenticated /metrics lives on a dedicated ops port (OPS_PORT=0
+    # disables), like the controller's serve_ops; the app-port /metrics
+    # requires an authenticated caller. Default derives from PORT (5000 →
+    # 8082, the port the manifests scrape) so two apps on one dev host
+    # don't collide on a shared hard-coded ops port.
+    ops_port = int(os.environ.get("OPS_PORT", str(port + 3082)))
+    if ops_port:
+        import threading
+
+        ops_server = make_server("0.0.0.0", ops_port, app.ops_app())
+        threading.Thread(target=ops_server.serve_forever, daemon=True).start()
+        logging.info("serving %s ops (metrics) on :%d", name, ops_port)
     logging.info("serving %s on :%d", name, port)
     make_server("0.0.0.0", port, app).serve_forever()
 

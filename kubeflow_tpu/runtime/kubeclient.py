@@ -30,6 +30,16 @@ from kubeflow_tpu.runtime.fake import AlreadyExists, Conflict, NotFound
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+# a stream that lived at least this long before failing was healthy: its
+# failure is routine churn, not a degraded server (tests lower this)
+HEALTHY_STREAM_S = 60.0
+
+
+def _pause(backoff: float) -> None:
+    """Full-jitter backoff sleep; module-level seam so tests can observe the
+    sequence of backoff values without real sleeping."""
+    time.sleep(random.uniform(0, backoff))
+
 # kind -> (api prefix, group/version, plural, namespaced)
 RESOURCES: dict[str, tuple[str, str, str, bool]] = {
     "Pod": ("api", "v1", "pods", True),
@@ -334,11 +344,6 @@ class KubeClient:
                         if not line:
                             continue
                         event = json.loads(line)
-                        # a real (non-error) event proves the stream is
-                        # healthy — only then reset the backoff, else a
-                        # 200-then-ERROR server defeats it
-                        if event.get("type") != "ERROR":
-                            backoff = 0.5
                         etype = event.get("type")
                         obj = event.get("object", {})
                         if etype == "ERROR":
@@ -352,9 +357,15 @@ class KubeClient:
                             new_rv = obj.get("metadata", {}).get("resourceVersion")
                             if new_rv:
                                 rv = new_rv
+                            backoff = 0.5  # bookmark has no handler: healthy
                             continue
                         obj.setdefault("kind", kind)
                         fn(etype or "MODIFIED", obj)
+                        # only a successfully *handled* event proves health —
+                        # resetting before fn() would redeliver a poison event
+                        # (handler always raises) at 2-4 Hz forever with no
+                        # backoff growth
+                        backoff = 0.5
                         # advance rv only after the handler succeeded, so an
                         # event whose handler raised is redelivered on resume
                         new_rv = obj.get("metadata", {}).get("resourceVersion")
@@ -366,10 +377,19 @@ class KubeClient:
                     # an idle-but-healthy stream delivers no events before
                     # the read timeout; if it lived a while, the failure is
                     # routine churn, not a degraded server — start fresh so
-                    # sporadic blips can't ratchet backoff to the cap
-                    if stream_started and time.monotonic() - stream_started > 60:
+                    # sporadic blips can't ratchet backoff to the cap.
+                    # Consume stream_started so only the failure *immediately
+                    # following* a long-lived stream resets: during a
+                    # prolonged outage every retry fails before a stream ever
+                    # starts, and backoff must keep escalating.
+                    long_lived = (
+                        stream_started
+                        and time.monotonic() - stream_started > HEALTHY_STREAM_S
+                    )
+                    stream_started = 0.0
+                    if long_lived:
                         backoff = 0.5
-                    time.sleep(random.uniform(0, backoff))
+                    _pause(backoff)
                     backoff = min(backoff * 2, 30.0)
 
         t = threading.Thread(target=run, daemon=True, name=f"watch-{kind}")

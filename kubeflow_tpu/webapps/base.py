@@ -58,6 +58,8 @@ class App:
         userid_prefix: str = "",
         csrf_protect: bool = True,
         metrics_registry: Registry | None = None,
+        metrics_public: bool = False,
+        count_requests: bool = True,
     ) -> None:
         self.name = name
         self.authorizer = authorizer
@@ -71,6 +73,7 @@ class App:
         if metrics_registry is None:
             metrics_registry = Registry()
         self.metrics_registry = metrics_registry
+        self.count_requests = count_requests
         self._requests_total = metrics_registry.counter(
             "http_requests_total", "HTTP requests served, by method and code"
         )
@@ -81,11 +84,34 @@ class App:
         self.route("/healthz/readiness")(lambda req: success("message", "ready"))
         # closes over self, not the constructor local: swapping
         # app.metrics_registry later would otherwise silently diverge from
-        # what /metrics serves
-        self.route("/metrics")(
-            lambda req: Response(
+        # what /metrics serves. On the user-facing port the route requires an
+        # authenticated caller (ADVICE r3: counters and any domain registry
+        # must not be readable by anonymous clients); unauthenticated scrape
+        # belongs on the dedicated ops port (ops_app), like the reference's
+        # separate metrics bind address (main.go:56).
+        def metrics_view(req):
+            if not metrics_public:
+                self.current_user(req)
+            return Response(
                 self.metrics_registry.expose(), mimetype="text/plain"
             )
+
+        self.route("/metrics")(metrics_view)
+
+    def ops_app(self) -> "App":
+        """A sibling app for the ops port: same registry, /metrics served
+        without authentication (Prometheus scrapes don't carry the gateway's
+        userid header), probes included. Mirrors the controller's serve_ops."""
+        # count_requests=False: scrape and probe hits on the ops port are
+        # self-monitoring traffic and must not skew the user-facing app's
+        # request-rate/error-ratio series (promhttp doesn't self-instrument
+        # either)
+        return App(
+            f"{self.name}-ops",
+            csrf_protect=False,
+            metrics_registry=self.metrics_registry,
+            metrics_public=True,
+            count_requests=False,
         )
 
     def route(self, rule: str, methods: tuple[str, ...] = ("GET",)):
@@ -173,9 +199,10 @@ class App:
             if csrf_fail is not None:
                 # count before the early return: CSRF rejections are an
                 # attack-indicating error class /metrics must surface
-                self._requests_total.inc(
-                    method=request.method, code=str(csrf_fail.status_code)
-                )
+                if self.count_requests:
+                    self._requests_total.inc(
+                        method=request.method, code=str(csrf_fail.status_code)
+                    )
                 return csrf_fail(environ, start_response)
             endpoint, args = adapter.match()
             response = self.endpoints[endpoint](request, **args)
@@ -197,9 +224,10 @@ class App:
             response = error(e.code or 500, e.description or str(e))
         except Exception:
             response = error(500, traceback.format_exc(limit=3))
-        self._requests_total.inc(
-            method=request.method, code=str(response.status_code)
-        )
+        if self.count_requests:
+            self._requests_total.inc(
+                method=request.method, code=str(response.status_code)
+            )
         # seed the CSRF cookie on safe responses (double-submit bootstrap)
         if (
             self.csrf_protect

@@ -60,15 +60,20 @@ def create_app(
     metrics: NotebookMetrics | None = None,
     links: dict | None = None,
 ) -> App:
+    metrics = metrics or NotebookMetrics()
+    # the domain gauges are scraped live (reference collector pattern,
+    # metrics.go:82-99): refresh them on every expose so the ops-port scrape
+    # serves current values, not whatever the last /api/metrics UI hit left
+    metrics.registry.pre_expose(lambda: metrics.observe_notebooks(cluster))
     app = App(
         "centraldashboard",
         userid_header=userid_header,
         userid_prefix=userid_prefix,
         authorizer=Authorizer(cluster, cluster_admins=cluster_admins),
+        metrics_registry=metrics.registry,
     )
     bindings = BindingClient(cluster)
     profiles = ProfileClient(cluster, cluster_admins=cluster_admins)
-    metrics = metrics or NotebookMetrics()
 
     app.attach_frontend("dashboard")
 

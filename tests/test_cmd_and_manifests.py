@@ -154,3 +154,37 @@ class TestEntrypoints:
             app = build_app(name, cluster)
             client = Client(app)
             assert client.get("/healthz/liveness").status_code == 200
+
+    def test_serve_ops_split_listeners(self, cluster):
+        """The probe listener (Deployment liveness/readiness target) and the
+        unauthenticated metrics listener are independent, like the
+        reference's metrics-addr/probe-addr split (main.go:56): turning
+        metrics off must not kill the probe surface (→ CrashLoopBackOff)."""
+        import socket
+
+        import requests
+
+        from kubeflow_tpu.cmd.controller import build_manager as bm, serve_ops
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+            s.close()
+            return p
+
+        manager, metrics = bm(cluster)
+        probe_p, metrics_p = free_port(), free_port()
+        # metrics disabled, probes alive
+        assert serve_ops(metrics, port=probe_p, metrics_port=0)
+        r = requests.get(f"http://127.0.0.1:{probe_p}/healthz/liveness", timeout=5)
+        assert r.status_code == 200
+        # both listeners: metrics served unauthenticated on its own port
+        threads = serve_ops(
+            metrics, port=free_port(), manager=manager, metrics_port=metrics_p
+        )
+        assert len(threads) == 2
+        text = requests.get(f"http://127.0.0.1:{metrics_p}/metrics", timeout=5).text
+        assert "workqueue_stat" in text
+        # port=0 disables everything (what the deploy-shape tests pass)
+        assert serve_ops(metrics, port=0, metrics_port=0) == []

@@ -173,6 +173,56 @@ class TestClientConformance:
         client.delete("Notebook", "nb1", "team-a")
         eventually(lambda: ("DELETED", "nb1") in seen)
 
+    def test_poison_event_escalates_backoff(self, env, monkeypatch):
+        """ADVICE r3 (low): a redelivered event whose handler always raises
+        must escalate the reconnect sleep — backoff resets only after the
+        handler *succeeds*, else the poison event is hammered at 2-4 Hz."""
+        from kubeflow_tpu.runtime import kubeclient as kc
+
+        _, client = env
+        pauses = []
+        monkeypatch.setattr(
+            kc, "_pause", lambda b: (pauses.append(b), time.sleep(0.02))[1]
+        )
+        good = []
+
+        def handler(ev, obj):
+            if obj["metadata"]["name"] == "poison":
+                raise RuntimeError("boom")
+            good.append(obj["metadata"]["name"])
+
+        client.watch("Notebook", handler)
+        client.create(api.notebook("ok", "team-a"))
+        eventually(lambda: "ok" in good)
+        client.create(api.notebook("poison", "team-a"))
+        eventually(lambda: len(pauses) >= 4)
+        # each redelivery doubled the sleep instead of pinning at 0.5
+        assert pauses[:4] == [0.5, 1.0, 2.0, 4.0], pauses[:4]
+
+    def test_outage_backoff_escalates_after_healthy_stream(self, env, monkeypatch):
+        """ADVICE r3 (medium): the after-a-long-lived-stream backoff reset is
+        consumed by the first failure; a prolonged outage must then escalate
+        exponentially, not tight-loop at ~0.25s average per retry."""
+        from kubeflow_tpu.runtime import kubeclient as kc
+
+        server, client = env
+        monkeypatch.setattr(kc, "HEALTHY_STREAM_S", 0.05)
+        pauses = []
+        monkeypatch.setattr(
+            kc, "_pause", lambda b: (pauses.append(b), time.sleep(0.02))[1]
+        )
+        seen = []
+        client.watch("Notebook", lambda ev, obj: seen.append(obj["metadata"]["name"]))
+        client.create(api.notebook("nb1", "team-a"))
+        eventually(lambda: "nb1" in seen)
+        time.sleep(0.1)  # age the live stream past HEALTHY_STREAM_S
+        server.stop()  # prolonged outage: every reconnect now fails
+        eventually(lambda: len(pauses) >= 5)
+        # the stream that died was long-lived → its failure may reset to 0.5;
+        # every failure after that starts before any stream exists and must
+        # keep doubling
+        assert pauses[1:5] == [1.0, 2.0, 4.0, 8.0], pauses[:5]
+
     @staticmethod
     def _pod(name, namespace="team-a"):
         return {

@@ -531,14 +531,20 @@ class TestDashboardApp:
         for factory in (jupyter.create_app, volumes.create_app,
                         tensorboards.create_app, kfam_app.create_app,
                         dashboard.create_app):
-            client = Client(factory(cluster))
+            app = factory(cluster)
+            client = Client(app)
             client.get("/healthz/liveness")
             client.get("/no-such-route", headers=ALICE)
-            text = client.get("/metrics").get_data(as_text=True)
+            # app-port /metrics requires an authenticated caller (ADVICE r3)
+            assert client.get("/metrics").status_code == 401
+            text = client.get("/metrics", headers=ALICE).get_data(as_text=True)
             assert 'http_requests_total{code="200",method="GET"}' in text, (
                 factory.__module__
             )
             assert 'code="404"' in text
+            # the ops-port sibling serves the same registry unauthenticated
+            ops_text = Client(app.ops_app()).get("/metrics").get_data(as_text=True)
+            assert 'code="404"' in ops_text
 
     def test_csrf_rejections_are_counted(self, platform):
         cluster, _ = platform
@@ -547,7 +553,7 @@ class TestDashboardApp:
             "/api/namespaces/alice/notebooks", json={"name": "x"},
             headers={**ALICE, "X-XSRF-TOKEN": "wrong"},
         )
-        text = client.get("/metrics").get_data(as_text=True)
+        text = client.get("/metrics", headers=ALICE).get_data(as_text=True)
         assert 'http_requests_total{code="403",method="POST"}' in text
 
     def test_shared_registry_has_one_request_family(self, platform):

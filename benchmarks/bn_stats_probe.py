@@ -1,0 +1,142 @@
+"""Probe: XLA BN-stats reduction vs a Pallas channel-moments kernel.
+
+The round-3 device trace (BASELINE.md "ResNet step anatomy") showed the
+BatchNorm-statistics pass (`convert_reduce_fusion`) at 1.33 ms/step = 26% of
+the ResNet step, with the stem tensor's reduce running at ~82 GB/s — far off
+the ~750 GB/s streaming bandwidth. This probe measures, per ResNet activation
+shape, XLA's (sum, sumsq) channel reduction against a Pallas kernel that
+streams the tensor once and accumulates per-channel f32 moments in VMEM.
+
+Timing: K reduction passes inside ONE dispatch via lax.scan (per-dispatch
+overhead would swamp a ~30 us kernel), with a scalar carry multiplied into the
+input INSIDE the single pass (fuses into the read for XLA; an SMEM scalar for
+Pallas) so loop-invariant code motion can't hoist the work. Short/long window
+differencing cancels the tunnel's fixed readback cost.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+SHAPES = [  # the ResNet-50 batch-16 activation zoo (NHWC)
+    (16, 112, 112, 64),
+    (16, 56, 56, 64),
+    (16, 56, 56, 256),
+    (16, 28, 28, 512),
+    (16, 14, 14, 1024),
+    (16, 7, 7, 2048),
+]
+
+
+def xla_moments(x, c):
+    xf = x.astype(jnp.float32) * c
+    return jnp.sum(xf, axis=(0, 1, 2)), jnp.sum(xf * xf, axis=(0, 1, 2))
+
+
+def _moments_kernel(c_ref, x_ref, sum_ref, sq_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        sum_ref[:] = jnp.zeros_like(sum_ref)
+        sq_ref[:] = jnp.zeros_like(sq_ref)
+
+    xf = x_ref[:].astype(jnp.float32) * c_ref[0]
+    sum_ref[:] += jnp.sum(xf, axis=0, keepdims=True)
+    sq_ref[:] += jnp.sum(xf * xf, axis=0, keepdims=True)
+
+
+def pick_block_rows(m: int, ch: int, budget_bytes: int = 4 << 20) -> int:
+    """Largest divisor of m whose bf16 block fits the VMEM budget."""
+    best = 1
+    d = 1
+    while d * d <= m:
+        if m % d == 0:
+            for cand in (d, m // d):
+                if cand * ch * 2 <= budget_bytes and cand > best:
+                    best = cand
+        d += 1
+    return best
+
+
+def pallas_moments(x, c, block_rows=None):
+    n, h, w, ch = x.shape
+    m = n * h * w
+    x2 = x.reshape(m, ch)
+    if block_rows is None:
+        block_rows = pick_block_rows(m, ch)
+    assert m % block_rows == 0, (m, block_rows)
+    grid = (m // block_rows,)
+    s, q = pl.pallas_call(
+        _moments_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((block_rows, ch), lambda i: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, ch), lambda i: (0, 0)),
+            pl.BlockSpec((1, ch), lambda i: (0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, ch), jnp.float32),
+            jax.ShapeDtypeStruct((1, ch), jnp.float32),
+        ),
+    )(jnp.reshape(c, (1,)), x2)
+    return s[0], q[0]
+
+
+def make_looped(fn, x, k):
+    @jax.jit
+    def run(c0):
+        def body(c, _):
+            s, q = fn(x, c)
+            # fold the result back into the carry: a true data dependency
+            return 1.0 + 0.0 * s[0], None
+
+        c, _ = jax.lax.scan(body, c0, None, length=k)
+        return c
+
+    return run
+
+
+def timeit(fn, x, k=512, repeats=6):
+    short = make_looped(fn, x, k)
+    long_ = make_looped(fn, x, 3 * k)
+    float(short(jnp.float32(1.0)))  # compile
+    float(long_(jnp.float32(1.0)))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(short(jnp.float32(1.0)))
+        t1 = time.perf_counter()
+        float(long_(jnp.float32(1.0)))
+        t2 = time.perf_counter()
+        per = ((t2 - t1) - (t1 - t0)) / (2 * k)
+        best = min(best, per)  # stalls are additive; min is the honest time
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    print(f"{'shape':>22} {'MB':>6} {'xla':>9} {'pallas':>9} {'x GB/s':>7} {'p GB/s':>7}")
+    for shape in SHAPES:
+        x = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        nbytes = x.size * 2
+        t_x = timeit(xla_moments, x)
+        t_p = timeit(pallas_moments, x)
+        one = jnp.float32(1.0)
+        s1, q1 = jax.jit(xla_moments)(x, one)
+        s2, q2 = jax.jit(pallas_moments)(x, one)
+        rel = float(jnp.max(jnp.abs(s1 - s2) / (jnp.abs(s1) + 1.0)))
+        print(
+            f"{str(shape):>22} {nbytes/1e6:5.1f}M {t_x*1e6:8.1f}u {t_p*1e6:8.1f}u "
+            f"{nbytes/t_x/1e9:7.0f} {nbytes/t_p/1e9:7.0f}  rel={rel:.1e}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+"""XLA flag sweep for the ResNet bench step (each config = fresh process).
+
+Per-config absolute rates are confounded by tunnel phase drift (measured 11%
+between processes minutes apart), so each config run ALSO measures the
+default-flags program in the same process: the reported ratio is
+config/default within one process, which the drift cancels out of.
+"""
+import json
+import os
+import subprocess
+import sys
+
+CONFIGS = {
+    "lhs": "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "vmem64": "--xla_tpu_scoped_vmem_limit_kib=65536",
+    "vmem32": "--xla_tpu_scoped_vmem_limit_kib=32768",
+}
+
+INNER = r"""
+import time, statistics, functools
+import jax, jax.numpy as jnp, numpy as np, optax
+from kubeflow_tpu.models.resnet import ResNet50
+from kubeflow_tpu.parallel import mesh as meshlib
+from kubeflow_tpu.parallel.train import make_classifier_train_step
+
+mesh = meshlib.create_mesh(meshlib.MeshPlan(data=1))
+
+def build():
+    model = ResNet50(num_classes=1000)
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    bundle = make_classifier_train_step(model, tx, mesh)
+    rng = np.random.default_rng(0)
+    batch = {"image": jnp.asarray(rng.standard_normal((16,224,224,3)), jnp.bfloat16),
+             "label": jnp.asarray(rng.integers(0,1000,16), jnp.int32)}
+    sh = {k: meshlib.batch_sharding(mesh) for k in batch}
+    batch = jax.device_put(batch, sh)
+    state = bundle.init(jax.random.PRNGKey(0), batch)
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi(state, batch):
+        def body(s, _):
+            s2, m = bundle.step(s, batch)
+            return s2, m["loss"]
+        s, losses = jax.lax.scan(body, state, None, length=10)
+        return s, losses[-1]
+    return [multi, state, batch]
+
+cfg = build()
+
+def window(cfg, k):
+    fn, state, batch = cfg
+    t = time.perf_counter()
+    for _ in range(k):
+        state, loss = fn(state, batch)
+    float(loss); cfg[1] = state
+    return time.perf_counter() - t
+
+window(cfg, 2)
+shorts, longs = [], []
+for _ in range(6):
+    shorts.append(window(cfg, 1))
+    longs.append(window(cfg, 9))
+step = (min(longs) - min(shorts)) / 80
+print("RATE", 16 / step)
+"""
+
+
+def run(flags: str) -> float:
+    env = dict(os.environ)
+    if flags:
+        env["LIBTPU_INIT_ARGS"] = (env.get("LIBTPU_INIT_ARGS", "") + " " + flags).strip()
+    out = subprocess.run(
+        [sys.executable, "-c", INNER], env=env, capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        timeout=900,
+    )
+    for line in out.stdout.splitlines():
+        if line.startswith("RATE"):
+            return float(line.split()[1])
+    print(out.stdout[-2000:], out.stderr[-2000:], file=sys.stderr)
+    return float("nan")
+
+
+def main():
+    results = {}
+    base_rates = []
+    for name, flags in CONFIGS.items():
+        base = run("")  # same-phase default reference
+        rate = run(flags)
+        base_rates.append(base)
+        results[name] = {
+            "rate": round(rate, 1),
+            "default_same_phase": round(base, 1),
+            "ratio": round(rate / base, 4),
+        }
+        print(json.dumps({name: results[name]}), flush=True)
+    print(json.dumps({"summary": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,7 +1,7 @@
 """In-process A/B probe: two ResNet configs, interleaved windows, so tunnel
 throughput drift (measured 2x between processes) cancels. Usage:
 
-    python benchmarks/resnet_ab_probe.py BATCH_A BATCH_B [--b-mom-bf16]
+    python benchmarks/resnet_ab_probe.py BATCH_A BATCH_B [--b-mom-bf16] [--b-s2d]
 """
 import json
 import statistics
@@ -21,12 +21,12 @@ from kubeflow_tpu.parallel import mesh as meshlib
 from kubeflow_tpu.parallel.train import make_classifier_train_step
 
 
-def build(batch, mom_bf16):
+def build(batch, mom_bf16, s2d=False):
     devices = jax.devices()
     mesh = meshlib.create_mesh(
         meshlib.MeshPlan(data=len(devices)), devices=devices
     )
-    model = ResNet50(num_classes=1000)
+    model = ResNet50(num_classes=1000, s2d_stem=s2d)
     tx = optax.sgd(
         0.1, momentum=0.9, nesterov=True,
         accumulator_dtype=jnp.bfloat16 if mom_bf16 else None,
@@ -64,8 +64,9 @@ def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     batch_a, batch_b = int(args[0]), int(args[1])
     b_mom = "--b-mom-bf16" in sys.argv
+    b_s2d = "--b-s2d" in sys.argv
     A = build(batch_a, False)
-    B = build(batch_b, b_mom)
+    B = build(batch_b, b_mom, b_s2d)
 
     def window(cfg, k):
         step, state, data, _n = cfg
@@ -97,7 +98,7 @@ def main():
         ratios.append(rb / ra)
     print(json.dumps({
         "a": {"batch": batch_a, "imgs_per_sec": round(statistics.median(rates_a), 1)},
-        "b": {"batch": batch_b, "mom_bf16": b_mom,
+        "b": {"batch": batch_b, "mom_bf16": b_mom, "s2d": b_s2d,
               "imgs_per_sec": round(statistics.median(rates_b), 1)},
         "b_over_a_median_ratio": round(statistics.median(ratios), 4),
         "ratio_spread": [round(r, 3) for r in sorted(ratios)],

@@ -1,0 +1,151 @@
+"""On-chip step anatomy from an XLA profiler trace.
+
+Captures a device trace of a jitted step function, parses the xplane proto
+(tensorflow.tsl bundled proto — no TensorBoard UI needed in this image), and
+prints per-op-group device time so optimization targets are named from
+measurement, not guesswork (BASELINE.md "ResNet step anatomy").
+
+Usage:
+    python benchmarks/trace_anatomy.py resnet   # bench.py's batch-16 step
+    python benchmarks/trace_anatomy.py moe      # moe_bench's step
+"""
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LOGDIR = "/tmp/anatomy_trace"
+N_STEPS = 10
+
+
+def capture(step_fn, state, batch):
+    """Run N_STEPS under the profiler; returns the trace dir."""
+    import jax
+
+    import shutil
+
+    shutil.rmtree(LOGDIR, ignore_errors=True)
+    # warm (compile outside the trace)
+    state, metrics = step_fn(state, batch)
+    state, metrics = step_fn(state, batch)
+    jax.block_until_ready(metrics)
+    jax.profiler.start_trace(LOGDIR)
+    for _ in range(N_STEPS):
+        state, metrics = step_fn(state, batch)
+    jax.block_until_ready(metrics)
+    # tunneled runtimes sync only on a value fetch
+    jax.tree_util.tree_map(
+        lambda x: float(x.reshape(-1)[0]), metrics, is_leaf=lambda x: hasattr(x, "reshape")
+    )
+    jax.profiler.stop_trace()
+    return LOGDIR
+
+
+GROUPS = [
+    ("conv/matmul", re.compile(r"convolution|conv\d|dot|%fusion.*matmul")),
+    ("bn-stats reduce", re.compile(r"convert_reduce|reduce(?!_window)|bn_stats")),
+    ("copies", re.compile(r"copy")),
+    ("reduce-window (pool)", re.compile(r"reduce_window|select_and_scatter")),
+    ("all-to-all/collective", re.compile(r"all-to-all|all-reduce|collective|permute")),
+    ("pallas", re.compile(r"custom-call|tpu_custom_call")),
+]
+
+
+def parse(logdir: str) -> dict:
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    files = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+    if not files:
+        raise SystemExit(f"no xplane.pb under {logdir}")
+    space = xplane_pb2.XSpace()
+    with open(files[0], "rb") as f:
+        data = f.read()
+    try:
+        space.ParseFromString(data)
+    except Exception:
+        space.ParseFromString(gzip.decompress(data))
+
+    op_total: dict[str, float] = collections.defaultdict(float)
+    device_total = 0.0
+    for plane in space.planes:
+        if "TPU" not in plane.name and "/device" not in plane.name.lower():
+            continue
+        for line in plane.lines:
+            # ONLY the synchronous op timeline: "Async XLA Ops" durations span
+            # issue→done and overlap compute, so summing them double-counts
+            if line.name != "XLA Ops":
+                continue
+            for event in line.events:
+                meta = plane.event_metadata[event.metadata_id]
+                dur = event.duration_ps / 1e6  # ps -> us
+                op_total[meta.name] += dur
+                device_total += dur
+    return {"ops": dict(op_total), "total_us": device_total}
+
+
+def report(parsed: dict, n_steps: int = N_STEPS) -> None:
+    ops, total = parsed["ops"], parsed["total_us"]
+    grouped = collections.defaultdict(float)
+    for name, dur in ops.items():
+        for gname, pat in GROUPS:
+            if pat.search(name):
+                grouped[gname] += dur
+                break
+        else:
+            grouped["other"] += dur
+    print(f"\ndevice time: {total / n_steps / 1e3:.3f} ms/step over {n_steps} steps")
+    for g, dur in sorted(grouped.items(), key=lambda kv: -kv[1]):
+        print(f"  {g:28s} {dur / n_steps / 1e3:8.3f} ms/step  {dur / total:6.1%}")
+    print("\ntop 15 ops:")
+    for name, dur in sorted(ops.items(), key=lambda kv: -kv[1])[:15]:
+        print(f"  {dur / n_steps / 1e3:8.3f} ms/step  {dur / total:6.1%}  {name[:100]}")
+
+
+def resnet_case():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubeflow_tpu.models.resnet import ResNet50
+    from kubeflow_tpu.parallel import mesh as meshlib
+    from kubeflow_tpu.parallel.train import make_classifier_train_step
+
+    BATCH = 16
+    mesh = meshlib.create_mesh(meshlib.MeshPlan(data=1))
+    model = ResNet50(num_classes=1000)
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    bundle = make_classifier_train_step(model, tx, mesh)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(rng.standard_normal((BATCH, 224, 224, 3)), jnp.bfloat16),
+        "label": jnp.asarray(rng.integers(0, 1000, BATCH), jnp.int32),
+    }
+    sh = {k: meshlib.batch_sharding(mesh) for k in batch}
+    batch = jax.device_put(batch, sh)
+    state = bundle.init(jax.random.PRNGKey(0), batch)
+    return bundle.step, state, batch
+
+
+def moe_case():
+    import importlib
+
+    mb = importlib.import_module("benchmarks.moe_bench")
+    return mb.build_for_trace()
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "resnet"
+    step_fn, state, batch = {"resnet": resnet_case, "moe": moe_case}[which]()
+    logdir = capture(step_fn, state, batch)
+    report(parse(logdir))
+
+
+if __name__ == "__main__":
+    main()

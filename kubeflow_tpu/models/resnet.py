@@ -13,13 +13,60 @@ BASELINE.md's north-star metric is "spawned-notebook JAX ResNet-50 img/s/chip"
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Sequence
+from typing import Any, Callable, Sequence
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from kubeflow_tpu.ops.bn_pallas import batch_norm_train
+
 ModuleDef = Any
+
+
+class PallasBatchNorm(nn.Module):
+    """flax ``nn.BatchNorm`` drop-in whose train-mode statistics and gradient
+    reductions run as single-sweep Pallas kernels (``ops/bn_pallas.py``).
+
+    XLA's stats pass was 26% of the ResNet step at ~82 GB/s (BASELINE.md
+    "ResNet step anatomy"); these kernels stream each activation once per
+    pass. Param/collection names match flax (scale/bias, batch_stats
+    mean/var) so checkpoints and train-step plumbing are interchangeable.
+    Inference mode is pure elementwise XLA (fuses into neighbors).
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scale_init: Callable = nn.initializers.ones
+    bias_init: Callable = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x):
+        ch = x.shape[-1]
+        scale = self.param("scale", self.scale_init, (ch,), self.param_dtype)
+        bias = self.param("bias", self.bias_init, (ch,), self.param_dtype)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((ch,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((ch,), jnp.float32)
+        )
+        if self.use_running_average:
+            rinv = jax.lax.rsqrt(ra_var.value + self.epsilon)
+            a = scale * rinv
+            b = bias - ra_mean.value * a
+            return (x.astype(jnp.float32) * a + b).astype(self.dtype)
+        y, (mean, var) = batch_norm_train(
+            x.astype(self.dtype), scale, bias, self.epsilon
+        )
+        if not self.is_initializing():
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
+            ra_var.value = m * ra_var.value + (1.0 - m) * var
+        return y.astype(self.dtype)
 
 
 class SpaceToDepthStem(nn.Module):
@@ -105,12 +152,17 @@ class ResNet(nn.Module):
     width: int = 64
     dtype: Any = jnp.bfloat16
     s2d_stem: bool = False  # space-to-depth stem (same math, MXU-friendly)
+    # PallasBatchNorm's reduce kernels beat XLA's stats fusions 2x in
+    # isolation, but the pallas_call boundary relayouts every activation
+    # ({3,0,2,1} conv layout → row-major), measured net 3336 → 2193 img/s —
+    # so XLA BN stays the default here; see ops/bn_pallas.py and BASELINE.md
+    pallas_bn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, dtype=self.dtype, param_dtype=jnp.float32)
         norm = partial(
-            nn.BatchNorm,
+            PallasBatchNorm if self.pallas_bn else nn.BatchNorm,
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,

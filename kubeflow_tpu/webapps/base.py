@@ -23,7 +23,7 @@ from werkzeug.routing import Map, RequestRedirect, Rule
 from werkzeug.wrappers import Request, Response
 
 from kubeflow_tpu.auth.rbac import AuthError, Authorizer, User, authenticate
-from kubeflow_tpu.runtime.fake import AdmissionDenied, AlreadyExists
+from kubeflow_tpu.runtime.fake import AdmissionDenied, AlreadyExists, Conflict
 from kubeflow_tpu.runtime.fake import NotFound as ClusterNotFound
 from kubeflow_tpu.utils.metrics import Registry
 
@@ -214,7 +214,7 @@ class App:
             response = error(getattr(e, "status", 401), str(e))
         except (ClusterNotFound, NotFound) as e:
             response = error(404, str(e))
-        except AlreadyExists as e:
+        except (AlreadyExists, Conflict) as e:
             response = error(409, str(e))
         except AdmissionDenied as e:
             response = error(403, str(e))
@@ -254,6 +254,67 @@ def add_namespaces_route(app: "App", cluster) -> None:
             for ns in cluster.list("Namespace")
         )
         return success("namespaces", [n for n in names if n])
+
+
+def apply_edited_cr(
+    cluster,
+    kind: str,
+    name: str,
+    namespace: str,
+    body: dict,
+    *,
+    validate: Callable[[dict], list] | None = None,
+    dry_run: bool = False,
+) -> dict:
+    """Server-side apply for the editable-YAML flow (the kubeflow-common-lib
+    ``editor`` module's save path): the full edited CR replaces the stored
+    one.
+
+    - Path identity wins: a body whose metadata.name/namespace disagrees
+      with the URL is rejected (no silent renames), and kind must match.
+    - ``.status`` is carried over from the stored object — main-path updates
+      cannot write the status subresource (apiserver semantics the fake
+      doesn't enforce on ``update``).
+    - A body without resourceVersion applies over the current revision; a
+      stale revision surfaces as 409 via the cluster client.
+    - ``dry_run`` runs every check and returns the would-be object without
+      persisting (the all-or-nothing UX of the POST path).
+    """
+    if body.get("kind") not in (None, kind):
+        raise ValueError(f"kind must be {kind}")
+    meta = body.setdefault("metadata", {})
+    if meta.get("name", name) != name or meta.get("namespace", namespace) != namespace:
+        raise ValueError("metadata.name/namespace must match the URL")
+    current = cluster.get(kind, name, namespace)
+    body["kind"] = kind
+    body.setdefault("apiVersion", current.get("apiVersion"))
+    meta["name"], meta["namespace"] = name, namespace
+    meta.setdefault("resourceVersion", current["metadata"].get("resourceVersion"))
+    if "status" in current:
+        body["status"] = current["status"]
+    else:
+        body.pop("status", None)
+    if validate is not None:
+        errors = validate(body)
+        if errors:
+            raise ValueError("; ".join(errors))
+    if dry_run:
+        return body
+    return cluster.update(body)
+
+
+def handle_cr_put(
+    request: Request, cluster, kind: str, name: str, namespace: str,
+    *, validate: Callable[[dict], list] | None = None,
+) -> Response:
+    """The PUT-handler body every editable CR shares: parse the JSON body,
+    honor ?dryRun, apply via ``apply_edited_cr``. Callers do authz first."""
+    body = get_json(request)
+    dry = request.args.get("dryRun", "").lower() in ("1", "true", "all")
+    apply_edited_cr(
+        cluster, kind, name, namespace, body, validate=validate, dry_run=dry
+    )
+    return success("message", "Valid (dry run)." if dry else f"{kind} updated")
 
 
 def get_json(request: Request, *required: str) -> dict:

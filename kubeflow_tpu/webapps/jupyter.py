@@ -266,6 +266,19 @@ def create_app(
         return success("message", "Notebook updated")
 
     @app.route(
+        "/api/namespaces/<namespace>/notebooks/<name>", methods=("PUT",)
+    )
+    def put_notebook(request, namespace, name):
+        """Editable-YAML apply (detail page's editor tab): the full edited
+        CR replaces the stored spec, authz'd as update, schema-checked, with
+        ?dryRun=true validating without persisting."""
+        app.ensure(request, "update", "notebooks", namespace)
+        return base.handle_cr_put(
+            request, cluster, "Notebook", name, namespace,
+            validate=api.validate_notebook,
+        )
+
+    @app.route(
         "/api/namespaces/<namespace>/notebooks/<name>", methods=("DELETE",)
     )
     def delete_notebook(request, namespace, name):

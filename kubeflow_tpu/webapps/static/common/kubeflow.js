@@ -383,6 +383,448 @@
     container.appendChild(pre);
   }
 
+  // ---- YAML parser (editor module, the toYaml inverse) -------------------
+  // Block maps, block sequences, quoted/plain scalars, comments, flow []/{}
+  // — the subset toYaml emits plus what humans type into the editor.
+  // Throws Error with a 1-based line number on malformed input.
+  function fromYaml(text) {
+    const rawLines = text.split("\n");
+    const lines = [];  // {indent, body, num}
+    rawLines.forEach((raw, i) => {
+      // strip comments: full-line, or trailing outside quotes
+      let line = raw.replace(/\t/g, "  ");
+      let inS = null, cut = -1;
+      for (let j = 0; j < line.length; j++) {
+        const ch = line[j];
+        if (inS) { if (ch === inS && line[j - 1] !== "\\") inS = null; }
+        else if (ch === '"' || ch === "'") inS = ch;
+        else if (ch === "#" && (j === 0 || line[j - 1] === " ")) { cut = j; break; }
+      }
+      if (cut >= 0) line = line.slice(0, cut);
+      if (!line.trim()) return;
+      lines.push({
+        indent: line.length - line.trimStart().length,
+        body: line.trim(),
+        num: i + 1,
+      });
+    });
+
+    function primitive(s, num) {
+      if (s === "" || s === "~" || s === "null") return null;
+      if (s === "true") return true;
+      if (s === "false") return false;
+      if (s[0] === '"' || s[0] === "'") {
+        try {
+          return s[0] === '"'
+            ? JSON.parse(s)
+            : s.slice(1, -1).replace(/''/g, "'");
+        } catch (e) {
+          throw new Error("line " + num + ": bad quoted string " + s);
+        }
+      }
+      if (/^[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?$/.test(s)) return Number(s);
+      return s;
+    }
+
+    // flow collections ([a, b] / {k: v}, the k8s-manifest inline style):
+    // parsed for real — falling through to a string would silently corrupt
+    // an edited CR (e.g. a container command) instead of rejecting it
+    function parseFlow(str, num) {
+      let i = 0;
+      function ws() { while (i < str.length && /\s/.test(str[i])) i++; }
+      function fail(what) {
+        throw new Error("line " + num + ": " + what + " in flow value " + str);
+      }
+      function quoted() {
+        const q = str[i];
+        let j = i + 1;
+        while (j < str.length && (str[j] !== q || (q === "'" && str[j + 1] === "'"))) {
+          if (q === '"' && str[j] === "\\") j++;
+          if (q === "'" && str[j] === "'" && str[j + 1] === "'") j++;
+          j++;
+        }
+        if (j >= str.length) fail("unterminated string");
+        const out = primitive(str.slice(i, j + 1), num);
+        i = j + 1;
+        return out;
+      }
+      function bare(stop) {
+        const start = i;
+        while (i < str.length && stop.indexOf(str[i]) === -1) i++;
+        return str.slice(start, i).trim();
+      }
+      function value(stop) {
+        ws();
+        if (str[i] === "[") return arr();
+        if (str[i] === "{") return map();
+        if (str[i] === '"' || str[i] === "'") return quoted();
+        return primitive(bare(stop), num);
+      }
+      function arr() {
+        i++;  // [
+        const out = [];
+        ws();
+        if (str[i] === "]") { i++; return out; }
+        for (;;) {
+          out.push(value(",]"));
+          ws();
+          if (str[i] === ",") { i++; continue; }
+          if (str[i] === "]") { i++; return out; }
+          fail("expected ',' or ']'");
+        }
+      }
+      function map() {
+        i++;  // {
+        const out = {};
+        ws();
+        if (str[i] === "}") { i++; return out; }
+        for (;;) {
+          ws();
+          let key;
+          if (str[i] === '"' || str[i] === "'") key = quoted();
+          else key = bare(":,}");
+          ws();
+          if (str[i] !== ":") fail("expected ':'");
+          i++;
+          out[key] = value(",}");
+          ws();
+          if (str[i] === ",") { i++; continue; }
+          if (str[i] === "}") { i++; return out; }
+          fail("expected ',' or '}'");
+        }
+      }
+      const out = value("");
+      ws();
+      if (i < str.length) fail("trailing content");
+      return out;
+    }
+
+    function scalar(s, num) {
+      s = s.trim();
+      if (s[0] === "[" || s[0] === "{") return parseFlow(s, num);
+      return primitive(s, num);
+    }
+
+    let pos = 0;
+    function parseBlock(indent) {
+      if (pos >= lines.length) return null;
+      const first = lines[pos];
+      if (first.indent < indent) return null;
+      if (first.body.startsWith("- ") || first.body === "-") {
+        const arr = [];
+        while (pos < lines.length && lines[pos].indent === first.indent &&
+               (lines[pos].body.startsWith("- ") || lines[pos].body === "-")) {
+          const ln = lines[pos];
+          const rest = ln.body === "-" ? "" : ln.body.slice(2);
+          if (!rest) {  // nested block on following lines
+            pos++;
+            const v = parseBlock(first.indent + 1);
+            arr.push(v === null && (pos >= lines.length ||
+              lines[pos].indent <= first.indent) ? null : v);
+          } else if (rest === "-" || rest.startsWith("- ")) {
+            // "- - x": nested sequence inline (what toYaml emits for
+            // list-of-lists) — reparse the tail as a sequence item at the
+            // virtual indent
+            lines[pos] = { indent: ln.indent + 2, body: rest, num: ln.num };
+            arr.push(parseBlock(ln.indent + 2));
+          } else if (/^[^"':\s][^:]*:(\s|$)/.test(rest) || /^"[^"]*":(\s|$)/.test(rest)) {
+            // "- key: value": the item is a map whose first entry is inline;
+            // rewrite this line as the map entry at the virtual indent
+            lines[pos] = { indent: ln.indent + 2, body: rest, num: ln.num };
+            arr.push(parseBlock(ln.indent + 2));
+          } else {
+            arr.push(scalar(rest, ln.num));
+            pos++;
+          }
+        }
+        return arr;
+      }
+      const obj = {};
+      let any = false;
+      while (pos < lines.length && lines[pos].indent === first.indent) {
+        const ln = lines[pos];
+        if (ln.body.startsWith("- ")) break;
+        let key, rest;
+        const qm = ln.body.match(/^"((?:[^"\\]|\\.)*)"\s*:\s*(.*)$/);
+        if (qm) {
+          key = JSON.parse('"' + qm[1] + '"');
+          rest = qm[2];
+        } else {
+          const m = ln.body.match(/^([^:]+?)\s*:\s*(.*)$/);
+          if (!m) throw new Error("line " + ln.num + ": expected 'key: value'");
+          key = m[1];
+          rest = m[2];
+        }
+        pos++;
+        if (rest) {
+          obj[key] = scalar(rest, ln.num);
+        } else {
+          const v = parseBlock(ln.indent + 1);
+          obj[key] = v === null ? null : v;
+        }
+        any = true;
+      }
+      if (!any) {
+        throw new Error("line " + first.num + ": unexpected indentation");
+      }
+      return obj;
+    }
+
+    if (!lines.length) return null;
+    const out = parseBlock(lines[0].indent);
+    if (pos < lines.length) {
+      throw new Error("line " + lines[pos].num + ": unexpected content");
+    }
+    return out;
+  }
+
+  // ---- editable editor (kubeflow-common-lib `editor` module) -------------
+  // yamlEditor(container, obj, onApply?): read view with an Edit button;
+  // Edit swaps in a textarea + Apply/Cancel. Apply parses the YAML and
+  // resolves onApply(parsed) (async; typically a PUT) before re-rendering.
+  // Without onApply the editor is read-only (the old yamlView behavior).
+  function yamlEditor(container, obj, onApply) {
+    container.textContent = "";
+    const bar = document.createElement("div");
+    bar.className = "kf-editor-bar";
+    const body = document.createElement("div");
+    container.appendChild(bar);
+    container.appendChild(body);
+    let version = 0;  // bumped by update(): detects refresh during Apply
+
+    function view() {
+      bar.textContent = "";
+      body.textContent = "";
+      if (onApply) bar.appendChild(button("Edit", edit));
+      const pre = document.createElement("pre");
+      pre.className = "kf-yaml";
+      pre.textContent = toYaml(obj);
+      body.appendChild(pre);
+    }
+
+    function edit() {
+      bar.textContent = "";
+      body.textContent = "";
+      const ta = document.createElement("textarea");
+      ta.className = "kf-yaml-edit";
+      ta.value = toYaml(obj);
+      ta.rows = Math.min(40, ta.value.split("\n").length + 2);
+      ta.spellcheck = false;
+      const err = document.createElement("div");
+      err.className = "kf-field-error";
+      bar.appendChild(
+        button("Apply", async () => {
+          let parsed;
+          try {
+            parsed = fromYaml(ta.value);
+          } catch (e) {
+            err.textContent = e.message;
+            return;
+          }
+          err.textContent = "";
+          const seen = version;
+          try {
+            await onApply(parsed);
+            // onApply typically reloads and calls update() with the fresh
+            // object (new resourceVersion); only fall back to the parsed
+            // text when no refresh happened, else the next Apply would
+            // carry the stale revision and 409
+            if (version === seen) obj = parsed;
+            view();
+          } catch (e) {
+            err.textContent = e.message;  // server rejection: stay editing
+          }
+        })
+      );
+      bar.appendChild(button("Cancel", view));
+      bar.appendChild(err);
+      body.appendChild(ta);
+      ta.focus();
+    }
+
+    view();
+    return {
+      update: (next) => {
+        obj = next;
+        version++;
+        // don't clobber an in-progress edit with poll refreshes
+        if (!body.querySelector("textarea")) view();
+      },
+    };
+  }
+
+  // ---- loading spinner (loading-spinner module) --------------------------
+  function loadingSpinner(container) {
+    const el = document.createElement("div");
+    el.className = "kf-spinner";
+    el.setAttribute("role", "progressbar");
+    container.appendChild(el);
+    return () => el.remove();
+  }
+
+  // ---- help popover (help-popover module) --------------------------------
+  function helpPopover(text) {
+    const wrap = document.createElement("span");
+    wrap.className = "kf-help";
+    const btn = document.createElement("button");
+    btn.type = "button";
+    btn.className = "kf-help-btn";
+    btn.textContent = "?";
+    btn.setAttribute("aria-label", "help");
+    const bubble = document.createElement("span");
+    bubble.className = "kf-help-bubble";
+    bubble.textContent = text;
+    bubble.hidden = true;
+    btn.addEventListener("click", () => (bubble.hidden = !bubble.hidden));
+    btn.addEventListener("blur", () => (bubble.hidden = true));
+    wrap.appendChild(btn);
+    wrap.appendChild(bubble);
+    return wrap;
+  }
+
+  // ---- panel (collapsible section; panel module) -------------------------
+  function panel(container, title, renderContent, opts) {
+    opts = opts || {};
+    const det = document.createElement("details");
+    det.className = "kf-panel";
+    det.open = opts.open !== false;
+    const sum = document.createElement("summary");
+    sum.textContent = title;
+    det.appendChild(sum);
+    const content = document.createElement("div");
+    det.appendChild(content);
+    renderContent(content);
+    container.appendChild(det);
+    return det;
+  }
+
+  // ---- resource table v2 (sort / filter / pagination) --------------------
+  // resourceTable(container, columns, rows, opts):
+  //   columns: [{key, label, render?, sortValue?(row)}] — sortValue defaults
+  //   to row[key]; opts: {actions?, filter: true, pageSize: 10}
+  function resourceTable(container, columns, rows, opts) {
+    opts = opts || {};
+    const state = {
+      sortKey: null,
+      asc: true,
+      page: 0,
+      query: "",
+      pageSize: opts.pageSize || 10,
+    };
+
+    function sortValue(col, row) {
+      if (col.sortValue) return col.sortValue(row);
+      const v = row[col.key];
+      return v == null ? "" : v;
+    }
+
+    function visibleRows() {
+      let out = rows;
+      if (state.query) {
+        const q = state.query.toLowerCase();
+        out = out.filter((row) =>
+          columns.some((c) =>
+            String(sortValue(c, row)).toLowerCase().includes(q)
+          )
+        );
+      }
+      if (state.sortKey) {
+        const col = columns.find((c) => c.key === state.sortKey);
+        out = out.slice().sort((a, b) => {
+          const va = sortValue(col, a), vb = sortValue(col, b);
+          const cmp = typeof va === "number" && typeof vb === "number"
+            ? va - vb
+            : String(va).localeCompare(String(vb));
+          return state.asc ? cmp : -cmp;
+        });
+      }
+      return out;
+    }
+
+    function render() {
+      container.textContent = "";
+      if (opts.filter) {
+        const box = document.createElement("input");
+        box.type = "search";
+        box.className = "kf-table-filter";
+        box.placeholder = "Filter…";
+        box.value = state.query;
+        box.addEventListener("input", () => {
+          state.query = box.value;
+          state.page = 0;
+          render();
+          const nb = container.querySelector(".kf-table-filter");
+          nb.focus();
+          nb.setSelectionRange(nb.value.length, nb.value.length);
+        });
+        container.appendChild(box);
+      }
+      const all = visibleRows();
+      // clamp: deletions/refreshes can shrink the list under the current
+      // page, which would strand the user on an empty page with no pager
+      const maxPage = Math.max(0, Math.ceil(all.length / state.pageSize) - 1);
+      state.page = Math.min(state.page, maxPage);
+      const start = state.page * state.pageSize;
+      const pageRows = all.slice(start, start + state.pageSize);
+
+      const table = document.createElement("table");
+      table.className = "kf-table";
+      const hr = table.createTHead().insertRow();
+      columns.forEach((c) => {
+        const th = document.createElement("th");
+        th.className = "sortable";
+        th.textContent = c.label;
+        if (state.sortKey === c.key)
+          th.textContent += state.asc ? " ▲" : " ▼";
+        th.addEventListener("click", () => {
+          state.asc = state.sortKey === c.key ? !state.asc : true;
+          state.sortKey = c.key;
+          render();
+        });
+        hr.appendChild(th);
+      });
+      if (opts.actions) hr.appendChild(document.createElement("th"));
+      const tbody = table.createTBody();
+      pageRows.forEach((row) => {
+        const tr = tbody.insertRow();
+        columns.forEach((c) => {
+          const td = tr.insertCell();
+          const v = c.render ? c.render(row) : row[c.key];
+          if (v instanceof Node) td.appendChild(v);
+          else td.textContent = v == null ? "" : String(v);
+        });
+        if (opts.actions) {
+          const td = tr.insertCell();
+          opts.actions(row).forEach((btn) => td.appendChild(btn));
+        }
+      });
+      container.appendChild(table);
+
+      if (all.length > state.pageSize) {
+        const pager = document.createElement("div");
+        pager.className = "kf-pager";
+        const pages = Math.ceil(all.length / state.pageSize);
+        const prev = button("‹", () => { state.page--; render(); });
+        prev.disabled = state.page === 0;
+        const next = button("›", () => { state.page++; render(); });
+        next.disabled = state.page >= pages - 1;
+        const label = document.createElement("span");
+        label.textContent =
+          (start + 1) + "–" + Math.min(start + state.pageSize, all.length) +
+          " of " + all.length;
+        pager.appendChild(prev);
+        pager.appendChild(label);
+        pager.appendChild(next);
+        container.appendChild(pager);
+      }
+    }
+
+    render();
+    return {
+      update: (next) => { rows = next; render(); },
+    };
+  }
+
   // ---- sparkline (dashboard metrics chart; resource-charts analog) -------
   // values: number[]; renders an inline SVG polyline
   function sparkline(container, values, opts) {
@@ -480,7 +922,13 @@
     detailsList: detailsList,
     conditionsTable: conditionsTable,
     toYaml: toYaml,
+    fromYaml: fromYaml,
     yamlView: yamlView,
+    yamlEditor: yamlEditor,
+    loadingSpinner: loadingSpinner,
+    helpPopover: helpPopover,
+    panel: panel,
+    resourceTable: resourceTable,
     sparkline: sparkline,
     namespaceSelector: namespaceSelector,
   };

@@ -45,6 +45,34 @@ def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) ->
         cluster.create(api.tensorboard(body["name"], namespace, body["logspath"]))
         return success("message", "Tensorboard created successfully.")
 
+    @app.route("/api/namespaces/<namespace>/tensorboards/<name>")
+    def get_tensorboard(request, namespace, name):
+        app.ensure(request, "get", "tensorboards", namespace)
+        return success("tensorboard", cluster.get("Tensorboard", name, namespace))
+
+    @app.route(
+        "/api/namespaces/<namespace>/tensorboards/<name>", methods=("PUT",)
+    )
+    def put_tensorboard(request, namespace, name):
+        """Editable-YAML apply (editor module save path), authz'd as update;
+        ?dryRun=true validates without persisting."""
+        app.ensure(request, "update", "tensorboards", namespace)
+
+        def validate(tb: dict) -> list[str]:
+            logspath = (tb.get("spec") or {}).get("logspath")
+            if not logspath or not isinstance(logspath, str):
+                return ["spec.logspath is required"]
+            scheme, _ = parse_logspath(logspath)
+            if scheme == "unknown":
+                return [
+                    f"spec.logspath {logspath!r} must use pvc://, gs:// or s3://"
+                ]
+            return []
+
+        return base.handle_cr_put(
+            request, cluster, "Tensorboard", name, namespace, validate=validate
+        )
+
     @app.route(
         "/api/namespaces/<namespace>/tensorboards/<name>", methods=("DELETE",)
     )

@@ -405,3 +405,148 @@ class TestDomContract:
         # the modal creates both action buttons and resolves a Promise
         assert "kf-modal-ok" in lib and "kf-modal-cancel" in lib
         assert "Promise((resolve)" in lib
+
+
+class TestEditableYaml:
+    """The editor module's save path (kubeflow-common-lib `editor` +
+    server-side apply): dry-run validate, PUT, identity guards, conflicts."""
+
+    def _spawn(self, platform):
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "nb"},
+            headers=auth(client),
+        )
+        assert get_json(r)["success"]
+        m.run_until_idle()
+        cluster.settle(m)
+        m.run_until_idle()
+        return cluster, m, client
+
+    def test_edit_image_applies_end_to_end(self, platform):
+        cluster, m, client = self._spawn(platform)
+        raw = get_json(
+            client.get("/api/namespaces/alice/notebooks/nb", headers=ALICE)
+        )["raw"]
+        assert raw.get("status"), "editor needs the live CR incl. status"
+        edited = {k: v for k, v in raw.items() if k != "status"}
+        edited["spec"]["template"]["spec"]["containers"][0]["image"] = "jupyter-jax:v9"
+
+        # the page dry-runs first: nothing may persist
+        r = client.put(
+            "/api/namespaces/alice/notebooks/nb?dryRun=true",
+            json=edited, headers=auth(client),
+        )
+        assert get_json(r)["success"]
+        stored = cluster.get("Notebook", "nb", "alice")
+        img = stored["spec"]["template"]["spec"]["containers"][0]["image"]
+        assert img != "jupyter-jax:v9", "dry run must not persist"
+
+        r = client.put(
+            "/api/namespaces/alice/notebooks/nb", json=edited,
+            headers=auth(client),
+        )
+        assert get_json(r)["success"]
+        stored = cluster.get("Notebook", "nb", "alice")
+        assert (
+            stored["spec"]["template"]["spec"]["containers"][0]["image"]
+            == "jupyter-jax:v9"
+        )
+        # main-path apply must not clobber the controller's status
+        assert stored.get("status") == raw["status"]
+        # and the controller rolls the edit out to the StatefulSet
+        m.run_until_idle()
+        sts = cluster.get("StatefulSet", "nb", "alice")
+        assert (
+            sts["spec"]["template"]["spec"]["containers"][0]["image"]
+            == "jupyter-jax:v9"
+        )
+
+    def test_identity_and_schema_guards(self, platform):
+        cluster, m, client = self._spawn(platform)
+        raw = get_json(
+            client.get("/api/namespaces/alice/notebooks/nb", headers=ALICE)
+        )["raw"]
+        renamed = {k: v for k, v in raw.items() if k != "status"}
+        renamed["metadata"] = dict(renamed["metadata"], name="other")
+        r = client.put(
+            "/api/namespaces/alice/notebooks/nb", json=renamed,
+            headers=auth(client),
+        )
+        assert r.status_code == 400
+
+        bad_tpu = get_json(
+            client.get("/api/namespaces/alice/notebooks/nb", headers=ALICE)
+        )["raw"]
+        bad_tpu.pop("status", None)
+        bad_tpu["spec"]["tpu"] = {"accelerator": "h100", "topology": "2x2"}
+        r = client.put(
+            "/api/namespaces/alice/notebooks/nb", json=bad_tpu,
+            headers=auth(client),
+        )
+        assert r.status_code == 400, "schema validation must run on PUT"
+
+    def test_stale_resource_version_conflicts(self, platform):
+        cluster, m, client = self._spawn(platform)
+        raw = get_json(
+            client.get("/api/namespaces/alice/notebooks/nb", headers=ALICE)
+        )["raw"]
+        stale = {k: v for k, v in raw.items() if k != "status"}
+        stale["metadata"] = dict(stale["metadata"], resourceVersion="1")
+        r = client.put(
+            "/api/namespaces/alice/notebooks/nb", json=stale,
+            headers=auth(client),
+        )
+        assert r.status_code == 409
+
+    def test_tensorboard_edit_flow(self, platform):
+        from kubeflow_tpu.webapps import tensorboards
+
+        cluster, m = platform
+        client = Client(tensorboards.create_app(cluster))
+        r = client.post(
+            "/api/namespaces/alice/tensorboards",
+            json={"name": "tb", "logspath": "pvc://logs-vol/tb"},
+            headers=auth(client),
+        )
+        assert get_json(r)["success"]
+        tb = get_json(
+            client.get("/api/namespaces/alice/tensorboards/tb", headers=ALICE)
+        )["tensorboard"]
+        tb.pop("status", None)
+        tb["spec"]["logspath"] = "gs://bucket/exp2"
+        r = client.put(
+            "/api/namespaces/alice/tensorboards/tb", json=tb,
+            headers=auth(client),
+        )
+        assert get_json(r)["success"]
+        assert (
+            cluster.get("Tensorboard", "tb", "alice")["spec"]["logspath"]
+            == "gs://bucket/exp2"
+        )
+        # invalid logspath scheme is rejected by the PUT validator
+        tb = get_json(
+            client.get("/api/namespaces/alice/tensorboards/tb", headers=ALICE)
+        )["tensorboard"]
+        tb.pop("status", None)
+        tb["spec"]["logspath"] = "ftp://nope"
+        r = client.put(
+            "/api/namespaces/alice/tensorboards/tb", json=tb,
+            headers=auth(client),
+        )
+        assert r.status_code == 400
+
+    def test_editor_page_wiring(self):
+        """notebook.html must dry-run before applying, and the lib must ship
+        the editor + table modules the pages now use."""
+        page = (STATIC / "jupyter" / "notebook.html").read_text()
+        assert 'kf.api("PUT", base + "?dryRun=true"' in page
+        assert 'kf.api("PUT", base, edited)' in page
+        lib = (STATIC / "common" / "kubeflow.js").read_text()
+        for fn in ("fromYaml", "yamlEditor", "resourceTable",
+                   "loadingSpinner", "helpPopover", "panel"):
+            assert f"function {fn}(" in lib, fn
+        # the editor parses before PUTting and surfaces parse errors inline
+        assert "fromYaml(ta.value)" in lib

@@ -78,6 +78,81 @@ class TestResNet:
         assert flops_per_image(112) == pytest.approx(flops_per_image(224) / 4)
 
 
+class TestPallasBatchNorm:
+    """PallasBatchNorm must be a numerical drop-in for flax nn.BatchNorm
+    (same params/collections, same forward values, same gradients)."""
+
+    def _pair(self, use_running_average, dtype=jnp.float32):
+        import flax.linen as nn
+
+        from kubeflow_tpu.models.resnet import PallasBatchNorm
+
+        kw = dict(
+            use_running_average=use_running_average, momentum=0.9,
+            epsilon=1e-5, dtype=dtype, param_dtype=jnp.float32,
+        )
+        return PallasBatchNorm(**kw), nn.BatchNorm(**kw)
+
+    def test_train_forward_and_stats_match_flax(self):
+        ours, flax_bn = self._pair(False)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 6, 6, 16)) * 3 + 1
+        v1 = ours.init(jax.random.PRNGKey(0), x)
+        v2 = flax_bn.init(jax.random.PRNGKey(0), x)
+        assert jax.tree_util.tree_structure(v1) == jax.tree_util.tree_structure(v2)
+        y1, m1 = ours.apply(v1, x, mutable=["batch_stats"])
+        y2, m2 = flax_bn.apply(v2, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(m1["batch_stats"]["mean"]),
+            np.asarray(m2["batch_stats"]["mean"]), atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(m1["batch_stats"]["var"]),
+            np.asarray(m2["batch_stats"]["var"]), atol=1e-4,
+        )
+
+    def test_gradients_match_flax(self):
+        ours, flax_bn = self._pair(False)
+        x = jax.random.normal(jax.random.PRNGKey(2), (4, 6, 6, 16))
+        v1 = ours.init(jax.random.PRNGKey(0), x)
+        v2 = flax_bn.init(jax.random.PRNGKey(0), x)
+        tgt = jax.random.normal(jax.random.PRNGKey(3), (4, 6, 6, 16))
+
+        def loss(variables, module, x):
+            y, _ = module.apply(variables, x, mutable=["batch_stats"])
+            return jnp.mean((y.astype(jnp.float32) - tgt) ** 2)
+
+        g1x = jax.grad(lambda x_: loss(v1, ours, x_))(x)
+        g2x = jax.grad(lambda x_: loss(v2, flax_bn, x_))(x)
+        np.testing.assert_allclose(np.asarray(g1x), np.asarray(g2x), atol=1e-4)
+        g1 = jax.grad(lambda v: loss(v, ours, x))(v1)["params"]
+        g2 = jax.grad(lambda v: loss(v, flax_bn, x))(v2)["params"]
+        for k in ("scale", "bias"):
+            np.testing.assert_allclose(
+                np.asarray(g1[k]), np.asarray(g2[k]), atol=1e-4, err_msg=k
+            )
+
+    def test_eval_uses_running_stats(self):
+        ours, flax_bn = self._pair(True)
+        x = jax.random.normal(jax.random.PRNGKey(4), (2, 4, 4, 8)) * 2
+        v1 = ours.init(jax.random.PRNGKey(0), x)
+        v2 = flax_bn.init(jax.random.PRNGKey(0), x)
+        np.testing.assert_allclose(
+            np.asarray(ours.apply(v1, x)), np.asarray(flax_bn.apply(v2, x)),
+            atol=1e-5,
+        )
+
+    def test_awkward_channel_counts_fall_back(self):
+        """Shapes the tiler can't split cleanly must still be correct."""
+        from kubeflow_tpu.ops.bn_pallas import channel_moments
+
+        x = jax.random.normal(jax.random.PRNGKey(5), (3, 5, 7, 11))
+        mean, var = channel_moments(x)
+        xf = np.asarray(x, np.float64).reshape(-1, 11)
+        np.testing.assert_allclose(np.asarray(mean), xf.mean(0), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(var), xf.var(0), atol=1e-4)
+
+
 def tiny_cfg(**kw):
     return TransformerConfig(
         vocab_size=128,

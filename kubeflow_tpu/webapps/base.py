@@ -97,6 +97,20 @@ class App:
             )
 
         self.route("/metrics")(metrics_view)
+        self._on_close: list[Callable[[], None]] = []
+
+    def on_close(self, fn: Callable[[], None]) -> None:
+        """Register teardown (background samplers, watchers). WSGI has no
+        lifecycle of its own; embedders that create apps repeatedly (tests,
+        hot-reloading servers) call close() or the resources accumulate."""
+        self._on_close.append(fn)
+
+    def close(self) -> None:
+        for fn in self._on_close:
+            try:
+                fn()
+            except Exception:
+                pass
 
     def ops_app(self) -> "App":
         """A sibling app for the ops port: same registry, /metrics served

@@ -27,6 +27,11 @@ from kubeflow_tpu.runtime.fake import FakeCluster
 from kubeflow_tpu.utils.metrics import NotebookMetrics
 from kubeflow_tpu.webapps import base
 from kubeflow_tpu.webapps.base import App, get_json, success
+from kubeflow_tpu.webapps.metrics_source import (
+    MetricsSource,
+    RegistrySource,
+    metrics_source_from_env,
+)
 
 DEFAULT_LINKS = {
     "menuLinks": [
@@ -58,9 +63,38 @@ def create_app(
     userid_prefix: str = "",
     cluster_admins: set[str] | None = None,
     metrics: NotebookMetrics | None = None,
+    metrics_source: MetricsSource | None = None,
     links: dict | None = None,
 ) -> App:
     metrics = metrics or NotebookMetrics()
+
+    def _gauge_total(gauge):
+        return lambda: sum(s["value"] for s in gauge.samples())
+
+    # the cluster walk runs ONCE per sample (pre_sample below), not once
+    # per reader — the readers are then pure gauge sums
+    readers = {
+        "notebooks": _gauge_total(metrics.running),
+        "tpus": _gauge_total(metrics.tpu_chips_in_use),
+    }
+    owned_source = None
+    if metrics_source is None:
+        if os.environ.get("METRICS_SOURCE"):
+            metrics_source = metrics_source_from_env(
+                readers, os.environ,
+                pre_sample=lambda: metrics.observe_notebooks(cluster),
+            )
+        else:
+            metrics_source = RegistrySource(
+                readers,
+                pre_sample=lambda: metrics.observe_notebooks(cluster),
+            )
+        # history accumulates while nobody is looking; an injected source
+        # (tests, embedding apps) controls its own ticker. The app owns
+        # this one: registered on app.close() below, or every create_app
+        # call leaks a polling thread holding the cluster alive
+        metrics_source.start_background()
+        owned_source = metrics_source
     # the domain gauges are scraped live (reference collector pattern,
     # metrics.go:82-99): refresh them on every expose so the ops-port scrape
     # serves current values, not whatever the last /api/metrics UI hit left
@@ -72,6 +106,8 @@ def create_app(
         authorizer=Authorizer(cluster, cluster_admins=cluster_admins),
         metrics_registry=metrics.registry,
     )
+    if owned_source is not None:
+        app.on_close(owned_source.stop_background)
     bindings = BindingClient(cluster)
     profiles = ProfileClient(cluster, cluster_admins=cluster_admins)
 
@@ -270,12 +306,37 @@ def create_app(
 
     @app.route("/api/metrics/<metric_type>")
     def cluster_metrics(request, metric_type):
+        """Current per-label values PLUS the server-held series (reference
+        api.ts:31-59 serves MetricsService time series; round-3's client-side
+        sparkline accumulation vanished on reload and diverged across
+        replicas — the history now lives in the MetricsSource store)."""
         app.current_user(request)
         metrics.observe_notebooks(cluster)
         if metric_type == "notebooks":
-            return success("values", metrics.running.samples())
-        if metric_type == "tpus":
-            return success("values", metrics.tpu_chips_in_use.samples())
-        raise ValueError(f"unknown metric type {metric_type!r}")
+            values = metrics.running.samples()
+        elif metric_type == "tpus":
+            values = metrics.tpu_chips_in_use.samples()
+        else:
+            raise ValueError(f"unknown metric type {metric_type!r}")
+        try:
+            window = float(request.args.get("window", 900))
+        except ValueError:
+            raise ValueError("window must be a number of seconds")
+        try:
+            series = metrics_source.series(metric_type, window)
+        except KeyError:
+            # a custom source (e.g. prometheus with a trimmed families map)
+            # may cover fewer types than the gauges do: misconfiguration,
+            # not a server fault
+            raise ValueError(
+                f"metric type {metric_type!r} not served by the configured "
+                f"metrics source (has: {metrics_source.types()})"
+            )
+        return success(
+            "values", values,
+            series=series,
+            source=getattr(metrics_source, "kind", "registry"),
+            interval=metrics_source.interval_s,
+        )
 
     return app

@@ -45,3 +45,25 @@ def eventually(fn, timeout=8.0, interval=0.05):
             return last
         time.sleep(interval)
     raise AssertionError(f"condition not met within {timeout}s (last={last!r})")
+
+
+@pytest.fixture(autouse=True)
+def _close_created_dashboard_apps(monkeypatch):
+    """Dashboard apps own a background metrics ticker (metrics_source.py);
+    WSGI has no lifecycle, so the suite would otherwise accumulate one
+    polling thread per create_app call. Wrap create_app and close what each
+    test made."""
+    from kubeflow_tpu.webapps import dashboard as _dash
+
+    created = []
+    orig = _dash.create_app
+
+    def tracking(*args, **kwargs):
+        app = orig(*args, **kwargs)
+        created.append(app)
+        return app
+
+    monkeypatch.setattr(_dash, "create_app", tracking)
+    yield
+    for app in created:
+        app.close()

@@ -1,0 +1,290 @@
+"""Dashboard MetricsSource: server-held history + replica agreement.
+
+Pins the series contract of ``webapps/metrics_source.py`` (the reference's
+MetricsService interface, ``centraldashboard/app/metrics_service.ts:11-21``,
+factory ``metrics_service_factory.ts:24``) and its wiring into the dashboard
+``/api/metrics/<type>`` route (``api.ts:31-59``).
+"""
+from __future__ import annotations
+
+import pytest
+from werkzeug.test import Client
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.webapps import dashboard
+from kubeflow_tpu.webapps.metrics_source import (
+    PrometheusSource,
+    RegistrySource,
+    SeriesStore,
+    metrics_source_from_env,
+    parse_prometheus_text,
+)
+
+ALICE = {"kubeflow-userid": "alice@x.io"}
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def body(resp):
+    assert resp.status_code == 200, resp.get_data(as_text=True)
+    import json
+
+    return json.loads(resp.get_data(as_text=True))
+
+
+class TestSeriesStore:
+    def test_window_filters_and_orders(self):
+        store = SeriesStore()
+        for ts in (10.0, 20.0, 30.0):
+            store.append("x", ts, ts * 2)
+        pts = store.window("x", window_s=15.0, now=30.0)
+        assert pts == [
+            {"timestamp": 20.0, "value": 40.0},
+            {"timestamp": 30.0, "value": 60.0},
+        ]
+
+    def test_ring_caps_length(self):
+        store = SeriesStore(maxlen=3)
+        for i in range(10):
+            store.append("x", float(i), 0.0)
+        pts = store.window("x", window_s=100.0, now=10.0)
+        assert [p["timestamp"] for p in pts] == [7.0, 8.0, 9.0]
+
+    def test_same_tick_resample_overwrites(self):
+        store = SeriesStore()
+        store.append("x", 5.0, 1.0)
+        store.append("x", 5.0, 2.0)
+        assert store.window("x", 100.0, 5.0) == [
+            {"timestamp": 5.0, "value": 2.0}
+        ]
+
+
+class TestRegistrySource:
+    def test_samples_on_tick_grid(self):
+        clock = FakeClock(1007.0)  # mid-tick: grid is 15 s
+        vals = {"v": 3.0}
+        src = RegistrySource(
+            {"nb": lambda: vals["v"]}, interval_s=15.0, clock=clock
+        )
+        s1 = src.series("nb")
+        # timestamp snaps to the tick, not the read instant
+        assert s1 == [{"timestamp": 1005.0, "value": 3.0}]
+        # a second read in the same tick takes no new sample even though the
+        # underlying value moved
+        vals["v"] = 9.0
+        assert src.series("nb") == s1
+        clock.t = 1022.0  # next tick
+        assert src.series("nb")[-1] == {"timestamp": 1020.0, "value": 9.0}
+
+    def test_history_accumulates_across_ticks(self):
+        clock = FakeClock(0.0)
+        n = iter(range(100))
+        src = RegistrySource(
+            {"nb": lambda: float(next(n))}, interval_s=10.0, clock=clock
+        )
+        for t in (5.0, 15.0, 25.0, 35.0):
+            clock.t = t
+            src.series("nb")
+        assert [p["value"] for p in src.series("nb", window_s=100.0)] == [
+            0.0, 1.0, 2.0, 3.0,
+        ]
+
+    def test_background_ticker_accumulates_without_reads(self):
+        """History must grow while nobody is looking — sample-on-read alone
+        would hand a returning user a one-point 'history'."""
+        import time as _time
+
+        src = RegistrySource({"nb": lambda: 1.0}, interval_s=0.03)
+        src.start_background()
+        try:
+            _time.sleep(0.15)
+            pts = src._store.window("nb", 10.0, _time.time())
+            assert len(pts) >= 2, pts
+        finally:
+            src.stop_background()
+        assert src._ticker is None  # idempotent restartable
+
+    def test_unknown_type_raises(self):
+        src = RegistrySource({"nb": lambda: 0.0})
+        with pytest.raises(KeyError):
+            src.series("nope")
+
+    def test_broken_reader_does_not_starve_others(self):
+        clock = FakeClock(100.0)
+
+        def boom() -> float:
+            raise RuntimeError("reader down")
+
+        src = RegistrySource(
+            {"ok": lambda: 1.0, "bad": boom}, interval_s=10.0, clock=clock
+        )
+        assert [p["value"] for p in src.series("ok")] == [1.0]
+        assert src.series("bad") == []
+
+
+PROM_TEXT = """\
+# HELP notebook_running Current running notebooks
+# TYPE notebook_running gauge
+notebook_running{namespace="alice"} 2
+notebook_running{namespace="bob"} 3
+notebook_tpu_chips_in_use{namespace="alice"} 8
+garbage line without a value
+"""
+
+
+class TestPrometheusSource:
+    def test_parse_sums_label_sets(self):
+        totals = parse_prometheus_text(PROM_TEXT)
+        assert totals["notebook_running"] == 5.0
+        assert totals["notebook_tpu_chips_in_use"] == 8.0
+
+    def test_replicas_agree(self):
+        """Two sources (two dashboard replicas) polling the same endpoint on
+        the same clock produce IDENTICAL series — the agreement contract."""
+        clock = FakeClock(1000.0)
+        families = {"notebooks": "notebook_running"}
+        mk = lambda: PrometheusSource(
+            "http://prom:9090/metrics", families,
+            interval_s=15.0, clock=clock, fetch=lambda url: PROM_TEXT,
+        )
+        a, b = mk(), mk()
+        for t in (1000.0, 1016.0, 1031.0):
+            clock.t = t
+            sa, sb = a.series("notebooks"), b.series("notebooks")
+            assert sa == sb
+        assert [p["timestamp"] for p in a.series("notebooks")] == [
+            990.0, 1005.0, 1020.0,
+        ]
+
+    def test_endpoint_down_leaves_gap(self):
+        clock = FakeClock(100.0)
+        calls = {"n": 0}
+
+        def flaky(url: str) -> str:
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise OSError("connection refused")
+            return PROM_TEXT
+
+        src = PrometheusSource(
+            "http://prom/metrics", {"notebooks": "notebook_running"},
+            interval_s=10.0, clock=clock, fetch=flaky,
+        )
+        for t in (100.0, 110.0, 120.0):
+            clock.t = t
+            src.series("notebooks")
+        # tick 110 failed: series has exactly the two healthy points
+        assert [p["timestamp"] for p in src.series("notebooks")] == [
+            100.0, 120.0,
+        ]
+
+
+class TestFactory:
+    def test_default_is_registry(self):
+        src = metrics_source_from_env({"nb": lambda: 0.0}, env={})
+        assert isinstance(src, RegistrySource)
+
+    def test_prometheus_selected_with_url(self):
+        src = metrics_source_from_env(
+            {}, env={
+                "METRICS_SOURCE": "prometheus",
+                "METRICS_PROMETHEUS_URL": "http://prom:9090/metrics",
+            },
+        )
+        assert isinstance(src, PrometheusSource)
+        assert src.types() == ["notebooks", "tpus"]
+
+    def test_prometheus_requires_url(self):
+        with pytest.raises(ValueError, match="METRICS_PROMETHEUS_URL"):
+            metrics_source_from_env({}, env={"METRICS_SOURCE": "prometheus"})
+
+    def test_unknown_source_rejected(self):
+        with pytest.raises(ValueError, match="unknown METRICS_SOURCE"):
+            metrics_source_from_env({}, env={"METRICS_SOURCE": "graphite"})
+
+
+class TestDashboardRoute:
+    def _cluster(self) -> FakeCluster:
+        cluster = FakeCluster()
+        cluster.create(api.profile("alice", "alice@x.io"))
+        return cluster
+
+    def test_series_in_response_and_survives_reload(self):
+        cluster = self._cluster()
+        clock = FakeClock(500.0)
+        counts = iter([1.0, 2.0, 3.0])
+        source = RegistrySource(
+            {"notebooks": lambda: next(counts), "tpus": lambda: 0.0},
+            interval_s=10.0, clock=clock,
+        )
+        app = dashboard.create_app(cluster, metrics_source=source)
+        client = Client(app)
+        for t in (500.0, 510.0, 520.0):
+            clock.t = t
+            resp = body(client.get("/api/metrics/notebooks", headers=ALICE))
+        assert resp["source"] == "registry"
+        assert resp["interval"] == 10.0
+        # "reload": a brand-new client sees the full accumulated history —
+        # the round-3 client-side version lost it here
+        resp2 = body(
+            Client(app).get(
+                "/api/metrics/notebooks?window=900", headers=ALICE
+            )
+        )
+        assert [p["value"] for p in resp2["series"]] == [1.0, 2.0, 3.0]
+
+    def test_window_param_limits_series(self):
+        cluster = self._cluster()
+        clock = FakeClock(0.0)
+        source = RegistrySource(
+            {"notebooks": lambda: 1.0, "tpus": lambda: 0.0},
+            interval_s=10.0, clock=clock,
+        )
+        app = dashboard.create_app(cluster, metrics_source=source)
+        client = Client(app)
+        for t in (0.0, 100.0, 200.0):
+            clock.t = t
+            client.get("/api/metrics/notebooks", headers=ALICE)
+        resp = body(
+            client.get("/api/metrics/notebooks?window=150", headers=ALICE)
+        )
+        assert [p["timestamp"] for p in resp["series"]] == [100.0, 200.0]
+
+    def test_bad_window_is_400(self):
+        cluster = self._cluster()
+        app = dashboard.create_app(cluster)
+        resp = Client(app).get(
+            "/api/metrics/notebooks?window=abc", headers=ALICE
+        )
+        assert resp.status_code == 400
+
+    def test_source_without_type_is_400_not_500(self):
+        """A prometheus source with a trimmed families map must surface a
+        client error on the uncovered type, not a 500 on every home load."""
+        cluster = self._cluster()
+        source = PrometheusSource(
+            "http://prom/metrics", {"notebooks": "notebook_running"},
+            fetch=lambda url: PROM_TEXT,
+        )
+        app = dashboard.create_app(cluster, metrics_source=source)
+        resp = Client(app).get("/api/metrics/tpus", headers=ALICE)
+        assert resp.status_code == 400
+        assert b"not served" in resp.get_data()
+
+    def test_default_source_reads_cluster_gauges(self):
+        """End to end with the default (registry) source: the series tracks
+        the cluster's actual ready notebooks."""
+        cluster = self._cluster()
+        app = dashboard.create_app(cluster)
+        resp = body(
+            Client(app).get("/api/metrics/notebooks", headers=ALICE)
+        )
+        assert resp["series"][-1]["value"] == 0.0
+        assert resp["values"] == []

@@ -602,6 +602,19 @@
       body.appendChild(pre);
     }
 
+    // the user-editable surface: everything the PUT honors (status and
+    // server-set metadata are carried over server-side, base.py
+    // apply_edited_cr) — used to tell status-only refreshes apart from a
+    // concurrent edit of what the user is editing
+    function editableFingerprint(o) {
+      const md = o.metadata || {};
+      return JSON.stringify({
+        spec: o.spec || null,
+        labels: md.labels || null,
+        annotations: md.annotations || null,
+      });
+    }
+
     function edit() {
       bar.textContent = "";
       body.textContent = "";
@@ -610,6 +623,7 @@
       ta.value = toYaml(obj);
       ta.rows = Math.min(40, ta.value.split("\n").length + 2);
       ta.spellcheck = false;
+      const seedPrint = editableFingerprint(obj);
       const err = document.createElement("div");
       err.className = "kf-field-error";
       bar.appendChild(
@@ -620,6 +634,21 @@
           } catch (e) {
             err.textContent = e.message;
             return;
+          }
+          // polls kept `obj` fresh during the edit. Status-only updates
+          // (controller/kubelet) bump resourceVersion without touching
+          // anything this editor can change — carry the live rv so a
+          // spec-only edit of a Running resource doesn't 409 against its
+          // own status churn. A live change to spec/labels/annotations is
+          // a REAL concurrent edit: refuse, keep the 409 semantics.
+          if (editableFingerprint(obj) !== seedPrint) {
+            err.textContent =
+              "resource was modified while editing — Cancel to reload";
+            return;
+          }
+          if (parsed && parsed.metadata && obj.metadata &&
+              obj.metadata.resourceVersion !== undefined) {
+            parsed.metadata.resourceVersion = obj.metadata.resourceVersion;
           }
           err.textContent = "";
           const seen = version;
@@ -742,6 +771,12 @@
     }
 
     function render() {
+      // rebuilding wipes the filter input; if the user is typing in it when
+      // a poll-driven update() fires, restore focus and caret or every
+      // refresh tick steals the keyboard mid-word
+      const prevFilter = container.querySelector(".kf-table-filter");
+      const hadFocus = prevFilter && document.activeElement === prevFilter;
+      const caret = hadFocus ? prevFilter.selectionStart : null;
       container.textContent = "";
       if (opts.filter) {
         const box = document.createElement("input");
@@ -753,11 +788,12 @@
           state.query = box.value;
           state.page = 0;
           render();
-          const nb = container.querySelector(".kf-table-filter");
-          nb.focus();
-          nb.setSelectionRange(nb.value.length, nb.value.length);
         });
         container.appendChild(box);
+        if (hadFocus) {
+          box.focus();
+          box.setSelectionRange(caret, caret);
+        }
       }
       const all = visibleRows();
       // clamp: deletions/refreshes can shrink the list under the current
@@ -902,7 +938,61 @@
     return ns;
   }
 
+  // ---- i18n (reference: crud-web-apps/*/frontend/i18n catalogs) ----------
+  // Keys live on elements as data-i18n (textContent) / data-i18n-placeholder
+  // (input placeholder); catalogs are flat JSON at static/common/i18n/<lang>
+  // .json. English is the source language: with no catalog (or a missing
+  // key) the markup's own text stands, so pages never blank out on a fetch
+  // failure — same fallback contract as the reference's missing-translation
+  // behavior.
+  let i18nCatalog = {};
+  let i18nLang = "en";
+
+  function t(key, fallback) {
+    return Object.prototype.hasOwnProperty.call(i18nCatalog, key)
+      ? i18nCatalog[key]
+      : (fallback !== undefined ? fallback : key);
+  }
+
+  function applyI18n(root) {
+    (root || document).querySelectorAll("[data-i18n]").forEach((el) => {
+      el.textContent = t(el.dataset.i18n, el.textContent);
+    });
+    (root || document)
+      .querySelectorAll("[data-i18n-placeholder]")
+      .forEach((el) => {
+        el.placeholder = t(el.dataset.i18nPlaceholder, el.placeholder);
+      });
+  }
+
+  async function initI18n() {
+    // explicit choice (persisted) wins over the browser locale
+    const lang = (
+      localStorage.getItem("kf.lang") || navigator.language || "en"
+    ).slice(0, 2).toLowerCase();
+    i18nLang = lang;
+    if (lang !== "en") {
+      try {
+        const resp = await fetch("static/common/i18n/" + lang + ".json", {
+          credentials: "same-origin",
+        });
+        if (resp.ok) i18nCatalog = await resp.json();
+      } catch (e) { /* missing catalog -> English */ }
+    }
+    applyI18n();
+    return i18nLang;
+  }
+
+  function setLang(lang) {
+    localStorage.setItem("kf.lang", lang);
+    location.reload();
+  }
+
   window.kf = {
+    t: t,
+    applyI18n: applyI18n,
+    initI18n: initI18n,
+    setLang: setLang,
     api: api,
     snack: snack,
     statusIcon: statusIcon,

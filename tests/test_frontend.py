@@ -407,6 +407,64 @@ class TestDomContract:
         assert "Promise((resolve)" in lib
 
 
+class TestI18n:
+    """i18n scaffolding contract (reference ships translation catalogs for
+    every web-app frontend, crud-web-apps/*/frontend/i18n/): data-i18n keys
+    on the pages resolve in the shipped catalogs, every page initializes the
+    catalog before rendering, and the helper trio is exported."""
+
+    PAGES = sorted(STATIC.glob("*/*.html"))
+
+    def _catalogs(self):
+        import json
+
+        out = {}
+        for cat in (STATIC / "common" / "i18n").glob("*.json"):
+            out[cat.stem] = json.loads(cat.read_text())
+        return out
+
+    def test_non_english_catalog_exists_and_parses(self):
+        cats = self._catalogs()
+        assert cats, "no i18n catalogs shipped"
+        assert "fr" in cats
+        assert all(isinstance(v, str) and v for v in cats["fr"].values())
+
+    def test_page_keys_resolve_in_every_catalog(self):
+        cats = self._catalogs()
+        tagged = set()
+        for page in self.PAGES:
+            soup = BeautifulSoup(page.read_text(), "html.parser")
+            for el in soup.select("[data-i18n]"):
+                tagged.add(el["data-i18n"])
+            for el in soup.select("[data-i18n-placeholder]"):
+                tagged.add(el["data-i18n-placeholder"])
+        assert tagged, "no data-i18n tags on any page"
+        for lang, cat in cats.items():
+            missing = tagged - set(cat)
+            assert not missing, f"{lang}.json missing keys: {missing}"
+
+    def test_dynamic_kf_t_keys_resolve(self):
+        cats = self._catalogs()
+        for page in self.PAGES:
+            for key in re.findall(r'kf\.t\("([^"]+)"', page.read_text()):
+                for lang, cat in cats.items():
+                    assert key in cat, f"{page.name}: kf.t key {key!r} not in {lang}.json"
+
+    def test_every_page_initializes_i18n(self):
+        for page in self.PAGES:
+            assert "kf.initI18n()" in page.read_text(), (
+                f"{page.name} never loads the catalog"
+            )
+
+    def test_helpers_exported_and_fallback_contract(self):
+        lib = (STATIC / "common" / "kubeflow.js").read_text()
+        for sym in ("t: t", "applyI18n: applyI18n", "initI18n: initI18n"):
+            assert sym in lib
+        # missing catalog / missing key must fall back to the markup text,
+        # never blank the element
+        assert "el.textContent = t(el.dataset.i18n, el.textContent)" in lib
+
+
 class TestEditableYaml:
     """The editor module's save path (kubeflow-common-lib `editor` +
     server-side apply): dry-run validate, PUT, identity guards, conflicts."""

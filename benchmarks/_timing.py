@@ -1,0 +1,42 @@
+"""Shared two-window / min-over-windows estimator for tunnel benchmarks.
+
+THE methodology (bench.py module docstring is the canonical writeup):
+
+- the tunneled runtime charges a large FIXED latency on the first scalar
+  readback of a dispatch queue → time a short and a long window, each
+  ending in exactly one readback; the fixed cost cancels in the difference;
+- tunnel stalls are ADDITIVE (they lengthen a window, never shorten it) →
+  the minimum over repeats is each window's uncontaminated time;
+- multiplicative phase drift (measured ±30% process-to-process on Pallas
+  rows) needs enough repeats for the min to catch a clean phase.
+
+``bench.py`` keeps its own inline copy ON PURPOSE: it is the driver's
+entrypoint and must stay runnable as a single file; any change here must be
+mirrored there (and vice versa — both cite this note).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+def min_window_step_seconds(
+    window: Callable[[int], float],
+    n_short: int,
+    n_long: int,
+    repeats: int,
+) -> tuple[float, list[float], list[float]]:
+    """Estimate seconds per window-unit from interleaved short/long windows.
+
+    ``window(n)`` runs n units ending in ONE readback and returns elapsed
+    seconds (callers close over any carried state). Returns
+    ``(sec_per_unit, shorts, longs)`` — the raw window times let callers
+    report jitter visibility (stall census, per-pair medians).
+    """
+    shorts: list[float] = []
+    longs: list[float] = []
+    for _ in range(repeats):
+        shorts.append(window(n_short))
+        longs.append(window(n_long))
+    sec = (min(longs) - min(shorts)) / (n_long - n_short)
+    return sec, shorts, longs

@@ -1,0 +1,176 @@
+"""Perf-regression gate: compare the two newest rounds of each bench artifact.
+
+The reference has no automated perf gate anywhere (SURVEY.md §6); this closes
+that gap the round-4 verdict asked for (item 9). The driver records one JSON
+artifact per bench family per round (``BENCH_r03.json`` …); this tool finds,
+for every family, the two most recent rounds present and fails (exit 1) if
+the newer number regressed beyond tolerance:
+
+- throughput families (img/s, tok/s): newer < older × (1 − tol) fails
+- latency families (ms, per-phase p50): newer > older × (1 + tol) fails
+
+Usage:  python tools/perf_gate.py [--repo DIR] [--tolerance 0.05] [--json]
+Exit 0: no regressions (or fewer than two rounds to compare).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+
+ROUND_RE = re.compile(r"^(?P<family>[A-Z0-9_]+)_r(?P<round>\d+)\.json$")
+
+
+def _last_json_line(text: str) -> dict | None:
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def extract_metrics(family: str, payload: dict) -> dict[str, tuple[float, str]]:
+    """Canonical comparable numbers for one artifact:
+    {metric_key: (value, direction)} with direction 'higher' | 'lower'."""
+    if "tail" in payload and "value" not in payload:
+        # driver-captured wrapper: the bench's own JSON line is in the tail
+        inner = _last_json_line(payload.get("tail", ""))
+        if inner is None:
+            return {}
+        payload = inner
+    out: dict[str, tuple[float, str]] = {}
+    if isinstance(payload.get("value"), (int, float)):
+        unit = str(payload.get("unit", ""))
+        direction = "lower" if ("ms" in unit or unit == "s") else "higher"
+        out["value"] = (float(payload["value"]), direction)
+    for res in payload.get("results", []):  # attention-style sweep rows
+        if isinstance(res.get("ms"), (int, float)):
+            key = f"{res.get('impl', '?')}@{res.get('seq', '?')}"
+            out[key] = (float(res["ms"]), "lower")
+    for phase, stats in (payload.get("phases") or {}).items():
+        if isinstance(stats, dict) and isinstance(
+            stats.get("p50"), (int, float)
+        ):
+            out[f"{phase}.p50"] = (float(stats["p50"]), "lower")
+    return out
+
+
+def collect_rounds(repo: pathlib.Path) -> dict[str, dict[int, pathlib.Path]]:
+    families: dict[str, dict[int, pathlib.Path]] = {}
+    for path in repo.glob("*_r*.json"):
+        m = ROUND_RE.match(path.name)
+        if not m:
+            continue
+        families.setdefault(m["family"], {})[int(m["round"])] = path
+    return families
+
+
+def compare(repo: pathlib.Path, tolerance: float) -> dict:
+    report = {"families": {}, "regressions": []}
+    for family, rounds in sorted(collect_rounds(repo).items()):
+        if len(rounds) < 2:
+            continue
+        new_r, old_r = sorted(rounds)[-1], sorted(rounds)[-2]
+        try:
+            old = extract_metrics(
+                family, json.loads(rounds[old_r].read_text())
+            )
+            new = extract_metrics(
+                family, json.loads(rounds[new_r].read_text())
+            )
+        except (json.JSONDecodeError, OSError) as exc:
+            report["regressions"].append(
+                {"family": family, "error": f"unreadable artifact: {exc}"}
+            )
+            continue
+        rows = {}
+        for key, (old_val, direction) in old.items():
+            if key not in new:
+                # a config that stopped producing its number (crash/OOM
+                # recorded as null) must not pass silently — partial
+                # disappearance is the common failure mode
+                report["regressions"].append({
+                    "family": family,
+                    "metric": key,
+                    "error": f"r{new_r:02d} no longer reports this metric",
+                })
+                continue
+            if old_val == 0:
+                continue
+            new_val = new[key][0]
+            ratio = new_val / old_val
+            regressed = (
+                ratio < 1 - tolerance
+                if direction == "higher"
+                else ratio > 1 + tolerance
+            )
+            rows[key] = {
+                "old": old_val,
+                "new": new_val,
+                "ratio": round(ratio, 4),
+                "direction": direction,
+                "regressed": regressed,
+            }
+            if regressed:
+                report["regressions"].append({
+                    "family": family,
+                    "metric": key,
+                    "rounds": f"r{old_r:02d}->r{new_r:02d}",
+                    **{k: rows[key][k] for k in ("old", "new", "ratio")},
+                })
+        report["families"][family] = {
+            "rounds": f"r{old_r:02d}->r{new_r:02d}",
+            "metrics": rows,
+        }
+        if not rows and (old or new):
+            # one side has perf metrics the other lacks: a schema change
+            # silently removing a family from coverage must be visible, not
+            # a pass — a real regression would sail through otherwise.
+            # (Families where NEITHER round has metrics — e.g. MULTICHIP's
+            # ok/skipped contract — are not perf artifacts; skip.)
+            report["regressions"].append({
+                "family": family,
+                "error": (
+                    f"r{old_r:02d}->r{new_r:02d}: no comparable metrics "
+                    "(artifact schema changed?)"
+                ),
+            })
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repo", default=".", type=pathlib.Path)
+    ap.add_argument("--tolerance", default=0.05, type=float)
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+    report = compare(args.repo, args.tolerance)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        for family, info in report["families"].items():
+            for key, row in info["metrics"].items():
+                flag = "REGRESSED" if row["regressed"] else "ok"
+                print(
+                    f"{family:24s} {key:12s} {info['rounds']}  "
+                    f"{row['old']:>10.2f} -> {row['new']:>10.2f} "
+                    f"({row['ratio']:.3f}, {row['direction']} is better) {flag}"
+                )
+        if not report["families"]:
+            print("perf gate: fewer than two rounds of any artifact; nothing to compare")
+    if report["regressions"]:
+        print(
+            f"\nPERF GATE FAILED: {len(report['regressions'])} regression(s) "
+            f"beyond {args.tolerance:.0%}", file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

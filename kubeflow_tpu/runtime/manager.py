@@ -88,14 +88,39 @@ class Manager:
         self._clock = clock
         self._epoch = clock() if clock else 0.0
         self._sync_lock = threading.Lock()
+        self._watches_started = False
 
     # ------------------------------------------------------------- wiring
 
     def register(self, rec: Reconciler) -> None:
+        """Record a reconciler. Watches install when execution starts
+        (``start_watches``), NOT here: controller-runtime starts informers
+        only when the manager starts — under leader election a STANDBY
+        replica must not stream events into a queue no worker drains
+        (unbounded growth, and its scraped depth would read as a live
+        backlog; the multiproc churn loadtest hit exactly that)."""
         self._reconcilers.append(rec)
-        self.cluster.watch(rec.kind, self._primary_handler(rec))
-        for kind, map_fn in rec.watches():
-            self.cluster.watch(kind, self._secondary_handler(rec, map_fn))
+
+    def start_watches(self) -> None:
+        """Install watches + initial sync (idempotent). The initial pass
+        enqueues every existing object as ADDED — the informer cache-sync
+        contract — so objects created before the manager started still
+        reconcile (KubeClient.watch replays its own initial list; the
+        in-memory FakeCluster delivers only live events, so the replay here
+        covers both)."""
+        if self._watches_started:
+            return
+        self._watches_started = True
+        for rec in self._reconcilers:
+            primary = self._primary_handler(rec)
+            self.cluster.watch(rec.kind, primary)
+            for obj in self.cluster.list(rec.kind):
+                primary("ADDED", obj)
+            for kind, map_fn in rec.watches():
+                secondary = self._secondary_handler(rec, map_fn)
+                self.cluster.watch(kind, secondary)
+                for obj in self.cluster.list(kind):
+                    secondary("ADDED", obj)
 
     def reconciler_for(self, kind: str) -> Reconciler | None:
         """The registered reconciler for a primary kind (process wiring —
@@ -183,6 +208,7 @@ class Manager:
 
     def run_until_idle(self, max_iterations: int = 1000) -> int:
         """Drain the workqueue; returns number of reconciles executed."""
+        self.start_watches()
         executed = 0
         for _ in range(max_iterations):
             self._sync_external_clock()
@@ -198,6 +224,7 @@ class Manager:
     ) -> list[threading.Thread]:
         """Long-running mode: N threads block on the queue; a pacer thread
         syncs the external clock so ``add_after`` requeues fire."""
+        self.start_watches()
 
         def worker():
             while not stop.is_set():

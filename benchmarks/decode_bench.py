@@ -1,11 +1,20 @@
 """Autoregressive decode throughput on the flagship transformer (real chip).
 
-Measures generate() — prefill 128-token prompts, then 128 compiled
+Default: measures generate() — prefill 128-token prompts, then 128 compiled
 while_loop decode steps with temperature/top-k sampling — and prints one
 JSON line. Methodology: the tunneled runtime's fixed readback cost cancels
 in a 1-call vs 3-call window subtraction (BASELINE.md "Methodology");
 sync is a value fetch, never block_until_ready.
+
+``--long``: the round-4 verdict's decode-only long-context table. A FIXED
+16k-class serving cache; steady-state ms/step at live context pos ∈
+{1k, 4k, 16k} measured over ``decode_steps`` (prefill NEVER amortizes into
+the rate — the r03 table's 3584-prompt row timed generate() and buried the
+block-skip win under prefill), plus flash-vs-einsum prefill timings. The
+flash-decode kernel's claim (ops/flash_decode.py:22-26) is that KV traffic
+scales with pos, not max_seq_len — this table is that claim measured.
 """
+import dataclasses
 import json
 import statistics
 import sys
@@ -18,7 +27,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubeflow_tpu.models.decoding import decode_config, generate
+from benchmarks import _timing
+from kubeflow_tpu.models.decoding import (
+    decode_config,
+    decode_steps,
+    generate,
+    prefill,
+)
 from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
 
 BATCH, PROMPT, NEW = 4, 128, 128
@@ -71,5 +86,109 @@ def main() -> None:
     }))
 
 
+def long_mode() -> None:
+    L = 16640  # 65 x 256-token decode blocks: a 16k-class serving cache
+    DECODE_N = 32  # steps per decode_steps dispatch
+    base = TransformerConfig(
+        vocab_size=32_000, num_layers=24, num_heads=8, embed_dim=1024,
+        mlp_dim=4096, max_seq_len=L, num_kv_heads=4,
+        attention_impl="flash", dtype=jnp.bfloat16,
+    )
+    flash_model = TransformerLM(decode_config(base))
+    xla_model = TransformerLM(
+        decode_config(dataclasses.replace(base, attention_impl="xla"))
+    )
+    rng = np.random.default_rng(0)
+    short = jnp.asarray(rng.integers(0, base.vocab_size, (BATCH, 128)), jnp.int32)
+    params = jax.jit(
+        lambda k: TransformerLM(base).init(k, short)["params"]
+    )(jax.random.PRNGKey(0))
+
+    def prompt_of(pos):
+        return jnp.asarray(
+            rng.integers(0, base.vocab_size, (BATCH, pos)), jnp.int32
+        )
+
+    decode_rows, prefill_rows = [], []
+    for pos in (1024, 4096, 16384):
+        prompt = prompt_of(pos)
+
+        # ---- prefill timing (flash kernel vs eager einsum) -------------
+        for name, model in (("flash", flash_model), ("xla", xla_model)):
+            try:
+                def pf():
+                    cache, last = prefill(model, params, prompt)
+                    float(last[0, 0])  # value fetch = the only honest sync
+                    return cache
+
+                pf()  # compile + warm
+
+                def window(n):
+                    t = time.perf_counter()
+                    for _ in range(n):
+                        pf()
+                    return time.perf_counter() - t
+
+                sec, _, _ = _timing.min_window_step_seconds(window, 1, 3, 3)
+                prefill_rows.append({
+                    "impl": name, "pos": pos, "ms": round(sec * 1e3, 1),
+                    "tok_per_sec": round(BATCH * pos / sec, 0),
+                })
+            except Exception as e:
+                prefill_rows.append(
+                    {"impl": name, "pos": pos, "ms": None,
+                     "note": type(e).__name__}
+                )
+            print(json.dumps(prefill_rows[-1]), flush=True)
+
+        # ---- decode-only steady state at live context = pos ------------
+        # cache always filled by the FLASH prefill (identical layout); the
+        # einsum impl still decodes from it, so its row exists even where
+        # its own prefill OOMs
+        for name, model in (("flash", flash_model), ("xla", xla_model)):
+            try:
+                cache, last = prefill(flash_model, params, prompt)
+                tok0 = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                box = {"cache": cache}
+
+                def window(n):
+                    t = time.perf_counter()
+                    toks = None
+                    for _ in range(n):
+                        toks, box["cache"] = decode_steps(
+                            model, params, box["cache"], tok0, pos,
+                            n=DECODE_N, temperature=0.8, top_k=40,
+                        )
+                    int(toks[0, 0])
+                    return time.perf_counter() - t
+
+                window(1)  # compile + warm
+                sec, _, _ = _timing.min_window_step_seconds(window, 1, 3, 3)
+                ms = sec / DECODE_N * 1e3
+                decode_rows.append({
+                    "impl": name, "seq": pos, "ms": round(ms, 3),
+                    "tok_per_sec_row": round(1.0 / (ms / 1e3), 1),
+                })
+                del box, cache
+            except Exception as e:
+                decode_rows.append(
+                    {"impl": name, "seq": pos, "ms": None,
+                     "note": type(e).__name__}
+                )
+            print(json.dumps(decode_rows[-1]), flush=True)
+
+    print(json.dumps({
+        "metric": "decode_only_ms_per_step_long_context",
+        "cache_len": L,
+        "batch": BATCH,
+        "decode_n_per_dispatch": DECODE_N,
+        "results": decode_rows,
+        "prefill": prefill_rows,
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if "--long" in sys.argv:
+        long_mode()
+    else:
+        main()

@@ -69,6 +69,77 @@ def _sample(logits, temperature, top_k, rng):
     )
 
 
+@partial(jax.jit, static_argnames=("model",))
+def prefill(model: TransformerLM, params, prompt: jnp.ndarray):
+    """Fill the KV cache from a prompt [B, P]; returns (cache, last_logits).
+
+    The serving split: prefill once (with attention_impl='flash' this runs
+    the training flash kernel — linear memory in P, no [S, S] score
+    materialization), then drive ``decode_steps``/``generate`` from the
+    returned cache. ``generate`` composes these two for the simple case.
+    """
+    B, P = prompt.shape
+    logits, state = model.apply(
+        {"params": params}, prompt, positions=jnp.arange(P),
+        mutable=["cache"],
+    )
+    return state["cache"], logits[:, -1].astype(jnp.float32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("model", "n", "temperature", "top_k"),
+    donate_argnums=(2,),  # the cache updates in place: at 16k context it is
+    # ~6.5 GB — holding input AND output copies would double that per call
+)
+def decode_steps(
+    model: TransformerLM,
+    params,
+    cache,
+    first_token: jnp.ndarray,
+    start_pos: int | jnp.ndarray,
+    *,
+    n: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    rng: Any = None,
+):
+    """Run exactly ``n`` single-token decode steps from ``start_pos``.
+
+    ``first_token`` [B] is the token at position ``start_pos`` (e.g. sampled
+    from prefill's last_logits). Returns (tokens [B, n], cache) — one
+    compiled ``fori_loop`` program, no per-step retrace and no early-exit
+    data-dependence, which also makes it the honest steady-state decode
+    benchmark body (benchmarks/decode_bench.py --long): prefill time never
+    amortizes into the per-step rate.
+    """
+    cfg = model.cfg
+    B = first_token.shape[0]
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    tokens0 = jnp.zeros((B, n), jnp.int32)
+    start = jnp.asarray(start_pos, jnp.int32)
+
+    def body(i, carry):
+        tokens, cache, cur, rng = carry
+        pos = start + i
+        logits, new_state = model.apply(
+            {"params": params, "cache": cache}, cur[:, None],
+            positions=pos[None], mutable=["cache"],
+        )
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(
+            logits[:, -1].astype(jnp.float32), temperature, top_k, sub
+        )
+        tokens = lax.dynamic_update_slice(tokens, nxt[:, None], (0, i))
+        return tokens, new_state["cache"], nxt, rng
+
+    tokens, cache, _, _ = lax.fori_loop(
+        0, n, body, (tokens0, cache, first_token.astype(jnp.int32), rng)
+    )
+    return tokens, cache
+
+
 @partial(
     jax.jit,
     static_argnames=("model", "max_new_tokens", "temperature", "top_k", "eos_id"),
@@ -100,14 +171,9 @@ def generate(
         rng = jax.random.PRNGKey(0)
 
     # prefill: full prompt in one pass, cache initialized + filled
-    logits, state = model.apply(
-        {"params": params}, prompt, positions=jnp.arange(P),
-        mutable=["cache"],
-    )
+    cache0, last_logits = prefill(model, params, prompt)
     rng, prefill_rng = jax.random.split(rng)  # keys are single-use
-    next_tok = _sample(
-        logits[:, -1].astype(jnp.float32), temperature, top_k, prefill_rng
-    )
+    next_tok = _sample(last_logits, temperature, top_k, prefill_rng)
 
     # pad with eos (not 0 — a real token id) so rows that finish early
     # carry an eos suffix, per the module contract
@@ -147,7 +213,7 @@ def generate(
         _, tokens, _, _, _ = lax.while_loop(
             cond,
             body,
-            (jnp.asarray(0, jnp.int32), tokens0, state["cache"], done0, rng),
+            (jnp.asarray(0, jnp.int32), tokens0, cache0, done0, rng),
         )
     else:
         tokens = tokens0

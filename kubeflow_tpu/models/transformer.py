@@ -70,13 +70,31 @@ class TransformerConfig:
 
 def resolve_remat_policy(name: str):
     """Map a config remat_policy name to a jax.checkpoint policy; raises on
-    unknown names (shared by the dense and MoE model families)."""
+    unknown names (shared by the dense and MoE model families).
+
+    The ladder (memory high → low):
+    - 'dots': save every matmul output (jax dots_saveable) — cheapest
+      recompute, residuals linear in S×mlp_dim; exceeds HBM at 16k+ on a
+      16 GB chip (BASELINE.md).
+    - 'flash': save ONLY the flash kernel's out+lse (named residuals,
+      ops/pallas_attention.py _fwd) — the backward replay redoes the cheap
+      projections/MLP but never the S^2 attention kernel. The round-4 rung
+      between dots and full: ~68 MB/layer at 32k vs dots' ~600 MB. With a
+      non-flash attention impl the names never appear and this degrades to
+      exactly 'full'.
+    - 'full': save block inputs only (policy None) — maximum recompute,
+      including a second flash forward per block.
+    """
     if name == "dots":
         return jax.checkpoint_policies.dots_saveable
+    if name == "flash":
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse"
+        )
     if name == "full":
         return None
     raise ValueError(
-        f"unknown remat_policy {name!r}; expected 'full' or 'dots'"
+        f"unknown remat_policy {name!r}; expected 'full', 'dots' or 'flash'"
     )
 
 
@@ -205,6 +223,20 @@ class Attention(nn.Module):
         cached_v.value = v_all
 
         q_g = q.reshape(B, S, G, R, D)
+        bs_pf = min(cfg.attention_block_size, S)
+        if S > 1 and cfg.attention_impl == "flash" and S % bs_pf == 0:
+            # flash prefill (round 4): the TRAINING kernel fills attention
+            # for the whole prompt in linear memory — the einsum path below
+            # materializes [B,G,R,S,S] fp32 scores, quadratic in prompt
+            # length (2.1 GB at S=4k, OOM at 16k). Valid because prefill
+            # writes from slot 0 (the same assumption the einsum path's
+            # [:S] slice makes): causal-within-prompt == causal-vs-cache.
+            # Grouped K/V feed the kernel directly; the cache write above
+            # already persisted them.
+            o = flash_attention(
+                q, k, v, True, bs_pf, bs_pf, None, cfg.attention_window,
+            )
+            return o
         bk = min(cfg.decode_block_k, L)
         if S == 1 and cfg.attention_impl == "flash" and L % bk == 0:
             # flash-decode kernel: KV traffic scales with the live context

@@ -495,6 +495,18 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret, window):
         qt, kt, vt, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret, save_residuals=True, window=window,
     )
+    # Named for selective remat (models/transformer.py remat_policy
+    # 'flash'): saving exactly these two residuals lets a rematerialized
+    # block skip re-running THIS kernel in its backward replay — the S^2
+    # part of the recompute — while q/k/v come back from the cheap
+    # projection replay. Names must be on the PRE-transpose values: they
+    # are the residuals the bwd rule consumes, so the saved bytes are the
+    # bytes used (naming a downstream transpose would leave the kernel
+    # re-run in the replay).
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out.transpose(0, 2, 1, 3), (qt, kt, vt, out, lse)
 
 

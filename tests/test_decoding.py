@@ -165,3 +165,57 @@ def test_generate_rejects_cache_overflow():
     params = TransformerLM(base).init(jax.random.PRNGKey(0), prompt)["params"]
     with pytest.raises(ValueError, match="exceeds the cache"):
         generate(decode_model, params, prompt, max_new_tokens=10)
+
+
+@pytest.mark.parametrize("kv_heads", [None, 2])
+def test_decode_steps_matches_generate(kv_heads):
+    """The serving split (prefill + decode_steps) must produce exactly the
+    tokens generate() produces — same cache, same sampling, one program."""
+    from kubeflow_tpu.models.decoding import decode_steps, prefill
+
+    base, dec = cfg_pair(num_kv_heads=kv_heads)
+    decode_model = TransformerLM(dec)
+    prompt = jnp.asarray(
+        np.random.default_rng(1).integers(0, 97, (2, 8)), jnp.int32
+    )
+    params = TransformerLM(base).init(jax.random.PRNGKey(0), prompt)["params"]
+
+    want = generate(decode_model, params, prompt, max_new_tokens=6)
+
+    cache, last_logits = prefill(decode_model, params, prompt)
+    tok0 = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+    toks, _ = decode_steps(
+        decode_model, params, cache, tok0, prompt.shape[1], n=5
+    )
+    got = jnp.concatenate([prompt, tok0[:, None], toks], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_flash_prefill_matches_einsum_prefill():
+    """The round-4 flash-prefill branch (training kernel fills the cache)
+    must agree with the eager einsum path: same cache contents, same last
+    logits."""
+    from kubeflow_tpu.models.decoding import prefill
+
+    base, dec = cfg_pair(num_kv_heads=2)
+    flash_model = TransformerLM(
+        dataclasses.replace(dec, attention_impl="flash",
+                            attention_block_size=8)
+    )
+    xla_model = TransformerLM(dec)
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, 97, (2, 16)), jnp.int32
+    )
+    params = TransformerLM(base).init(jax.random.PRNGKey(0), prompt)["params"]
+
+    cache_f, logits_f = prefill(flash_model, params, prompt)
+    cache_x, logits_x = prefill(xla_model, params, prompt)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        cache_f, cache_x,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_f), np.asarray(logits_x), atol=2e-2, rtol=1e-2
+    )

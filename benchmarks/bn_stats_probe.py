@@ -13,7 +13,11 @@ input INSIDE the single pass (fuses into the read for XLA; an SMEM scalar for
 Pallas) so loop-invariant code motion can't hoist the work. Short/long window
 differencing cancels the tunnel's fixed readback cost.
 """
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -49,17 +53,13 @@ def _moments_kernel(c_ref, x_ref, sum_ref, sq_ref):
     sq_ref[:] += jnp.sum(xf * xf, axis=0, keepdims=True)
 
 
-def pick_block_rows(m: int, ch: int, budget_bytes: int = 4 << 20) -> int:
-    """Largest divisor of m whose bf16 block fits the VMEM budget."""
-    best = 1
-    d = 1
-    while d * d <= m:
-        if m % d == 0:
-            for cand in (d, m // d):
-                if cand * ch * 2 <= budget_bytes and cand > best:
-                    best = cand
-        d += 1
-    return best
+def pick_block_rows(m: int, ch: int) -> int:
+    """The library's divisor search (ops/bn_pallas.py) with the probe's
+    larger VMEM budget — one implementation of the Mosaic sublane
+    constraint, not two drifting copies."""
+    from kubeflow_tpu.ops.bn_pallas import _pick_block_rows
+
+    return _pick_block_rows(m, ch, budget_bytes=4 << 20)
 
 
 def pallas_moments(x, c, block_rows=None):

@@ -1,9 +1,12 @@
 """XLA flag sweep for the ResNet bench step (each config = fresh process).
 
-Per-config absolute rates are confounded by tunnel phase drift (measured 11%
-between processes minutes apart), so each config run ALSO measures the
-default-flags program in the same process: the reported ratio is
-config/default within one process, which the drift cancels out of.
+XLA/libtpu flags bind at backend init, so a config and the default CANNOT
+share a process — and per-process absolute rates drift with tunnel phase
+(measured 11% between processes minutes apart). Best available control:
+each config run is BRACKETED by default-flags runs (default, config,
+default), and the ratio uses the better bracket — drift slower than one
+process lifetime cancels; faster drift shows up as bracket disagreement,
+which is reported so a suspicious ratio can be re-run.
 """
 import json
 import os
@@ -55,12 +58,9 @@ def window(cfg, k):
     return time.perf_counter() - t
 
 window(cfg, 2)
-shorts, longs = [], []
-for _ in range(6):
-    shorts.append(window(cfg, 1))
-    longs.append(window(cfg, 9))
-step = (min(longs) - min(shorts)) / 80
-print("RATE", 16 / step)
+from benchmarks import _timing
+sec, _, _ = _timing.min_window_step_seconds(lambda n: window(cfg, n), 1, 9, 6)
+print("RATE", 16 / (sec / 10))
 """
 
 
@@ -82,14 +82,23 @@ def run(flags: str) -> float:
 
 def main():
     results = {}
-    base_rates = []
     for name, flags in CONFIGS.items():
-        base = run("")  # same-phase default reference
+        before = run("")  # bracket: default, config, default
         rate = run(flags)
-        base_rates.append(base)
+        after = run("")
+        import math
+
+        if any(math.isnan(v) for v in (before, rate, after)):
+            results[name] = {"error": "bracket or config run failed "
+                             f"(before={before}, rate={rate}, after={after})"}
+            print(json.dumps({name: results[name]}), flush=True)
+            continue
+        base = max(before, after)  # less-stalled bracket is the honest ref
         results[name] = {
             "rate": round(rate, 1),
-            "default_same_phase": round(base, 1),
+            "default_before": round(before, 1),
+            "default_after": round(after, 1),
+            "bracket_spread": round(abs(before - after) / base, 4),
             "ratio": round(rate / base, 4),
         }
         print(json.dumps({name: results[name]}), flush=True)

@@ -22,7 +22,6 @@ Usage: python benchmarks/moe_bench.py [--dispatch einsum|gather] [--remat]
 """
 import functools
 import json
-import statistics
 import sys
 import time
 from pathlib import Path
@@ -48,7 +47,7 @@ PEAK_FLOPS = {
 BATCH = 4
 SEQ = 2048
 CHUNK = 1024
-N_SHORT, N_LONG, REPEATS = 3, 13, 3
+N_SHORT, N_LONG, REPEATS = 3, 13, 5
 
 
 def chip_peak_flops(device) -> float:
@@ -59,10 +58,7 @@ def chip_peak_flops(device) -> float:
     return 197e12
 
 
-def main() -> None:
-    dispatch = "gather"
-    if "--dispatch" in sys.argv:
-        dispatch = sys.argv[sys.argv.index("--dispatch") + 1]
+def build(dispatch: str = "gather", remat: bool = False):
     cfg = MoEConfig(
         vocab_size=32_000,
         num_layers=8,
@@ -75,7 +71,7 @@ def main() -> None:
         dispatch=dispatch,
         attention_impl="flash",
         attention_block_size=1024,
-        remat="--remat" in sys.argv,
+        remat=remat,
         dtype=jnp.bfloat16,
     )
     model = MoETransformerLM(cfg)
@@ -116,22 +112,42 @@ def main() -> None:
             "opt_state": opt_state,
         }, loss
 
-    def window(n, state):
+    return cfg, step, state, tokens, n_total, n_active
+
+
+def build_for_trace():
+    """(step, state, batch) for trace_anatomy's moe case."""
+    _, step, state, tokens, _, _ = build()
+    return step, state, tokens
+
+
+def main() -> None:
+    dispatch = "gather"
+    if "--dispatch" in sys.argv:
+        dispatch = sys.argv[sys.argv.index("--dispatch") + 1]
+    cfg, step, state, tokens, n_total, n_active = build(
+        dispatch, "--remat" in sys.argv
+    )
+
+    carried = {"state": state}
+
+    def window(n):
         t = time.perf_counter()
         loss = None
         for _ in range(n):
-            state, loss = step(state, tokens)
+            carried["state"], loss = step(carried["state"], tokens)
         float(loss)
-        return time.perf_counter() - t, state
+        return time.perf_counter() - t
 
-    _, state = window(N_SHORT, state)
-    rates = []
-    for _ in range(REPEATS):
-        ts, state = window(N_SHORT, state)
-        tl, state = window(N_LONG, state)
-        rates.append(BATCH * SEQ / ((tl - ts) / (N_LONG - N_SHORT)))
+    window(N_SHORT)  # compile + warm
+    from benchmarks import _timing
 
-    tok_per_sec = statistics.median(rates)
+    # min-over-windows (benchmarks/_timing.py): medians let one stalled
+    # repeat move the record ~10%
+    sec, _, _ = _timing.min_window_step_seconds(
+        window, N_SHORT, N_LONG, REPEATS
+    )
+    tok_per_sec = BATCH * SEQ / sec
     attn = 12 * cfg.num_layers * cfg.embed_dim * SEQ * 0.5
     mfu = (
         tok_per_sec * (6 * n_active + attn) / chip_peak_flops(jax.devices()[0])

@@ -1,0 +1,209 @@
+"""Probe: pack small params (BN scale/bias) + batch stats into flat buffers.
+
+The round-4 step-anatomy trace (`trace_anatomy.py resnet`) shows ~1,440
+copy ops per step — 1,144 of them tiny f32[C] shuttles between scoped
+memory and HBM — costing ~0.4 ms of the 5.04 ms step, plus the scheduling
+drag of ~3,900 ops/step. Hypothesis: most tiny buffers (161 BN scales/
+biases + 106 running stats + their momentum slots) can live in TWO flat
+f32 vectors; slices feeding the convs fuse into consumers, the optimizer
+updates one vector instead of hundreds of [C] tensors, and donation
+aliases two buffers instead of ~500.
+
+Both variants run in ONE process, interleaved A/B/A/B, so tunnel drift
+cancels (flag_sweep.py methodology).
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.models.resnet import ResNet50
+from kubeflow_tpu.parallel import mesh as meshlib
+from kubeflow_tpu.parallel.train import make_classifier_train_step
+
+BATCH = 16
+SMALL = 8192  # leaves with <= this many elements get packed
+K_INNER = 10
+
+
+def make_batch():
+    rng = np.random.default_rng(0)
+    return {
+        "image": jnp.asarray(
+            rng.standard_normal((BATCH, 224, 224, 3)), jnp.bfloat16
+        ),
+        "label": jnp.asarray(rng.integers(0, 1000, BATCH), jnp.int32),
+    }
+
+
+class Packer:
+    """Static pack/unpack between a pytree's small leaves and one flat f32
+    vector. Split points are static -> XLA slices that fuse into consumers."""
+
+    def __init__(self, abstract_tree):
+        leaves, self.treedef = jax.tree_util.tree_flatten(abstract_tree)
+        self.small = [
+            i for i, l in enumerate(leaves)
+            if l.size <= SMALL and l.dtype == jnp.float32
+        ]
+        self.shapes = [leaves[i].shape for i in self.small]
+        self.sizes = [int(np.prod(s)) for s in self.shapes]
+        self.splits = list(np.cumsum(self.sizes)[:-1])
+        self.n_leaves = len(leaves)
+
+    def pack(self, tree):
+        leaves = jax.tree_util.tree_leaves(tree)
+        big = [l for i, l in enumerate(leaves) if i not in set(self.small)]
+        flat = jnp.concatenate([leaves[i].ravel() for i in self.small])
+        return big, flat
+
+    def unpack(self, big, flat):
+        parts = jnp.split(flat, self.splits)
+        small_iter = iter(
+            p.reshape(s) for p, s in zip(parts, self.shapes)
+        )
+        big_iter = iter(big)
+        small_set = set(self.small)
+        leaves = [
+            next(small_iter) if i in small_set else next(big_iter)
+            for i in range(self.n_leaves)
+        ]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+def build_default(mesh, batch):
+    model = ResNet50(num_classes=1000)
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    bundle = make_classifier_train_step(model, tx, mesh)
+    state = bundle.init(jax.random.PRNGKey(0), batch)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi(state, batch):
+        def body(s, _):
+            s2, m = bundle.step(s, batch)
+            return s2, m["loss"]
+
+        s, losses = jax.lax.scan(body, state, None, length=K_INNER)
+        return s, losses[-1]
+
+    return multi, state
+
+
+def build_packed(mesh, batch):
+    model = ResNet50(num_classes=1000)
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    variables = model.init(jax.random.PRNGKey(0), batch["image"], train=False)
+    params, stats = variables["params"], variables["batch_stats"]
+    p_packer = Packer(jax.eval_shape(lambda: params))
+    s_packer = Packer(jax.eval_shape(lambda: stats))
+    big, pack = p_packer.pack(params)
+    _, stats_pack = s_packer.pack(stats)  # batch stats are ALL small
+    opt_params = {"big": big, "pack": pack}
+    state = {
+        "big": big,
+        "pack": pack,
+        "stats_pack": stats_pack,
+        "opt_state": tx.init(opt_params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+    def train_step(state, batch):
+        def compute_loss(opt_params):
+            params = p_packer.unpack(opt_params["big"], opt_params["pack"])
+            bstats = s_packer.unpack([], state["stats_pack"])
+            logits, upd = model.apply(
+                {"params": params, "batch_stats": bstats},
+                batch["image"], train=True, mutable=["batch_stats"],
+            )
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            loss = -jnp.mean(
+                jnp.take_along_axis(logp, batch["label"][:, None], axis=1)
+            )
+            return loss, upd
+
+        opt_params = {"big": state["big"], "pack": state["pack"]}
+        (loss, upd), grads = jax.value_and_grad(compute_loss, has_aux=True)(
+            opt_params
+        )
+        updates, new_opt = tx.update(grads, state["opt_state"], opt_params)
+        new_params = optax.apply_updates(opt_params, updates)
+        _, new_stats_pack = s_packer.pack(upd["batch_stats"])
+        return {
+            "big": new_params["big"],
+            "pack": new_params["pack"],
+            "stats_pack": new_stats_pack,
+            "opt_state": new_opt,
+            "step": state["step"] + 1,
+        }, loss
+
+    step = jax.jit(train_step, donate_argnums=(0,))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi(state, batch):
+        def body(s, _):
+            s2, loss = step(s, batch)
+            return s2, loss
+
+        s, losses = jax.lax.scan(body, state, None, length=K_INNER)
+        return s, losses[-1]
+
+    return multi, state
+
+
+def measure(multi, state, batch, n_short=2, n_long=8):
+    def window(n, state):
+        t = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            state, loss = multi(state, batch)
+        float(loss)
+        return time.perf_counter() - t, state
+
+    from benchmarks import _timing
+
+    _, state = window(n_short, state)  # compile+warm
+    _, state = window(n_long, state)
+    carried = {"state": state}
+
+    def timed(n):
+        t, carried["state"] = window(n, carried["state"])
+        return t
+
+    sec, _, _ = _timing.min_window_step_seconds(timed, n_short, n_long, 6)
+    return sec / K_INNER, carried["state"]
+
+
+def main():
+    mesh = meshlib.create_mesh(meshlib.MeshPlan(data=1))
+    batch = make_batch()
+    sh = {k: meshlib.batch_sharding(mesh) for k in batch}
+    batch = jax.device_put(batch, sh)
+
+    d_multi, d_state = build_default(mesh, batch)
+    p_multi, p_state = build_packed(mesh, batch)
+
+    # interleave so drift hits both
+    d1, d_state = measure(d_multi, d_state, batch)
+    p1, p_state = measure(p_multi, p_state, batch)
+    d2, d_state = measure(d_multi, d_state, batch)
+    p2, p_state = measure(p_multi, p_state, batch)
+    d_step, p_step = min(d1, d2), min(p1, p2)
+    print(json.dumps({
+        "default_ms": round(d_step * 1e3, 3),
+        "packed_ms": round(p_step * 1e3, 3),
+        "default_imgs": round(BATCH / d_step, 1),
+        "packed_imgs": round(BATCH / p_step, 1),
+        "speedup": round(d_step / p_step, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

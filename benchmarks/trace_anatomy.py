@@ -47,12 +47,20 @@ def capture(step_fn, state, batch):
     return LOGDIR
 
 
+# Matched against the INSTRUCTION NAME only (the token before ' = '), not
+# the full HLO text — operand names inside fusion(...) otherwise claim the
+# op for the wrong group (a conv fusion whose operand is %copy-done.3 would
+# count as a copy). Order matters: collectives before the reduce pattern
+# (all-reduce contains 'reduce'), pooling before it too (XLA emits
+# hyphenated reduce-window / select-and-scatter).
 GROUPS = [
-    ("conv/matmul", re.compile(r"convolution|conv\d|dot|%fusion.*matmul")),
-    ("bn-stats reduce", re.compile(r"convert_reduce|reduce(?!_window)|bn_stats")),
-    ("copies", re.compile(r"copy")),
-    ("reduce-window (pool)", re.compile(r"reduce_window|select_and_scatter")),
-    ("all-to-all/collective", re.compile(r"all-to-all|all-reduce|collective|permute")),
+    ("all-to-all/collective", re.compile(
+        r"all-to-all|all-reduce|reduce-scatter|all-gather|collective|permute")),
+    ("reduce-window (pool)", re.compile(
+        r"reduce[-_]window|select[-_]and[-_]scatter")),
+    ("conv/matmul", re.compile(r"convolution|conv\d|dot|matmul")),
+    ("bn-stats reduce", re.compile(r"convert_reduce|reduce|bn_stats")),
+    ("copies", re.compile(r"^copy|slice-(start|done)")),
     ("pallas", re.compile(r"custom-call|tpu_custom_call")),
 ]
 
@@ -93,8 +101,12 @@ def report(parsed: dict, n_steps: int = N_STEPS) -> None:
     ops, total = parsed["ops"], parsed["total_us"]
     grouped = collections.defaultdict(float)
     for name, dur in ops.items():
+        opname = name.lstrip("%").split(" ", 1)[0]
+        # "%fusion.12 = ..." tells us nothing; fall through to the full text
+        # for generic fusions, which XLA names by their root op otherwise
+        probe_text = name if opname.startswith("fusion") else opname
         for gname, pat in GROUPS:
-            if pat.search(name):
+            if pat.search(probe_text):
                 grouped[gname] += dur
                 break
         else:

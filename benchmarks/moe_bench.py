@@ -37,6 +37,7 @@ from kubeflow_tpu.models.moe import (
     MoEConfig,
     MoETransformerLM,
     moe_lm_loss_chunked,
+    moe_lm_loss_fused,
 )
 
 PEAK_FLOPS = {
@@ -58,7 +59,7 @@ def chip_peak_flops(device) -> float:
     return 197e12
 
 
-def build(dispatch: str = "gather", remat: bool = False):
+def build(dispatch: str = "gather", remat: bool = False, head: str = "fused"):
     cfg = MoEConfig(
         vocab_size=32_000,
         num_layers=8,
@@ -101,11 +102,15 @@ def build(dispatch: str = "gather", remat: bool = False):
         1 - cfg.experts_per_token / cfg.num_experts
     )
 
+    loss_fn = (
+        (lambda p: moe_lm_loss_chunked(model, p, tokens, chunk=CHUNK))
+        if head == "chunked"
+        else (lambda p: moe_lm_loss_fused(model, p, tokens))
+    )
+
     @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: moe_lm_loss_chunked(model, p, tokens, chunk=CHUNK)
-        )(state["params"])
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
         updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
         return {
             "params": optax.apply_updates(state["params"], updates),
@@ -117,7 +122,9 @@ def build(dispatch: str = "gather", remat: bool = False):
 
 def build_for_trace():
     """(step, state, batch) for trace_anatomy's moe case."""
-    _, step, state, tokens, _, _ = build()
+    _, step, state, tokens, _, _ = build(
+        head="chunked" if "--chunked-head" in sys.argv else "fused"
+    )
     return step, state, tokens
 
 
@@ -126,7 +133,8 @@ def main() -> None:
     if "--dispatch" in sys.argv:
         dispatch = sys.argv[sys.argv.index("--dispatch") + 1]
     cfg, step, state, tokens, n_total, n_active = build(
-        dispatch, "--remat" in sys.argv
+        dispatch, "--remat" in sys.argv,
+        head="chunked" if "--chunked-head" in sys.argv else "fused",
     )
 
     carried = {"state": state}

@@ -97,7 +97,16 @@ def main() -> None:
         # 16k+ even saved matmul outputs (~700 MB/layer at 32k) exceed HBM,
         # so very long contexts use full per-block remat.
         remat=seq > SEQ,
-        remat_policy="full" if seq > 8192 else "dots",
+        # 16k+: 'flash' saves ONLY the flash kernel's out+lse (~68 MB/layer
+        # at 32k) — fits where dots_saveable OOMs, and the backward replay
+        # skips the S^2 kernel re-run that 'full' pays (round-4 rung;
+        # models/transformer.py resolve_remat_policy). --remat-policy
+        # overrides for A/B measurement.
+        remat_policy=(
+            sys.argv[sys.argv.index("--remat-policy") + 1]
+            if "--remat-policy" in sys.argv
+            else ("flash" if seq > 8192 else "dots")
+        ),
         dtype=jnp.bfloat16,
     )
     model = TransformerLM(cfg)

@@ -255,8 +255,11 @@ class MoEMLP(nn.Module):
                 )
             plan = route_top_k(logits, cfg.experts_per_token, C)
             expert_in, flat_idx = _gather_dispatch(x, plan, E, C, cfg.dtype)
-            h = nn.gelu(jnp.einsum("ebcm,emh->ebch", expert_in, wi))
-            out = jnp.einsum("ebch,ehm->ebcm", h, wo)
+            # [B,E,C,M] orientation end to end: the kernel gathers straight
+            # into it and the combine gathers straight out — no 42 MB
+            # [E,B,C,M] transposes in the hot loop (round-4 trace: 3.8 ms)
+            h = nn.gelu(jnp.einsum("becm,emh->bech", expert_in, wi))
+            out = jnp.einsum("bech,ehm->becm", h, wo)
             y = _gather_combine(out, plan, flat_idx, S)
             aux_loss = plan.aux_loss
         elif cfg.dispatch == "a2a":
@@ -267,7 +270,9 @@ class MoEMLP(nn.Module):
                 )
             plan = route_top_k(logits, cfg.experts_per_token, C)
             expert_in, flat_idx = _gather_dispatch(x, plan, E, C, cfg.dtype)
-            out = _expert_compute_a2a(expert_in, wi, wo, cfg.mesh)
+            out = _expert_compute_a2a(
+                expert_in.transpose(1, 0, 2, 3), wi, wo, cfg.mesh
+            ).transpose(1, 0, 2, 3)
             y = _gather_combine(out, plan, flat_idx, S)
             aux_loss = plan.aux_loss
         else:
@@ -278,13 +283,17 @@ class MoEMLP(nn.Module):
 
 def _gather_dispatch(x, plan: RoutingPlan, E: int, C: int, dtype):
     """Index-based (zero-matmul-FLOP) dispatch: x [B,S,M] → expert slots
-    [E,B,C,M] + the slot indices for the return trip.
+    [B,E,C,M] + the slot indices for the return trip.
 
     The one-hot einsum dispatch costs 2*B*S*(E*C)*M FLOPs (E*C ≈
     k*capacity_factor*S, effectively quadratic in S — as much as the expert
     matmuls at bench scale); static-shape scatter/gather moves the same
     tokens for free. Slots are collision-free by construction; dropped
-    tokens land in an overflow bucket, empty slots read a zero row."""
+    tokens land in an overflow bucket, empty slots read a zero row.
+
+    The row movement itself runs as the Pallas gather kernel
+    (``ops/moe_dispatch.gather_rows``): XLA's row-gather measured
+    20-85 GB/s — ~22 ms of the round-4 90 ms step was this shuffling."""
     B, S, M = x.shape
     k_choices = plan.experts.shape[0]
     flat_idx = plan.experts * C + plan.pos                    # [k,B,S]
@@ -299,24 +308,33 @@ def _gather_dispatch(x, plan: RoutingPlan, E: int, C: int, dtype):
     x_pad = jnp.concatenate(
         [x.astype(dtype), jnp.zeros((B, 1, M), dtype)], axis=1
     )
-    expert_in = jnp.take_along_axis(
-        x_pad, slot_token[..., None], axis=1
-    ).reshape(B, E, C, M).transpose(1, 0, 2, 3)               # [E,B,C,M]
+    from kubeflow_tpu.ops.moe_dispatch import gather_rows
+
+    expert_in = gather_rows(x_pad, slot_token).reshape(B, E, C, M)
     return expert_in, flat_idx
 
 
 def _gather_combine(out, plan: RoutingPlan, flat_idx, S: int):
-    """Weighted return trip of _gather_dispatch: [E,B,C,M] → [B,S,M] f32."""
-    E, B, C, M = out.shape
+    """Weighted return trip of _gather_dispatch: [B,E,C,M] → [B,S,M] f32.
+
+    Slot indices are injective per choice (distinct (expert, pos) pairs by
+    construction), so the Pallas gather runs with ``unique_indices=True``
+    — dropped tokens clamp onto the zero OVERFLOW row, whose gradient is
+    discarded with the padding, so their index collisions there are
+    harmless."""
+    B, E, C, M = out.shape
     k_choices = flat_idx.shape[0]
-    out_flat = out.transpose(1, 0, 2, 3).reshape(B, E * C, M)
+    from kubeflow_tpu.ops.moe_dispatch import gather_rows
+
+    out_pad = jnp.concatenate(
+        [out.reshape(B, E * C, M), jnp.zeros((B, 1, M), out.dtype)], axis=1
+    )
     y = jnp.zeros((B, S, M), jnp.float32)
     for j in range(k_choices):
-        tok = jnp.take_along_axis(
-            out_flat,
-            jnp.minimum(flat_idx[j], E * C - 1)[..., None],
-            axis=1,
-        )                                                      # [B,S,M]
+        idx = jnp.where(
+            plan.keep[j] > 0, flat_idx[j], E * C
+        ).astype(jnp.int32)
+        tok = gather_rows(out_pad, idx, unique_indices=True)   # [B,S,M]
         w = (plan.gates[j] * plan.keep[j])[..., None]
         y = y + w * tok.astype(jnp.float32)
     return y
@@ -461,9 +479,27 @@ def moe_lm_loss(model: MoETransformerLM, params, tokens):
     return jnp.mean(nll) + model.cfg.aux_loss_weight * _mean_aux(inter)
 
 
-def moe_lm_loss_chunked(model: MoETransformerLM, params, tokens, *, chunk=512):
+def moe_lm_loss_fused(model: MoETransformerLM, params, tokens):
+    """moe_lm_loss via the fused Pallas head (ops/fused_head_loss.py): the
+    [B, S, vocab] logits exist only as VMEM tiles and the embed grad
+    accumulates in-kernel instead of riding a scan carry — the round-4 MoE
+    trace put the scan-based chunked head at ~27 ms of a 106 ms step."""
+    from kubeflow_tpu.ops.fused_head_loss import fused_head_nll
+
+    hidden, inter = model.apply(
+        {"params": params}, tokens, mutable=["intermediates"],
+        return_hidden=True,
+    )
+    nll = fused_head_nll(hidden, params["embed"]["embedding"], tokens)
+    return nll + model.cfg.aux_loss_weight * _mean_aux(inter)
+
+
+def moe_lm_loss_chunked(
+    model: MoETransformerLM, params, tokens, *, chunk=512, compute_dtype=None
+):
     """moe_lm_loss via the chunked tied head (lm_loss_chunked) — the
-    [B, S, vocab] fp32 logits never materialize."""
+    [B, S, vocab] fp32 logits never materialize. ``compute_dtype`` passes
+    through (default bf16 operands / f32 accumulation — MXU rate)."""
     from kubeflow_tpu.models.transformer import lm_loss_chunked
 
     hidden, inter = model.apply(
@@ -471,6 +507,7 @@ def moe_lm_loss_chunked(model: MoETransformerLM, params, tokens, *, chunk=512):
         return_hidden=True,
     )
     nll = lm_loss_chunked(
-        hidden, params["embed"]["embedding"], tokens, chunk=chunk
+        hidden, params["embed"]["embedding"], tokens, chunk=chunk,
+        compute_dtype=compute_dtype,
     )
     return nll + model.cfg.aux_loss_weight * _mean_aux(inter)

@@ -347,7 +347,9 @@ def lm_loss(logits, tokens):
     return jnp.mean(nll)
 
 
-def lm_loss_chunked(hidden, embedding, tokens, *, chunk: int = 512):
+def lm_loss_chunked(
+    hidden, embedding, tokens, *, chunk: int = 512, compute_dtype=None
+):
     """Next-token cross entropy with the tied head folded in, chunked over
     the sequence so the [B, S, vocab] fp32 logits (and log-softmax residual —
     ~4 GB at batch 8 / seq 2048 / vocab 32k) never exist at once.
@@ -356,9 +358,18 @@ def lm_loss_chunked(hidden, embedding, tokens, *, chunk: int = 512):
     ``embedding`` the tied [vocab, E] table. Each scan step computes one
     chunk's logits on the MXU and reduces to scalars under ``jax.checkpoint``,
     so the backward recomputes per-chunk logits instead of saving them.
-    Identical math to ``lm_loss(embed.attend(hidden), tokens)``.
+    Same math as ``lm_loss(embed.attend(hidden), tokens)``.
+
+    ``compute_dtype`` sets the matmul OPERAND precision; accumulation and
+    everything past the logits (logsumexp, gather, reductions) stay fp32
+    either way. Default bfloat16: the MXU runs bf16-operand/f32-accumulate
+    at full rate while fp32 operands cost ~4x — the round-4 MoE step trace
+    measured the fp32 head at 27 ms of a 106 ms step, ~3x its bf16
+    matmul-floor cost. Pass ``jnp.float32`` for bit-level parity with the
+    unchunked reference loss.
     """
     B, S, E = hidden.shape
+    compute_dtype = compute_dtype or jnp.bfloat16
     c = min(chunk, S)
     if S % c:
         raise ValueError(f"chunk {c} must divide seq len {S}")
@@ -376,9 +387,14 @@ def lm_loss_chunked(hidden, embedding, tokens, *, chunk: int = 512):
     @partial(jax.checkpoint, prevent_cse=False)
     def body(carry, xs):
         h_c, t_c, m_c = xs                                # [B,c,E] [B,c] [B,c]
-        # upcast per chunk (a whole-sequence fp32 copy would defeat the point)
+        # operands in compute_dtype, accumulate f32 (a whole-sequence fp32
+        # copy would defeat the point; fp32 operands would run the MXU at
+        # quarter rate — see docstring)
         logits = jnp.einsum(
-            "bce,ve->bcv", h_c.astype(jnp.float32), embedding.astype(jnp.float32)
+            "bce,ve->bcv",
+            h_c.astype(compute_dtype),
+            embedding.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
         )
         logz = jax.scipy.special.logsumexp(logits, axis=-1)      # [B,c]
         gold = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]

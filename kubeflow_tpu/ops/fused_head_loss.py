@@ -1,0 +1,337 @@
+"""Fused LM-head + softmax-cross-entropy as Pallas TPU kernels.
+
+The chunked tied-head loss (``models/transformer.py lm_loss_chunked``) still
+materializes per-chunk ``[C, V]`` fp32 logits in HBM (512 MB at C=4096,
+V=32k) and re-reads them for logsumexp/softmax; the round-4 MoE step trace
+measured the head at ~27 ms of a 106 ms step against an ~11 ms matmul floor
+— the excess is exactly that logits traffic plus the scan-carried fp32
+embed-grad read-modify-write.
+
+These kernels stream VOCAB BLOCKS through VMEM the way flash attention
+streams KV blocks (``ops/pallas_attention.py`` — same scratch/lane and
+two-kernel-backward conventions): the logits tile never leaves VMEM, HBM
+traffic is hidden-states + embedding (+ their grads), and the only
+residuals are the per-token ``lse`` and gold logit.
+
+- forward: grid (token_blocks, vocab_blocks), vocab innermost (sequential);
+  VMEM scratch carries the streaming-softmax state (m, s) and the gold
+  accumulator; emits ``lse [T, 8]`` / ``gold [T, 8]`` on the last vocab
+  step (8 f32 sublanes, the LSE_LANES convention).
+- backward, FlashAttention-2 style split: a dh kernel on grid (nT, nV)
+  accumulating the token block's grad in VMEM, and a dE kernel on grid
+  (nV, nT) accumulating the vocab block's grad — each recomputes block
+  logits from the saved lse, so nothing quadratic is ever stored.
+- matmuls feed the MXU in bf16 with f32 accumulation; softmax bookkeeping
+  on the VPU in f32.
+
+Public entry ``head_lse_gold(h, emb, tgt)`` is shape-guarded: token/vocab
+counts that don't tile (or a missing TPU) fall back to an einsum reference
+with identical semantics, so callers never need their own guard.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from kubeflow_tpu.ops.pallas_attention import (
+    LSE_LANES,
+    _auto_interpret,
+    _compiler_params,
+    _scratch,
+)
+
+_TRANS_B = (((1,), (1,)), ((), ()))  # a @ b.T on 2D blocks
+_NOTRANS = (((1,), (0,)), ((), ()))  # a @ b
+
+BLOCK_T = 256
+
+
+def _pick_block_v(v: int, limit: int) -> int | None:
+    """Largest divisor of V that is a multiple of 128 and <= limit.
+
+    Per-kernel limits (16 MB VMEM): the forward holds emb[bv,E]bf16 +
+    logits[bt,bv]f32; dh adds a p tile; dE additionally carries a
+    [bv, E] f32 accumulator, so its vocab block must be much smaller —
+    one size for all three OOMs the dE scratch (measured: 38.5 MB asked
+    at bv=3200, E=1024)."""
+    best = None
+    for bv in range(128, limit + 1, 128):
+        if v % bv == 0:
+            best = bv
+    return best
+
+
+BV_FWD_LIMIT = 3328
+BV_DH_LIMIT = 1664
+BV_DE_LIMIT = 768
+
+
+# ------------------------------------------------------------------ forward
+
+
+def _fwd_kernel(tgt_ref, h_ref, emb_ref, lse_ref, gold_ref,
+                m_scr, s_scr, g_scr, *, bt, bv, nv):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        s_scr[...] = jnp.zeros_like(s_scr)
+        g_scr[...] = jnp.zeros_like(g_scr)
+
+    logits = lax.dot_general(
+        h_ref[...], emb_ref[...], _TRANS_B,
+        preferred_element_type=jnp.float32,
+    )                                                   # [bt, bv]
+    col = j * bv + lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    tgt = tgt_ref[...][:, :1]                           # [bt, 1]
+    hit = col == tgt                                    # [bt, bv]
+
+    m_prev = m_scr[...][:, :1]                          # [bt, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    s_new = s_scr[...][:, :1] * alpha + jnp.sum(
+        jnp.exp(logits - m_new), axis=1, keepdims=True
+    )
+    gold_new = g_scr[...][:, :1] + jnp.sum(
+        jnp.where(hit, logits, 0.0), axis=1, keepdims=True
+    )
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    s_scr[...] = jnp.broadcast_to(s_new, s_scr.shape)
+    g_scr[...] = jnp.broadcast_to(gold_new, g_scr.shape)
+
+    @pl.when(j == nv - 1)
+    def _():
+        lse_ref[...] = jnp.broadcast_to(
+            m_new + jnp.log(s_new), lse_ref.shape
+        )
+        gold_ref[...] = jnp.broadcast_to(gold_new, gold_ref.shape)
+
+
+def _fwd_call(h, emb, tgt2, *, bt, bv, interpret):
+    T, E = h.shape
+    V = emb.shape[0]
+    nt, nv = T // bt, V // bv
+    lse, gold = pl.pallas_call(
+        functools.partial(_fwd_kernel, bt=bt, bv=bv, nv=nv),
+        grid=(nt, nv),
+        in_specs=[
+            pl.BlockSpec((bt, LSE_LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((bt, E), lambda i, j: (i, 0)),
+            pl.BlockSpec((bv, E), lambda i, j: (j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bt, LSE_LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((bt, LSE_LANES), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, LSE_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((T, LSE_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            _scratch((bt, LSE_LANES)),
+            _scratch((bt, LSE_LANES)),
+            _scratch((bt, LSE_LANES)),
+        ],
+        compiler_params=_fused_params(interpret),
+        interpret=interpret,
+    )(tgt2, h, emb)
+    return lse[:, 0], gold[:, 0]
+
+
+def _fused_params(interpret):
+    # 2-D grid variant of pallas_attention._compiler_params
+    params = _compiler_params(interpret)
+    if params is None:
+        return None
+    return type(params)(dimension_semantics=("parallel", "arbitrary"))
+
+
+# ----------------------------------------------------------------- backward
+
+
+def _dh_kernel(tgt_ref, dlse_ref, dgold_ref, h_ref, emb_ref, lse_ref,
+               dh_ref, acc_scr, *, bv, nv):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    logits = lax.dot_general(
+        h_ref[...], emb_ref[...], _TRANS_B,
+        preferred_element_type=jnp.float32,
+    )
+    p = jnp.exp(logits - lse_ref[...][:, :1])
+    col = j * bv + lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    y = (col == tgt_ref[...][:, :1]).astype(jnp.float32)
+    dlogits = dlse_ref[...][:, :1] * p + dgold_ref[...][:, :1] * y
+    acc_scr[...] += lax.dot_general(
+        dlogits.astype(emb_ref.dtype), emb_ref[...], _NOTRANS,
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == nv - 1)
+    def _():
+        dh_ref[...] = acc_scr[...]
+
+
+def _de_kernel(tgt_ref, dlse_ref, dgold_ref, h_ref, emb_ref, lse_ref,
+               de_ref, acc_scr, *, bt, bv, nt):
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    logits = lax.dot_general(
+        h_ref[...], emb_ref[...], _TRANS_B,
+        preferred_element_type=jnp.float32,
+    )
+    p = jnp.exp(logits - lse_ref[...][:, :1])
+    j = pl.program_id(0)
+    col = j * bv + lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    y = (col == tgt_ref[...][:, :1]).astype(jnp.float32)
+    dlogits = dlse_ref[...][:, :1] * p + dgold_ref[...][:, :1] * y
+    # dE_j += dlogits^T @ h_i
+    acc_scr[...] += lax.dot_general(
+        dlogits.astype(h_ref.dtype), h_ref[...],
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == nt - 1)
+    def _():
+        de_ref[...] = acc_scr[...]
+
+
+def _bwd_call(h, emb, tgt2, lse2, dlse2, dgold2, *, bt, bv_dh, bv_de,
+              interpret):
+    T, E = h.shape
+    V = emb.shape[0]
+    nt = T // bt
+    bv, nv = bv_dh, V // bv_dh
+    tok_spec = pl.BlockSpec((bt, LSE_LANES), lambda i, j: (i, 0))
+    dh = pl.pallas_call(
+        functools.partial(_dh_kernel, bv=bv, nv=nv),
+        grid=(nt, nv),
+        in_specs=[
+            tok_spec, tok_spec, tok_spec,
+            pl.BlockSpec((bt, E), lambda i, j: (i, 0)),
+            pl.BlockSpec((bv, E), lambda i, j: (j, 0)),
+            tok_spec,
+        ],
+        out_specs=pl.BlockSpec((bt, E), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((T, E), jnp.float32),
+        scratch_shapes=[_scratch((bt, E))],
+        compiler_params=_fused_params(interpret),
+        interpret=interpret,
+    )(tgt2, dlse2, dgold2, h, emb, lse2)
+
+    bv, nv = bv_de, V // bv_de
+    tok_minor = pl.BlockSpec((bt, LSE_LANES), lambda j, i: (i, 0))
+    de = pl.pallas_call(
+        functools.partial(_de_kernel, bt=bt, bv=bv, nt=nt),
+        grid=(nv, nt),
+        in_specs=[
+            tok_minor, tok_minor, tok_minor,
+            pl.BlockSpec((bt, E), lambda j, i: (i, 0)),
+            pl.BlockSpec((bv, E), lambda j, i: (j, 0)),
+            tok_minor,
+        ],
+        out_specs=pl.BlockSpec((bv, E), lambda j, i: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((V, E), jnp.float32),
+        scratch_shapes=[_scratch((bv, E))],
+        compiler_params=_fused_params(interpret),
+        interpret=interpret,
+    )(tgt2, dlse2, dgold2, h, emb, lse2)
+    return dh, de
+
+
+# ------------------------------------------------------------- public entry
+
+
+def _reference_lse_gold(h, emb, tgt):
+    logits = jnp.einsum(
+        "te,ve->tv", h, emb, preferred_element_type=jnp.float32
+    )
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, tgt[:, None], axis=1)[:, 0]
+    return lse, gold
+
+
+def _lanes(x):
+    """[T] -> [T, LSE_LANES] broadcast (the kernels' row-scalar layout)."""
+    return jnp.broadcast_to(x[:, None], (x.shape[0], LSE_LANES))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def head_lse_gold(h, emb, tgt, bt, bvs, interpret):
+    lse, gold = _fwd_call(
+        h, emb, _lanes(tgt).astype(jnp.int32),
+        bt=bt, bv=bvs[0], interpret=interpret,
+    )
+    return lse, gold
+
+
+def _vjp_fwd(h, emb, tgt, bt, bvs, interpret):
+    lse, gold = head_lse_gold(h, emb, tgt, bt, bvs, interpret)
+    return (lse, gold), (h, emb, tgt, lse)
+
+
+def _vjp_bwd(bt, bvs, interpret, res, g):
+    h, emb, tgt, lse = res
+    dlse, dgold = g
+    dh, de = _bwd_call(
+        h, emb, _lanes(tgt).astype(jnp.int32), _lanes(lse),
+        _lanes(dlse), _lanes(dgold), bt=bt, bv_dh=bvs[1], bv_de=bvs[2],
+        interpret=interpret,
+    )
+    return dh.astype(h.dtype), de, None
+
+
+head_lse_gold.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def fused_lse_gold(h, emb, tgt, *, interpret: bool | None = None):
+    """(lse [T], gold [T]) for logits = h @ emb^T without materializing
+    them. h [T, E] (any float dtype; fed to the MXU as-is), emb [V, E],
+    tgt [T] int32. Falls back to the einsum reference when the shapes
+    don't tile (T % 256, no 128-multiple divisor of V) — identical math.
+    """
+    T, E = h.shape
+    V = emb.shape[0]
+    bt = BLOCK_T if T % BLOCK_T == 0 else None
+    bvs = tuple(
+        _pick_block_v(V, lim)
+        for lim in (BV_FWD_LIMIT, BV_DH_LIMIT, BV_DE_LIMIT)
+    )
+    if bt is None or any(b is None for b in bvs):
+        return _reference_lse_gold(h, emb, tgt)
+    if interpret is None:
+        interpret = _auto_interpret()
+    return head_lse_gold(h, emb, tgt, bt, bvs, interpret)
+
+
+def fused_head_nll(hidden, embedding, tokens, *, compute_dtype=jnp.bfloat16,
+                   interpret: bool | None = None):
+    """Mean next-token NLL over [B, S] tokens with the tied head fused.
+
+    Drop-in for ``lm_loss_chunked`` (same contract: hidden [B, S, E] from
+    ``return_hidden=True``, tied ``embedding [V, E]``); the [B*S, V] logits
+    exist only as VMEM tiles.
+    """
+    B, S, E = hidden.shape
+    h = hidden.reshape(B * S, E).astype(compute_dtype)
+    emb = embedding.astype(compute_dtype)
+    tgt = jnp.roll(tokens, -1, axis=1).reshape(B * S).astype(jnp.int32)
+    mask = jnp.concatenate(
+        [jnp.ones((B, S - 1), jnp.float32), jnp.zeros((B, 1), jnp.float32)],
+        axis=1,
+    ).reshape(B * S)
+    lse, gold = fused_lse_gold(h, emb, tgt, interpret=interpret)
+    return jnp.sum((lse - gold) * mask) / jnp.sum(mask)

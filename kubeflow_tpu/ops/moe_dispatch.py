@@ -1,0 +1,282 @@
+"""MoE dispatch/combine row movement as Pallas TPU kernels.
+
+The gather-dispatch MoE path moves token rows with XLA gathers/scatters
+that measured 20-85 GB/s on chip — ~22 ms of the 90 ms round-4 MoE step
+(trace: `benchmarks/trace_anatomy.py moe`), pure data movement against a
+~750 GB/s part. The reason is access pattern, not volume: XLA lowers
+row-gather to per-element work, while each gathered row is a contiguous
+2 KB slab.
+
+These kernels keep the SOURCE resident in VMEM (one batch row of the
+token/slot table is 4-11 MB — it fits) and stream rows VMEM→VMEM with a
+scalar-prefetched index vector steering per-row dynamic loads, the same
+scalar-prefetch steering ``ops/flash_decode.py`` uses for cache blocks:
+
+- ``gather_rows(x, idx)``: out[b, j] = x[b, idx[b, j]] — the dispatch
+  (tokens → expert slots) and combine (slots → tokens) forward.
+- backward = the matching scatter kernel. ``unique_indices=True``
+  (combine: slots are injective by construction) scatters by direct store
+  in the input dtype; the default accumulates in f32 (dispatch: a token
+  can sit in k slots, so its gradient rows collide).
+
+Shape guard: falls back to ``jnp.take_along_axis`` when a batch row
+exceeds the VMEM budget or J doesn't tile — identical semantics, so
+callers never branch.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from kubeflow_tpu.ops.pallas_attention import _auto_interpret
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+BLOCK_J = 256
+VMEM_ROW_BUDGET = 12 << 20  # resident [R, M] source/dest per batch row
+
+
+def _gather_kernel(idx_ref, x_ref, out_ref, tab_scr, *, bj, br, n_load,
+                   n_rows):
+    """Phase 1 (steps < n_load): copy x tiles into the scratch table.
+    Phase 2: stream rows out of scratch. Scratch is single-buffered; a
+    whole-row in/out BLOCK would be double-buffered by Mosaic — 2 x 8.4 MB
+    blew the 16 MB scoped-vmem budget (measured)."""
+    b = pl.program_id(0)
+    step = pl.program_id(1)
+
+    @pl.when(step < n_load)
+    def _():
+        tab_scr[pl.dslice(step * br, br), :, :] = x_ref[0].astype(
+            tab_scr.dtype
+        )
+
+    @pl.when(step >= n_load)
+    def _():
+        jb = step - n_load
+
+        def body(i, _):
+            row = idx_ref[b, jb * bj + i]
+            # sentinel (row >= R): zero row — callers reference "row R"
+            # instead of physically padding the table with a zero row
+            # (the pad concat alone cost 0.75 ms/layer on chip)
+            safe = jnp.minimum(row, tab_scr.shape[0] - 1)
+            val = tab_scr[pl.dslice(safe, 1), :, :].astype(out_ref.dtype)
+            val = jnp.where(row < n_rows, val, jnp.zeros_like(val))
+            out_ref[0, pl.dslice(i, 1), :, :] = val
+            return 0
+
+        lax.fori_loop(0, bj, body, 0)
+
+
+def _scatter_kernel(idx_ref, dy_ref, out_ref, tab_scr, *, bj, br, nj,
+                    accumulate, n_rows):
+    """Phase 1 (steps < nj): scatter dy tiles into the scratch table
+    (zeroed at step 0). Phase 2: copy scratch out in tiles."""
+    b = pl.program_id(0)
+    step = pl.program_id(1)
+
+    @pl.when(step == 0)
+    def _():
+        R_pad = tab_scr.shape[0]
+        zero = jnp.zeros((br,) + tab_scr.shape[1:], tab_scr.dtype)
+
+        def zbody(h, _):
+            tab_scr[pl.dslice(h * br, br), :, :] = zero
+            return 0
+
+        lax.fori_loop(0, R_pad // br, zbody, 0)
+
+    @pl.when(step < nj)
+    def _():
+        def body(i, _):
+            row = idx_ref[b, step * bj + i]
+            # sentinel rows (>= n_rows) carry no gradient: redirect the
+            # store at a scratch-only spill row past the real table
+            safe = jnp.where(row < n_rows, row, n_rows)
+            val = dy_ref[0, pl.dslice(i, 1), :, :][0].astype(tab_scr.dtype)
+            if accumulate:
+                val = val + tab_scr[pl.dslice(safe, 1), :, :][0]
+            tab_scr[pl.dslice(safe, 1), :, :] = val[None]
+            return 0
+
+        lax.fori_loop(0, bj, body, 0)
+
+    @pl.when(step >= nj)
+    def _():
+        rb = step - nj
+        out_ref[0] = tab_scr[pl.dslice(rb * br, br), :, :].astype(
+            out_ref.dtype
+        )
+
+
+BLOCK_R = 256  # table load/flush tile (rows)
+
+
+def _pad_rows(a, R_pad):
+    B, R, M = a.shape
+    if R == R_pad:
+        return a
+    return jnp.concatenate(
+        [a, jnp.zeros((B, R_pad - R, M), a.dtype)], axis=1
+    )
+
+
+def _gather_grid_call(idx, x, interpret):
+    B, J = idx.shape
+    _, R, M = x.shape
+    bj, br, sub = BLOCK_J, BLOCK_R, M // 128
+    R_pad = -(-R // br) * br
+    x4 = _pad_rows(x, R_pad).reshape(B, R_pad, sub, 128)
+    n_load, nj = R_pad // br, J // bj
+    out = pl.pallas_call(
+        functools.partial(
+            _gather_kernel, bj=bj, br=br, n_load=n_load, n_rows=R
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, n_load + nj),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, br, sub, 128),
+                    lambda b, st, idx_ref: (b, jnp.minimum(st, n_load - 1), 0, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, bj, sub, 128),
+                lambda b, st, idx_ref: (b, jnp.maximum(st - n_load, 0), 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((R_pad, sub, 128), x.dtype),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, J, sub, 128), x.dtype),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(idx, x4)
+    return out.reshape(B, J, M)
+
+
+def _scatter_grid_call(idx, dy, R, out_dtype, accumulate, interpret):
+    B, J = idx.shape
+    M = dy.shape[2]
+    bj, br, sub = BLOCK_J, BLOCK_R, M // 128
+    R_pad = -(-(R + 1) // br) * br  # +1: sentinel stores spill past row R
+    dy4 = dy.reshape(B, J, sub, 128)
+    nj, n_flush = J // bj, R_pad // br
+    out = pl.pallas_call(
+        functools.partial(
+            _scatter_kernel, bj=bj, br=br, nj=nj, accumulate=accumulate,
+            n_rows=R,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, nj + n_flush),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, bj, sub, 128),
+                    lambda b, st, idx_ref: (b, jnp.minimum(st, nj - 1), 0, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, br, sub, 128),
+                lambda b, st, idx_ref: (b, jnp.maximum(st - nj, 0), 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM(
+                    (R_pad, sub, 128),
+                    jnp.float32 if accumulate else out_dtype,
+                ),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, R_pad, sub, 128), out_dtype),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(idx, dy4)
+    return out.reshape(B, R_pad, M)[:, :R]
+
+
+def _fits(R: int, M: int, itemsize: int) -> bool:
+    return R * M * itemsize <= VMEM_ROW_BUDGET
+
+
+def _gather_ref(x, idx):
+    """Sentinel semantics: idx >= R reads a zero row (and carries no
+    gradient — where() zeroes the cotangent path too)."""
+    R = x.shape[1]
+    safe = jnp.minimum(idx, R - 1)
+    rows = jnp.take_along_axis(x, safe[..., None], axis=1)
+    return jnp.where((idx < R)[..., None], rows, 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _gather_rows_p(x, idx, unique_indices, interpret):
+    return _gather_call(x, idx, interpret)
+
+
+def _gather_call(x, idx, interpret):
+    return _gather_grid_call(idx, x, interpret)
+
+
+def _gather_fwd(x, idx, unique_indices, interpret):
+    # dtype/shape ride along as a zero-size token (residuals must be arrays)
+    token = jnp.zeros(x.shape[:2] + (0,), x.dtype)
+    return _gather_rows_p(x, idx, unique_indices, interpret), (idx, token)
+
+
+def _gather_bwd(unique_indices, interpret, res, dy):
+    idx, token = res
+    x_dtype = token.dtype
+    B, R = token.shape[:2]
+    M = dy.shape[2]
+    # unique (combine: injective slots): direct store in the cotangent
+    # dtype; default (dispatch: a token in k slots collides): f32 adds
+    dx = _scatter_grid_call(
+        idx, dy, R,
+        out_dtype=dy.dtype if unique_indices else jnp.float32,
+        accumulate=not unique_indices,
+        interpret=interpret,
+    )
+    return dx.astype(x_dtype), None
+
+
+_gather_rows_p.defvjp(_gather_fwd, _gather_bwd)
+
+
+def gather_rows(x, idx, *, unique_indices: bool = False,
+                interpret: bool | None = None):
+    """out[b, j, :] = x[b, idx[b, j], :] at HBM streaming rate.
+
+    x ``[B, R, M]``, idx ``[B, J]`` int32 in [0, R). Differentiable in x
+    (bwd is the scatter kernel; ``unique_indices=True`` promises no index
+    repeats per batch row, enabling the cheaper direct-store scatter —
+    same contract as ``jax.lax`` scatter's ``unique_indices``). Falls back
+    to ``take_along_axis`` when a batch row exceeds the VMEM budget, M is
+    not lane-aligned, or J doesn't tile.
+    """
+    B, R, M = x.shape
+    J = idx.shape[1]
+    if (
+        M % 128
+        or J % BLOCK_J
+        or not _fits(R, M, x.dtype.itemsize)
+        or not _fits(R, M, 4)  # the f32 scatter accumulator in bwd
+    ):
+        return _gather_ref(x, idx)
+    if interpret is None:
+        interpret = _auto_interpret()
+    return _gather_rows_p(x, idx.astype(jnp.int32), unique_indices, interpret)

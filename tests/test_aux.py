@@ -231,3 +231,17 @@ class TestPerfGate:
         self._write(tmp_path, "MULTI_r01.json", {"ok": True})
         self._write(tmp_path, "MULTI_r02.json", {"ok": True})
         assert perf_gate.main(["--repo", str(tmp_path)]) == 0
+
+    def test_declared_non_comparability_skips_gating(self, tmp_path):
+        import sys
+        sys.path.insert(0, str(REPO_TOOLS))
+        import perf_gate
+
+        self._write(tmp_path, "CHURN_r01.json",
+                    {"phases": {"create": {"p50": 1.0}}})
+        self._write(tmp_path, "CHURN_r02.json",
+                    {"phases": {"create": {"p50": 9.0}},
+                     "not_comparable_with_previous": "host changed"})
+        assert perf_gate.main(["--repo", str(tmp_path)]) == 0
+        report = perf_gate.compare(tmp_path, 0.05)
+        assert report["families"]["CHURN"]["not_comparable"] == "host changed"

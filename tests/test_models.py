@@ -237,6 +237,15 @@ class TestTransformer:
             return lm_loss(model.apply({"params": p}, tokens), tokens)
 
         def chunked(p):
+            # fp32 operands: this test pins BIT-LEVEL parity with the
+            # reference loss; the bf16-operand default is covered below
+            hidden = model.apply({"params": p}, tokens, return_hidden=True)
+            return lm_loss_chunked(
+                hidden, p["embed"]["embedding"], tokens, chunk=16,
+                compute_dtype=jnp.float32,
+            )
+
+        def chunked_bf16(p):
             hidden = model.apply({"params": p}, tokens, return_hidden=True)
             return lm_loss_chunked(
                 hidden, p["embed"]["embedding"], tokens, chunk=16
@@ -252,6 +261,11 @@ class TestTransformer:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=1e-5
             )
+        # default (bf16 operands, f32 accumulate): same loss to bf16 input
+        # precision — the MXU-rate configuration the benches train with
+        np.testing.assert_allclose(
+            float(full(p)), float(chunked_bf16(p)), rtol=5e-3
+        )
 
     def test_chunked_loss_rejects_indivisible(self):
         from kubeflow_tpu.models.transformer import lm_loss_chunked

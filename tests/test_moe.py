@@ -187,12 +187,16 @@ def test_moe_chunked_loss_matches_full():
     )
     params = model.init(jax.random.PRNGKey(0), tokens)["params"]
     full = float(moe_lm_loss(model, params, tokens))
-    chunked = float(moe_lm_loss_chunked(model, params, tokens, chunk=16))
+    chunked = float(moe_lm_loss_chunked(
+        model, params, tokens, chunk=16, compute_dtype=jnp.float32
+    ))
     np.testing.assert_allclose(full, chunked, rtol=1e-6)
 
     g_full = jax.grad(lambda p: moe_lm_loss(model, p, tokens))(params)
     g_chunk = jax.grad(
-        lambda p: moe_lm_loss_chunked(model, p, tokens, chunk=16)
+        lambda p: moe_lm_loss_chunked(
+            model, p, tokens, chunk=16, compute_dtype=jnp.float32
+        )
     )(params)
     for a, b in zip(
         jax.tree_util.tree_leaves(g_full), jax.tree_util.tree_leaves(g_chunk)

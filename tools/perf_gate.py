@@ -77,12 +77,21 @@ def compare(repo: pathlib.Path, tolerance: float) -> dict:
             continue
         new_r, old_r = sorted(rounds)[-1], sorted(rounds)[-2]
         try:
-            old = extract_metrics(
-                family, json.loads(rounds[old_r].read_text())
-            )
-            new = extract_metrics(
-                family, json.loads(rounds[new_r].read_text())
-            )
+            old_payload = json.loads(rounds[old_r].read_text())
+            new_payload = json.loads(rounds[new_r].read_text())
+            marker = new_payload.get("not_comparable_with_previous")
+            if isinstance(marker, str) and marker:
+                # the newer artifact declares the comparison invalid (e.g.
+                # the host changed between rounds) and says why — surface
+                # the note, don't gate on apples-to-oranges numbers
+                report["families"][family] = {
+                    "rounds": f"r{old_r:02d}->r{new_r:02d}",
+                    "metrics": {},
+                    "not_comparable": marker,
+                }
+                continue
+            old = extract_metrics(family, old_payload)
+            new = extract_metrics(family, new_payload)
         except (json.JSONDecodeError, OSError) as exc:
             report["regressions"].append(
                 {"family": family, "error": f"unreadable artifact: {exc}"}

@@ -16,7 +16,6 @@ mirrored there (and vice versa — both cite this note).
 """
 from __future__ import annotations
 
-import time
 from typing import Callable
 
 
@@ -40,3 +39,31 @@ def min_window_step_seconds(
         longs.append(window(n_long))
     sec = (min(longs) - min(shorts)) / (n_long - n_short)
     return sec, shorts, longs
+
+
+def ab_palindrome(
+    windows: dict[str, Callable[[int], float]],
+    n_short: int,
+    n_long: int,
+    repeats: int,
+) -> dict[str, float]:
+    """In-process A/B of two window fns with palindromic ordering (A B B A
+    per repeat — cancels linear drift) and min-over-windows per side.
+
+    Process-to-process phase drift on Pallas rows measured ±30%, so only an
+    in-process palindrome ranks variants honestly. Returns
+    ``{name: sec_per_unit}``. Call sites: moe_bench --ab/--ab-dispatch,
+    transformer_bench --ab-head, resnet_ab_probe (its own ABBA predates
+    this helper).
+    """
+    names = list(windows)
+    assert len(names) == 2, names
+    raw: dict[str, tuple[list, list]] = {n: ([], []) for n in names}
+    for _ in range(repeats):
+        for n in (names[0], names[1], names[1], names[0]):
+            raw[n][0].append(windows[n](n_short))
+            raw[n][1].append(windows[n](n_long))
+    return {
+        n: (min(longs) - min(shorts)) / (n_long - n_short)
+        for n, (shorts, longs) in raw.items()
+    }

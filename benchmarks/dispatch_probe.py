@@ -1,0 +1,127 @@
+"""Probe: MoE dispatch/combine row movement in isolation.
+
+The round-5 MoE step trace (trace_anatomy moe, fixed op-kind classifier)
+puts the `moe*` gather/scatter Pallas kernels at 11.0 ms of the 92.5 ms
+step — pure data movement of ~600 MB r+w/step, i.e. ~55 GB/s effective on
+a ~750 GB/s part. The per-row `lax.fori_loop` body (dynamic-slice read +
+predicated select + dynamic store of a [1, 8, 128] tile) costs ~70 cycles
+per 2 KB row, so the kernel is instruction-bound, not bandwidth-bound.
+
+This probe times gather_rows fwd and fwd+bwd at the bench shapes against
+the XLA take_along_axis reference, so kernel variants can be ranked in
+isolation before a full-step A/B. Same min-over-windows discipline as
+benchmarks/_timing.py.
+
+Usage: python benchmarks/dispatch_probe.py [--unroll N]
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.ops import moe_dispatch as md
+
+# bench shapes (moe_bench: B=4, S=2048, M=1024, E=8, C=640 -> J=5120)
+CASES = [
+    ("dispatch", dict(B=4, R=2049, M=1024, J=5120, unique=False)),
+    ("combine", dict(B=4, R=5121, M=1024, J=2048, unique=True)),
+]
+K = 128  # inner scan reps per dispatch (windows must dwarf the fixed sync cost)
+
+
+def make_run(fn, k, *args):
+    @functools.partial(jax.jit, static_argnames=())
+    def run(c0):
+        def body(c, _):
+            out = fn(c, *args)
+            return 1.0 + 0.0 * out.reshape(-1)[0].astype(jnp.float32), None
+
+        c, _ = jax.lax.scan(body, c0, None, length=k)
+        return c
+
+    return run
+
+
+def timeit(fn, repeats=8):
+    """min-over-windows differencing via benchmarks/_timing.py: min(short)
+    and min(long) are each window's uncontaminated time (stalls are
+    additive), and the fixed readback cost cancels in the difference —
+    differencing per-pair first lets one stalled short window go negative."""
+    from benchmarks import _timing
+
+    runs = {K: make_run(fn, K), 3 * K: make_run(fn, 3 * K)}
+    for r in runs.values():
+        float(r(jnp.float32(1.0)))
+
+    def window(n):
+        t0 = time.perf_counter()
+        float(runs[n](jnp.float32(1.0)))
+        return time.perf_counter() - t0
+
+    sec, _, _ = _timing.min_window_step_seconds(window, K, 3 * K, repeats)
+    return sec
+
+
+def main():
+    rng = np.random.default_rng(0)
+    out = {"metric": "dispatch_probe", "unit": "us/call", "cases": {}}
+    for name, c in CASES:
+        x = jnp.asarray(
+            rng.standard_normal((c["B"], c["R"], c["M"])), jnp.bfloat16
+        )
+        if c["unique"]:
+            idx = np.stack([
+                rng.permutation(c["R"])[: c["J"]] for _ in range(c["B"])
+            ]).astype(np.int32)
+        else:
+            idx = rng.integers(0, c["R"], (c["B"], c["J"])).astype(np.int32)
+        idx = jnp.asarray(idx)
+        mb = (c["B"] * c["J"] * c["M"] * 2) / 1e6  # rows moved, one way
+
+        def fwd_kernel(cc, x=x, idx=idx, u=c["unique"]):
+            return md.gather_rows(x * cc.astype(x.dtype), idx, unique_indices=u)
+
+        def fwd_ref(cc, x=x, idx=idx):
+            return md._gather_ref(x * cc.astype(x.dtype), idx)
+
+        # the carry must reach the COTANGENT: grad of sum(gather(x)) is
+        # x-independent, so XLA hoists the whole backward out of the scan
+        # (measured ~0) — multiplying the loss by cc keeps it honest
+        def grad_kernel(cc, x=x, idx=idx, u=c["unique"]):
+            return jax.grad(
+                lambda x: jnp.sum(
+                    md.gather_rows(x, idx, unique_indices=u).astype(
+                        jnp.float32
+                    )
+                ) * cc
+            )(x)
+
+        def grad_ref(cc, x=x, idx=idx):
+            return jax.grad(
+                lambda x: jnp.sum(
+                    md._gather_ref(x, idx).astype(jnp.float32)
+                ) * cc
+            )(x)
+
+        row = {}
+        for label, fn in [
+            ("fwd_kernel", fwd_kernel), ("fwd_xla", fwd_ref),
+            ("bwd_kernel", grad_kernel), ("bwd_xla", grad_ref),
+        ]:
+            t = timeit(fn)
+            row[label] = round(t * 1e6, 1)
+            row[f"{label}_gbps"] = round(2 * mb / 1e3 / t, 1)  # r+w
+        out["cases"][name] = row
+        print(name, row, file=sys.stderr)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

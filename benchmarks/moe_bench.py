@@ -19,6 +19,12 @@ convention; total params also reported. vs_baseline mirrors the dense bench:
 MFU / (0.90 * 0.40).
 
 Usage: python benchmarks/moe_bench.py [--dispatch einsum|gather] [--remat]
+       [--chunked-head] [--ab]
+
+``--ab`` measures the fused AND chunked heads in ONE process with
+palindromic window ordering (A B B A, the resnet_ab_probe convention):
+process-to-process phase drift on Pallas rows measured ±30%, so only an
+in-process palindrome says which head is actually faster.
 """
 import functools
 import json
@@ -128,15 +134,7 @@ def build_for_trace():
     return step, state, tokens
 
 
-def main() -> None:
-    dispatch = "gather"
-    if "--dispatch" in sys.argv:
-        dispatch = sys.argv[sys.argv.index("--dispatch") + 1]
-    cfg, step, state, tokens, n_total, n_active = build(
-        dispatch, "--remat" in sys.argv,
-        head="chunked" if "--chunked-head" in sys.argv else "fused",
-    )
-
+def _make_window(step, state, tokens):
     carried = {"state": state}
 
     def window(n):
@@ -147,6 +145,94 @@ def main() -> None:
         float(loss)
         return time.perf_counter() - t
 
+    return window
+
+
+def _ab_run(metric: str, sides: dict, extra: dict) -> None:
+    """Palindromic in-process A/B over two named step builders (the shared
+    ``_timing.ab_palindrome``). ``sides``: name -> dict(window, cfg,
+    n_active)."""
+    from benchmarks import _timing
+
+    names = list(sides)
+    for s in sides.values():
+        s["window"](N_SHORT)  # compile + warm
+    secs = _timing.ab_palindrome(
+        {n: sides[n]["window"] for n in names}, N_SHORT, N_LONG, REPEATS
+    )
+    cfg = sides[names[0]]["cfg"]
+    n_active = sides[names[0]]["n_active"]
+    attn = 12 * cfg.num_layers * cfg.embed_dim * SEQ * 0.5
+    peak = chip_peak_flops(jax.devices()[0])
+    out = {"metric": metric, "unit": "tok/s/chip",
+           "seq_len": SEQ, "per_chip_batch": BATCH, **extra}
+    for n in names:
+        tps = BATCH * SEQ / secs[n]
+        out[n] = round(tps, 1)
+        out[f"{n}_mfu"] = round(tps * (6 * n_active + attn) / peak, 4)
+    out[f"{names[0]}_over_{names[1]}"] = round(
+        out[names[0]] / out[names[1]], 4
+    )
+    print(json.dumps(out))
+
+
+def _ab_main(dispatch: str, remat: bool) -> None:
+    """fused vs chunked tied head."""
+    sides = {}
+    for head in ("fused", "chunked"):
+        cfg, step, state, tokens, n_total, n_active = build(
+            dispatch, remat, head=head
+        )
+        sides[head] = {
+            "window": _make_window(step, state, tokens),
+            "cfg": cfg, "n_active": n_active,
+        }
+    _ab_run("moe_head_ab", sides, {"dispatch": dispatch})
+
+
+def _ab_dispatch_main(remat: bool, head: str) -> None:
+    """Pallas row-movement kernels vs the XLA take_along_axis fallback,
+    full step (the isolated probe and the in-step behavior disagree —
+    benchmarks/dispatch_probe.py — so the step is the arbiter)."""
+    from kubeflow_tpu.ops import moe_dispatch as md
+
+    sides = {}
+    for name in ("kernel", "xla"):
+        saved = md.VMEM_ROW_BUDGET
+        if name == "xla":
+            md.VMEM_ROW_BUDGET = 0  # force the take_along_axis fallback
+        try:
+            cfg, step, state, tokens, n_total, n_active = build(
+                "gather", remat, head=head
+            )
+            sides[name] = {
+                "window": _make_window(step, state, tokens),
+                "cfg": cfg, "n_active": n_active,
+            }
+            sides[name]["window"](N_SHORT)  # compile while budget applies
+        finally:
+            md.VMEM_ROW_BUDGET = saved
+    _ab_run("moe_dispatch_ab", sides, {"head": head})
+
+
+def main() -> None:
+    dispatch = "gather"
+    if "--dispatch" in sys.argv:
+        dispatch = sys.argv[sys.argv.index("--dispatch") + 1]
+    if "--ab" in sys.argv:
+        _ab_main(dispatch, "--remat" in sys.argv)
+        return
+    if "--ab-dispatch" in sys.argv:
+        _ab_dispatch_main(
+            "--remat" in sys.argv,
+            head="chunked" if "--chunked-head" in sys.argv else "fused",
+        )
+        return
+    cfg, step, state, tokens, n_total, n_active = build(
+        dispatch, "--remat" in sys.argv,
+        head="chunked" if "--chunked-head" in sys.argv else "fused",
+    )
+    window = _make_window(step, state, tokens)
     window(N_SHORT)  # compile + warm
     from benchmarks import _timing
 

@@ -47,10 +47,12 @@ def capture(step_fn, state, batch):
     return LOGDIR
 
 
-# Matched against the INSTRUCTION NAME only (the token before ' = '), not
-# the full HLO text — operand names inside fusion(...) otherwise claim the
-# op for the wrong group (a conv fusion whose operand is %copy-done.3 would
-# count as a copy). Order matters: collectives before the reduce pattern
+# Matched against the INSTRUCTION NAME and the HLO OP KIND (the token after
+# the result type), each probed separately — NOT the full HLO text: operand
+# names inside fusion(...) otherwise claim the op for the wrong group (a
+# conv fusion whose operand is %copy-done.3 would count as a copy), while a
+# renamed instruction (%transpose_jvp = ... custom-call) must still bucket
+# by kind. Order matters: collectives before the reduce pattern
 # (all-reduce contains 'reduce'), pooling before it too (XLA emits
 # hyphenated reduce-window / select-and-scatter).
 GROUPS = [
@@ -100,13 +102,23 @@ def parse(logdir: str) -> dict:
 def report(parsed: dict, n_steps: int = N_STEPS) -> None:
     ops, total = parsed["ops"], parsed["total_us"]
     grouped = collections.defaultdict(float)
+    opkind_re = re.compile(r"=\s*(?:\([^)]*\)|\S+)\s+([a-z][a-z0-9._-]*)[(<]")
     for name, dur in ops.items():
         opname = name.lstrip("%").split(" ", 1)[0]
+        # the HLO op kind (the token after the result type) — a renamed
+        # instruction (%transpose_jvp___ = ... custom-call(...), or a
+        # renamed copy) must bucket by its kind, so probe opname and opkind
+        # SEPARATELY: anchored patterns like ^copy can't see a token
+        # appended to the name
+        m = opkind_re.search(name)
+        opkind = m.group(1) if m else ""
         # "%fusion.12 = ..." tells us nothing; fall through to the full text
         # for generic fusions, which XLA names by their root op otherwise
-        probe_text = name if opname.startswith("fusion") else opname
+        probes = (
+            [name] if opname.startswith("fusion") else [opname, opkind]
+        )
         for gname, pat in GROUPS:
-            if pat.search(probe_text):
+            if any(pat.search(p) for p in probes):
                 grouped[gname] += dur
                 break
         else:

@@ -43,6 +43,7 @@ from kubeflow_tpu.models.transformer import (
     TransformerLM,
     lm_loss_chunked,
 )
+from kubeflow_tpu.ops.fused_head_loss import fused_head_nll
 from kubeflow_tpu.ops.optimizers import adamw_lowmem
 from kubeflow_tpu.parallel import mesh as meshlib
 from kubeflow_tpu.parallel.train import optimizer_state_shardings
@@ -145,22 +146,46 @@ def main() -> None:
         for p in jax.tree_util.tree_leaves(state["params"])
     )
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def step(state, tokens):
-        def loss_fn(params):
+    def make_step(head: str):
+        def loss_fn(params, tokens):
             hidden = model.apply({"params": params}, tokens, return_hidden=True)
+            if head == "fused":
+                return fused_head_nll(
+                    hidden, params["embed"]["embedding"], tokens
+                )
             return lm_loss_chunked(
                 hidden, params["embed"]["embedding"], tokens, chunk=CHUNK
             )
 
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
-        updates, opt_state = tx.update(
-            grads, state["opt_state"], state["params"]
-        )
-        return {
-            "params": optax.apply_updates(state["params"], updates),
-            "opt_state": opt_state,
-        }, loss
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                state["params"], tokens
+            )
+            updates, opt_state = tx.update(
+                grads, state["opt_state"], state["params"]
+            )
+            return {
+                "params": optax.apply_updates(state["params"], updates),
+                "opt_state": opt_state,
+            }, loss
+
+        return step
+
+    head = (
+        sys.argv[sys.argv.index("--head") + 1]
+        if "--head" in sys.argv else "chunked"
+    )
+
+    if "--ab-head" in sys.argv:
+        # fused vs chunked tied head, palindromic in-process A/B (process
+        # phase drift on Pallas rows measured ±30% — only ABBA within one
+        # process ranks them honestly; moe_bench --ab is the sibling)
+        _ab_head(state, init_fn, shardings, make_step, tokens, n_chips,
+                 batch, seq, n_params, cfg, devices)
+        return
+
+    step = make_step(head)
 
     def window(n, state):
         t = time.perf_counter()
@@ -203,9 +228,53 @@ def main() -> None:
                 "params_m": round(n_params / 1e6, 1),
                 "seq_len": seq,
                 "per_chip_batch": batch,
+                "head": head,
             }
         )
     )
+
+
+def _ab_head(state, init_fn, shardings, make_step, tokens, n_chips, batch,
+             seq, n_params, cfg, devices):
+    # reuse main()'s already-initialized state for side A; init ONE more for
+    # side B (a third copy would not fit next to the activations)
+    sides = {
+        "fused": {"step": make_step("fused"), "state": state},
+        "chunked": {
+            "step": make_step("chunked"),
+            "state": jax.jit(init_fn, out_shardings=shardings)(
+                jax.random.PRNGKey(0), tokens
+            ),
+        },
+    }
+
+    from benchmarks import _timing
+
+    def make_window(side):
+        def window(n):
+            t = time.perf_counter()
+            loss = None
+            for _ in range(n):
+                side["state"], loss = side["step"](side["state"], tokens)
+            float(loss)
+            return time.perf_counter() - t
+
+        return window
+
+    windows = {h: make_window(sides[h]) for h in ("fused", "chunked")}
+    for w in windows.values():
+        w(N_SHORT)  # compile + warm
+    secs = _timing.ab_palindrome(windows, N_SHORT, N_LONG, REPEATS)
+    attn = 12 * cfg.num_layers * cfg.embed_dim * seq * 0.5
+    peak = chip_peak_flops(devices[0])
+    out = {"metric": "transformer_head_ab", "unit": "tok/s/chip",
+           "seq_len": seq, "per_chip_batch": batch}
+    for head in ("fused", "chunked"):
+        tps = batch * seq / secs[head]  # per chip: batch is per-chip
+        out[head] = round(tps, 1)
+        out[f"{head}_mfu"] = round(tps * (6 * n_params + attn) / peak, 4)
+    out["fused_over_chunked"] = round(out["fused"] / out["chunked"], 4)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

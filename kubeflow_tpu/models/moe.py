@@ -479,18 +479,25 @@ def moe_lm_loss(model: MoETransformerLM, params, tokens):
     return jnp.mean(nll) + model.cfg.aux_loss_weight * _mean_aux(inter)
 
 
-def moe_lm_loss_fused(model: MoETransformerLM, params, tokens):
+def moe_lm_loss_fused(
+    model: MoETransformerLM, params, tokens, *, compute_dtype=None
+):
     """moe_lm_loss via the fused Pallas head (ops/fused_head_loss.py): the
     [B, S, vocab] logits exist only as VMEM tiles and the embed grad
     accumulates in-kernel instead of riding a scan carry — the round-4 MoE
-    trace put the scan-based chunked head at ~27 ms of a 106 ms step."""
+    trace put the scan-based chunked head at ~27 ms of a 106 ms step.
+    ``compute_dtype`` as in ``moe_lm_loss_chunked`` (default bf16 operands;
+    pass f32 for bit-parity testing)."""
     from kubeflow_tpu.ops.fused_head_loss import fused_head_nll
 
     hidden, inter = model.apply(
         {"params": params}, tokens, mutable=["intermediates"],
         return_hidden=True,
     )
-    nll = fused_head_nll(hidden, params["embed"]["embedding"], tokens)
+    nll = fused_head_nll(
+        hidden, params["embed"]["embedding"], tokens,
+        compute_dtype=compute_dtype or jnp.bfloat16,
+    )
     return nll + model.cfg.aux_loss_weight * _mean_aux(inter)
 
 

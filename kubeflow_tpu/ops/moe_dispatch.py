@@ -274,7 +274,12 @@ def gather_rows(x, idx, *, unique_indices: bool = False,
         M % 128
         or J % BLOCK_J
         or not _fits(R, M, x.dtype.itemsize)
-        or not _fits(R, M, 4)  # the f32 scatter accumulator in bwd
+        # the f32 scatter accumulator only exists in the colliding-index
+        # backward; unique mode scatters in the cotangent dtype, so a bf16
+        # table up to the full budget stays on the kernel path (the combine
+        # table [EC+1, M] is ~2.5x the token table — the unconditional f32
+        # check silently pushed every combine onto the XLA fallback)
+        or (not unique_indices and not _fits(R, M, 4))
     ):
         return _gather_ref(x, idx)
     if interpret is None:

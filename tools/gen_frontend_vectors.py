@@ -1,0 +1,120 @@
+"""Regenerate the canonical `yaml` fields in selftest_vectors.js.
+
+The AUTHORITATIVE generator is kubeflow.js itself — open
+``static/common/selftest.html?dump=1`` in a browser and paste the dump.
+No browser or JS engine exists in this image, so this module carries a
+line-faithful Python port of ``toYaml`` (kubeflow.js:334-376) used ONLY to
+produce the pinned strings; the selftest page asserts the real JS emits
+exactly these, and ``tests/test_frontend_js.py`` asserts they safe_load
+back to the source objects (so a port divergence can only be a FORMAT
+drift, never a semantic one — and the browser run catches format drift).
+
+Usage: python tools/gen_frontend_vectors.py [--check]
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+import sys
+
+VECTORS = (
+    pathlib.Path(__file__).resolve().parents[1]
+    / "kubeflow_tpu" / "webapps" / "static" / "common"
+    / "selftest_vectors.js"
+)
+
+_QUOTE_CHARS = re.compile(r"[:#\[\]{}&*!|>'\"%@`,\n]")
+_LEAD = re.compile(r"^[\s\-?]")
+_TRAIL_WS = re.compile(r"\s$")
+_WORDS = re.compile(r"^(true|false|null|~|yes|no|on|off)$", re.I)
+_NUMISH = re.compile(r"^[\d.+-]")
+
+
+def _js_number(n) -> str:
+    """JS String(number): integral floats print without the trailing .0."""
+    if isinstance(n, bool):
+        return "true" if n else "false"
+    if isinstance(n, float) and n.is_integer():
+        return str(int(n))
+    return str(n)
+
+
+def to_yaml(value, indent="") -> str:
+    if value is None:
+        return "null"
+    if isinstance(value, str):
+        if (
+            value == ""
+            or _QUOTE_CHARS.search(value)
+            or _LEAD.search(value)
+            or _TRAIL_WS.search(value)
+            or _WORDS.match(value)
+            or _NUMISH.match(value)
+        ):
+            return json.dumps(value)
+        return value
+    if isinstance(value, bool) or isinstance(value, (int, float)):
+        return _js_number(value)
+    if isinstance(value, list):
+        if not value:
+            return "[]"
+        out = []
+        for v in value:
+            composite = isinstance(v, (list, dict)) and len(v)
+            if composite:
+                rendered = to_yaml(v, indent + "  ")
+                out.append(indent + "- " + rendered[len(indent) + 2:])
+            else:
+                out.append(indent + "- " + to_yaml(v, indent))
+        return "\n".join(out)
+    keys = list(value.keys())
+    if not keys:
+        return "{}"
+    out = []
+    for k in keys:
+        v = value[k]
+        composite = isinstance(v, (list, dict)) and len(v)
+        if composite:
+            out.append(indent + k + ":\n" + to_yaml(v, indent + "  "))
+        else:
+            out.append(indent + k + ": " + to_yaml(v, indent))
+    return "\n".join(out)
+
+
+def load_vectors() -> dict:
+    text = VECTORS.read_text()
+    payload = text.split("\n", 1)[1]
+    while not payload.lstrip().startswith("{"):
+        payload = payload.split("\n", 1)[1]
+    payload = payload.rstrip().rstrip(";")
+    return json.loads(payload)
+
+
+def main(argv: list[str]) -> int:
+    text = VECTORS.read_text()
+    head, _, _ = text.partition("window.KF_VECTORS =")
+    vectors = load_vectors()
+    changed = []
+    for case in vectors["yaml_roundtrip"]:
+        want = to_yaml(case["obj"])
+        if case.get("yaml") != want:
+            changed.append(case["name"])
+            case["yaml"] = want
+    if "--check" in argv:
+        if changed:
+            print(f"stale yaml vectors: {changed}", file=sys.stderr)
+            return 1
+        print("vectors up to date")
+        return 0
+    VECTORS.write_text(
+        head + "window.KF_VECTORS =\n"
+        + json.dumps(vectors, indent=2, ensure_ascii=False)
+        + "\n;\n"
+    )
+    print(f"regenerated {VECTORS.name}: {changed or 'no changes'}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

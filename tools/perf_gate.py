@@ -8,6 +8,11 @@ the newer number regressed beyond tolerance:
 
 - throughput families (img/s, tok/s): newer < older × (1 − tol) fails
 - latency families (ms, per-phase p50): newer > older × (1 + tol) fails
+- whole-family disappearance: a family whose newest artifact predates the
+  repo's newest round FAILS (round-4's actual failure mode — MOE_BENCH and
+  DECODE_BENCH simply had no r04 file and the gate stayed green). A family
+  retired on purpose goes in ``tools/perf_gate_retired.txt`` (one
+  ``FAMILY reason…`` per line) or ``--allow-stale FAMILY``.
 
 Usage:  python tools/perf_gate.py [--repo DIR] [--tolerance 0.05] [--json]
 Exit 0: no regressions (or fewer than two rounds to compare).
@@ -70,9 +75,62 @@ def collect_rounds(repo: pathlib.Path) -> dict[str, dict[int, pathlib.Path]]:
     return families
 
 
-def compare(repo: pathlib.Path, tolerance: float) -> dict:
+def _retired(repo: pathlib.Path) -> dict[str, str]:
+    """Families retired on purpose: tools/perf_gate_retired.txt, one
+    ``FAMILY reason…`` per line (# comments allowed)."""
+    out: dict[str, str] = {}
+    path = repo / "tools" / "perf_gate_retired.txt"
+    if not path.exists():
+        return out
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, reason = line.partition(" ")
+        out[name] = reason.strip() or "retired"
+    return out
+
+
+def compare(
+    repo: pathlib.Path, tolerance: float, allow_stale: set[str] = frozenset()
+) -> dict:
     report = {"families": {}, "regressions": []}
-    for family, rounds in sorted(collect_rounds(repo).items()):
+    all_rounds = collect_rounds(repo)
+    newest = max((max(r) for r in all_rounds.values()), default=0)
+    retired = _retired(repo)
+    for family, rounds in sorted(all_rounds.items()):
+        fam_newest = max(rounds)
+        stale_note = {}
+        if fam_newest < newest:
+            # the family SKIPPED the newest round entirely — round-4's
+            # silent failure mode. Partial metric loss is caught below;
+            # whole-family loss must be just as loud.
+            if family in retired:
+                report["families"][family] = {
+                    "rounds": f"r{fam_newest:02d} (newest)",
+                    "metrics": {},
+                    "retired": retired[family],
+                }
+                continue
+            if family in allow_stale and fam_newest >= newest - 1:
+                # a bounded waiver: ONE round of lag (e.g. driver-written
+                # families mid-round). The family's own two-newest-round
+                # comparison still runs below — the waiver covers only the
+                # staleness error, not regression coverage. A lag beyond
+                # one round fails even with the flag: an unbounded
+                # exemption would re-open the silent-disappearance hole.
+                stale_note = {"stale_allowed": f"r{fam_newest:02d} < r{newest:02d}"}
+            else:
+                report["regressions"].append({
+                    "family": family,
+                    "error": (
+                        f"newest artifact is r{fam_newest:02d} but the repo "
+                        f"has r{newest:02d} artifacts — the family skipped "
+                        "the newest round (record it or retire it in "
+                        "tools/perf_gate_retired.txt)"
+                    ),
+                })
+                continue
         if len(rounds) < 2:
             continue
         new_r, old_r = sorted(rounds)[-1], sorted(rounds)[-2]
@@ -135,6 +193,7 @@ def compare(repo: pathlib.Path, tolerance: float) -> dict:
         report["families"][family] = {
             "rounds": f"r{old_r:02d}->r{new_r:02d}",
             "metrics": rows,
+            **stale_note,
         }
         if not rows and (old or new):
             # one side has perf metrics the other lacks: a schema change
@@ -157,8 +216,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--repo", default=".", type=pathlib.Path)
     ap.add_argument("--tolerance", default=0.05, type=float)
     ap.add_argument("--json", action="store_true", help="machine output")
+    ap.add_argument(
+        "--allow-stale", action="append", default=[], metavar="FAMILY",
+        help="family allowed to skip the newest round (repeatable)",
+    )
     args = ap.parse_args(argv)
-    report = compare(args.repo, args.tolerance)
+    report = compare(args.repo, args.tolerance, set(args.allow_stale))
     if args.json:
         print(json.dumps(report, indent=1))
     else:
@@ -170,13 +233,20 @@ def main(argv: list[str] | None = None) -> int:
                     f"{row['old']:>10.2f} -> {row['new']:>10.2f} "
                     f"({row['ratio']:.3f}, {row['direction']} is better) {flag}"
                 )
+        for reg in report["regressions"]:
+            if "error" in reg:
+                print(f"{reg['family']:24s} ERROR: {reg['error']}")
         if not report["families"]:
             print("perf gate: fewer than two rounds of any artifact; nothing to compare")
     if report["regressions"]:
-        print(
-            f"\nPERF GATE FAILED: {len(report['regressions'])} regression(s) "
-            f"beyond {args.tolerance:.0%}", file=sys.stderr,
-        )
+        n_err = sum(1 for r in report["regressions"] if "error" in r)
+        n_perf = len(report["regressions"]) - n_err
+        parts = []
+        if n_perf:
+            parts.append(f"{n_perf} regression(s) beyond {args.tolerance:.0%}")
+        if n_err:
+            parts.append(f"{n_err} coverage/staleness error(s)")
+        print(f"\nPERF GATE FAILED: {' + '.join(parts)}", file=sys.stderr)
         return 1
     return 0
 

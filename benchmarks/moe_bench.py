@@ -19,7 +19,7 @@ convention; total params also reported. vs_baseline mirrors the dense bench:
 MFU / (0.90 * 0.40).
 
 Usage: python benchmarks/moe_bench.py [--dispatch einsum|gather] [--remat]
-       [--chunked-head] [--ab]
+       [--fused-head] [--ab] [--ab-dispatch]
 
 ``--ab`` measures the fused AND chunked heads in ONE process with
 palindromic window ordering (A B B A, the resnet_ab_probe convention):
@@ -65,7 +65,12 @@ def chip_peak_flops(device) -> float:
     return 197e12
 
 
-def build(dispatch: str = "gather", remat: bool = False, head: str = "fused"):
+def build(dispatch: str = "gather", remat: bool = False,
+          head: str = "chunked"):
+    """Default head: chunked-bf16 — the round-5 in-process palindrome
+    measured fused_over_chunked = 0.99 (MOE_BENCH_r05 ab_head), i.e. the
+    fused Pallas head does not beat the bf16 chunked scan at this config;
+    its win case remains memory (no per-chunk [C, V] logits in HBM)."""
     cfg = MoEConfig(
         vocab_size=32_000,
         num_layers=8,
@@ -129,7 +134,7 @@ def build(dispatch: str = "gather", remat: bool = False, head: str = "fused"):
 def build_for_trace():
     """(step, state, batch) for trace_anatomy's moe case."""
     _, step, state, tokens, _, _ = build(
-        head="chunked" if "--chunked-head" in sys.argv else "fused"
+        head="fused" if "--fused-head" in sys.argv else "chunked"
     )
     return step, state, tokens
 
@@ -225,12 +230,12 @@ def main() -> None:
     if "--ab-dispatch" in sys.argv:
         _ab_dispatch_main(
             "--remat" in sys.argv,
-            head="chunked" if "--chunked-head" in sys.argv else "fused",
+            head="fused" if "--fused-head" in sys.argv else "chunked",
         )
         return
     cfg, step, state, tokens, n_total, n_active = build(
         dispatch, "--remat" in sys.argv,
-        head="chunked" if "--chunked-head" in sys.argv else "fused",
+        head="fused" if "--fused-head" in sys.argv else "chunked",
     )
     window = _make_window(step, state, tokens)
     window(N_SHORT)  # compile + warm

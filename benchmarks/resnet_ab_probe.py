@@ -1,7 +1,8 @@
 """In-process A/B probe: two ResNet configs, interleaved windows, so tunnel
 throughput drift (measured 2x between processes) cancels. Usage:
 
-    python benchmarks/resnet_ab_probe.py BATCH_A BATCH_B [--b-mom-bf16] [--b-s2d]
+    python benchmarks/resnet_ab_probe.py BATCH_A BATCH_B [--b-mom-bf16]
+        [--b-s2d] [--b-bn-mxu]
 """
 import json
 import statistics
@@ -21,12 +22,12 @@ from kubeflow_tpu.parallel import mesh as meshlib
 from kubeflow_tpu.parallel.train import make_classifier_train_step
 
 
-def build(batch, mom_bf16, s2d=False):
+def build(batch, mom_bf16, s2d=False, bn_impl="xla"):
     devices = jax.devices()
     mesh = meshlib.create_mesh(
         meshlib.MeshPlan(data=len(devices)), devices=devices
     )
-    model = ResNet50(num_classes=1000, s2d_stem=s2d)
+    model = ResNet50(num_classes=1000, s2d_stem=s2d, bn_impl=bn_impl)
     tx = optax.sgd(
         0.1, momentum=0.9, nesterov=True,
         accumulator_dtype=jnp.bfloat16 if mom_bf16 else None,
@@ -65,8 +66,9 @@ def main():
     batch_a, batch_b = int(args[0]), int(args[1])
     b_mom = "--b-mom-bf16" in sys.argv
     b_s2d = "--b-s2d" in sys.argv
+    b_bn = "mxu" if "--b-bn-mxu" in sys.argv else "xla"
     A = build(batch_a, False)
-    B = build(batch_b, b_mom, b_s2d)
+    B = build(batch_b, b_mom, b_s2d, b_bn)
 
     def window(cfg, k):
         step, state, data, _n = cfg
@@ -99,6 +101,7 @@ def main():
     print(json.dumps({
         "a": {"batch": batch_a, "imgs_per_sec": round(statistics.median(rates_a), 1)},
         "b": {"batch": batch_b, "mom_bf16": b_mom, "s2d": b_s2d,
+              "bn_impl": b_bn,
               "imgs_per_sec": round(statistics.median(rates_b), 1)},
         "b_over_a_median_ratio": round(statistics.median(ratios), 4),
         "ratio_spread": [round(r, 3) for r in sorted(ratios)],

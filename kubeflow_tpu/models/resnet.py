@@ -26,13 +26,17 @@ ModuleDef = Any
 
 class PallasBatchNorm(nn.Module):
     """flax ``nn.BatchNorm`` drop-in whose train-mode statistics and gradient
-    reductions run as single-sweep Pallas kernels (``ops/bn_pallas.py``).
+    reductions run outside XLA's slow stats pass (``ops/bn_pallas.py``).
 
     XLA's stats pass was 26% of the ResNet step at ~82 GB/s (BASELINE.md
-    "ResNet step anatomy"); these kernels stream each activation once per
-    pass. Param/collection names match flax (scale/bias, batch_stats
-    mean/var) so checkpoints and train-step plumbing are interchangeable.
-    Inference mode is pure elementwise XLA (fuses into neighbors).
+    "ResNet step anatomy"). ``strategy='pallas'`` streams each activation
+    once per pass in single-sweep kernels; ``strategy='mxu'`` computes the
+    same four reductions as plain XLA dots (sum = ones-dot, sumsq/cross =
+    Gram diagonal) — no custom-call boundary, so none of the relayout
+    copies that made the Pallas kernels a net loss inside the conv step.
+    Param/collection names match flax (scale/bias, batch_stats mean/var) so
+    checkpoints and train-step plumbing are interchangeable. Inference mode
+    is pure elementwise XLA (fuses into neighbors).
     """
 
     use_running_average: bool = False
@@ -42,6 +46,7 @@ class PallasBatchNorm(nn.Module):
     param_dtype: Any = jnp.float32
     scale_init: Callable = nn.initializers.ones
     bias_init: Callable = nn.initializers.zeros
+    strategy: str = "pallas"
 
     @nn.compact
     def __call__(self, x):
@@ -60,7 +65,8 @@ class PallasBatchNorm(nn.Module):
             b = bias - ra_mean.value * a
             return (x.astype(jnp.float32) * a + b).astype(self.dtype)
         y, (mean, var) = batch_norm_train(
-            x.astype(self.dtype), scale, bias, self.epsilon
+            x.astype(self.dtype), scale, bias, self.epsilon,
+            strategy=self.strategy,
         )
         if not self.is_initializing():
             m = self.momentum
@@ -152,23 +158,37 @@ class ResNet(nn.Module):
     width: int = 64
     dtype: Any = jnp.bfloat16
     s2d_stem: bool = False  # space-to-depth stem (same math, MXU-friendly)
-    # PallasBatchNorm's reduce kernels beat XLA's stats fusions 2x in
-    # isolation, but the pallas_call boundary relayouts every activation
-    # ({3,0,2,1} conv layout → row-major), measured net 3336 → 2193 img/s —
-    # so XLA BN stays the default here; see ops/bn_pallas.py and BASELINE.md
-    pallas_bn: bool = False
+    # BN implementation: 'xla' | 'pallas' | 'mxu'.
+    # - pallas: reduce kernels beat XLA's stats fusions 2x in isolation,
+    #   but the pallas_call boundary relayouts every activation ({3,0,2,1}
+    #   conv layout → row-major), measured net 3336 → 2193 img/s — never
+    #   the right call inside the conv step;
+    # - mxu: the same reductions as plain XLA dots (no boundary) — see
+    #   ops/bn_pallas.py "MXU stats" and benchmarks/resnet_ab_probe.py.
+    bn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, dtype=self.dtype, param_dtype=jnp.float32)
-        norm = partial(
-            PallasBatchNorm if self.pallas_bn else nn.BatchNorm,
-            use_running_average=not train,
-            momentum=0.9,
-            epsilon=1e-5,
-            dtype=self.dtype,
-            param_dtype=jnp.float32,
-        )
+        if self.bn_impl == "xla":
+            norm = partial(
+                nn.BatchNorm,
+                use_running_average=not train,
+                momentum=0.9,
+                epsilon=1e-5,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+            )
+        else:
+            norm = partial(
+                PallasBatchNorm,
+                use_running_average=not train,
+                momentum=0.9,
+                epsilon=1e-5,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                strategy=self.bn_impl,
+            )
         x = x.astype(self.dtype)
         if self.s2d_stem and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
             x = SpaceToDepthStem(

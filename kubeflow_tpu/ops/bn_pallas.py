@@ -191,8 +191,89 @@ def _bn_grad_sums(dy, x, mean, rinv, interpret: bool | None = None):
     return db[0], dg[0]
 
 
-def _bn_train_fwd(x, scale, bias, eps: float):
-    mean, var = channel_moments(x)
+# --------------------------------------------------------------- MXU stats
+# Reductions as matmuls: sum(x) is a ones-vector dot and the (sum x_i x_j)
+# family is a Gram product, so both channel moments and BN's backward sums
+# can ride the MXU at streaming bandwidth as PLAIN XLA dots — no Pallas
+# boundary, hence none of the relayout copies that made the kernels above a
+# net loss inside the conv step (module docstring "Measured caveat").
+# Guard: worthwhile when rows >= channels (the [C, C] Gram write is then
+# bounded by the data read); late small-m/large-C layers stay on XLA.
+
+
+def _mxu_ok(m: int, ch: int) -> bool:
+    return m >= ch
+
+
+_CONTRACT_ROWS = (((0,), (0,)), ((), ()))  # contract dim 0 of both, no batch
+
+
+def channel_moments_mxu(x):
+    """(mean [C] f32, var [C] f32) via MXU dots: sum = ones @ x, sumsq =
+    diag(x^T x). bf16 operands multiply exactly into the f32 accumulator,
+    so numerics match the convert-then-reduce XLA pass."""
+    ch = x.shape[-1]
+    m = x.size // ch
+    x2 = x.reshape(m, ch)
+    ones = jnp.ones((m,), x.dtype)
+    s1 = jax.lax.dot_general(
+        ones, x2, _CONTRACT_ROWS, preferred_element_type=jnp.float32
+    )
+    gram = jax.lax.dot_general(
+        x2, x2, _CONTRACT_ROWS, preferred_element_type=jnp.float32
+    )
+    s2 = jnp.diagonal(gram)
+    mean = s1 / m
+    var = s2 / m - mean * mean
+    return mean, var
+
+
+def _bn_grad_sums_mxu(dy, x, mean, rinv):
+    """(dbeta, dgamma) via MXU dots on the RAW tensors: sum(dy) = ones @ dy
+    and sum(dy * xhat) = (diag(dy^T x) - mean * sum(dy)) * rinv — the
+    raw-moment identity keeps xhat from ever materializing."""
+    ch = x.shape[-1]
+    m = x.size // ch
+    dy2 = dy.reshape(m, ch).astype(x.dtype)
+    x2 = x.reshape(m, ch)
+    ones = jnp.ones((m,), x.dtype)
+    dbeta = jax.lax.dot_general(
+        ones, dy2, _CONTRACT_ROWS, preferred_element_type=jnp.float32
+    )
+    cross = jax.lax.dot_general(
+        dy2, x2, _CONTRACT_ROWS, preferred_element_type=jnp.float32
+    )
+    sum_dyx = jnp.diagonal(cross)
+    dgamma = (sum_dyx - mean * dbeta) * rinv
+    return dbeta, dgamma
+
+
+def _moments(x, strategy: str):
+    if strategy == "mxu" and _mxu_ok(x.size // x.shape[-1], x.shape[-1]):
+        return channel_moments_mxu(x)
+    if strategy == "mxu":
+        # small-m/large-C tail: the XLA reduce is already cheap there
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=tuple(range(x.ndim - 1)))
+        var = jnp.mean(xf * xf, axis=tuple(range(x.ndim - 1))) - mean * mean
+        return mean, var
+    return channel_moments(x)
+
+
+def _grad_sums(dy, x, mean, rinv, strategy: str):
+    if strategy == "mxu" and _mxu_ok(x.size // x.shape[-1], x.shape[-1]):
+        return _bn_grad_sums_mxu(dy, x, mean, rinv)
+    if strategy == "mxu":
+        axes = tuple(range(x.ndim - 1))
+        dyf = dy.astype(jnp.float32)
+        dbeta = jnp.sum(dyf, axis=axes)
+        xhat = (x.astype(jnp.float32) - mean) * rinv
+        return dbeta, jnp.sum(dyf * xhat, axis=axes)
+    return _bn_grad_sums(dy, x, mean, rinv)
+
+
+def _bn_train_fwd(x, scale, bias, eps: float, strategy: str):
+    mean, var = _moments(x, strategy)
     rinv = jax.lax.rsqrt(var + eps)
     a = (scale * rinv).astype(jnp.float32)
     b = bias - mean * a
@@ -200,12 +281,12 @@ def _bn_train_fwd(x, scale, bias, eps: float):
     return (y, (mean, var)), (x, mean, rinv, scale)
 
 
-def _bn_train_bwd(eps: float, res, cts):
+def _bn_train_bwd(eps: float, strategy: str, res, cts):
     dy, _ = cts  # stats outputs feed the (stop-gradient) EMA only
     x, mean, rinv, scale = res
     ch = x.shape[-1]
     m = x.size // ch
-    dbeta, dgamma = _bn_grad_sums(dy, x, mean, rinv)
+    dbeta, dgamma = _grad_sums(dy, x, mean, rinv, strategy)
     g = (scale * rinv).astype(jnp.float32)
     # dx = g * (dy - dbeta/m - xhat * dgamma/m); all elementwise → XLA fuses
     xhat_coeff = (rinv * dgamma) / m
@@ -216,17 +297,19 @@ def _bn_train_bwd(eps: float, res, cts):
     return dx, dgamma.astype(scale.dtype), dbeta.astype(scale.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _bn_train_vjp(x, scale, bias, eps: float):
-    (y, stats), _ = _bn_train_fwd(x, scale, bias, eps)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train_vjp(x, scale, bias, eps: float, strategy: str):
+    (y, stats), _ = _bn_train_fwd(x, scale, bias, eps, strategy)
     return y, stats
 
 
 _bn_train_vjp.defvjp(_bn_train_fwd, _bn_train_bwd)
 
 
-def batch_norm_train(x, scale, bias, eps: float = 1e-5):
+def batch_norm_train(x, scale, bias, eps: float = 1e-5,
+                     strategy: str = "pallas"):
     """Train-mode BN: returns (y, (mean, var)); stats carry stop-gradient
-    semantics (they exist to update the running averages)."""
-    y, stats = _bn_train_vjp(x, scale, bias, eps)
+    semantics (they exist to update the running averages). ``strategy``:
+    'pallas' (single-sweep kernels) or 'mxu' (reductions as XLA dots)."""
+    y, stats = _bn_train_vjp(x, scale, bias, eps, strategy)
     return y, jax.tree_util.tree_map(jax.lax.stop_gradient, stats)

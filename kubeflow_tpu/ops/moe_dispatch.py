@@ -46,12 +46,20 @@ BLOCK_J = 256
 VMEM_ROW_BUDGET = 12 << 20  # resident [R, M] source/dest per batch row
 
 
-def _gather_kernel(idx_ref, x_ref, out_ref, tab_scr, *, bj, br, n_load,
-                   n_rows):
+UNROLL = 8
+
+
+def _gather_kernel(idx_ref, x_ref, out_ref, tab_scr, *, bj, br, n_load):
     """Phase 1 (steps < n_load): copy x tiles into the scratch table.
     Phase 2: stream rows out of scratch. Scratch is single-buffered; a
     whole-row in/out BLOCK would be double-buffered by Mosaic — 2 x 8.4 MB
-    blew the 16 MB scoped-vmem budget (measured)."""
+    blew the 16 MB scoped-vmem budget (measured).
+
+    The row loop is the hot path (round-5 step trace: 11 ms of the 92.5 ms
+    MoE step was these kernels): indices are pre-clamped host-side onto the
+    scratch's guaranteed-zero pad row (R_pad > R always — see
+    _gather_grid_call), so the body is a bare copy with no select, and the
+    loop is unrolled UNROLL× to amortize loop/bounds scalar work."""
     b = pl.program_id(0)
     step = pl.program_id(1)
 
@@ -65,22 +73,20 @@ def _gather_kernel(idx_ref, x_ref, out_ref, tab_scr, *, bj, br, n_load,
     def _():
         jb = step - n_load
 
-        def body(i, _):
-            row = idx_ref[b, jb * bj + i]
-            # sentinel (row >= R): zero row — callers reference "row R"
-            # instead of physically padding the table with a zero row
-            # (the pad concat alone cost 0.75 ms/layer on chip)
-            safe = jnp.minimum(row, tab_scr.shape[0] - 1)
-            val = tab_scr[pl.dslice(safe, 1), :, :].astype(out_ref.dtype)
-            val = jnp.where(row < n_rows, val, jnp.zeros_like(val))
-            out_ref[0, pl.dslice(i, 1), :, :] = val
+        def body(u, _):
+            base = jb * bj + u * UNROLL
+            for k in range(UNROLL):
+                row = idx_ref[b, base + k]
+                out_ref[0, pl.dslice(u * UNROLL + k, 1), :, :] = tab_scr[
+                    pl.dslice(row, 1), :, :
+                ].astype(out_ref.dtype)
             return 0
 
-        lax.fori_loop(0, bj, body, 0)
+        lax.fori_loop(0, bj // UNROLL, body, 0)
 
 
 def _scatter_kernel(idx_ref, dy_ref, out_ref, tab_scr, *, bj, br, nj,
-                    accumulate, n_rows):
+                    accumulate):
     """Phase 1 (steps < nj): scatter dy tiles into the scratch table
     (zeroed at step 0). Phase 2: copy scratch out in tiles."""
     b = pl.program_id(0)
@@ -99,18 +105,22 @@ def _scatter_kernel(idx_ref, dy_ref, out_ref, tab_scr, *, bj, br, nj,
 
     @pl.when(step < nj)
     def _():
-        def body(i, _):
-            row = idx_ref[b, step * bj + i]
-            # sentinel rows (>= n_rows) carry no gradient: redirect the
-            # store at a scratch-only spill row past the real table
-            safe = jnp.where(row < n_rows, row, n_rows)
-            val = dy_ref[0, pl.dslice(i, 1), :, :][0].astype(tab_scr.dtype)
-            if accumulate:
-                val = val + tab_scr[pl.dslice(safe, 1), :, :][0]
-            tab_scr[pl.dslice(safe, 1), :, :] = val[None]
+        # sentinel rows were pre-clamped host-side onto the spill row
+        # n_rows (scratch-only, discarded by the [:, :R] slice) — the body
+        # is a bare store / read-modify-write, unrolled like the gather
+        def body(u, _):
+            base = step * bj + u * UNROLL
+            for k in range(UNROLL):
+                row = idx_ref[b, base + k]
+                val = dy_ref[0, pl.dslice(u * UNROLL + k, 1), :, :][
+                    0
+                ].astype(tab_scr.dtype)
+                if accumulate:
+                    val = val + tab_scr[pl.dslice(row, 1), :, :][0]
+                tab_scr[pl.dslice(row, 1), :, :] = val[None]
             return 0
 
-        lax.fori_loop(0, bj, body, 0)
+        lax.fori_loop(0, bj // UNROLL, body, 0)
 
     @pl.when(step >= nj)
     def _():
@@ -136,13 +146,15 @@ def _gather_grid_call(idx, x, interpret):
     B, J = idx.shape
     _, R, M = x.shape
     bj, br, sub = BLOCK_J, BLOCK_R, M // 128
-    R_pad = -(-R // br) * br
+    # R_pad > R always: row R is a guaranteed zero row, so sentinel reads
+    # become a host-side clamp (elementwise on [B, J] int32 — fuses) and
+    # the kernel's row loop is a bare copy
+    R_pad = -(-(R + 1) // br) * br
+    idx = jnp.where(idx < R, idx, R).astype(jnp.int32)
     x4 = _pad_rows(x, R_pad).reshape(B, R_pad, sub, 128)
     n_load, nj = R_pad // br, J // bj
     out = pl.pallas_call(
-        functools.partial(
-            _gather_kernel, bj=bj, br=br, n_load=n_load, n_rows=R
-        ),
+        functools.partial(_gather_kernel, bj=bj, br=br, n_load=n_load),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, n_load + nj),
@@ -174,12 +186,12 @@ def _scatter_grid_call(idx, dy, R, out_dtype, accumulate, interpret):
     M = dy.shape[2]
     bj, br, sub = BLOCK_J, BLOCK_R, M // 128
     R_pad = -(-(R + 1) // br) * br  # +1: sentinel stores spill past row R
+    idx = jnp.where(idx < R, idx, R).astype(jnp.int32)  # host-side clamp
     dy4 = dy.reshape(B, J, sub, 128)
     nj, n_flush = J // bj, R_pad // br
     out = pl.pallas_call(
         functools.partial(
             _scatter_kernel, bj=bj, br=br, nj=nj, accumulate=accumulate,
-            n_rows=R,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,

@@ -300,3 +300,66 @@ class TestTransformer:
             params, opt_state, loss = step(params, opt_state)
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+
+
+class TestMXUBatchNorm:
+    """strategy='mxu' (reductions as XLA dots, ops/bn_pallas.py "MXU
+    stats") must match flax nn.BatchNorm the same way the Pallas strategy
+    does — forward, batch stats, and all three gradients — on both the
+    dot path (rows >= channels) and the small-m fallback."""
+
+    def _pair(self, shape):
+        import flax.linen as nn
+
+        from kubeflow_tpu.models.resnet import PallasBatchNorm
+
+        kw = dict(
+            use_running_average=False, momentum=0.9, epsilon=1e-5,
+            dtype=jnp.float32, param_dtype=jnp.float32,
+        )
+        return PallasBatchNorm(strategy="mxu", **kw), nn.BatchNorm(**kw)
+
+    @pytest.mark.parametrize(
+        "shape",
+        [(4, 6, 6, 16),     # rows 144 >= ch 16: the dot path
+         (2, 2, 2, 64)],    # rows 8 < ch 64: the small-m XLA fallback
+        ids=["gram-dots", "small-m-fallback"],
+    )
+    def test_matches_flax(self, shape):
+        ours, flax_bn = self._pair(shape)
+        x = jax.random.normal(jax.random.PRNGKey(1), shape) * 3 + 1
+        v1 = ours.init(jax.random.PRNGKey(0), x)
+        v2 = flax_bn.init(jax.random.PRNGKey(0), x)
+        y1, m1 = ours.apply(v1, x, mutable=["batch_stats"])
+        y2, m2 = flax_bn.apply(v2, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(m1["batch_stats"]["var"]),
+            np.asarray(m2["batch_stats"]["var"]), atol=1e-4,
+        )
+        tgt = jax.random.normal(jax.random.PRNGKey(3), shape)
+
+        def loss(variables, module, x):
+            y, _ = module.apply(variables, x, mutable=["batch_stats"])
+            return jnp.mean((y.astype(jnp.float32) - tgt) ** 2)
+
+        g1x = jax.grad(lambda x_: loss(v1, ours, x_))(x)
+        g2x = jax.grad(lambda x_: loss(v2, flax_bn, x_))(x)
+        np.testing.assert_allclose(np.asarray(g1x), np.asarray(g2x), atol=1e-4)
+        g1 = jax.grad(lambda v: loss(v, ours, x))(v1)["params"]
+        g2 = jax.grad(lambda v: loss(v, flax_bn, x))(v2)["params"]
+        for k in ("scale", "bias"):
+            np.testing.assert_allclose(
+                np.asarray(g1[k]), np.asarray(g2[k]), atol=1e-4, err_msg=k
+            )
+
+    def test_resnet_bn_impl_mxu_trains(self):
+        from kubeflow_tpu.models.resnet import ResNet18
+
+        model = ResNet18(num_classes=10, width=8, dtype=jnp.float32,
+                         bn_impl="mxu")
+        x = jnp.ones((2, 32, 32, 3))
+        vars_ = model.init(jax.random.PRNGKey(0), x)
+        y, mutated = model.apply(vars_, x, mutable=["batch_stats"])
+        assert y.shape == (2, 10)
+        assert "batch_stats" in mutated

@@ -15,7 +15,9 @@ in-process store, plus two things envtest lacks (SURVEY.md §4 takeaway):
 from __future__ import annotations
 
 import fnmatch
+import hashlib
 import itertools
+import json
 import threading
 import uuid
 from typing import Callable, Mapping
@@ -38,6 +40,16 @@ class AlreadyExists(Exception):
 
 class AdmissionDenied(Exception):
     """A mutating webhook rejected the object (HTTP 403 from admission)."""
+
+
+class TooManyRequests(Exception):
+    """HTTP 429 — the server asked the client to back off. Transient by
+    definition; reconcilers must let it propagate into the workqueue's
+    rate-limited requeue rather than treating it as fatal."""
+
+
+class ServerError(Exception):
+    """HTTP 5xx — transient apiserver failure. Same retry contract as 429."""
 
 
 WatchFn = Callable[[str, dict], None]  # (event_type, object) -> None
@@ -221,6 +233,19 @@ class FakeCluster:
         with self._lock:
             self._watchers.append((kind, fn))
 
+    def unwatch(self, fn: WatchFn) -> None:
+        """Detach a watch handler (a stopped manager's informer teardown —
+        without it, every controller crash-restart in the chaos harness would
+        leak a dead subscription that still pays a deep-copy per event)."""
+        with self._lock:
+            self._watchers = [(k, f) for k, f in self._watchers if f is not fn]
+
+    def dump(self) -> list[dict]:
+        """Deep-copied snapshot of every stored object (invariant checking
+        and fixed-point fingerprints in testing/chaos.py)."""
+        with self._lock:
+            return [ko.deep_copy(o) for o in self._objects.values()]
+
     def _notify(self, event: str, obj: dict) -> None:
         for kind, fn in list(self._watchers):
             if kind is None or kind == obj.get("kind"):
@@ -277,10 +302,23 @@ class FakeCluster:
 
     # ------------------------------------------------------- fake kubelet
 
+    @staticmethod
+    def _template_hash(owner: Mapping) -> str:
+        """Deterministic revision of a workload's pod template — the
+        controller-revision-hash analog that lets the kubelet roll pods
+        whose spec predates the current template."""
+        template = owner.get("spec", {}).get("template", {})
+        digest = hashlib.sha256(
+            json.dumps(template, sort_keys=True).encode()
+        ).hexdigest()
+        return digest[:10]
+
     def _create_workload_pod(self, owner: Mapping, pod_name: str, owner_kind: str) -> dict | None:
         """Materialize one pod from a workload's template, through admission."""
         ns = ko.namespace(owner)
         template = ko.deep_copy(owner["spec"].get("template", {}))
+        annotations = dict(template.get("metadata", {}).get("annotations", {}))
+        annotations["kubeflow.internal/template-hash"] = self._template_hash(owner)
         pod = {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -288,9 +326,7 @@ class FakeCluster:
                 "name": pod_name,
                 "namespace": ns,
                 "labels": dict(template.get("metadata", {}).get("labels", {})),
-                "annotations": dict(
-                    template.get("metadata", {}).get("annotations", {})
-                ),
+                "annotations": annotations,
                 "ownerReferences": [
                     {
                         "apiVersion": owner["apiVersion"],
@@ -394,9 +430,20 @@ class FakeCluster:
         for pod_name in sorted(set(pods) - wanted_names, reverse=True):
             self.delete("Pod", pod_name, ns)
         ready = 0
+        revision = self._template_hash(owner)
         for i in range(want):
             pod_name = pod_name_fn(i)
             pod = pods.get(pod_name)
+            if pod is not None and (
+                ko.annotations(pod).get("kubeflow.internal/template-hash")
+                != revision
+            ):
+                # rolling update: a pod built from a stale template is
+                # deleted and recreated from the current one (the real
+                # StatefulSet controller's controller-revision semantics —
+                # without this, spec edits never reach running pods)
+                self.delete("Pod", pod_name, ns)
+                pod = None
             if pod is None:
                 pod = self._create_workload_pod(owner, pod_name, owner_kind)
                 if pod is None:

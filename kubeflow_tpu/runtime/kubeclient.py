@@ -34,11 +34,49 @@ SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 # failure is routine churn, not a degraded server (tests lower this)
 HEALTHY_STREAM_S = 60.0
 
+# transient statuses worth retrying inside one logical request; everything
+# else is either a semantic answer (404/409/422) or a caller bug (403)
+RETRYABLE_STATUSES = (429, 500, 502, 503, 504)
+
+
+class RetriesExhausted(Exception):
+    """A request kept failing transiently past the client's retry deadline.
+
+    Carries ``attempts`` and ``last_status`` (None when the final failure was
+    a connection error) so reconcilers and operators can tell a flaky
+    apiserver from a dead one without parsing the message.
+    """
+
+    def __init__(self, path: str, attempts: int, last_status: int | None) -> None:
+        self.attempts = attempts
+        self.last_status = last_status
+        super().__init__(
+            f"{path}: {attempts} attempts failed, last status {last_status}"
+        )
+
 
 def _pause(backoff: float) -> None:
     """Full-jitter backoff sleep; module-level seam so tests can observe the
     sequence of backoff values without real sleeping."""
     time.sleep(random.uniform(0, backoff))
+
+
+def _sleep(seconds: float) -> None:
+    """Exact sleep (Retry-After honoring); separate seam from the jittered
+    ``_pause`` so tests can distinguish the two."""
+    time.sleep(seconds)
+
+
+def _retry_after_seconds(resp) -> float | None:
+    """Parse a Retry-After header (seconds form only; HTTP-date is rare from
+    apiservers and not worth a date parser here)."""
+    value = resp.headers.get("Retry-After")
+    if value is None:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        return None
 
 # kind -> (api prefix, group/version, plural, namespaced)
 RESOURCES: dict[str, tuple[str, str, str, bool]] = {
@@ -103,7 +141,20 @@ class KubeClient:
         token: str | None = None,
         ca_cert: str | bool | None = None,
         session=None,
+        *,
+        retry_deadline_s: float = 15.0,
+        retry_backoff_base: float = 0.2,
     ) -> None:
+        # Bounded-retry policy: a request that keeps failing transiently
+        # (429/5xx/connection reset) is retried with jittered exponential
+        # backoff until retry_deadline_s of wall time has elapsed, then
+        # surfaces as RetriesExhausted. The deadline (not an attempt count)
+        # is what matters operationally: reconcile latency is budgeted in
+        # seconds, and an unbounded retry loop inside the client would stall
+        # a worker thread forever on a persistently-500ing apiserver while
+        # the workqueue believes the key is being processed.
+        self.retry_deadline_s = retry_deadline_s
+        self.retry_backoff_base = retry_backoff_base
         if base_url is None:
             # KUBE_API_BASE_URL: out-of-cluster/dev hook (kubeconfig analog)
             # — the deploy-shape smoke points controller processes at the
@@ -129,20 +180,56 @@ class KubeClient:
     # ------------------------------------------------------------------ http
 
     def _request(self, method: str, path: str, *, raw: bool = False, **kw):
-        resp = self.session.request(
-            method, self.base_url + path, verify=self.verify, **kw
+        """One logical request = bounded transient-retry loop.
+
+        429/5xx and connection resets retry with jittered exponential backoff
+        (Retry-After honored exactly on 429) until ``retry_deadline_s`` has
+        elapsed, then surface as :class:`RetriesExhausted`. Semantic answers
+        (404/409) and caller bugs (403/422) never retry."""
+        deadline = time.monotonic() + self.retry_deadline_s
+        backoff = self.retry_backoff_base
+        attempts = 0
+        last_status: int | None = None
+        conn_errors = (
+            (requests.RequestException, OSError) if requests else (OSError,)
         )
-        if resp.status_code == 404:
-            raise NotFound(path)
-        if resp.status_code == 409:
-            body = resp.text
-            if "AlreadyExists" in body:
-                raise AlreadyExists(path)
-            raise Conflict(body)
-        resp.raise_for_status()
-        if raw:  # pod logs: the API returns text, not JSON
-            return resp.text
-        return resp.json() if resp.content else {}
+        while True:
+            attempts += 1
+            resp = None
+            try:
+                resp = self.session.request(
+                    method, self.base_url + path, verify=self.verify, **kw
+                )
+            except conn_errors:
+                last_status = None
+            if resp is not None:
+                if resp.status_code == 404:
+                    raise NotFound(path)
+                if resp.status_code == 409:
+                    body = resp.text
+                    if "AlreadyExists" in body:
+                        raise AlreadyExists(path)
+                    raise Conflict(body)
+                if resp.status_code not in RETRYABLE_STATUSES:
+                    resp.raise_for_status()
+                    if raw:  # pod logs: the API returns text, not JSON
+                        return resp.text
+                    return resp.json() if resp.content else {}
+                last_status = resp.status_code
+            if time.monotonic() >= deadline:
+                raise RetriesExhausted(path, attempts, last_status)
+            retry_after = (
+                _retry_after_seconds(resp)
+                if resp is not None and resp.status_code == 429
+                else None
+            )
+            if retry_after is not None:
+                # the server named its price; cap it at the deadline so a
+                # hostile/buggy Retry-After cannot stretch the budget
+                _sleep(min(retry_after, max(0.0, deadline - time.monotonic())))
+            else:
+                _pause(min(backoff, max(0.0, deadline - time.monotonic())))
+                backoff = min(backoff * 2, 5.0)
 
     # ------------------------------------------------------------------ CRUD
 

@@ -116,9 +116,18 @@ class LeaderElector:
                 self.cluster.update(lease)
                 self.is_leader = True
                 return True
-            except (Conflict, NotFound):
+            except NotFound:
                 self.is_leader = False
                 return False
+            # A 409 on our OWN renew is ambiguous — a transient apiserver
+            # blip or a write that raced ours — and must not stand a healthy
+            # leader down instantly (run() would then return for good).
+            # Propagate into run()'s renew-deadline grace: the next step
+            # re-reads, so a genuine takeover shows an unexpired foreign
+            # holder (definitive stand-down, the branch below) while a blip
+            # just renews late. Safe because a legitimate takeover requires
+            # our renewTime to age past lease_duration, and the grace
+            # expires earlier, at renew_deadline < lease_duration.
 
         if now < renew + float(spec.get("leaseDurationSeconds", self.lease_duration)):
             self.is_leader = False  # healthy holder elsewhere
@@ -168,7 +177,14 @@ class LeaderElector:
     ) -> None:
         """Block until leadership, fire the callback, keep renewing; on loss
         fire ``on_stopped_leading`` (default: hard exit, the controller-runtime
-        behavior — a stale leader must not keep reconciling)."""
+        behavior — a stale leader must not keep reconciling).
+
+        ``run`` RETURNS after a stand-down (client-go's ``LeaderElector.Run``
+        contract): the loop must not keep renewing with workers stopped —
+        re-acquiring its own still-unexpired lease seconds after standing down
+        would fire ``on_started_leading`` into a half-torn-down process. The
+        exactly-once guarantee on ``on_stopped_leading`` is structural: the
+        callback is immediately followed by the return."""
         stop = stop or threading.Event()
         was_leader = False
         last_renew_ok = self.clock()
@@ -182,7 +198,8 @@ class LeaderElector:
                 if leading:
                     last_renew_ok = t_step
             except Exception:
-                # Transient API error (connection blip, 5xx): keep retrying —
+                # Transient API error (connection blip, 5xx, renew 409):
+                # keep retrying —
                 # dying here while workers run would be silent split-brain.
                 # A leader that can't renew within renew_deadline must stand
                 # down while the lease is still unexpired for challengers
@@ -201,5 +218,6 @@ class LeaderElector:
                     on_stopped_leading()
                 else:  # pragma: no cover - process exit
                     os._exit(1)
+                return
             was_leader = leading
             stop.wait(self.retry_period)

@@ -80,6 +80,7 @@ class Manager:
     ) -> None:
         self.cluster = cluster
         self._reconcilers: list[Reconciler] = []
+        self.error_backoff_max = error_backoff_max
         self._wq = make_workqueue(
             virtual_clock=True,
             backoff_base=error_backoff_base,
@@ -89,6 +90,13 @@ class Manager:
         self._epoch = clock() if clock else 0.0
         self._sync_lock = threading.Lock()
         self._watches_started = False
+        self._installed_watches: list = []
+        # one-worker-per-key runtime guard: keys currently inside reconcile.
+        # The workqueue makes a violation structurally impossible; counting
+        # (instead of trusting) is what lets the chaos soak assert it.
+        self._active_keys: set[str] = set()
+        self._active_lock = threading.Lock()
+        self.concurrency_violations = 0
 
     # ------------------------------------------------------------- wiring
 
@@ -107,20 +115,50 @@ class Manager:
         contract — so objects created before the manager started still
         reconcile (KubeClient.watch replays its own initial list; the
         in-memory FakeCluster delivers only live events, so the replay here
-        covers both)."""
+        covers both).
+
+        All-or-nothing: a fault during installation (a flaky initial list)
+        rolls back the watches already attached and re-raises, so the next
+        call retries from scratch — a half-wired manager would silently
+        never reconcile the kinds past the failure point (controller-runtime
+        fails manager start on cache-sync failure for the same reason).
+        """
         if self._watches_started:
             return
+        installed: list = []
+        try:
+            for rec in self._reconcilers:
+                primary = self._primary_handler(rec)
+                self.cluster.watch(rec.kind, primary)
+                installed.append(primary)
+                for obj in self.cluster.list(rec.kind):
+                    primary("ADDED", obj)
+                for kind, map_fn in rec.watches():
+                    secondary = self._secondary_handler(rec, map_fn)
+                    self.cluster.watch(kind, secondary)
+                    installed.append(secondary)
+                    for obj in self.cluster.list(kind):
+                        secondary("ADDED", obj)
+        except Exception:
+            unwatch = getattr(self.cluster, "unwatch", None)
+            if unwatch is not None:
+                for handler in installed:
+                    unwatch(handler)
+            raise
+        self._installed_watches = installed
         self._watches_started = True
-        for rec in self._reconcilers:
-            primary = self._primary_handler(rec)
-            self.cluster.watch(rec.kind, primary)
-            for obj in self.cluster.list(rec.kind):
-                primary("ADDED", obj)
-            for kind, map_fn in rec.watches():
-                secondary = self._secondary_handler(rec, map_fn)
-                self.cluster.watch(kind, secondary)
-                for obj in self.cluster.list(kind):
-                    secondary("ADDED", obj)
+
+    def shutdown(self) -> None:
+        """Tear the manager down: detach its watch handlers (when the cluster
+        supports it) and shut the workqueue so blocked workers drain out.
+        The chaos harness uses this to model a controller process dying."""
+        unwatch = getattr(self.cluster, "unwatch", None)
+        if unwatch is not None:
+            for handler in self._installed_watches:
+                unwatch(handler)
+        self._installed_watches = []
+        self._watches_started = False
+        self._wq.shutdown()
 
     def reconciler_for(self, kind: str) -> Reconciler | None:
         """The registered reconciler for a primary kind (process wiring —
@@ -184,14 +222,40 @@ class Manager:
         were silently reading 0 without it."""
         return {"depth": len(self._wq), **self._wq.metrics()}
 
+    def next_requeue_in(self) -> float | None:
+        """Seconds until the earliest pending timer fires, or None. The chaos
+        soak's backoff invariant reads this: no requeue may ever be scheduled
+        further out than max(error_backoff_max, largest legitimate
+        requeue_after a reconciler returns)."""
+        deadline = self._wq.next_deadline()
+        if deadline is None:
+            return None
+        return deadline - self._wq.now()
+
     # ----------------------------------------------------------- execution
 
     def _execute(self, key: str) -> None:
         rec, ns, name = self._unkey(key)
+        with self._active_lock:
+            if key in self._active_keys:
+                self.concurrency_violations += 1
+                log.error("one-worker-per-key violated for %s", key)
+            self._active_keys.add(key)
         try:
             result = rec.reconcile(self.cluster, ns, name)
         except Exception:
             log.exception("reconcile %s %s/%s failed", rec.kind, ns, name)
+            result = None
+            failed = True
+        else:
+            failed = False
+        finally:
+            # leave _active_keys strictly BEFORE done(): once done() runs,
+            # another worker may legitimately re-acquire the key, and finding
+            # it still marked active would be a false concurrency violation
+            with self._active_lock:
+                self._active_keys.discard(key)
+        if failed:
             self._wq.done(key)
             self._wq.add_rate_limited(key)  # per-key exponential backoff
             return

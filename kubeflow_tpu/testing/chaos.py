@@ -1,0 +1,821 @@
+"""Deterministic, seeded fault injection for the control plane.
+
+The platform's whole safety argument is level-triggered reconciliation: any
+interleaving of API errors, watch drops, controller crashes, and kubelet
+flakiness must still converge to the declared state (PAPER.md §1). envtest-style
+happy-path suites only exercise conflicts incidentally; this module makes the
+hostile interleavings a first-class, *reproducible* test axis:
+
+- :class:`ChaosCluster` wraps :class:`FakeCluster` behind the same client
+  surface and injects faults from a seeded PRNG: transient 409/429/500 on any
+  verb, lost responses (the write APPLIED but the controller saw an error —
+  the retry-on-success case that flushes out idempotency gaps), per-verb
+  latency, watch-stream drops with stale re-lists and duplicate deliveries,
+  kubelet flakiness (ticks skipped, pods killed, readiness flaps, whole-gang
+  node drains), and controller crash-restart armed *between consecutive
+  writes* (the partial-write case).
+- :class:`Scenario` derives a workload (profiles, CPU/TPU/multislice/OAuth
+  notebooks, tensorboards, a stop/start/edit/delete op timeline) from the
+  same seed.
+- :func:`run_seed` runs the scenario twice — fault-free and faulted — on the
+  virtual clock and asserts the faulted run converges to the fault-free fixed
+  point with every invariant holding throughout. Every decision flows from
+  the seed, so any failure reproduces from its printed seed alone
+  (``python tools/chaos_soak.py --seed N``).
+
+Faults are injected on the *controller-facing* surface only; the harness
+mutates the underlying store directly (user/API-server side), exactly like a
+real outage hits the controllers, not etcd.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import random
+from typing import Callable
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.controllers.oauth_controller import (
+    INJECT_ANNOTATION,
+    OAuthReconciler,
+)
+from kubeflow_tpu.controllers.oauth_controller import install_webhook as _install_oauth
+from kubeflow_tpu.controllers.profile_controller import ProfileReconciler
+from kubeflow_tpu.controllers.tensorboard_controller import TensorboardReconciler
+from kubeflow_tpu.culler.culler import Culler
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import (
+    AlreadyExists,
+    Conflict,
+    FakeCluster,
+    NotFound,
+    ServerError,
+    TooManyRequests,
+)
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhooks import tpu_env
+
+
+class ControllerCrash(Exception):
+    """The controller process died mid-reconcile (chaos-injected). Raised
+    from a verb call so whatever the reconciler wrote *before* this point
+    stays in the store — the partial-write state a restart must absorb."""
+
+
+@dataclasses.dataclass
+class ChaosConfig:
+    """Per-fault probabilities. All draws come from one seeded PRNG in call
+    order, so a (seed, schedule) pair is fully reproducible."""
+
+    error_rate: float = 0.06          # pre-apply transient error on any verb
+    lost_response_rate: float = 0.04  # write applies, response lost (5xx after)
+    crash_rate: float = 0.02          # arm a controller crash after a write
+    latency_rate: float = 0.10        # verb accrues virtual-clock latency
+    latency_max_s: float = 2.0
+    watch_drop_rate: float = 0.02     # per delivered event, stream severs
+    watch_reconnect_p: float = 0.5    # per tick, a severed stream re-lists
+    duplicate_event_rate: float = 0.03  # at-least-once delivery
+    kubelet_skip_rate: float = 0.12   # kubelet tick lost (pods stuck Pending)
+    pod_kill_rate: float = 0.04       # one running pod dies
+    readiness_flap_rate: float = 0.04  # one running pod flaps to not-ready
+    gang_drain_rate: float = 0.02     # a whole gang's pods evicted (node drain)
+    read_errors: tuple = (TooManyRequests, ServerError)
+    write_errors: tuple = (Conflict, TooManyRequests, ServerError)
+
+    @classmethod
+    def quiet(cls) -> "ChaosConfig":
+        """Every probabilistic fault off — targeted tests arm exactly the
+        fault under study (``arm_crash``, ``outage``, ``drop_all_watches``)."""
+        return cls(
+            error_rate=0.0, lost_response_rate=0.0, crash_rate=0.0,
+            latency_rate=0.0, watch_drop_rate=0.0, duplicate_event_rate=0.0,
+            kubelet_skip_rate=0.0, pod_kill_rate=0.0, readiness_flap_rate=0.0,
+            gang_drain_rate=0.0,
+        )
+
+
+class _Sub:
+    __slots__ = ("kind", "fn", "dropped")
+
+    def __init__(self, kind, fn):
+        self.kind = kind
+        self.fn = fn
+        self.dropped = False
+
+
+class ChaosCluster:
+    """FakeCluster-compatible client surface with seeded fault injection.
+
+    Controllers (and the Manager) talk to this; the test harness sets up and
+    mutates ``inner`` directly so scenario operations are never faulted.
+    """
+
+    def __init__(self, inner: FakeCluster, *, seed: int, config: ChaosConfig | None = None) -> None:
+        self.inner = inner
+        self.cfg = config or ChaosConfig()
+        self.rng = random.Random(f"faults-{seed}")
+        self.crashed = False
+        self._crash_armed = False
+        self._crash_after_writes = 0
+        self._healed = False
+        self.outage = False  # total blackout: every verb raises 500
+        self._pending_latency = 0.0
+        self._subs: list[_Sub] = []
+        self._wrapped: dict = {}  # original fn -> wrapped fn (for unwatch)
+        self.fault_counts: collections.Counter = collections.Counter()
+
+    # ------------------------------------------------------------ fault core
+
+    def _maybe_fault(self, verb: str, *, write: bool) -> None:
+        if self.outage:
+            self.fault_counts["outage"] += 1
+            raise ServerError(f"chaos: apiserver unreachable ({verb})")
+        if self._healed:
+            return
+        if self._crash_armed:
+            self._crash_armed = False
+            self.crashed = True
+            self.fault_counts["crash"] += 1
+            raise ControllerCrash(f"chaos: controller killed before {verb}")
+        r = self.rng
+        if r.random() < self.cfg.latency_rate:
+            self._pending_latency += r.uniform(0.0, self.cfg.latency_max_s)
+            self.fault_counts["latency"] += 1
+        if r.random() < self.cfg.error_rate:
+            excs = self.cfg.write_errors if write else self.cfg.read_errors
+            exc = excs[int(r.random() * len(excs)) % len(excs)]
+            self.fault_counts[exc.__name__] += 1
+            raise exc(f"chaos: injected {exc.__name__} on {verb}")
+
+    def _after_write(self, verb: str) -> None:
+        if self._healed or self.outage:
+            return
+        if self._crash_after_writes > 0:
+            self._crash_after_writes -= 1
+            if self._crash_after_writes == 0:
+                self._crash_armed = True
+                return
+        r = self.rng
+        if r.random() < self.cfg.lost_response_rate:
+            self.fault_counts["lost_response"] += 1
+            raise ServerError(f"chaos: response lost after {verb} (write applied)")
+        if r.random() < self.cfg.crash_rate:
+            self._crash_armed = True
+
+    # --------------------------------------------------------- harness knobs
+
+    def take_crash(self) -> bool:
+        """True once per injected crash; the harness rebuilds the Manager."""
+        crashed, self.crashed = self.crashed, False
+        return crashed
+
+    def take_latency(self) -> float:
+        """Accumulated injected latency; the harness advances the clock by it."""
+        lat, self._pending_latency = self._pending_latency, 0.0
+        return lat
+
+    def arm_crash(self, after_writes: int = 0) -> None:
+        """Kill the controller on the next verb call — or, with
+        ``after_writes=N``, between consecutive writes: the Nth applied write
+        succeeds and the verb after it dies, leaving a deterministic
+        partial-write state (targeted tests)."""
+        if after_writes <= 0:
+            self._crash_armed = True
+        else:
+            self._crash_after_writes = after_writes
+
+    def drop_all_watches(self) -> None:
+        for sub in self._subs:
+            sub.dropped = True
+
+    def heal(self) -> None:
+        """Stop injecting faults and reconnect every severed watch stream.
+        Convergence is asserted *after* heal: faults are transient by
+        definition; what must not be transient is their damage."""
+        self._healed = True
+        self._crash_armed = False
+        self.outage = False
+        self.tick_watches()
+
+    # ---------------------------------------------------------- watch plane
+
+    def watch(self, kind, fn) -> None:
+        sub = _Sub(kind, fn)
+
+        def wrapped(event, obj):
+            if sub.dropped:
+                self.fault_counts["swallowed"] += 1
+                return
+            if not self._healed and self.rng.random() < self.cfg.watch_drop_rate:
+                sub.dropped = True
+                self.fault_counts["watch_drop"] += 1
+                return
+            fn(event, obj)
+            if not self._healed and self.rng.random() < self.cfg.duplicate_event_rate:
+                self.fault_counts["dup_event"] += 1
+                fn(event, obj)
+
+        self._subs.append(sub)
+        self._wrapped[fn] = wrapped
+        self.inner.watch(kind, wrapped)
+
+    def unwatch(self, fn) -> None:
+        wrapped = self._wrapped.pop(fn, None)
+        self._subs = [s for s in self._subs if s.fn is not fn]
+        self.inner.unwatch(wrapped if wrapped is not None else fn)
+
+    def tick_watches(self) -> None:
+        """Reconnect severed streams: a reconnect replays the CURRENT object
+        list as ADDED (informer re-list) — events missed during the drop stay
+        missed; level-triggered reconcilers must recover from the list."""
+        for sub in self._subs:
+            if not sub.dropped:
+                continue
+            if self._healed or self.rng.random() < self.cfg.watch_reconnect_p:
+                sub.dropped = False
+                self.fault_counts["relist"] += 1
+                objs = (
+                    self.inner.list(sub.kind)
+                    if sub.kind is not None
+                    else self.inner.dump()
+                )
+                for obj in objs:
+                    sub.fn("ADDED", obj)
+
+    # --------------------------------------------------------- fake kubelet
+
+    def step_kubelet(self) -> None:
+        if not self._healed:
+            r = self.rng
+            if r.random() < self.cfg.kubelet_skip_rate:
+                self.fault_counts["kubelet_skip"] += 1
+                return  # kubelet outage: pods stay Pending this tick
+            running = [
+                p
+                for p in self.inner.list("Pod")
+                if p.get("status", {}).get("phase") == "Running"
+            ]
+            if running and r.random() < self.cfg.pod_kill_rate:
+                victim = running[int(r.random() * len(running)) % len(running)]
+                self.fault_counts["pod_kill"] += 1
+                self._evict(victim)
+            if running and r.random() < self.cfg.readiness_flap_rate:
+                victim = running[int(r.random() * len(running)) % len(running)]
+                self.fault_counts["readiness_flap"] += 1
+                try:
+                    self.inner.patch(
+                        "Pod", ko.name(victim), ko.namespace(victim),
+                        {"status": {"phase": "Pending", "conditions": [
+                            {"type": "Ready", "status": "False"}]}},
+                    )
+                except NotFound:
+                    pass  # same pod the kill above already evicted
+            stses = self.inner.list("StatefulSet")
+            if stses and r.random() < self.cfg.gang_drain_rate:
+                gang = stses[int(r.random() * len(stses)) % len(stses)]
+                self.fault_counts["gang_drain"] += 1
+                uid = gang["metadata"].get("uid")
+                for p in self.inner.list("Pod", ko.namespace(gang)):
+                    if any(
+                        ref.get("uid") == uid
+                        for ref in p["metadata"].get("ownerReferences", [])
+                    ):
+                        self._evict(p)
+        self.inner.step_kubelet()
+
+    def _evict(self, pod: dict) -> None:
+        try:
+            self.inner.delete("Pod", ko.name(pod), ko.namespace(pod))
+        except NotFound:
+            pass
+
+    # ------------------------------------------------- faulted client verbs
+
+    def create(self, obj, **kw):
+        self._maybe_fault("create", write=True)
+        out = self.inner.create(obj, **kw)
+        self._after_write("create")
+        return out
+
+    def update(self, obj):
+        self._maybe_fault("update", write=True)
+        out = self.inner.update(obj)
+        self._after_write("update")
+        return out
+
+    def update_status(self, obj):
+        self._maybe_fault("update_status", write=True)
+        out = self.inner.update_status(obj)
+        self._after_write("update_status")
+        return out
+
+    def patch(self, kind, name, namespace, patch):
+        self._maybe_fault("patch", write=True)
+        out = self.inner.patch(kind, name, namespace, patch)
+        self._after_write("patch")
+        return out
+
+    def delete(self, kind, name, namespace=""):
+        self._maybe_fault("delete", write=True)
+        out = self.inner.delete(kind, name, namespace)
+        self._after_write("delete")
+        return out
+
+    def finalize(self, obj):
+        self._maybe_fault("finalize", write=True)
+        out = self.inner.finalize(obj)
+        self._after_write("finalize")
+        return out
+
+    def emit_event(self, involved, reason, message, type_="Normal", count=1):
+        self._maybe_fault("emit_event", write=True)
+        out = self.inner.emit_event(involved, reason, message, type_, count)
+        self._after_write("emit_event")
+        return out
+
+    def get(self, kind, name, namespace=""):
+        self._maybe_fault("get", write=False)
+        return self.inner.get(kind, name, namespace)
+
+    def try_get(self, kind, name, namespace=""):
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def list(self, kind, namespace=None, selector=None):
+        self._maybe_fault("list", write=False)
+        return self.inner.list(kind, namespace, selector)
+
+    def events_for(self, involved):
+        self._maybe_fault("events_for", write=False)
+        return self.inner.events_for(involved)
+
+    def __getattr__(self, name):
+        # everything else (register_mutator, add_node, pod_logs, dump, ...)
+        # passes through unfaulted
+        return getattr(self.inner, name)
+
+
+# ---------------------------------------------------------------- invariants
+
+# Largest delay a reconciler may legitimately schedule: the soak's culler
+# check period (30 s), the OAuth lock requeue (3 s), and the manager's error
+# backoff cap (64 s). Anything beyond is a backoff-escape bug.
+SOAK_MAX_REQUEUE_S = 65.0
+
+_TS_ANNOTATIONS = (
+    api.STOP_ANNOTATION,
+    api.LAST_ACTIVITY_ANNOTATION,
+    api.LAST_ACTIVITY_CHECK_TS,
+)
+
+
+def check_invariants(
+    base: FakeCluster,
+    manager: Manager | None = None,
+    *,
+    max_requeue_s: float | None = None,
+    where: str = "",
+    final: bool = False,
+) -> list[str]:
+    """Safety properties that must hold in EVERY observable state, not just
+    at the fixed point. Returns human-readable violations (empty == healthy)."""
+    out: list[str] = []
+    objs = base.dump()
+    uids = {o.get("metadata", {}).get("uid") for o in objs}
+    for o in objs:
+        kind, ns, nm = o.get("kind"), ko.namespace(o), ko.name(o)
+        for ref in o.get("metadata", {}).get("ownerReferences", []) or []:
+            if ref.get("uid") and ref["uid"] not in uids:
+                out.append(
+                    f"{where}: orphaned owned object {kind} {ns}/{nm} "
+                    f"(owner {ref.get('kind')}/{ref.get('name')} gone)"
+                )
+        if kind == "Notebook":
+            status = o.get("status", {}) or {}
+            conds = {c.get("type"): c for c in status.get("conditions", [])}
+            ready_cond = conds.get("TPUSliceReady")
+            if ready_cond is not None and ready_cond.get("status") == "True":
+                tpu = status.get("tpu") or {}
+                expected = int(tpu.get("numHosts", 0)) * int(tpu.get("numSlices", 1))
+                if expected <= 0 or status.get("readyReplicas", 0) < expected:
+                    out.append(
+                        f"{where}: gang all-or-nothing violated for {ns}/{nm}: "
+                        f"TPUSliceReady=True with readyReplicas="
+                        f"{status.get('readyReplicas')} expected={expected}"
+                    )
+        if final and o.get("metadata", {}).get("deletionTimestamp") and not (
+            o.get("metadata", {}).get("finalizers")
+        ):
+            out.append(f"{where}: {kind} {ns}/{nm} stuck terminating")
+    if manager is not None:
+        if manager.concurrency_violations:
+            out.append(
+                f"{where}: one-worker-per-key violated "
+                f"{manager.concurrency_violations}x"
+            )
+        if max_requeue_s is not None:
+            nri = manager.next_requeue_in()
+            if nri is not None and nri > max_requeue_s + 1e-6:
+                out.append(
+                    f"{where}: requeue scheduled {nri:.1f}s out "
+                    f"(> {max_requeue_s:.1f}s backoff/requeue bound)"
+                )
+    return out
+
+
+# --------------------------------------------------------------- fingerprint
+
+def _normalize(obj: dict) -> dict:
+    o = ko.deep_copy(obj)
+    m = o.setdefault("metadata", {})
+    for field in ("resourceVersion", "uid", "creationTimestamp", "generation"):
+        m.pop(field, None)
+    if "deletionTimestamp" in m:
+        m["deletionTimestamp"] = "<set>"
+    for ref in m.get("ownerReferences", []) or []:
+        ref.pop("uid", None)
+    anns = m.get("annotations")
+    if anns:
+        # stop-state is declared state: keep its presence, not its timestamp
+        if api.STOP_ANNOTATION in anns:
+            anns[api.STOP_ANNOTATION] = "<set>"
+        # activity tracking is bookkeeping keyed to the run's clock: injected
+        # latency legitimately shifts when the culler and a scripted stop
+        # race, flipping which one wrote (or cleared) these keys — presence
+        # itself is history, not converged state
+        anns.pop(api.LAST_ACTIVITY_ANNOTATION, None)
+        anns.pop(api.LAST_ACTIVITY_CHECK_TS, None)
+    if o.get("kind") == "Secret":
+        for field in ("data", "stringData"):
+            if field in o:
+                o[field] = {k: "<redacted>" for k in o[field]}
+    if o.get("kind") == "Profile":
+        conds = (o.get("status") or {}).get("conditions")
+        if conds:
+            # conditions are an append-only history; only the latest is state
+            o["status"]["conditions"] = [conds[-1]]
+    return o
+
+
+def fingerprint(base: FakeCluster) -> str:
+    """Canonical serialization of the cluster's *declared + converged* state:
+    everything except Events (a log, not state) and fields that encode run
+    history (uids, revisions, timestamps) rather than outcome."""
+    objs = [
+        _normalize(o)
+        for o in base.dump()
+        if o.get("kind") != "Event"
+    ]
+    objs.sort(key=lambda o: (o.get("kind", ""), ko.namespace(o), ko.name(o)))
+    return json.dumps(objs, sort_keys=True)
+
+
+# ------------------------------------------------------------------ scenario
+
+class Scenario:
+    """A seeded workload + operation timeline, identical for the fault-free
+    and faulted runs of the same seed."""
+
+    N_ROUNDS = 8
+    NAMESPACE = "team-a"
+
+    def __init__(self, seed: int) -> None:
+        rng = random.Random(f"scenario-{seed}")
+        self.seed = seed
+        self.culling = rng.random() < 0.5
+        self.notebooks: dict[str, dict] = {"nb-cpu": {}}
+        if rng.random() < 0.8:
+            self.notebooks["nb-tpu"] = dict(
+                tpu_accelerator="v4", tpu_topology="2x2x2"
+            )
+        if rng.random() < 0.4:
+            self.notebooks["nb-ms"] = dict(
+                tpu_accelerator="v4", tpu_topology="2x2x2", tpu_num_slices=2
+            )
+        if rng.random() < 0.5:
+            self.notebooks["nb-oauth"] = dict(
+                annotations={INJECT_ANNOTATION: "true"}
+            )
+        self.active = {n for n in sorted(self.notebooks) if rng.random() < 0.4}
+        self.profiles = ["team-a"] + (["team-b"] if rng.random() < 0.5 else [])
+        self.tensorboards = (
+            {"tb-0": "pvc://logs-claim/runs"} if rng.random() < 0.6 else {}
+        )
+        self.rounds = self._op_timeline(rng)
+
+    def _op_timeline(self, rng: random.Random) -> list[list[tuple[str, str]]]:
+        alive_nb, dead_nb = set(self.notebooks), set()
+        alive_tb, dead_tb = set(self.tensorboards), set()
+        alive_pr, dead_pr = set(self.profiles) - {"team-a"}, set()
+        rounds: list[list[tuple[str, str]]] = []
+        for _ in range(self.N_ROUNDS):
+            ops: list[tuple[str, str]] = []
+            for _ in range(rng.randint(0, 2)):
+                choices: list[tuple[str, str]] = []
+                for nb in sorted(alive_nb):
+                    choices += [
+                        ("stop", nb), ("start", nb),
+                        ("edit_cpu", nb), ("delete_nb", nb),
+                    ]
+                choices += [("recreate_nb", nb) for nb in sorted(dead_nb)]
+                choices += [("delete_tb", tb) for tb in sorted(alive_tb)]
+                choices += [("recreate_tb", tb) for tb in sorted(dead_tb)]
+                choices += [("delete_profile", p) for p in sorted(alive_pr)]
+                choices += [("recreate_profile", p) for p in sorted(dead_pr)]
+                if not choices:
+                    break
+                op = choices[int(rng.random() * len(choices)) % len(choices)]
+                verb, target = op
+                if verb == "delete_nb":
+                    alive_nb.discard(target); dead_nb.add(target)
+                elif verb == "recreate_nb":
+                    dead_nb.discard(target); alive_nb.add(target)
+                elif verb == "delete_tb":
+                    alive_tb.discard(target); dead_tb.add(target)
+                elif verb == "recreate_tb":
+                    dead_tb.discard(target); alive_tb.add(target)
+                elif verb == "delete_profile":
+                    alive_pr.discard(target); dead_pr.add(target)
+                elif verb == "recreate_profile":
+                    dead_pr.discard(target); alive_pr.add(target)
+                ops.append(op)
+            rounds.append(ops)
+        return rounds
+
+    # -- world construction (user / API-server side: never faulted) ---------
+
+    def _nb(self, name: str) -> dict:
+        return api.notebook(name, self.NAMESPACE, **self.notebooks[name])
+
+    def setup(self, base: FakeCluster) -> None:
+        for p in self.profiles:
+            base.create(api.profile(p, owner_name=f"{p}-owner@example.com"))
+        for nb in sorted(self.notebooks):
+            base.create(self._nb(nb))
+        for tb, path in sorted(self.tensorboards.items()):
+            base.create(api.tensorboard(tb, self.NAMESPACE, path))
+
+    def apply(self, base: FakeCluster, op: tuple[str, str], round_no: int) -> None:
+        verb, target = op
+        ns = self.NAMESPACE
+        try:
+            if verb == "stop":
+                base.patch("Notebook", target, ns, {"metadata": {"annotations": {
+                    api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+            elif verb == "start":
+                base.patch("Notebook", target, ns, {"metadata": {"annotations": {
+                    api.STOP_ANNOTATION: None,
+                    api.LAST_ACTIVITY_ANNOTATION: None}}})
+            elif verb == "edit_cpu":
+                nb = base.get("Notebook", target, ns)
+                nb["spec"]["template"]["spec"]["containers"][0]["resources"][
+                    "requests"]["cpu"] = ("0.5", "1", "2")[round_no % 3]
+                base.update(nb)
+            elif verb == "delete_nb":
+                base.delete("Notebook", target, ns)
+            elif verb == "recreate_nb":
+                base.create(self._nb(target))
+            elif verb == "delete_tb":
+                base.delete("Tensorboard", target, ns)
+            elif verb == "recreate_tb":
+                base.create(
+                    api.tensorboard(target, ns, self.tensorboards[target])
+                )
+            elif verb == "delete_profile":
+                base.delete("Profile", target)
+            elif verb == "recreate_profile":
+                base.create(
+                    api.profile(target, owner_name=f"{target}-owner@example.com")
+                )
+        except (NotFound, AlreadyExists, Conflict):
+            pass  # op raced a controller write; the next round's op retries
+
+    def make_fetcher(self) -> Callable:
+        active = set(self.active)
+
+        def fetch(namespace: str, name: str):
+            if name in active:
+                return [{"execution_state": "busy"}]
+            return []  # reachable server, zero kernels: idle by definition
+
+        return fetch
+
+
+# -------------------------------------------------------------------- runner
+
+class _Clock:
+    def __init__(self, start: float) -> None:
+        self.t = start
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+@dataclasses.dataclass
+class ScenarioRun:
+    fingerprint: str
+    violations: list[str]
+    restarts: int
+    fault_counts: collections.Counter
+    quiesced: bool
+
+
+@dataclasses.dataclass
+class SeedResult:
+    seed: int
+    converged: bool
+    violations: list[str]
+    restarts: int
+    fault_counts: collections.Counter
+
+    @property
+    def ok(self) -> bool:
+        return self.converged and not self.violations
+
+    def describe(self) -> str:
+        if self.ok:
+            faults = sum(self.fault_counts.values())
+            return (
+                f"seed {self.seed}: converged "
+                f"({faults} faults, {self.restarts} controller restarts)"
+            )
+        lines = [f"seed {self.seed}: FAILED "
+                 f"(repro: python tools/chaos_soak.py --seed {self.seed})"]
+        if not self.converged:
+            lines.append("  final state diverged from fault-free fixed point")
+        lines += [f"  invariant: {v}" for v in self.violations[:10]]
+        if len(self.violations) > 10:
+            lines.append(f"  ... {len(self.violations) - 10} more")
+        return "\n".join(lines)
+
+
+def run_scenario(
+    seed: int,
+    faults: ChaosConfig | None = None,
+    *,
+    max_restarts_per_tick: int = 6,
+) -> ScenarioRun:
+    """One full scenario run on the virtual clock. ``faults=None`` is the
+    fault-free reference run whose final state is the fixed point."""
+    scenario = Scenario(seed)
+    base = FakeCluster()
+    tpu_env.install(base)
+    _install_oauth(base)
+    chaos = ChaosCluster(base, seed=seed, config=faults) if faults else None
+    cluster = chaos if chaos is not None else base
+    clock = _Clock(1_000_000.0)
+    cfg = ControllerConfig()
+    culler = Culler(
+        enabled=scenario.culling,
+        cull_idle_minutes=1.0,
+        check_period_minutes=0.5,
+        fetch_kernels=scenario.make_fetcher(),
+        clock=clock,
+    )
+
+    def build() -> Manager:
+        m = Manager(cluster, clock=clock)
+        m.register(NotebookReconciler(cfg, culler=culler))
+        m.register(ProfileReconciler())
+        m.register(TensorboardReconciler(cfg))
+        m.register(OAuthReconciler())
+        return m
+
+    mgr = build()
+    violations: list[str] = []
+    restarts = 0
+
+    def tick(where: str) -> None:
+        nonlocal mgr, restarts
+        for _ in range(max_restarts_per_tick):
+            crashed = False
+            try:
+                mgr.tick()
+            except Exception:
+                # start_watches faulted mid-install (rolled back) or the
+                # reconcile loop blew up: either way the process would die
+                crashed = True
+            if chaos is not None and chaos.take_crash():
+                crashed = True
+            if not crashed:
+                return
+            # controller crash-restart: rebuild the Manager from scratch —
+            # fresh workqueue, fresh watch sync — and resume over whatever
+            # partial writes the dead incarnation left behind
+            restarts += 1
+            mgr.shutdown()
+            mgr = build()
+        # crash storm exhausted the budget this tick; next tick retries
+
+    def drive(where: str, *, sub_ticks: int = 3, dt: float = 10.0) -> None:
+        for s in range(sub_ticks):
+            cluster.step_kubelet()
+            if chaos is not None:
+                chaos.tick_watches()
+            tick(where)
+            if chaos is not None:
+                lat = chaos.take_latency()
+                if lat:
+                    clock.advance(lat)
+            violations.extend(
+                check_invariants(
+                    base, mgr,
+                    max_requeue_s=SOAK_MAX_REQUEUE_S,
+                    where=f"{where}.{s}",
+                )
+            )
+        clock.advance(dt)
+
+    for r, ops in enumerate(scenario.rounds):
+        for op in ops:
+            scenario.apply(base, op, r)
+        drive(f"round {r}")
+
+    if chaos is not None:
+        chaos.heal()
+
+    # settle: push the clock far past the cull-idle threshold (60 s) and the
+    # error-backoff cap (64 s) so both runs reach the same steady state
+    for s in range(8):
+        drive(f"settle {s}", sub_ticks=2, dt=45.0)
+
+    # quiesce: iterate until the normalized fingerprint is stable
+    prev = None
+    quiesced = False
+    for s in range(20):
+        cluster.step_kubelet()
+        tick(f"quiesce {s}")
+        fp = fingerprint(base)
+        if fp == prev:
+            quiesced = True
+            break
+        prev = fp
+        clock.advance(65.0)
+    violations.extend(
+        check_invariants(
+            base, mgr,
+            max_requeue_s=SOAK_MAX_REQUEUE_S,
+            where="final", final=True,
+        )
+    )
+    return ScenarioRun(
+        fingerprint=prev or fingerprint(base),
+        violations=violations,
+        restarts=restarts,
+        fault_counts=(chaos.fault_counts if chaos else collections.Counter()),
+        quiesced=quiesced,
+    )
+
+
+def run_seed(seed: int, faults: ChaosConfig | None = None) -> SeedResult:
+    """The soak unit: fault-free fixed point vs faulted run, same seed."""
+    reference = run_scenario(seed, None)
+    chaotic = run_scenario(seed, faults or ChaosConfig())
+    violations = list(chaotic.violations)
+    if reference.violations:
+        violations += [f"(fault-free!) {v}" for v in reference.violations]
+    if not chaotic.quiesced:
+        violations.append("faulted run did not quiesce")
+    converged = chaotic.fingerprint == reference.fingerprint
+    return SeedResult(
+        seed=seed,
+        converged=converged,
+        violations=violations,
+        restarts=chaotic.restarts,
+        fault_counts=chaotic.fault_counts,
+    )
+
+
+def diff_states(seed: int, faults: ChaosConfig | None = None) -> str:
+    """Debug helper: where the faulted fixed point diverges (chaos_soak -v)."""
+    ref = json.loads(run_scenario(seed, None).fingerprint)
+    got = json.loads(run_scenario(seed, faults or ChaosConfig()).fingerprint)
+
+    def index(objs):
+        return {
+            (o.get("kind", ""), ko.namespace(o), ko.name(o)): o for o in objs
+        }
+
+    ri, gi = index(ref), index(got)
+    lines = []
+    for key in sorted(set(ri) | set(gi)):
+        if key not in gi:
+            lines.append(f"missing in faulted run: {key}")
+        elif key not in ri:
+            lines.append(f"extra in faulted run:   {key}")
+        elif ri[key] != gi[key]:
+            lines.append(f"differs: {key}")
+            a = json.dumps(ri[key], sort_keys=True, indent=1).splitlines()
+            b = json.dumps(gi[key], sort_keys=True, indent=1).splitlines()
+            import difflib
+
+            lines += list(difflib.unified_diff(a, b, "reference", "faulted", n=1))
+    return "\n".join(lines) or "states identical"

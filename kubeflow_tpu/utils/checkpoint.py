@@ -14,9 +14,12 @@ exist there. This module adds it TPU-natively on orbax:
 """
 from __future__ import annotations
 
+import logging
 from typing import Any
 
 import jax
+
+log = logging.getLogger(__name__)
 
 
 class CheckpointManager:
@@ -62,6 +65,10 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self.manager.latest_step()
 
+    def all_steps(self) -> list[int]:
+        """Retained steps, ascending (fallback order for torn-step recovery)."""
+        return sorted(self.manager.all_steps())
+
     def wait(self) -> None:
         """Block until async saves land (call before letting a cull proceed)."""
         self.manager.wait_until_finished()
@@ -76,12 +83,25 @@ def resume_or_init(directory: str, init_fn, *args, **kwargs):
     (same topology re-formed by the reconciler), this makes culling lossless:
 
         state = resume_or_init("/home/jovyan/ckpt", bundle.init, rng, batch)
+
+    A corrupt or partial step is treated as absent, not fatal: a notebook
+    culled (or its host drained) mid-save leaves a torn latest step behind,
+    and the very next cell execution calls this — raising here would brick
+    resume exactly when it matters. Fall back step-by-step to the newest
+    restorable checkpoint, or fresh init when none survives.
     """
     state = init_fn(*args, **kwargs)
     mgr = CheckpointManager(directory)
     try:
-        if mgr.latest_step() is not None:
-            state = mgr.restore(state)
+        for step in reversed(mgr.all_steps()):
+            try:
+                return mgr.restore(state, step)
+            except Exception as exc:
+                log.warning(
+                    "checkpoint step %d under %s is torn/corrupt (%s); "
+                    "falling back to the previous step",
+                    step, directory, exc,
+                )
     finally:
         mgr.close()
     return state

@@ -69,6 +69,84 @@ class TestCheckpoint:
         assert int(resumed["step"]) == 1
 
 
+class TestTornCheckpoint:
+    """A notebook culled (or its TPU host drained) mid-save leaves a torn
+    latest step; ``resume_or_init`` must fall back to the newest restorable
+    step — or fresh init — instead of raising into the user's first cell.
+    Stubbed orbax so the torn-read path is deterministic and dependency-free."""
+
+    def _stub_orbax(self, monkeypatch, steps, torn, restore_calls):
+        import sys
+        import types
+
+        class StubArgs:
+            @staticmethod
+            def StandardSave(state):
+                return state
+
+            @staticmethod
+            def StandardRestore(abstract):
+                return abstract
+
+        class StubManager:
+            def __init__(self, directory, options=None):
+                pass
+
+            def all_steps(self):
+                return list(steps)
+
+            def latest_step(self):
+                return max(steps) if steps else None
+
+            def restore(self, step, args=None):
+                restore_calls.append(step)
+                if step in torn:
+                    # orbax surfaces torn/partial steps as ValueError (missing
+                    # shard files) or FileNotFoundError (no commit marker)
+                    raise ValueError(f"missing shard for step {step}")
+                return {"step": step}
+
+            def wait_until_finished(self):
+                pass
+
+            def close(self):
+                pass
+
+        ckpt = types.ModuleType("orbax.checkpoint")
+        ckpt.CheckpointManager = StubManager
+        ckpt.CheckpointManagerOptions = lambda **kw: None
+        ckpt.args = StubArgs
+        orbax = types.ModuleType("orbax")
+        orbax.checkpoint = ckpt
+        monkeypatch.setitem(sys.modules, "orbax", orbax)
+        monkeypatch.setitem(sys.modules, "orbax.checkpoint", ckpt)
+
+    def test_falls_back_past_torn_latest_step(self, monkeypatch, tmp_path, caplog):
+        import logging
+
+        calls = []
+        self._stub_orbax(monkeypatch, steps=[1, 2, 3], torn={3}, restore_calls=calls)
+        with caplog.at_level(logging.WARNING, logger="kubeflow_tpu.utils.checkpoint"):
+            state = resume_or_init(str(tmp_path), lambda: {"step": 0})
+        assert state == {"step": 2}  # newest restorable, not the torn 3
+        assert calls == [3, 2]  # tried latest first, fell back once
+        assert "torn/corrupt" in caplog.text
+
+    def test_fresh_init_when_every_step_torn(self, monkeypatch, tmp_path):
+        calls = []
+        self._stub_orbax(monkeypatch, steps=[1, 2], torn={1, 2}, restore_calls=calls)
+        state = resume_or_init(str(tmp_path), lambda: {"step": 0})
+        assert state == {"step": 0}  # fresh init, no exception escaped
+        assert calls == [2, 1]
+
+    def test_no_checkpoints_is_plain_init(self, monkeypatch, tmp_path):
+        calls = []
+        self._stub_orbax(monkeypatch, steps=[], torn=set(), restore_calls=calls)
+        state = resume_or_init(str(tmp_path), lambda: {"step": 0})
+        assert state == {"step": 0}
+        assert calls == []
+
+
 class TestProfiling:
     def test_trace_writes_profile_dir(self, tmp_path):
         from kubeflow_tpu.utils.profiling import trace

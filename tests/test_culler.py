@@ -129,3 +129,40 @@ def test_stop_annotation_roundtrip():
     assert c.stop_annotation_is_set(nb)
     c.remove_stop_annotation(nb)
     assert not c.stop_annotation_is_set(nb)
+
+
+def test_replayed_events_do_not_double_stop():
+    """At-least-once watch delivery: duplicate or re-listed events reaching
+    the reconciler after a cull must not rewrite the stop timestamp — a
+    double-stop would both churn the object forever and move the user-visible
+    'stopped at' time (the chaos soak's duplicate_event_rate exercises this
+    path probabilistically; this pins it deterministically)."""
+    from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+    from kubeflow_tpu.runtime.fake import FakeCluster
+    from kubeflow_tpu.utils.config import ControllerConfig
+
+    class Clock:
+        t = 1000.0
+
+        def __call__(self):
+            return self.t
+
+    clock = Clock()
+    cul = c.Culler(
+        enabled=True, cull_idle_minutes=1.0, check_period_minutes=0.1,
+        fetch_kernels=lambda ns, name: [], clock=clock,
+    )
+    cluster = FakeCluster()
+    cluster.create(api.notebook("n", "ns"))
+    rec = NotebookReconciler(ControllerConfig(), culler=cul)
+    rec.reconcile(cluster, "ns", "n")  # seeds last-activity
+    clock.t += 120.0  # idle past the 60 s threshold
+    rec.reconcile(cluster, "ns", "n")  # culls: stop annotation set
+    stop_ts = cluster.get("Notebook", "n", "ns")["metadata"]["annotations"][
+        api.STOP_ANNOTATION
+    ]
+    for dt in (30.0, 600.0):  # replayed/duplicate deliveries, much later
+        clock.t += dt
+        rec.reconcile(cluster, "ns", "n")
+        anns = cluster.get("Notebook", "n", "ns")["metadata"]["annotations"]
+        assert anns[api.STOP_ANNOTATION] == stop_ts, "double-stop rewrote the timestamp"

@@ -168,3 +168,104 @@ class TestElection:
                 FakeCluster(), name="x", identity="a",
                 lease_duration=10.0, renew_deadline=10.0,
             )
+
+    def test_apiserver_outage_fires_stop_exactly_once_and_run_returns(self):
+        """Chaos-injected apiserver blackout past renew_deadline:
+        ``on_stopped_leading`` fires exactly once, ``run`` returns on its own
+        (nobody sets the stop event), and the loop never writes a renew after
+        standing down — the ex-leader must not reclaim its own still-unexpired
+        lease into a process whose workers already stopped."""
+        from kubeflow_tpu.runtime.leader import _parse
+        from kubeflow_tpu.testing.chaos import ChaosCluster, ChaosConfig
+
+        base, clock = FakeCluster(), FakeClock()
+        chaos = ChaosCluster(base, seed=1, config=ChaosConfig.quiet())
+        a = LeaderElector(
+            chaos, name="test-lock", identity="a",
+            lease_duration=15.0, retry_period=0.01, clock=clock,
+        )
+        started = threading.Event()
+        stopped = []
+        t = threading.Thread(
+            target=a.run, args=(started.set,),
+            kwargs={"on_stopped_leading": lambda: stopped.append(clock())},
+            daemon=True,
+        )
+        t.start()
+        assert started.wait(timeout=5)
+        acquired_at = clock()
+        chaos.outage = True  # total blackout: every verb raises 500
+        # within renew_deadline: blips must not flap leadership
+        clock.t = acquired_at + 5.0
+        import time as _t
+
+        _t.sleep(0.1)
+        assert not stopped
+        assert a.is_leader
+        # past renew_deadline (10 s), before lease expiry (15 s): stand down
+        clock.t = acquired_at + a.renew_deadline + 0.5
+        t.join(timeout=5)
+        assert not t.is_alive(), "run() kept looping after standing down"
+        assert len(stopped) == 1, f"on_stopped_leading fired {len(stopped)}x"
+        assert stopped[0] < acquired_at + a.lease_duration
+        assert a.is_leader is False
+        # no zombie renew: the lease's renewTime froze at the last successful
+        # pre-outage renew, so a challenger can take over on schedule
+        lease = base.get("Lease", "test-lock", "kubeflow-system")
+        assert _parse(lease["spec"]["renewTime"]) <= acquired_at
+        chaos.outage = False
+        clock.t = acquired_at + 20.0  # lease expired for challengers
+        b = make(base, "b", clock)
+        assert b.try_acquire_or_renew() is True
+
+    def test_transient_renew_conflict_does_not_stand_down(self):
+        """A 409 blip on the leader's OWN renew write (chaos write_errors
+        treats Conflict as transient) must ride the renew_deadline grace, not
+        stand the leader down instantly — run() returning on a single blip
+        would be a permanent, unnecessary abdication."""
+        from kubeflow_tpu.runtime.fake import Conflict
+
+        base, clock = FakeCluster(), FakeClock()
+
+        class Blippy:
+            """One-shot: the next Lease update raises Conflict pre-apply."""
+
+            def __init__(self, inner):
+                self.inner = inner
+                self.blips = 0
+
+            def update(self, obj):
+                if self.blips > 0:
+                    self.blips -= 1
+                    raise Conflict("chaos: injected 409 on renew")
+                return self.inner.update(obj)
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+        proxy = Blippy(base)
+        a = make(proxy, "a", clock)
+        started = threading.Event()
+        stop = threading.Event()
+        stopped = []
+        t = threading.Thread(
+            target=a.run, args=(started.set,),
+            kwargs={"on_stopped_leading": lambda: stopped.append(clock()),
+                    "stop": stop},
+            daemon=True,
+        )
+        t.start()
+        assert started.wait(timeout=5)
+        import time as _t
+
+        proxy.blips = 1
+        clock.t += 5.0  # well inside renew_deadline (10 s)
+        _t.sleep(0.2)  # several retry periods: blip consumed, then a renew
+        assert not stopped, "single renew 409 stood the leader down"
+        assert a.is_leader
+        lease = base.get("Lease", "test-lock", "kubeflow-system")
+        assert lease["spec"]["holderIdentity"] == "a"
+        stop.set()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert not stopped

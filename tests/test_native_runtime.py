@@ -189,6 +189,94 @@ class TestWorkQueue:
         assert sorted(seen) == sorted(f"key-{i}" for i in range(500))
 
 
+class TestWorkQueueParity:
+    """Differential testing: the native queue and the Python fallback must be
+    observably identical — same drain order, same failure counters, same
+    metrics — under randomized op schedules on the virtual clock. A platform
+    that silently changes behavior depending on whether the .so built is a
+    platform with heisenbugs."""
+
+    OPS = (
+        "add", "add", "add",          # weighted: adds dominate real traffic
+        "add_after", "add_rate_limited",
+        "get", "get", "get",
+        "done", "forget", "advance",
+    )
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_randomized_schedule_parity(self, seed):
+        if not wq.native_available():
+            pytest.skip("native library unavailable")
+        import random
+
+        rng = random.Random(seed)
+        queues = [
+            wq.NativeWorkQueue(virtual_clock=True, backoff_base=0.5, backoff_max=8.0),
+            wq.PyWorkQueue(virtual_clock=True, backoff_base=0.5, backoff_max=8.0),
+        ]
+        keys = [f"k{i}" for i in range(6)]
+        in_flight: list[str] = []  # identical across queues by induction
+        drained: list[str] = []
+        for _ in range(600):
+            op = rng.choice(self.OPS)
+            key = rng.choice(keys)
+            if op == "add":
+                for q in queues:
+                    q.add(key)
+            elif op == "add_after":
+                delay = rng.choice([0.0, 0.5, 2.0, 5.0])
+                for q in queues:
+                    q.add_after(key, delay)
+            elif op == "add_rate_limited":
+                for q in queues:
+                    q.add_rate_limited(key)
+            elif op == "get":
+                a, b = (q.get(0) for q in queues)
+                assert a == b, f"drain order diverged: native={a} python={b}"
+                if a is not None:
+                    in_flight.append(a)
+                    drained.append(a)
+            elif op == "done":
+                if in_flight:
+                    k = in_flight.pop(rng.randrange(len(in_flight)))
+                    for q in queues:
+                        q.done(k)
+            elif op == "forget":
+                for q in queues:
+                    q.forget(key)
+            elif op == "advance":
+                dt = rng.choice([0.25, 1.0, 4.0])
+                for q in queues:
+                    q.advance(dt)
+            qa, qb = queues
+            assert len(qa) == len(qb)
+            assert qa.timer_count() == qb.timer_count()
+            assert qa.failures(key) == qb.failures(key)
+        # settle: finish in-flight keys, fire every timer, drain to empty
+        for k in list(in_flight):
+            for q in queues:
+                q.done(k)
+        for q in queues:
+            q.advance(1000.0)
+        while True:
+            a, b = (q.get(0) for q in queues)
+            assert a == b
+            if a is None:
+                break
+            drained.append(a)
+            for q in queues:
+                q.done(a)
+        qa, qb = queues
+        assert qa.metrics() == qb.metrics()
+        assert [qa.failures(k) for k in keys] == [qb.failures(k) for k in keys]
+        # shutdown semantics match: drained queues return None ever after
+        for q in queues:
+            q.shutdown()
+            q.add("post-shutdown")  # must be a no-op
+        assert qa.get(0) == qb.get(0) == None  # noqa: E711
+        assert drained, "schedule never handed out a key (degenerate test)"
+
+
 class _KernelsHandler(http.server.BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         if self.path.endswith("/api/kernels"):

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Convergence soak: hundreds of seeded fault schedules against the control
+plane, each asserted to converge to its fault-free fixed point with every
+invariant holding throughout (docs/chaos.md).
+
+    python tools/chaos_soak.py --seeds 200     # CI sweep
+    python tools/chaos_soak.py --seed 1234     # reproduce one failure exactly
+    python tools/chaos_soak.py --seed 1234 -v  # ... with a state diff
+
+Every failure line carries its seed; ``--seed N`` replays the identical
+schedule (same scenario, same faults, same interleaving) — the printed repro
+command is the whole bug report.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from kubeflow_tpu.testing.chaos import (  # noqa: E402
+    ChaosConfig,
+    diff_states,
+    run_seed,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=200,
+                    help="number of seeds to sweep (default 200)")
+    ap.add_argument("--start", type=int, default=1,
+                    help="first seed of the sweep (default 1)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="run exactly one seed (failure reproduction)")
+    ap.add_argument("--error-rate", type=float, default=None,
+                    help="override ChaosConfig.error_rate")
+    ap.add_argument("--crash-rate", type=float, default=None,
+                    help="override ChaosConfig.crash_rate")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="per-seed lines; on failure, a fixed-point diff")
+    args = ap.parse_args(argv)
+
+    # injected faults make reconcilers scream; the soak's verdict is the
+    # convergence check, not the log stream
+    logging.disable(logging.ERROR)
+
+    cfg = ChaosConfig()
+    if args.error_rate is not None:
+        cfg.error_rate = args.error_rate
+    if args.crash_rate is not None:
+        cfg.crash_rate = args.crash_rate
+
+    seeds = (
+        [args.seed] if args.seed is not None
+        else range(args.start, args.start + args.seeds)
+    )
+    t0 = time.monotonic()
+    failures = 0
+    total_faults = 0
+    total_restarts = 0
+    for seed in seeds:
+        result = run_seed(seed, cfg)
+        total_faults += sum(result.fault_counts.values())
+        total_restarts += result.restarts
+        if result.ok:
+            if args.verbose:
+                print(result.describe())
+        else:
+            failures += 1
+            print(result.describe())
+            if args.verbose and not result.converged:
+                print(diff_states(seed, cfg))
+    n = len(list(seeds))
+    dt = time.monotonic() - t0
+    print(
+        f"chaos soak: {n - failures}/{n} seeds converged in {dt:.1f}s "
+        f"({total_faults} faults injected, {total_restarts} controller "
+        f"restarts)"
+    )
+    if failures:
+        print(f"{failures} FAILING seed(s) — reproduce with --seed <N> above")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

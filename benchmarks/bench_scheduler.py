@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Fleet-scheduler throughput benchmark: placements/s and p99 time-to-bind
+at 10k queued gangs (docs/scheduler.md).
+
+Drives the real reconciler against the in-memory cluster with a synthetic
+fleet and a cold queue of N gangs; every cycle's binds are "completed"
+(deleted) before the next cycle, so the queue drains through the scheduler
+at its own pace — what a burst of notebook launches at the ROADMAP's
+"millions of users" scale looks like to the bind path. Time-to-bind is
+wall-clock from queue admission (the queued-at annotation the scheduler
+itself stamps) to the bind write, so it includes every real cost: listing
+the world, replaying occupancy, packing, and writing conditions.
+
+    python benchmarks/bench_scheduler.py                 # 10k gangs
+    python benchmarks/bench_scheduler.py --gangs 1000    # quick local run
+
+Emits one SCHED_BENCH JSON line (consumed by CI artifacts / perf tracking).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from kubeflow_tpu import scheduler as sched  # noqa: E402
+from kubeflow_tpu.api import types as api  # noqa: E402
+from kubeflow_tpu.runtime import objects as ko  # noqa: E402
+from kubeflow_tpu.runtime.fake import FakeCluster, NotFound  # noqa: E402
+from kubeflow_tpu.scheduler.controller import (  # noqa: E402
+    FLEET_KEY,
+    SchedulerReconciler,
+)
+from kubeflow_tpu.scheduler.soak import make_pool  # noqa: E402
+
+NS = "bench"
+# the gang mix: mostly small interactive slices, some pool-sized ones
+_SHAPES = ["2x2x1", "2x2x1", "2x2x2", "2x2x2", "2x2x4", "4x4x4"]
+
+
+class _RecordingMetrics:
+    """Duck-typed SchedulerMetrics that keeps every bind latency sample (the
+    shipped metrics expose sum/count; a benchmark needs the distribution)."""
+
+    def __init__(self) -> None:
+        self.bind_latencies: list[float] = []
+        self.cycles = 0
+        self.preempt_count = 0
+
+        class _Ctr:
+            def __init__(self, outer):
+                self.outer = outer
+
+            def inc(self, *a, **k):
+                self.outer.preempt_count += 1
+
+        self.preemptions = _Ctr(self)
+
+    def observe_cycle(self, fleet, *, queue_depth, unschedulable):
+        self.cycles += 1
+
+    def observe_bind(self, seconds: float) -> None:
+        self.bind_latencies.append(seconds)
+
+
+def _percentile(samples: list[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    xs = sorted(samples)
+    idx = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[idx]
+
+
+def run(gangs: int, pools: int, seed: int) -> dict:
+    rng = random.Random(seed)
+    cluster = FakeCluster()
+    for i in range(pools):
+        make_pool(cluster, "v4", "4x4x4", f"pool-{i}")  # 64 chips each
+    for i in range(gangs):
+        nb = api.notebook(
+            f"g{i}", NS,
+            tpu_accelerator="v4",
+            tpu_topology=_SHAPES[rng.randrange(len(_SHAPES))],
+        )
+        prio = rng.randrange(3)
+        if prio:
+            ko.set_annotation(nb, sched.PRIORITY_ANNOTATION, str(prio))
+        cluster.create(nb)
+
+    metrics = _RecordingMetrics()
+    rec = SchedulerReconciler(metrics=metrics, clock=time.monotonic)
+
+    t0 = time.monotonic()
+    remaining = gangs
+    cycles = 0
+    while remaining > 0:
+        before = len(metrics.bind_latencies)
+        rec.reconcile(cluster, "", FLEET_KEY)
+        cycles += 1
+        bound = [
+            nb for nb in cluster.list("Notebook", NS)
+            if sched.placement_of(nb) is not None
+        ]
+        if len(metrics.bind_latencies) == before and not bound:
+            raise RuntimeError(
+                f"scheduler stalled with {remaining} gangs unbound"
+            )
+        # gang "completes": frees its chips for the queue behind it
+        for nb in bound:
+            try:
+                cluster.delete("Notebook", ko.name(nb), NS)
+            except NotFound:
+                pass
+        remaining -= len(bound)
+    wall = time.monotonic() - t0
+
+    lat = metrics.bind_latencies
+    return {
+        "bench": "SCHED_BENCH",
+        "gangs": gangs,
+        "pools": pools,
+        "fleet_chips": pools * 64,
+        "cycles": cycles,
+        "wall_s": round(wall, 3),
+        "placements_per_s": round(gangs / wall, 1),
+        "time_to_bind_s": {
+            "p50": round(_percentile(lat, 0.50), 4),
+            "p90": round(_percentile(lat, 0.90), 4),
+            "p99": round(_percentile(lat, 0.99), 4),
+            "max": round(max(lat), 4) if lat else 0.0,
+        },
+        "preemptions": metrics.preempt_count,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--gangs", type=int, default=10_000,
+                    help="queued gangs to drain (default 10000)")
+    ap.add_argument("--pools", type=int, default=8,
+                    help="v4-4x4x4 node pools in the fleet (default 8)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    logging.disable(logging.ERROR)
+    result = run(args.gangs, args.pools, args.seed)
+    print("SCHED_BENCH " + json.dumps(result, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

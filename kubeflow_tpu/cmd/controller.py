@@ -20,7 +20,7 @@ from kubeflow_tpu.culler import probe
 from kubeflow_tpu.culler.culler import Culler
 from kubeflow_tpu.runtime.manager import Manager
 from kubeflow_tpu.utils.config import ControllerConfig
-from kubeflow_tpu.utils.metrics import NotebookMetrics
+from kubeflow_tpu.utils.metrics import NotebookMetrics, SchedulerMetrics
 from kubeflow_tpu.webapps.base import App
 
 log = logging.getLogger("controller")
@@ -112,6 +112,15 @@ def build_manager(
     manager.register(NotebookReconciler(cfg, culler=culler, metrics=metrics))
     manager.register(ProfileReconciler())
     manager.register(TensorboardReconciler(cfg))
+    if cfg.scheduler_enabled:
+        # fleet scheduler (kubeflow_tpu/scheduler/): gangs bind through its
+        # placement annotation; shares the metrics registry so one /metrics
+        # endpoint carries queue depth / time-to-bind / utilization too
+        from kubeflow_tpu.scheduler.controller import SchedulerReconciler
+
+        manager.register(
+            SchedulerReconciler(metrics=SchedulerMetrics(metrics.registry))
+        )
     if cfg.enable_oauth_controller:
         # OpenShift companion (ref odh-notebook-controller): the openshift
         # overlay's ENABLE_OAUTH_CONTROLLER env was dead until this wired it

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import logging
 
+from kubeflow_tpu import scheduler as sched
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.culler.culler import Culler, set_stop_annotation, stop_annotation_is_set
 from kubeflow_tpu.runtime import objects as ko
@@ -40,6 +41,10 @@ log = logging.getLogger(__name__)
 PREFIX_ENV = "NB_PREFIX"
 REWRITE_ANNOTATION = "notebooks.kubeflow.org/http-rewrite-uri"
 HEADERS_ANNOTATION = "notebooks.kubeflow.org/http-headers-request-set"
+# Assigned host set for a slice's pods, stamped on the pod template when the
+# fleet scheduler bound the gang (consumed by node-affinity tooling; the fake
+# kubelet ignores it).
+ASSIGNED_NODES_ANNOTATION = "scheduling.kubeflow.org/assigned-nodes"
 
 
 class NotebookReconciler(Reconciler):
@@ -73,8 +78,41 @@ class NotebookReconciler(Reconciler):
 
         topo = api.notebook_topology(nb)
         num_slices = api.notebook_num_slices(nb) if topo is not None else 1
+        placement = (
+            sched.placement_of(nb) if self.config.scheduler_enabled else None
+        )
+        if (
+            placement is not None
+            and topo is not None
+            and not sched.placement_matches(placement, topo, num_slices)
+        ):
+            # spec.tpu edited on a bound gang: acting on the stale placement
+            # would run the new shape on the old reservation (or a partial
+            # gang). Gate until the scheduler unbinds and re-places.
+            placement = None
+        # Grandfathering: before the scheduler has spoken for this notebook
+        # (no placement AND no scheduler condition — e.g. the scheduler was
+        # just enabled on a cluster with running gangs, or is not running),
+        # an already-running gang keeps its pods. Gating it to zero would
+        # kill live sessions for a scheduler that may never bind them.
+        adopted = False
+        if (
+            self.config.scheduler_enabled
+            and topo is not None
+            and placement is None
+            and not any(
+                sched.condition(nb, t) is not None
+                for t in sched.SCHEDULER_CONDITION_TYPES
+            )
+        ):
+            adopted = any(
+                (sts.get("spec") or {}).get("replicas", 0) > 0
+                for sts in self._owned_statefulsets(cluster, nb)
+            )
 
-        desired_stses = self.generate_statefulsets(nb, topo, num_slices)
+        desired_stses = self.generate_statefulsets(
+            nb, topo, num_slices, placement=placement, adopted=adopted
+        )
         for sts in desired_stses:
             helper.reconcile_object(
                 cluster, sts, owner=nb,
@@ -136,13 +174,28 @@ class NotebookReconciler(Reconciler):
         nb: dict,
         topo: tputopo.SliceTopology | None,
         num_slices: int = 1,
+        placement: dict | None = None,
+        adopted: bool = False,
     ) -> list[dict]:
         """One StatefulSet per slice (SURVEY.md §7 stage 3: multislice is N
         identical gangs joined over DCN; slice j's pods are <name>-s<j>-<i>)."""
+        slices = (placement or {}).get("slices") or []
+
+        def slice_placement(j: int) -> dict | None:
+            return slices[j] if j < len(slices) else None
+
         if topo is None or num_slices <= 1:
-            return [self.generate_statefulset(nb, topo)]
+            return [
+                self.generate_statefulset(
+                    nb, topo, placement_slice=slice_placement(0),
+                    adopted=adopted,
+                )
+            ]
         return [
-            self.generate_statefulset(nb, topo, slice_id=j, num_slices=num_slices)
+            self.generate_statefulset(
+                nb, topo, slice_id=j, num_slices=num_slices,
+                placement_slice=slice_placement(j), adopted=adopted,
+            )
             for j in range(num_slices)
         ]
 
@@ -153,6 +206,8 @@ class NotebookReconciler(Reconciler):
         *,
         slice_id: int | None = None,
         num_slices: int = 1,
+        placement_slice: dict | None = None,
+        adopted: bool = False,
     ) -> dict:
         cfg = self.config
         name, ns = ko.name(nb), ko.namespace(nb)
@@ -160,7 +215,15 @@ class NotebookReconciler(Reconciler):
         if stop_annotation_is_set(nb):
             replicas = 0
         elif topo is not None:
-            replicas = topo.num_hosts
+            # Gang gating: under the fleet scheduler a TPU gang holds zero
+            # pods until its placement annotation appears — the all-or-
+            # nothing admission the scheduler's bind is the commit point
+            # for. ``adopted`` exempts a gang that was already running
+            # before the scheduler ever saw it (upgrade path).
+            if cfg.scheduler_enabled and placement_slice is None and not adopted:
+                replicas = 0
+            else:
+                replicas = topo.num_hosts
         else:
             replicas = 1
 
@@ -187,6 +250,22 @@ class NotebookReconciler(Reconciler):
         if topo is not None:
             sel = pod_spec.setdefault("nodeSelector", {})
             sel.update(topo.node_selectors())
+            if placement_slice is not None:
+                # Pin the gang to the pool the scheduler chose. The pool's
+                # torus may be larger than the request, so its nodes carry
+                # the POOL topology label, not the request's — the pool
+                # selector replaces the free topology match.
+                sel.pop("cloud.google.com/gke-tpu-topology", None)
+                if placement_slice.get("poolTopology"):
+                    sel["cloud.google.com/gke-tpu-topology"] = (
+                        placement_slice["poolTopology"]
+                    )
+                # Only select on the nodepool label when the nodes actually
+                # carry it — a fleet-synthesized pool name written into a
+                # nodeSelector would match no node and leave every pod of a
+                # bound gang Pending forever.
+                if placement_slice.get("poolLabeled", True):
+                    sel[sched.POOL_LABEL] = placement_slice.get("pool", "")
             limits = container.setdefault("resources", {}).setdefault("limits", {})
             limits.update(topo.resource_limits())
             # Chips are host-bound: requests must equal limits for device plugins.
@@ -216,7 +295,8 @@ class NotebookReconciler(Reconciler):
                     "metadata": {
                         "labels": pod_labels,
                         "annotations": _tpu_pod_annotations(
-                            nb, topo, slice_id=slice_id, num_slices=num_slices
+                            nb, topo, slice_id=slice_id, num_slices=num_slices,
+                            placement_slice=placement_slice,
                         ),
                     },
                     "spec": pod_spec,
@@ -409,9 +489,23 @@ class NotebookReconciler(Reconciler):
             if num_slices > 1:
                 status["tpu"]["numSlices"] = num_slices
         current = cluster.try_get("Notebook", name, ns)
-        if current is not None and current.get("status") != status:
-            current["status"] = status
-            cluster.update_status(current)
+        if current is not None:
+            if self.config.scheduler_enabled:
+                # the scheduler owns its condition types (Queued/
+                # Unschedulable/Preempted); a full status rewrite must carry
+                # them over in the shared canonical layout or the two
+                # reconcilers would ping-pong each other's writes forever
+                status["conditions"] = sched.merge_conditions(
+                    conditions,
+                    (current.get("status") or {}).get("conditions", []) or [],
+                )
+            # scheduler disabled: no reconciler will ever clear its
+            # conditions, so dropping them here is the cleanup path — a
+            # stale Queued=True would block the culler and corrupt the UI
+            # status forever after an operator turns the scheduler off
+            if current.get("status") != status:
+                current["status"] = status
+                cluster.update_status(current)
         if self.metrics is not None:
             self.metrics.observe_notebooks(cluster)
 
@@ -481,7 +575,8 @@ class NotebookReconciler(Reconciler):
 
 
 def _tpu_pod_annotations(
-    nb: dict, topo, *, slice_id: int | None = None, num_slices: int = 1
+    nb: dict, topo, *, slice_id: int | None = None, num_slices: int = 1,
+    placement_slice: dict | None = None,
 ) -> dict:
     anns = {}
     if topo is not None:
@@ -492,6 +587,12 @@ def _tpu_pod_annotations(
         if num_slices > 1:
             anns["tpu.kubeflow.org/slice-id"] = str(slice_id or 0)
             anns["tpu.kubeflow.org/num-slices"] = str(num_slices)
+        if placement_slice is not None and placement_slice.get("nodes"):
+            import json
+
+            anns[ASSIGNED_NODES_ANNOTATION] = json.dumps(
+                placement_slice["nodes"], sort_keys=True
+            )
     return anns
 
 

@@ -18,6 +18,7 @@ import datetime as _dt
 import time
 from typing import Callable, Mapping
 
+from kubeflow_tpu import scheduler as sched
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.runtime import objects as ko
 
@@ -130,6 +131,14 @@ class Culler:
             return True
         if not self.needs_check(nb):
             return False
+        if sched.condition_is_true(nb, sched.COND_QUEUED):
+            # Queued for capacity: the gang has zero pods, so its kernel API
+            # is unreachable and its idle clock would keep running through
+            # the whole queue wait — then cull it the moment it finally
+            # binds. Waiting in line is not idleness: freeze the clock.
+            ko.set_annotation(nb, api.LAST_ACTIVITY_ANNOTATION, format_time(now))
+            ko.set_annotation(nb, api.LAST_ACTIVITY_CHECK_TS, format_time(now))
+            return True
         kernels = (
             self.fetch_kernels(ko.namespace(nb), ko.name(nb))
             if self.fetch_kernels
@@ -158,6 +167,13 @@ class Culler:
         if not self.enabled:
             return False
         if stop_annotation_is_set(nb):
+            return False
+        if sched.condition_is_true(nb, sched.COND_QUEUED):
+            # A queued gang has zero pods — its "idleness" is the fleet
+            # being full, not the user being gone. Culling it would also
+            # drop its queue seniority (the scheduler clears queued-at for
+            # stopped gangs so capacity accounting stays exact), so a
+            # long queue wait must never cost the user their place in it.
             return False
         la = ko.annotations(nb).get(api.LAST_ACTIVITY_ANNOTATION)
         if not la:

@@ -45,6 +45,11 @@ class Reconciler:
     """Base class. Subclasses set ``kind`` and implement ``reconcile``."""
 
     kind: str = ""
+    # False for reconcilers whose primary kind is a pseudo-kind (no such
+    # object ever exists — e.g. the fleet scheduler's global cycle): the
+    # manager then installs only the secondary watches(). Against the real
+    # apiserver a primary watch on a made-up kind is not even resolvable.
+    watch_primary: bool = True
 
     def reconcile(self, cluster: FakeCluster, namespace: str, name: str) -> Result | None:
         raise NotImplementedError
@@ -128,11 +133,12 @@ class Manager:
         installed: list = []
         try:
             for rec in self._reconcilers:
-                primary = self._primary_handler(rec)
-                self.cluster.watch(rec.kind, primary)
-                installed.append(primary)
-                for obj in self.cluster.list(rec.kind):
-                    primary("ADDED", obj)
+                if rec.watch_primary:
+                    primary = self._primary_handler(rec)
+                    self.cluster.watch(rec.kind, primary)
+                    installed.append(primary)
+                    for obj in self.cluster.list(rec.kind):
+                        primary("ADDED", obj)
                 for kind, map_fn in rec.watches():
                     secondary = self._secondary_handler(rec, map_fn)
                     self.cluster.watch(kind, secondary)

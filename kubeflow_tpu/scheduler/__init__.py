@@ -1,0 +1,150 @@
+"""Fleet scheduler: topology-aware gang queueing for TPU pod slices.
+
+The notebook controller reconciles a Notebook CR into a multi-host pod-slice
+gang but admits every gang unconditionally — ResourceQuota bounds a
+*namespace's* chip budget (``profile_controller._quota_spec``), yet nothing
+models fleet capacity, so gangs either over-commit node pools or fail
+opaquely at the kubelet. This package closes that gap with a scheduler that
+sits between the notebook controller and the cluster:
+
+- ``fleet.py``    — node pools as free/used torus cuboids, fed from Nodes;
+- ``binpack.py``  — topology-aware best-fit placement of a SliceTopology
+  request, minimizing fragmentation;
+- ``queue.py``    — priority gang queue with aging (all-or-nothing
+  admission, FIFO within priority, no starvation);
+- ``preemption.py`` — victim selection (lowest priority, then youngest,
+  then fewest chips) and hole-backfill of small gangs;
+- ``controller.py`` — a reconciler under ``runtime/manager.py`` that binds
+  gangs via annotation + nodeSelector and writes ``Queued`` /
+  ``Unschedulable`` / ``Preempted`` status conditions;
+- ``soak.py``     — the seeded chaos convergence soak
+  (``tools/sched_soak.py``).
+
+This module holds only the wire contract shared with the notebook
+controller, culler, and web apps (annotation keys, condition types, and the
+placement codec), so importing it never drags in scheduler internals.
+"""
+from __future__ import annotations
+
+import json
+from typing import Mapping
+
+# The single atomic commit point of a bind: one annotation write carries the
+# whole gang's placement (every slice), so a gang is either fully placed or
+# not placed at all — crash-restart between any two writes cannot leave a
+# half-bound gang.
+PLACEMENT_ANNOTATION = "scheduling.kubeflow.org/placement"
+# Admission timestamp: queue order (FIFO within priority) and aging both key
+# off it, and persisting it on the CR is what lets a restarted scheduler
+# rebuild the exact queue order.
+QUEUED_AT_ANNOTATION = "scheduling.kubeflow.org/queued-at"
+# User-set gang priority (integer, default 0); larger schedules first.
+PRIORITY_ANNOTATION = "scheduling.kubeflow.org/priority"
+
+# Status condition types the scheduler owns on a Notebook. Everything else
+# in .status.conditions belongs to the notebook controller, which preserves
+# these types when it rewrites status (SCHEDULER_CONDITION_TYPES is the
+# ownership boundary between the two reconcilers).
+COND_QUEUED = "Queued"
+COND_UNSCHEDULABLE = "Unschedulable"
+COND_PREEMPTED = "Preempted"
+SCHEDULER_CONDITION_TYPES = (COND_QUEUED, COND_UNSCHEDULABLE, COND_PREEMPTED)
+
+# Node labels the fleet model is built from. Pool membership comes from the
+# GKE node-pool label; the host index pins a Node to its host-block
+# coordinate inside the pool's torus (fake nodes carry it explicitly; real
+# GKE nodes fall back to the trailing ordinal in the node name).
+POOL_LABEL = "cloud.google.com/gke-nodepool"
+HOST_INDEX_LABEL = "tpu.kubeflow.org/host-index"
+
+
+def placement_of(nb: Mapping) -> dict | None:
+    """Decode the bound placement from a Notebook CR, or None if unbound.
+
+    A malformed annotation (half a write never happens — but a user can
+    kubectl-edit garbage in) reads as unbound: the scheduler then re-places
+    the gang rather than crash-looping on it.
+    """
+    raw = (nb.get("metadata", {}).get("annotations") or {}).get(
+        PLACEMENT_ANNOTATION
+    )
+    if not raw:
+        return None
+    try:
+        placement = json.loads(raw)
+    except ValueError:
+        return None
+    slices = placement.get("slices")
+    if not isinstance(slices, list) or not slices:
+        return None
+    for s in slices:
+        if not isinstance(s, dict) or not s.get("pool") or not s.get("shape"):
+            return None
+    return placement
+
+
+def encode_placement(slices: list[dict], bound_at: float) -> str:
+    """Serialize a gang placement for the annotation (sorted keys: the soak
+    fingerprints annotations, so the encoding must be canonical)."""
+    return json.dumps(
+        {"boundAt": bound_at, "slices": slices}, sort_keys=True
+    )
+
+
+def gang_priority(nb: Mapping) -> int:
+    raw = (nb.get("metadata", {}).get("annotations") or {}).get(
+        PRIORITY_ANNOTATION
+    )
+    try:
+        return int(raw) if raw is not None else 0
+    except ValueError:
+        return 0
+
+
+def merge_conditions(others: list, scheduler_conds: list) -> list:
+    """The canonical ``.status.conditions`` layout BOTH reconcilers write:
+    non-scheduler conditions first (caller order), scheduler-owned types
+    appended sorted by type. The notebook controller passes (its own fresh
+    conditions, the live list) to carry scheduler types over; the scheduler
+    passes (the live list, its own conditions) to own exactly its types.
+    One implementation — if the two writers ever disagreed on the layout
+    they would rewrite each other's status every cycle and never settle."""
+    return [
+        c for c in others if c.get("type") not in SCHEDULER_CONDITION_TYPES
+    ] + sorted(
+        (
+            c for c in scheduler_conds
+            if c.get("type") in SCHEDULER_CONDITION_TYPES
+        ),
+        key=lambda c: c.get("type", ""),
+    )
+
+
+def placement_matches(placement: Mapping, topo, num_slices: int) -> bool:
+    """Does a committed placement still describe the CR's current request?
+    Slice count must match and every slice must be the requested topology
+    (up to the axis rotation placement is allowed to apply). Checked by the
+    scheduler before replaying occupancy AND by the notebook controller
+    before acting on a placement — a spec edit on a bound gang must gate
+    the gang, not run the new shape on the old reservation."""
+    slices = placement.get("slices") or []
+    if len(slices) != num_slices:
+        return False
+    want = sorted(topo.shape)
+    return all(
+        s.get("accelerator") == topo.accelerator.name
+        and sorted(s.get("shape") or []) == want
+        for s in slices
+    )
+
+
+def condition(nb: Mapping, type_: str) -> dict | None:
+    for c in (nb.get("status") or {}).get("conditions", []) or []:
+        if c.get("type") == type_:
+            return c
+    return None
+
+
+def condition_is_true(nb: Mapping, type_: str) -> bool:
+    c = condition(nb, type_)
+    return bool(c) and c.get("status") == "True"

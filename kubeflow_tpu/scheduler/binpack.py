@@ -1,0 +1,181 @@
+"""Topology-aware best-fit placement of torus cuboids.
+
+All geometry runs in *host-block units*: scheduling granularity is a whole
+host (one pod per host, ``topology.py``), so a pool of chip shape ``4x4x4``
+on v4 (host block ``2x2x1``) is a ``2x2x4`` grid of host cells. Pools are
+small (a v4-4096 pool is 8x8x16 = 1024 cells), so exact algorithms beat
+clever ones: the free set is recomputed canonically from the used set — a
+freed gang's cuboid coalesces back automatically because the decomposition
+is a pure function of what remains used (the round-trip property the bin
+packing suite asserts), not an incremental merge that can drift.
+
+Placement is best-fit: among every (free cuboid, request orientation) pair
+that fits, pick the free cuboid with the least leftover volume — the
+smallest hole that accommodates the gang, which is what minimizes
+fragmentation for the gangs behind it. Greedy decomposition can split an
+L-shaped free region across cuboid boundaries, so a miss falls back to an
+exhaustive offset scan: ``fits`` is exact — a placement exists iff the
+scheduler finds one — which is what lets the soak assert "every feasible
+gang eventually binds" against the scheduler's own feasibility notion.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Iterable, Iterator, Sequence
+
+from kubeflow_tpu.tpu.topology import TpuAccelerator
+
+
+@dataclasses.dataclass(frozen=True)
+class Cuboid:
+    """An axis-aligned box inside a pool grid (host-block units)."""
+
+    offset: tuple[int, ...]
+    shape: tuple[int, ...]
+
+    @property
+    def volume(self) -> int:
+        return math.prod(self.shape)
+
+    @property
+    def end(self) -> tuple[int, ...]:
+        return tuple(o + s for o, s in zip(self.offset, self.shape))
+
+    def overlaps(self, other: "Cuboid") -> bool:
+        return all(
+            o1 < e2 and o2 < e1
+            for o1, e1, o2, e2 in zip(
+                self.offset, self.end, other.offset, other.end
+            )
+        )
+
+    def within(self, grid: Sequence[int]) -> bool:
+        return all(o >= 0 for o in self.offset) and all(
+            e <= g for e, g in zip(self.end, grid)
+        )
+
+    def cells(self) -> Iterator[tuple[int, ...]]:
+        return itertools.product(
+            *(range(o, o + s) for o, s in zip(self.offset, self.shape))
+        )
+
+
+def ceil_div_shape(
+    chip_shape: Sequence[int], host_block: Sequence[int]
+) -> tuple[int, ...]:
+    """Chip-shape → host-block shape. Sub-host offerings (v5e 1x1/2x2) round
+    up to one whole block: the host is theirs alone either way."""
+    return tuple(-(-d // b) for d, b in zip(chip_shape, host_block))
+
+
+def orientations(
+    accel: TpuAccelerator, chip_shape: Sequence[int]
+) -> list[tuple[tuple[int, ...], tuple[int, ...]]]:
+    """Valid axis permutations of a request, as (chip_shape, block_shape).
+
+    A slice request can be rotated onto the pool torus — the sub-cuboid is
+    the same mesh up to axis relabeling — but only rotations that still map
+    onto whole hosts are usable (same admission rule as ``parse_topology``).
+    """
+    out: list[tuple[tuple[int, ...], tuple[int, ...]]] = []
+    seen: set[tuple[int, ...]] = set()
+    for perm in itertools.permutations(tuple(chip_shape)):
+        if perm in seen:
+            continue
+        seen.add(perm)
+        tiles = all(d % b == 0 for d, b in zip(perm, accel.host_block))
+        if tiles or perm in accel.supports_single_host_sub_blocks:
+            out.append((perm, ceil_div_shape(perm, accel.host_block)))
+    return out
+
+
+def decompose_free(
+    grid: Sequence[int], used: Iterable[Cuboid]
+) -> list[Cuboid]:
+    """Canonical decomposition of the free space into disjoint cuboids.
+
+    Deterministic greedy sweep: take the lexicographically smallest free
+    cell, grow the box axis-by-axis (last axis first, so runs follow the
+    host-ordinal direction) as far as every covered cell stays free, emit,
+    repeat. Pure function of the used set — freeing a gang and re-running
+    yields exactly the pre-placement free set (the coalescing contract).
+    """
+    free: set[tuple[int, ...]] = set(
+        itertools.product(*(range(g) for g in grid))
+    )
+    for c in used:
+        free.difference_update(c.cells())
+    out: list[Cuboid] = []
+    while free:
+        origin = min(free)
+        shape = [1] * len(grid)
+        # grow along each axis, last axis first (innermost runs)
+        for axis in range(len(grid) - 1, -1, -1):
+            while origin[axis] + shape[axis] < grid[axis]:
+                grown = list(shape)
+                grown[axis] += 1
+                candidate = Cuboid(origin, tuple(grown))
+                if all(cell in free for cell in candidate.cells()):
+                    shape = grown
+                else:
+                    break
+        box = Cuboid(origin, tuple(shape))
+        free.difference_update(box.cells())
+        out.append(box)
+    return out
+
+
+def _scan_fit(
+    grid: Sequence[int],
+    free_cells: set[tuple[int, ...]],
+    block_shape: tuple[int, ...],
+) -> tuple[int, ...] | None:
+    """Exhaustive completeness fallback: first offset (lexicographic) where
+    the whole request region is free. Greedy decomposition can split a
+    placeable region across free-cuboid boundaries; this cannot."""
+    for offset in itertools.product(
+        *(range(g - s + 1) for g, s in zip(grid, block_shape))
+    ):
+        if all(c in free_cells for c in Cuboid(offset, block_shape).cells()):
+            return offset
+    return None
+
+
+def best_fit(
+    grid: Sequence[int],
+    used: Iterable[Cuboid],
+    accel: TpuAccelerator,
+    chip_shape: Sequence[int],
+) -> tuple[Cuboid, tuple[int, ...]] | None:
+    """Place one slice request into one pool grid.
+
+    Returns ``(block_cuboid, oriented_chip_shape)`` or None. Score order:
+    least leftover volume in the hosting free cuboid (best-fit), then
+    lexicographic offset, then orientation order — fully deterministic, so
+    a restarted scheduler re-derives identical decisions from identical
+    state.
+    """
+    frees = decompose_free(grid, used)
+    options = orientations(accel, chip_shape)
+    best: tuple[tuple[int, int, tuple[int, ...]], Cuboid, tuple[int, ...]] | None = None
+    for i, (chips, blocks) in enumerate(options):
+        for f in frees:
+            if all(b <= fs for b, fs in zip(blocks, f.shape)):
+                score = (f.volume - math.prod(blocks), i, f.offset)
+                if best is None or score < best[0]:
+                    best = (score, Cuboid(f.offset, blocks), chips)
+    if best is not None:
+        return best[1], best[2]
+    # fall back to the exact scan (free region exists but was split)
+    free_cells: set[tuple[int, ...]] = set(
+        itertools.product(*(range(g) for g in grid))
+    )
+    for c in used:
+        free_cells.difference_update(c.cells())
+    for chips, blocks in options:
+        offset = _scan_fit(grid, free_cells, blocks)
+        if offset is not None:
+            return Cuboid(offset, blocks), chips
+    return None

@@ -1,0 +1,478 @@
+"""Fleet scheduler reconciler.
+
+Runs as one more reconciler under ``runtime/manager.py``, between the
+notebook controller and the cluster: a Notebook CR with ``spec.tpu`` is not
+a gang until this controller binds it. The bind is a single annotation
+write (``scheduling.kubeflow.org/placement``) carrying every slice's pool,
+cuboid, and node set — the atomic commit point. The notebook controller
+keeps its StatefulSets at 0 replicas until the annotation appears, then
+pins the gang to its pool (gang gating,
+``notebook_controller.generate_statefulset``).
+
+Level-triggered and stateless across restarts: every scheduling cycle
+rebuilds the fleet from Nodes and the occupancy + queue from Notebook
+annotations, replays committed placements, then runs admission in priority
+order with aging, preemption for blocked heads, and hole-backfill. A crash
+between any two writes (armed by the chaos layer) loses nothing: the next
+incarnation replays the committed annotations before computing new
+placements, so two gangs can never hold overlapping cuboids.
+
+Every Notebook or Node event maps to ONE workqueue key (``@fleet``) — the
+cycle is global (placement decisions are fleet-wide), so per-object keys
+would run N full cycles for N events; the deduplicating workqueue collapses
+them into exactly one (SNIPPETS.md batch-scheduler idiom).
+
+Status surface: ``Queued`` (with queue position), ``Unschedulable`` (no
+pool could ever hold the topology), ``Preempted`` (victim of a higher
+priority gang or a node drain) — preserved by the notebook controller's
+status rewrites and translated by ``webapps/jupyter.py`` for the spawner.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Iterable
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import Conflict, FakeCluster, NotFound
+from kubeflow_tpu.runtime.manager import Reconciler, Result
+from kubeflow_tpu.scheduler import (
+    COND_PREEMPTED,
+    COND_QUEUED,
+    COND_UNSCHEDULABLE,
+    PLACEMENT_ANNOTATION,
+    QUEUED_AT_ANNOTATION,
+    condition,
+    encode_placement,
+    gang_priority,
+    merge_conditions,
+    placement_matches,
+    placement_of,
+)
+from kubeflow_tpu.scheduler import preemption as preempt
+from kubeflow_tpu.scheduler.fleet import Fleet
+from kubeflow_tpu.scheduler.preemption import BoundGang
+from kubeflow_tpu.scheduler.queue import (
+    DEFAULT_AGING_INTERVAL_S,
+    GangQueue,
+    GangRequest,
+)
+
+log = logging.getLogger(__name__)
+
+FLEET_KEY = "@fleet"  # the single coalesced reconcile key
+
+# Beyond this queue depth, Queued messages stop carrying exact positions:
+# every bind shifts every position behind it, and rewriting 10k conditions
+# per cycle is write-amplification with no reader (the spawner shows tens).
+POSITION_MESSAGE_DEPTH = 1000
+
+
+class SchedulerReconciler(Reconciler):
+    """Capacity-aware gang scheduler for TPU notebooks."""
+
+    # Pseudo-kind: no object of this kind ever exists (and no API server
+    # could resolve it), so the primary watch is disabled outright; all real
+    # events arrive via watches() mapped to FLEET_KEY.
+    kind = "SchedulerCycle"
+    watch_primary = False
+
+    def __init__(
+        self,
+        *,
+        metrics=None,
+        clock: Callable[[], float] = time.time,
+        aging_interval_s: float = DEFAULT_AGING_INTERVAL_S,
+        backfill_window: int = preempt.DEFAULT_BACKFILL_WINDOW,
+        resync_s: float = 30.0,
+    ) -> None:
+        self.metrics = metrics
+        self.clock = clock
+        self.aging_interval_s = aging_interval_s
+        self.backfill_window = backfill_window
+        self.resync_s = resync_s
+        # The workqueue already serializes the single key; the lock is a
+        # belt-and-braces guard for direct _cycle() callers (bench, tests).
+        self._cycle_lock = threading.Lock()
+
+    def watches(self):
+        return [("Notebook", _map_to_fleet), ("Node", _map_to_fleet)]
+
+    def reconcile(self, cluster: FakeCluster, namespace: str, name: str) -> Result | None:
+        with self._cycle_lock:
+            queue_depth = self._cycle(cluster)
+        if queue_depth:
+            # aging changes effective priorities over time with no event to
+            # announce it; periodic resync keeps a waiting queue honest
+            return Result(requeue_after=min(self.resync_s, self.aging_interval_s))
+        return None
+
+    # ----------------------------------------------------------- the cycle
+
+    def _cycle(self, cluster: FakeCluster) -> int:
+        """One full scheduling pass. Returns the resulting queue depth."""
+        now = self.clock()
+        fleet = Fleet.from_nodes(cluster.list("Node"))
+        notebooks: list[tuple[dict, object, int]] = []
+        for nb in cluster.list("Notebook"):
+            try:
+                topo = api.notebook_topology(nb)
+                num_slices = api.notebook_num_slices(nb)
+            except ValueError:
+                continue  # malformed spec.tpu: admission's problem, not ours
+            if topo is None:
+                continue  # CPU notebook: no chips wanted
+            notebooks.append((nb, topo, num_slices))
+
+        queue = GangQueue(aging_interval_s=self.aging_interval_s)
+        bound: dict[str, BoundGang] = {}
+        preempted_now: dict[str, str] = {}  # key -> human reason
+
+        # -- replay committed placements (deterministic order: bind time
+        #    then key, so an overlap after a drain always evicts the same
+        #    gang regardless of list order) --------------------------------
+        with_placement = [
+            (nb, topo, num_slices, placement_of(nb))
+            for nb, topo, num_slices in notebooks
+        ]
+        with_placement.sort(
+            key=lambda t: ((t[3] or {}).get("boundAt", 0.0), _nb_key(t[0]))
+        )
+        for nb, topo, num_slices, placement in with_placement:
+            if placement is None:
+                continue
+            key = _nb_key(nb)
+            if not _wants_capacity(nb):
+                # stopped/culled while bound: release the chips and clear
+                # every scheduler mark — a restart re-queues from scratch
+                self._unbind(cluster, nb, drop_queued_at=True)
+                continue
+            if not placement_matches(placement, topo, num_slices):
+                # spec.tpu edited while bound: the committed placement no
+                # longer describes what the gang wants — release it and let
+                # the new shape queue from scratch (keeping it would run
+                # the gang at the stale shape forever)
+                self._unbind(cluster, nb)
+                continue
+            if fleet.occupy_gang(key, placement["slices"]):
+                bound[key] = BoundGang(
+                    key=key,
+                    priority=gang_priority(nb),
+                    queued_at=_queued_at(nb, now),
+                    chips=topo.num_chips * num_slices,
+                    topo=topo,
+                    num_slices=num_slices,
+                )
+            else:
+                # node drain / capacity flap invalidated the placement
+                self._unbind(cluster, nb)
+                preempted_now[key] = "placement lost to node drain"
+
+        # -- queue admission ----------------------------------------------
+        unschedulable: dict[str, str] = {}
+        feasible_cache: dict[tuple, bool] = {}
+        for nb, topo, num_slices in notebooks:
+            key = _nb_key(nb)
+            if key in bound:
+                continue
+            if not _wants_capacity(nb):
+                # stopped while still queued: the queue entry must go with
+                # it — a ghost queued-at would hold a phantom capacity claim
+                # and resurrect stale seniority on restart. A raced delete
+                # or conflicting write must not abort the whole fleet cycle
+                # for a gang that holds no geometry claim; the clear is
+                # retried next cycle.
+                if QUEUED_AT_ANNOTATION in ko.annotations(nb):
+                    try:
+                        self._patch_annotations(
+                            cluster, nb, {QUEUED_AT_ANNOTATION: None}
+                        )
+                    except (NotFound, Conflict):
+                        pass
+                continue
+            shape_key = (topo.accelerator.name, topo.shape, num_slices)
+            feasible = feasible_cache.get(shape_key)
+            if feasible is None:
+                feasible = fleet.feasible_on_empty(topo, num_slices)
+                feasible_cache[shape_key] = feasible
+            if not feasible:
+                unschedulable[key] = (
+                    f"no node pool can hold {topo.slice_name}"
+                    + (f" x{num_slices}" if num_slices > 1 else "")
+                )
+                continue
+            queued_at = _queued_at(nb, None)
+            if queued_at is None:
+                queued_at = now
+                try:
+                    self._patch_annotations(
+                        cluster, nb, {QUEUED_AT_ANNOTATION: repr(queued_at)}
+                    )
+                except (NotFound, Conflict):
+                    continue  # deleted/raced: next cycle re-admits
+            queue.push(GangRequest(
+                key=key,
+                priority=gang_priority(nb),
+                queued_at=queued_at,
+                topo=topo,
+                num_slices=num_slices,
+            ))
+
+        # -- scheduling pass ----------------------------------------------
+        newly_bound = self._schedule(
+            cluster, fleet, queue, bound, preempted_now, now
+        )
+
+        # -- status conditions + metrics ----------------------------------
+        order = queue.ordered(now)
+        positions = {r.key: i + 1 for i, r in enumerate(order)}
+        for nb, topo, num_slices in notebooks:
+            key = _nb_key(nb)
+            if not _wants_capacity(nb):
+                self._write_conditions(cluster, nb, [])
+            elif key in bound or key in newly_bound:
+                self._write_conditions(cluster, nb, [{
+                    "type": COND_QUEUED, "status": "False",
+                    "reason": "Bound", "message": "",
+                }])
+            elif key in unschedulable:
+                self._write_conditions(cluster, nb, [{
+                    "type": COND_UNSCHEDULABLE, "status": "True",
+                    "reason": "NoFittingPool",
+                    "message": unschedulable[key],
+                }])
+            elif key in positions:
+                if len(order) <= POSITION_MESSAGE_DEPTH:
+                    msg = f"position {positions[key]} of {len(order)}"
+                else:
+                    # depth changes every cycle; putting it in the message
+                    # would rewrite every queued notebook's status per cycle
+                    msg = "waiting for TPU capacity"
+                conds = [{
+                    "type": COND_QUEUED, "status": "True",
+                    "reason": "WaitingForCapacity", "message": msg,
+                }]
+                reason = preempted_now.get(key)
+                if reason is not None:
+                    conds.append({
+                        "type": COND_PREEMPTED, "status": "True",
+                        "reason": "Preempted", "message": reason,
+                    })
+                else:
+                    # a victim stays marked Preempted until it binds again
+                    existing = condition(nb, COND_PREEMPTED)
+                    if existing is not None and existing.get("status") == "True":
+                        conds.append(existing)
+                self._write_conditions(cluster, nb, conds)
+
+        if self.metrics is not None:
+            self.metrics.observe_cycle(
+                fleet,
+                queue_depth=len(order),
+                unschedulable=len(unschedulable),
+            )
+        return len(order)
+
+    def _schedule(
+        self,
+        cluster: FakeCluster,
+        fleet: Fleet,
+        queue: GangQueue,
+        bound: dict[str, BoundGang],
+        preempted_now: dict[str, str],
+        now: float,
+    ) -> set[str]:
+        """Admission in effective-priority order; preemption for a blocked
+        head, then hole-backfill of strictly smaller gangs behind it. Heads
+        are PER ACCELERATOR: a blocked v4 head says nothing about v5e
+        capacity, so gangs of other generations keep scheduling as their own
+        heads (a global head would starve them on idle pools forever). One
+        sort per cycle — the order is fixed at cycle start (an evicted victim
+        re-enters *behind* the position it was evicted for, never ahead of
+        the head that evicted it). Every bind commits through the cluster
+        before the next decision, so the fleet model and the annotation set
+        move in lockstep."""
+        newly_bound: set[str] = set()
+        order = queue.ordered(now)
+        blocked: dict[str, GangRequest] = {}  # accel -> its blocked head
+        behind: dict[str, int] = {}  # same-accel entries seen past the head
+        i = 0
+        while i < len(order):
+            req = order[i]
+            i += 1
+            accel = req.topo.accelerator.name
+            head = blocked.get(accel)
+            if head is not None:
+                # behind this accelerator's blocked head: backfill only —
+                # strictly smaller than the head, within the window (same
+                # predicate as preempt.backfill_candidates, which the soak's
+                # fixed-point audit re-derives)
+                behind[accel] += 1
+                if behind[accel] > self.backfill_window:
+                    continue
+                if req.chips >= head.chips:
+                    continue
+                slices = fleet.place_gang(req.key, req.topo, req.num_slices)
+                if slices is not None:
+                    self._commit_bind(cluster, req, slices, now)
+                    queue.discard(req.key)
+                    newly_bound.add(req.key)
+                continue
+            slices = fleet.place_gang(req.key, req.topo, req.num_slices)
+            if slices is not None:
+                self._commit_bind(cluster, req, slices, now)
+                queue.discard(req.key)
+                newly_bound.add(req.key)
+                continue
+            # victims: only gangs bound by a PREVIOUS cycle — same-cycle
+            # binds were just scheduled by current policy; evicting them
+            # now would churn annotations for a decision the next cycle
+            # reaches anyway
+            victims = preempt.select_victims(fleet, list(bound.values()), req)
+            if victims is not None:
+                for v in victims:
+                    self._evict(cluster, v, req, preempted_now)
+                    fleet.free_gang(v.key)
+                    bound.pop(v.key, None)
+                    # the victim re-queues with its real request and its
+                    # original seniority; this cycle reconsiders it after
+                    # everything already ahead of the current head
+                    queue.push(v.as_request())
+                    order.append(v.as_request())
+                    if self.metrics is not None:
+                        self.metrics.preemptions.inc()
+                slices = fleet.place_gang(req.key, req.topo, req.num_slices)
+                if slices is not None:  # guaranteed by the trial
+                    self._commit_bind(cluster, req, slices, now)
+                    queue.discard(req.key)
+                    newly_bound.add(req.key)
+                continue
+            # blocked and nothing junior frees enough: this gang becomes its
+            # accelerator's head; everything behind it (same accel) is
+            # backfill-only until capacity changes
+            blocked[accel] = req
+            behind[accel] = 0
+        return newly_bound
+
+    # ------------------------------------------------------------- commits
+
+    def _commit_bind(
+        self,
+        cluster: FakeCluster,
+        req: GangRequest,
+        slices: list[dict],
+        now: float,
+    ) -> None:
+        ns, name = req.key.split("/", 1)
+        try:
+            cluster.patch(
+                "Notebook", name, ns,
+                {"metadata": {"annotations": {
+                    PLACEMENT_ANNOTATION: encode_placement(slices, now),
+                }}},
+            )
+        except NotFound:
+            return  # deleted under us; the fleet model re-derives next cycle
+        if self.metrics is not None:
+            self.metrics.observe_bind(max(0.0, now - req.queued_at))
+
+    def _evict(
+        self,
+        cluster: FakeCluster,
+        victim: BoundGang,
+        head: GangRequest,
+        preempted_now: dict[str, str],
+    ) -> None:
+        ns, name = victim.key.split("/", 1)
+        nb = cluster.try_get("Notebook", name, ns)
+        if nb is not None:
+            self._unbind(cluster, nb)
+        preempted_now[victim.key] = f"preempted by {head.key}"
+
+    def _unbind(
+        self,
+        cluster: FakeCluster,
+        nb_obj: dict,
+        *,
+        drop_queued_at: bool = False,
+    ) -> None:
+        """Remove a gang's placement claim. Only NotFound is swallowed (the
+        object is gone, its annotation with it). Every other failure MUST
+        abort the cycle: the store still carries the claim, and binding
+        other gangs into space the failed unbind was supposed to free is
+        exactly how two gangs end up holding the same chips (the sched soak
+        caught this as a real double-booking under injected Conflicts)."""
+        anns: dict = {PLACEMENT_ANNOTATION: None}
+        if drop_queued_at:
+            anns[QUEUED_AT_ANNOTATION] = None
+        try:
+            self._patch_annotations(cluster, nb_obj, anns)
+        except NotFound:
+            pass
+
+    def _patch_annotations(
+        self, cluster: FakeCluster, nb: dict, anns: dict
+    ) -> None:
+        cluster.patch(
+            "Notebook", ko.name(nb), ko.namespace(nb),
+            {"metadata": {"annotations": anns}},
+        )
+        # keep the in-memory copy coherent for the rest of the cycle
+        for k, v in anns.items():
+            if v is None:
+                ko.remove_annotation(nb, k)
+            else:
+                ko.set_annotation(nb, k, v)
+
+    def _write_conditions(
+        self, cluster: FakeCluster, nb: dict, conds: list[dict]
+    ) -> None:
+        """Own exactly the scheduler condition types: strip ours, append the
+        given ones in the shared canonical layout (``merge_conditions`` —
+        the notebook controller writes the same layout, or the two would
+        rewrite each other's status forever), write only on change
+        (idempotent cycles must produce zero writes, or the manager would
+        never settle). The no-op check runs against the cycle's own listed
+        copy — re-reading every notebook every cycle would be a get per
+        object per cycle."""
+        current = (nb.get("status") or {}).get("conditions", []) or []
+        new = merge_conditions(current, conds)
+        if new == current:
+            return
+        fresh = cluster.try_get("Notebook", ko.name(nb), ko.namespace(nb))
+        if fresh is None:
+            return
+        status = fresh.setdefault("status", {})
+        live = status.get("conditions", []) or []
+        new = merge_conditions(live, conds)
+        if new != live:
+            status["conditions"] = new
+            cluster.update_status(fresh)
+        # mirror into the local copy so the same cycle sees its own writes
+        nb.setdefault("status", {})["conditions"] = new
+
+
+def _nb_key(nb: dict) -> str:
+    return f"{ko.namespace(nb)}/{ko.name(nb)}"
+
+
+def _wants_capacity(nb: dict) -> bool:
+    return api.STOP_ANNOTATION not in ko.annotations(nb)
+
+
+
+
+def _queued_at(nb: dict, default: float | None) -> float | None:
+    raw = ko.annotations(nb).get(QUEUED_AT_ANNOTATION)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def _map_to_fleet(obj: dict) -> Iterable[tuple[str, str]]:
+    yield ("", FLEET_KEY)

@@ -1,0 +1,359 @@
+"""Fleet capacity model: node pools as free/used torus cuboids.
+
+A ``Pool`` is one TPU slice node pool — a torus of chips whose shape comes
+from the nodes' ``gke-tpu-topology`` label — tracked at host-block
+granularity. Its state is the *used* cuboid set (bound gangs plus blocked
+cells for unavailable hosts); the free set is always derived from it
+(``binpack.decompose_free``), so freeing a gang coalesces by construction.
+
+``Fleet`` aggregates pools from live Node objects and carries the gang
+operations the scheduler controller uses: all-or-nothing trial placement of
+a multi-slice gang, occupancy replay from committed placement annotations,
+and the accounting the metrics layer scrapes. The fleet is rebuilt from the
+cluster every scheduling cycle — the annotation set IS the store of record,
+which is what makes crash-restart between bind writes safe: a restarted
+scheduler replays committed placements before computing new ones.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Iterable, Mapping, Sequence
+
+from kubeflow_tpu.scheduler import HOST_INDEX_LABEL, POOL_LABEL
+from kubeflow_tpu.scheduler import binpack
+from kubeflow_tpu.scheduler.binpack import Cuboid, ceil_div_shape
+from kubeflow_tpu.tpu.topology import (
+    ACCELERATORS,
+    SliceTopology,
+    TpuAccelerator,
+    parse_topology,
+)
+
+_TRAILING_ORDINAL = re.compile(r"-(\d+)$")
+_BLOCKED_PREFIX = "!node/"  # used-set keys for unavailable host cells
+
+
+def node_is_available(node: Mapping) -> bool:
+    """Schedulable = Ready and not cordoned (``spec.unschedulable``)."""
+    if (node.get("spec") or {}).get("unschedulable"):
+        return False
+    for cond in (node.get("status") or {}).get("conditions", []) or []:
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False
+
+
+def _host_index(node: Mapping) -> int | None:
+    labels = node.get("metadata", {}).get("labels", {}) or {}
+    idx = labels.get(HOST_INDEX_LABEL)
+    if idx is not None:
+        try:
+            return int(idx)
+        except ValueError:
+            return None
+    m = _TRAILING_ORDINAL.search(node.get("metadata", {}).get("name", ""))
+    return int(m.group(1)) if m else None
+
+
+class Pool:
+    """One node pool's torus, occupied by gang cuboids (host-block units)."""
+
+    def __init__(
+        self,
+        name: str,
+        accel: TpuAccelerator,
+        chip_shape: Sequence[int],
+        *,
+        labeled: bool = True,
+    ) -> None:
+        self.name = name
+        self.accel = accel
+        # False when the name was synthesized (nodes carry no nodepool
+        # label): the bind then must not be pinned via that label — no node
+        # would match and the gang's pods would stay Pending forever.
+        self.labeled = labeled
+        self.chip_shape = tuple(chip_shape)
+        self.grid = ceil_div_shape(self.chip_shape, accel.host_block)
+        self.num_hosts = math.prod(self.grid)
+        # host ordinal -> node name, C-order over the block grid (matches
+        # add_tpu_node_pool's per-host fan-out and GKE's worker numbering)
+        self.nodes: dict[int, str] = {}
+        self.used: dict[str, Cuboid] = {}
+
+    # ------------------------------------------------------------- geometry
+
+    def _coord(self, host_index: int) -> tuple[int, ...]:
+        coord = []
+        rem = host_index
+        for dim in reversed(self.grid):
+            coord.append(rem % dim)
+            rem //= dim
+        return tuple(reversed(coord))
+
+    def _ordinal(self, coord: Sequence[int]) -> int:
+        out = 0
+        for c, dim in zip(coord, self.grid):
+            out = out * dim + c
+        return out
+
+    def add_host(self, index: int, node_name: str, available: bool) -> None:
+        if index < 0 or index >= self.num_hosts:
+            return
+        self.nodes[index] = node_name
+        if not available:
+            self.block_host(index)
+
+    def block_host(self, index: int) -> None:
+        """Mark one host cell unusable (drained / cordoned / NotReady)."""
+        self.used[f"{_BLOCKED_PREFIX}{index}"] = Cuboid(
+            self._coord(index), (1,) * len(self.grid)
+        )
+
+    def missing_hosts(self) -> None:
+        """Block every host cell with no backing Node (capacity flap: the
+        node object is gone, its chips with it)."""
+        for i in range(self.num_hosts):
+            if i not in self.nodes:
+                self.block_host(i)
+
+    def nodes_for(self, block_cuboid: Cuboid) -> list[str]:
+        return sorted(
+            self.nodes.get(self._ordinal(c), f"<missing-{self._ordinal(c)}>")
+            for c in block_cuboid.cells()
+        )
+
+    # ------------------------------------------------------------ occupancy
+
+    def place(
+        self, topo: SliceTopology
+    ) -> tuple[Cuboid, tuple[int, ...]] | None:
+        return binpack.best_fit(
+            self.grid, self.used.values(), self.accel, topo.shape
+        )
+
+    def occupy(self, key: str, block_cuboid: Cuboid) -> bool:
+        """Commit (or replay) an allocation; False if invalid/conflicting."""
+        if not block_cuboid.within(self.grid):
+            return False
+        if any(block_cuboid.overlaps(c) for c in self.used.values()):
+            return False
+        self.used[key] = block_cuboid
+        return True
+
+    def free(self, key: str) -> None:
+        self.used.pop(key, None)
+
+    def gang_keys(self) -> list[str]:
+        return [k for k in self.used if not k.startswith(_BLOCKED_PREFIX)]
+
+    # ----------------------------------------------------------- accounting
+
+    @property
+    def total_chips(self) -> int:
+        return math.prod(self.chip_shape)
+
+    @property
+    def chips_per_block(self) -> int:
+        return self.accel.chips_per_host
+
+    def used_chips(self) -> int:
+        return sum(
+            c.volume * self.chips_per_block for c in self.used.values()
+        )
+
+    def free_chips(self) -> int:
+        return self.total_chips - self.used_chips()
+
+    def clone(self) -> "Pool":
+        out = Pool(self.name, self.accel, self.chip_shape, labeled=self.labeled)
+        out.nodes = dict(self.nodes)
+        out.used = dict(self.used)  # Cuboids are frozen; shallow is enough
+        return out
+
+
+class Fleet:
+    """Every pool, plus gang-level (all-or-nothing) operations."""
+
+    def __init__(self, pools: Mapping[str, Pool] | None = None) -> None:
+        self.pools: dict[str, Pool] = dict(pools or {})
+
+    @classmethod
+    def from_nodes(cls, nodes: Iterable[Mapping]) -> "Fleet":
+        """Build the capacity model from live Node objects. Nodes without
+        the TPU topology labels are not TPU hosts and are ignored; a pool's
+        torus shape must be consistent across its nodes (first node wins —
+        a mislabeled straggler cannot corrupt the whole pool)."""
+        fleet = cls()
+        for node in nodes:
+            labels = node.get("metadata", {}).get("labels", {}) or {}
+            gke_accel = labels.get("cloud.google.com/gke-tpu-accelerator")
+            topology = labels.get("cloud.google.com/gke-tpu-topology")
+            if not gke_accel or not topology:
+                continue
+            accel = next(
+                (a for a in ACCELERATORS.values()
+                 if a.gke_accelerator == gke_accel),
+                None,
+            )
+            if accel is None:
+                continue
+            labeled = POOL_LABEL in labels
+            pool_name = labels.get(POOL_LABEL) or f"{accel.name}-{topology}"
+            pool = fleet.pools.get(pool_name)
+            if pool is None:
+                try:
+                    topo = parse_topology(accel.name, topology)
+                except ValueError:
+                    continue
+                pool = Pool(pool_name, accel, topo.shape, labeled=labeled)
+                fleet.pools[pool_name] = pool
+            idx = _host_index(node)
+            if idx is None:
+                continue
+            pool.add_host(
+                idx, node.get("metadata", {}).get("name", ""),
+                node_is_available(node),
+            )
+        for pool in fleet.pools.values():
+            pool.missing_hosts()
+        return fleet
+
+    def clone(self) -> "Fleet":
+        return Fleet({n: p.clone() for n, p in self.pools.items()})
+
+    # ------------------------------------------------------ gang operations
+
+    def place_gang(
+        self, key: str, topo: SliceTopology, num_slices: int = 1
+    ) -> list[dict] | None:
+        """All-or-nothing placement of every slice of a gang.
+
+        Slices place independently (multislice joins over DCN, so slices
+        may land in different pools); each takes the best-fit across all
+        pools. Commits into this fleet on success; on any slice missing,
+        rolls back and returns None.
+        """
+        committed: list[tuple[Pool, str]] = []
+        slices: list[dict] = []
+        for j in range(num_slices):
+            best: tuple[tuple[int, str], Pool, Cuboid, tuple[int, ...]] | None = None
+            for pool in sorted(self.pools.values(), key=lambda p: p.name):
+                if pool.accel.name != topo.accelerator.name:
+                    continue
+                fit = pool.place(topo)
+                if fit is None:
+                    continue
+                block_cuboid, chips = fit
+                # tightest pool first: least free chips remaining after the
+                # placement packs gangs together, preserving large holes
+                score = (pool.free_chips() - topo.num_chips, pool.name)
+                if best is None or score < best[0]:
+                    best = (score, pool, block_cuboid, chips)
+            if best is None:
+                for pool, k in committed:
+                    pool.free(k)
+                return None
+            _, pool, block_cuboid, chips = best
+            slice_key = f"{key}/s{j}"
+            pool.occupy(slice_key, block_cuboid)
+            committed.append((pool, slice_key))
+            slices.append(
+                {
+                    "pool": pool.name,
+                    "poolLabeled": pool.labeled,
+                    "accelerator": pool.accel.name,
+                    "poolTopology": "x".join(map(str, pool.chip_shape)),
+                    "offset": [
+                        o * b
+                        for o, b in zip(
+                            block_cuboid.offset, pool.accel.host_block
+                        )
+                    ],
+                    "shape": list(chips),
+                    "nodes": pool.nodes_for(block_cuboid),
+                }
+            )
+        return slices
+
+    def occupy_gang(self, key: str, slices: list[dict]) -> bool:
+        """Replay a committed placement annotation into the model.
+
+        False if any slice is invalid — unknown pool, misaligned offset,
+        out of bounds, or overlapping an earlier occupant (including blocked
+        cells of drained hosts): the caller must then unbind the gang.
+        All-or-nothing: a partial replay is rolled back.
+        """
+        committed: list[tuple[Pool, str]] = []
+        for j, s in enumerate(slices):
+            pool = self.pools.get(s.get("pool", ""))
+            if pool is None:
+                break
+            offset = s.get("offset") or []
+            shape = s.get("shape") or []
+            if len(offset) != len(pool.grid) or len(shape) != len(pool.grid):
+                break
+            if any(o % b for o, b in zip(offset, pool.accel.host_block)):
+                break
+            cuboid = Cuboid(
+                tuple(o // b for o, b in zip(offset, pool.accel.host_block)),
+                ceil_div_shape(shape, pool.accel.host_block),
+            )
+            if not pool.occupy(f"{key}/s{j}", cuboid):
+                break
+            committed.append((pool, f"{key}/s{j}"))
+        else:
+            return True
+        for pool, k in committed:
+            pool.free(k)
+        return False
+
+    def free_gang(self, key: str) -> None:
+        prefix = f"{key}/s"
+        for pool in self.pools.values():
+            for k in [k for k in pool.used if k.startswith(prefix)]:
+                pool.free(k)
+
+    def feasible_on_empty(
+        self, topo: SliceTopology, num_slices: int = 1
+    ) -> bool:
+        """Could this gang EVER bind — on a fully drained fleet with every
+        host healthy? False means Unschedulable (a topology no pool can
+        hold), not merely queued."""
+        empty = Fleet(
+            {
+                n: Pool(p.name, p.accel, p.chip_shape)
+                for n, p in self.pools.items()
+            }
+        )
+        for n, p in self.pools.items():
+            empty.pools[n].nodes = dict(p.nodes)
+        return empty.place_gang("probe", topo, num_slices) is not None
+
+    # ----------------------------------------------------------- accounting
+
+    def total_chips(self) -> int:
+        return sum(p.total_chips for p in self.pools.values())
+
+    def used_chips(self) -> int:
+        return sum(p.used_chips() for p in self.pools.values())
+
+    def utilization(self) -> float:
+        total = self.total_chips()
+        return (self.used_chips() / total) if total else 0.0
+
+    def assert_no_overlap(self) -> list[str]:
+        """Double-booking audit over the in-memory model (the soak audits
+        the cluster-state analog from annotations). Empty == healthy."""
+        out = []
+        for pool in self.pools.values():
+            entries = sorted(pool.used.items())
+            for i, (ka, ca) in enumerate(entries):
+                if not ca.within(pool.grid):
+                    out.append(f"{pool.name}: {ka} out of bounds {ca}")
+                for kb, cb in entries[i + 1:]:
+                    if ca.overlaps(cb):
+                        out.append(
+                            f"{pool.name}: {ka} overlaps {kb} ({ca} vs {cb})"
+                        )
+        return out

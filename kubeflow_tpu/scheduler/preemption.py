@@ -1,0 +1,110 @@
+"""Preemption and backfill policy.
+
+Preemption runs only for the head of the queue — the single gang whose
+delay defines everyone else's (strict priority order). Victims must be
+strictly junior to the head: lower base priority, or same priority but
+queued later. Eligible victims are tried in policy order — **lowest
+priority first, then youngest, then fewest chips** — and eviction is
+greedy-minimal: stop at the first prefix whose removal actually fits the
+head, evict nothing if even the full set would not (useless evictions are
+worse than waiting; a victim evicted without freeing enough space for the
+head would thrash forever).
+
+Backfill fills the holes behind a blocked head: gangs further down the
+queue may bind now iff they are strictly smaller than the head (a backfill
+as large as the head could simply *be* the head) and fit current free
+space. Without run-time estimates there is no reservation to respect;
+fairness is restored by aging — a backfilled junior gang is preemptible the
+moment the aged head can use its chips.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from kubeflow_tpu.scheduler.fleet import Fleet
+from kubeflow_tpu.scheduler.queue import GangRequest
+from kubeflow_tpu.tpu.topology import SliceTopology
+
+DEFAULT_BACKFILL_WINDOW = 32
+
+
+@dataclasses.dataclass(frozen=True)
+class BoundGang:
+    """A gang currently holding capacity (rebuilt from its annotation)."""
+
+    key: str
+    priority: int
+    queued_at: float
+    chips: int
+    # carried so an evicted victim re-enters the same cycle's queue with its
+    # real request (and its original queued_at: seniority survives eviction)
+    topo: SliceTopology
+    num_slices: int
+
+    def as_request(self) -> GangRequest:
+        return GangRequest(
+            key=self.key,
+            priority=self.priority,
+            queued_at=self.queued_at,
+            topo=self.topo,
+            num_slices=self.num_slices,
+        )
+
+
+def eligible_victim(victim: BoundGang, head: GangRequest) -> bool:
+    if victim.priority != head.priority:
+        return victim.priority < head.priority
+    return victim.queued_at > head.queued_at
+
+
+def select_victims(
+    fleet: Fleet, bound: list[BoundGang], head: GangRequest
+) -> list[BoundGang] | None:
+    """Minimal victim prefix whose eviction lets the head bind, or None.
+
+    Pure trial: simulates on a clone, never mutates ``fleet`` — the caller
+    commits evictions through the cluster (annotation removal) so a crash
+    between evict and bind leaves only re-queued victims, never a
+    double-booking. Candidates are scoped to the head's accelerator:
+    evicting a gang whose chips the head cannot use frees nothing for it
+    (the greedy prefix would evict junior cross-accel gangs pointlessly
+    before reaching a victim that matters).
+    """
+    accel = head.topo.accelerator.name
+    candidates = sorted(
+        (
+            v for v in bound
+            if v.topo.accelerator.name == accel and eligible_victim(v, head)
+        ),
+        key=lambda v: (v.priority, -v.queued_at, v.chips, v.key),
+    )
+    if not candidates:
+        return None
+    trial = fleet.clone()
+    evicted: list[BoundGang] = []
+    for victim in candidates:
+        trial.free_gang(victim.key)
+        evicted.append(victim)
+        if trial.place_gang(head.key, head.topo, head.num_slices) is not None:
+            return evicted
+    return None
+
+
+def backfill_candidates(
+    queue_order: list[GangRequest],
+    head: GangRequest,
+    *,
+    window: int = DEFAULT_BACKFILL_WINDOW,
+) -> list[GangRequest]:
+    """Gangs behind a blocked head allowed to try the holes it cannot use.
+
+    Scoped to the head's accelerator: a blocked v4 head says nothing about
+    v5e capacity, so gangs for other accelerators are never held behind it —
+    they get their own head (cross-accel head-of-line blocking would starve
+    a gang on an idle pool of a different generation forever)."""
+    accel = head.topo.accelerator.name
+    behind = [
+        r for r in queue_order
+        if r.key != head.key and r.topo.accelerator.name == accel
+    ]
+    return [r for r in behind[:window] if r.chips < head.chips]

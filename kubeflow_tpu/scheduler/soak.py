@@ -1,0 +1,658 @@
+"""Seeded chaos soak for the fleet scheduler (``tools/sched_soak.py``).
+
+The scheduler's whole safety argument is that the placement-annotation set is
+the store of record: every cycle rebuilds occupancy from it, so any
+interleaving of API faults, node drains, capacity flaps, and scheduler
+crash-restarts *between bind writes* must preserve two hard invariants at
+every observable state —
+
+- **zero chip double-booking**: no two gangs' committed placements overlap;
+- **gang atomicity**: a placement annotation always carries every slice of
+  its gang (the bind is one write), and a gang's StatefulSets hold either
+  all their pods or none.
+
+— and converge, once the faults heal, to a fixed point where the scheduler
+itself has nothing left to do: the queue head does not fit free capacity, no
+eligible preemption would make it fit, and no strictly-smaller gang behind it
+could backfill (otherwise "every feasible gang eventually binds" is broken —
+a quiesced-but-wrong scheduler would pass a pure quiescence check, so the
+final audit re-derives the policy's own fixed-point condition from the
+store).
+
+Reuses the control-plane chaos layer (:mod:`kubeflow_tpu.testing.chaos`) for
+verb faults, lost responses, watch drops, and crash-restart arming; the
+scheduler-specific chaos — drains, flaps, priority bumps, stop/start churn —
+is the seeded op timeline. Everything flows from the seed: a printed failure
+reproduces with ``python tools/sched_soak.py --seed N``.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import random
+from typing import Callable
+
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.culler.culler import Culler
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import (
+    AlreadyExists,
+    Conflict,
+    FakeCluster,
+    NotFound,
+)
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.scheduler import preemption as preempt
+from kubeflow_tpu.scheduler.binpack import ceil_div_shape
+from kubeflow_tpu.scheduler.controller import SchedulerReconciler
+from kubeflow_tpu.scheduler.fleet import Fleet
+from kubeflow_tpu.scheduler.queue import GangQueue, GangRequest
+from kubeflow_tpu.testing.chaos import (
+    SOAK_MAX_REQUEUE_S,
+    ChaosCluster,
+    ChaosConfig,
+    check_invariants,
+    fingerprint,
+)
+from kubeflow_tpu.tpu.topology import ACCELERATORS, parse_topology
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import SchedulerMetrics
+from kubeflow_tpu.webhooks import tpu_env
+
+# Short aging interval so the soak's virtual timeline (minutes, not hours)
+# actually crosses aging boundaries — the quiescence check then proves the
+# continuous-aging design claim: relative queue order is time-invariant.
+SOAK_AGING_INTERVAL_S = 60.0
+
+
+def make_pool(
+    base: FakeCluster, accelerator: str, topology: str, pool_name: str
+) -> list[dict]:
+    """One TPU node pool with explicit pool + host-index labels (the GKE
+    labels ``Fleet.from_nodes`` keys on); returns the created Node objects
+    so a capacity flap can re-create them verbatim."""
+    topo = parse_topology(accelerator, topology)
+    accel = ACCELERATORS[accelerator]
+    nodes = []
+    for i in range(topo.num_hosts):
+        nodes.append(
+            base.add_node(
+                f"{pool_name}-{i}",
+                labels={
+                    "cloud.google.com/gke-tpu-accelerator": accel.gke_accelerator,
+                    "cloud.google.com/gke-tpu-topology": topology,
+                    sched.POOL_LABEL: pool_name,
+                    sched.HOST_INDEX_LABEL: str(i),
+                },
+                capacity={"google.com/tpu": str(topo.chips_per_host)},
+            )
+        )
+    return nodes
+
+
+# ------------------------------------------------------------------- audits
+
+
+def _nb_key(nb: dict) -> str:
+    return f"{ko.namespace(nb)}/{ko.name(nb)}"
+
+
+def _healthy_fleet(base: FakeCluster) -> Fleet:
+    """The fleet model with every known host treated usable — the geometry
+    double-booking is judged against (a drained host still HOLDS the chips
+    its gang was bound to; it does not hand them to a second gang)."""
+    fleet = Fleet.from_nodes(base.list("Node"))
+    for pool in fleet.pools.values():
+        pool.used.clear()  # drop blocked cells: gang-vs-gang only
+    return fleet
+
+
+def audit_placements(
+    base: FakeCluster, *, strict: bool = False, where: str = ""
+) -> list[str]:
+    """The two always-invariants, checked straight from the store.
+
+    Non-strict (mid-run) tolerates a placement into a pool whose every node
+    object is currently flapped away — the scheduler has not reacted yet and
+    the geometry is unknowable; strict (fixed point, data plane healed)
+    tolerates nothing.
+    """
+    out: list[str] = []
+    fleet = _healthy_fleet(base)
+    for nb in base.list("Notebook"):
+        placement = sched.placement_of(nb)
+        if placement is None:
+            continue
+        key = _nb_key(nb)
+        try:
+            topo = api.notebook_topology(nb)
+        except ValueError:
+            topo = None
+        if topo is None:
+            out.append(f"{where}: {key}: placement on a non-TPU notebook")
+            continue
+        slices = placement["slices"]
+        num_slices = api.notebook_num_slices(nb)
+        if len(slices) != num_slices:
+            out.append(
+                f"{where}: {key}: gang atomicity violated — "
+                f"{len(slices)} slices placed, {num_slices} requested"
+            )
+            continue
+        unknown = [s.get("pool") for s in slices if s.get("pool") not in fleet.pools]
+        if unknown:
+            if strict:
+                out.append(f"{where}: {key}: slice in unknown pool {unknown}")
+            continue
+        if not fleet.occupy_gang(key, slices):
+            out.append(
+                f"{where}: {key}: placement overlaps an earlier gang or "
+                f"falls outside its pool (CHIP DOUBLE-BOOKING)"
+            )
+            continue
+        if strict:
+            for j, s in enumerate(slices):
+                pool = fleet.pools[s["pool"]]
+                want = ceil_div_shape(s["shape"], pool.accel.host_block)
+                expected_hosts = 1
+                for d in want:
+                    expected_hosts *= d
+                if len(s.get("nodes") or []) != expected_hosts:
+                    out.append(
+                        f"{where}: {key}/s{j}: {len(s.get('nodes') or [])} "
+                        f"assigned nodes for a {expected_hosts}-host slice"
+                    )
+    return out
+
+
+def audit_fixed_point(
+    base: FakeCluster,
+    now: float,
+    *,
+    aging_interval_s: float = SOAK_AGING_INTERVAL_S,
+    backfill_window: int = preempt.DEFAULT_BACKFILL_WINDOW,
+    where: str = "final",
+) -> list[str]:
+    """Everything that must hold once faults are healed and the state has
+    quiesced. Re-derives the scheduler's own fixed-point condition from the
+    store alone, so a scheduler that silently stopped cycling (lost requeue)
+    fails here even though the state looks quiet."""
+    out = audit_placements(base, strict=True, where=where)
+    fleet = _healthy_fleet(base)
+    bound: list[preempt.BoundGang] = []
+    queue = GangQueue(aging_interval_s=aging_interval_s)
+
+    for nb in base.list("Notebook"):
+        try:
+            topo = api.notebook_topology(nb)
+        except ValueError:
+            continue
+        if topo is None:
+            continue
+        key = _nb_key(nb)
+        ns, name = ko.namespace(nb), ko.name(nb)
+        num_slices = api.notebook_num_slices(nb)
+        anns = ko.annotations(nb)
+        active = api.STOP_ANNOTATION not in anns
+        placement = sched.placement_of(nb)
+
+        # -- workload gating: all pods or none, gated on the bind ----------
+        expected = topo.num_hosts if (active and placement) else 0
+        for j in range(num_slices):
+            sts_name = name if num_slices == 1 else f"{name}-s{j}"
+            sts = base.try_get("StatefulSet", sts_name, ns)
+            replicas = (sts or {}).get("spec", {}).get("replicas", 0)
+            if replicas != expected:
+                out.append(
+                    f"{where}: {key}: slice {j} StatefulSet has "
+                    f"{replicas} replicas, want {expected} "
+                    f"({'bound' if placement else 'unbound'} gang)"
+                )
+
+        if not active:
+            if placement is not None:
+                out.append(f"{where}: {key}: stopped gang still holds a placement")
+            if sched.QUEUED_AT_ANNOTATION in anns:
+                out.append(
+                    f"{where}: {key}: stopped gang still queued "
+                    f"(ghost capacity claim)"
+                )
+            for t in sched.SCHEDULER_CONDITION_TYPES:
+                if sched.condition_is_true(nb, t):
+                    out.append(f"{where}: {key}: stopped gang still marked {t}")
+            continue
+
+        if placement is not None:
+            fleet.occupy_gang(key, placement["slices"])
+            bound.append(
+                preempt.BoundGang(
+                    key=key,
+                    priority=sched.gang_priority(nb),
+                    queued_at=float(anns.get(sched.QUEUED_AT_ANNOTATION, now)),
+                    chips=topo.num_chips * num_slices,
+                    topo=topo,
+                    num_slices=num_slices,
+                )
+            )
+            if sched.condition_is_true(nb, sched.COND_QUEUED):
+                out.append(f"{where}: {key}: bound gang still marked Queued")
+            continue
+
+        if not fleet.feasible_on_empty(topo, num_slices):
+            if not sched.condition_is_true(nb, sched.COND_UNSCHEDULABLE):
+                out.append(
+                    f"{where}: {key}: infeasible gang not marked Unschedulable"
+                )
+            continue
+        if not sched.condition_is_true(nb, sched.COND_QUEUED):
+            out.append(f"{where}: {key}: waiting feasible gang not marked Queued")
+        raw = anns.get(sched.QUEUED_AT_ANNOTATION)
+        if raw is None:
+            out.append(f"{where}: {key}: queued gang has no queued-at annotation")
+            continue
+        queue.push(
+            GangRequest(
+                key=key,
+                priority=sched.gang_priority(nb),
+                queued_at=float(raw),
+                topo=topo,
+                num_slices=num_slices,
+            )
+        )
+
+    # -- the policy's own fixed-point condition ----------------------------
+    # heads are per accelerator (a blocked v4 head must not hide starvation
+    # of a v5e gang on an idle v5e pool — the scheduler's _schedule loop
+    # uses the same rule)
+    order = queue.ordered(now)
+    heads: dict[str, GangRequest] = {}
+    for req in order:
+        heads.setdefault(req.topo.accelerator.name, req)
+    for accel in sorted(heads):
+        head = heads[accel]
+        if fleet.clone().place_gang(head.key, head.topo, head.num_slices):
+            out.append(
+                f"{where}: STARVATION — {accel} queue head {head.key} fits "
+                f"free capacity but was never bound"
+            )
+            continue
+        if preempt.select_victims(fleet, bound, head) is not None:
+            out.append(
+                f"{where}: head {head.key} could bind by preempting "
+                f"junior gangs but was never bound"
+            )
+        for cand in preempt.backfill_candidates(
+            order, head, window=backfill_window
+        ):
+            if fleet.clone().place_gang(cand.key, cand.topo, cand.num_slices):
+                out.append(
+                    f"{where}: STARVATION — {cand.key} is backfillable "
+                    f"behind blocked head {head.key} but was never bound"
+                )
+    return out
+
+
+# ----------------------------------------------------------------- scenario
+
+# (accelerator, pool topology): small enough that seeds run fast, varied
+# enough to exercise rotation, multi-pool spread, and cross-accel queues.
+_POOL_CHOICES = [
+    ("v4", "4x4x4"),   # 16 hosts / 64 chips, 3-d torus
+    ("v4", "2x2x4"),   # 4 hosts
+    ("v5e", "4x8"),    # 4 hosts / 32 chips, 2-d
+    ("v5p", "2x2x4"),  # 4 hosts
+]
+_GANG_TOPOLOGIES = {
+    "v4": ["2x2x1", "2x2x2", "2x2x4", "4x4x4"],
+    "v5e": ["2x4", "4x4", "4x8"],
+    "v5p": ["2x2x1", "2x2x2", "2x2x4"],
+}
+# Valid shapes no soak pool can ever hold — must surface as Unschedulable.
+_INFEASIBLE = [("v4", "8x8x8"), ("v5e", "8x16"), ("v5p", "4x4x8")]
+
+
+class SchedScenario:
+    """A seeded fleet + gang workload + hostile op timeline."""
+
+    N_ROUNDS = 6
+    NAMESPACE = "team-a"
+
+    def __init__(self, seed: int) -> None:
+        rng = random.Random(f"sched-scenario-{seed}")
+        self.seed = seed
+        self.culling = rng.random() < 0.3
+        n_pools = 1 + (rng.random() < 0.6) + (rng.random() < 0.2)
+        picks = rng.sample(_POOL_CHOICES, k=min(n_pools, len(_POOL_CHOICES)))
+        self.pools = {
+            f"pool-{accel}-{i}": (accel, topo)
+            for i, (accel, topo) in enumerate(picks)
+        }
+        pool_accels = sorted({a for a, _ in self.pools.values()})
+        self.gangs: dict[str, dict] = {}
+        for i in range(rng.randint(5, 10)):
+            if rng.random() < 0.12:
+                accel, topo = _INFEASIBLE[rng.randrange(len(_INFEASIBLE))]
+            else:
+                accel = pool_accels[rng.randrange(len(pool_accels))]
+                shapes = _GANG_TOPOLOGIES[accel]
+                topo = shapes[rng.randrange(len(shapes))]
+            gang = dict(tpu_accelerator=accel, tpu_topology=topo)
+            if rng.random() < 0.2 and parse_topology(accel, topo).num_hosts <= 2:
+                gang["tpu_num_slices"] = 2
+            prio = (0, 0, 0, 1, 5)[rng.randrange(5)]
+            if prio:
+                gang["annotations"] = {sched.PRIORITY_ANNOTATION: str(prio)}
+            self.gangs[f"g{i}"] = gang
+        # busy gangs survive the culler; the rest are idle and cullable
+        self.busy = {g for g in sorted(self.gangs) if rng.random() < 0.7}
+        self.node_specs: dict[str, dict] = {}
+        self.rounds = self._op_timeline(rng)
+
+    def _op_timeline(self, rng: random.Random) -> list[list[tuple[str, str]]]:
+        node_names = [
+            f"{pool}-{i}"
+            for pool, (accel, topo) in sorted(self.pools.items())
+            for i in range(parse_topology(accel, topo).num_hosts)
+        ]
+        alive_nb, dead_nb = set(self.gangs), set()
+        drained: set[str] = set()
+        flapped: set[str] = set()
+        rounds: list[list[tuple[str, str]]] = []
+        for _ in range(self.N_ROUNDS):
+            ops: list[tuple[str, str]] = []
+            for _ in range(rng.randint(0, 2)):
+                choices: list[tuple[str, str]] = []
+                for nb in sorted(alive_nb):
+                    choices += [
+                        ("stop", nb), ("start", nb),
+                        ("bump_priority", nb), ("delete_nb", nb),
+                    ]
+                    shapes = _GANG_TOPOLOGIES[
+                        self.gangs[nb]["tpu_accelerator"]
+                    ]
+                    choices.append(
+                        ("resize", f"{nb}:{shapes[rng.randrange(len(shapes))]}")
+                    )
+                choices += [("recreate_nb", nb) for nb in sorted(dead_nb)]
+                for node in node_names:
+                    if node in flapped:
+                        choices.append(("restore", node))
+                    elif node in drained:
+                        choices.append(("undrain", node))
+                    else:
+                        choices += [("drain", node), ("flap", node)]
+                op = choices[rng.randrange(len(choices))]
+                verb, target = op
+                if verb == "delete_nb":
+                    alive_nb.discard(target); dead_nb.add(target)
+                elif verb == "recreate_nb":
+                    dead_nb.discard(target); alive_nb.add(target)
+                elif verb == "drain":
+                    drained.add(target)
+                elif verb == "undrain":
+                    drained.discard(target)
+                elif verb == "flap":
+                    flapped.add(target); drained.discard(target)
+                elif verb == "restore":
+                    flapped.discard(target)
+                ops.append(op)
+            rounds.append(ops)
+        return rounds
+
+    # -- world construction (user / API-server side: never faulted) --------
+
+    def _nb(self, name: str) -> dict:
+        return api.notebook(name, self.NAMESPACE, **self.gangs[name])
+
+    def setup(self, base: FakeCluster) -> None:
+        for pool, (accel, topo) in sorted(self.pools.items()):
+            for node in make_pool(base, accel, topo, pool):
+                self.node_specs[ko.name(node)] = {
+                    "apiVersion": "v1",
+                    "kind": "Node",
+                    "metadata": {
+                        "name": ko.name(node),
+                        "labels": dict(ko.labels(node)),
+                    },
+                    "status": ko.deep_copy(node.get("status", {})),
+                }
+        for name in sorted(self.gangs):
+            base.create(self._nb(name))
+
+    def apply(self, base: FakeCluster, op: tuple[str, str], round_no: int) -> None:
+        verb, target = op
+        ns = self.NAMESPACE
+        try:
+            if verb == "stop":
+                base.patch("Notebook", target, ns, {"metadata": {"annotations": {
+                    api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+            elif verb == "start":
+                base.patch("Notebook", target, ns, {"metadata": {"annotations": {
+                    api.STOP_ANNOTATION: None,
+                    api.LAST_ACTIVITY_ANNOTATION: None}}})
+            elif verb == "bump_priority":
+                base.patch("Notebook", target, ns, {"metadata": {"annotations": {
+                    sched.PRIORITY_ANNOTATION: str((round_no % 3) * 5)}}})
+            elif verb == "resize":
+                # spec.tpu edited in place: a bound gang's committed
+                # placement no longer matches and must be released
+                name, topo = target.split(":", 1)
+                base.patch("Notebook", name, ns, {"spec": {"tpu": {
+                    "topology": topo}}})
+            elif verb == "delete_nb":
+                base.delete("Notebook", target, ns)
+            elif verb == "recreate_nb":
+                base.create(self._nb(target))
+            elif verb == "drain":
+                base.patch("Node", target, "", {"spec": {"unschedulable": True}})
+            elif verb == "undrain":
+                base.patch("Node", target, "", {"spec": {"unschedulable": None}})
+            elif verb == "flap":
+                base.delete("Node", target)
+            elif verb == "restore":
+                base.create(self.node_specs[target], skip_admission=True)
+        except (NotFound, AlreadyExists, Conflict):
+            pass  # op raced a controller write; a later round retries
+
+    def heal_data_plane(self, base: FakeCluster) -> None:
+        """Undrain and restore every node: the final audit judges the
+        scheduler against a fully healthy fleet (feasible ⇒ eventually
+        bound has no meaning while the capacity itself is still gone)."""
+        for name, spec in sorted(self.node_specs.items()):
+            node = base.try_get("Node", name)
+            if node is None:
+                base.create(spec, skip_admission=True)
+            elif (node.get("spec") or {}).get("unschedulable"):
+                base.patch("Node", name, "", {"spec": {"unschedulable": None}})
+
+    def make_fetcher(self) -> Callable:
+        busy = set(self.busy)
+
+        def fetch(namespace: str, name: str):
+            if name in busy:
+                return [{"execution_state": "busy"}]
+            return []  # reachable server, zero kernels: idle by definition
+
+        return fetch
+
+
+# -------------------------------------------------------------------- runner
+
+
+class _Clock:
+    def __init__(self, start: float) -> None:
+        self.t = start
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+@dataclasses.dataclass
+class SchedSeedResult:
+    seed: int
+    violations: list[str]
+    quiesced: bool
+    restarts: int
+    binds: int
+    preemptions: int
+    fault_counts: collections.Counter
+
+    @property
+    def ok(self) -> bool:
+        return self.quiesced and not self.violations
+
+    def describe(self) -> str:
+        if self.ok:
+            faults = sum(self.fault_counts.values())
+            return (
+                f"seed {self.seed}: converged ({self.binds} binds, "
+                f"{self.preemptions} preemptions, {faults} faults, "
+                f"{self.restarts} scheduler restarts)"
+            )
+        lines = [f"seed {self.seed}: FAILED "
+                 f"(repro: python tools/sched_soak.py --seed {self.seed})"]
+        if not self.quiesced:
+            lines.append("  state never quiesced after faults healed")
+        lines += [f"  invariant: {v}" for v in self.violations[:10]]
+        if len(self.violations) > 10:
+            lines.append(f"  ... {len(self.violations) - 10} more")
+        return "\n".join(lines)
+
+
+def run_sched_seed(
+    seed: int,
+    faults: ChaosConfig | None = None,
+    *,
+    max_restarts_per_tick: int = 6,
+) -> SchedSeedResult:
+    """One seeded soak run: hostile timeline under chaos, heal, settle,
+    quiesce, then the fixed-point audit. ``faults=None`` runs the same
+    timeline fault-free (a sanity baseline for targeted tests)."""
+    scenario = SchedScenario(seed)
+    base = FakeCluster()
+    tpu_env.install(base)
+    chaos = (
+        ChaosCluster(base, seed=seed, config=faults)
+        if faults is not None
+        else None
+    )
+    cluster = chaos if chaos is not None else base
+    clock = _Clock(1_000_000.0)
+    cfg = ControllerConfig(scheduler_enabled=True)
+    culler = Culler(
+        enabled=scenario.culling,
+        cull_idle_minutes=1.0,
+        check_period_minutes=0.5,
+        fetch_kernels=scenario.make_fetcher(),
+        clock=clock,
+    )
+    metrics = SchedulerMetrics()
+
+    def build() -> Manager:
+        m = Manager(cluster, clock=clock)
+        m.register(NotebookReconciler(cfg, culler=culler))
+        # a crash-restart loses every bit of in-memory scheduler state —
+        # a fresh reconciler instance models exactly that
+        m.register(
+            SchedulerReconciler(
+                metrics=metrics,
+                clock=clock,
+                aging_interval_s=SOAK_AGING_INTERVAL_S,
+            )
+        )
+        return m
+
+    scenario.setup(base)
+    mgr = build()
+    violations: list[str] = []
+    restarts = 0
+
+    def tick() -> None:
+        nonlocal mgr, restarts
+        for _ in range(max_restarts_per_tick):
+            crashed = False
+            try:
+                mgr.tick()
+            except Exception:
+                crashed = True
+            if chaos is not None and chaos.take_crash():
+                crashed = True
+            if not crashed:
+                return
+            restarts += 1
+            mgr.shutdown()
+            mgr = build()
+
+    def drive(where: str, *, sub_ticks: int = 3, dt: float = 10.0) -> None:
+        for s in range(sub_ticks):
+            cluster.step_kubelet()
+            if chaos is not None:
+                chaos.tick_watches()
+            tick()
+            if chaos is not None:
+                lat = chaos.take_latency()
+                if lat:
+                    clock.advance(lat)
+            sub_where = f"{where}.{s}"
+            violations.extend(
+                audit_placements(base, strict=False, where=sub_where)
+            )
+            violations.extend(
+                check_invariants(
+                    base, mgr,
+                    max_requeue_s=SOAK_MAX_REQUEUE_S,
+                    where=sub_where,
+                )
+            )
+        clock.advance(dt)
+
+    for r, ops in enumerate(scenario.rounds):
+        for op in ops:
+            scenario.apply(base, op, r)
+        drive(f"round {r}")
+
+    scenario.heal_data_plane(base)
+    if chaos is not None:
+        chaos.heal()
+
+    # settle past the cull threshold (60 s) and the backoff cap (64 s)
+    for s in range(6):
+        drive(f"settle {s}", sub_ticks=2, dt=45.0)
+
+    # quiesce: the normalized store must stop changing even as the clock
+    # keeps crossing aging intervals (continuous aging keeps order stable)
+    prev = None
+    quiesced = False
+    for s in range(20):
+        cluster.step_kubelet()
+        tick()
+        fp = fingerprint(base)
+        if fp == prev:
+            quiesced = True
+            break
+        prev = fp
+        clock.advance(65.0)
+    violations.extend(
+        check_invariants(
+            base, mgr,
+            max_requeue_s=SOAK_MAX_REQUEUE_S,
+            where="final", final=True,
+        )
+    )
+    violations.extend(audit_fixed_point(base, clock()))
+    return SchedSeedResult(
+        seed=seed,
+        violations=violations,
+        quiesced=quiesced,
+        restarts=restarts,
+        binds=int(metrics.binds.get()),
+        preemptions=int(metrics.preemptions.get()),
+        fault_counts=(
+            chaos.fault_counts if chaos is not None else collections.Counter()
+        ),
+    )

@@ -46,6 +46,13 @@ class ControllerConfig:
     # TPU-native
     tpu_coordinator_port: int = 8476  # jax.distributed default coordinator port
     tpu_gang_schedule: bool = True    # all-or-nothing pod-slice admission
+    # Fleet scheduler (kubeflow_tpu/scheduler/): when enabled, a TPU gang's
+    # StatefulSets stay at 0 replicas until the scheduler binds it (the
+    # placement annotation is the gate) and the gang is pinned to the pool
+    # the scheduler chose. Off by default for programmatic construction so
+    # tests that run the notebook controller alone keep their semantics;
+    # the shipped controller-manager process enables it (SCHEDULER_ENABLED).
+    scheduler_enabled: bool = False
     # Profile defaults (ref --namespace-labels-path flag, profile-controller
     # main.go; the mounted file is hot-reloaded, go:356-405)
     namespace_labels_path: str = ""
@@ -67,6 +74,7 @@ class ControllerConfig:
             idleness_check_minutes=_env_float("IDLENESS_CHECK_PERIOD", 1.0),
             dev=_env_bool("DEV", False),
             tpu_gang_schedule=_env_bool("TPU_GANG_SCHEDULE", True),
+            scheduler_enabled=_env_bool("SCHEDULER_ENABLED", True),
             namespace_labels_path=os.environ.get("NAMESPACE_LABELS_PATH", ""),
             enable_oauth_controller=_env_bool("ENABLE_OAUTH_CONTROLLER", False),
         )

@@ -158,3 +158,66 @@ class NotebookMetrics:
 
     def notebook_culled(self, namespace: str) -> None:
         self.culled.inc(namespace=namespace)
+
+
+class SchedulerMetrics:
+    """Fleet-scheduler observability (docs/scheduler.md): queue pressure,
+    time-to-bind, fleet utilization, and preemption churn — the four numbers
+    an operator needs to answer "why is my notebook still pending".
+
+    Shares a registry with :class:`NotebookMetrics` so one /metrics endpoint
+    carries both; time-to-bind is exposed as a cumulative sum + count (+ max)
+    rather than a histogram — the benchmark computes percentiles offline
+    from its own samples, and sum/count is what a rate() query needs.
+    """
+
+    def __init__(self, registry: Registry | None = None) -> None:
+        self.registry = registry or Registry()
+        self.queue_depth = self.registry.gauge(
+            "scheduler_queue_depth", "Gangs waiting for TPU capacity"
+        )
+        self.unschedulable = self.registry.gauge(
+            "scheduler_unschedulable",
+            "Gangs no node pool could ever hold (bad topology for this fleet)",
+        )
+        self.fleet_chips_total = self.registry.gauge(
+            "scheduler_fleet_chips_total", "TPU chips the fleet models"
+        )
+        self.fleet_chips_used = self.registry.gauge(
+            "scheduler_fleet_chips_used",
+            "TPU chips held by bound gangs or blocked hosts",
+        )
+        self.utilization = self.registry.gauge(
+            "scheduler_fleet_utilization", "used/total chips, 0..1"
+        )
+        self.binds = self.registry.counter(
+            "scheduler_bind_total", "Gang placements committed"
+        )
+        self.preemptions = self.registry.counter(
+            "scheduler_preemption_total", "Gangs evicted for a senior gang"
+        )
+        self.bind_seconds_sum = self.registry.counter(
+            "scheduler_time_to_bind_seconds_sum",
+            "Cumulative queue-admission→bind latency",
+        )
+        self.bind_seconds_max = self.registry.gauge(
+            "scheduler_time_to_bind_seconds_max",
+            "Largest time-to-bind observed",
+        )
+        self.cycles = self.registry.counter(
+            "scheduler_cycle_total", "Scheduling cycles run"
+        )
+
+    def observe_cycle(self, fleet, *, queue_depth: int, unschedulable: int) -> None:
+        self.cycles.inc()
+        self.queue_depth.set(queue_depth)
+        self.unschedulable.set(unschedulable)
+        self.fleet_chips_total.set(fleet.total_chips())
+        self.fleet_chips_used.set(fleet.used_chips())
+        self.utilization.set(fleet.utilization())
+
+    def observe_bind(self, seconds: float) -> None:
+        self.binds.inc()
+        self.bind_seconds_sum.inc(seconds)
+        if seconds > self.bind_seconds_max.get():
+            self.bind_seconds_max.set(seconds)

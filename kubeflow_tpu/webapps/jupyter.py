@@ -23,6 +23,7 @@ Status derivation for the index table follows the reference's CR+events logic
 """
 from __future__ import annotations
 
+from kubeflow_tpu import scheduler as sched
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.auth.rbac import Authorizer
 from kubeflow_tpu.controllers.notebook_controller import REWRITE_ANNOTATION
@@ -39,7 +40,9 @@ import time
 
 
 def notebook_status(nb: dict, events: list[dict]) -> dict:
-    """Derive UI status (ref status.py:9-99)."""
+    """Derive UI status (ref status.py:9-99), extended with the fleet
+    scheduler's conditions: a queued gang says WHERE it is in line instead
+    of a generic "pending", an unschedulable one says why it never will be."""
     anns = ko.annotations(nb)
     ready = nb.get("status", {}).get("readyReplicas", 0)
     topo = api.notebook_topology(nb)
@@ -52,6 +55,23 @@ def notebook_status(nb: dict, events: list[dict]) -> dict:
         return {"phase": "terminating", "message": "Notebook Server is stopping."}
     if ready >= expected:
         return {"phase": "ready", "message": "Running"}
+    unsched = sched.condition(nb, sched.COND_UNSCHEDULABLE)
+    if unsched is not None and unsched.get("status") == "True":
+        return {
+            "phase": "warning",
+            "message": f"Unschedulable: {unsched.get('message') or 'no fitting node pool'}",
+        }
+    queued = sched.condition(nb, sched.COND_QUEUED)
+    if queued is not None and queued.get("status") == "True":
+        detail = queued.get("message") or "waiting for capacity"
+        message = f"Queued for TPU capacity ({detail})."
+        preempted = sched.condition(nb, sched.COND_PREEMPTED)
+        if preempted is not None and preempted.get("status") == "True":
+            message = (
+                f"Preempted ({preempted.get('message') or 'by a higher-priority gang'}); "
+                f"re-queued ({detail})."
+            )
+        return {"phase": "waiting", "message": message}
     warnings = [e for e in events if e.get("type") == "Warning"]
     if warnings:
         return {"phase": "warning", "message": warnings[-1].get("message", "")}

@@ -1,0 +1,257 @@
+"""Bin-packing property tests (docs/scheduler.md).
+
+The placement layer's contract is geometric: placed cuboids never overlap,
+never leave the grid, and freeing a gang coalesces its space back exactly —
+``decompose_free`` is a pure function of the used set, so place → free →
+re-place must round-trip to the identical decision. Randomized request
+streams (seeded ``random`` — deterministic, no external property-test dep)
+drive all of it through the same ``Pool``/``Fleet`` surface the scheduler
+uses.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import random
+
+import pytest
+
+from kubeflow_tpu.scheduler import binpack
+from kubeflow_tpu.scheduler.binpack import Cuboid, ceil_div_shape
+from kubeflow_tpu.scheduler.fleet import Fleet, Pool
+from kubeflow_tpu.tpu.topology import ACCELERATORS, parse_topology
+
+V4 = ACCELERATORS["v4"]
+V5E = ACCELERATORS["v5e"]
+
+# (accelerator, pool topology, request topologies) exercised by the streams
+_CASES = [
+    ("v4", "4x4x4", ["2x2x1", "2x2x2", "2x2x4", "4x4x4", "2x2x8"]),
+    ("v4", "2x2x4", ["2x2x1", "2x2x2", "2x2x4"]),
+    ("v5e", "4x8", ["1x1", "2x2", "2x4", "4x4", "4x8"]),
+]
+
+
+def _pool(accel_name: str, topology: str, name: str | None = None) -> Pool:
+    topo = parse_topology(accel_name, topology)
+    pool = Pool(name or f"{accel_name}-{topology}", topo.accelerator, topo.shape)
+    for i in range(pool.num_hosts):
+        pool.add_host(i, f"node-{i}", True)
+    return pool
+
+
+def _no_overlaps(pool: Pool) -> bool:
+    entries = list(pool.used.values())
+    return all(
+        not a.overlaps(b)
+        for i, a in enumerate(entries)
+        for b in entries[i + 1:]
+    ) and all(c.within(pool.grid) for c in entries)
+
+
+class TestCuboid:
+    def test_overlap_is_symmetric_and_exact(self):
+        a = Cuboid((0, 0, 0), (2, 2, 1))
+        b = Cuboid((1, 1, 0), (2, 2, 1))
+        c = Cuboid((2, 2, 0), (1, 1, 1))
+        assert a.overlaps(b) and b.overlaps(a)
+        assert not a.overlaps(c)  # touching faces do not overlap
+        assert a.volume == 4 and set(a.cells()) == {
+            (0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)
+        }
+
+    def test_ceil_div_rounds_sub_host_shapes_up(self):
+        # a v5e 1x1 single-host offering still consumes one whole host block
+        assert ceil_div_shape((1, 1), V5E.host_block) == (1, 1)
+        assert ceil_div_shape((4, 8), V5E.host_block) == (2, 2)
+        assert ceil_div_shape((4, 4, 4), V4.host_block) == (2, 2, 4)
+
+
+class TestDecomposeFree:
+    def test_empty_grid_is_one_cuboid(self):
+        frees = binpack.decompose_free((2, 2, 4), [])
+        assert len(frees) == 1
+        assert frees[0] == Cuboid((0, 0, 0), (2, 2, 4))
+
+    def test_pure_function_of_used_set(self):
+        """The coalescing contract: the decomposition depends only on what
+        remains used, never on the order holes were created."""
+        grid = (4, 4)
+        used_a = [Cuboid((0, 0), (2, 2)), Cuboid((2, 2), (2, 2))]
+        used_b = list(reversed(used_a))
+        assert binpack.decompose_free(grid, used_a) == binpack.decompose_free(
+            grid, used_b
+        )
+
+    def test_covers_exactly_the_free_cells(self):
+        rng = random.Random(7)
+        for _ in range(50):
+            grid = (rng.randint(1, 4), rng.randint(1, 4), rng.randint(1, 4))
+            used = []
+            for _ in range(rng.randint(0, 3)):
+                shape = tuple(rng.randint(1, g) for g in grid)
+                offset = tuple(
+                    rng.randint(0, g - s) for g, s in zip(grid, shape)
+                )
+                used.append(Cuboid(offset, shape))
+            frees = binpack.decompose_free(grid, used)
+            free_cells = set(
+                itertools.product(*(range(g) for g in grid))
+            )
+            for c in used:
+                free_cells -= set(c.cells())
+            covered: set = set()
+            for f in frees:
+                cells = set(f.cells())
+                assert not (cells & covered), "free cuboids overlap"
+                covered |= cells
+            assert covered == free_cells
+
+
+class TestBestFit:
+    def test_prefers_tightest_hole(self):
+        # grid 2x2x4 with a genuine 1x1x1 hole at (1,1,0) (leftover 0): a
+        # single-host request must take it rather than fragment a big free
+        # cuboid.
+        used = [Cuboid((0, 0, 1), (1, 1, 3)), Cuboid((0, 1, 0), (1, 1, 4))]
+        frees = binpack.decompose_free((2, 2, 4), used)
+        assert Cuboid((1, 1, 0), (1, 1, 1)) in frees
+        fit = binpack.best_fit((2, 2, 4), used, V4, (2, 2, 1))
+        assert fit is not None
+        block, chips = fit
+        assert block == Cuboid((1, 1, 0), (1, 1, 1))
+
+    def test_orientation_rotation_finds_fit(self):
+        # a 2x2x8 pool is a 1x1x8 host grid; an 8x2x2 request (4x1x2 blocks)
+        # does not fit unrotated, but relabeled to 2x2x8 -> 1x1x8 it does
+        fit = binpack.best_fit((1, 1, 8), [], V4, (8, 2, 2))
+        assert fit is not None
+        block, chips = fit
+        assert chips == (2, 2, 8)
+        assert block == Cuboid((0, 0, 0), (1, 1, 8))
+        assert math.prod(chips) == 32
+
+    def test_exhaustive_fallback_beats_greedy_split(self):
+        """An L-shaped free region the greedy decomposition splits across
+        cuboid boundaries: ``fits`` must still be exact."""
+        # v5e grid 2x3 cells; block one cell so no single free cuboid holds
+        # a 1x3 run, but a 2-cell region still exists in the other row...
+        # construct: used blocks (0,0); free = {(0,1),(0,2),(1,0),(1,1),(1,2)}.
+        # greedy emits (0,1)x(1,2) then (1,0)x(1,3): a 1x3 request fits only
+        # via the second cuboid; a 2x1 column at offset (0,1) spans both.
+        grid = (2, 3)
+        used = [Cuboid((0, 0), (1, 1))]
+        frees = binpack.decompose_free(grid, used)
+        # the 2x2-chip request (1 block after ceil-div) always fits; the
+        # interesting one is a 2-blocks-tall column: 4x4 chips -> 2x1 blocks
+        fit = binpack.best_fit(grid, used, V5E, (4, 4))
+        assert fit is not None
+        block, _ = fit
+        assert not any(block.overlaps(c) for c in used)
+        assert block.within(grid)
+        assert len(frees) >= 2  # the region really was split
+
+
+class TestRandomStreams:
+    @pytest.mark.parametrize("case_seed", range(20))
+    def test_stream_never_overlaps_and_free_coalesces(self, case_seed):
+        rng = random.Random(f"binpack-{case_seed}")
+        accel_name, pool_topo, requests = _CASES[
+            case_seed % len(_CASES)
+        ]
+        pool = _pool(accel_name, pool_topo)
+        live: dict[str, tuple] = {}
+        counter = 0
+        for step in range(120):
+            if live and rng.random() < 0.4:
+                key = sorted(live)[rng.randrange(len(live))]
+                pool.free(key)
+                del live[key]
+            else:
+                topo = parse_topology(
+                    accel_name, requests[rng.randrange(len(requests))]
+                )
+                fit = pool.place(topo)
+                if fit is None:
+                    continue
+                block, chips = fit
+                key = f"g{counter}"
+                counter += 1
+                assert pool.occupy(key, block)
+                live[key] = (block, chips)
+            assert _no_overlaps(pool), f"overlap at step {step}"
+            # used + free partition the grid exactly
+            frees = binpack.decompose_free(pool.grid, pool.used.values())
+            total = sum(c.volume for c in pool.used.values()) + sum(
+                c.volume for c in frees
+            )
+            assert total == math.prod(pool.grid)
+        # free everything: the grid coalesces back to one full cuboid
+        for key in list(live):
+            pool.free(key)
+        frees = binpack.decompose_free(pool.grid, pool.used.values())
+        assert frees == [Cuboid((0,) * len(pool.grid), pool.grid)]
+
+    @pytest.mark.parametrize("case_seed", range(10))
+    def test_place_free_replace_round_trips(self, case_seed):
+        """Freeing a gang and re-requesting the same shape must re-derive
+        the identical placement (determinism + exact coalescing)."""
+        rng = random.Random(f"roundtrip-{case_seed}")
+        accel_name, pool_topo, requests = _CASES[case_seed % len(_CASES)]
+        pool = _pool(accel_name, pool_topo)
+        placed = []
+        for i in range(8):
+            topo = parse_topology(
+                accel_name, requests[rng.randrange(len(requests))]
+            )
+            fit = pool.place(topo)
+            if fit is None:
+                continue
+            pool.occupy(f"g{i}", fit[0])
+            placed.append((f"g{i}", topo, fit))
+        for key, topo, fit in placed:
+            pool.free(key)
+            # the freed cuboid coalesced back, so the same shape must fit
+            # again — and deterministically (two identical asks, one answer)
+            refit = pool.place(topo)
+            assert refit is not None, "free did not coalesce the space back"
+            assert pool.place(topo) == refit
+            assert pool.occupy(key, refit[0])
+            assert _no_overlaps(pool)
+
+
+class TestFleetGangOps:
+    def _fleet(self) -> Fleet:
+        return Fleet({
+            "a": _pool("v4", "2x2x4", name="a"),
+            "b": _pool("v4", "2x2x4", name="b"),
+        })
+
+    def test_multislice_all_or_nothing_rolls_back(self):
+        fleet = self._fleet()
+        topo = parse_topology("v4", "2x2x4")  # fills one pool exactly
+        # 3 slices over 2 pools cannot fit: nothing may remain committed
+        assert fleet.place_gang("g", topo, num_slices=3) is None
+        assert fleet.used_chips() == 0
+        # 2 slices fit, one per pool
+        slices = fleet.place_gang("g", topo, num_slices=2)
+        assert slices is not None
+        assert {s["pool"] for s in slices} == {"a", "b"}
+        assert fleet.used_chips() == 32
+
+    def test_occupy_gang_replay_rejects_overlap(self):
+        fleet = self._fleet()
+        topo = parse_topology("v4", "2x2x2")
+        slices = fleet.place_gang("g1", topo)
+        assert slices is not None
+        # replaying a second gang onto the same cuboid must fail atomically
+        assert not fleet.occupy_gang("g2", slices)
+        assert fleet.pools[slices[0]["pool"]].gang_keys() == ["g1/s0"]
+
+    def test_free_gang_releases_every_slice(self):
+        fleet = self._fleet()
+        topo = parse_topology("v4", "2x2x2")
+        assert fleet.place_gang("g", topo, num_slices=2) is not None
+        assert fleet.used_chips() == 16
+        fleet.free_gang("g")
+        assert fleet.used_chips() == 0

@@ -100,6 +100,33 @@ class TestNeedsCulling:
     def test_no_activity_annotation_no_cull(self):
         assert not _culler(now=1e9).needs_culling(_nb())
 
+    def test_queued_gang_never_culls(self):
+        """A queued gang has zero pods; its idleness is the fleet being
+        full, not the user being gone. Culling it would also drop its queue
+        seniority (the scheduler clears queued-at on stop), so a long wait
+        must never cost the user their place in line."""
+        nb = _nb({api.LAST_ACTIVITY_ANNOTATION: c.format_time(0.0)})
+        nb["status"] = {"conditions": [{"type": "Queued", "status": "True"}]}
+        assert not _culler(now=1e9).needs_culling(nb)
+        # once bound (Queued flips False) the same idleness culls again
+        nb["status"]["conditions"][0]["status"] = "False"
+        assert _culler(now=1e9).needs_culling(nb)
+
+    def test_queue_wait_freezes_the_idle_clock(self):
+        """A gang that waited in line must not be culled the moment it
+        binds: while Queued, last-activity is refreshed (waiting is not
+        idleness), so the idle clock starts from ~bind time."""
+        nb = _nb({api.LAST_ACTIVITY_ANNOTATION: c.format_time(0.0)})
+        nb["status"] = {"conditions": [{"type": "Queued", "status": "True"}]}
+        cul = _culler(now=100_000.0)
+        assert cul.update_last_activity(nb)
+        # bound now (Queued cleared): idle-for counts from the queue wait's
+        # end, not from before it
+        nb["status"]["conditions"] = []
+        assert not cul.needs_culling(nb)
+        cul.clock = lambda: 100_000.0 + 601.0
+        assert cul.needs_culling(nb)
+
 
 def test_restart_after_long_stop_does_not_instantly_recull():
     """Regression: while stopped, last-activity must never be re-seeded —

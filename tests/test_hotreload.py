@@ -124,12 +124,20 @@ class TestCertWatcher:
 
 class TestFileWatcher:
     def test_fires_on_change_and_reappearance(self, tmp_path):
+        import os
+
         p = tmp_path / "f.yaml"
         p.write_text("a: 1\n")
         hits = []
         w = FileWatcher(str(p), lambda: hits.append(1))
         assert not w.poll_once()
         p.write_text("a: 2\n")
+        # a same-size in-place rewrite within one mtime tick is invisible on
+        # coarse-granularity filesystems; bump mtime explicitly — the real
+        # ConfigMap/cert mount update is an atomic swap that always moves
+        # the signature (see test_atomic_replace_detected_via_inode)
+        st = p.stat()
+        os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
         assert w.poll_once() and len(hits) == 1
         p.unlink()
         assert not w.poll_once(), "deletion alone must not fire"

@@ -85,6 +85,32 @@ class TestProfileReconcile:
         assert quota["spec"]["hard"]["cpu"] == "10"
         assert quota["spec"]["hard"]["requests.google.com/tpu"] == "32"
 
+    def test_tpu_quota_update_patches_live_quota(self, cluster, manager):
+        """Changing spec.tpu.maxChips on an EXISTING profile must patch the
+        live ResourceQuota, not only shape it at create time — a namespace
+        whose chip budget was raised would otherwise stay capped forever."""
+        prof = api.profile("bob", "bob@x.io")
+        prof["spec"]["tpu"] = {"maxChips": 8}
+        cluster.create(prof)
+        manager.run_until_idle()
+        quota = cluster.get("ResourceQuota", QUOTA_NAME, "bob")
+        assert quota["spec"]["hard"]["requests.google.com/tpu"] == "8"
+
+        live = cluster.get("Profile", "bob")
+        live["spec"]["tpu"] = {"maxChips": 64}
+        cluster.update(live)
+        manager.run_until_idle()
+        quota = cluster.get("ResourceQuota", QUOTA_NAME, "bob")
+        assert quota["spec"]["hard"]["requests.google.com/tpu"] == "64"
+
+        # shrinking works the same way (the update path is symmetric)
+        live = cluster.get("Profile", "bob")
+        live["spec"]["tpu"] = {"maxChips": 16}
+        cluster.update(live)
+        manager.run_until_idle()
+        quota = cluster.get("ResourceQuota", QUOTA_NAME, "bob")
+        assert quota["spec"]["hard"]["requests.google.com/tpu"] == "16"
+
     def test_default_labels_hot_reload(self, cluster, manager):
         rec = ProfileReconciler()
         m = Manager(cluster)

@@ -1,0 +1,48 @@
+"""Scheduler chaos soak (docs/scheduler.md).
+
+Mirrors the control-plane chaos suite's split (``test_chaos.py``): a
+deterministic-replay check, a short tier-1 seed sweep, and the slow-marked
+nightly sweep. Seed ranges are disjoint from the CI workflow's
+``tools/sched_soak.py`` step (which starts at 26), so the two runs buy
+coverage instead of duplicating it.
+"""
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.scheduler.soak import run_sched_seed
+from kubeflow_tpu.testing.chaos import ChaosConfig
+
+CI_SEEDS = range(1, 26)
+NIGHTLY_SEEDS = range(1, 501)
+
+
+class TestDeterminism:
+    def test_same_seed_identical_run(self):
+        """Everything flows from the seed — fleet, gangs, timeline, faults —
+        so a printed failing seed is a complete bug report."""
+        a = run_sched_seed(17, ChaosConfig())
+        b = run_sched_seed(17, ChaosConfig())
+        assert a.fault_counts == b.fault_counts
+        assert a.restarts == b.restarts
+        assert a.binds == b.binds
+        assert a.preemptions == b.preemptions
+        assert a.violations == b.violations
+
+    def test_fault_free_baseline_converges(self):
+        result = run_sched_seed(3, None)
+        assert result.ok, result.describe()
+        assert sum(result.fault_counts.values()) == 0
+
+
+class TestSoak:
+    @pytest.mark.parametrize("seed", CI_SEEDS)
+    def test_seed_converges(self, seed):
+        result = run_sched_seed(seed, ChaosConfig())
+        assert result.ok, result.describe()
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", NIGHTLY_SEEDS)
+    def test_seed_converges_nightly(self, seed):
+        result = run_sched_seed(seed, ChaosConfig())
+        assert result.ok, result.describe()

@@ -1,0 +1,611 @@
+"""Fleet scheduler: queue, preemption policy, fleet model, and the
+reconciler integration (docs/scheduler.md).
+
+Integration tests run the scheduler exactly as shipped: as one more
+reconciler under ``runtime/manager.py`` next to the notebook controller,
+against the in-memory cluster with real Node objects — the bind annotation,
+gang gating, pool pinning, and status conditions are all asserted through
+the store, never through scheduler internals.
+"""
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.controllers.notebook_controller import (
+    ASSIGNED_NODES_ANNOTATION,
+    NotebookReconciler,
+)
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.scheduler import preemption as preempt
+from kubeflow_tpu.scheduler.controller import SchedulerReconciler
+from kubeflow_tpu.scheduler.fleet import Fleet
+from kubeflow_tpu.scheduler.queue import GangQueue, GangRequest
+from kubeflow_tpu.scheduler.soak import make_pool
+from kubeflow_tpu.testing.chaos import ChaosCluster, ChaosConfig
+from kubeflow_tpu.tpu.topology import parse_topology
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import SchedulerMetrics
+from kubeflow_tpu.webapps.jupyter import notebook_status
+
+NS = "team-a"
+
+
+def _req(key, priority=0, queued_at=0.0, topo="2x2x2", accel="v4", slices=1):
+    return GangRequest(
+        key=key,
+        priority=priority,
+        queued_at=queued_at,
+        topo=parse_topology(accel, topo),
+        num_slices=slices,
+    )
+
+
+class TestGangQueue:
+    def test_priority_then_fifo_then_key(self):
+        q = GangQueue()
+        q.push(_req("ns/b", priority=0, queued_at=2.0))
+        q.push(_req("ns/a", priority=0, queued_at=1.0))
+        q.push(_req("ns/hi", priority=5, queued_at=9.0))
+        assert [r.key for r in q.ordered(now=10.0)] == ["ns/hi", "ns/a", "ns/b"]
+
+    def test_aging_lifts_long_waiters_over_fresh_high_priority(self):
+        q = GangQueue(aging_interval_s=10.0)
+        q.push(_req("ns/old", priority=0, queued_at=0.0))
+        q.push(_req("ns/new", priority=2, queued_at=100.0))
+        # at t=100 the old gang has aged 10 classes: outranks priority 2
+        assert [r.key for r in q.ordered(now=100.0)][0] == "ns/old"
+        # freshly arrived it would not have
+        assert [r.key for r in q.ordered(now=5.0)][0] == "ns/new"
+
+    def test_relative_order_is_time_invariant(self):
+        """Continuous aging: two waiting gangs never swap as time passes
+        (their boost difference is constant) — the queue cannot oscillate."""
+        q = GangQueue(aging_interval_s=10.0)
+        q.push(_req("ns/a", priority=1, queued_at=0.0))
+        q.push(_req("ns/b", priority=0, queued_at=3.0))
+        orders = {tuple(r.key for r in q.ordered(now=t)) for t in
+                  (4.0, 50.0, 500.0, 5000.0)}
+        assert len(orders) == 1
+
+    def test_discard_removes_the_gang(self):
+        q = GangQueue()
+        q.push(_req("ns/a"))
+        assert "ns/a" in q and len(q) == 1
+        q.discard("ns/a")
+        assert "ns/a" not in q and len(q) == 0
+        assert q.ordered(now=0.0) == []
+
+
+class TestPreemptionPolicy:
+    def _fleet(self):
+        base = FakeCluster()
+        make_pool(base, "v4", "2x2x4", "p0")  # 4 hosts / 16 chips
+        return Fleet.from_nodes(base.list("Node"))
+
+    def _bound(self, key, priority, queued_at, topo="2x2x2"):
+        t = parse_topology("v4", topo)
+        return preempt.BoundGang(
+            key=key, priority=priority, queued_at=queued_at,
+            chips=t.num_chips, topo=t, num_slices=1,
+        )
+
+    def test_victims_only_strictly_junior(self):
+        head = _req("ns/head", priority=1, queued_at=10.0)
+        assert preempt.eligible_victim(self._bound("ns/lo", 0, 0.0), head)
+        assert not preempt.eligible_victim(self._bound("ns/hi", 2, 99.0), head)
+        # same priority: only later-queued gangs are junior
+        assert preempt.eligible_victim(self._bound("ns/young", 1, 11.0), head)
+        assert not preempt.eligible_victim(self._bound("ns/old", 1, 9.0), head)
+
+    def test_minimal_prefix_lowest_priority_youngest_fewest_chips(self):
+        fleet = self._fleet()
+        a = self._bound("ns/a", 0, 1.0, "2x2x2")  # senior low-prio
+        b = self._bound("ns/b", 0, 5.0, "2x2x2")  # younger: first victim
+        for g in (a, b):
+            assert fleet.place_gang(g.key, g.topo) is not None
+        head = _req("ns/head", priority=1, topo="2x2x2")
+        victims = preempt.select_victims(fleet, [a, b], head)
+        assert [v.key for v in victims] == ["ns/b"]
+        # trial must not have mutated the fleet
+        assert sorted(
+            k for p in fleet.pools.values() for k in p.gang_keys()
+        ) == ["ns/a/s0", "ns/b/s0"]
+
+    def test_no_useless_eviction(self):
+        fleet = self._fleet()
+        a = self._bound("ns/a", 0, 1.0, "2x2x2")
+        assert fleet.place_gang(a.key, a.topo) is not None
+        # head wants the whole 16-chip pool twice over: even evicting
+        # everything cannot fit it, so nothing may be evicted
+        head = _req("ns/head", priority=9, topo="4x4x4")
+        assert preempt.select_victims(fleet, [a], head) is None
+
+    def test_backfill_strictly_smaller_within_window(self):
+        head = _req("ns/head", topo="2x2x4")  # 16 chips
+        small = _req("ns/small", topo="2x2x1", queued_at=1.0)   # 4 chips
+        equal = _req("ns/equal", topo="2x2x4", queued_at=2.0)   # 16 chips
+        order = [head, small, equal]
+        assert [r.key for r in preempt.backfill_candidates(order, head)] == [
+            "ns/small"
+        ]
+        assert preempt.backfill_candidates(order, head, window=0) == []
+
+
+class TestFleetModel:
+    def test_from_nodes_drained_and_missing_hosts_blocked(self):
+        base = FakeCluster()
+        make_pool(base, "v4", "2x2x4", "p0")
+        base.patch("Node", "p0-1", "", {"spec": {"unschedulable": True}})
+        base.delete("Node", "p0-2")
+        fleet = Fleet.from_nodes(base.list("Node"))
+        pool = fleet.pools["p0"]
+        # 4 hosts, 2 unusable: half the chips are blocked
+        assert pool.total_chips == 16
+        assert pool.free_chips() == 8
+        # a 4-host gang no longer fits, a 1-host gang does
+        assert pool.place(parse_topology("v4", "2x2x4")) is None
+        assert pool.place(parse_topology("v4", "2x2x1")) is not None
+
+    def test_feasible_on_empty_ignores_occupancy_and_drains(self):
+        base = FakeCluster()
+        make_pool(base, "v4", "2x2x4", "p0")
+        base.patch("Node", "p0-0", "", {"spec": {"unschedulable": True}})
+        fleet = Fleet.from_nodes(base.list("Node"))
+        full = parse_topology("v4", "2x2x4")
+        # not placeable now (drain), but feasible in principle: Queued, not
+        # Unschedulable
+        assert fleet.place_gang("probe", full) is None
+        assert fleet.feasible_on_empty(full)
+        assert not fleet.feasible_on_empty(parse_topology("v4", "8x8x8"))
+
+
+# --------------------------------------------------------------- integration
+
+
+def _platform(cluster, *, metrics=None, clock=None, aging=300.0):
+    cfg = ControllerConfig(scheduler_enabled=True)
+    m = Manager(cluster, clock=clock)
+    m.register(NotebookReconciler(cfg))
+    kwargs = {"metrics": metrics, "aging_interval_s": aging}
+    if clock is not None:
+        kwargs["clock"] = clock
+    m.register(SchedulerReconciler(**kwargs))
+    return m
+
+
+def _conds(nb):
+    return {
+        c["type"]: c for c in (nb.get("status") or {}).get("conditions", [])
+    }
+
+
+class TestSchedulerReconciler:
+    def test_bind_pins_pool_and_stamps_assigned_nodes(self, cluster):
+        make_pool(cluster, "v4", "4x4x4", "big")
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("nb", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        nb = cluster.get("Notebook", "nb", NS)
+        placement = sched.placement_of(nb)
+        assert placement is not None
+        (s,) = placement["slices"]
+        assert s["pool"] == "big"
+        assert len(s["nodes"]) == 2  # 2-host gang
+        sts = cluster.get("StatefulSet", "nb", NS)
+        assert sts["spec"]["replicas"] == 2
+        sel = sts["spec"]["template"]["spec"]["nodeSelector"]
+        # pinned to the POOL's identity, not the request's free topology
+        assert sel[sched.POOL_LABEL] == "big"
+        assert sel["cloud.google.com/gke-tpu-topology"] == "4x4x4"
+        anns = sts["spec"]["template"]["metadata"]["annotations"]
+        assert "big-0" in anns[ASSIGNED_NODES_ANNOTATION]
+        assert _conds(nb)["Queued"]["status"] == "False"
+
+    def test_gang_gated_at_zero_replicas_until_bound(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")  # 8 chips: holds one gang
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("first", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        cluster.create(api.notebook("second", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        second = cluster.get("Notebook", "second", NS)
+        assert sched.placement_of(second) is None
+        assert cluster.get("StatefulSet", "second", NS)["spec"]["replicas"] == 0
+        q = _conds(second)["Queued"]
+        assert q["status"] == "True" and "position 1 of 1" in q["message"]
+        # no pods were ever created for the queued gang (all-or-nothing)
+        pods = [p for p in cluster.list("Pod", NS)
+                if p["metadata"]["name"].startswith("second")]
+        assert pods == []
+
+    def test_multislice_spreads_across_pools(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "pa")
+        make_pool(cluster, "v4", "2x2x2", "pb")
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("ms", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2", tpu_num_slices=2))
+        cluster.settle(mgr)
+        nb = cluster.get("Notebook", "ms", NS)
+        placement = sched.placement_of(nb)
+        assert placement is not None
+        assert {s["pool"] for s in placement["slices"]} == {"pa", "pb"}
+        for j in range(2):
+            sts = cluster.get("StatefulSet", f"ms-s{j}", NS)
+            assert sts["spec"]["replicas"] == 2
+
+    def test_unschedulable_topology_is_marked_not_queued(self, cluster):
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("huge", NS, tpu_accelerator="v4",
+                                    tpu_topology="8x8x8"))
+        cluster.settle(mgr)
+        nb = cluster.get("Notebook", "huge", NS)
+        conds = _conds(nb)
+        assert conds["Unschedulable"]["status"] == "True"
+        assert "Queued" not in conds
+        assert sched.QUEUED_AT_ANNOTATION not in nb["metadata"]["annotations"]
+
+    def test_stop_while_queued_clears_queue_entry(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("first", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.create(api.notebook("waiting", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        assert sched.QUEUED_AT_ANNOTATION in cluster.get(
+            "Notebook", "waiting", NS)["metadata"]["annotations"]
+        cluster.patch("Notebook", "waiting", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        cluster.settle(mgr)
+        nb = cluster.get("Notebook", "waiting", NS)
+        # the queue entry died with the stop: no ghost capacity claim, no
+        # stale seniority on restart, no leftover conditions
+        assert sched.QUEUED_AT_ANNOTATION not in nb["metadata"]["annotations"]
+        assert "Queued" not in _conds(nb)
+
+    def test_stop_while_bound_releases_capacity_to_next(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("first", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.create(api.notebook("waiting", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        cluster.patch("Notebook", "first", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        cluster.settle(mgr)
+        assert sched.placement_of(cluster.get("Notebook", "first", NS)) is None
+        assert sched.placement_of(
+            cluster.get("Notebook", "waiting", NS)
+        ) is not None
+
+    def test_priority_preempts_and_victim_keeps_seniority(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("victim", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        queued_at = cluster.get("Notebook", "victim", NS)["metadata"][
+            "annotations"][sched.QUEUED_AT_ANNOTATION]
+        cluster.create(api.notebook(
+            "urgent", NS, tpu_accelerator="v4", tpu_topology="2x2x2",
+            annotations={sched.PRIORITY_ANNOTATION: "10"},
+        ))
+        cluster.settle(mgr)
+        urgent = cluster.get("Notebook", "urgent", NS)
+        victim = cluster.get("Notebook", "victim", NS)
+        assert sched.placement_of(urgent) is not None
+        assert sched.placement_of(victim) is None
+        conds = _conds(victim)
+        assert conds["Preempted"]["status"] == "True"
+        assert "urgent" in conds["Preempted"]["message"]
+        assert conds["Queued"]["status"] == "True"
+        # eviction preserved the original admission time (seniority)
+        assert victim["metadata"]["annotations"][
+            sched.QUEUED_AT_ANNOTATION] == queued_at
+        assert cluster.get("StatefulSet", "victim", NS)["spec"]["replicas"] == 0
+
+    def test_backfill_binds_small_gang_behind_blocked_head(self, cluster):
+        make_pool(cluster, "v4", "2x2x4", "p0")  # 16 chips
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("holder", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))  # 8 chips
+        cluster.settle(mgr)
+        # head needs the full pool (blocked by holder); a 1-host gang behind
+        # it fits the hole and must not wait
+        cluster.create(api.notebook("bighead", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x4"))
+        cluster.create(api.notebook("small", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x1"))
+        cluster.settle(mgr)
+        assert sched.placement_of(
+            cluster.get("Notebook", "bighead", NS)) is None
+        assert sched.placement_of(
+            cluster.get("Notebook", "small", NS)) is not None
+
+    def test_running_gang_grandfathered_until_scheduler_speaks(self, cluster):
+        """Enabling the scheduler on a cluster with running gangs must not
+        gate them to zero before the scheduler has ever seen them — that
+        would kill live sessions on upgrade (and forever, if the fleet has
+        no readable TPU labels)."""
+        # gang starts life WITHOUT the scheduler (pre-upgrade state)
+        off = Manager(cluster)
+        off.register(NotebookReconciler(ControllerConfig()))
+        cluster.create(api.notebook("old", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(off)
+        assert cluster.get("StatefulSet", "old", NS)["spec"]["replicas"] == 2
+        off.shutdown()
+        # upgrade: scheduler-enabled notebook controller, scheduler NOT yet
+        # running — the gang must keep its pods
+        on = Manager(cluster)
+        on.register(NotebookReconciler(ControllerConfig(scheduler_enabled=True)))
+        cluster.settle(on)
+        assert cluster.get("StatefulSet", "old", NS)["spec"]["replicas"] == 2
+        on.shutdown()
+        # the scheduler arrives (with a pool): the gang binds and is pinned
+        make_pool(cluster, "v4", "2x2x2", "pool")
+        full = _platform(cluster)
+        cluster.settle(full)
+        nb = cluster.get("Notebook", "old", NS)
+        assert sched.placement_of(nb) is not None
+        assert cluster.get("StatefulSet", "old", NS)["spec"]["replicas"] == 2
+
+    def test_notebook_controller_gates_stale_placement_itself(self, cluster):
+        """A spec.tpu edit can reach the notebook controller before the
+        scheduler's next cycle: it must not run the new shape on the old
+        reservation (partial gangs / host over-subscription)."""
+        make_pool(cluster, "v4", "4x4x4", "big")
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("nb", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        mgr.shutdown()
+        cluster.patch("Notebook", "nb", NS,
+                      {"spec": {"tpu": {"topology": "2x2x4"}}})
+        # only the notebook controller runs (scheduler cycle hasn't yet)
+        nb_only = Manager(cluster)
+        nb_only.register(NotebookReconciler(ControllerConfig(scheduler_enabled=True)))
+        cluster.settle(nb_only)
+        assert cluster.get("StatefulSet", "nb", NS)["spec"]["replicas"] == 0
+
+    def test_unlabeled_pool_not_pinned_via_nodepool_selector(self, cluster):
+        """Nodes without the gke-nodepool label get a synthesized pool name;
+        writing that into a nodeSelector would match no node and leave every
+        pod Pending forever on a real cluster."""
+        cluster.add_tpu_node_pool("v4", "2x2x2")  # fixture: no pool label
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("nb", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        nb = cluster.get("Notebook", "nb", NS)
+        (s,) = sched.placement_of(nb)["slices"]
+        assert s["poolLabeled"] is False
+        sel = cluster.get("StatefulSet", "nb", NS)["spec"]["template"][
+            "spec"]["nodeSelector"]
+        assert sched.POOL_LABEL not in sel
+        # still pinned by the labels the nodes DO carry
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x2x2"
+
+    def test_blocked_head_does_not_starve_other_accelerators(self, cluster):
+        """Heads are per accelerator: a blocked v4 head (even one LARGER
+        than the gang behind it, so backfill never applies) must not hold a
+        v5e gang off an idle v5e pool."""
+        make_pool(cluster, "v4", "2x2x2", "v4pool")   # 8 chips
+        make_pool(cluster, "v5e", "4x8", "v5epool")   # 32 chips, idle
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("holder", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        # v4 head: 4 chips, blocked behind holder; v5e gang: 32 chips (not
+        # strictly smaller than the head, so a global-head policy with
+        # backfill would never even try it)
+        cluster.create(api.notebook("v4head", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x1"))
+        cluster.create(api.notebook("v5egang", NS, tpu_accelerator="v5e",
+                                    tpu_topology="4x8"))
+        cluster.settle(mgr)
+        assert sched.placement_of(
+            cluster.get("Notebook", "v4head", NS)) is None
+        assert sched.placement_of(
+            cluster.get("Notebook", "v5egang", NS)) is not None
+
+    def test_disabling_scheduler_clears_stale_conditions(self, cluster):
+        """An operator turning SCHEDULER_ENABLED off must not strand
+        Queued=True conditions no reconciler will ever clear — they would
+        block the culler and corrupt the UI status forever."""
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("a", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.create(api.notebook("b", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        assert _conds(cluster.get("Notebook", "b", NS))["Queued"]["status"] == "True"
+        mgr.shutdown()
+        # restart with the scheduler off: the notebook controller's status
+        # rewrite is the cleanup path
+        off = Manager(cluster)
+        off.register(NotebookReconciler(ControllerConfig()))
+        cluster.settle(off)
+        for n in ("a", "b"):
+            conds = _conds(cluster.get("Notebook", n, NS))
+            assert not set(conds) & set(sched.SCHEDULER_CONDITION_TYPES)
+
+    def test_node_drain_preempts_and_replaces_gang(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "pa")
+        make_pool(cluster, "v4", "2x2x2", "pb")
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("nb", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        nb = cluster.get("Notebook", "nb", NS)
+        (before,) = sched.placement_of(nb)["slices"]
+        # drain one node of the hosting pool: the placement is invalid
+        victim_node = before["nodes"][0]
+        cluster.patch("Node", victim_node, "", {"spec": {"unschedulable": True}})
+        cluster.settle(mgr)
+        nb = cluster.get("Notebook", "nb", NS)
+        placement = sched.placement_of(nb)
+        assert placement is not None
+        (after,) = placement["slices"]
+        assert after["pool"] != before["pool"]  # re-placed onto the other pool
+
+    def test_capacity_flap_requeues_then_rebinds(self, cluster):
+        nodes = make_pool(cluster, "v4", "2x2x2", "only")
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("nb", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        spec = {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": nodes[0]["metadata"]["name"],
+                         "labels": dict(nodes[0]["metadata"]["labels"])},
+            "status": {"capacity": dict(nodes[0]["status"]["capacity"]),
+                       "conditions": [{"type": "Ready", "status": "True"}]},
+        }
+        cluster.delete("Node", spec["metadata"]["name"])
+        cluster.settle(mgr)
+        nb = cluster.get("Notebook", "nb", NS)
+        assert sched.placement_of(nb) is None
+        assert _conds(nb)["Queued"]["status"] == "True"
+        cluster.create(spec, skip_admission=True)
+        cluster.settle(mgr)
+        assert sched.placement_of(cluster.get("Notebook", "nb", NS)) is not None
+
+    @pytest.mark.parametrize("after_writes", range(1, 9))
+    def test_crash_between_bind_writes_never_double_books(self, after_writes):
+        """Kill the scheduler after its Nth applied write — sweeping N walks
+        the crash through every partial-write boundary of a multi-bind
+        cycle, including between two bind annotations. The restarted
+        incarnation must replay the committed binds and finish the rest with
+        zero double-booking."""
+        from kubeflow_tpu.scheduler.soak import audit_placements
+
+        cluster = FakeCluster()
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        chaos = ChaosCluster(cluster, seed=0, config=ChaosConfig.quiet())
+
+        def scheduler_only():
+            m = Manager(chaos)
+            m.register(SchedulerReconciler())
+            return m
+
+        mgr = scheduler_only()
+        for i in range(3):
+            cluster.create(api.notebook(f"g{i}", NS, tpu_accelerator="v4",
+                                        tpu_topology="2x2x1"))
+        chaos.arm_crash(after_writes=after_writes)
+        try:
+            mgr.tick()
+        except Exception:
+            pass  # crash during watch install: the process died either way
+        chaos.take_crash()
+        # whatever was committed before the crash is already consistent
+        assert audit_placements(cluster) == []
+        mgr.shutdown()
+        mgr = scheduler_only()  # fresh incarnation, no memory of the cycle
+        cluster.settle(mgr)
+        assert audit_placements(cluster) == []
+        for i in range(3):
+            nb = cluster.get("Notebook", f"g{i}", NS)
+            assert sched.placement_of(nb) is not None, f"g{i} never bound"
+
+    def test_spec_edit_while_bound_releases_and_rebinds(self, cluster):
+        """Editing spec.tpu on a bound gang invalidates its committed
+        placement: without the replay-time match check the gang would run
+        at the stale shape forever."""
+        make_pool(cluster, "v4", "4x4x4", "big")
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("nb", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        cluster.patch("Notebook", "nb", NS,
+                      {"spec": {"tpu": {"topology": "2x2x4"}}})
+        cluster.settle(mgr)
+        nb = cluster.get("Notebook", "nb", NS)
+        placement = sched.placement_of(nb)
+        assert placement is not None
+        (s,) = placement["slices"]
+        assert sorted(s["shape"]) == [2, 2, 4]
+        assert cluster.get("StatefulSet", "nb", NS)["spec"]["replicas"] == 4
+
+    def test_controllers_preserve_each_others_conditions(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        mgr = _platform(cluster)
+        cluster.create(api.notebook("a", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.create(api.notebook("b", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr, rounds=8)
+        a, b = (cluster.get("Notebook", n, NS) for n in ("a", "b"))
+        # bound gang: notebook controller's Ready conditions coexist with
+        # the scheduler's Queued=False
+        assert {"Ready", "TPUSliceReady", "Queued"} <= set(_conds(a))
+        # queued gang: controller status rewrites never wiped Queued=True
+        assert _conds(b)["Queued"]["status"] == "True"
+        assert _conds(b)["TPUSliceReady"]["status"] == "False"
+
+    def test_metrics_observe_cycles_binds_and_queue(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        metrics = SchedulerMetrics()
+        mgr = _platform(cluster, metrics=metrics)
+        cluster.create(api.notebook("a", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.create(api.notebook("b", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        assert metrics.binds.get() == 1
+        assert metrics.queue_depth.get() == 1
+        assert metrics.fleet_chips_total.get() == 8
+        assert metrics.fleet_chips_used.get() == 8
+        assert metrics.utilization.get() == 1.0
+        assert metrics.cycles.get() > 0
+        exposition = metrics.registry.expose()
+        assert "scheduler_queue_depth 1" in exposition
+
+
+class TestSpawnerStatusText:
+    def _nb(self, conds):
+        nb = api.notebook("nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2")
+        nb["status"] = {"conditions": conds, "readyReplicas": 0}
+        return nb
+
+    def test_queued_shows_position(self):
+        st = notebook_status(self._nb([
+            {"type": "Queued", "status": "True",
+             "reason": "WaitingForCapacity", "message": "position 3 of 7"},
+        ]), [])
+        assert st["phase"] == "waiting"
+        assert "position 3 of 7" in st["message"]
+
+    def test_unschedulable_says_why(self):
+        st = notebook_status(self._nb([
+            {"type": "Unschedulable", "status": "True",
+             "reason": "NoFittingPool",
+             "message": "no node pool can hold v4-1024"},
+        ]), [])
+        assert st["phase"] == "warning"
+        assert "no node pool can hold v4-1024" in st["message"]
+
+    def test_preempted_keeps_queue_position(self):
+        st = notebook_status(self._nb([
+            {"type": "Queued", "status": "True", "message": "position 1 of 2"},
+            {"type": "Preempted", "status": "True",
+             "message": "preempted by team-a/urgent"},
+        ]), [])
+        assert st["phase"] == "waiting"
+        assert "Preempted" in st["message"]
+        assert "position 1 of 2" in st["message"]
+
+    def test_running_notebook_unaffected(self):
+        nb = self._nb([{"type": "Queued", "status": "False"}])
+        nb["status"]["readyReplicas"] = 2
+        assert notebook_status(nb, [])["phase"] == "ready"

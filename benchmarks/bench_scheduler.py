@@ -61,7 +61,7 @@ class _RecordingMetrics:
 
         self.preemptions = _Ctr(self)
 
-    def observe_cycle(self, fleet, *, queue_depth, unschedulable):
+    def observe_cycle(self, fleet, *, queue_depth, unschedulable, **_kw):
         self.cycles += 1
 
     def observe_bind(self, seconds: float) -> None:

@@ -18,9 +18,19 @@ from kubeflow_tpu.controllers.profile_controller import ProfileReconciler
 from kubeflow_tpu.controllers.tensorboard_controller import TensorboardReconciler
 from kubeflow_tpu.culler import probe
 from kubeflow_tpu.culler.culler import Culler
+from kubeflow_tpu.obs import (
+    EventRecorder,
+    HealthState,
+    Tracer,
+    install_probe_routes,
+)
 from kubeflow_tpu.runtime.manager import Manager
 from kubeflow_tpu.utils.config import ControllerConfig
-from kubeflow_tpu.utils.metrics import NotebookMetrics, SchedulerMetrics
+from kubeflow_tpu.utils.metrics import (
+    ControlPlaneMetrics,
+    NotebookMetrics,
+    SchedulerMetrics,
+)
 from kubeflow_tpu.webapps.base import App
 
 log = logging.getLogger("controller")
@@ -101,6 +111,12 @@ def build_manager(
 ) -> tuple[Manager, NotebookMetrics]:
     cfg = config or ControllerConfig.from_env()
     metrics = NotebookMetrics()
+    # control-plane telemetry (docs/observability.md): reconcile tracing
+    # (/debug/traces), reconcile/queue-wait/apiserver histograms (shared
+    # registry → one /metrics), deduplicated Kubernetes Events
+    tracer = Tracer()
+    cp_metrics = ControlPlaneMetrics(metrics.registry)
+    recorder = EventRecorder()
     culler = Culler(
         enabled=cfg.enable_culling,
         cull_idle_minutes=cfg.cull_idle_minutes,
@@ -108,8 +124,20 @@ def build_manager(
         fetch_kernels=fetch_kernels,
         clock=time.time,
     )
-    manager = Manager(cluster, clock=time.time)
-    manager.register(NotebookReconciler(cfg, culler=culler, metrics=metrics))
+    manager = Manager(
+        cluster, clock=time.time, tracer=tracer, metrics=cp_metrics
+    )
+    if hasattr(cluster, "session"):  # KubeClient: per-verb latency/retries.
+        # NOT cluster.tracer: the Manager already wraps this cluster in a
+        # TracingCluster, so a client-level tracer would double-record every
+        # reconcile write and flag non-reconcile writers (the leader lease
+        # renewal loop) as unattributed forever.
+        cluster.metrics = cp_metrics
+    manager.register(
+        NotebookReconciler(
+            cfg, culler=culler, metrics=metrics, recorder=recorder
+        )
+    )
     manager.register(ProfileReconciler())
     manager.register(TensorboardReconciler(cfg))
     if cfg.scheduler_enabled:
@@ -119,7 +147,10 @@ def build_manager(
         from kubeflow_tpu.scheduler.controller import SchedulerReconciler
 
         manager.register(
-            SchedulerReconciler(metrics=SchedulerMetrics(metrics.registry))
+            SchedulerReconciler(
+                metrics=SchedulerMetrics(metrics.registry),
+                recorder=EventRecorder(),
+            )
         )
     if cfg.enable_oauth_controller:
         # OpenShift companion (ref odh-notebook-controller): the openshift
@@ -171,6 +202,7 @@ def serve_ops(
     port: int = 8081,
     manager: Manager | None = None,
     metrics_port: int = 8080,
+    health: HealthState | None = None,
 ) -> list[threading.Thread]:
     """Ops listeners, split like the reference's bind addresses (main.go:56:
     metrics-addr :8080, probe-addr :8081): probes on ``port`` — the
@@ -188,7 +220,19 @@ def serve_ops(
         threads.append(t)
 
     if port:
-        _spawn(App("controller-probes", csrf_protect=False), port)
+        probes = App("controller-probes", csrf_protect=False)
+        if health is None:
+            health = HealthState()
+        if manager is not None:
+            health.attach_manager(manager)
+        # /healthz + /readyz (live control loop, leader, watch freshness) and
+        # /debug/traces (the manager's reconcile span buffer) ride the probe
+        # port: cluster-internal like the probes, never the gateway
+        install_probe_routes(
+            probes, health,
+            tracer=getattr(manager, "tracer", None) if manager else None,
+        )
+        _spawn(probes, port)
     if metrics_port:
         if manager is not None:
             wq_gauge = metrics.registry.gauge(
@@ -223,6 +267,12 @@ def main() -> None:
     cfg = ControllerConfig.from_env()
     fleet = FleetKernelFetcher(cluster, cfg)
     manager, metrics = build_manager(cluster, cfg, fetch_kernels=fleet)
+    leader_elect = os.environ.get("LEADER_ELECT", "").lower() in ("1", "true")
+    # under election a replica starts as standby (readyz 503 until elected);
+    # without election the single replica is born leader
+    health = HealthState(leader_elected=not leader_elect)
+    if hasattr(cluster, "session"):  # KubeClient: watch-freshness beats
+        cluster.health = health
     ops_port = int(os.environ.get("OPS_PORT", "8081"))
     metrics_port_env = os.environ.get("METRICS_PORT")
     if metrics_port_env is not None:
@@ -232,7 +282,10 @@ def main() -> None:
         # meaning (what the deploy-shape tests pass) instead of surprising
         # them with a bound 8080
         metrics_port = 8080 if ops_port else 0
-    serve_ops(metrics, port=ops_port, manager=manager, metrics_port=metrics_port)
+    serve_ops(
+        metrics, port=ops_port, manager=manager, metrics_port=metrics_port,
+        health=health,
+    )
     if cfg.namespace_labels_path:
         labels_watch = watch_namespace_labels(
             cfg.namespace_labels_path, manager, cluster
@@ -247,9 +300,10 @@ def main() -> None:
     def start_workers():
         manager.run_workers(n_workers, stop)
         reconciling.set()
+        health.set_leader(True)
         log.info("controller manager running with %d workers", n_workers)
 
-    if os.environ.get("LEADER_ELECT", "").lower() in ("1", "true"):
+    if leader_elect:
         # ref main.go:84-91: only the lease holder reconciles; standbys wait.
         from kubeflow_tpu.runtime.leader import LeaderElector
 

@@ -31,7 +31,12 @@ from kubeflow_tpu.api import types as api
 from kubeflow_tpu.culler.culler import Culler, set_stop_annotation, stop_annotation_is_set
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime import reconcilehelper as helper
-from kubeflow_tpu.runtime.fake import Conflict, FakeCluster, NotFound
+from kubeflow_tpu.runtime.fake import (
+    AdmissionDenied,
+    Conflict,
+    FakeCluster,
+    NotFound,
+)
 from kubeflow_tpu.runtime.manager import Reconciler, Result
 from kubeflow_tpu.tpu import topology as tputopo
 from kubeflow_tpu.utils.config import ControllerConfig
@@ -55,10 +60,15 @@ class NotebookReconciler(Reconciler):
         config: ControllerConfig | None = None,
         culler: Culler | None = None,
         metrics=None,
+        recorder=None,
     ) -> None:
         self.config = config or ControllerConfig()
         self.culler = culler
         self.metrics = metrics
+        # EventRecorder (obs/events.py): Created/CreateFailed/Culled become
+        # deduplicated Event objects on the CR — what the spawner's detail
+        # view and `kubectl describe notebook` show users
+        self.recorder = recorder
 
     def watches(self):
         return [
@@ -113,11 +123,25 @@ class NotebookReconciler(Reconciler):
         desired_stses = self.generate_statefulsets(
             nb, topo, num_slices, placement=placement, adopted=adopted
         )
-        for sts in desired_stses:
-            helper.reconcile_object(
-                cluster, sts, owner=nb,
-                copy_fields=helper.copy_statefulset_fields,
+
+        def _created(obj: dict) -> None:
+            self._emit(
+                cluster, nb, "Created",
+                f"Created StatefulSet {ko.name(obj)}",
             )
+
+        for sts in desired_stses:
+            try:
+                helper.reconcile_object(
+                    cluster, sts, owner=nb,
+                    copy_fields=helper.copy_statefulset_fields,
+                    on_create=_created,
+                )
+            except AdmissionDenied as e:
+                # semantic rejection, not a transient fault: surface it to
+                # the user as an Event before the backoff requeue
+                self._emit(cluster, nb, "CreateFailed", str(e), "Warning")
+                raise
         # scale changes (numSlices edited, multislice toggled) must reap the
         # gangs no longer desired — their pods hold a stale DCN contract
         desired_names = {ko.name(sts) for sts in desired_stses}
@@ -509,6 +533,17 @@ class NotebookReconciler(Reconciler):
         if self.metrics is not None:
             self.metrics.observe_notebooks(cluster)
 
+    def _emit(
+        self,
+        cluster: FakeCluster,
+        nb: dict,
+        reason: str,
+        message: str,
+        type_: str = "Normal",
+    ) -> None:
+        if self.recorder is not None:
+            self.recorder.emit(cluster, nb, reason, message, type_)
+
     def _reemit_child_events(self, cluster: FakeCluster, nb: dict) -> None:
         """Mirror Warning events from owned Pods/StatefulSets onto the CR
         (ref go:94-118) so users see scheduling/pull failures in the UI."""
@@ -558,19 +593,29 @@ class NotebookReconciler(Reconciler):
         if nb is None:
             return period
         changed = self.culler.update_last_activity(nb)
+        culled = False
         if self.culler.needs_culling(nb):
             set_stop_annotation(nb, self.culler.clock())
-            changed = True
-            if self.metrics is not None:
-                self.metrics.notebook_culled(ko.namespace(nb))
+            changed = culled = True
             log.info("culling idle notebook %s/%s", namespace, name)
         if changed:
             try:
                 cluster.update(nb)
             except (Conflict, NotFound):
                 # conflict: next requeue retries with a fresh object;
-                # not-found: deleted underneath us, nothing left to cull
-                pass
+                # not-found: deleted underneath us, nothing left to cull.
+                # The cull did NOT commit — no metric, no Event (a raced
+                # stop write must not leave a user-visible "Culled" trail
+                # for a notebook that kept running).
+                return period
+        if culled:
+            if self.metrics is not None:
+                self.metrics.notebook_culled(ko.namespace(nb))
+            self._emit(
+                cluster, nb, "Culled",
+                f"notebook idle past {self.culler.cull_idle_s:.0f}s; "
+                f"scaling gang to zero",
+            )
         return period
 
 

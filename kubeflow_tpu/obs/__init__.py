@@ -1,0 +1,31 @@
+"""Control-plane observability: reconcile tracing, Kubernetes Events, probes.
+
+The reference stack's only telemetry is a three-metric collector
+(``notebook-controller/pkg/metrics/metrics.go``); NotebookOS (PAPERS.md)
+argues interactive notebook platforms live or die on answering "where did my
+session's time go". This package closes the gap for the platform's control
+plane (docs/observability.md):
+
+- ``tracing.py`` — a lightweight span tracer: every watch event gets a trace
+  id, the id rides the workqueue into the reconcile span, and every API
+  write inside the reconcile becomes a child span. Exported as JSON at
+  ``/debug/traces``; the chaos soak audits that NO write is ever
+  unattributed (causality, not just convergence).
+- ``events.py`` — an EventRecorder writing real ``Event`` objects with
+  dedup/aggregation (count bumping via deterministic names, so a
+  crash-restart loop bumps one object instead of storming new ones).
+- ``health.py`` — ``/healthz`` + ``/readyz`` state: leader flag, watch
+  freshness, workqueue liveness.
+"""
+from kubeflow_tpu.obs.events import EventRecorder
+from kubeflow_tpu.obs.health import HealthState, install_probe_routes
+from kubeflow_tpu.obs.tracing import Span, Tracer, TracingCluster
+
+__all__ = [
+    "EventRecorder",
+    "HealthState",
+    "install_probe_routes",
+    "Span",
+    "Tracer",
+    "TracingCluster",
+]

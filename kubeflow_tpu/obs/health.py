@@ -1,0 +1,164 @@
+"""Controller-manager health: /healthz (live) and /readyz (serving traffic).
+
+The reference wires controller-runtime's healthz.Ping into its probe
+address (``main.go:56``); the platform's probes were static 200s — a
+deadlocked manager read as healthy forever. This module makes the probes
+observe the actual control loop:
+
+- **liveness** (``/healthz``): the process is making progress — the
+  workqueue is not deadlocked (depth > 0 while no worker has picked a key
+  up for a full staleness window means the workers are gone or wedged).
+- **readiness** (``/readyz``): this replica is the one doing the work —
+  leader (or no election configured), watches installed, workqueue live.
+  Watch-stream freshness (a beat per delivered event / stream (re)connect)
+  is reported as *detail*, not gated on: an idle cluster legitimately
+  delivers nothing between read-timeout reconnects, and flapping readiness
+  on quiet streams would drain traffic from a healthy replica.
+
+State is pushed by the runtime (``set_leader``, ``beat``, the manager
+snapshot fn) and pulled by the probe routes, so the checks cost nothing
+between scrapes.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable
+
+# a watch stream sends bookmarks/timeouts well inside this window; a beat
+# older than this marks the plane stale (degraded detail, not dead)
+DEFAULT_WATCH_STALE_S = 900.0
+# depth>0 with zero gets for this long = wedged workers
+DEFAULT_QUEUE_STALL_S = 120.0
+
+
+class HealthState:
+    def __init__(
+        self,
+        *,
+        clock: Callable[[], float] = time.time,
+        watch_stale_s: float = DEFAULT_WATCH_STALE_S,
+        queue_stall_s: float = DEFAULT_QUEUE_STALL_S,
+        leader_elected: bool = True,
+    ) -> None:
+        self.clock = clock
+        self.watch_stale_s = watch_stale_s
+        self.queue_stall_s = queue_stall_s
+        self._lock = threading.Lock()
+        # leader_elected=True covers the no-election deployment (the single
+        # replica IS the leader); under LEADER_ELECT the elector flips it
+        self._leader = leader_elected
+        self._beats: dict[str, float] = {}
+        # queue-progress tracking: (last seen gets counter, when it moved)
+        self._queue_gets = -1
+        self._queue_moved_at = 0.0
+        self._manager = None
+
+    # ------------------------------------------------------------- inputs
+
+    def set_leader(self, is_leader: bool) -> None:
+        with self._lock:
+            self._leader = is_leader
+
+    def beat(self, name: str) -> None:
+        """Heartbeat from a watch stream / pacer / sampler."""
+        with self._lock:
+            self._beats[name] = self.clock()
+
+    def attach_manager(self, manager) -> None:
+        """Read workqueue liveness + watch installation off the manager."""
+        with self._lock:
+            self._manager = manager
+            self._queue_moved_at = self.clock()
+
+    # ------------------------------------------------------------- checks
+
+    def _queue_check(self) -> tuple[bool, dict]:
+        mgr = self._manager
+        if mgr is None:
+            return True, {"status": "no manager attached"}
+        qm = mgr.queue_metrics()
+        now = self.clock()
+        with self._lock:
+            if qm["gets"] != self._queue_gets:
+                self._queue_gets = qm["gets"]
+                self._queue_moved_at = now
+            stalled = (
+                qm["depth"] > 0
+                and now - self._queue_moved_at > self.queue_stall_s
+            )
+        detail = {
+            "depth": qm["depth"],
+            "gets": qm["gets"],
+            "status": "stalled" if stalled else "ok",
+        }
+        return not stalled, detail
+
+    def _watch_detail(self) -> dict:
+        now = self.clock()
+        with self._lock:
+            beats = dict(self._beats)
+        return {
+            name: {
+                "ageS": round(now - ts, 1),
+                "status": "stale" if now - ts > self.watch_stale_s else "fresh",
+            }
+            for name, ts in sorted(beats.items())
+        }
+
+    def healthz(self) -> tuple[bool, dict]:
+        """Liveness: restart-worthy only if the control loop is wedged."""
+        ok, queue = self._queue_check()
+        return ok, {"queue": queue, "healthy": ok}
+
+    def readyz(self) -> tuple[bool, dict]:
+        """Readiness: is THIS replica reconciling (leader + watches live)."""
+        with self._lock:
+            leader = self._leader
+            mgr = self._manager
+        watches_started = bool(
+            mgr is not None and getattr(mgr, "watches_started", False)
+        )
+        queue_ok, queue = self._queue_check()
+        ready = leader and watches_started and queue_ok
+        return ready, {
+            "ready": ready,
+            "leader": leader,
+            "watchesStarted": watches_started,
+            "queue": queue,
+            "watchStreams": self._watch_detail(),
+        }
+
+
+def install_probe_routes(app, health: HealthState, tracer=None) -> None:
+    """Mount /healthz, /readyz (and /debug/traces when a tracer is given) on
+    a web App. Plain-text-status + JSON detail, like k8s ?verbose probes."""
+    from werkzeug.wrappers import Response
+
+    def _respond(ok: bool, detail: dict) -> Response:
+        return Response(
+            json.dumps(detail, sort_keys=True),
+            status=200 if ok else 503,
+            mimetype="application/json",
+        )
+
+    @app.route("/healthz")
+    def healthz(request):
+        return _respond(*health.healthz())
+
+    @app.route("/readyz")
+    def readyz(request):
+        return _respond(*health.readyz())
+
+    if tracer is not None:
+
+        @app.route("/debug/traces")
+        def debug_traces(request):
+            try:
+                limit = int(request.args.get("limit", "0")) or None
+            except ValueError:
+                limit = None
+            return Response(
+                tracer.export_json(limit), mimetype="application/json"
+            )

@@ -1,0 +1,390 @@
+"""Reconcile tracing: watch event → workqueue → reconcile → API writes.
+
+The platform's control plane is a pipeline (watch event → workqueue key →
+reconcile → kubeclient writes) with nothing connecting the ends: when a
+notebook sticks Pending, no artifact says WHICH event caused WHICH reconcile
+caused WHICH writes. This module adds that causality spine without an
+OpenTelemetry dependency (not in the image):
+
+- the Manager stamps a fresh **trace id on every watch event** and remembers
+  it against the workqueue key it enqueued (``Manager._pending_traces``);
+- when a worker picks the key up, the Manager opens a **reconcile span**
+  carrying every trace id that funneled into the key (the dedup queue
+  legitimately coalesces N events into one reconcile — the span records all
+  N, which is the honest shape of level-triggered work);
+- every cluster **write inside the reconcile** becomes a child span (verb,
+  kind, key, status, duration) via :class:`TracingCluster`, the same
+  client-surface-wrapper idiom the chaos layer uses;
+- finished spans land in a bounded ring buffer, exported as JSON at
+  ``/debug/traces`` and summarized per kind.
+
+A write with no enclosing reconcile span is recorded as **unattributed** —
+the chaos soak asserts there are none, turning PR 1's convergence proof into
+a causality proof: every mutation the controllers made is explained by an
+event-triggered reconcile.
+
+Span timestamps come from an injectable clock (the soak's virtual clock, so
+traces are deterministic per seed); durations use the same clock, so on the
+virtual clock a span's duration is the *injected* latency, not host jitter.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from typing import Callable, Mapping
+
+# every mutating verb on the shared client surface (FakeCluster, ChaosCluster,
+# KubeClient all expose exactly these)
+WRITE_VERBS = (
+    "create",
+    "update",
+    "update_status",
+    "patch",
+    "strategic_patch",
+    "delete",
+    "finalize",
+    "emit_event",
+)
+
+DEFAULT_CAPACITY = 2048
+MAX_UNATTRIBUTED_SAMPLES = 64
+
+
+class Span:
+    """One finished operation. Flat record, not a tree node — parents are
+    linked by id so the ring buffer can drop ancestors independently."""
+
+    __slots__ = (
+        "trace_ids", "span_id", "parent_id", "name", "kind",
+        "start", "end", "status", "attrs",
+    )
+
+    def __init__(
+        self,
+        *,
+        trace_ids: tuple[str, ...],
+        span_id: str,
+        parent_id: str | None,
+        name: str,
+        kind: str,
+        start: float,
+    ) -> None:
+        self.trace_ids = trace_ids
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.kind = kind  # "reconcile" | "write" | "event"
+        self.start = start
+        self.end = start
+        self.status = "ok"
+        self.attrs: dict = {}
+
+    @property
+    def duration(self) -> float:
+        return max(0.0, self.end - self.start)
+
+    def to_dict(self) -> dict:
+        return {
+            "traceIds": list(self.trace_ids),
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "name": self.name,
+            "kind": self.kind,
+            "start": self.start,
+            "end": self.end,
+            "durationS": self.duration,
+            "status": self.status,
+            "attrs": self.attrs,
+        }
+
+
+class Tracer:
+    """Bounded in-process span store with thread-local span context."""
+
+    def __init__(
+        self,
+        *,
+        capacity: int = DEFAULT_CAPACITY,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self.clock = clock
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []  # ring: oldest evicted first
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+        # audit state: writes recorded with no reconcile span above them
+        self.unattributed_writes = 0
+        self.unattributed_samples: list[dict] = []
+        # monotone counters the audit + /debug/traces summary read
+        self.traces_started = 0
+        self.spans_finished = 0
+        self.spans_dropped = 0
+
+    # ---------------------------------------------------------------- ids
+
+    def _next_id(self, prefix: str) -> str:
+        return f"{prefix}-{next(self._ids):08x}"
+
+    def new_trace(self, origin: str) -> str:
+        """A trace id for one watch event; ``origin`` names the source
+        (e.g. ``watch:Notebook:MODIFIED``) and is kept as an event span so
+        the exported buffer shows the cause even when its reconcile span
+        has been evicted."""
+        with self._lock:
+            self.traces_started += 1
+        trace_id = self._next_id("t")
+        span = Span(
+            trace_ids=(trace_id,),
+            span_id=self._next_id("s"),
+            parent_id=None,
+            name=origin,
+            kind="event",
+            start=self.clock(),
+        )
+        self._finish(span)
+        return trace_id
+
+    # ------------------------------------------------------------- context
+
+    def _stack(self) -> list[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def current_span(self) -> Span | None:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    def start_reconcile(
+        self, kind: str, key: str, trace_ids: tuple[str, ...]
+    ) -> Span:
+        span = Span(
+            trace_ids=trace_ids,
+            span_id=self._next_id("s"),
+            parent_id=None,
+            name=f"reconcile {kind}",
+            kind="reconcile",
+            start=self.clock(),
+        )
+        span.attrs.update({"kind": kind, "key": key, "writes": 0})
+        self._stack().append(span)
+        return span
+
+    def end_reconcile(self, span: Span, outcome: str) -> None:
+        stack = self._stack()
+        if stack and stack[-1] is span:
+            stack.pop()
+        span.end = self.clock()
+        span.attrs["outcome"] = outcome
+        if outcome == "error":
+            span.status = "error"
+        self._finish(span)
+
+    # -------------------------------------------------------------- writes
+
+    def record_write(
+        self,
+        verb: str,
+        *,
+        kind: str,
+        key: str,
+        start: float,
+        status: str,
+        retries: int = 0,
+    ) -> None:
+        parent = self.current_span()
+        span = Span(
+            trace_ids=parent.trace_ids if parent else (),
+            span_id=self._next_id("s"),
+            parent_id=parent.span_id if parent else None,
+            name=f"{verb} {kind}",
+            kind="write",
+            start=start,
+        )
+        span.end = self.clock()
+        span.status = status
+        span.attrs.update(
+            {"verb": verb, "objectKind": kind, "objectKey": key,
+             "retries": retries}
+        )
+        if parent is not None:
+            parent.attrs["writes"] = parent.attrs.get("writes", 0) + 1
+        else:
+            span.attrs["unattributed"] = True
+            with self._lock:
+                self.unattributed_writes += 1
+                if len(self.unattributed_samples) < MAX_UNATTRIBUTED_SAMPLES:
+                    self.unattributed_samples.append(span.to_dict())
+        self._finish(span)
+
+    def _finish(self, span: Span) -> None:
+        with self._lock:
+            self.spans_finished += 1
+            self._spans.append(span)
+            if len(self._spans) > self.capacity:
+                drop = len(self._spans) - self.capacity
+                del self._spans[:drop]
+                self.spans_dropped += drop
+
+    # -------------------------------------------------------------- export
+
+    def export(self, limit: int | None = None) -> list[dict]:
+        with self._lock:
+            spans = self._spans[-limit:] if limit else list(self._spans)
+        return [s.to_dict() for s in spans]
+
+    def summary(self) -> dict:
+        """Per-kind rollup for /debug/traces: reconcile counts/errors/time
+        and write verbs — the "where did the time go" headline without
+        paging through raw spans."""
+        with self._lock:
+            spans = list(self._spans)
+            out = {
+                "spansFinished": self.spans_finished,
+                "spansDropped": self.spans_dropped,
+                "tracesStarted": self.traces_started,
+                "unattributedWrites": self.unattributed_writes,
+                "capacity": self.capacity,
+            }
+        per_kind: dict[str, dict] = {}
+        writes: dict[str, int] = {}
+        for s in spans:
+            if s.kind == "reconcile":
+                k = s.attrs.get("kind", "?")
+                row = per_kind.setdefault(
+                    k, {"count": 0, "errors": 0, "totalS": 0.0, "writes": 0}
+                )
+                row["count"] += 1
+                row["totalS"] += s.duration
+                row["writes"] += s.attrs.get("writes", 0)
+                if s.status == "error":
+                    row["errors"] += 1
+            elif s.kind == "write":
+                writes[s.name] = writes.get(s.name, 0) + 1
+        out["reconciles"] = per_kind
+        out["writeSpans"] = writes
+        return out
+
+    def export_json(self, limit: int | None = None) -> str:
+        return json.dumps(
+            {"summary": self.summary(), "spans": self.export(limit)},
+            sort_keys=True,
+        )
+
+    # --------------------------------------------------------------- audit
+
+    def audit(self) -> list[str]:
+        """Trace-audit invariant (chaos soak): every write span must hang
+        off a reconcile span. Returns human-readable violations."""
+        out: list[str] = []
+        with self._lock:
+            n = self.unattributed_writes
+            samples = list(self.unattributed_samples)
+        if n:
+            heads = ", ".join(
+                f"{s['attrs'].get('verb')} {s['attrs'].get('objectKind')} "
+                f"{s['attrs'].get('objectKey')}"
+                for s in samples[:5]
+            )
+            out.append(
+                f"trace audit: {n} API write(s) not attributable to any "
+                f"reconcile span (first: {heads})"
+            )
+        return out
+
+
+class TracingCluster:
+    """Client-surface wrapper recording a child span per write verb.
+
+    Sits between the Manager's reconcilers and the cluster client (which may
+    itself be the chaos layer wrapping the store — faults inject *below*
+    this wrapper, so a faulted write is recorded with its error status).
+    Reads pass through untraced: the write set is the causality that
+    matters, and tracing every list would dwarf the buffer.
+    """
+
+    def __init__(self, inner, tracer: Tracer) -> None:
+        self.inner = inner
+        self.tracer = tracer
+
+    def __getattr__(self, name):
+        # reads + fixtures (get/list/watch/step_kubelet/...) pass through
+        return getattr(self.inner, name)
+
+    def _traced(self, verb: str, kind: str, key: str, fn, *args, **kw):
+        start = self.tracer.clock()
+        try:
+            out = fn(*args, **kw)
+        except Exception as exc:
+            self.tracer.record_write(
+                verb, kind=kind, key=key, start=start,
+                status=type(exc).__name__,
+            )
+            raise
+        self.tracer.record_write(
+            verb, kind=kind, key=key, start=start, status="ok",
+        )
+        return out
+
+    # one wrapper per write verb (signatures differ; a loop over
+    # WRITE_VERBS would hide them from readers and type checkers)
+
+    def create(self, obj: Mapping, **kw):
+        return self._traced(
+            "create", obj.get("kind", "?"), _obj_key(obj),
+            self.inner.create, obj, **kw,
+        )
+
+    def update(self, obj: Mapping):
+        return self._traced(
+            "update", obj.get("kind", "?"), _obj_key(obj),
+            self.inner.update, obj,
+        )
+
+    def update_status(self, obj: Mapping):
+        return self._traced(
+            "update_status", obj.get("kind", "?"), _obj_key(obj),
+            self.inner.update_status, obj,
+        )
+
+    def patch(self, kind: str, name: str, namespace: str, patch: Mapping):
+        return self._traced(
+            "patch", kind, f"{namespace}/{name}",
+            self.inner.patch, kind, name, namespace, patch,
+        )
+
+    def strategic_patch(
+        self, kind: str, name: str, namespace: str, patch: Mapping
+    ):
+        return self._traced(
+            "strategic_patch", kind, f"{namespace}/{name}",
+            self.inner.strategic_patch, kind, name, namespace, patch,
+        )
+
+    def delete(self, kind: str, name: str, namespace: str = ""):
+        return self._traced(
+            "delete", kind, f"{namespace}/{name}",
+            self.inner.delete, kind, name, namespace,
+        )
+
+    def finalize(self, obj: Mapping):
+        return self._traced(
+            "finalize", obj.get("kind", "?"), _obj_key(obj),
+            self.inner.finalize, obj,
+        )
+
+    def emit_event(self, involved, reason, message, type_="Normal", count=1):
+        return self._traced(
+            "emit_event", "Event", _obj_key(involved),
+            self.inner.emit_event, involved, reason, message, type_, count,
+        )
+
+
+def _obj_key(obj: Mapping) -> str:
+    meta = obj.get("metadata", {}) or {}
+    ns = meta.get("namespace", "")
+    return f"{ns}/{meta.get('name', '')}"

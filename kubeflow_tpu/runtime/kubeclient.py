@@ -109,6 +109,19 @@ RESOURCES: dict[str, tuple[str, str, str, bool]] = {
 }
 
 
+_PLURAL_TO_KIND = {plural: kind for kind, (_, _, plural, _) in RESOURCES.items()}
+
+
+def _path_kind(path: str) -> str:
+    """Best-effort kind from an API path (write-span labeling)."""
+    parts = [p for p in path.split("/") if p]
+    for seg in reversed(parts):
+        kind = _PLURAL_TO_KIND.get(seg)
+        if kind is not None:
+            return kind
+    return "?"
+
+
 def resource_path(
     kind: str,
     namespace: str | None = None,
@@ -155,6 +168,15 @@ class KubeClient:
         # the workqueue believes the key is being processed.
         self.retry_deadline_s = retry_deadline_s
         self.retry_backoff_base = retry_backoff_base
+        # observability hooks (obs/): a ControlPlaneMetrics records per-verb
+        # request latency + transient-retry counts; a Tracer records every
+        # mutating verb as a write span under the current reconcile span; a
+        # HealthState hears a beat per handled watch event / stream
+        # (re)connect. All optional and settable after construction
+        # (cmd/controller.py wires them).
+        self.metrics = None
+        self.tracer = None
+        self.health = None
         if base_url is None:
             # KUBE_API_BASE_URL: out-of-cluster/dev hook (kubeconfig analog)
             # — the deploy-shape smoke points controller processes at the
@@ -179,13 +201,64 @@ class KubeClient:
 
     # ------------------------------------------------------------------ http
 
-    def _request(self, method: str, path: str, *, raw: bool = False, **kw):
+    def _request(
+        self,
+        method: str,
+        path: str,
+        *,
+        raw: bool = False,
+        verb: str | None = None,
+        **kw,
+    ):
         """One logical request = bounded transient-retry loop.
 
         429/5xx and connection resets retry with jittered exponential backoff
         (Retry-After honored exactly on 429) until ``retry_deadline_s`` has
         elapsed, then surface as :class:`RetriesExhausted`. Semantic answers
-        (404/409) and caller bugs (403/422) never retry."""
+        (404/409) and caller bugs (403/422) never retry.
+
+        ``verb`` labels the request for metrics/tracing (create/get/list/...);
+        it defaults to the HTTP method. The whole logical request — retries
+        included — is one latency observation and one write span, matching
+        what a reconcile actually waited for."""
+        if verb is None:
+            verb = method.lower()
+        if self.metrics is None and self.tracer is None:
+            return self._request_inner(method, path, verb, raw=raw, **kw)[0]
+        started = time.monotonic()
+        # span timestamps must come from the TRACER's clock (epoch/virtual) —
+        # mixing a monotonic start with a wall-clock end would yield
+        # billion-second durations
+        span_start = self.tracer.clock() if self.tracer is not None else 0.0
+
+        def done(status: str, attempts: int) -> None:
+            if self.metrics is not None:
+                self.metrics.api_latency.observe(
+                    time.monotonic() - started, verb=verb
+                )
+            if self.tracer is not None and method != "GET":
+                self.tracer.record_write(
+                    verb, kind=_path_kind(path), key=path,
+                    start=span_start, status=status,
+                    retries=max(0, attempts - 1),
+                )
+
+        try:
+            out, attempts = self._request_inner(
+                method, path, verb, raw=raw, **kw
+            )
+        except RetriesExhausted as exc:
+            done("RetriesExhausted", exc.attempts)
+            raise
+        except Exception as exc:
+            done(type(exc).__name__, 1)
+            raise
+        done("ok", attempts)
+        return out
+
+    def _request_inner(
+        self, method: str, path: str, verb: str = "", *, raw: bool = False, **kw
+    ):
         deadline = time.monotonic() + self.retry_deadline_s
         backoff = self.retry_backoff_base
         attempts = 0
@@ -213,11 +286,15 @@ class KubeClient:
                 if resp.status_code not in RETRYABLE_STATUSES:
                     resp.raise_for_status()
                     if raw:  # pod logs: the API returns text, not JSON
-                        return resp.text
-                    return resp.json() if resp.content else {}
+                        return resp.text, attempts
+                    return (resp.json() if resp.content else {}), attempts
                 last_status = resp.status_code
             if time.monotonic() >= deadline:
                 raise RetriesExhausted(path, attempts, last_status)
+            if self.metrics is not None:
+                # counted at retry time (not at completion) so a scrape
+                # mid-outage already shows the churn
+                self.metrics.api_retries.inc(verb=verb or method.lower())
             retry_after = (
                 _retry_after_seconds(resp)
                 if resp is not None and resp.status_code == 429
@@ -240,11 +317,14 @@ class KubeClient:
             resource_path(
                 kind, ko.namespace(obj), api_version=obj.get("apiVersion")
             ),
+            verb="create",
             json=dict(obj),
         )
 
     def get(self, kind: str, name: str, namespace: str = "") -> dict:
-        return self._request("GET", resource_path(kind, namespace, name))
+        return self._request(
+            "GET", resource_path(kind, namespace, name), verb="get"
+        )
 
     def try_get(self, kind: str, name: str, namespace: str = "") -> dict | None:
         try:
@@ -279,7 +359,9 @@ class KubeClient:
             params["labelSelector"] = ",".join(
                 f"{k}={v}" for k, v in selector["matchLabels"].items()
             )
-        out = self._request("GET", resource_path(kind, namespace), params=params)
+        out = self._request(
+            "GET", resource_path(kind, namespace), verb="list", params=params
+        )
         items = out.get("items", [])
         for item in items:  # list items omit kind/apiVersion; restore them
             item.setdefault("kind", kind)
@@ -296,6 +378,7 @@ class KubeClient:
                 kind, ko.namespace(obj), ko.name(obj),
                 api_version=obj.get("apiVersion"),
             ),
+            verb="update",
             json=dict(obj),
         )
 
@@ -309,6 +392,7 @@ class KubeClient:
                 kind, ko.namespace(obj), ko.name(obj),
                 api_version=obj.get("apiVersion"),
             ) + "/status",
+            verb="update_status",
             json=dict(obj),
         )
 
@@ -316,6 +400,7 @@ class KubeClient:
         return self._request(
             "PATCH",
             resource_path(kind, namespace, name),
+            verb="patch",
             json=dict(patch),
             headers={"Content-Type": "application/merge-patch+json"},
         )
@@ -326,12 +411,15 @@ class KubeClient:
         return self._request(
             "PATCH",
             resource_path(kind, namespace, name),
+            verb="patch",
             json=dict(patch),
             headers={"Content-Type": "application/strategic-merge-patch+json"},
         )
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
-        self._request("DELETE", resource_path(kind, namespace, name))
+        self._request(
+            "DELETE", resource_path(kind, namespace, name), verb="delete"
+        )
 
     def finalize(self, obj: Mapping) -> None:
         # real API server completes deletes once finalizers empty; nothing to do
@@ -373,7 +461,8 @@ class KubeClient:
             },
         }
         out = self._request(
-            "POST", resource_path("SubjectAccessReview"), json=sar
+            "POST", resource_path("SubjectAccessReview"), verb="create",
+            json=sar,
         )
         return bool(out.get("status", {}).get("allowed", False))
 
@@ -404,7 +493,9 @@ class KubeClient:
                 error_pause = False
                 try:
                     if rv is None:
-                        listing = self._request("GET", resource_path(kind))
+                        listing = self._request(
+                            "GET", resource_path(kind), verb="list"
+                        )
                         for item in listing.get("items", []):
                             item.setdefault("kind", kind)
                             fn("ADDED", item)
@@ -425,6 +516,10 @@ class KubeClient:
                         continue
                     resp.raise_for_status()  # 403 etc. → backoff path, not a busy loop
                     stream_started = time.monotonic()
+                    if self.health is not None:
+                        # connect counts as freshness: an idle-but-healthy
+                        # stream delivers no events to beat on
+                        self.health.beat(f"watch:{kind}")
                     for line in resp.iter_lines():
                         if self._stop.is_set():
                             return
@@ -448,6 +543,8 @@ class KubeClient:
                             continue
                         obj.setdefault("kind", kind)
                         fn(etype or "MODIFIED", obj)
+                        if self.health is not None:
+                            self.health.beat(f"watch:{kind}")
                         # only a successfully *handled* event proves health —
                         # resetting before fn() would redeliver a poison event
                         # (handler always raises) at 2-4 Hz forever with no

@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+import time
 from typing import Callable, Iterable
 
 from kubeflow_tpu.runtime import objects as ko
@@ -34,6 +35,10 @@ log = logging.getLogger(__name__)
 MapFn = Callable[[dict], Iterable[tuple[str, str]]]  # obj -> (ns, name) keys
 
 _SEP = "\x1f"  # key separator; never appears in k8s names
+
+# the dedup queue coalesces events; keep at most this many trace ids pending
+# per key (the span records "N events funneled here", not an unbounded list)
+_MAX_TRACES_PER_KEY = 8
 
 
 @dataclasses.dataclass
@@ -82,8 +87,28 @@ class Manager:
         # churn (loadtest/churn.py found it: create p50 1.5 s at n=20)
         error_backoff_base: float = 0.005,
         error_backoff_max: float = 64.0,
+        tracer=None,
+        metrics=None,
     ) -> None:
         self.cluster = cluster
+        # reconcile tracing (obs/tracing.py): reconcilers see the traced
+        # client surface so every write they issue lands as a child span of
+        # the reconcile that caused it; the manager's own watch/list plumbing
+        # keeps the raw client (reads are untraced by design)
+        self.tracer = tracer
+        if tracer is not None:
+            from kubeflow_tpu.obs.tracing import TracingCluster
+
+            self._rec_cluster = TracingCluster(cluster, tracer)
+        else:
+            self._rec_cluster = cluster
+        # ControlPlaneMetrics (utils/metrics.py): reconcile duration/outcome
+        # per kind + workqueue queue-wait/retries — controller-runtime's
+        # standard families
+        self.metrics = metrics
+        self._pending_traces: dict[str, list[str]] = {}
+        self._enqueued_at: dict[str, float] = {}
+        self._trace_lock = threading.Lock()
         self._reconcilers: list[Reconciler] = []
         self.error_backoff_max = error_backoff_max
         self._wq = make_workqueue(
@@ -166,6 +191,11 @@ class Manager:
         self._watches_started = False
         self._wq.shutdown()
 
+    @property
+    def watches_started(self) -> bool:
+        """Public view of watch installation (readiness probes read this)."""
+        return self._watches_started
+
     def reconciler_for(self, kind: str) -> Reconciler | None:
         """The registered reconciler for a primary kind (process wiring —
         e.g. the labels-file watcher needs the ProfileReconciler)."""
@@ -174,16 +204,31 @@ class Manager:
                 return rec
         return None
 
+    def _event_trace(self, event: str, obj: dict) -> str | None:
+        """Stamp a trace id on one delivered watch event (tracing's origin
+        point: everything downstream — queue wait, reconcile, writes — links
+        back to this id)."""
+        if self.tracer is None:
+            return None
+        return self.tracer.new_trace(
+            f"watch:{obj.get('kind', '?')}:{event} "
+            f"{ko.namespace(obj)}/{ko.name(obj)}"
+        )
+
     def _primary_handler(self, rec: Reconciler):
         def handle(event: str, obj: dict) -> None:
-            self.enqueue(rec, ko.namespace(obj), ko.name(obj))
+            trace_id = self._event_trace(event, obj)
+            self.enqueue(rec, ko.namespace(obj), ko.name(obj), trace_id)
 
         return handle
 
     def _secondary_handler(self, rec: Reconciler, map_fn: MapFn):
         def handle(event: str, obj: dict) -> None:
+            trace_id = None
             for ns, name in map_fn(obj):
-                self.enqueue(rec, ns, name)
+                if trace_id is None:  # one event = one trace, N mapped keys
+                    trace_id = self._event_trace(event, obj)
+                self.enqueue(rec, ns, name, trace_id)
 
         return handle
 
@@ -196,8 +241,24 @@ class Manager:
         idx, ns, name = key.split(_SEP, 2)
         return self._reconcilers[int(idx)], ns, name
 
-    def enqueue(self, rec: Reconciler, namespace: str, name: str) -> None:
-        self._wq.add(self._key(rec, namespace, name))
+    def enqueue(
+        self,
+        rec: Reconciler,
+        namespace: str,
+        name: str,
+        trace_id: str | None = None,
+    ) -> None:
+        key = self._key(rec, namespace, name)
+        if self.tracer is not None or self.metrics is not None:
+            with self._trace_lock:
+                if trace_id is not None:
+                    pending = self._pending_traces.setdefault(key, [])
+                    if len(pending) < _MAX_TRACES_PER_KEY:
+                        pending.append(trace_id)
+                # queue-wait clock starts at the FIRST add of this round;
+                # re-adds while queued are dedup'd and must not reset it
+                self._enqueued_at.setdefault(key, self.now())
+        self._wq.add(key)
 
     def now(self) -> float:
         if self._clock is not None:
@@ -247,8 +308,23 @@ class Manager:
                 self.concurrency_violations += 1
                 log.error("one-worker-per-key violated for %s", key)
             self._active_keys.add(key)
+        trace_ids: tuple[str, ...] = ()
+        if self.tracer is not None or self.metrics is not None:
+            with self._trace_lock:
+                trace_ids = tuple(self._pending_traces.pop(key, ()))
+                queued_at = self._enqueued_at.pop(key, None)
+            if self.metrics is not None and queued_at is not None:
+                self.metrics.observe_queue_wait(
+                    max(0.0, self.now() - queued_at)
+                )
+        span = (
+            self.tracer.start_reconcile(rec.kind, f"{ns}/{name}", trace_ids)
+            if self.tracer is not None
+            else None
+        )
+        started = time.perf_counter()
         try:
-            result = rec.reconcile(self.cluster, ns, name)
+            result = rec.reconcile(self._rec_cluster, ns, name)
         except Exception:
             log.exception("reconcile %s %s/%s failed", rec.kind, ns, name)
             result = None
@@ -262,8 +338,22 @@ class Manager:
             with self._active_lock:
                 self._active_keys.discard(key)
         if failed:
+            outcome = "error"
+        elif result and result.requeue_after is not None:
+            outcome = "requeue"
+        else:
+            outcome = "success"
+        if span is not None:
+            self.tracer.end_reconcile(span, outcome)
+        if self.metrics is not None:
+            self.metrics.observe_reconcile(
+                rec.kind, time.perf_counter() - started, outcome
+            )
+        if failed:
             self._wq.done(key)
             self._wq.add_rate_limited(key)  # per-key exponential backoff
+            if self.metrics is not None:
+                self.metrics.queue_retries.inc()
             return
         self._wq.forget(key)
         self._wq.done(key)

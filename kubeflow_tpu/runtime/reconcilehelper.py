@@ -21,7 +21,11 @@ def reconcile_object(
     desired: Mapping,
     owner: Mapping | None = None,
     copy_fields: CopyFn | None = None,
+    on_create: Callable[[dict], None] | None = None,
 ) -> dict:
+    """``on_create`` fires only when the object was newly created (not on
+    the update path) — the seam event recording hangs off without every
+    caller re-reading the store to learn what happened."""
     desired = ko.deep_copy(dict(desired))
     if owner is not None:
         ko.set_controller_reference(desired, owner)
@@ -29,7 +33,10 @@ def reconcile_object(
         desired["kind"], ko.name(desired), ko.namespace(desired)
     )
     if existing is None:
-        return cluster.create(desired)
+        created = cluster.create(desired)
+        if on_create is not None:
+            on_create(created)
+        return created
     merged = (copy_fields or copy_spec_fields)(existing, desired)
     if merged is None:
         return existing

@@ -83,12 +83,20 @@ class SchedulerReconciler(Reconciler):
         self,
         *,
         metrics=None,
+        recorder=None,
         clock: Callable[[], float] = time.time,
         aging_interval_s: float = DEFAULT_AGING_INTERVAL_S,
         backfill_window: int = preempt.DEFAULT_BACKFILL_WINDOW,
         resync_s: float = 30.0,
     ) -> None:
         self.metrics = metrics
+        # EventRecorder (obs/events.py): Queued/Bound/Preempted/Unschedulable
+        # become real Event objects users see in the spawner. Emitted only on
+        # TRANSITIONS (first admission, a bind commit, an eviction) — an
+        # every-cycle emit would bump counts once per cycle forever on a
+        # full fleet, which is exactly the write amplification the recorder's
+        # dedup exists to prevent.
+        self.recorder = recorder
         self.clock = clock
         self.aging_interval_s = aging_interval_s
         self.backfill_window = backfill_window
@@ -113,6 +121,7 @@ class SchedulerReconciler(Reconciler):
 
     def _cycle(self, cluster: FakeCluster) -> int:
         """One full scheduling pass. Returns the resulting queue depth."""
+        cycle_started = time.perf_counter()
         now = self.clock()
         fleet = Fleet.from_nodes(cluster.list("Node"))
         notebooks: list[tuple[dict, object, int]] = []
@@ -212,6 +221,14 @@ class SchedulerReconciler(Reconciler):
                     )
                 except (NotFound, Conflict):
                     continue  # deleted/raced: next cycle re-admits
+                # first admission is the transition worth an Event; the
+                # queued-at annotation makes it exactly-once per wait
+                self._emit(
+                    cluster, nb, "Queued",
+                    f"gang admitted to the TPU capacity queue "
+                    f"({topo.slice_name}"
+                    + (f" x{num_slices}" if num_slices > 1 else "") + ")",
+                )
             queue.push(GangRequest(
                 key=key,
                 priority=gang_priority(nb),
@@ -238,6 +255,15 @@ class SchedulerReconciler(Reconciler):
                     "reason": "Bound", "message": "",
                 }])
             elif key in unschedulable:
+                if not (
+                    (condition(nb, COND_UNSCHEDULABLE) or {}).get("status")
+                    == "True"
+                ):
+                    # transition into Unschedulable (not the steady state)
+                    self._emit(
+                        cluster, nb, "Unschedulable", unschedulable[key],
+                        type_="Warning",
+                    )
                 self._write_conditions(cluster, nb, [{
                     "type": COND_UNSCHEDULABLE, "status": "True",
                     "reason": "NoFittingPool",
@@ -272,6 +298,7 @@ class SchedulerReconciler(Reconciler):
                 fleet,
                 queue_depth=len(order),
                 unschedulable=len(unschedulable),
+                duration_s=time.perf_counter() - cycle_started,
             )
         return len(order)
 
@@ -377,6 +404,15 @@ class SchedulerReconciler(Reconciler):
             return  # deleted under us; the fleet model re-derives next cycle
         if self.metrics is not None:
             self.metrics.observe_bind(max(0.0, now - req.queued_at))
+        if self.recorder is not None:
+            nb = cluster.try_get("Notebook", name, ns)
+            if nb is not None:
+                pools = sorted({s.get("pool", "?") for s in slices})
+                self.recorder.emit(
+                    cluster, nb, "Bound",
+                    f"gang bound to pool(s) {', '.join(pools)} after "
+                    f"{max(0.0, now - req.queued_at):.0f}s in queue",
+                )
 
     def _evict(
         self,
@@ -389,6 +425,11 @@ class SchedulerReconciler(Reconciler):
         nb = cluster.try_get("Notebook", name, ns)
         if nb is not None:
             self._unbind(cluster, nb)
+            self._emit(
+                cluster, nb, "Preempted",
+                f"evicted for higher-priority gang {head.key}",
+                type_="Warning",
+            )
         preempted_now[victim.key] = f"preempted by {head.key}"
 
     def _unbind(
@@ -411,6 +452,17 @@ class SchedulerReconciler(Reconciler):
             self._patch_annotations(cluster, nb_obj, anns)
         except NotFound:
             pass
+
+    def _emit(
+        self,
+        cluster: FakeCluster,
+        nb: dict,
+        reason: str,
+        message: str,
+        type_: str = "Normal",
+    ) -> None:
+        if self.recorder is not None:
+            self.recorder.emit(cluster, nb, reason, message, type_)
 
     def _patch_annotations(
         self, cluster: FakeCluster, nb: dict, anns: dict

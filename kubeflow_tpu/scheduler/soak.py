@@ -36,6 +36,8 @@ from kubeflow_tpu import scheduler as sched
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
 from kubeflow_tpu.culler.culler import Culler
+from kubeflow_tpu.obs.events import EventRecorder, audit_events
+from kubeflow_tpu.obs.tracing import Tracer
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import (
     AlreadyExists,
@@ -552,15 +554,24 @@ def run_sched_seed(
         clock=clock,
     )
     metrics = SchedulerMetrics()
+    # one tracer spans the whole run (the trace audit is a run property);
+    # recorders are per-incarnation — a restart loses the dedup hot cache
+    # and must rediscover Events instead of storming new ones
+    tracer = Tracer(clock=clock)
 
     def build() -> Manager:
-        m = Manager(cluster, clock=clock)
-        m.register(NotebookReconciler(cfg, culler=culler))
+        m = Manager(cluster, clock=clock, tracer=tracer)
+        m.register(
+            NotebookReconciler(
+                cfg, culler=culler, recorder=EventRecorder(clock=clock)
+            )
+        )
         # a crash-restart loses every bit of in-memory scheduler state —
         # a fresh reconciler instance models exactly that
         m.register(
             SchedulerReconciler(
                 metrics=metrics,
+                recorder=EventRecorder(clock=clock),
                 clock=clock,
                 aging_interval_s=SOAK_AGING_INTERVAL_S,
             )
@@ -645,6 +656,10 @@ def run_sched_seed(
         )
     )
     violations.extend(audit_fixed_point(base, clock()))
+    # causality + event-storm audits (obs/): every write attributable to a
+    # reconcile span; Event dedup bounded under crash-restart loops
+    violations.extend(tracer.audit())
+    violations.extend(audit_events(base, where="final"))
     return SchedSeedResult(
         seed=seed,
         violations=violations,

@@ -19,8 +19,12 @@ hostile interleavings a first-class, *reproducible* test axis:
   same seed.
 - :func:`run_seed` runs the scenario twice — fault-free and faulted — on the
   virtual clock and asserts the faulted run converges to the fault-free fixed
-  point with every invariant holding throughout. Every decision flows from
-  the seed, so any failure reproduces from its printed seed alone
+  point with every invariant holding throughout, plus two run-level audits
+  (docs/observability.md): the **trace audit** (every API write attributable
+  to an event-triggered reconcile span — causality, not just convergence)
+  and the **bounded-events audit** (Event dedup bumps counts, never
+  multiplies objects, even across crash-restart loops). Every decision flows
+  from the seed, so any failure reproduces from its printed seed alone
   (``python tools/chaos_soak.py --seed N``).
 
 Faults are injected on the *controller-facing* surface only; the harness
@@ -45,6 +49,8 @@ from kubeflow_tpu.controllers.oauth_controller import install_webhook as _instal
 from kubeflow_tpu.controllers.profile_controller import ProfileReconciler
 from kubeflow_tpu.controllers.tensorboard_controller import TensorboardReconciler
 from kubeflow_tpu.culler.culler import Culler
+from kubeflow_tpu.obs.events import EventRecorder, audit_events
+from kubeflow_tpu.obs.tracing import Tracer
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import (
     AlreadyExists,
@@ -681,14 +687,32 @@ def run_scenario(
         clock=clock,
     )
 
+    # ONE tracer across controller restarts: the trace-audit invariant is a
+    # property of the whole run (every write attributable), and the span
+    # buffer is an observer, not controller state — but each incarnation gets
+    # a FRESH EventRecorder, because a real restart loses the dedup hot cache
+    # and must rediscover existing Events (AlreadyExists → count bump), which
+    # is exactly the storm-shaped path the bounded-events audit guards.
+    tracer = Tracer(clock=clock)
+
     def build() -> Manager:
-        m = Manager(cluster, clock=clock)
-        m.register(NotebookReconciler(cfg, culler=culler))
+        m = Manager(cluster, clock=clock, tracer=tracer)
+        m.register(
+            NotebookReconciler(
+                cfg, culler=culler, recorder=EventRecorder(clock=clock)
+            )
+        )
         m.register(ProfileReconciler())
         m.register(TensorboardReconciler(cfg))
         m.register(OAuthReconciler())
         return m
 
+    # world construction BEFORE the manager starts: the initial watch sync
+    # must replay pre-existing objects (this call was defined but never made
+    # — the soak was running against a near-empty world, so profiles,
+    # tensorboards, and the initial notebooks never exercised their
+    # controllers until a delete/recreate op happened to fire)
+    scenario.setup(base)
     mgr = build()
     violations: list[str] = []
     restarts = 0
@@ -766,6 +790,12 @@ def run_scenario(
             where="final", final=True,
         )
     )
+    # trace audit: convergence says the state is right; this says every
+    # write that produced it is attributable to an event-triggered reconcile
+    violations.extend(tracer.audit())
+    # bounded events: dedup must bump counts, never multiply objects —
+    # crash-restart loops re-emitting transitions are the storm risk
+    violations.extend(audit_events(base, where="final"))
     return ScenarioRun(
         fingerprint=prev or fingerprint(base),
         violations=violations,

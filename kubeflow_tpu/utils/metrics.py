@@ -1,74 +1,243 @@
 """Prometheus-style metrics with text exposition.
 
 The platform's observability contract mirrors the reference's
-(``notebook-controller/pkg/metrics/metrics.go:13-99``): a live-scraped
-``notebook_running`` gauge plus create/cull counters, exposed in Prometheus
-text format at ``/metrics`` by the web layer. Implemented standalone (no
-prometheus_client in the image) — exposition format is stable and tiny.
+(``notebook-controller/pkg/metrics/metrics.go:13-99``) and extends it with
+controller-runtime's standard families (reconcile duration/outcome, workqueue
+queue-wait, apiserver request latency — docs/observability.md): counters,
+gauges, and cumulative-bucket histograms exposed in Prometheus text format at
+``/metrics`` by the web layer. Implemented standalone (no prometheus_client
+in the image) — exposition format is stable and tiny.
+
+Label discipline: a family's label names are fixed — at registration when
+``labelnames`` is passed, else frozen by the first observation. A later call
+with a different label set raises ``ValueError`` naming both sets (the
+silent-drop/KeyError failure mode this replaces corrupted series invisibly).
 """
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Mapping
+from typing import Mapping, Sequence
+
+# prometheus DefBuckets: tuned for request/reconcile latencies in seconds
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def escape_label_value(v: str) -> str:
+    """Exposition-format label escaping: backslash, quote, newline."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def escape_help(v: str) -> str:
+    """HELP text escaping: backslash and newline (quotes are legal there)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def format_value(v: float) -> str:
+    """Sample value formatting: integers render exactly (counters must not
+    round through %g's 6 significant digits), floats keep full precision."""
+    if v == int(v) and abs(v) < 2**53:
+        return str(int(v))
+    return repr(float(v))
 
 
 class _Metric:
-    def __init__(self, name: str, help_: str, kind: str) -> None:
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        kind: str,
+        labelnames: Sequence[str] | None = None,
+        buckets: Sequence[float] | None = None,
+    ) -> None:
         self.name = name
         self.help = help_
         self.kind = kind
-        self._values: dict[tuple, float] = {}
-        self._label_names: tuple[str, ...] = ()
+        # counters/gauges: key -> float; histograms: key -> [bucket counts...,
+        # +Inf count, sum] (one list per label set, len(buckets) + 2)
+        self._values: dict[tuple, object] = {}
+        # None = not yet frozen; () = frozen unlabeled
+        self._label_names: tuple[str, ...] | None = (
+            tuple(labelnames) if labelnames is not None else None
+        )
+        if kind == "histogram":
+            bs = tuple(sorted(buckets if buckets is not None else DEFAULT_BUCKETS))
+            if not bs:
+                raise ValueError(f"histogram {name!r} needs at least one bucket")
+            self.buckets: tuple[float, ...] = bs
         self._lock = threading.Lock()
 
     def _key(self, labels: Mapping[str, str]) -> tuple:
         names = tuple(sorted(labels))
-        if not self._label_names:
+        if self._label_names is None:
+            # first observation freezes the schema (registration may have
+            # already fixed it via labelnames)
             self._label_names = names
+        elif names != tuple(sorted(self._label_names)):
+            raise ValueError(
+                f"metric {self.name!r} has labels "
+                f"{sorted(self._label_names)}, got {sorted(names)} — a "
+                f"family's label names are fixed at registration/first use"
+            )
         return tuple(labels[n] for n in self._label_names)
 
+    # ------------------------------------------------------ counters/gauges
+
     def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if self.kind == "histogram":
+            raise TypeError(f"{self.name}: use observe() on histograms")
         with self._lock:
             k = self._key(labels)
             self._values[k] = self._values.get(k, 0.0) + amount
 
     def set(self, value: float, **labels: str) -> None:
+        if self.kind == "histogram":
+            raise TypeError(f"{self.name}: use observe() on histograms")
         with self._lock:
             self._values[self._key(labels)] = value
 
     def get(self, **labels: str) -> float:
         with self._lock:
-            return self._values.get(self._key(labels), 0.0)
+            k = self._key(labels)
+            if self.kind == "histogram":
+                cells = self._values.get(k)
+                # observation count (cells hold per-bucket counts + sum)
+                return float(builtins_sum(cells[:-1])) if cells else 0.0
+            return self._values.get(k, 0.0)
 
     def clear(self) -> None:
         with self._lock:
             self._values.clear()
 
-    def samples(self) -> list[dict]:
-        """Public sample view: [{"labels": {...}, "value": v}, ...]."""
+    # ----------------------------------------------------------- histograms
+
+    def observe(self, value: float, **labels: str) -> None:
+        if self.kind != "histogram":
+            raise TypeError(f"{self.name}: observe() is histogram-only")
         with self._lock:
-            return [
-                {"labels": dict(zip(self._label_names, k)), "value": v}
-                for k, v in sorted(self._values.items())
-            ]
+            k = self._key(labels)
+            cells = self._values.get(k)
+            if cells is None:
+                cells = [0] * (len(self.buckets) + 1) + [0.0]
+                self._values[k] = cells
+            i = bisect.bisect_left(self.buckets, value)
+            cells[i] += 1  # non-cumulative per-bucket; cumulated at expose
+            cells[-1] += value
+
+    def sum(self, **labels: str) -> float:
+        with self._lock:
+            cells = self._values.get(self._key(labels))
+            return float(cells[-1]) if cells else 0.0
+
+    def count(self, **labels: str) -> int:
+        with self._lock:
+            cells = self._values.get(self._key(labels))
+            return int(builtins_sum(cells[:-1])) if cells else 0
+
+    def quantile(self, q: float, **labels: str) -> float:
+        """Prometheus histogram_quantile: linear interpolation inside the
+        bucket the q-th observation falls in (the +Inf bucket clamps to the
+        largest finite bound — same convention)."""
+        with self._lock:
+            cells = self._values.get(self._key(labels))
+            if not cells:
+                return 0.0
+            counts = cells[:-1]
+            total = builtins_sum(counts)
+            if total == 0:
+                return 0.0
+            rank = q * total
+            seen = 0.0
+            for i, c in enumerate(counts):
+                seen += c
+                if seen >= rank:
+                    if i >= len(self.buckets):  # +Inf bucket
+                        return self.buckets[-1]
+                    lo = self.buckets[i - 1] if i else 0.0
+                    hi = self.buckets[i]
+                    if c == 0:
+                        return hi
+                    return lo + (hi - lo) * (rank - (seen - c)) / c
+            return self.buckets[-1]
+
+    # ------------------------------------------------------------ exposition
+
+    def samples(self) -> list[dict]:
+        """Public sample view: [{"labels": {...}, "value": v}, ...] (for
+        histograms, value is the observation count and "sum" rides along)."""
+        with self._lock:
+            names = self._label_names or ()
+            out = []
+            for k, v in sorted(self._values.items()):
+                labels = dict(zip(names, k))
+                if self.kind == "histogram":
+                    out.append({
+                        "labels": labels,
+                        "value": builtins_sum(v[:-1]),
+                        "sum": v[-1],
+                    })
+                else:
+                    out.append({"labels": labels, "value": v})
+            return out
+
+    def _labelstr(self, key: tuple, extra: str = "") -> str:
+        names = self._label_names or ()
+        parts = [
+            f'{n}="{escape_label_value(v)}"' for n, v in zip(names, key)
+        ]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
 
     def expose(self) -> str:
         lines = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {escape_help(self.help)}",
             f"# TYPE {self.name} {self.kind}",
         ]
         with self._lock:
+            labeled = bool(self._label_names)
             if not self._values:
-                lines.append(f"{self.name} 0")
+                # an empty UNLABELED counter/gauge still exposes its zero (a
+                # scraper can distinguish "0" from "missing"); a labeled or
+                # histogram family with no series exposes none — the old
+                # bogus unlabeled `name 0` sample was invalid exposition
+                if not labeled and self.kind != "histogram":
+                    lines.append(f"{self.name} 0")
+                return "\n".join(lines)
             for key, val in sorted(self._values.items()):
-                if key:
-                    lbl = ",".join(
-                        f'{n}="{v}"' for n, v in zip(self._label_names, key)
+                if self.kind == "histogram":
+                    cum = 0
+                    for i, bound in enumerate(self.buckets):
+                        cum += val[i]
+                        le = 'le="' + format_value(bound) + '"'
+                        lines.append(
+                            f"{self.name}_bucket{self._labelstr(key, le)} {cum}"
+                        )
+                    cum += val[len(self.buckets)]
+                    inf = 'le="+Inf"'
+                    lines.append(
+                        f"{self.name}_bucket{self._labelstr(key, inf)} {cum}"
                     )
-                    lines.append(f"{self.name}{{{lbl}}} {val:g}")
+                    lines.append(
+                        f"{self.name}_sum{self._labelstr(key)} "
+                        f"{format_value(val[-1])}"
+                    )
+                    lines.append(
+                        f"{self.name}_count{self._labelstr(key)} {cum}"
+                    )
                 else:
-                    lines.append(f"{self.name} {val:g}")
+                    lines.append(
+                        f"{self.name}{self._labelstr(key)} "
+                        f"{format_value(val)}"
+                    )
         return "\n".join(lines)
+
+
+builtins_sum = sum  # _Metric defines .sum(); keep the builtin reachable
 
 
 class Registry:
@@ -81,11 +250,24 @@ class Registry:
         reference's custom-collector idiom, metrics.go:82-99)."""
         self._pre_expose.append(fn)
 
-    def counter(self, name: str, help_: str) -> _Metric:
-        return self._add(_Metric(name, help_, "counter"))
+    def counter(
+        self, name: str, help_: str, labelnames: Sequence[str] | None = None
+    ) -> _Metric:
+        return self._add(_Metric(name, help_, "counter", labelnames))
 
-    def gauge(self, name: str, help_: str) -> _Metric:
-        return self._add(_Metric(name, help_, "gauge"))
+    def gauge(
+        self, name: str, help_: str, labelnames: Sequence[str] | None = None
+    ) -> _Metric:
+        return self._add(_Metric(name, help_, "gauge", labelnames))
+
+    def histogram(
+        self,
+        name: str,
+        help_: str,
+        labelnames: Sequence[str] | None = None,
+        buckets: Sequence[float] | None = None,
+    ) -> _Metric:
+        return self._add(_Metric(name, help_, "histogram", labelnames, buckets))
 
     def _add(self, m: _Metric) -> _Metric:
         # same-name registration returns the existing family (two Apps
@@ -115,19 +297,24 @@ class NotebookMetrics:
     def __init__(self, registry: Registry | None = None) -> None:
         self.registry = registry or Registry()
         self.running = self.registry.gauge(
-            "notebook_running", "Current running notebooks in the cluster"
+            "notebook_running", "Current running notebooks in the cluster",
+            labelnames=("namespace",),
         )
         self.tpu_chips_in_use = self.registry.gauge(
-            "notebook_tpu_chips_in_use", "TPU chips held by running notebooks"
+            "notebook_tpu_chips_in_use", "TPU chips held by running notebooks",
+            labelnames=("namespace",),
         )
         self.created = self.registry.counter(
-            "notebook_create_total", "Total notebooks created"
+            "notebook_create_total", "Total notebooks created",
+            labelnames=("namespace",),
         )
         self.create_failed = self.registry.counter(
-            "notebook_create_failed_total", "Total notebook create failures"
+            "notebook_create_failed_total", "Total notebook create failures",
+            labelnames=("namespace",),
         )
         self.culled = self.registry.counter(
-            "notebook_cull_total", "Total notebooks culled"
+            "notebook_cull_total", "Total notebooks culled",
+            labelnames=("namespace",),
         )
 
     def observe_notebooks(self, cluster) -> None:
@@ -160,16 +347,75 @@ class NotebookMetrics:
         self.culled.inc(namespace=namespace)
 
 
+class ControlPlaneMetrics:
+    """controller-runtime's standard families for the reconcile hot path
+    (docs/observability.md): reconcile duration + outcome per kind
+    (``manager.py``), workqueue queue-wait and retry churn, and per-verb
+    apiserver request latency (``kubeclient.py``). One instance is shared by
+    the manager and the API client so a single /metrics scrape answers
+    "where did the reconcile's time go"."""
+
+    # reconcile/queue-wait spans ms..minutes; apiserver requests ms..seconds
+    RECONCILE_BUCKETS = (
+        0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
+    )
+
+    def __init__(self, registry: Registry | None = None) -> None:
+        self.registry = registry or Registry()
+        self.reconcile_duration = self.registry.histogram(
+            "controller_reconcile_duration_seconds",
+            "Time spent in reconcile(), per primary kind",
+            labelnames=("kind",),
+            buckets=self.RECONCILE_BUCKETS,
+        )
+        self.reconcile_total = self.registry.counter(
+            "controller_reconcile_total",
+            "Reconcile outcomes per kind (success|error|requeue)",
+            labelnames=("kind", "outcome"),
+        )
+        self.queue_wait = self.registry.histogram(
+            "workqueue_queue_wait_seconds",
+            "Time a key waited in the workqueue before a worker picked it up",
+            buckets=self.RECONCILE_BUCKETS,
+        )
+        self.queue_retries = self.registry.counter(
+            "workqueue_retries_total",
+            "Keys re-enqueued through per-key error backoff",
+        )
+        self.api_latency = self.registry.histogram(
+            "apiserver_request_duration_seconds",
+            "Kubernetes API request latency, per verb",
+            labelnames=("verb",),
+        )
+        self.api_retries = self.registry.counter(
+            "apiserver_request_retries_total",
+            "Transient-error retries inside one logical API request, per verb",
+            labelnames=("verb",),
+        )
+
+    def observe_reconcile(self, kind: str, seconds: float, outcome: str) -> None:
+        self.reconcile_duration.observe(seconds, kind=kind)
+        self.reconcile_total.inc(kind=kind, outcome=outcome)
+
+    def observe_queue_wait(self, seconds: float) -> None:
+        self.queue_wait.observe(seconds)
+
+
 class SchedulerMetrics:
     """Fleet-scheduler observability (docs/scheduler.md): queue pressure,
     time-to-bind, fleet utilization, and preemption churn — the four numbers
     an operator needs to answer "why is my notebook still pending".
 
     Shares a registry with :class:`NotebookMetrics` so one /metrics endpoint
-    carries both; time-to-bind is exposed as a cumulative sum + count (+ max)
-    rather than a histogram — the benchmark computes percentiles offline
-    from its own samples, and sum/count is what a rate() query needs.
+    carries both. Time-to-bind is a histogram (`_bucket`/`_sum`/`_count`):
+    `rate(sum)/rate(count)` gives the mean and `histogram_quantile` the
+    tail — the old sum-only counter made both impossible. The max gauge
+    stays: a single pathological wait must survive bucket averaging.
     """
+
+    # queue waits span seconds (idle fleet) to hours (saturated fleet)
+    BIND_BUCKETS = (1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 3600.0, 14400.0)
+    CYCLE_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
 
     def __init__(self, registry: Registry | None = None) -> None:
         self.registry = registry or Registry()
@@ -196,9 +442,10 @@ class SchedulerMetrics:
         self.preemptions = self.registry.counter(
             "scheduler_preemption_total", "Gangs evicted for a senior gang"
         )
-        self.bind_seconds_sum = self.registry.counter(
-            "scheduler_time_to_bind_seconds_sum",
-            "Cumulative queue-admission→bind latency",
+        self.time_to_bind = self.registry.histogram(
+            "scheduler_time_to_bind_seconds",
+            "Queue-admission→bind latency distribution",
+            buckets=self.BIND_BUCKETS,
         )
         self.bind_seconds_max = self.registry.gauge(
             "scheduler_time_to_bind_seconds_max",
@@ -207,17 +454,31 @@ class SchedulerMetrics:
         self.cycles = self.registry.counter(
             "scheduler_cycle_total", "Scheduling cycles run"
         )
+        self.cycle_duration = self.registry.histogram(
+            "scheduler_cycle_duration_seconds",
+            "Wall time of one full scheduling pass",
+            buckets=self.CYCLE_BUCKETS,
+        )
 
-    def observe_cycle(self, fleet, *, queue_depth: int, unschedulable: int) -> None:
+    def observe_cycle(
+        self,
+        fleet,
+        *,
+        queue_depth: int,
+        unschedulable: int,
+        duration_s: float | None = None,
+    ) -> None:
         self.cycles.inc()
         self.queue_depth.set(queue_depth)
         self.unschedulable.set(unschedulable)
         self.fleet_chips_total.set(fleet.total_chips())
         self.fleet_chips_used.set(fleet.used_chips())
         self.utilization.set(fleet.utilization())
+        if duration_s is not None:
+            self.cycle_duration.observe(duration_s)
 
     def observe_bind(self, seconds: float) -> None:
         self.binds.inc()
-        self.bind_seconds_sum.inc(seconds)
+        self.time_to_bind.observe(seconds)
         if seconds > self.bind_seconds_max.get():
             self.bind_seconds_max.set(seconds)

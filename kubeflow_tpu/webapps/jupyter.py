@@ -182,6 +182,25 @@ def create_app(
                 if k not in ("conditions",)
             }
         )
+        # the event stream ON the detail payload (not just /events): the
+        # controllers now record Created/Bound/Queued/Preempted/Culled with
+        # dedup counts — the "what happened to my notebook" timeline the
+        # overview tab renders without a second round trip
+        summary["events"] = [
+            {
+                "reason": e.get("reason", ""),
+                "message": e.get("message", ""),
+                "type": e.get("type", "Normal"),
+                "count": e.get("count", 1),
+                "firstTimestamp": e.get("firstTimestamp", ""),
+                "lastTimestamp": e.get("lastTimestamp", ""),
+                "source": (e.get("source") or {}).get("component", ""),
+            }
+            for e in sorted(
+                events, key=lambda e: (e.get("lastTimestamp") or "",
+                                       e.get("metadata", {}).get("name", ""))
+            )
+        ]
         return success("notebook", summary, raw=nb)
 
     @app.route("/api/namespaces/<namespace>/notebooks/<name>/pod")

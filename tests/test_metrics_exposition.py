@@ -1,0 +1,308 @@
+"""Prometheus exposition-format lint over the platform's combined registry.
+
+A strict scraper rejects an entire /metrics page for one malformed line —
+an invalid label escape or duplicate family silently blinds every dashboard
+at once. This suite scrapes the REAL combined registry (notebook + scheduler
++ control-plane families sharing one Registry, exactly the wiring
+``cmd/controller.py`` ships) through a small grammar validator:
+
+- every line is a well-formed HELP/TYPE/sample;
+- one HELP+TYPE per family, no duplicate families;
+- sample names belong to their family (histograms: ``_bucket``/``_sum``/
+  ``_count`` suffixes only);
+- label values parse under exposition escaping rules;
+- histogram buckets are cumulative-monotone, carry ``le="+Inf"``, and the
+  +Inf bucket equals ``_count``.
+
+Run as the metrics-lint step in ``unit_tests.yaml``.
+"""
+from __future__ import annotations
+
+import re
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.obs import EventRecorder, Tracer
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.utils.metrics import (
+    ControlPlaneMetrics,
+    NotebookMetrics,
+    Registry,
+    SchedulerMetrics,
+)
+
+NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# a sample line: name[{labels}] value  — labels parsed separately
+SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)$"
+)
+# one label under exposition escaping: value may contain \\, \", \n escapes
+LABEL_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\["\\n])*)"'
+)
+
+
+def parse_exposition(text: str) -> dict[str, dict]:
+    """Validating parser: returns {family: {"type", "help", "samples":
+    [(name, labels, value)]}}; raises AssertionError on any grammar breach."""
+    families: dict[str, dict] = {}
+    current: str | None = None
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for lineno, line in enumerate(text.splitlines(), 1):
+        where = f"line {lineno}: {line!r}"
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            assert NAME_RE.match(name), f"bad HELP name, {where}"
+            assert name not in families, f"duplicate family {name}, {where}"
+            families[name] = {"help": help_text, "type": None, "samples": []}
+            current = name
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert name == current, f"TYPE before/without its HELP, {where}"
+            assert kind in ("counter", "gauge", "histogram"), where
+            assert families[name]["type"] is None, f"duplicate TYPE, {where}"
+            families[name]["type"] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment, {where}"
+        m = SAMPLE_RE.match(line)
+        assert m, f"unparseable sample, {where}"
+        sname = m.group("name")
+        assert current is not None, f"sample before any family, {where}"
+        fam = families[current]
+        if fam["type"] == "histogram":
+            assert (
+                sname == current + "_bucket"
+                or sname == current + "_sum"
+                or sname == current + "_count"
+            ), f"sample {sname} not a {current} histogram series, {where}"
+        else:
+            assert sname == current, (
+                f"sample {sname} outside family {current}, {where}"
+            )
+        labels: dict[str, str] = {}
+        raw = m.group("labels")
+        while raw:
+            lm = LABEL_RE.match(raw)
+            assert lm, f"bad label syntax at {raw!r}, {where}"
+            labels[lm.group("name")] = lm.group("value")
+            raw = raw[lm.end():]
+            if raw.startswith(","):
+                raw = raw[1:]
+        value = float(m.group("value"))  # ValueError = invalid sample
+        fam["samples"].append((sname, labels, value))
+    for name, fam in families.items():
+        assert fam["type"] is not None, f"family {name} missing TYPE"
+    return families
+
+
+def check_histograms(families: dict[str, dict]) -> None:
+    for name, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        # group by non-le label set
+        series: dict[tuple, dict] = {}
+        for sname, labels, value in fam["samples"]:
+            key = tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le"
+            ))
+            row = series.setdefault(
+                key, {"buckets": [], "sum": None, "count": None}
+            )
+            if sname.endswith("_bucket"):
+                row["buckets"].append((labels["le"], value))
+            elif sname.endswith("_sum"):
+                row["sum"] = value
+            else:
+                row["count"] = value
+        for key, row in series.items():
+            assert row["buckets"], f"{name}{key}: no buckets"
+            assert row["buckets"][-1][0] == "+Inf", (
+                f"{name}{key}: last bucket must be +Inf"
+            )
+            counts = [v for _, v in row["buckets"]]
+            assert counts == sorted(counts), (
+                f"{name}{key}: buckets not cumulative-monotone: {counts}"
+            )
+            bounds = [float(le) for le, _ in row["buckets"][:-1]]
+            assert bounds == sorted(bounds), (
+                f"{name}{key}: bucket bounds not increasing"
+            )
+            assert row["count"] is not None and row["sum"] is not None, (
+                f"{name}{key}: missing _sum/_count"
+            )
+            assert row["count"] == counts[-1], (
+                f"{name}{key}: +Inf bucket {counts[-1]} != count {row['count']}"
+            )
+
+
+def combined_registry() -> Registry:
+    """The full production wiring: one registry, every family, populated by
+    actually running the control plane (not by poking counters)."""
+    nm = NotebookMetrics()
+    sm = SchedulerMetrics(nm.registry)
+    cpm = ControlPlaneMetrics(nm.registry)
+    wq_gauge = nm.registry.gauge(
+        "workqueue_stat", "Reconcile workqueue counters (native core)"
+    )
+
+    from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+    from kubeflow_tpu.scheduler.controller import SchedulerReconciler
+    from kubeflow_tpu.utils.config import ControllerConfig
+
+    cluster = FakeCluster()
+    cluster.add_tpu_node_pool("v4", "2x2x2")
+    tracer = Tracer()
+    mgr = Manager(cluster, tracer=tracer, metrics=cpm)
+    cfg = ControllerConfig(scheduler_enabled=True)
+    mgr.register(
+        NotebookReconciler(cfg, metrics=nm, recorder=EventRecorder())
+    )
+    mgr.register(
+        SchedulerReconciler(metrics=sm, recorder=EventRecorder())
+    )
+    cluster.create(
+        api.notebook("nb-lint", "team-metrics", tpu_accelerator="v4",
+                     tpu_topology="2x2x2")
+    )
+    cluster.settle(mgr, rounds=4)
+    for k, v in mgr.queue_metrics().items():
+        wq_gauge.set(float(v), stat=k)
+    return nm.registry
+
+
+class TestExpositionFormat:
+    def test_combined_registry_is_valid(self):
+        registry = combined_registry()
+        families = parse_exposition(registry.expose())
+        check_histograms(families)
+        # the acceptance-criteria families are present as histograms
+        for name in (
+            "controller_reconcile_duration_seconds",
+            "workqueue_queue_wait_seconds",
+            "scheduler_time_to_bind_seconds",
+        ):
+            assert families[name]["type"] == "histogram", name
+        # ... and actually carry observations from the settle above
+        assert any(
+            v > 0
+            for s, _, v in families[
+                "controller_reconcile_duration_seconds"]["samples"]
+            if s.endswith("_count")
+        )
+        assert families["apiserver_request_duration_seconds"]["type"] == (
+            "histogram"
+        )
+
+    def test_no_duplicate_families_with_web_apps(self):
+        # two Apps + the domain registries on one registry (the ops-port
+        # sharing pattern): still one HELP/TYPE per family
+        from kubeflow_tpu.webapps.base import App
+
+        nm = NotebookMetrics()
+        ControlPlaneMetrics(nm.registry)
+        App("one", csrf_protect=False, metrics_registry=nm.registry)
+        App("two", csrf_protect=False, metrics_registry=nm.registry)
+        parse_exposition(nm.registry.expose())
+
+    def test_escaping_round_trips(self):
+        reg = Registry()
+        g = reg.gauge("weird", "label escape test", labelnames=("v",))
+        hostile = 'quote:" backslash:\\ newline:\nend'
+        g.set(1, v=hostile)
+        families = parse_exposition(reg.expose())
+        ((_, labels, _),) = families["weird"]["samples"]
+        unescaped = (
+            labels["v"]
+            .replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+        )
+        assert unescaped == hostile
+
+    def test_empty_labeled_family_emits_no_bogus_sample(self):
+        reg = Registry()
+        reg.counter("labeled_total", "never observed", labelnames=("ns",))
+        families = parse_exposition(reg.expose())
+        assert families["labeled_total"]["samples"] == []
+
+    def test_empty_unlabeled_family_still_exposes_zero(self):
+        reg = Registry()
+        reg.counter("bare_total", "zero-valued")
+        families = parse_exposition(reg.expose())
+        assert families["bare_total"]["samples"] == [("bare_total", {}, 0.0)]
+
+
+class TestLabelDiscipline:
+    def test_mismatched_labels_raise_clear_error(self):
+        reg = Registry()
+        c = reg.counter("c_total", "h", labelnames=("namespace",))
+        c.inc(namespace="a")
+        with pytest.raises(ValueError, match="c_total.*namespace"):
+            c.inc(pod="p")  # wrong label name
+        with pytest.raises(ValueError, match="c_total"):
+            c.inc()  # missing label
+
+    def test_first_use_freezes_schema_without_declaration(self):
+        reg = Registry()
+        g = reg.gauge("g", "h")
+        g.set(1, zone="a")
+        with pytest.raises(ValueError):
+            g.set(2)  # unlabeled after labeled first use
+
+    def test_histogram_rejects_counter_verbs(self):
+        reg = Registry()
+        h = reg.histogram("h_seconds", "h")
+        with pytest.raises(TypeError):
+            h.inc()
+        with pytest.raises(TypeError):
+            h.set(1)
+
+
+class TestHistogramSemantics:
+    def test_bucket_boundaries_are_le_inclusive(self):
+        reg = Registry()
+        h = reg.histogram("h_seconds", "h", buckets=(0.1, 1.0))
+        h.observe(0.1)  # exactly on a bound → that bucket (le semantics)
+        families = parse_exposition(reg.expose())
+        samples = {
+            (s, l.get("le")): v
+            for s, l, v in families["h_seconds"]["samples"]
+        }
+        assert samples[("h_seconds_bucket", "0.1")] == 1
+
+    def test_quantile_estimation(self):
+        reg = Registry()
+        h = reg.histogram("q_seconds", "h", buckets=(1.0, 2.0, 4.0, 8.0))
+        for v in (0.5, 1.5, 3.0, 7.0):
+            h.observe(v)
+        assert 0.0 < h.quantile(0.5) <= 2.0
+        assert h.quantile(0.99) <= 8.0
+        assert h.count() == 4
+        assert h.sum() == pytest.approx(12.0)
+
+    def test_time_to_bind_exposes_sum_and_count(self):
+        """ISSUE satellite: rate(sum)/rate(count) must be possible — the old
+        sum-only counter exposed no _count at all."""
+        sm = SchedulerMetrics()
+        sm.observe_bind(12.0)
+        sm.observe_bind(700.0)
+        text = sm.registry.expose()
+        families = parse_exposition(text)
+        samples = {
+            s: v
+            for s, _, v in families["scheduler_time_to_bind_seconds"]["samples"]
+            if not s.endswith("_bucket")
+        }
+        assert samples["scheduler_time_to_bind_seconds_count"] == 2
+        assert samples["scheduler_time_to_bind_seconds_sum"] == (
+            pytest.approx(712.0)
+        )
+        assert sm.bind_seconds_max.get() == 700.0

@@ -1,0 +1,431 @@
+"""Observability layer (kubeflow_tpu/obs/): tracing, events, health.
+
+Three contracts pinned here, each of which the chaos soak then asserts under
+fault schedules (test_chaos.py):
+
+- **causality**: a watch event's trace id survives the workqueue into the
+  reconcile span, and every cluster write inside the reconcile is a child
+  span — a write outside any reconcile is flagged unattributed;
+- **bounded events**: re-emitting the same (object, reason) bumps ONE Event
+  object's count — across recorder restarts (cold cache) too;
+- **honest probes**: /readyz reflects leader+watches, /healthz detects a
+  wedged workqueue, /debug/traces serves the span buffer.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+from werkzeug.test import Client
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.obs.events import EventRecorder, audit_events, event_name
+from kubeflow_tpu.obs.health import HealthState, install_probe_routes
+from kubeflow_tpu.obs.tracing import Tracer, TracingCluster
+from kubeflow_tpu.runtime.fake import Conflict, FakeCluster, ServerError
+from kubeflow_tpu.runtime.manager import Manager, Reconciler, Result
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import ControlPlaneMetrics
+from kubeflow_tpu.webapps.base import App
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------- tracing
+
+
+class TestTracer:
+    def test_watch_event_trace_reaches_reconcile_span(self):
+        cluster = FakeCluster()
+        tracer = Tracer()
+        mgr = Manager(cluster, tracer=tracer)
+        mgr.register(NotebookReconciler(ControllerConfig()))
+        cluster.create(api.notebook("nb", "ns"))
+        mgr.run_until_idle()
+        spans = tracer.export()
+        events = [s for s in spans if s["kind"] == "event"]
+        recs = [s for s in spans if s["kind"] == "reconcile"]
+        assert events and recs
+        # the ADDED event's trace id is carried by a reconcile span
+        nb_event = next(
+            s for s in events if "watch:Notebook:ADDED" in s["name"]
+        )
+        carried = {tid for s in recs for tid in s["traceIds"]}
+        assert nb_event["traceIds"][0] in carried
+
+    def test_writes_are_children_of_reconcile(self):
+        cluster = FakeCluster()
+        tracer = Tracer()
+        mgr = Manager(cluster, tracer=tracer)
+        mgr.register(NotebookReconciler(ControllerConfig()))
+        cluster.create(api.notebook("nb", "ns"))
+        mgr.run_until_idle()
+        writes = [s for s in tracer.export() if s["kind"] == "write"]
+        assert writes, "reconcile created objects; spans must exist"
+        rec_ids = {
+            s["spanId"] for s in tracer.export() if s["kind"] == "reconcile"
+        }
+        assert all(w["parentId"] in rec_ids for w in writes)
+        assert tracer.unattributed_writes == 0
+        assert tracer.audit() == []
+
+    def test_unattributed_write_is_flagged(self):
+        tracer = Tracer()
+        traced = TracingCluster(FakeCluster(), tracer)
+        traced.create(api.notebook("rogue", "ns"))  # no reconcile span open
+        assert tracer.unattributed_writes == 1
+        (violation,) = tracer.audit()
+        assert "unattributed" not in violation or violation  # human text
+        assert "create" in violation and "Notebook" in violation
+
+    def test_coalesced_events_all_carried(self):
+        """The dedup queue collapses N events into one reconcile; the span
+        must carry every funneled trace id (bounded)."""
+        cluster = FakeCluster()
+        tracer = Tracer()
+        mgr = Manager(cluster, tracer=tracer)
+
+        seen = []
+
+        class Rec(Reconciler):
+            kind = "Notebook"
+
+            def reconcile(self, cluster, namespace, name):
+                seen.append((namespace, name))
+                return None
+
+        rec = Rec()
+        mgr.register(rec)
+        # enqueue 3 events for one key before any worker runs
+        for _ in range(3):
+            mgr.enqueue(rec, "ns", "nb", tracer.new_trace("watch:test"))
+        mgr.run_until_idle()
+        span = next(
+            s for s in tracer.export() if s["kind"] == "reconcile"
+        )
+        assert len(span["traceIds"]) == 3
+        assert len(seen) == 1
+
+    def test_ring_buffer_is_bounded(self):
+        tracer = Tracer(capacity=16)
+        for i in range(100):
+            tracer.new_trace(f"watch:{i}")
+        assert len(tracer.export()) == 16
+        assert tracer.spans_dropped == 84
+        assert tracer.spans_finished == 100
+
+    def test_failed_write_records_error_status(self):
+        tracer = Tracer()
+        base = FakeCluster()
+        traced = TracingCluster(base, tracer)
+        base.create(api.notebook("nb", "ns"))
+        nb = base.get("Notebook", "nb", "ns")
+        nb["metadata"]["resourceVersion"] = "999"  # stale → Conflict
+        with pytest.raises(Conflict):
+            traced.update(nb)
+        span = next(s for s in tracer.export() if s["kind"] == "write")
+        assert span["status"] == "Conflict"
+
+    def test_export_json_shape(self):
+        tracer = Tracer()
+        tracer.new_trace("watch:x")
+        out = json.loads(tracer.export_json())
+        assert "summary" in out and "spans" in out
+        assert out["summary"]["tracesStarted"] == 1
+
+
+class TestManagerMetrics:
+    def test_reconcile_outcomes_and_queue_wait(self):
+        cluster = FakeCluster()
+        metrics = ControlPlaneMetrics()
+        mgr = Manager(cluster, metrics=metrics)
+
+        calls = {"n": 0}
+
+        class Flaky(Reconciler):
+            kind = "Notebook"
+
+            def reconcile(self, cluster, namespace, name):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise ServerError("boom")
+                if calls["n"] == 2:
+                    return Result(requeue_after=5.0)
+                return None
+
+        rec = Flaky()
+        mgr.register(rec)
+        mgr.enqueue(rec, "ns", "nb")
+        mgr.run_until_idle()  # error → backoff requeue
+        mgr.advance(1.0)
+        mgr.run_until_idle()  # requeue outcome
+        mgr.advance(6.0)
+        mgr.run_until_idle()  # success
+        assert metrics.reconcile_total.get(kind="Notebook", outcome="error") == 1
+        assert metrics.reconcile_total.get(kind="Notebook", outcome="requeue") == 1
+        assert metrics.reconcile_total.get(kind="Notebook", outcome="success") == 1
+        assert metrics.reconcile_duration.count(kind="Notebook") == 3
+        assert metrics.queue_retries.get() == 1
+        # the first explicit enqueue produced a queue-wait sample
+        assert metrics.queue_wait.count() >= 1
+
+
+# ----------------------------------------------------------------- events
+
+
+class TestEventRecorder:
+    def test_repeat_emits_bump_one_object(self):
+        cluster = FakeCluster()
+        nb = cluster.create(api.notebook("nb", "ns"))
+        rec = EventRecorder(clock=_Clock())
+        for i in range(5):
+            rec.emit(cluster, nb, "Queued", f"position {i}")
+        events = cluster.events_for(nb)
+        assert len(events) == 1
+        assert events[0]["count"] == 5
+        assert events[0]["message"] == "position 4"
+        assert audit_events(cluster) == []
+
+    def test_cold_cache_restart_still_bumps(self):
+        """A crash-restarted controller (fresh recorder, empty cache) must
+        find the existing Event by its deterministic name, not storm."""
+        cluster = FakeCluster()
+        nb = cluster.create(api.notebook("nb", "ns"))
+        EventRecorder(clock=_Clock()).emit(cluster, nb, "Culled", "idle")
+        EventRecorder(clock=_Clock()).emit(cluster, nb, "Culled", "idle")
+        events = cluster.events_for(nb)
+        assert len(events) == 1
+        assert events[0]["count"] == 2
+
+    def test_new_incarnation_gets_new_object(self):
+        cluster = FakeCluster()
+        rec = EventRecorder(clock=_Clock())
+        nb1 = cluster.create(api.notebook("nb", "ns"))
+        rec.emit(cluster, nb1, "Created", "v1")
+        cluster.delete("Notebook", "nb", "ns")
+        nb2 = cluster.create(api.notebook("nb", "ns"))
+        rec.emit(cluster, nb2, "Created", "v2")
+        assert event_name(nb1, "Created", "Normal") != (
+            event_name(nb2, "Created", "Normal")
+        )
+        # per-uid views each see exactly their own event
+        assert len(cluster.events_for(nb2)) == 1
+        assert audit_events(cluster) == []
+
+    def test_transient_failure_is_dropped_not_raised(self):
+        class Flaky:
+            def __init__(self, inner):
+                self.inner = inner
+                self.fail = True
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+            def create(self, obj, **kw):
+                if self.fail:
+                    self.fail = False
+                    raise ServerError("chaos")
+                return self.inner.create(obj, **kw)
+
+        cluster = FakeCluster()
+        nb = cluster.create(api.notebook("nb", "ns"))
+        flaky = Flaky(cluster)
+        rec = EventRecorder(clock=_Clock())
+        rec.emit(flaky, nb, "Created", "m")  # swallowed
+        assert rec.dropped == 1
+        rec.emit(flaky, nb, "Created", "m")  # lands
+        assert len(cluster.events_for(nb)) == 1
+
+    def test_audit_detects_planted_storm(self):
+        cluster = FakeCluster()
+        nb = cluster.create(api.notebook("nb", "ns"))
+        # the raw verb creates one uuid-named object per call — two identical
+        # emits are exactly the storm shape the recorder exists to prevent
+        cluster.emit_event(nb, "Boom", "same message", "Warning")
+        cluster.emit_event(nb, "Boom", "same message", "Warning")
+        violations = audit_events(cluster, where="t")
+        assert violations and "event storm" in violations[0]
+
+
+# ----------------------------------------------------------------- health
+
+
+class TestHealth:
+    def _manager(self):
+        cluster = FakeCluster()
+        mgr = Manager(cluster)
+        mgr.register(NotebookReconciler(ControllerConfig()))
+        return cluster, mgr
+
+    def test_readyz_requires_watches_and_leader(self):
+        clock = _Clock()
+        _, mgr = self._manager()
+        health = HealthState(clock=clock, leader_elected=False)
+        health.attach_manager(mgr)
+        ready, detail = health.readyz()
+        assert not ready and not detail["leader"]
+        health.set_leader(True)
+        ready, detail = health.readyz()
+        assert not ready and not detail["watchesStarted"]
+        mgr.run_until_idle()  # installs watches
+        ready, detail = health.readyz()
+        assert ready, detail
+
+    def test_healthz_detects_stalled_queue(self):
+        clock = _Clock()
+        cluster, mgr = self._manager()
+        health = HealthState(clock=clock, queue_stall_s=60.0)
+        health.attach_manager(mgr)
+        ok, _ = health.healthz()
+        assert ok
+        # a key sits in the queue, no worker ever takes it
+        rec = mgr.reconciler_for("Notebook")
+        mgr.enqueue(rec, "ns", "stuck")
+        ok, _ = health.healthz()
+        assert ok  # within the stall window
+        clock.advance(61.0)
+        ok, detail = health.healthz()
+        assert not ok and detail["queue"]["status"] == "stalled"
+        # progress clears it
+        mgr.run_until_idle()
+        ok, _ = health.healthz()
+        assert ok
+
+    def test_watch_beats_reported(self):
+        clock = _Clock()
+        health = HealthState(clock=clock, watch_stale_s=100.0)
+        health.beat("watch:Notebook")
+        clock.advance(150.0)
+        health.beat("watch:Pod")
+        _, detail = health.readyz()
+        streams = detail["watchStreams"]
+        assert streams["watch:Notebook"]["status"] == "stale"
+        assert streams["watch:Pod"]["status"] == "fresh"
+
+    def test_probe_routes_and_debug_traces(self):
+        cluster, mgr = self._manager()
+        tracer = Tracer()
+        mgr.tracer = tracer
+        tracer.new_trace("watch:test")
+        health = HealthState()
+        health.attach_manager(mgr)
+        app = App("probes", csrf_protect=False)
+        install_probe_routes(app, health, tracer=tracer)
+        client = Client(app)
+        assert client.get("/healthz").status_code == 200
+        r = client.get("/readyz")
+        assert r.status_code == 503  # watches not started yet
+        mgr.run_until_idle()
+        assert client.get("/readyz").status_code == 200
+        traces = client.get("/debug/traces")
+        assert traces.status_code == 200
+        body = json.loads(traces.data)
+        assert body["summary"]["tracesStarted"] == 1
+        assert body["spans"][0]["name"] == "watch:test"
+
+
+# ------------------------------------------------- spawner event surface
+
+
+class TestDetailViewEvents:
+    def test_notebook_detail_carries_deduped_event_stream(self):
+        """The detail payload returns the recorder's events inline (reason,
+        message, count) — the 'what happened to my notebook' timeline."""
+        from kubeflow_tpu.auth.rbac import Authorizer
+        from kubeflow_tpu.webapps.jupyter import create_app
+
+        cluster = FakeCluster()
+        nb = cluster.create(api.notebook("nb", "team-a"))
+        rec = EventRecorder(clock=_Clock())
+        rec.emit(cluster, nb, "Created", "Created StatefulSet nb")
+        rec.emit(cluster, nb, "Queued", "position 2 of 3")
+        rec.emit(cluster, nb, "Queued", "position 1 of 3")
+        app = create_app(
+            cluster, authorizer=Authorizer(cluster, cluster_admins={"a"})
+        )
+        client = Client(app)
+        r = client.get(
+            "/api/namespaces/team-a/notebooks/nb",
+            headers={"kubeflow-userid": "a"},
+        )
+        assert r.status_code == 200, r.data
+        events = json.loads(r.data)["notebook"]["events"]
+        by_reason = {e["reason"]: e for e in events}
+        assert by_reason["Created"]["count"] == 1
+        assert by_reason["Queued"]["count"] == 2
+        assert by_reason["Queued"]["message"] == "position 1 of 3"
+
+
+# ------------------------------------------------------------- kubeclient
+
+
+class _Resp:
+    def __init__(self, status, body=b"{}", headers=None):
+        self.status_code = status
+        self.content = body
+        self.text = body.decode()
+        self.headers = headers or {}
+
+    def json(self):
+        return json.loads(self.text)
+
+    def raise_for_status(self):
+        if self.status_code >= 400:
+            raise RuntimeError(f"http {self.status_code}")
+
+
+class _ScriptedSession:
+    def __init__(self, script):
+        self.script = list(script)
+        self.headers = {}
+
+    def request(self, method, url, **kw):
+        item = self.script.pop(0) if len(self.script) > 1 else self.script[0]
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+class TestKubeClientInstrumentation:
+    def _client(self, script):
+        from kubeflow_tpu.runtime import kubeclient as kc
+
+        client = kc.KubeClient(
+            base_url="https://api:6443", token="t",
+            session=_ScriptedSession(script), retry_deadline_s=2.0,
+        )
+        client.metrics = ControlPlaneMetrics()
+        client.tracer = Tracer()
+        return client
+
+    def test_latency_retries_and_write_span(self, monkeypatch):
+        from kubeflow_tpu.runtime import kubeclient as kc
+
+        monkeypatch.setattr(kc, "_pause", lambda b: None)
+        client = self._client([_Resp(500), _Resp(200, b'{"kind": "Pod"}')])
+        client.create({"kind": "Pod", "metadata": {"name": "p", "namespace": "ns"}})
+        assert client.metrics.api_latency.count(verb="create") == 1
+        assert client.metrics.api_retries.get(verb="create") == 1
+        (span,) = [
+            s for s in client.tracer.export() if s["kind"] == "write"
+        ]
+        assert span["attrs"]["verb"] == "create"
+        assert span["attrs"]["objectKind"] == "Pod"
+        assert span["status"] == "ok"
+        assert span["attrs"]["retries"] == 1
+
+    def test_reads_observe_latency_but_no_write_span(self):
+        client = self._client([_Resp(200, b'{"kind": "Pod"}')])
+        client.get("Pod", "p", "ns")
+        assert client.metrics.api_latency.count(verb="get") == 1
+        assert [s for s in client.tracer.export() if s["kind"] == "write"] == []

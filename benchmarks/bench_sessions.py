@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Session-lifecycle latency benchmark: suspend latency and time-to-resume
+percentiles from the REAL histograms (docs/sessions.md).
+
+Drives N suspend→resume cycles through the shipped stack — notebook
+controller (teardown barrier), sessions controller, snapshot store — on a
+virtual clock, then reads p50/p99 straight off ``session_suspend_seconds``
+and ``session_resume_seconds``: the same numbers a ``histogram_quantile``
+query returns in production, so CI records a suspend/resume latency
+trajectory PRs can be judged against. Wall-clock throughput (cycles/s of
+the whole control-plane machinery) rides along.
+
+    python benchmarks/bench_sessions.py              # 100 sessions
+    python benchmarks/bench_sessions.py --sessions 20
+
+Emits one SESSIONS_BENCH JSON line (consumed by CI artifacts).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from kubeflow_tpu.api import types as api  # noqa: E402
+from kubeflow_tpu.controllers.notebook_controller import (  # noqa: E402
+    NotebookReconciler,
+)
+from kubeflow_tpu.runtime.fake import FakeCluster  # noqa: E402
+from kubeflow_tpu.runtime.manager import Manager  # noqa: E402
+from kubeflow_tpu.sessions.controller import SessionReconciler  # noqa: E402
+from kubeflow_tpu.sessions.store import SnapshotStore  # noqa: E402
+from kubeflow_tpu.testing.sessionstore import (  # noqa: E402
+    FakeObjectStore,
+    FakeSessionAgent,
+)
+from kubeflow_tpu.utils.config import ControllerConfig  # noqa: E402
+from kubeflow_tpu.utils.metrics import SessionMetrics  # noqa: E402
+
+NS = "bench"
+
+
+class _Clock:
+    def __init__(self) -> None:
+        self.t = 1_000_000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+def run(sessions: int) -> dict:
+    cluster = FakeCluster()
+    clock = _Clock()
+    cfg = ControllerConfig(sessions_enabled=True, suspend_deadline_s=120.0)
+    metrics = SessionMetrics()
+    store = SnapshotStore(FakeObjectStore())
+    agent = FakeSessionAgent(cluster)
+    mgr = Manager(cluster, clock=clock)
+    mgr.register(NotebookReconciler(cfg, clock=clock))
+    mgr.register(
+        SessionReconciler(store, agent, config=cfg, metrics=metrics,
+                          clock=clock)
+    )
+    for i in range(sessions):
+        cluster.create(api.notebook(f"nb-{i}", NS))
+
+    def settle(rounds: int = 3, dt: float = 2.0) -> None:
+        for _ in range(rounds):
+            cluster.step_kubelet()
+            mgr.tick()
+            clock.advance(dt)
+
+    settle(rounds=3)
+    agent.tick()  # every session accrues live state worth preserving
+
+    started = time.perf_counter()
+    # suspend the whole fleet (what a capacity crunch or mass cull does)
+    for i in range(sessions):
+        cluster.patch("Notebook", f"nb-{i}", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+    settle(rounds=4)
+    suspend_wall = time.perf_counter() - started
+
+    started = time.perf_counter()
+    for i in range(sessions):
+        cluster.patch("Notebook", f"nb-{i}", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: None}}})
+    settle(rounds=5)
+    resume_wall = time.perf_counter() - started
+
+    suspended = int(sum(s["value"] for s in metrics.suspends.samples()))
+    resumed = int(sum(s["value"] for s in metrics.resumes.samples()))
+    if suspended < sessions or resumed < sessions:
+        raise SystemExit(
+            f"bench world broken: {suspended}/{sessions} suspended, "
+            f"{resumed}/{sessions} resumed"
+        )
+    return {
+        "bench": "SESSIONS_BENCH",
+        "sessions": sessions,
+        "suspends": suspended,
+        "resumes": resumed,
+        # virtual-clock barrier latency (request→commit / start→restored):
+        # the production histogram_quantile numbers
+        "suspend_p50_s": round(metrics.suspend_latency.quantile(0.5), 4),
+        "suspend_p99_s": round(metrics.suspend_latency.quantile(0.99), 4),
+        "resume_p50_s": round(metrics.time_to_resume.quantile(0.5), 4),
+        "resume_p99_s": round(metrics.time_to_resume.quantile(0.99), 4),
+        # wall-clock control-plane throughput of the cycle itself
+        "suspend_cycles_per_s": round(sessions / max(suspend_wall, 1e-9), 1),
+        "resume_cycles_per_s": round(sessions / max(resume_wall, 1e-9), 1),
+    }
+
+
+if __name__ == "__main__":
+    logging.disable(logging.WARNING)
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sessions", type=int, default=100)
+    args = ap.parse_args()
+    print("SESSIONS_BENCH " + json.dumps(run(args.sessions), sort_keys=True))

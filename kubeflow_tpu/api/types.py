@@ -77,6 +77,13 @@ def notebook(
         if not (tpu_accelerator and tpu_topology):
             raise ValueError("spec.tpu requires both accelerator and topology")
         parse_topology(tpu_accelerator, tpu_topology)  # validate early
+        if int(tpu_num_slices) < 1:
+            # reject at construction, not runtime: a clamped-to-1 zero would
+            # silently run a different shape than the user asked for
+            raise ValueError(
+                f"tpu_num_slices must be a positive integer, got "
+                f"{tpu_num_slices!r}"
+            )
         spec["tpu"] = {"accelerator": tpu_accelerator, "topology": tpu_topology}
         if tpu_num_slices > 1:
             # multislice: N identical slices joined over DCN (MEGASCALE)
@@ -125,6 +132,25 @@ def validate_notebook(nb: Mapping) -> list[str]:
             )
         except ValueError as e:
             errors.append(f"spec.tpu: {e}")
+        # numSlices <= 0 / non-integer used to be accepted here and silently
+        # clamped at runtime (notebook_num_slices max(1, ...)): the gang then
+        # ran a different multislice degree than the CR declared. Reject at
+        # validation time with a message that names the field.
+        raw = spec["tpu"].get("numSlices", 1)
+        valid = False
+        if isinstance(raw, int) and not isinstance(raw, bool):
+            valid = raw >= 1
+        elif isinstance(raw, str):
+            # try/int, not str.isdigit(): isdigit() accepts unicode digits
+            # ("²") that int() rejects — a validator must never raise
+            try:
+                valid = int(raw) >= 1
+            except ValueError:
+                valid = False
+        if not valid:
+            errors.append(
+                f"spec.tpu.numSlices must be a positive integer, got {raw!r}"
+            )
     return errors
 
 

@@ -30,6 +30,7 @@ from kubeflow_tpu.utils.metrics import (
     ControlPlaneMetrics,
     NotebookMetrics,
     SchedulerMetrics,
+    SessionMetrics,
 )
 from kubeflow_tpu.webapps.base import App
 
@@ -143,12 +144,39 @@ def build_manager(
     if cfg.scheduler_enabled:
         # fleet scheduler (kubeflow_tpu/scheduler/): gangs bind through its
         # placement annotation; shares the metrics registry so one /metrics
-        # endpoint carries queue depth / time-to-bind / utilization too
+        # endpoint carries queue depth / time-to-bind / utilization too.
+        # With sessions enabled its preemption path runs the suspend
+        # barrier instead of killing victims outright.
         from kubeflow_tpu.scheduler.controller import SchedulerReconciler
 
         manager.register(
             SchedulerReconciler(
                 metrics=SchedulerMetrics(metrics.registry),
+                recorder=EventRecorder(),
+                suspend_deadline_s=(
+                    cfg.suspend_deadline_s if cfg.sessions_enabled else None
+                ),
+            )
+        )
+    if cfg.sessions_enabled:
+        # session lifecycle (kubeflow_tpu/sessions/): suspend/resume state
+        # machine over a write-ahead snapshot store; the culler's stop and
+        # the scheduler's preemption both become resumable suspends
+        from kubeflow_tpu.sessions.controller import (
+            HttpSessionAgent,
+            SessionReconciler,
+        )
+        from kubeflow_tpu.sessions.store import FileObjectStore, SnapshotStore
+
+        store_root = os.environ.get(
+            "SESSIONS_STORE_DIR", "/var/lib/kubeflow-tpu/sessions"
+        )
+        manager.register(
+            SessionReconciler(
+                SnapshotStore(FileObjectStore(store_root)),
+                HttpSessionAgent(cfg.cluster_domain),
+                config=cfg,
+                metrics=SessionMetrics(metrics.registry),
                 recorder=EventRecorder(),
             )
         )

@@ -25,8 +25,10 @@ around a first-class TPU slice:
 from __future__ import annotations
 
 import logging
+import time
 
 from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu import sessions as sess
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.culler.culler import Culler, set_stop_annotation, stop_annotation_is_set
 from kubeflow_tpu.runtime import objects as ko
@@ -61,6 +63,7 @@ class NotebookReconciler(Reconciler):
         culler: Culler | None = None,
         metrics=None,
         recorder=None,
+        clock=None,
     ) -> None:
         self.config = config or ControllerConfig()
         self.culler = culler
@@ -69,6 +72,8 @@ class NotebookReconciler(Reconciler):
         # deduplicated Event objects on the CR — what the spawner's detail
         # view and `kubectl describe notebook` show users
         self.recorder = recorder
+        # the suspend barrier compares the force deadline against this clock
+        self.clock = clock or (culler.clock if culler else time.time)
 
     def watches(self):
         return [
@@ -120,8 +125,49 @@ class NotebookReconciler(Reconciler):
                 for sts in self._owned_statefulsets(cluster, nb)
             )
 
+        # Suspend barrier (sessions/): a stop/cull is a teardown, and with
+        # sessions enabled every teardown is a suspend. THIS controller is
+        # the actor that scales pods away, so THIS controller writes the
+        # suspend request before doing it — a separate watcher would race
+        # the scale-down and lose the session. The gang's pods then stay up
+        # (suspend_hold) until the sessions controller acks a committed
+        # snapshot or the force deadline passes; both are annotations, so a
+        # crash-restart re-derives the hold instead of forgetting it.
+        suspend_hold = False
+        if self.config.sessions_enabled and stop_annotation_is_set(nb):
+            now = self.clock()
+            has_pods = any(
+                (sts.get("spec") or {}).get("replicas", 0) > 0
+                for sts in self._owned_statefulsets(cluster, nb)
+            )
+            # keyed on the REQUEST being absent, not on any session
+            # machinery at all: a stop landing mid-resume (ack/state still
+            # on the CR, no request) must still start a teardown barrier —
+            # gating on session_engaged left that gang in a hold nobody
+            # could ever resolve (the sessions controller parks on stopped
+            # gangs and only a request completes). An existing ack
+            # immediately satisfies suspend_complete, so re-requesting over
+            # a preserved snapshot costs nothing.
+            if has_pods and sess.suspend_request(nb) is None:
+                request = sess.encode_suspend_request(
+                    sess.REASON_STOP, now, self.config.suspend_deadline_s
+                )
+                try:
+                    cluster.patch(
+                        "Notebook", name, namespace,
+                        {"metadata": {"annotations": {
+                            sess.SUSPEND_ANNOTATION: request,
+                        }}},
+                    )
+                except (NotFound, Conflict):
+                    pass  # hold anyway; the request retries next reconcile
+                else:
+                    ko.set_annotation(nb, sess.SUSPEND_ANNOTATION, request)
+            suspend_hold = has_pods and not sess.suspend_complete(nb, now)
+
         desired_stses = self.generate_statefulsets(
-            nb, topo, num_slices, placement=placement, adopted=adopted
+            nb, topo, num_slices, placement=placement, adopted=adopted,
+            suspend_hold=suspend_hold,
         )
 
         def _created(obj: dict) -> None:
@@ -189,6 +235,10 @@ class NotebookReconciler(Reconciler):
         requeue = None
         if self.culler is not None:
             requeue = self._maybe_cull(cluster, namespace, name)
+        if suspend_hold:
+            # the force-deadline crossing has no watch event; poll so a
+            # wedged snapshot cannot hold the teardown past the deadline
+            requeue = min(requeue, 5.0) if requeue is not None else 5.0
         return Result(requeue_after=requeue)
 
     # ------------------------------------------------------------ generators
@@ -200,6 +250,7 @@ class NotebookReconciler(Reconciler):
         num_slices: int = 1,
         placement: dict | None = None,
         adopted: bool = False,
+        suspend_hold: bool = False,
     ) -> list[dict]:
         """One StatefulSet per slice (SURVEY.md §7 stage 3: multislice is N
         identical gangs joined over DCN; slice j's pods are <name>-s<j>-<i>)."""
@@ -212,13 +263,14 @@ class NotebookReconciler(Reconciler):
             return [
                 self.generate_statefulset(
                     nb, topo, placement_slice=slice_placement(0),
-                    adopted=adopted,
+                    adopted=adopted, suspend_hold=suspend_hold,
                 )
             ]
         return [
             self.generate_statefulset(
                 nb, topo, slice_id=j, num_slices=num_slices,
                 placement_slice=slice_placement(j), adopted=adopted,
+                suspend_hold=suspend_hold,
             )
             for j in range(num_slices)
         ]
@@ -232,11 +284,15 @@ class NotebookReconciler(Reconciler):
         num_slices: int = 1,
         placement_slice: dict | None = None,
         adopted: bool = False,
+        suspend_hold: bool = False,
     ) -> dict:
         cfg = self.config
         name, ns = ko.name(nb), ko.namespace(nb)
         sts_name = name if slice_id is None else f"{name}-s{slice_id}"
-        if stop_annotation_is_set(nb):
+        if stop_annotation_is_set(nb) and not suspend_hold:
+            # suspend_hold keeps a stopping gang's pods up until its session
+            # snapshot commits (or the force deadline) — the teardown half
+            # of the suspend barrier (sessions/)
             replicas = 0
         elif topo is not None:
             # Gang gating: under the fleet scheduler a TPU gang holds zero
@@ -592,7 +648,8 @@ class NotebookReconciler(Reconciler):
         period = self.culler.check_period_s
         if nb is None:
             return period
-        changed = self.culler.update_last_activity(nb)
+        warnings: list[str] = []
+        changed = self.culler.update_last_activity(nb, warnings)
         culled = False
         if self.culler.needs_culling(nb):
             set_stop_annotation(nb, self.culler.clock())
@@ -608,6 +665,10 @@ class NotebookReconciler(Reconciler):
                 # stop write must not leave a user-visible "Culled" trail
                 # for a notebook that kept running).
                 return period
+        for w in warnings:
+            # e.g. a hand-edited last-activity the culler had to re-stamp;
+            # emitted only once the repaired annotations actually landed
+            self._emit(cluster, nb, "MalformedAnnotation", w, "Warning")
         if culled:
             if self.metrics is not None:
                 self.metrics.notebook_culled(ko.namespace(nb))

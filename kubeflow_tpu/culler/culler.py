@@ -108,12 +108,20 @@ class Culler:
         except ValueError:
             return True
 
-    def update_last_activity(self, nb: dict) -> bool:
+    def update_last_activity(
+        self, nb: dict, warnings: list[str] | None = None
+    ) -> bool:
         """Probe the coordinator's kernel API and refresh annotations in place.
 
         Returns True if annotations changed. An unreachable server leaves
         last-activity untouched (the server may be culled or still starting;
-        ref behavior at culler.go:217-226).
+        ref behavior at culler.go:217-226). Anomalies found while
+        maintaining annotations (e.g. a hand-edited, unparseable
+        last-activity) are appended to the caller's ``warnings`` list — the
+        reconciler turns them into Warning events; a per-call out-param
+        (not instance state) because one Culler is shared by every
+        reconcile worker, and shared state would misattribute a warning to
+        whichever notebook drained it first.
         """
         now = self.clock()
         anns = ko.annotations(nb)
@@ -126,6 +134,25 @@ class Culler:
             ko.set_annotation(nb, api.LAST_ACTIVITY_CHECK_TS, format_time(now))
             return True
         if api.LAST_ACTIVITY_ANNOTATION not in anns:
+            ko.set_annotation(nb, api.LAST_ACTIVITY_ANNOTATION, format_time(now))
+            ko.set_annotation(nb, api.LAST_ACTIVITY_CHECK_TS, format_time(now))
+            return True
+        try:
+            parse_time(anns[api.LAST_ACTIVITY_ANNOTATION])
+        except ValueError:
+            # A malformed (hand-edited, wrong-format, missing-tz) timestamp
+            # must not wedge the culling loop: unparseable means the idle
+            # clock is unknowable — treat it as missing, re-stamp from now,
+            # and surface the anomaly. (Before this, needs_culling silently
+            # returned False forever: the notebook became unkillable and
+            # held its slice indefinitely.)
+            if warnings is not None:
+                warnings.append(
+                    f"unparseable last-activity annotation "
+                    f"{anns[api.LAST_ACTIVITY_ANNOTATION]!r} (want "
+                    f"{TIME_FORMAT}); re-stamping and restarting the idle "
+                    f"clock"
+                )
             ko.set_annotation(nb, api.LAST_ACTIVITY_ANNOTATION, format_time(now))
             ko.set_annotation(nb, api.LAST_ACTIVITY_CHECK_TS, format_time(now))
             return True

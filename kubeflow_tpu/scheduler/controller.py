@@ -26,6 +26,20 @@ Status surface: ``Queued`` (with queue position), ``Unschedulable`` (no
 pool could ever hold the topology), ``Preempted`` (victim of a higher
 priority gang or a node drain) — preserved by the notebook controller's
 status rewrites and translated by ``webapps/jupyter.py`` for the spawner.
+
+Suspend barrier (``sessions/``, enabled via ``suspend_deadline_s``): the
+preemption path stops killing victims outright. A selected victim gets a
+suspend-request annotation instead of an eviction; its chips stay held (and
+its pods stay up) until the sessions controller acks a committed snapshot —
+or the force deadline passes — and only then does one atomic write release
+the placement *and* retire the spent request, letting the preemptor bind.
+The head stays blocked behind the handoff and backfill is suppressed for
+its accelerator (a backfill into the space the victims are about to free
+would invalidate the eviction trial and strand everyone). Stopped gangs get
+the same courtesy: their chips are not released while the teardown barrier
+still holds their pods. Everything is re-derived from annotations each
+cycle, so a crash between the snapshot commit and the chip release replays
+instead of double-booking (the sessions soak arms exactly that crash).
 """
 from __future__ import annotations
 
@@ -34,6 +48,7 @@ import threading
 import time
 from typing import Callable, Iterable
 
+from kubeflow_tpu import sessions as sess
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import Conflict, FakeCluster, NotFound
@@ -88,6 +103,7 @@ class SchedulerReconciler(Reconciler):
         aging_interval_s: float = DEFAULT_AGING_INTERVAL_S,
         backfill_window: int = preempt.DEFAULT_BACKFILL_WINDOW,
         resync_s: float = 30.0,
+        suspend_deadline_s: float | None = None,
     ) -> None:
         self.metrics = metrics
         # EventRecorder (obs/events.py): Queued/Bound/Preempted/Unschedulable
@@ -101,6 +117,11 @@ class SchedulerReconciler(Reconciler):
         self.aging_interval_s = aging_interval_s
         self.backfill_window = backfill_window
         self.resync_s = resync_s
+        # Suspend barrier (sessions/): None keeps the legacy immediate-evict
+        # preemption; a deadline turns every eviction into a suspend-request
+        # handoff bounded by it (chips release on snapshot ack or deadline,
+        # whichever first).
+        self.suspend_deadline_s = suspend_deadline_s
         # The workqueue already serializes the single key; the lock is a
         # belt-and-braces guard for direct _cycle() callers (bench, tests).
         self._cycle_lock = threading.Lock()
@@ -110,7 +131,12 @@ class SchedulerReconciler(Reconciler):
 
     def reconcile(self, cluster: FakeCluster, namespace: str, name: str) -> Result | None:
         with self._cycle_lock:
-            queue_depth = self._cycle(cluster)
+            queue_depth, barrier_pending = self._cycle(cluster)
+        if barrier_pending:
+            # a force deadline crossing has no watch event to announce it;
+            # poll the handoff tightly so a wedged snapshot can't stall the
+            # preemptor past the deadline
+            return Result(requeue_after=min(self.resync_s, 5.0))
         if queue_depth:
             # aging changes effective priorities over time with no event to
             # announce it; periodic resync keeps a waiting queue honest
@@ -119,9 +145,10 @@ class SchedulerReconciler(Reconciler):
 
     # ----------------------------------------------------------- the cycle
 
-    def _cycle(self, cluster: FakeCluster) -> int:
-        """One full scheduling pass. Returns the resulting queue depth."""
+    def _cycle(self, cluster: FakeCluster) -> tuple[int, bool]:
+        """One full scheduling pass. Returns (queue depth, barrier pending)."""
         cycle_started = time.perf_counter()
+        barrier_pending = False
         now = self.clock()
         fleet = Fleet.from_nodes(cluster.list("Node"))
         notebooks: list[tuple[dict, object, int]] = []
@@ -137,7 +164,10 @@ class SchedulerReconciler(Reconciler):
 
         queue = GangQueue(aging_interval_s=self.aging_interval_s)
         bound: dict[str, BoundGang] = {}
+        nb_by_key = {_nb_key(nb): nb for nb, _, _ in notebooks}
         preempted_now: dict[str, str] = {}  # key -> human reason
+        released: set[str] = set()  # suspend handoffs completed this cycle
+        handoff_accels: set[str] = set()  # accels with a handoff in flight
 
         # -- replay committed placements (deterministic order: bind time
         #    then key, so an overlap after a drain always evicts the same
@@ -154,6 +184,19 @@ class SchedulerReconciler(Reconciler):
                 continue
             key = _nb_key(nb)
             if not _wants_capacity(nb):
+                if (
+                    self.suspend_deadline_s is not None
+                    and not sess.suspend_complete(nb, now)
+                    and not self._gang_scaled_down(cluster, nb, num_slices)
+                ):
+                    # teardown barrier: the gang's pods are still up waiting
+                    # for their snapshot to commit — the chips stay held (a
+                    # release now would bind a second gang onto hosts whose
+                    # pods have not exited). Occupancy failing means the
+                    # capacity itself is gone (drain/flap): nothing to hold.
+                    if fleet.occupy_gang(key, placement["slices"]):
+                        barrier_pending = True
+                        continue
                 # stopped/culled while bound: release the chips and clear
                 # every scheduler mark — a restart re-queues from scratch
                 self._unbind(cluster, nb, drop_queued_at=True)
@@ -165,6 +208,31 @@ class SchedulerReconciler(Reconciler):
                 # the gang at the stale shape forever)
                 self._unbind(cluster, nb)
                 continue
+            request = (
+                sess.suspend_request(nb)
+                if self.suspend_deadline_s is not None
+                else None
+            )
+            if (
+                request is not None
+                and request.get("reason") == sess.REASON_PREEMPTION
+            ):
+                if sess.suspend_complete(nb, now):
+                    # the handoff's commit point: ONE write releases the
+                    # placement and retires the spent request, so a crash on
+                    # either side replays cleanly (chips still held, or
+                    # victim fully queued — never half). The victim keeps
+                    # its queued-at: seniority survives suspension.
+                    self._release_suspended(cluster, nb)
+                    preempted_now[key] = (
+                        "suspended for a higher-priority gang"
+                    )
+                    released.add(key)
+                    continue
+                # barrier holds: the victim keeps its chips until the
+                # snapshot commits or the force deadline passes
+                barrier_pending = True
+                handoff_accels.add(topo.accelerator.name)
             if fleet.occupy_gang(key, placement["slices"]):
                 bound[key] = BoundGang(
                     key=key,
@@ -238,9 +306,30 @@ class SchedulerReconciler(Reconciler):
             ))
 
         # -- scheduling pass ----------------------------------------------
-        newly_bound = self._schedule(
-            cluster, fleet, queue, bound, preempted_now, now
+        # Victims already released while a same-accel handoff is still in
+        # flight (multi-victim preemption resolving ack by ack) carry the
+        # same re-bind hazard as this cycle's releases: their preserved
+        # seniority would grab the partially-freed space back before the
+        # head ever gets all of it. Their Preempted=True condition (kept
+        # until re-bind) identifies them durably across cycles.
+        deferred = set(released)
+        if handoff_accels:
+            for nb, topo, num_slices in notebooks:
+                key = _nb_key(nb)
+                if (
+                    key not in bound
+                    and topo.accelerator.name in handoff_accels
+                    and (condition(nb, COND_PREEMPTED) or {}).get("status")
+                    == "True"
+                ):
+                    deferred.add(key)
+
+        # -- scheduling pass ----------------------------------------------
+        newly_bound, handoffs = self._schedule(
+            cluster, fleet, queue, bound, preempted_now, now, nb_by_key,
+            deferred,
         )
+        barrier_pending = barrier_pending or handoffs
 
         # -- status conditions + metrics ----------------------------------
         order = queue.ordered(now)
@@ -300,7 +389,7 @@ class SchedulerReconciler(Reconciler):
                 unschedulable=len(unschedulable),
                 duration_s=time.perf_counter() - cycle_started,
             )
-        return len(order)
+        return len(order), barrier_pending
 
     def _schedule(
         self,
@@ -310,7 +399,9 @@ class SchedulerReconciler(Reconciler):
         bound: dict[str, BoundGang],
         preempted_now: dict[str, str],
         now: float,
-    ) -> set[str]:
+        nb_by_key: dict[str, dict] | None = None,
+        deferred: set[str] | None = None,
+    ) -> tuple[set[str], bool]:
         """Admission in effective-priority order; preemption for a blocked
         head, then hole-backfill of strictly smaller gangs behind it. Heads
         are PER ACCELERATOR: a blocked v4 head says nothing about v5e
@@ -322,9 +413,29 @@ class SchedulerReconciler(Reconciler):
         before the next decision, so the fleet model and the annotation set
         move in lockstep."""
         newly_bound: set[str] = set()
+        handoffs = False
         order = queue.ordered(now)
+        if deferred:
+            # A suspend-released victim must be considered AFTER the head
+            # that suspended it — its preserved submit time usually
+            # out-ages the preemptor, and in plain aged order it would
+            # re-bind straight into its own freed chips, get re-preempted,
+            # and ping-pong forever (the sessions soak caught this as a
+            # real livelock: thousands of suspend/resume cycles per seed).
+            # The legacy evict path had the same rule implicitly: it bound
+            # the head before appending victims to the order.
+            order = (
+                [r for r in order if r.key not in deferred]
+                + [r for r in order if r.key in deferred]
+            )
         blocked: dict[str, GangRequest] = {}  # accel -> its blocked head
         behind: dict[str, int] = {}  # same-accel entries seen past the head
+        # accelerators whose head is waiting on a suspend handoff: backfill
+        # is suppressed there — the eviction trial proved the head fits in
+        # free+victim space, and a backfill binding into today's free space
+        # would invalidate that proof (victims suspended for nothing, head
+        # still blocked: a livelock the barrier must not introduce)
+        barrier_accels: set[str] = set()
         i = 0
         while i < len(order):
             req = order[i]
@@ -337,6 +448,8 @@ class SchedulerReconciler(Reconciler):
                 # predicate as preempt.backfill_candidates, which the soak's
                 # fixed-point audit re-derives)
                 behind[accel] += 1
+                if accel in barrier_accels:
+                    continue
                 if behind[accel] > self.backfill_window:
                     continue
                 if req.chips >= head.chips:
@@ -359,6 +472,20 @@ class SchedulerReconciler(Reconciler):
             # reaches anyway
             victims = preempt.select_victims(fleet, list(bound.values()), req)
             if victims is not None:
+                if self.suspend_deadline_s is not None:
+                    # suspend barrier: request a suspend on each victim
+                    # instead of evicting. Chips move only after the
+                    # sessions controller acks a committed snapshot (or the
+                    # deadline forces) — the replay phase of a LATER cycle
+                    # performs the release. Until then the head stays
+                    # blocked and its accelerator is backfill-frozen.
+                    if self._request_suspends(cluster, victims, req,
+                                              nb_by_key or {}, now):
+                        handoffs = True
+                    blocked[accel] = req
+                    behind[accel] = 0
+                    barrier_accels.add(accel)
+                    continue
                 for v in victims:
                     self._evict(cluster, v, req, preempted_now)
                     fleet.free_gang(v.key)
@@ -381,7 +508,7 @@ class SchedulerReconciler(Reconciler):
             # backfill-only until capacity changes
             blocked[accel] = req
             behind[accel] = 0
-        return newly_bound
+        return newly_bound, handoffs
 
     # ------------------------------------------------------------- commits
 
@@ -431,6 +558,75 @@ class SchedulerReconciler(Reconciler):
                 type_="Warning",
             )
         preempted_now[victim.key] = f"preempted by {head.key}"
+
+    def _request_suspends(
+        self,
+        cluster: FakeCluster,
+        victims: list[BoundGang],
+        head: GangRequest,
+        nb_by_key: dict[str, dict],
+        now: float,
+    ) -> bool:
+        """Write the suspend request on every selected victim that does not
+        already carry one. Returns True while any victim's handoff is still
+        outstanding (request written or pending)."""
+        outstanding = False
+        for v in victims:
+            vnb = nb_by_key.get(v.key)
+            if vnb is None:
+                continue
+            outstanding = True
+            if sess.suspend_request(vnb) is not None:
+                continue  # already in the barrier; idempotent
+            try:
+                self._patch_annotations(cluster, vnb, {
+                    sess.SUSPEND_ANNOTATION: sess.encode_suspend_request(
+                        sess.REASON_PREEMPTION, now, self.suspend_deadline_s
+                    ),
+                })
+            except (NotFound, Conflict):
+                continue  # raced a delete/write; next cycle retries
+            self._emit(
+                cluster, vnb, "Preempted",
+                f"suspending for higher-priority gang {head.key}; chips "
+                f"hand over once the session snapshot commits",
+                type_="Warning",
+            )
+            if self.metrics is not None:
+                self.metrics.preemptions.inc()
+        return outstanding
+
+    def _release_suspended(self, cluster: FakeCluster, nb: dict) -> None:
+        """The handoff's release: drop the placement AND the spent suspend
+        request in one write (half a release could re-run the suspend
+        forever, or strand an unbound gang inside the barrier). queued-at
+        survives — the victim re-enters the queue with its original submit
+        time, so aging makes resume fast."""
+        try:
+            self._patch_annotations(cluster, nb, {
+                PLACEMENT_ANNOTATION: None,
+                sess.SUSPEND_ANNOTATION: None,
+            })
+        except NotFound:
+            pass
+
+    @staticmethod
+    def _gang_scaled_down(
+        cluster: FakeCluster, nb: dict, num_slices: int
+    ) -> bool:
+        """Has the notebook controller finished tearing the gang's pods
+        down (every slice's StatefulSet at spec.replicas 0)? While it has
+        not, the hosts still run the gang's containers and the chips must
+        not be handed to anyone else."""
+        name, ns = ko.name(nb), ko.namespace(nb)
+        for j in range(max(1, num_slices)):
+            sts_name = name if num_slices <= 1 else f"{name}-s{j}"
+            sts = cluster.try_get("StatefulSet", sts_name, ns)
+            if sts is not None and (
+                (sts.get("spec") or {}).get("replicas", 0) > 0
+            ):
+                return False
+        return True
 
     def _unbind(
         self,

@@ -1,0 +1,194 @@
+"""Session lifecycle: snapshot-backed suspend/resume with a preemption-safe
+handoff.
+
+The platform can take chips away — the fleet scheduler preempts junior gangs
+(``scheduler/preemption.py``) and the culler scales idle gangs to zero — but
+before this package both paths destroyed the user's session: a teardown was a
+kill, and a restart was always cold. This subsystem makes every gang teardown
+a *suspend* and every start a potential *resume*:
+
+- ``store.py``      — durable snapshot store with write-ahead manifest +
+  atomic commit (torn/uncommitted snapshots are never restored — the
+  torn-``latest_step`` discipline from ``utils/checkpoint.py`` at the
+  control-plane layer);
+- ``controller.py`` — the sessions reconciler under ``runtime/manager.py``
+  driving the state machine Running → Suspending → Suspended → Resuming →
+  Running, with every transition carried in CR annotations so a controller
+  crash-restart replays, never forgets (the scheduler's bind-annotation
+  idiom);
+- ``soak.py``       — the seeded chaos soak (``tools/sessions_soak.py``)
+  whose audit proves the no-loss invariant: no gang that acked a snapshot
+  ever restarts cold, and no chips are released before commit or the force
+  deadline.
+
+The suspend barrier protocol (shared with ``scheduler/controller.py`` and
+``controllers/notebook_controller.py``):
+
+1. whoever tears a gang down (scheduler preemption, notebook controller on a
+   stop/cull) writes the **suspend request** annotation instead of killing;
+2. pods stay up and chips stay held while the request is *in flight*;
+3. the sessions controller snapshots the session, commits it to the store,
+   and writes the **snapshot ack** annotation — the commit record;
+4. only then (or after the force deadline) do pods scale to zero and, for a
+   preemption, do chips pass to the preemptor;
+5. a resumed gang re-enters the scheduler queue with its **original submit
+   time** (preserved in the ack), so aging makes resume fast.
+
+This module holds only the wire contract (annotation keys, state names, the
+codecs) shared by the scheduler, notebook controller, culler, and web apps —
+importing it never drags in controller or store internals.
+"""
+from __future__ import annotations
+
+import json
+from typing import Mapping
+
+# The suspend request: "this gang is being torn down — snapshot it first".
+# JSON {"reason": ..., "requestedAt": t, "deadline": t}. Written by the
+# scheduler (preemption) or the notebook controller (stop/cull teardown);
+# cleared by the scheduler when it releases a preempted gang's chips, or by
+# the sessions controller when a resume completes.
+SUSPEND_ANNOTATION = "sessions.kubeflow.org/suspend-requested"
+# The snapshot ack — the barrier's commit record. JSON {"snapshotId",
+# "digest", "committedAt", "queuedAt"?}. Written by the sessions controller
+# ONLY after the store commit is verified durable; its presence is what lets
+# the scheduler release chips and the notebook controller scale to zero.
+SNAPSHOT_ANNOTATION = "sessions.kubeflow.org/snapshot"
+# The state-machine position (suspending | suspended | resuming). Absent
+# means Running. One annotation write per transition — crash-restart safe.
+STATE_ANNOTATION = "sessions.kubeflow.org/state"
+# When the resume began (stop removed / release observed): the
+# time-to-resume histogram measures from here to restore-complete.
+RESUMING_AT_ANNOTATION = "sessions.kubeflow.org/resuming-at"
+
+STATE_SUSPENDING = "suspending"
+STATE_SUSPENDED = "suspended"
+STATE_RESUMING = "resuming"
+
+REASON_PREEMPTION = "preemption"
+REASON_STOP = "stop"
+
+# Without a force deadline a gang whose snapshot can never commit (pods
+# crashlooping, store unreachable) would hold its chips forever — the
+# preemptor's priority would mean nothing. After the deadline the teardown
+# proceeds cold; nothing was acked, so the no-loss invariant is untouched.
+DEFAULT_SUSPEND_DEADLINE_S = 120.0
+
+SESSION_EVENT_SUSPENDED = "Suspended"
+SESSION_EVENT_SNAPSHOT_FAILED = "SnapshotFailed"
+SESSION_EVENT_RESUMED = "Resumed"
+
+
+def _annotations(nb: Mapping) -> dict:
+    return nb.get("metadata", {}).get("annotations", {}) or {}
+
+
+def encode_suspend_request(
+    reason: str, requested_at: float, deadline_s: float
+) -> str:
+    return json.dumps(
+        {
+            "reason": reason,
+            "requestedAt": requested_at,
+            "deadline": requested_at + deadline_s,
+        },
+        sort_keys=True,
+    )
+
+
+def suspend_request(nb: Mapping) -> dict | None:
+    """Decode the suspend request, or None. A malformed annotation (users
+    can kubectl-edit garbage in) reads as absent: the teardown then proceeds
+    as a plain stop rather than wedging the barrier forever."""
+    raw = _annotations(nb).get(SUSPEND_ANNOTATION)
+    if not raw:
+        return None
+    try:
+        req = json.loads(raw)
+    except ValueError:
+        return None
+    if not isinstance(req, dict):
+        return None
+    try:
+        req["requestedAt"] = float(req["requestedAt"])
+        req["deadline"] = float(req["deadline"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    return req
+
+
+def encode_snapshot_record(
+    snapshot_id: str,
+    digest: str,
+    committed_at: float,
+    queued_at: float | None = None,
+) -> str:
+    rec: dict = {
+        "snapshotId": snapshot_id,
+        "digest": digest,
+        "committedAt": committed_at,
+    }
+    if queued_at is not None:
+        rec["queuedAt"] = queued_at
+    return json.dumps(rec, sort_keys=True)
+
+
+def snapshot_record(nb: Mapping) -> dict | None:
+    """Decode the snapshot ack, or None. Like the placement annotation, a
+    malformed record reads as absent (no ack means the no-loss invariant
+    never attached to it)."""
+    raw = _annotations(nb).get(SNAPSHOT_ANNOTATION)
+    if not raw:
+        return None
+    try:
+        rec = json.loads(raw)
+    except ValueError:
+        return None
+    if not isinstance(rec, dict) or not rec.get("snapshotId"):
+        return None
+    return rec
+
+
+def session_state(nb: Mapping) -> str | None:
+    state = _annotations(nb).get(STATE_ANNOTATION)
+    return state if state in (
+        STATE_SUSPENDING, STATE_SUSPENDED, STATE_RESUMING
+    ) else None
+
+
+def session_engaged(nb: Mapping) -> bool:
+    """Any session machinery attached to this CR at all."""
+    anns = _annotations(nb)
+    return any(
+        k in anns
+        for k in (SUSPEND_ANNOTATION, SNAPSHOT_ANNOTATION, STATE_ANNOTATION)
+    )
+
+
+def suspend_in_flight(nb: Mapping, now: float) -> bool:
+    """The barrier holds: a suspend was requested, no snapshot has been
+    acked, the state machine has not moved past Suspending, and the force
+    deadline has not passed. While this is True, pods stay up and chips stay
+    held."""
+    req = suspend_request(nb)
+    if req is None:
+        return False
+    if snapshot_record(nb) is not None:
+        return False
+    if session_state(nb) == STATE_SUSPENDED:
+        return False
+    return now < req["deadline"]
+
+
+def suspend_complete(nb: Mapping, now: float) -> bool:
+    """The barrier released: the snapshot was acked (commit record present),
+    the state machine reached Suspended, or the force deadline passed. Only
+    now may chips be released and pods scaled to zero."""
+    req = suspend_request(nb)
+    if req is None:
+        return False
+    return (
+        snapshot_record(nb) is not None
+        or session_state(nb) == STATE_SUSPENDED
+        or now >= req["deadline"]
+    )

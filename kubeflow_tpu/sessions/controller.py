@@ -1,0 +1,427 @@
+"""Sessions reconciler: the suspend/resume state machine.
+
+One more reconciler under ``runtime/manager.py``, owning the session
+lifecycle annotations on Notebook CRs (wire contract in
+``sessions/__init__.py``). Level-triggered and stateless: every transition
+is one annotation write, and every decision re-derives from the CR + the
+snapshot store, so a controller crash-restart anywhere inside the barrier
+replays instead of losing the suspend (the chaos soak arms crashes between
+every pair of writes to prove it).
+
+The machine::
+
+    Running ──suspend requested──▶ Suspending ──commit acked──▶ Suspended
+       ▲                              │  (force deadline, no ack:    │
+       │                              └──────▶ Suspended cold)       │
+       └── restore complete ◀── Resuming ◀── gang wants capacity ────┘
+
+- **Suspending**: a teardown actor (scheduler preemption, notebook
+  controller on stop/cull) wrote the suspend request. Pods are still up —
+  the barrier holds them. This controller asks the in-pod session agent for
+  a snapshot (production: the Jupyter extension running
+  ``utils/checkpoint.snapshot_for_suspend`` — save + ``wait_until_finished``
+  so an async orbax save can't be torn down mid-flight), commits it through
+  the write-ahead store, and ONLY after the store verifies the commit
+  durable writes the snapshot ack + ``state=suspended`` in one patch. The
+  ack is the barrier's release signal: the scheduler hands the chips over,
+  the notebook controller scales to zero.
+- **Suspended**: parked. The ack records the snapshot id, payload digest,
+  and the gang's original queue-admission time.
+- **Resuming**: the gang wants capacity again (stop annotation removed, or
+  a preemption victim aging back up the queue). The original ``queued-at``
+  is re-stamped from the ack so the scheduler's aging makes resume fast;
+  once the coordinator pod is Running the committed snapshot is loaded
+  (torn/uncommitted snapshots are structurally unrestorable — the store
+  refuses) and pushed to the agent; then every session annotation is
+  cleared in one patch and a ``Resumed`` event lands.
+
+Hard rule the soak audits: the ack is cleared ONLY in the same patch that
+follows a successful restore (or a cold resume with no ack at all) — an
+acked snapshot can never silently evaporate into a cold restart.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Protocol
+
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu import sessions as sess
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster, NotFound
+from kubeflow_tpu.runtime.manager import Reconciler, Result
+from kubeflow_tpu.sessions import store as snapstore
+from kubeflow_tpu.sessions.store import SnapshotStore, SnapshotUnavailable, StoreError
+
+# Barrier poll cadence while waiting on pods / the agent / the deadline.
+# Watch events (pod phase flips, annotation writes) usually wake the key
+# sooner; this bounds the wait when nothing else fires (must stay under the
+# chaos soak's requeue ceiling).
+DEFAULT_RETRY_S = 5.0
+
+
+class SessionAgent(Protocol):
+    """The in-pod half of the barrier (a Jupyter server extension in
+    production; ``testing/sessionstore.FakeSessionAgent`` in soaks)."""
+
+    def snapshot(self, namespace: str, name: str) -> bytes | None: ...
+    def restore(
+        self, namespace: str, name: str, payload: bytes, snapshot_id: str
+    ) -> bool: ...
+
+
+class SessionReconciler(Reconciler):
+    kind = "Notebook"
+
+    def __init__(
+        self,
+        store: SnapshotStore,
+        agent: SessionAgent,
+        *,
+        config=None,
+        metrics=None,
+        recorder=None,
+        clock: Callable[[], float] = time.time,
+        retry_s: float = DEFAULT_RETRY_S,
+    ) -> None:
+        self.store = store
+        self.agent = agent
+        # Under the fleet scheduler a TPU gang's pods exist iff it holds a
+        # placement. A restore is only safe into the gang's NEW incarnation:
+        # right after a release, the old pods are still draining for a tick,
+        # and restoring into them would clear the ack on pods that are about
+        # to die — the exact work loss the subsystem exists to prevent (the
+        # soak's no-loss audit caught this as a real bug). So in a
+        # scheduler-gated world, resume waits for the re-bind.
+        self.scheduler_gated = bool(
+            config is not None and getattr(config, "scheduler_enabled", False)
+        )
+        self.metrics = metrics
+        self.recorder = recorder
+        self.clock = clock
+        self.retry_s = retry_s
+
+    def watches(self):
+        # pod phase transitions drive both ends of the machine: Running pods
+        # make a snapshot possible (suspend) and a restore deliverable
+        # (resume)
+        return [("Pod", _map_pod_to_notebook)]
+
+    # ------------------------------------------------------------------ main
+
+    def reconcile(
+        self, cluster: FakeCluster, namespace: str, name: str
+    ) -> Result | None:
+        nb = cluster.try_get("Notebook", name, namespace)
+        if nb is None or not sess.session_engaged(nb):
+            return None
+        now = self.clock()
+        req = sess.suspend_request(nb)
+        ack = sess.snapshot_record(nb)
+        state = sess.session_state(nb)
+
+        if req is not None and ack is None and state != sess.STATE_SUSPENDED:
+            return self._suspend(cluster, nb, req, state, now)
+        return self._maybe_resume(cluster, nb, req, ack, state, now)
+
+    # --------------------------------------------------------------- suspend
+
+    def _suspend(
+        self,
+        cluster: FakeCluster,
+        nb: dict,
+        req: dict,
+        state: str | None,
+        now: float,
+    ) -> Result | None:
+        ns, name = ko.namespace(nb), ko.name(nb)
+        key = f"{ns}/{name}"
+        if (
+            req.get("reason") == sess.REASON_STOP
+            and api.STOP_ANNOTATION not in ko.annotations(nb)
+        ):
+            # the stop that initiated this suspend was retracted before the
+            # snapshot committed: the session never went down, so there is
+            # nothing to preserve — abort the barrier instead of suspending
+            # a gang the user just started (preemption suspends, whose
+            # initiator is the scheduler, are NOT aborted here)
+            self._patch(cluster, nb, {
+                sess.SUSPEND_ANNOTATION: None,
+                sess.STATE_ANNOTATION: None,
+            })
+            return None
+        if state != sess.STATE_SUSPENDING:
+            self._patch(cluster, nb, {
+                sess.STATE_ANNOTATION: sess.STATE_SUSPENDING,
+            })
+        payload = self.agent.snapshot(ns, name)
+        if payload is not None:
+            uid = nb.get("metadata", {}).get("uid", "")
+            sid = snapstore.snapshot_id(key, uid, req["requestedAt"])
+            try:
+                record = self.store.save(
+                    key, payload, snapshot_id=sid, now=now
+                )
+            except StoreError as e:
+                # NOT committed: no ack may be written. Surface and retry —
+                # the deterministic snapshot id makes the retry an
+                # idempotent overwrite of this attempt's objects.
+                self._emit(
+                    cluster, nb, sess.SESSION_EVENT_SNAPSHOT_FAILED,
+                    f"snapshot write failed: {e}", "Warning",
+                )
+                if self.metrics is not None:
+                    self.metrics.snapshot_failures.inc()
+                return Result(requeue_after=self.retry_s)
+            # commit verified durable: the ack + the state flip are ONE
+            # write — a crash leaves either no ack (retry re-saves, same id)
+            # or the complete commit record, never a half-acked session
+            queued_at = _queued_at(nb)
+            self._patch(cluster, nb, {
+                sess.SNAPSHOT_ANNOTATION: sess.encode_snapshot_record(
+                    sid, record["digest"], now, queued_at
+                ),
+                sess.STATE_ANNOTATION: sess.STATE_SUSPENDED,
+            })
+            self._emit(
+                cluster, nb, sess.SESSION_EVENT_SUSPENDED,
+                f"session snapshot {sid} committed; suspended with work "
+                f"preserved",
+            )
+            if self.metrics is not None:
+                self.metrics.observe_suspend(
+                    now - req["requestedAt"], req.get("reason", "unknown")
+                )
+            return None
+        if now >= req["deadline"]:
+            # force path: nothing was ever acked, so nothing can be lost
+            # that the platform promised to keep — the teardown proceeds
+            # cold rather than holding chips forever
+            self._patch(cluster, nb, {
+                sess.STATE_ANNOTATION: sess.STATE_SUSPENDED,
+            })
+            self._emit(
+                cluster, nb, sess.SESSION_EVENT_SNAPSHOT_FAILED,
+                f"no snapshot before the force deadline "
+                f"({req['deadline'] - req['requestedAt']:.0f}s); the session "
+                f"will restart cold", "Warning",
+            )
+            if self.metrics is not None:
+                self.metrics.force_suspends.inc()
+            return None
+        # coordinator unreachable (pods pending, kubelet flaking): the
+        # barrier keeps holding; retry until the agent answers or the
+        # deadline forces
+        return Result(requeue_after=self.retry_s)
+
+    # ---------------------------------------------------------------- resume
+
+    def _maybe_resume(
+        self,
+        cluster: FakeCluster,
+        nb: dict,
+        req: dict | None,
+        ack: dict | None,
+        state: str | None,
+        now: float,
+    ) -> Result | None:
+        ns, name = ko.namespace(nb), ko.name(nb)
+        key = f"{ns}/{name}"
+        anns = ko.annotations(nb)
+        if api.STOP_ANNOTATION in anns:
+            return None  # parked; resume starts when the stop is removed
+        if (
+            req is not None
+            and req.get("reason") == sess.REASON_PREEMPTION
+            and sched.placement_of(nb) is not None
+        ):
+            # handoff pending: the snapshot is acked but the scheduler has
+            # not yet released the chips (it clears the request with the
+            # placement in one write). Starting a resume now would clear
+            # the ack underneath the barrier.
+            return Result(requeue_after=self.retry_s)
+        if (
+            ack is not None
+            and ack.get("queuedAt") is not None
+            and sched.QUEUED_AT_ANNOTATION not in anns
+        ):
+            # hand the gang its original queue seniority back: aging from
+            # the real submit time is what makes resume fast (and fair)
+            self._patch(cluster, nb, {
+                sched.QUEUED_AT_ANNOTATION: repr(float(ack["queuedAt"])),
+            })
+        if state != sess.STATE_RESUMING:
+            self._patch(cluster, nb, {
+                sess.STATE_ANNOTATION: sess.STATE_RESUMING,
+                sess.RESUMING_AT_ANNOTATION: repr(now),
+            })
+        if (
+            self.scheduler_gated
+            and nb.get("spec", {}).get("tpu")
+            and sched.placement_of(nb) is None
+        ):
+            # not re-bound yet: any Running coordinator is the PREVIOUS
+            # incarnation draining away — wait for the scheduler
+            return Result(requeue_after=self.retry_s)
+        if not _coordinator_running(cluster, nb):
+            # queued for capacity, or pods still starting: level-triggered
+            # retry; the Pod watch wakes us the moment the coordinator runs
+            return Result(requeue_after=self.retry_s)
+        from_snapshot = False
+        if ack is not None:
+            try:
+                payload = self.store.load(key, ack.get("snapshotId"))
+            except (SnapshotUnavailable, StoreError, KeyError, OSError) as e:
+                # an acked snapshot MUST restore — blocking here beats
+                # silently booting the user's session cold (the no-loss
+                # invariant the soak audits)
+                self._emit(
+                    cluster, nb, sess.SESSION_EVENT_SNAPSHOT_FAILED,
+                    f"committed snapshot unreadable: {e}; retrying restore",
+                    "Warning",
+                )
+                return Result(requeue_after=self.retry_s)
+            if not self.agent.restore(
+                ns, name, payload, ack.get("snapshotId", "")
+            ):
+                return Result(requeue_after=self.retry_s)
+            from_snapshot = True
+        resumed_from = ack.get("snapshotId") if ack else None
+        try:
+            started = float(anns.get(sess.RESUMING_AT_ANNOTATION, now))
+        except (TypeError, ValueError):
+            started = now
+        # restore delivered: clear every session annotation in one write —
+        # the ack leaves the CR only together with the rest of the machinery
+        self._patch(cluster, nb, {
+            sess.SUSPEND_ANNOTATION: None,
+            sess.SNAPSHOT_ANNOTATION: None,
+            sess.STATE_ANNOTATION: None,
+            sess.RESUMING_AT_ANNOTATION: None,
+        })
+        self._emit(
+            cluster, nb, sess.SESSION_EVENT_RESUMED,
+            f"session resumed from snapshot {resumed_from}"
+            if resumed_from
+            else "session resumed cold (no snapshot was committed)",
+        )
+        if self.metrics is not None:
+            self.metrics.observe_resume(
+                now - started, from_snapshot=from_snapshot
+            )
+        return None
+
+    # -------------------------------------------------------------- plumbing
+
+    def _patch(self, cluster: FakeCluster, nb: dict, anns: dict) -> None:
+        """One annotation write, mirrored into the in-memory copy so the
+        same reconcile pass sees its own transition. NotFound (deleted under
+        us) ends the work; Conflict propagates into the workqueue's backoff."""
+        try:
+            cluster.patch(
+                "Notebook", ko.name(nb), ko.namespace(nb),
+                {"metadata": {"annotations": anns}},
+            )
+        except NotFound:
+            return
+        for k, v in anns.items():
+            if v is None:
+                ko.remove_annotation(nb, k)
+            else:
+                ko.set_annotation(nb, k, v)
+
+    def _emit(
+        self,
+        cluster: FakeCluster,
+        nb: dict,
+        reason: str,
+        message: str,
+        type_: str = "Normal",
+    ) -> None:
+        if self.recorder is not None:
+            self.recorder.emit(cluster, nb, reason, message, type_)
+
+
+def _queued_at(nb: dict) -> float | None:
+    raw = ko.annotations(nb).get(sched.QUEUED_AT_ANNOTATION)
+    if raw is None:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def _coordinator_running(cluster: FakeCluster, nb: dict) -> bool:
+    """Is the gang's coordinator pod (slice 0 host 0 — the only host that
+    holds the kernel manager and the session state) actually Running?"""
+    ns, name = ko.namespace(nb), ko.name(nb)
+    try:
+        num_slices = api.notebook_num_slices(nb)
+    except (TypeError, ValueError):
+        num_slices = 1
+    pod_name = f"{name}-s0-0" if num_slices > 1 else f"{name}-0"
+    pod = cluster.try_get("Pod", pod_name, ns)
+    return (
+        pod is not None and pod.get("status", {}).get("phase") == "Running"
+    )
+
+
+def _map_pod_to_notebook(pod: dict) -> Iterable[tuple[str, str]]:
+    nb = ko.labels(pod).get("notebook-name")
+    if nb:
+        yield (ko.namespace(pod), nb)
+
+
+class HttpSessionAgent:
+    """Production agent: asks the coordinator pod's session endpoint over
+    the same in-cluster URL shape the culler probes kernels on. The notebook
+    image's session extension implements ``GET /api/sessions/snapshot``
+    (returns the serialized session after ``snapshot_for_suspend`` — the
+    save MUST have passed ``wait_until_finished()``) and ``POST
+    /api/sessions/restore``. Unreachable servers answer None/False — the
+    controller retries until the force deadline, exactly like an idle-probe
+    miss."""
+
+    def __init__(self, cluster_domain: str = "cluster.local", timeout: float = 10.0) -> None:
+        self.cluster_domain = cluster_domain
+        self.timeout = timeout
+
+    def _url(self, namespace: str, name: str, verb: str) -> str:
+        return (
+            f"http://{name}.{namespace}.svc.{self.cluster_domain}"
+            f"/notebook/{namespace}/{name}/api/sessions/{verb}"
+        )
+
+    def snapshot(self, namespace: str, name: str) -> bytes | None:
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                self._url(namespace, name, "snapshot"), timeout=self.timeout
+            ) as resp:
+                return resp.read()
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def restore(
+        self, namespace: str, name: str, payload: bytes, snapshot_id: str
+    ) -> bool:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._url(namespace, name, "restore"),
+            data=payload,
+            headers={
+                "Content-Type": "application/octet-stream",
+                "X-Snapshot-Id": snapshot_id,
+            },
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return 200 <= resp.status < 300
+        except (urllib.error.URLError, OSError, ValueError):
+            return False

@@ -1,0 +1,576 @@
+"""Seeded chaos soak for the session lifecycle (``tools/sessions_soak.py``).
+
+The subsystem's promise is the **no-loss invariant**: once a session's
+snapshot is acked (the commit record annotation lands on the CR), that
+session never restarts cold — and during a preemption handoff, no chips are
+released before the snapshot commits or the force deadline passes, and no
+chips are ever double-booked mid-handoff. The soak drives the full stack —
+notebook controller (teardown barrier), fleet scheduler (preemption
+barrier), sessions controller (snapshot/restore) — under the control-plane
+chaos layer (API faults, watch drops, controller crash-restart armed
+between writes — including the crash *between snapshot-commit and
+chip-release*) plus a fault-injecting object store (lost commit writes,
+torn manifests), and audits:
+
+- **temporal** (every sub-tick, via :class:`SessionAuditor`): a placement
+  never disappears while its suspend barrier holds; an acked snapshot never
+  leaves the CR without its restore being delivered; every ack points at a
+  store commit that verifies (parse + digest); plus the scheduler soak's
+  placement overlap audit (zero double-booking at every observable state);
+- **final** (fixed point, faults healed): the scheduler's own fixed-point
+  audit, every bound active gang fully resumed (no session machinery left),
+  every suspended gang actually scaled to zero with its snapshot restorable,
+  the trace audit, and the bounded-events audit.
+
+Everything flows from the seed: fleet, gangs, op timeline, API faults,
+store faults. A printed failure reproduces with
+``python tools/sessions_soak.py --seed N``.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import random
+from typing import Callable
+
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu import sessions as sess
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.culler.culler import Culler
+from kubeflow_tpu.obs.events import EventRecorder, audit_events
+from kubeflow_tpu.obs.tracing import Tracer
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import (
+    AlreadyExists,
+    Conflict,
+    FakeCluster,
+    NotFound,
+)
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.scheduler.controller import SchedulerReconciler
+from kubeflow_tpu.scheduler.soak import (
+    audit_fixed_point,
+    audit_placements,
+    make_pool,
+)
+from kubeflow_tpu.sessions.controller import SessionReconciler
+from kubeflow_tpu.sessions.store import SnapshotStore
+from kubeflow_tpu.testing.chaos import (
+    SOAK_MAX_REQUEUE_S,
+    ChaosCluster,
+    ChaosConfig,
+    check_invariants,
+    fingerprint,
+)
+from kubeflow_tpu.testing.sessionstore import (
+    FakeObjectStore,
+    FakeSessionAgent,
+    StoreChaosConfig,
+)
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import SchedulerMetrics, SessionMetrics
+from kubeflow_tpu.webhooks import tpu_env
+
+SOAK_AGING_INTERVAL_S = 60.0
+# Short enough that the force path is exercised within a run (agents are
+# unreachable while pods are down), long enough that a healthy snapshot
+# commits well before it.
+SOAK_SUSPEND_DEADLINE_S = 60.0
+
+
+# ------------------------------------------------------------------- audits
+
+
+def _nb_key(nb: dict) -> str:
+    return f"{ko.namespace(nb)}/{ko.name(nb)}"
+
+
+def _gang_scaled_down(base: FakeCluster, nb: dict) -> bool:
+    name, ns = ko.name(nb), ko.namespace(nb)
+    try:
+        num_slices = api.notebook_num_slices(nb)
+    except (TypeError, ValueError):
+        num_slices = 1
+    for j in range(max(1, num_slices)):
+        sts_name = name if num_slices <= 1 else f"{name}-s{j}"
+        sts = base.try_get("StatefulSet", sts_name, ns)
+        if sts is not None and (sts.get("spec") or {}).get("replicas", 0) > 0:
+            return False
+    return True
+
+
+@dataclasses.dataclass
+class _Obs:
+    uid: str
+    placed: bool
+    requested: bool
+    ack_id: str | None
+    complete: bool
+    scaled_down: bool
+
+
+class SessionAuditor:
+    """Temporal audit fed one observation per sub-tick. Transitions between
+    observations are judged by what the durable state itself proves: an ack
+    that persists past a release, a deadline computable from the request,
+    a restore ledger entry in the (data-plane) agent."""
+
+    def __init__(self, store: SnapshotStore, agent: FakeSessionAgent) -> None:
+        self.store = store
+        self.agent = agent
+        self.last: dict[str, _Obs] = {}
+
+    def observe(self, base: FakeCluster, now: float, where: str) -> list[str]:
+        out: list[str] = []
+        restores = set(self.agent.restores)
+        seen: set[str] = set()
+        for nb in base.list("Notebook"):
+            key = _nb_key(nb)
+            seen.add(key)
+            uid = nb.get("metadata", {}).get("uid", "")
+            ack = sess.snapshot_record(nb)
+            obs = _Obs(
+                uid=uid,
+                placed=sched.placement_of(nb) is not None,
+                requested=sess.suspend_request(nb) is not None,
+                ack_id=ack.get("snapshotId") if ack else None,
+                complete=sess.suspend_complete(nb, now),
+                scaled_down=_gang_scaled_down(base, nb),
+            )
+            prev = self.last.get(key)
+            if prev is not None and prev.uid == uid:
+                if prev.placed and not obs.placed:
+                    # chips were released between the two observations: the
+                    # barrier demands a committed snapshot, a passed
+                    # deadline, or a gang that had already finished tearing
+                    # down — provable from either endpoint of the interval
+                    allowed = (
+                        prev.complete
+                        or obs.complete
+                        or obs.ack_id is not None
+                        or prev.scaled_down
+                    )
+                    if not allowed:
+                        out.append(
+                            f"{where}: {key}: chips released while the "
+                            f"suspend barrier held (no snapshot ack, "
+                            f"deadline not passed, pods still up)"
+                        )
+                if prev.ack_id is not None and obs.ack_id is None:
+                    if (key, prev.ack_id) not in restores:
+                        out.append(
+                            f"{where}: {key}: acked snapshot {prev.ack_id} "
+                            f"left the CR without its restore being "
+                            f"delivered (cold restart of preserved work)"
+                        )
+            if obs.ack_id is not None and (
+                prev is None or prev.ack_id != obs.ack_id
+            ):
+                if self.store.commit_record(key, obs.ack_id) is None:
+                    out.append(
+                        f"{where}: {key}: ack {obs.ack_id} has no "
+                        f"verifiable committed snapshot in the store "
+                        f"(acked a torn/uncommitted write)"
+                    )
+            self.last[key] = obs
+        for key in list(self.last):
+            if key not in seen:
+                del self.last[key]  # deleted: its snapshot dies with it
+        return out
+
+
+def audit_sessions_fixed_point(
+    base: FakeCluster,
+    store: SnapshotStore,
+    agent: FakeSessionAgent,
+    now: float,
+    *,
+    where: str = "final",
+) -> list[str]:
+    """What must hold once faults healed and the state quiesced."""
+    out: list[str] = []
+    for nb in base.list("Notebook"):
+        key = _nb_key(nb)
+        anns = ko.annotations(nb)
+        active = api.STOP_ANNOTATION not in anns
+        placed = sched.placement_of(nb) is not None
+        ack = sess.snapshot_record(nb)
+        if active and placed:
+            # a bound, running gang must be fully resumed — session
+            # machinery still attached means a resume wedged
+            if sess.session_engaged(nb):
+                out.append(
+                    f"{where}: {key}: bound active gang still carries "
+                    f"session annotations (resume never completed)"
+                )
+        if not active:
+            if not _gang_scaled_down(base, nb):
+                out.append(
+                    f"{where}: {key}: stopped gang still holds pods after "
+                    f"the barrier should have resolved"
+                )
+            if sess.suspend_in_flight(nb, now):
+                out.append(
+                    f"{where}: {key}: suspend still in flight at the fixed "
+                    f"point (neither ack nor deadline resolved it)"
+                )
+        if ack is not None:
+            if store.commit_record(key, ack["snapshotId"]) is None:
+                out.append(
+                    f"{where}: {key}: resting ack {ack['snapshotId']} is "
+                    f"not restorable from the store"
+                )
+    return out
+
+
+# ----------------------------------------------------------------- scenario
+
+_POOL_CHOICES = [
+    ("v4", "2x2x4"),   # 4 hosts / 16 chips
+    ("v4", "2x2x2"),   # 2 hosts / 8 chips
+    ("v5e", "4x4"),    # 2 hosts / 16 chips
+]
+_GANG_TOPOLOGIES = {
+    "v4": ["2x2x1", "2x2x2", "2x2x4"],
+    "v5e": ["2x4", "4x4"],
+}
+
+
+class SessionScenario:
+    """A seeded fleet + gang workload + hostile op timeline. Deliberately
+    WITHOUT node drains/flaps and spec resizes (the scheduler soak owns
+    those): every capacity movement here flows through the suspend barrier,
+    so the temporal audit's release rule stays exact."""
+
+    N_ROUNDS = 6
+    NAMESPACE = "team-a"
+
+    def __init__(self, seed: int) -> None:
+        rng = random.Random(f"session-scenario-{seed}")
+        self.seed = seed
+        self.culling = rng.random() < 0.5
+        n_pools = 1 + (rng.random() < 0.5)
+        picks = rng.sample(_POOL_CHOICES, k=n_pools)
+        self.pools = {
+            f"pool-{accel}-{i}": (accel, topo)
+            for i, (accel, topo) in enumerate(picks)
+        }
+        pool_accels = sorted({a for a, _ in self.pools.values()})
+        self.gangs: dict[str, dict] = {}
+        for i in range(rng.randint(4, 7)):
+            accel = pool_accels[rng.randrange(len(pool_accels))]
+            shapes = _GANG_TOPOLOGIES[accel]
+            gang = dict(
+                tpu_accelerator=accel,
+                tpu_topology=shapes[rng.randrange(len(shapes))],
+            )
+            # skewed priorities: most gangs junior, a few seniors whose
+            # arrival forces preemption handoffs through the barrier
+            prio = (0, 0, 0, 1, 5)[rng.randrange(5)]
+            if prio:
+                gang["annotations"] = {sched.PRIORITY_ANNOTATION: str(prio)}
+            self.gangs[f"s{i}"] = gang
+        self.busy = {g for g in sorted(self.gangs) if rng.random() < 0.6}
+        self.rounds = self._op_timeline(rng)
+
+    def _op_timeline(self, rng: random.Random) -> list[list[tuple[str, str]]]:
+        alive, dead = set(self.gangs), set()
+        rounds: list[list[tuple[str, str]]] = []
+        for _ in range(self.N_ROUNDS):
+            ops: list[tuple[str, str]] = []
+            for _ in range(rng.randint(0, 2)):
+                choices: list[tuple[str, str]] = []
+                for nb in sorted(alive):
+                    choices += [
+                        ("stop", nb), ("start", nb),
+                        ("bump_priority", nb), ("delete_nb", nb),
+                    ]
+                choices += [("recreate_nb", nb) for nb in sorted(dead)]
+                if not choices:
+                    break
+                op = choices[rng.randrange(len(choices))]
+                verb, target = op
+                if verb == "delete_nb":
+                    alive.discard(target); dead.add(target)
+                elif verb == "recreate_nb":
+                    dead.discard(target); alive.add(target)
+                ops.append(op)
+            rounds.append(ops)
+        return rounds
+
+    # -- world construction (user / API-server side: never faulted) --------
+
+    def _nb(self, name: str) -> dict:
+        return api.notebook(name, self.NAMESPACE, **self.gangs[name])
+
+    def setup(self, base: FakeCluster) -> None:
+        for pool, (accel, topo) in sorted(self.pools.items()):
+            make_pool(base, accel, topo, pool)
+        for name in sorted(self.gangs):
+            base.create(self._nb(name))
+
+    def apply(self, base: FakeCluster, op: tuple[str, str], round_no: int) -> None:
+        verb, target = op
+        ns = self.NAMESPACE
+        try:
+            if verb == "stop":
+                base.patch("Notebook", target, ns, {"metadata": {"annotations": {
+                    api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+            elif verb == "start":
+                base.patch("Notebook", target, ns, {"metadata": {"annotations": {
+                    api.STOP_ANNOTATION: None,
+                    api.LAST_ACTIVITY_ANNOTATION: None}}})
+            elif verb == "bump_priority":
+                base.patch("Notebook", target, ns, {"metadata": {"annotations": {
+                    sched.PRIORITY_ANNOTATION: str((round_no % 3) * 5)}}})
+            elif verb == "delete_nb":
+                base.delete("Notebook", target, ns)
+            elif verb == "recreate_nb":
+                base.create(self._nb(target))
+        except (NotFound, AlreadyExists, Conflict):
+            pass  # op raced a controller write; a later round retries
+
+    def make_fetcher(self) -> Callable:
+        busy = set(self.busy)
+
+        def fetch(namespace: str, name: str):
+            if name in busy:
+                return [{"execution_state": "busy"}]
+            return []  # reachable server, zero kernels: idle by definition
+
+        return fetch
+
+
+# -------------------------------------------------------------------- runner
+
+
+class _Clock:
+    def __init__(self, start: float) -> None:
+        self.t = start
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+@dataclasses.dataclass
+class SessionSeedResult:
+    seed: int
+    violations: list[str]
+    quiesced: bool
+    restarts: int
+    suspends: int
+    resumes: int
+    force_suspends: int
+    fault_counts: collections.Counter
+    store_faults: collections.Counter
+
+    @property
+    def ok(self) -> bool:
+        return self.quiesced and not self.violations
+
+    def describe(self) -> str:
+        if self.ok:
+            faults = sum(self.fault_counts.values())
+            sfaults = sum(self.store_faults.values())
+            return (
+                f"seed {self.seed}: converged ({self.suspends} suspends, "
+                f"{self.resumes} resumes, {self.force_suspends} forced, "
+                f"{faults} API faults, {sfaults} store faults, "
+                f"{self.restarts} controller restarts)"
+            )
+        lines = [f"seed {self.seed}: FAILED "
+                 f"(repro: python tools/sessions_soak.py --seed {self.seed})"]
+        if not self.quiesced:
+            lines.append("  state never quiesced after faults healed")
+        lines += [f"  invariant: {v}" for v in self.violations[:10]]
+        if len(self.violations) > 10:
+            lines.append(f"  ... {len(self.violations) - 10} more")
+        return "\n".join(lines)
+
+
+def run_session_seed(
+    seed: int,
+    faults: ChaosConfig | None = None,
+    store_faults: StoreChaosConfig | None = None,
+    *,
+    max_restarts_per_tick: int = 6,
+) -> SessionSeedResult:
+    """One seeded soak run: hostile timeline under API + store chaos, heal,
+    settle past every deadline, quiesce, then the fixed-point audits.
+    ``faults=None`` runs fault-free (targeted-test baseline)."""
+    scenario = SessionScenario(seed)
+    base = FakeCluster()
+    tpu_env.install(base)
+    chaos = (
+        ChaosCluster(base, seed=seed, config=faults)
+        if faults is not None
+        else None
+    )
+    cluster = chaos if chaos is not None else base
+    clock = _Clock(1_000_000.0)
+    cfg = ControllerConfig(
+        scheduler_enabled=True,
+        sessions_enabled=True,
+        suspend_deadline_s=SOAK_SUSPEND_DEADLINE_S,
+    )
+    culler = Culler(
+        enabled=scenario.culling,
+        cull_idle_minutes=1.0,
+        check_period_minutes=0.5,
+        fetch_kernels=scenario.make_fetcher(),
+        clock=clock,
+    )
+    # durable across controller restarts (it IS the durability story); the
+    # agent is the data plane (pod memory) and also outlives the controller
+    objects = FakeObjectStore(
+        seed=seed,
+        chaos=store_faults
+        if store_faults is not None
+        else (StoreChaosConfig() if faults is not None else None),
+    )
+    store = SnapshotStore(objects)
+    agent = FakeSessionAgent(base)
+    sched_metrics = SchedulerMetrics()
+    session_metrics = SessionMetrics(sched_metrics.registry)
+    tracer = Tracer(clock=clock)
+
+    def build() -> Manager:
+        m = Manager(cluster, clock=clock, tracer=tracer)
+        m.register(
+            NotebookReconciler(
+                cfg, culler=culler, recorder=EventRecorder(clock=clock)
+            )
+        )
+        m.register(
+            SchedulerReconciler(
+                metrics=sched_metrics,
+                recorder=EventRecorder(clock=clock),
+                clock=clock,
+                aging_interval_s=SOAK_AGING_INTERVAL_S,
+                suspend_deadline_s=SOAK_SUSPEND_DEADLINE_S,
+            )
+        )
+        m.register(
+            SessionReconciler(
+                store, agent,
+                config=cfg,
+                metrics=session_metrics,
+                recorder=EventRecorder(clock=clock),
+                clock=clock,
+            )
+        )
+        return m
+
+    scenario.setup(base)
+    mgr = build()
+    auditor = SessionAuditor(store, agent)
+    violations: list[str] = []
+    restarts = 0
+
+    def tick() -> None:
+        nonlocal mgr, restarts
+        for _ in range(max_restarts_per_tick):
+            crashed = False
+            try:
+                mgr.tick()
+            except Exception:
+                crashed = True
+            if chaos is not None and chaos.take_crash():
+                crashed = True
+            if not crashed:
+                return
+            restarts += 1
+            mgr.shutdown()
+            mgr = build()
+
+    def drive(where: str, *, sub_ticks: int = 3, dt: float = 10.0) -> None:
+        for s in range(sub_ticks):
+            cluster.step_kubelet()
+            agent.tick()  # user work advances on every live session
+            if chaos is not None:
+                chaos.tick_watches()
+            tick()
+            if chaos is not None:
+                lat = chaos.take_latency()
+                if lat:
+                    clock.advance(lat)
+            sub_where = f"{where}.{s}"
+            violations.extend(
+                audit_placements(base, strict=False, where=sub_where)
+            )
+            violations.extend(auditor.observe(base, clock(), sub_where))
+            violations.extend(
+                check_invariants(
+                    base, mgr,
+                    max_requeue_s=SOAK_MAX_REQUEUE_S,
+                    where=sub_where,
+                )
+            )
+        clock.advance(dt)
+
+    for r, ops in enumerate(scenario.rounds):
+        for op in ops:
+            scenario.apply(base, op, r)
+        drive(f"round {r}")
+
+    if chaos is not None:
+        chaos.heal()
+    objects.heal()
+
+    # settle past the cull threshold (60 s), the force deadline (60 s), and
+    # the backoff cap (64 s)
+    for s in range(7):
+        drive(f"settle {s}", sub_ticks=2, dt=45.0)
+
+    prev = None
+    quiesced = False
+    for s in range(24):
+        cluster.step_kubelet()
+        agent.tick()
+        tick()
+        violations.extend(auditor.observe(base, clock(), f"quiesce {s}"))
+        fp = fingerprint(base)
+        if fp == prev:
+            quiesced = True
+            break
+        prev = fp
+        clock.advance(65.0)
+    violations.extend(
+        check_invariants(
+            base, mgr,
+            max_requeue_s=SOAK_MAX_REQUEUE_S,
+            where="final", final=True,
+        )
+    )
+    violations.extend(audit_placements(base, strict=True, where="final"))
+    violations.extend(
+        audit_fixed_point(
+            base, clock(), aging_interval_s=SOAK_AGING_INTERVAL_S
+        )
+    )
+    violations.extend(
+        audit_sessions_fixed_point(base, store, agent, clock())
+    )
+    violations.extend(tracer.audit())
+    violations.extend(audit_events(base, where="final"))
+    return SessionSeedResult(
+        seed=seed,
+        violations=violations,
+        quiesced=quiesced,
+        restarts=restarts,
+        suspends=int(
+            sum(s["value"] for s in session_metrics.suspends.samples())
+        ),
+        resumes=int(
+            sum(s["value"] for s in session_metrics.resumes.samples())
+        ),
+        force_suspends=int(session_metrics.force_suspends.get()),
+        fault_counts=(
+            chaos.fault_counts if chaos is not None else collections.Counter()
+        ),
+        store_faults=objects.fault_counts,
+    )

@@ -1,0 +1,313 @@
+"""Durable snapshot store: write-ahead manifest + atomic commit.
+
+The store of record for suspended sessions. The layout under one session
+prefix (``sessions/<namespace>/<name>``):
+
+    <sid>.wal      write-ahead intent — "a snapshot <sid> is being written"
+    <sid>.data     the session payload (opaque bytes from the session agent)
+    <sid>.commit   the commit record {snapshotId, digest, size, committedAt}
+
+The **commit record is the only thing that makes a snapshot restorable**,
+and it is written last, then read back and verified. The discipline is the
+torn-``latest_step`` one from ``utils/checkpoint.py``, lifted to the control
+plane:
+
+- a crash after wal/data but before commit leaves an *uncommitted* snapshot
+  — never restored, invisible to ``committed()``;
+- a torn commit write (the writer died mid-write; the store holds half a
+  record) fails JSON parse or digest verification — never restored; restore
+  falls back to the newest *older* commit that verifies, exactly like
+  ``resume_or_init`` walking back over torn checkpoint steps;
+- a lost commit write (applied, but the response was lost) is absorbed by
+  the read-back verify: ``save`` only returns success once the commit it
+  just wrote is readable and matches, so the caller's ack (the CR
+  annotation) is never written for a commit that may not exist. Retries
+  reuse the same deterministic snapshot id, so a replayed save after a
+  crash-restart overwrites its own half-finished objects instead of
+  leaking new ones.
+
+Object-store faults surface as :class:`StoreError` (the caller requeues and
+retries); a missing/ torn snapshot at restore time surfaces as
+:class:`SnapshotUnavailable` (the caller must NOT restart the session cold
+if an ack exists — blocking beats silent loss).
+
+Backends implement the four-verb :class:`ObjectStore` protocol. Production
+gets :class:`FileObjectStore` (atomic tmp+rename puts on a mounted volume or
+FUSE-mounted bucket); the soaks get the fault-injecting fake in
+``testing/sessionstore.py``.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Protocol
+
+
+class StoreError(Exception):
+    """A store write failed (or could not be verified durable)."""
+
+
+class SnapshotUnavailable(Exception):
+    """No committed, integrity-verified snapshot exists to restore from."""
+
+
+class ObjectStore(Protocol):
+    def put(self, key: str, data: bytes) -> None: ...
+    def get(self, key: str) -> bytes: ...            # KeyError if absent
+    def list(self, prefix: str) -> list[str]: ...
+    def delete(self, key: str) -> None: ...
+
+
+def snapshot_id(session: str, uid: str, requested_at: float) -> str:
+    """Deterministic snapshot identity for one suspend request. Derived from
+    (session, CR uid, request time) so a crash-restarted controller retrying
+    the same request converges on the same objects (idempotent overwrite),
+    while a recreated notebook (new uid) or a new suspend (new request time)
+    never collides with an old snapshot."""
+    raw = f"{session}|{uid}|{requested_at!r}"
+    return hashlib.sha1(raw.encode()).hexdigest()[:12]
+
+
+def _digest(payload: bytes) -> str:
+    return hashlib.sha256(payload).hexdigest()
+
+
+class SnapshotStore:
+    """Policy layer over an :class:`ObjectStore`: WAL, atomic commit,
+    read-back verification, torn-commit fallback."""
+
+    def __init__(self, objects: ObjectStore, *, keep: int = 2) -> None:
+        self.objects = objects
+        # older committed snapshots kept as fallback for a torn newest
+        # commit; everything older is pruned at save time
+        self.keep = keep
+
+    @staticmethod
+    def _prefix(session: str) -> str:
+        return f"sessions/{session}"
+
+    # ---------------------------------------------------------------- save
+
+    def save(
+        self, session: str, payload: bytes, *, snapshot_id: str, now: float
+    ) -> dict:
+        """Write one snapshot through the WAL→data→commit sequence and verify
+        the commit landed. Returns the commit record. Raises StoreError on
+        any failure — the caller retries with the SAME snapshot id."""
+        prefix = self._prefix(session)
+        digest = _digest(payload)
+        record = {
+            "snapshotId": snapshot_id,
+            "digest": digest,
+            "size": len(payload),
+            "committedAt": now,
+        }
+        try:
+            self.objects.put(
+                f"{prefix}/{snapshot_id}.wal",
+                json.dumps(
+                    {"snapshotId": snapshot_id, "startedAt": now},
+                    sort_keys=True,
+                ).encode(),
+            )
+            self.objects.put(f"{prefix}/{snapshot_id}.data", payload)
+            self.objects.put(
+                f"{prefix}/{snapshot_id}.commit",
+                json.dumps(record, sort_keys=True).encode(),
+            )
+        except StoreError:
+            raise
+        except Exception as e:  # backend-specific failure shapes
+            raise StoreError(f"snapshot {snapshot_id} write failed: {e}") from e
+        # read-back verify: a commit whose write was "lost" (applied-but-
+        # errored, or torn) must never be acked. Only a commit we can read
+        # back, parse, and digest-match counts as durable.
+        verified = self.commit_record(session, snapshot_id)
+        if verified != record:
+            raise StoreError(
+                f"snapshot {snapshot_id} commit did not verify "
+                f"(torn or lost write)"
+            )
+        self._prune(session, keep_id=snapshot_id)
+        return record
+
+    # ------------------------------------------------------------- restore
+
+    def _light_record(self, session: str, sid: str) -> dict | None:
+        """The commit record iff it parses (no payload read) — enough to
+        rank commits for pruning, NOT enough to restore from."""
+        try:
+            raw = self.objects.get(f"{self._prefix(session)}/{sid}.commit")
+        except KeyError:
+            return None
+        try:
+            record = json.loads(raw)
+        except ValueError:
+            return None  # torn commit write
+        if not isinstance(record, dict) or record.get("snapshotId") != sid:
+            return None
+        return record
+
+    def _verified(self, session: str, sid: str) -> tuple[dict, bytes] | None:
+        """(record, payload) iff the commit parses AND its data object
+        exists with a matching digest — torn commits and torn data both
+        read as 'not committed'. Returning the verified bytes lets restore
+        use exactly what the digest check covered (one payload read)."""
+        record = self._light_record(session, sid)
+        if record is None:
+            return None
+        try:
+            payload = self.objects.get(f"{self._prefix(session)}/{sid}.data")
+        except KeyError:
+            return None
+        if _digest(payload) != record.get("digest"):
+            return None  # torn data write
+        return record, payload
+
+    def commit_record(self, session: str, sid: str) -> dict | None:
+        """The fully-verified commit record for one snapshot, or None."""
+        verified = self._verified(session, sid)
+        return verified[0] if verified else None
+
+    def _newest_verified(self, session: str) -> tuple[dict, bytes] | None:
+        candidates = [
+            v
+            for v in (
+                self._verified(session, sid)
+                for sid in self._snapshot_ids(session)
+            )
+            if v is not None
+        ]
+        if not candidates:
+            return None
+        return max(
+            candidates,
+            key=lambda v: (v[0].get("committedAt", 0.0),
+                           v[0].get("snapshotId", "")),
+        )
+
+    def committed(self, session: str) -> dict | None:
+        """The newest verifiable commit record for a session, or None. A
+        torn newest commit falls back to the previous one — never restored,
+        never fatal."""
+        newest = self._newest_verified(session)
+        return newest[0] if newest else None
+
+    def load(self, session: str, snapshot_id: str | None = None) -> bytes:
+        """The payload of one committed snapshot (the newest when no id is
+        given). Torn or uncommitted snapshots are never restored; the bytes
+        returned are the ones the digest verification actually covered."""
+        if snapshot_id is None:
+            verified = self._newest_verified(session)
+        else:
+            verified = self._verified(session, snapshot_id)
+        if verified is None:
+            raise SnapshotUnavailable(
+                f"no committed snapshot for {session}"
+                + (f" (wanted {snapshot_id})" if snapshot_id else "")
+            )
+        return verified[1]
+
+    # ------------------------------------------------------------ plumbing
+
+    def _snapshot_ids(self, session: str) -> list[str]:
+        prefix = self._prefix(session)
+        ids = set()
+        for key in self.objects.list(prefix):
+            leaf = key[len(prefix) + 1:]
+            for suffix in (".commit", ".data", ".wal"):
+                if leaf.endswith(suffix):
+                    ids.add(leaf[: -len(suffix)])
+        return sorted(ids)
+
+    def _prune(self, session: str, *, keep_id: str) -> None:
+        """Drop all but the newest ``keep`` committed snapshots (plus any
+        uncommitted debris older than them). Best-effort: a failed delete
+        leaves garbage, never breaks a save."""
+        # light records rank the commits without re-reading every retained
+        # payload; a torn commit does not parse, so it never counts toward
+        # the keep budget (it is debris either way)
+        records = sorted(
+            (
+                r
+                for r in (
+                    self._light_record(session, sid)
+                    for sid in self._snapshot_ids(session)
+                )
+                if r is not None
+            ),
+            key=lambda r: (r.get("committedAt", 0.0), r.get("snapshotId", "")),
+            reverse=True,
+        )
+        keep = {r["snapshotId"] for r in records[: self.keep]} | {keep_id}
+        prefix = self._prefix(session)
+        for sid in self._snapshot_ids(session):
+            if sid in keep:
+                continue
+            for suffix in (".wal", ".data", ".commit"):
+                try:
+                    self.objects.delete(f"{prefix}/{sid}{suffix}")
+                except Exception:
+                    pass
+
+
+class FileObjectStore:
+    """Filesystem-backed object store for production single-writer use (a
+    mounted PVC or FUSE bucket). Puts are atomic at the object level via
+    tmp-file + fsync + rename — a torn write leaves the old object, matching
+    the store discipline the fake injects faults against."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+
+    def _path(self, key: str) -> str:
+        # keys are forward-slash namespaced; keep them inside root
+        safe = key.replace("..", "_")
+        return os.path.join(self.root, *safe.split("/"))
+
+    def put(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:
+            raise StoreError(f"put {key}: {e}") from e
+
+    def get(self, key: str) -> bytes:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise KeyError(key) from None
+        except OSError as e:
+            # transient read fault (EIO on a FUSE bucket): surface as the
+            # store contract's StoreError so callers requeue-and-retry
+            # instead of treating it as a controller bug
+            raise StoreError(f"get {key}: {e}") from e
+
+    def list(self, prefix: str) -> list[str]:
+        base = self._path(prefix)
+        if not os.path.isdir(base):
+            return []
+        out = []
+        for dirpath, _, files in os.walk(base):
+            for fn in files:
+                if fn.endswith(".tmp"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
+                out.append(rel.replace(os.sep, "/"))
+        return sorted(out)
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            raise StoreError(f"delete {key}: {e}") from e

@@ -1,0 +1,172 @@
+"""Fakes for the session subsystem: a fault-injecting object store and an
+in-pod session agent stand-in.
+
+:class:`FakeObjectStore` is the soak's durable store. Its faults model a
+real object store misbehaving at exactly the writes the snapshot discipline
+exists for (``sessions/store.py``):
+
+- **error**: the write never applied (plain 5xx);
+- **lost**: the write APPLIED but the response was lost — the retry-on-
+  success case the read-back verify absorbs;
+- **torn**: the writer died mid-write — the store holds a truncated object
+  and the caller saw an error. A torn ``.commit`` must never be restored.
+
+All draws come from one seeded PRNG in call order, so a failing sessions
+soak seed replays exactly.
+
+:class:`FakeSessionAgent` stands in for the in-pod agent (a Jupyter server
+extension that calls ``utils/checkpoint.snapshot_for_suspend`` — save,
+``wait_until_finished()``, only then report). It is *data plane*: it talks
+to the base cluster (never the faulted client surface) and answers only
+when the gang's coordinator pod is actually Running — a suspended or still-
+pending gang has no one to snapshot. Its ``work`` counter per session and
+``restores`` ledger are what the soak's no-loss audit reads: a session that
+came back without its acked snapshot shows up as a cold counter and a
+missing restore entry.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import random
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.sessions.store import StoreError
+
+
+class StoreChaosConfig:
+    """Per-write fault probabilities for :class:`FakeObjectStore`."""
+
+    def __init__(
+        self,
+        error_rate: float = 0.08,
+        lost_rate: float = 0.05,
+        torn_rate: float = 0.04,
+    ) -> None:
+        self.error_rate = error_rate
+        self.lost_rate = lost_rate
+        self.torn_rate = torn_rate
+
+    @classmethod
+    def quiet(cls) -> "StoreChaosConfig":
+        return cls(0.0, 0.0, 0.0)
+
+
+class FakeObjectStore:
+    """In-memory object store with seeded write faults (reads are the local
+    volume / GET path and stay reliable — the discipline under test is the
+    write side)."""
+
+    def __init__(
+        self, *, seed: int = 0, chaos: StoreChaosConfig | None = None
+    ) -> None:
+        self._objects: dict[str, bytes] = {}
+        self.cfg = chaos or StoreChaosConfig.quiet()
+        self.rng = random.Random(f"store-{seed}")
+        self._healed = False
+        self.fault_counts: collections.Counter = collections.Counter()
+
+    def heal(self) -> None:
+        self._healed = True
+
+    def put(self, key: str, data: bytes) -> None:
+        if isinstance(data, str):  # tolerate str payloads from tests
+            data = data.encode()
+        if not self._healed:
+            r = self.rng.random()
+            if r < self.cfg.error_rate:
+                self.fault_counts["error"] += 1
+                raise StoreError(f"chaos: put {key} failed (not applied)")
+            if r < self.cfg.error_rate + self.cfg.lost_rate:
+                self._objects[key] = bytes(data)
+                self.fault_counts["lost"] += 1
+                raise StoreError(f"chaos: put {key} response lost (applied)")
+            if r < self.cfg.error_rate + self.cfg.lost_rate + self.cfg.torn_rate:
+                self._objects[key] = bytes(data[: max(0, len(data) // 2)])
+                self.fault_counts["torn"] += 1
+                raise StoreError(f"chaos: writer died mid-put {key} (torn)")
+        self._objects[key] = bytes(data)
+
+    def get(self, key: str) -> bytes:
+        if key not in self._objects:
+            raise KeyError(key)
+        return self._objects[key]
+
+    def list(self, prefix: str) -> list[str]:
+        return sorted(k for k in self._objects if k.startswith(prefix + "/"))
+
+    def delete(self, key: str) -> None:
+        self._objects.pop(key, None)
+
+
+class FakeSessionAgent:
+    """The in-pod session agent against the base (data-plane) cluster."""
+
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+        # live in-memory session state, per session key: the thing a kill
+        # destroys and a snapshot preserves
+        self.work: dict[str, int] = {}
+        self._pod_uid: dict[str, str] = {}
+        self.snapshots: list[tuple[str, int]] = []    # (key, work captured)
+        self.restores: list[tuple[str, str]] = []     # (key, snapshot_id)
+        self.cold_starts: list[str] = []
+
+    # ------------------------------------------------------------ plumbing
+
+    def _coordinator(self, namespace: str, name: str) -> dict | None:
+        nb = self.cluster.try_get("Notebook", name, namespace)
+        if nb is None:
+            return None
+        num_slices = api.notebook_num_slices(nb)
+        pod_name = f"{name}-s0-0" if num_slices > 1 else f"{name}-0"
+        pod = self.cluster.try_get("Pod", pod_name, namespace)
+        if pod is None or pod.get("status", {}).get("phase") != "Running":
+            return None
+        return pod
+
+    def tick(self) -> None:
+        """One unit of user work on every live session; detects cold boots
+        (a coordinator incarnation that appeared without a restore resets
+        the counter — exactly what losing the session means)."""
+        for nb in self.cluster.list("Notebook"):
+            ns, name = ko.namespace(nb), ko.name(nb)
+            key = f"{ns}/{name}"
+            pod = self._coordinator(ns, name)
+            if pod is None:
+                continue
+            uid = pod.get("metadata", {}).get("uid", "")
+            if self._pod_uid.get(key) != uid:
+                self._pod_uid[key] = uid
+                if key in self.work:
+                    # fresh incarnation: memory starts empty until (unless)
+                    # the sessions controller restores into it
+                    self.cold_starts.append(key)
+                self.work[key] = 0
+            self.work[key] = self.work.get(key, 0) + 1
+
+    # ------------------------------------------------------ agent protocol
+
+    def snapshot(self, namespace: str, name: str) -> bytes | None:
+        """Capture the live session, or None when there is no one to ask
+        (coordinator not Running) — the controller then retries until the
+        force deadline."""
+        if self._coordinator(namespace, name) is None:
+            return None
+        key = f"{namespace}/{name}"
+        work = self.work.get(key, 0)
+        self.snapshots.append((key, work))
+        return json.dumps({"session": key, "work": work}).encode()
+
+    def restore(
+        self, namespace: str, name: str, payload: bytes, snapshot_id: str
+    ) -> bool:
+        """Load a snapshot into the (running) coordinator; False when the
+        pod is not there yet — the controller retries."""
+        if self._coordinator(namespace, name) is None:
+            return False
+        key = f"{namespace}/{name}"
+        self.work[key] = json.loads(payload).get("work", 0)
+        self.restores.append((key, snapshot_id))
+        return True

@@ -69,12 +69,39 @@ class CheckpointManager:
         """Retained steps, ascending (fallback order for torn-step recovery)."""
         return sorted(self.manager.all_steps())
 
-    def wait(self) -> None:
-        """Block until async saves land (call before letting a cull proceed)."""
+    def wait_until_finished(self) -> None:
+        """Block until every async save is durable on disk/GCS. ``save()``
+        returning only means the save was *started*: orbax writes shards in
+        the background, and a gang torn down before they land leaves a torn
+        step behind (recoverable, but the work since the previous step is
+        gone). The suspend barrier (``sessions/``) calls this before
+        reporting snapshot-committed — an ack must never point at bytes
+        that are still in flight."""
         self.manager.wait_until_finished()
 
+    def wait(self) -> None:
+        """Alias kept for existing callers (cull paths)."""
+        self.wait_until_finished()
+
     def close(self) -> None:
+        # draining first makes close() safe to call on the teardown path:
+        # closing with an async save in flight would abandon it
+        self.manager.wait_until_finished()
         self.manager.close()
+
+
+def snapshot_for_suspend(manager: CheckpointManager, step: int, state: Any) -> int | None:
+    """The suspend barrier's save: force a checkpoint and BLOCK until it is
+    durable, then report the step that may be acked as snapshot-committed.
+
+    The in-pod session agent calls this when the platform requests a
+    suspend (``sessions/controller.py``); only after it returns may the
+    agent answer the snapshot RPC — the control plane's commit record must
+    never be written for an async save that a pod teardown could still
+    tear. Returns the committed step (None if nothing was saved)."""
+    manager.save(step, state, force=True)
+    manager.wait_until_finished()
+    return manager.latest_step()
 
 
 def resume_or_init(directory: str, init_fn, *args, **kwargs):

@@ -53,6 +53,14 @@ class ControllerConfig:
     # tests that run the notebook controller alone keep their semantics;
     # the shipped controller-manager process enables it (SCHEDULER_ENABLED).
     scheduler_enabled: bool = False
+    # Session lifecycle (kubeflow_tpu/sessions/): when enabled, every gang
+    # teardown (stop, cull, preemption) runs the suspend barrier — pods stay
+    # up until the session snapshot commits (or the force deadline), and a
+    # restart resumes from the snapshot instead of cold. Off by default for
+    # programmatic construction (same rationale as scheduler_enabled); the
+    # shipped controller-manager process enables it (SESSIONS_ENABLED).
+    sessions_enabled: bool = False
+    suspend_deadline_s: float = 120.0
     # Profile defaults (ref --namespace-labels-path flag, profile-controller
     # main.go; the mounted file is hot-reloaded, go:356-405)
     namespace_labels_path: str = ""
@@ -75,6 +83,8 @@ class ControllerConfig:
             dev=_env_bool("DEV", False),
             tpu_gang_schedule=_env_bool("TPU_GANG_SCHEDULE", True),
             scheduler_enabled=_env_bool("SCHEDULER_ENABLED", True),
+            sessions_enabled=_env_bool("SESSIONS_ENABLED", True),
+            suspend_deadline_s=_env_float("SUSPEND_DEADLINE_S", 120.0),
             namespace_labels_path=os.environ.get("NAMESPACE_LABELS_PATH", ""),
             enable_oauth_controller=_env_bool("ENABLE_OAUTH_CONTROLLER", False),
         )

@@ -401,6 +401,64 @@ class ControlPlaneMetrics:
         self.queue_wait.observe(seconds)
 
 
+class SessionMetrics:
+    """Session-lifecycle observability (docs/sessions.md): suspend-barrier
+    latency (request→commit), time-to-resume (resume start→restore
+    complete), and the failure/force counters an operator tunes the force
+    deadline against. Shares a registry with the other collectors so one
+    /metrics scrape carries the whole story; ``SESSIONS_BENCH`` reads its
+    p50/p99 straight off these histograms.
+    """
+
+    # suspend: dominated by the snapshot write (seconds); resume: dominated
+    # by the queue wait + gang start (seconds to hours)
+    SUSPEND_BUCKETS = (0.5, 1.0, 5.0, 15.0, 60.0, 120.0, 300.0, 900.0)
+    RESUME_BUCKETS = (1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 3600.0, 14400.0)
+
+    def __init__(self, registry: Registry | None = None) -> None:
+        self.registry = registry or Registry()
+        self.suspends = self.registry.counter(
+            "session_suspend_total",
+            "Sessions suspended with a committed snapshot, per reason",
+            labelnames=("reason",),
+        )
+        self.resumes = self.registry.counter(
+            "session_resume_total",
+            "Sessions resumed (from a snapshot or cold)",
+            labelnames=("from_snapshot",),
+        )
+        self.snapshot_failures = self.registry.counter(
+            "session_snapshot_failed_total",
+            "Snapshot attempts that failed (store write or verify)",
+        )
+        self.force_suspends = self.registry.counter(
+            "session_force_suspend_total",
+            "Suspends that hit the force deadline without a snapshot",
+        )
+        self.suspended = self.registry.gauge(
+            "session_suspended",
+            "Sessions currently suspended (snapshot held, no pods)",
+        )
+        self.suspend_latency = self.registry.histogram(
+            "session_suspend_seconds",
+            "Suspend-request→snapshot-commit latency (the barrier's hold time)",
+            buckets=self.SUSPEND_BUCKETS,
+        )
+        self.time_to_resume = self.registry.histogram(
+            "session_resume_seconds",
+            "Resume-start→restore-complete latency (includes any queue wait)",
+            buckets=self.RESUME_BUCKETS,
+        )
+
+    def observe_suspend(self, seconds: float, reason: str) -> None:
+        self.suspends.inc(reason=reason)
+        self.suspend_latency.observe(max(0.0, seconds))
+
+    def observe_resume(self, seconds: float, *, from_snapshot: bool) -> None:
+        self.resumes.inc(from_snapshot="true" if from_snapshot else "false")
+        self.time_to_resume.observe(max(0.0, seconds))
+
+
 class SchedulerMetrics:
     """Fleet-scheduler observability (docs/scheduler.md): queue pressure,
     time-to-bind, fleet utilization, and preemption churn — the four numbers

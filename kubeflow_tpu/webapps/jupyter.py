@@ -24,6 +24,7 @@ Status derivation for the index table follows the reference's CR+events logic
 from __future__ import annotations
 
 from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu import sessions as sess
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.auth.rbac import Authorizer
 from kubeflow_tpu.controllers.notebook_controller import REWRITE_ANNOTATION
@@ -41,16 +42,36 @@ import time
 
 def notebook_status(nb: dict, events: list[dict]) -> dict:
     """Derive UI status (ref status.py:9-99), extended with the fleet
-    scheduler's conditions: a queued gang says WHERE it is in line instead
-    of a generic "pending", an unschedulable one says why it never will be."""
+    scheduler's conditions — a queued gang says WHERE it is in line instead
+    of a generic "pending", an unschedulable one says why it never will be —
+    and the session lifecycle (sessions/): a suspending gang says its work
+    is being snapshotted, a suspended one that resume restores it, a
+    resuming one that the snapshot is loading."""
     anns = ko.annotations(nb)
     ready = nb.get("status", {}).get("readyReplicas", 0)
     topo = api.notebook_topology(nb)
     expected = (
         topo.num_hosts * api.notebook_num_slices(nb) if topo else 1
     )
+    state = sess.session_state(nb)
+    snapshot = sess.snapshot_record(nb)
     if api.STOP_ANNOTATION in anns:
+        if state == sess.STATE_SUSPENDING or (
+            ready > 0 and sess.suspend_request(nb) is not None
+            and snapshot is None
+        ):
+            return {
+                "phase": "terminating",
+                "message": "Suspending: snapshotting session state "
+                           "before scaling down.",
+            }
         if ready == 0:
+            if snapshot is not None:
+                return {
+                    "phase": "suspended",
+                    "message": "Suspended. Starting the server resumes "
+                               "from the saved session snapshot.",
+                }
             return {"phase": "stopped", "message": "No Pods are currently running."}
         return {"phase": "terminating", "message": "Notebook Server is stopping."}
     if ready >= expected:
@@ -71,7 +92,19 @@ def notebook_status(nb: dict, events: list[dict]) -> dict:
                 f"Preempted ({preempted.get('message') or 'by a higher-priority gang'}); "
                 f"re-queued ({detail})."
             )
+        if state == sess.STATE_RESUMING or (
+            state == sess.STATE_SUSPENDED and snapshot is not None
+        ):
+            # queue wait first, restore after: both facts on one line
+            message += " Session snapshot will be restored on start."
         return {"phase": "waiting", "message": message}
+    if state in (sess.STATE_RESUMING, sess.STATE_SUSPENDED):
+        return {
+            "phase": "resuming",
+            "message": "Resuming: restoring the saved session snapshot."
+            if snapshot is not None
+            else "Resuming (no snapshot was saved; starting fresh).",
+        }
     warnings = [e for e in events if e.get("type") == "Warning"]
     if warnings:
         return {"phase": "warning", "message": warnings[-1].get("message", "")}
@@ -435,10 +468,25 @@ def build_notebook(body: dict, namespace: str, defaults: dict, creator: str) -> 
     accelerator = tpu.get("accelerator") or "none"
     tpu_kwargs = {}
     if accelerator != "none":
+        raw_slices = tpu.get("numSlices")
+        if raw_slices in (None, ""):
+            raw_slices = 1
+        try:
+            num_slices = int(raw_slices)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"tpu.numSlices must be a positive integer, got {raw_slices!r}"
+            )
+        # api.notebook rejects < 1 too, but erroring here names the FORM
+        # field (the old `or 1` silently ran numSlices=0 as a single slice)
+        if num_slices < 1:
+            raise ValueError(
+                f"tpu.numSlices must be a positive integer, got {raw_slices!r}"
+            )
         tpu_kwargs = {
             "tpu_accelerator": accelerator,
             "tpu_topology": tpu.get("topology", ""),
-            "tpu_num_slices": int(tpu.get("numSlices", 1) or 1),
+            "tpu_num_slices": num_slices,
         }
 
     server_type = fv(body, defaults, "serverType")

@@ -51,6 +51,8 @@
     waiting: "⏳",
     warning: "⚠",
     stopped: "⏹",
+    suspended: "⏸",
+    resuming: "↻",
     terminating: "…",
   };
   function statusIcon(phase) {

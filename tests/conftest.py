@@ -32,6 +32,21 @@ def cluster():
     return FakeCluster()
 
 
+def cookie_value(client, name):
+    """Werkzeug test-client cookie lookup across versions
+    (``Client.get_cookie`` landed in 2.3; older clients expose the cookie
+    jar). Shared by the webapp/frontend/standalone suites — three diverging
+    copies of this compat shim is how one of them rots."""
+    getter = getattr(client, "get_cookie", None)
+    if getter is not None:
+        cookie = getter(name)
+        return cookie.value if cookie is not None else None
+    for cookie in getattr(client, "cookie_jar", []) or []:
+        if cookie.name == name:
+            return cookie.value
+    return None
+
+
 def eventually(fn, timeout=8.0, interval=0.05):
     """envtest's Eventually(): poll until fn() returns truthy (shared by the
     conformance/stress/deploy-shape suites)."""

@@ -193,3 +193,140 @@ def test_replayed_events_do_not_double_stop():
         rec.reconcile(cluster, "ns", "n")
         anns = cluster.get("Notebook", "n", "ns")["metadata"]["annotations"]
         assert anns[api.STOP_ANNOTATION] == stop_ts, "double-stop rewrote the timestamp"
+
+
+class TestTimestampRobustness:
+    """Malformed / hand-edited timestamp annotations must never wedge the
+    culling loop: unparseable reads as missing (re-stamped, with a warning
+    surfaced), future-dated reads as not-idle, and a missing timezone is
+    just another malformed string."""
+
+    def test_malformed_last_activity_is_restamped_not_fatal(self):
+        nb = _nb({api.LAST_ACTIVITY_ANNOTATION: "not-a-timestamp"})
+        cul = _culler(now=1000.0)
+        warnings = []
+        assert cul.update_last_activity(nb, warnings)
+        anns = nb["metadata"]["annotations"]
+        assert anns[api.LAST_ACTIVITY_ANNOTATION] == c.format_time(1000.0)
+        assert len(warnings) == 1 and "not-a-timestamp" in warnings[0]
+        # the repaired clock runs normally from here
+        assert not cul.needs_culling(nb)
+        cul.clock = lambda: 1000.0 + 601.0
+        assert cul.needs_culling(nb)
+
+    def test_missing_timezone_is_malformed(self):
+        nb = _nb({api.LAST_ACTIVITY_ANNOTATION: "2026-01-01T00:00:00"})
+        cul = _culler(now=1000.0)
+        warnings = []
+        assert cul.update_last_activity(nb, warnings)
+        assert nb["metadata"]["annotations"][
+            api.LAST_ACTIVITY_ANNOTATION] == c.format_time(1000.0)
+        assert warnings
+
+    def test_future_dated_last_activity_never_culls(self):
+        future = c.format_time(2_000_000_000.0)
+        nb = _nb({api.LAST_ACTIVITY_ANNOTATION: future})
+        cul = _culler(now=1000.0)
+        assert not cul.needs_culling(nb)
+        # parseable: NOT re-stamped (the clock may simply be skewed), and
+        # no warning storm
+        warnings = []
+        cul.update_last_activity(nb, warnings)
+        assert warnings == []
+
+    def test_malformed_check_timestamp_forces_a_check(self):
+        nb = _nb({
+            api.LAST_ACTIVITY_ANNOTATION: c.format_time(900.0),
+            api.LAST_ACTIVITY_CHECK_TS: "garbage",
+        })
+        cul = _culler(now=1000.0)
+        assert cul.needs_check(nb)
+
+    def test_malformed_annotation_emits_warning_event(self):
+        """End to end through the notebook controller: the re-stamp lands on
+        the CR and a Warning event tells the operator what happened."""
+        from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+        from kubeflow_tpu.obs.events import EventRecorder
+        from kubeflow_tpu.runtime.fake import FakeCluster
+        from kubeflow_tpu.utils.config import ControllerConfig
+
+        cluster = FakeCluster()
+        cluster.create(api.notebook("n", "ns", annotations={
+            api.LAST_ACTIVITY_ANNOTATION: "kubectl-edited-garbage"}))
+        cul = c.Culler(
+            enabled=True, cull_idle_minutes=10, check_period_minutes=1,
+            fetch_kernels=lambda ns, name: [], clock=lambda: 1000.0,
+        )
+        rec = NotebookReconciler(
+            ControllerConfig(), culler=cul, recorder=EventRecorder())
+        rec.reconcile(cluster, "ns", "n")
+        anns = cluster.get("Notebook", "n", "ns")["metadata"]["annotations"]
+        assert anns[api.LAST_ACTIVITY_ANNOTATION] == c.format_time(1000.0)
+        events = [e for e in cluster.list("Event", "ns")
+                  if e["reason"] == "MalformedAnnotation"]
+        assert len(events) == 1 and events[0]["type"] == "Warning"
+        assert "kubectl-edited-garbage" in events[0]["message"]
+
+
+class TestSuspendVsStopTransition:
+    """With sessions enabled, a cull writes stop AND rides the suspend
+    barrier; with sessions disabled the stop stays a plain stop — the
+    transition between the two annotation regimes must be clean."""
+
+    def _world(self, sessions_enabled):
+        from kubeflow_tpu import sessions as sess
+        from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+        from kubeflow_tpu.runtime.fake import FakeCluster
+        from kubeflow_tpu.utils.config import ControllerConfig
+
+        class Clock:
+            t = 1000.0
+
+            def __call__(self):
+                return self.t
+
+        clock = Clock()
+        cluster = FakeCluster()
+        cul = c.Culler(
+            enabled=True, cull_idle_minutes=1.0, check_period_minutes=0.1,
+            fetch_kernels=lambda ns, name: [], clock=clock,
+        )
+        rec = NotebookReconciler(
+            ControllerConfig(
+                sessions_enabled=sessions_enabled, suspend_deadline_s=60.0
+            ),
+            culler=cul, clock=clock,
+        )
+        return cluster, rec, clock, sess
+
+    def _cull(self, cluster, rec, clock):
+        cluster.create(api.notebook("n", "ns"))
+        rec.reconcile(cluster, "ns", "n")
+        cluster.step_kubelet()
+        cluster.step_kubelet()
+        rec.reconcile(cluster, "ns", "n")  # seeds last-activity
+        clock.t += 120.0
+        rec.reconcile(cluster, "ns", "n")  # culls (stop annotation lands)
+        rec.reconcile(cluster, "ns", "n")  # acts on the stop (teardown)
+
+    def test_sessions_enabled_cull_requests_suspend_and_holds_pods(self):
+        cluster, rec, clock, sess = self._world(True)
+        self._cull(cluster, rec, clock)
+        nb = cluster.get("Notebook", "n", "ns")
+        assert api.STOP_ANNOTATION in nb["metadata"]["annotations"]
+        req = sess.suspend_request(nb)
+        assert req is not None and req["reason"] == sess.REASON_STOP
+        # the barrier holds the pod for the snapshot
+        assert cluster.get("StatefulSet", "n", "ns")["spec"]["replicas"] == 1
+        # ...but not past the force deadline
+        clock.t += 61.0
+        rec.reconcile(cluster, "ns", "n")
+        assert cluster.get("StatefulSet", "n", "ns")["spec"]["replicas"] == 0
+
+    def test_sessions_disabled_cull_is_a_plain_stop(self):
+        cluster, rec, clock, sess = self._world(False)
+        self._cull(cluster, rec, clock)
+        nb = cluster.get("Notebook", "n", "ns")
+        assert api.STOP_ANNOTATION in nb["metadata"]["annotations"]
+        assert not sess.session_engaged(nb)
+        assert cluster.get("StatefulSet", "n", "ns")["spec"]["replicas"] == 0

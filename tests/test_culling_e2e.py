@@ -207,3 +207,47 @@ class TestCullingOverHttp:
         advance_minutes(IDLE_MIN)
         nb = client.get("Notebook", "nb", "team")
         assert api.STOP_ANNOTATION in nb["metadata"]["annotations"]
+
+
+class TestTimestampRobustnessOverHttp:
+    def test_hand_edited_last_activity_does_not_wedge_culling(self, stack):
+        """A kubectl-edited, unparseable last-activity must not crash the
+        reconcile or make the notebook unkillable: the culler re-stamps it
+        through the REAL apiserver and the idle window then runs normally
+        from the repair."""
+        state, addr, client = stack
+        clock = {"t": 1_000_000.0}
+        culler = Culler(
+            enabled=True,
+            cull_idle_minutes=IDLE_MIN,
+            check_period_minutes=1,
+            fetch_kernels=http_fetch_kernels(addr),
+            clock=lambda: clock["t"],
+        )
+        m = Manager(client, clock=lambda: clock["t"])
+        m.register(NotebookReconciler(ControllerConfig(), culler=culler))
+        client.create(api.notebook("nb", "team", annotations={
+            api.LAST_ACTIVITY_ANNOTATION: "hand-edited ✂ garbage"}))
+
+        def settle(quiet=3):
+            zeros = 0
+            deadline = time.time() + 8
+            while zeros < quiet and time.time() < deadline:
+                zeros = zeros + 1 if m.tick() == 0 else 0
+                time.sleep(0.02)
+
+        settle()
+        nb = client.get("Notebook", "nb", "team")
+        from kubeflow_tpu.culler.culler import parse_time
+
+        # repaired in place: parseable, and stamped at the repair time
+        assert parse_time(
+            nb["metadata"]["annotations"][api.LAST_ACTIVITY_ANNOTATION]
+        ) == clock["t"]
+        # the repaired clock still culls once genuinely idle
+        state.execution_state = "idle"
+        for _ in range(IDLE_MIN + 3):
+            clock["t"] += 60
+            settle()
+        nb = client.get("Notebook", "nb", "team")
+        assert api.STOP_ANNOTATION in nb["metadata"]["annotations"]

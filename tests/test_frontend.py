@@ -44,12 +44,15 @@ def platform(cluster):
     return cluster, m
 
 
+from conftest import cookie_value as _cookie_value  # noqa: E402
+
+
 def auth(client, headers=ALICE):
-    cookie = client.get_cookie("XSRF-TOKEN")
-    if cookie is None:
+    value = _cookie_value(client, "XSRF-TOKEN")
+    if value is None:
         client.get("/healthz/liveness")
-        cookie = client.get_cookie("XSRF-TOKEN")
-    return {**headers, "X-XSRF-TOKEN": cookie.value}
+        value = _cookie_value(client, "XSRF-TOKEN")
+    return {**headers, "X-XSRF-TOKEN": value}
 
 
 def get_json(resp):

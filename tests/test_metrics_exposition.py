@@ -31,6 +31,7 @@ from kubeflow_tpu.utils.metrics import (
     NotebookMetrics,
     Registry,
     SchedulerMetrics,
+    SessionMetrics,
 )
 
 NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -151,29 +152,50 @@ def combined_registry() -> Registry:
     nm = NotebookMetrics()
     sm = SchedulerMetrics(nm.registry)
     cpm = ControlPlaneMetrics(nm.registry)
+    sessm = SessionMetrics(nm.registry)
     wq_gauge = nm.registry.gauge(
         "workqueue_stat", "Reconcile workqueue counters (native core)"
     )
 
     from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
     from kubeflow_tpu.scheduler.controller import SchedulerReconciler
+    from kubeflow_tpu.sessions.controller import SessionReconciler
+    from kubeflow_tpu.sessions.store import SnapshotStore
+    from kubeflow_tpu.testing.sessionstore import (
+        FakeObjectStore,
+        FakeSessionAgent,
+    )
     from kubeflow_tpu.utils.config import ControllerConfig
 
     cluster = FakeCluster()
     cluster.add_tpu_node_pool("v4", "2x2x2")
     tracer = Tracer()
     mgr = Manager(cluster, tracer=tracer, metrics=cpm)
-    cfg = ControllerConfig(scheduler_enabled=True)
+    cfg = ControllerConfig(scheduler_enabled=True, sessions_enabled=True)
     mgr.register(
         NotebookReconciler(cfg, metrics=nm, recorder=EventRecorder())
     )
     mgr.register(
-        SchedulerReconciler(metrics=sm, recorder=EventRecorder())
+        SchedulerReconciler(
+            metrics=sm, recorder=EventRecorder(),
+            suspend_deadline_s=cfg.suspend_deadline_s,
+        )
+    )
+    mgr.register(
+        SessionReconciler(
+            SnapshotStore(FakeObjectStore()), FakeSessionAgent(cluster),
+            config=cfg, metrics=sessm, recorder=EventRecorder(),
+        )
     )
     cluster.create(
         api.notebook("nb-lint", "team-metrics", tpu_accelerator="v4",
                      tpu_topology="2x2x2")
     )
+    cluster.settle(mgr, rounds=4)
+    # one suspend through the barrier so the session histograms carry data
+    cluster.patch("Notebook", "nb-lint", "team-metrics",
+                  {"metadata": {"annotations": {
+                      "kubeflow-resource-stopped": "2026-01-01T00:00:00Z"}}})
     cluster.settle(mgr, rounds=4)
     for k, v in mgr.queue_metrics().items():
         wq_gauge.set(float(v), stat=k)
@@ -190,8 +212,17 @@ class TestExpositionFormat:
             "controller_reconcile_duration_seconds",
             "workqueue_queue_wait_seconds",
             "scheduler_time_to_bind_seconds",
+            "session_suspend_seconds",
+            "session_resume_seconds",
         ):
             assert families[name]["type"] == "histogram", name
+        # the settle's stop ran the suspend barrier end to end: the suspend
+        # histogram must carry the observation
+        assert any(
+            v > 0
+            for s, _, v in families["session_suspend_seconds"]["samples"]
+            if s.endswith("_count")
+        )
         # ... and actually carry observations from the settle above
         assert any(
             v > 0

@@ -609,3 +609,166 @@ class TestSpawnerStatusText:
         nb = self._nb([{"type": "Queued", "status": "False"}])
         nb["status"]["readyReplicas"] = 2
         assert notebook_status(nb, [])["phase"] == "ready"
+
+
+# ------------------------------------------------- suspend-barrier handoff
+
+
+class TestPreemptionSuspendBarrier:
+    """Preemption end-to-end through the session suspend barrier
+    (docs/sessions.md): victim suspend → snapshot commit → chip release →
+    preemptor bound, with the victim resumable from its snapshot."""
+
+    def _platform(self, cluster, clock, agent, store):
+        from kubeflow_tpu.obs.events import EventRecorder
+        from kubeflow_tpu.sessions.controller import SessionReconciler
+
+        cfg = ControllerConfig(
+            scheduler_enabled=True, sessions_enabled=True,
+            suspend_deadline_s=120.0,
+        )
+        m = Manager(cluster, clock=clock)
+        m.register(NotebookReconciler(
+            cfg, clock=clock, recorder=EventRecorder(clock=clock)))
+        m.register(SchedulerReconciler(
+            clock=clock, suspend_deadline_s=120.0,
+            recorder=EventRecorder(clock=clock)))
+        m.register(SessionReconciler(
+            store, agent, config=cfg, clock=clock,
+            recorder=EventRecorder(clock=clock)))
+        return m
+
+    def test_victim_suspends_commits_releases_then_preemptor_binds(self, cluster):
+        import json as _json
+
+        from kubeflow_tpu import sessions as sess
+        from kubeflow_tpu.sessions.store import SnapshotStore
+        from kubeflow_tpu.testing.sessionstore import (
+            FakeObjectStore,
+            FakeSessionAgent,
+        )
+
+        class Clock:
+            t = 1_000_000.0
+
+            def __call__(self):
+                return self.t
+
+        class GatedAgent(FakeSessionAgent):
+            ready = False
+
+            def snapshot(self, ns, name):
+                return super().snapshot(ns, name) if self.ready else None
+
+        clock = Clock()
+        agent = GatedAgent(cluster)
+        store = SnapshotStore(FakeObjectStore())
+        make_pool(cluster, "v4", "2x2x2", "tiny")  # one gang's worth
+        mgr = self._platform(cluster, clock, agent, store)
+
+        cluster.create(api.notebook("victim", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        victim = cluster.get("Notebook", "victim", NS)
+        assert sched.placement_of(victim) is not None
+        queued_at = victim["metadata"]["annotations"][
+            sched.QUEUED_AT_ANNOTATION]
+        agent.work["team-a/victim"] = 33  # the work preemption must not lose
+
+        cluster.create(api.notebook(
+            "urgent", NS, tpu_accelerator="v4", tpu_topology="2x2x2",
+            annotations={sched.PRIORITY_ANNOTATION: "10"},
+        ))
+        cluster.settle(mgr)
+        # barrier holds: the victim was ASKED to suspend, but until its
+        # snapshot commits it keeps the chips and the pods — the preemptor
+        # waits (no kill-first handoff)
+        victim = cluster.get("Notebook", "victim", NS)
+        req = sess.suspend_request(victim)
+        assert req is not None and req["reason"] == sess.REASON_PREEMPTION
+        assert sched.placement_of(victim) is not None
+        assert sched.placement_of(
+            cluster.get("Notebook", "urgent", NS)) is None
+        assert cluster.get("StatefulSet", "victim", NS)["spec"]["replicas"] == 2
+
+        # the agent comes back: snapshot commits → ack → release → bind
+        # (the barrier's poll timers fire on clock advances)
+        agent.ready = True
+        for _ in range(4):
+            clock.t += 10.0
+            cluster.settle(mgr)
+        victim = cluster.get("Notebook", "victim", NS)
+        urgent = cluster.get("Notebook", "urgent", NS)
+        assert sched.placement_of(urgent) is not None
+        assert sched.placement_of(victim) is None
+        ack = sess.snapshot_record(victim)
+        assert ack is not None
+        assert _json.loads(store.load("team-a/victim"))["work"] == 33
+        # the spent request was retired with the release (one write), the
+        # Preempted condition is visible, and seniority survived
+        assert sess.suspend_request(victim) is None
+        assert victim["metadata"]["annotations"][
+            sched.QUEUED_AT_ANNOTATION] == queued_at
+        conds = _conds(victim)
+        assert conds["Preempted"]["status"] == "True"
+        assert conds["Queued"]["status"] == "True"
+
+        # capacity returns: the victim re-binds and resumes FROM THE
+        # SNAPSHOT (never cold) — the no-loss promise, end to end
+        cluster.patch("Notebook", "urgent", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        for _ in range(6):
+            clock.t += 30.0
+            cluster.settle(mgr)
+        victim = cluster.get("Notebook", "victim", NS)
+        assert sched.placement_of(victim) is not None
+        assert not sess.session_engaged(victim)
+        assert ("team-a/victim", ack["snapshotId"]) in agent.restores
+        assert agent.work["team-a/victim"] >= 33
+        reasons = {e["reason"] for e in cluster.list("Event", NS)}
+        assert {"Preempted", "Suspended", "Resumed"} <= reasons
+
+    def test_force_deadline_releases_wedged_victim(self, cluster):
+        """A victim whose agent never answers cannot hold the preemptor
+        hostage: past the force deadline the chips move anyway (cold — but
+        nothing was acked, so nothing promised was lost)."""
+        from kubeflow_tpu import sessions as sess
+        from kubeflow_tpu.sessions.store import SnapshotStore
+        from kubeflow_tpu.testing.sessionstore import (
+            FakeObjectStore,
+            FakeSessionAgent,
+        )
+
+        class Clock:
+            t = 1_000_000.0
+
+            def __call__(self):
+                return self.t
+
+        class DeadAgent(FakeSessionAgent):
+            def snapshot(self, ns, name):
+                return None
+
+        clock = Clock()
+        store = SnapshotStore(FakeObjectStore())
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        mgr = self._platform(cluster, clock, DeadAgent(cluster), store)
+        cluster.create(api.notebook("victim", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.settle(mgr)
+        cluster.create(api.notebook(
+            "urgent", NS, tpu_accelerator="v4", tpu_topology="2x2x2",
+            annotations={sched.PRIORITY_ANNOTATION: "10"},
+        ))
+        cluster.settle(mgr)
+        assert sched.placement_of(
+            cluster.get("Notebook", "urgent", NS)) is None
+        clock.t += 121.0  # past the 120 s force deadline
+        for _ in range(3):
+            clock.t += 10.0
+            cluster.settle(mgr)
+        victim = cluster.get("Notebook", "victim", NS)
+        assert sched.placement_of(victim) is None
+        assert sess.snapshot_record(victim) is None  # nothing falsely acked
+        assert sched.placement_of(
+            cluster.get("Notebook", "urgent", NS)) is not None

@@ -1,0 +1,400 @@
+"""Session lifecycle: snapshot store semantics, the suspend/resume state
+machine, and the teardown barrier (docs/sessions.md).
+
+Store tests pin the write-ahead/commit discipline in isolation (torn and
+uncommitted snapshots are never restorable; a lost commit write is absorbed
+by read-back verification). Integration tests run the shipped stack — the
+notebook controller's teardown barrier and the sessions controller — against
+the in-memory cluster, asserting through the store and the CR annotations,
+never through controller internals.
+"""
+from __future__ import annotations
+
+import json
+
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu import sessions as sess
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.culler.culler import Culler
+from kubeflow_tpu.obs.events import EventRecorder
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.sessions.controller import SessionReconciler
+from kubeflow_tpu.sessions.store import (
+    SnapshotStore,
+    SnapshotUnavailable,
+    StoreError,
+)
+from kubeflow_tpu.testing.chaos import ChaosCluster, ChaosConfig
+from kubeflow_tpu.testing.sessionstore import (
+    FakeObjectStore,
+    FakeSessionAgent,
+    StoreChaosConfig,
+)
+from kubeflow_tpu.utils.config import ControllerConfig
+
+import pytest
+
+NS = "team-a"
+
+
+# ------------------------------------------------------------------- store
+
+
+class TestSnapshotStore:
+    def _store(self, **chaos):
+        objects = FakeObjectStore(
+            seed=7, chaos=StoreChaosConfig(**chaos) if chaos else None
+        )
+        return SnapshotStore(objects), objects
+
+    def test_save_load_roundtrip(self):
+        store, _ = self._store()
+        rec = store.save("ns/nb", b"payload-1", snapshot_id="abc", now=10.0)
+        assert rec["snapshotId"] == "abc"
+        assert store.load("ns/nb") == b"payload-1"
+        assert store.load("ns/nb", "abc") == b"payload-1"
+        assert store.committed("ns/nb")["snapshotId"] == "abc"
+
+    def test_uncommitted_snapshot_is_never_restored(self):
+        """WAL + data without a commit record is an in-flight write, not a
+        snapshot — restore must not see it."""
+        store, objects = self._store()
+        objects.put("sessions/ns/nb/sid1.wal", b"{}")
+        objects.put("sessions/ns/nb/sid1.data", b"half-written state")
+        assert store.committed("ns/nb") is None
+        with pytest.raises(SnapshotUnavailable):
+            store.load("ns/nb")
+
+    def test_torn_commit_falls_back_to_previous_snapshot(self):
+        """The torn-latest_step discipline: a commit record the writer died
+        inside (half the bytes) must read as 'not committed', and restore
+        falls back to the newest older snapshot that verifies."""
+        store, objects = self._store()
+        store.save("ns/nb", b"old state", snapshot_id="old1", now=10.0)
+        good = json.dumps({
+            "snapshotId": "new2", "digest": "0" * 64, "size": 9,
+            "committedAt": 20.0,
+        }).encode()
+        objects.put("sessions/ns/nb/new2.data", b"new state")
+        objects.put("sessions/ns/nb/new2.commit", good[: len(good) // 2])
+        assert store.commit_record("ns/nb", "new2") is None
+        assert store.committed("ns/nb")["snapshotId"] == "old1"
+        assert store.load("ns/nb") == b"old state"
+
+    def test_torn_data_is_never_restored(self):
+        store, objects = self._store()
+        store.save("ns/nb", b"old state", snapshot_id="old1", now=10.0)
+        # commit parses, but the data it points at is truncated: the digest
+        # check must reject it
+        rec = {"snapshotId": "new2",
+               "digest": "a" * 64, "size": 4, "committedAt": 20.0}
+        objects.put("sessions/ns/nb/new2.data", b"ha")
+        objects.put("sessions/ns/nb/new2.commit",
+                    json.dumps(rec).encode())
+        assert store.committed("ns/nb")["snapshotId"] == "old1"
+
+    def test_lost_commit_write_retries_idempotently(self):
+        """A commit put that applied but errored (lost response) fails the
+        save — no ack may be written — and the retry with the SAME snapshot
+        id overwrites cleanly instead of leaking objects."""
+        store, objects = self._store(error_rate=0.0, lost_rate=1.0,
+                                     torn_rate=0.0)
+        with pytest.raises(StoreError):
+            store.save("ns/nb", b"state", snapshot_id="s1", now=10.0)
+        objects.heal()
+        rec = store.save("ns/nb", b"state", snapshot_id="s1", now=11.0)
+        assert rec["snapshotId"] == "s1"
+        assert store.load("ns/nb") == b"state"
+        # exactly one snapshot's objects exist (wal, data, commit)
+        assert len(objects.list("sessions/ns/nb")) == 3
+
+    def test_prune_keeps_fallback_snapshots(self):
+        store, objects = self._store()
+        for i in range(5):
+            store.save("ns/nb", f"v{i}".encode(),
+                       snapshot_id=f"sid{i}", now=float(i))
+        ids = {k.split("/")[-1].split(".")[0]
+               for k in objects.list("sessions/ns/nb")}
+        assert ids == {"sid3", "sid4"}  # keep=2
+        assert store.load("ns/nb") == b"v4"
+
+
+# ------------------------------------------------------ integration harness
+
+
+class _Clock:
+    def __init__(self, start: float = 1_000_000.0) -> None:
+        self.t = start
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+def _world(*, culling=False, busy=False, deadline=60.0, agent=None):
+    cluster = FakeCluster()
+    clock = _Clock()
+    cfg = ControllerConfig(
+        sessions_enabled=True, suspend_deadline_s=deadline
+    )
+    culler = Culler(
+        enabled=culling,
+        cull_idle_minutes=1.0,
+        check_period_minutes=0.25,
+        fetch_kernels=(
+            (lambda ns, n: [{"execution_state": "busy"}]) if busy
+            else (lambda ns, n: [])
+        ),
+        clock=clock,
+    )
+    objects = FakeObjectStore()
+    store = SnapshotStore(objects)
+    agent = agent or FakeSessionAgent(cluster)
+    mgr = Manager(cluster, clock=clock)
+    mgr.register(
+        NotebookReconciler(
+            cfg, culler=culler, clock=clock,
+            recorder=EventRecorder(clock=clock),
+        )
+    )
+    mgr.register(
+        SessionReconciler(
+            store, agent, config=cfg, clock=clock,
+            recorder=EventRecorder(clock=clock),
+        )
+    )
+    return cluster, mgr, clock, store, agent
+
+
+def _drive(cluster, mgr, clock, *, rounds=4, dt=10.0):
+    for _ in range(rounds):
+        cluster.step_kubelet()
+        mgr.tick()
+        clock.advance(dt)
+
+
+def _anns(cluster, name):
+    return cluster.get("Notebook", name, NS)["metadata"].get(
+        "annotations", {}
+    )
+
+
+class TestSuspendResume:
+    def test_stop_becomes_suspend_and_start_resumes(self):
+        """The full machine: stop → Suspending (pods held) → snapshot
+        committed → Suspended (scaled to zero) → start → Resuming →
+        restored → Running, with the ack cleared only after the restore.
+        The agent is gated so the Suspending hold is observable (a healthy
+        barrier otherwise resolves within one reconcile drain)."""
+
+        class GatedAgent(FakeSessionAgent):
+            ready = False
+
+            def snapshot(self, ns, name):
+                return super().snapshot(ns, name) if self.ready else None
+
+        cluster, mgr, clock, store, agent = _world()
+        agent = GatedAgent(cluster)
+        # rebind the registered sessions reconciler to the gated agent
+        mgr._reconcilers[1].agent = agent
+        cluster.create(api.notebook("nb", NS))
+        _drive(cluster, mgr, clock, rounds=3)
+        assert cluster.get("StatefulSet", "nb", NS)["spec"]["replicas"] == 1
+        agent.work["team-a/nb"] = 42  # the state a kill would destroy
+
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        mgr.tick()
+        # barrier engaged: request written, pods held up while the agent
+        # has not yet produced a snapshot
+        anns = _anns(cluster, "nb")
+        assert sess.suspend_request({"metadata": {"annotations": anns}})
+        _drive(cluster, mgr, clock, rounds=2, dt=5.0)
+        assert cluster.get("StatefulSet", "nb", NS)["spec"]["replicas"] == 1
+        assert sess.snapshot_record(cluster.get("Notebook", "nb", NS)) is None
+
+        agent.ready = True
+        _drive(cluster, mgr, clock, rounds=3)
+        nb = cluster.get("Notebook", "nb", NS)
+        ack = sess.snapshot_record(nb)
+        assert ack is not None, "snapshot never acked"
+        assert sess.session_state(nb) == sess.STATE_SUSPENDED
+        # ack points at a store-committed, digest-verified snapshot
+        rec = store.commit_record("team-a/nb", ack["snapshotId"])
+        assert rec is not None
+        assert json.loads(store.load("team-a/nb", ack["snapshotId"]))[
+            "work"] == 42
+        # only after the ack did the gang scale to zero
+        assert cluster.get("StatefulSet", "nb", NS)["spec"]["replicas"] == 0
+        reasons = {e["reason"] for e in cluster.list("Event", NS)}
+        assert "Suspended" in reasons
+
+        # one-click resume: remove the stop annotation (what the spawner's
+        # Resume button PATCHes)
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: None}}})
+        agent.work["team-a/nb"] = 0  # fresh pods boot cold...
+        _drive(cluster, mgr, clock, rounds=4)
+        nb = cluster.get("Notebook", "nb", NS)
+        assert not sess.session_engaged(nb), "resume did not clear the machinery"
+        assert agent.work["team-a/nb"] >= 42, "restored work was lost"
+        assert ("team-a/nb", ack["snapshotId"]) in agent.restores
+        reasons = {e["reason"] for e in cluster.list("Event", NS)}
+        assert "Resumed" in reasons
+
+    def test_cull_is_a_suspend(self):
+        """The culler's stop annotation rides the same barrier: an idle
+        notebook scales to zero only after its snapshot commits, and is
+        resumable."""
+        cluster, mgr, clock, store, agent = _world(culling=True)
+        cluster.create(api.notebook("nb", NS))
+        _drive(cluster, mgr, clock, rounds=3)
+        agent.work["team-a/nb"] = 7
+        # idle past the 60 s threshold: culled, then suspended
+        _drive(cluster, mgr, clock, rounds=6, dt=30.0)
+        nb = cluster.get("Notebook", "nb", NS)
+        assert api.STOP_ANNOTATION in nb["metadata"]["annotations"]
+        ack = sess.snapshot_record(nb)
+        assert ack is not None
+        assert cluster.get("StatefulSet", "nb", NS)["spec"]["replicas"] == 0
+        assert json.loads(store.load("team-a/nb"))["work"] >= 7
+
+    def test_force_deadline_proceeds_cold(self):
+        """An unreachable session agent cannot hold the teardown forever:
+        past the force deadline the gang scales to zero with no ack (nothing
+        promised, nothing lost) and a SnapshotFailed warning lands."""
+
+        class DeadAgent:
+            def snapshot(self, ns, name):
+                return None
+
+            def restore(self, ns, name, payload, sid):
+                return False
+
+        cluster, mgr, clock, store, agent = _world(
+            agent=DeadAgent(), deadline=30.0
+        )
+        cluster.create(api.notebook("nb", NS))
+        _drive(cluster, mgr, clock, rounds=3)
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        mgr.tick()
+        assert cluster.get("StatefulSet", "nb", NS)["spec"]["replicas"] == 1
+        _drive(cluster, mgr, clock, rounds=5, dt=10.0)
+        nb = cluster.get("Notebook", "nb", NS)
+        assert sess.snapshot_record(nb) is None
+        assert sess.session_state(nb) == sess.STATE_SUSPENDED
+        assert cluster.get("StatefulSet", "nb", NS)["spec"]["replicas"] == 0
+        reasons = {e["reason"] for e in cluster.list("Event", NS)}
+        assert "SnapshotFailed" in reasons
+
+    def test_stop_retracted_mid_suspend_aborts_barrier(self):
+        """A user starting the server back up before the snapshot commits
+        must get their live session back untouched — the barrier aborts
+        instead of suspending a gang nobody wants down."""
+
+        class SlowAgent(FakeSessionAgent):
+            def snapshot(self, ns, name):
+                return None  # never answers: the barrier stays open
+
+        cluster, mgr, clock, _, _ = _world(
+            agent=SlowAgent(FakeCluster()), deadline=300.0
+        )
+        cluster.create(api.notebook("nb", NS))
+        _drive(cluster, mgr, clock, rounds=3)
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        mgr.tick()
+        assert sess.suspend_request(cluster.get("Notebook", "nb", NS))
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: None}}})
+        _drive(cluster, mgr, clock, rounds=2)
+        nb = cluster.get("Notebook", "nb", NS)
+        assert not sess.session_engaged(nb)
+        assert cluster.get("StatefulSet", "nb", NS)["spec"]["replicas"] == 1
+
+    def test_crash_restart_inside_barrier_acks_exactly_once(self):
+        """A controller crash between any two writes of the barrier must
+        replay, not lose: the restarted incarnation re-derives Suspending
+        from the annotations, retries the snapshot with the SAME
+        deterministic id, and the run ends with one committed snapshot."""
+        base = FakeCluster()
+        clock = _Clock()
+        cfg = ControllerConfig(
+            sessions_enabled=True, suspend_deadline_s=300.0
+        )
+        chaos = ChaosCluster(base, seed=5, config=ChaosConfig.quiet())
+        objects = FakeObjectStore()
+        store = SnapshotStore(objects)
+        agent = FakeSessionAgent(base)
+
+        def build():
+            m = Manager(chaos, clock=clock)
+            m.register(NotebookReconciler(cfg, clock=clock))
+            m.register(
+                SessionReconciler(store, agent, config=cfg, clock=clock)
+            )
+            return m
+
+        mgr = build()
+        base.create(api.notebook("nb", NS))
+        for _ in range(3):
+            base.step_kubelet()
+            mgr.tick()
+            clock.advance(5.0)
+        agent.work["team-a/nb"] = 9
+        base.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        # kill the controller between consecutive writes, repeatedly — the
+        # suspend request, the state flip, and the ack all get a crash
+        # boundary armed after them across iterations
+        for after in (1, 1, 1, 1):
+            chaos.arm_crash(after_writes=after)
+            try:
+                mgr.tick()
+            except Exception:
+                pass
+            if chaos.take_crash():
+                mgr.shutdown()
+                mgr = build()
+            clock.advance(5.0)
+        for _ in range(4):
+            base.step_kubelet()
+            mgr.tick()
+            clock.advance(5.0)
+        nb = base.get("Notebook", "nb", NS)
+        ack = sess.snapshot_record(nb)
+        assert ack is not None
+        assert store.commit_record("team-a/nb", ack["snapshotId"])
+        assert json.loads(store.load("team-a/nb"))["work"] == 9
+        # deterministic id: the retries converged on ONE snapshot, not a
+        # trail of half-written ones
+        ids = {k.split("/")[-1].split(".")[0]
+               for k in objects.list("sessions/team-a/nb")}
+        assert ids == {ack["snapshotId"]}
+
+    def test_resume_restores_original_queue_seniority(self):
+        """The ack carries queued-at; a resume re-stamps it so the scheduler
+        ages the gang from its ORIGINAL submit time."""
+        cluster, mgr, clock, store, agent = _world()
+        cluster.create(api.notebook("nb", NS))
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            sched.QUEUED_AT_ANNOTATION: "123456.0"}}})
+        _drive(cluster, mgr, clock, rounds=3)
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        _drive(cluster, mgr, clock, rounds=4)
+        nb = cluster.get("Notebook", "nb", NS)
+        ack = sess.snapshot_record(nb)
+        assert ack is not None and float(ack["queuedAt"]) == 123456.0
+        # the stop dropped the live annotation (scheduler semantics); wipe
+        # it explicitly to model the release
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            sched.QUEUED_AT_ANNOTATION: None,
+            api.STOP_ANNOTATION: None}}})
+        _drive(cluster, mgr, clock, rounds=4)
+        assert _anns(cluster, "nb")[sched.QUEUED_AT_ANNOTATION] == repr(123456.0)

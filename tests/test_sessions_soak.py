@@ -1,0 +1,52 @@
+"""Session-lifecycle chaos soak (docs/sessions.md).
+
+Mirrors the scheduler soak suite's split (``test_sched_soak.py``): a
+deterministic-replay check, a short tier-1 seed sweep, and the slow-marked
+nightly sweep. Seed ranges are disjoint from the CI workflow's
+``tools/sessions_soak.py`` step (which starts at 26), so the two runs buy
+coverage instead of duplicating it.
+"""
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.sessions.soak import run_session_seed
+from kubeflow_tpu.testing.chaos import ChaosConfig
+from kubeflow_tpu.testing.sessionstore import StoreChaosConfig
+
+CI_SEEDS = range(1, 26)
+NIGHTLY_SEEDS = range(1, 501)
+
+
+class TestDeterminism:
+    def test_same_seed_identical_run(self):
+        """Everything flows from the seed — fleet, gangs, timeline, API
+        faults, store faults — so a printed failing seed is a complete bug
+        report."""
+        a = run_session_seed(17, ChaosConfig(), StoreChaosConfig())
+        b = run_session_seed(17, ChaosConfig(), StoreChaosConfig())
+        assert a.fault_counts == b.fault_counts
+        assert a.store_faults == b.store_faults
+        assert a.restarts == b.restarts
+        assert a.suspends == b.suspends
+        assert a.resumes == b.resumes
+        assert a.violations == b.violations
+
+    def test_fault_free_baseline_converges(self):
+        result = run_session_seed(4, None, None)
+        assert result.ok, result.describe()
+        assert sum(result.fault_counts.values()) == 0
+        assert sum(result.store_faults.values()) == 0
+
+
+class TestSoak:
+    @pytest.mark.parametrize("seed", CI_SEEDS)
+    def test_seed_converges(self, seed):
+        result = run_session_seed(seed, ChaosConfig(), StoreChaosConfig())
+        assert result.ok, result.describe()
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", NIGHTLY_SEEDS)
+    def test_seed_converges_nightly(self, seed):
+        result = run_session_seed(seed, ChaosConfig(), StoreChaosConfig())
+        assert result.ok, result.describe()

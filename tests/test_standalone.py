@@ -26,8 +26,10 @@ def test_full_platform_spawn_flow():
     assert {"name": "v4", "topologies": ["2x2x1", "2x2x2"]} in tpus
 
     # spawn through the mounted app with the CSRF echo
+    from conftest import cookie_value
+
     client.get("/jupyter/")
-    token = client.get_cookie("XSRF-TOKEN").value
+    token = cookie_value(client, "XSRF-TOKEN")
     r = client.post(
         "/jupyter/api/namespaces/demo/notebooks",
         json={"name": "nb", "tpu": {"accelerator": "v4", "topology": "2x2x2"}},

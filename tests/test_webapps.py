@@ -36,15 +36,18 @@ def get_json_body(resp):
     return json.loads(resp.get_data(as_text=True))
 
 
+from conftest import cookie_value as _cookie_value  # noqa: E402
+
+
 def auth(client, headers=ALICE):
     """Request headers incl. the CSRF double-submit echo (what the Angular
     frontend does with the XSRF-TOKEN cookie; CSRF is strict — a browser that
     never loaded the app cannot mutate, ref csrf.py:96-98)."""
-    cookie = client.get_cookie("XSRF-TOKEN")
-    if cookie is None:
+    value = _cookie_value(client, "XSRF-TOKEN")
+    if value is None:
         client.get("/healthz/liveness")  # seed, like loading the SPA
-        cookie = client.get_cookie("XSRF-TOKEN")
-    return {**headers, "X-XSRF-TOKEN": cookie.value}
+        value = _cookie_value(client, "XSRF-TOKEN")
+    return {**headers, "X-XSRF-TOKEN": value}
 
 
 class TestJupyterApp:
@@ -677,3 +680,101 @@ class TestDashboardApp:
         assert any(
             l["link"] == "/jupyter/" for l in get_json_body(r)["menuLinks"]
         )
+
+
+class TestSessionsSurface:
+    """Spawner-side session lifecycle: Suspended/Resuming phases, one-click
+    resume, and numSlices form validation (the API accepts what the
+    validator accepts — nothing is silently clamped)."""
+
+    def _nb_with(self, cluster, annotations, ready=0):
+        nb = api.notebook("snb", "alice", annotations=annotations)
+        nb["status"] = {"readyReplicas": ready}
+        cluster.create(nb)
+        return nb
+
+    def test_suspended_phase_and_one_click_resume(self, platform):
+        from kubeflow_tpu import sessions as sess
+
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        self._nb_with(cluster, {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z",
+            sess.SNAPSHOT_ANNOTATION: sess.encode_snapshot_record(
+                "abc123", "d" * 64, 1000.0, 900.0),
+            sess.STATE_ANNOTATION: sess.STATE_SUSPENDED,
+        })
+        r = client.get("/api/namespaces/alice/notebooks", headers=ALICE)
+        (row,) = [n for n in get_json_body(r)["notebooks"]
+                  if n["name"] == "snb"]
+        assert row["status"]["phase"] == "suspended"
+        assert "snapshot" in row["status"]["message"]
+        # one-click resume: the Resume button PATCHes stopped=false — the
+        # stop annotation goes, the snapshot ack stays for the controller
+        r = client.patch(
+            "/api/namespaces/alice/notebooks/snb",
+            json={"stopped": False}, headers=auth(client),
+        )
+        assert get_json_body(r)["success"]
+        nb = cluster.get("Notebook", "snb", "alice")
+        assert api.STOP_ANNOTATION not in nb["metadata"]["annotations"]
+        assert sess.snapshot_record(nb) is not None
+
+    def test_resuming_phase_while_restoring(self, platform):
+        from kubeflow_tpu import sessions as sess
+
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        self._nb_with(cluster, {
+            sess.SNAPSHOT_ANNOTATION: sess.encode_snapshot_record(
+                "abc123", "d" * 64, 1000.0),
+            sess.STATE_ANNOTATION: sess.STATE_RESUMING,
+        })
+        r = client.get("/api/namespaces/alice/notebooks", headers=ALICE)
+        (row,) = [n for n in get_json_body(r)["notebooks"]
+                  if n["name"] == "snb"]
+        assert row["status"]["phase"] == "resuming"
+        assert "Resuming" in row["status"]["message"]
+
+    def test_suspending_phase_while_snapshotting(self, platform):
+        from kubeflow_tpu import sessions as sess
+
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        self._nb_with(cluster, {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z",
+            sess.SUSPEND_ANNOTATION: sess.encode_suspend_request(
+                sess.REASON_STOP, 1000.0, 120.0),
+            sess.STATE_ANNOTATION: sess.STATE_SUSPENDING,
+        }, ready=1)
+        r = client.get("/api/namespaces/alice/notebooks", headers=ALICE)
+        (row,) = [n for n in get_json_body(r)["notebooks"]
+                  if n["name"] == "snb"]
+        assert row["status"]["phase"] == "terminating"
+        assert "Suspending" in row["status"]["message"]
+
+    def test_spawner_rejects_nonpositive_num_slices(self, platform):
+        cluster, m = platform
+        client = Client(jupyter.create_app(cluster))
+        for bad in (0, -2, "zero"):
+            r = client.post(
+                "/api/namespaces/alice/notebooks",
+                json={"name": f"bad-{bad}", "cpu": "1", "memory": "2Gi",
+                      "tpu": {"accelerator": "v4", "topology": "2x2x2",
+                              "numSlices": bad}},
+                headers=auth(client),
+            )
+            assert r.status_code == 400
+            assert "numSlices" in get_json_body(r)["log"]
+            assert cluster.try_get("Notebook", f"bad-{bad}", "alice") is None
+
+    def test_validate_notebook_rejects_bad_num_slices(self):
+        nb = api.notebook("n", "ns", tpu_accelerator="v4",
+                          tpu_topology="2x2x2")
+        nb["spec"]["tpu"]["numSlices"] = 0
+        errs = api.validate_notebook(nb)
+        assert any("numSlices" in e for e in errs)
+        nb["spec"]["tpu"]["numSlices"] = "3"
+        assert api.validate_notebook(nb) == []
+        nb["spec"]["tpu"]["numSlices"] = True
+        assert any("numSlices" in e for e in api.validate_notebook(nb))

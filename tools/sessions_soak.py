@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Session-lifecycle convergence soak: seeded hostile schedules (API faults,
+controller crash-restart inside the suspend barrier, lost commit writes,
+torn snapshot manifests) against the suspend/resume subsystem, each asserted
+to converge with the no-loss audit passing — every gang that acked a
+snapshot resumes from it, never cold, and no chips are released before the
+commit or the force deadline (docs/sessions.md).
+
+    python tools/sessions_soak.py --seeds 200    # CI sweep
+    python tools/sessions_soak.py --seed 1234    # reproduce one failure
+    python tools/sessions_soak.py --fault-free   # baseline without chaos
+
+Every failure line carries its seed; ``--seed N`` replays the identical
+schedule (same fleet, same gangs, same API and store faults, same
+interleaving) — the printed repro command is the whole bug report.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from kubeflow_tpu.sessions.soak import run_session_seed  # noqa: E402
+from kubeflow_tpu.testing.chaos import ChaosConfig  # noqa: E402
+from kubeflow_tpu.testing.sessionstore import StoreChaosConfig  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=200,
+                    help="number of seeds to sweep (default 200)")
+    ap.add_argument("--start", type=int, default=1,
+                    help="first seed of the sweep (default 1)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="run exactly one seed (failure reproduction)")
+    ap.add_argument("--fault-free", action="store_true",
+                    help="run the same timelines without injected faults")
+    ap.add_argument("--error-rate", type=float, default=None,
+                    help="override ChaosConfig.error_rate")
+    ap.add_argument("--crash-rate", type=float, default=None,
+                    help="override ChaosConfig.crash_rate")
+    ap.add_argument("--store-torn-rate", type=float, default=None,
+                    help="override StoreChaosConfig.torn_rate")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print a line per seed, not just failures")
+    args = ap.parse_args(argv)
+
+    # injected faults make reconcilers scream; the soak's verdict is the
+    # invariant + no-loss audit, not the log stream
+    logging.disable(logging.ERROR)
+
+    cfg: ChaosConfig | None = ChaosConfig()
+    store_cfg: StoreChaosConfig | None = StoreChaosConfig()
+    if args.fault_free:
+        cfg = None
+        store_cfg = None
+    else:
+        if args.error_rate is not None:
+            cfg.error_rate = args.error_rate
+        if args.crash_rate is not None:
+            cfg.crash_rate = args.crash_rate
+        if args.store_torn_rate is not None:
+            store_cfg.torn_rate = args.store_torn_rate
+
+    seeds = (
+        [args.seed] if args.seed is not None
+        else range(args.start, args.start + args.seeds)
+    )
+    t0 = time.monotonic()
+    failures = 0
+    suspends = resumes = forced = restarts = faults = store_faults = 0
+    for seed in seeds:
+        result = run_session_seed(seed, cfg, store_cfg)
+        suspends += result.suspends
+        resumes += result.resumes
+        forced += result.force_suspends
+        restarts += result.restarts
+        faults += sum(result.fault_counts.values())
+        store_faults += sum(result.store_faults.values())
+        if result.ok:
+            if args.verbose:
+                print(result.describe())
+        else:
+            failures += 1
+            print(result.describe())
+    n = len(list(seeds))
+    dt = time.monotonic() - t0
+    print(
+        f"sessions soak: {n - failures}/{n} seeds converged in {dt:.1f}s "
+        f"({suspends} suspends, {resumes} resumes, {forced} forced, "
+        f"{faults} API faults + {store_faults} store faults injected, "
+        f"{restarts} controller restarts)"
+    )
+    if failures:
+        print(f"{failures} FAILING seed(s) — reproduce with --seed <N> above")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,16 +118,40 @@ def build_manager(
     tracer = Tracer()
     cp_metrics = ControlPlaneMetrics(metrics.registry)
     recorder = EventRecorder()
+    telemetry = None
+    if cfg.telemetry_enabled:
+        # data-plane telemetry (kubeflow_tpu/telemetry/): the fleet
+        # collector scrapes every TPU notebook's in-pod agent in one
+        # parallel pass per interval — driven by its own loop in main(),
+        # NEVER from a reconcile — and feeds the culler's duty-cycle
+        # policy, the per-pool/fleet gauges, and /debug/telemetry
+        from kubeflow_tpu.telemetry.collector import FleetTelemetryCollector
+        from kubeflow_tpu.utils.metrics import TelemetryMetrics
+
+        telemetry = FleetTelemetryCollector(
+            cluster,
+            TelemetryMetrics(metrics.registry),
+            interval_s=cfg.telemetry_interval_s,
+            staleness_s=cfg.telemetry_staleness_s,
+            tracer=tracer,
+            cluster_domain=cfg.cluster_domain,
+            port=cfg.telemetry_port,
+        )
     culler = Culler(
         enabled=cfg.enable_culling,
         cull_idle_minutes=cfg.cull_idle_minutes,
         check_period_minutes=cfg.idleness_check_minutes,
         fetch_kernels=fetch_kernels,
         clock=time.time,
+        telemetry=telemetry,
+        duty_cycle_idle_threshold=cfg.telemetry_duty_cycle_idle,
     )
     manager = Manager(
         cluster, clock=time.time, tracer=tracer, metrics=cp_metrics
     )
+    # the ops listeners and main loop read it off the manager (build_manager
+    # keeps its two-value return for every existing caller)
+    manager.telemetry = telemetry
     if hasattr(cluster, "session"):  # KubeClient: per-verb latency/retries.
         # NOT cluster.tracer: the Manager already wraps this cluster in a
         # TracingCluster, so a client-level tracer would double-record every
@@ -253,13 +277,19 @@ def serve_ops(
             health = HealthState()
         if manager is not None:
             health.attach_manager(manager)
-        # /healthz + /readyz (live control loop, leader, watch freshness) and
-        # /debug/traces (the manager's reconcile span buffer) ride the probe
-        # port: cluster-internal like the probes, never the gateway
+        # /healthz + /readyz (live control loop, leader, watch freshness),
+        # /debug/traces (the manager's reconcile span buffer), and
+        # /debug/telemetry (the fleet collector's session store) ride the
+        # probe port: cluster-internal like the probes, never the gateway
         install_probe_routes(
             probes, health,
             tracer=getattr(manager, "tracer", None) if manager else None,
         )
+        telemetry = getattr(manager, "telemetry", None) if manager else None
+        if telemetry is not None:
+            from kubeflow_tpu.telemetry.collector import install_telemetry_route
+
+            install_telemetry_route(probes, telemetry)
         _spawn(probes, port)
     if metrics_port:
         if manager is not None:
@@ -345,6 +375,25 @@ def main() -> None:
         ).start()
     else:
         start_workers()
+    telemetry = getattr(manager, "telemetry", None)
+    if telemetry is not None:
+        # the fleet scrape runs on its OWN cadence, decoupled from both the
+        # reconcile workers (never on that path) and the kernel-probe loop
+        # below (whose period follows the culler's check period, not the
+        # telemetry interval). Standbys skip it for the same reason they
+        # skip kernel probing.
+        def telemetry_loop() -> None:
+            while True:
+                if reconciling.is_set():
+                    try:
+                        telemetry.collect()
+                    except Exception:
+                        log.exception("fleet telemetry scrape failed")
+                time.sleep(cfg.telemetry_interval_s)
+
+        threading.Thread(
+            target=telemetry_loop, daemon=True, name="telemetry-collector"
+        ).start()
     probe_period = max(10.0, cfg.idleness_check_minutes * 60.0 / 2)
     while True:
         # Workers drain the queue continuously; this loop keeps the fleet

@@ -56,11 +56,17 @@ class Platform:
             stop.wait(0.5)
 
 
-def build_platform(demo_user: str = "demo@example.com") -> Platform:
+def build_platform(
+    demo_user: str = "demo@example.com",
+    config: ControllerConfig | None = None,
+) -> Platform:
     cluster = FakeCluster()
     tpu_env.install(cluster)
     poddefaults.install(cluster)
-    manager, metrics = build_manager(cluster, ControllerConfig())
+    # programmatic defaults (scheduler/sessions/telemetry off): the
+    # in-memory demo has no real pods to scrape or preempt. An embedder
+    # passing its own config gets the full wiring.
+    manager, metrics = build_manager(cluster, config or ControllerConfig())
 
     # seed: demo tenant + schedulable TPU node pools
     cluster.add_tpu_node_pool("v4", "2x2x2")
@@ -70,13 +76,21 @@ def build_platform(demo_user: str = "demo@example.com") -> Platform:
     manager.run_until_idle()
 
     admins = {demo_user}
+    # None under the default in-memory config; build_manager hangs the
+    # collector off the manager when a caller-supplied config enables
+    # telemetry, and the webapps then serve its series
+    telemetry = getattr(manager, "telemetry", None)
     wsgi = DispatcherMiddleware(
-        dashboard.create_app(cluster, cluster_admins=admins, metrics=metrics),
+        dashboard.create_app(
+            cluster, cluster_admins=admins, metrics=metrics,
+            telemetry=telemetry,
+        ),
         {
             "/jupyter": jupyter.create_app(
                 cluster,
                 authorizer=Authorizer(cluster, cluster_admins=admins),
                 metrics=metrics,
+                telemetry=telemetry,
             ),
             "/volumes": volumes.create_app(
                 cluster, authorizer=Authorizer(cluster, cluster_admins=admins)

@@ -397,6 +397,30 @@ class NotebookReconciler(Reconciler):
         target = ports[0]["containerPort"] if ports else self.config.container_port
         # the UI lives on the coordinator gang: slice 0 when multislice
         ui_sts = name if num_slices <= 1 else f"{name}-s0"
+        svc_ports = [
+            {
+                # Istio-managed port naming convention (ref go:497-500)
+                "name": f"http-{name}",
+                "port": self.config.serving_port,
+                "targetPort": target,
+                "protocol": "TCP",
+            }
+        ]
+        if api.notebook_topology(nb) is not None:
+            # telemetry scrape path (telemetry/): the fleet collector
+            # addresses the coordinator's in-pod agent through this same
+            # Service — without this port the scrape has no route and the
+            # whole telemetry plane silently degrades to kernel fallback
+            from kubeflow_tpu.telemetry import TELEMETRY_PORT
+
+            svc_ports.append(
+                {
+                    "name": "http-telemetry",
+                    "port": TELEMETRY_PORT,
+                    "targetPort": TELEMETRY_PORT,
+                    "protocol": "TCP",
+                }
+            )
         return {
             "apiVersion": "v1",
             "kind": "Service",
@@ -404,15 +428,7 @@ class NotebookReconciler(Reconciler):
             "spec": {
                 "type": "ClusterIP",
                 "selector": {"statefulset": ui_sts},
-                "ports": [
-                    {
-                        # Istio-managed port naming convention (ref go:497-500)
-                        "name": f"http-{name}",
-                        "port": self.config.serving_port,
-                        "targetPort": target,
-                        "protocol": "TCP",
-                    }
-                ],
+                "ports": svc_ports,
             },
         }
 
@@ -672,10 +688,28 @@ class NotebookReconciler(Reconciler):
         if culled:
             if self.metrics is not None:
                 self.metrics.notebook_culled(ko.namespace(nb))
+            # decision provenance: WHICH signal culled (telemetry duty
+            # cycle vs kernel activity) goes on the Event users see, and —
+            # for telemetry-driven culls — into the collector's decision
+            # log, where the chaos soak's audit replays it against the
+            # recorded series (docs/observability.md)
+            policy, sample = self.culler.cull_provenance(nb)
+            detail = ""
+            if policy == "duty-cycle" and sample is not None:
+                detail = (
+                    f" (duty cycle {sample.duty_cycle:.3f} < "
+                    f"{self.culler.duty_cycle_idle_threshold:.3f})"
+                )
+            telemetry = self.culler.telemetry
+            if telemetry is not None and hasattr(telemetry, "record_cull"):
+                telemetry.record_cull(
+                    namespace, name, policy=policy, sample=sample,
+                    threshold=self.culler.duty_cycle_idle_threshold,
+                )
             self._emit(
                 cluster, nb, "Culled",
                 f"notebook idle past {self.culler.cull_idle_s:.0f}s; "
-                f"scaling gang to zero",
+                f"scaling gang to zero [policy: {policy}{detail}]",
             )
         return period
 

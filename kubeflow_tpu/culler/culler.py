@@ -11,6 +11,15 @@ re-forms with the same worker IDs.
 
 Kernel probing is injected (``KernelFetcher``) so tests can run against a fake
 kernel API — the fixture the reference lacks (SURVEY.md §4 takeaway).
+
+Idleness policy precedence (docs/observability.md): **telemetry when
+present, kernel activity as fallback**. With a fresh device-telemetry
+sample (``telemetry/collector.py``), the duty cycle decides — a notebook
+idle-spinning under a live "busy" kernel on an 8-chip slice finally becomes
+cullable, and a genuinely busy one is protected even if its kernel API
+flakes. When the sample is missing or stale (CPU notebook, agentless image,
+collector outage) the reference's kernel-activity logic applies unchanged,
+so enabling telemetry can never make culling *less* safe than before.
 """
 from __future__ import annotations
 
@@ -88,12 +97,37 @@ class Culler:
         check_period_minutes: float,
         fetch_kernels: KernelFetcher | None = None,
         clock: Callable[[], float] = time.time,
+        telemetry=None,
+        duty_cycle_idle_threshold: float = 0.05,
     ) -> None:
         self.enabled = enabled
         self.cull_idle_s = cull_idle_minutes * 60.0
         self.check_period_s = check_period_minutes * 60.0
         self.fetch_kernels = fetch_kernels
         self.clock = clock
+        # device-telemetry view (telemetry/collector.py): activity(ns, name)
+        # -> fresh ActivitySample | None. A pure memory read — the culler
+        # never waits on a scrape, so a wedged agent cannot block culling.
+        self.telemetry = telemetry
+        self.duty_cycle_idle_threshold = duty_cycle_idle_threshold
+        # which signal last drove each notebook's idle clock — provenance
+        # must name the policy that RAN the clock, not whatever signal
+        # happens to be fresh at cull-commit time (a collector outage in
+        # the final check window would otherwise mislabel a duty-cycle
+        # cull as kernel-activity and hide it from the telemetry audit).
+        # In-memory: a restarted controller re-derives on its next check.
+        self._last_policy: dict[tuple[str, str], tuple[str, object]] = {}
+
+    def _telemetry_sample(self, nb: Mapping):
+        """Fresh sample with a KNOWN duty cycle, else None (fallback). An
+        agent that cannot measure duty (blind backend, uninstrumented
+        notebook) reports it unknown — unknown must not read as idle."""
+        if self.telemetry is None:
+            return None
+        sample = self.telemetry.activity(ko.namespace(nb), ko.name(nb))
+        if sample is None or sample.duty_cycle is None:
+            return None
+        return sample
 
     # -- annotation maintenance (ref: UpdateNotebookLastActivityAnnotation
     #    culler.go:207-237) ---------------------------------------------------
@@ -128,7 +162,9 @@ class Culler:
         if stop_annotation_is_set(nb):
             # Stopped: never (re-)seed last-activity — set_stop_annotation
             # removed it deliberately so a restart re-initializes the idle
-            # clock (would instantly re-cull otherwise).
+            # clock (would instantly re-cull otherwise). The idle clock is
+            # gone, so its policy bookkeeping goes with it.
+            self._last_policy.pop((ko.namespace(nb), ko.name(nb)), None)
             if not self.needs_check(nb):
                 return False
             ko.set_annotation(nb, api.LAST_ACTIVITY_CHECK_TS, format_time(now))
@@ -166,6 +202,21 @@ class Culler:
             ko.set_annotation(nb, api.LAST_ACTIVITY_ANNOTATION, format_time(now))
             ko.set_annotation(nb, api.LAST_ACTIVITY_CHECK_TS, format_time(now))
             return True
+        key = (ko.namespace(nb), ko.name(nb))
+        sample = self._telemetry_sample(nb)
+        if sample is not None:
+            # Telemetry-when-present: the devices themselves say whether the
+            # session is working. Busy devices refresh the idle clock; idle
+            # devices let it run — even under a live "busy" kernel, which is
+            # exactly the idle-spinning case kernel presence cannot see.
+            self._last_policy[key] = ("duty-cycle", sample)
+            if sample.duty_cycle >= self.duty_cycle_idle_threshold:
+                ko.set_annotation(
+                    nb, api.LAST_ACTIVITY_ANNOTATION, format_time(now)
+                )
+            ko.set_annotation(nb, api.LAST_ACTIVITY_CHECK_TS, format_time(now))
+            return True
+        self._last_policy[key] = ("kernel-activity", None)
         kernels = (
             self.fetch_kernels(ko.namespace(nb), ko.name(nb))
             if self.fetch_kernels
@@ -210,3 +261,26 @@ class Culler:
         except ValueError:
             return False
         return idle_for >= self.cull_idle_s
+
+    def cull_provenance(self, nb: Mapping):
+        """Which signal drove this cull: ``("duty-cycle", sample)`` when
+        the duty-cycle policy ran the idle clock at its last check, else
+        ``("kernel-activity", None)`` — the reference's probe semantics.
+        Read from the per-notebook policy record the last
+        ``update_last_activity`` wrote (NOT re-sampled at commit time — a
+        collector outage in the final window must not relabel the
+        decision); a cold cache (controller restart between the check and
+        the cull) re-derives from the live sample. Consumed at cull commit,
+        so the entry is popped. Recorded into the Culled event and the
+        collector's decision log so a cull is explainable after the fact."""
+        key = (ko.namespace(nb), ko.name(nb))
+        recorded = self._last_policy.pop(key, None)
+        if recorded is not None:
+            return recorded
+        sample = self._telemetry_sample(nb)
+        if (
+            sample is not None
+            and sample.duty_cycle < self.duty_cycle_idle_threshold
+        ):
+            return "duty-cycle", sample
+        return "kernel-activity", None

@@ -89,8 +89,21 @@ def _probe_native(lib, targets, timeout, max_concurrency):
 
 
 def _probe_python(targets, timeout, max_concurrency):
+    import socket
     import urllib.error
     import urllib.request
+
+    def classify(exc: BaseException) -> int:
+        # Status parity with the native prober (native/culler_probe.cc):
+        # -1 connect/resolve failure, -2 deadline expired. urllib wraps the
+        # socket timeout in URLError(reason=timeout) for connect stalls but
+        # raises it bare for read stalls — unwrap before classifying, so
+        # the fallback never reports a timeout as a connect failure.
+        if isinstance(exc, urllib.error.URLError):
+            exc = exc.reason if isinstance(exc.reason, BaseException) else exc
+        if isinstance(exc, (TimeoutError, socket.timeout)):
+            return -2
+        return -1
 
     def one(target):
         host, port, path = target
@@ -100,8 +113,8 @@ def _probe_python(targets, timeout, max_concurrency):
                 return ProbeResult(resp.status, resp.read().decode(errors="replace"))
         except urllib.error.HTTPError as e:
             return ProbeResult(e.code, "")
-        except Exception:
-            return ProbeResult(-1, "")
+        except Exception as e:
+            return ProbeResult(classify(e), "")
 
     with ThreadPoolExecutor(max_workers=max_concurrency) as pool:
         return list(pool.map(one, targets))

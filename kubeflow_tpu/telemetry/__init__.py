@@ -1,0 +1,88 @@
+"""Session telemetry: TPU device metrics from pod slices to the controller.
+
+The control plane is fully observable (obs/, docs/observability.md) but was
+blind to the data plane: ``scheduler_fleet_utilization`` counts *allocated*
+chips, and the culler's only activity signal is kernel presence — a notebook
+idle-spinning on an 8-chip v4 slice reads "busy" forever. This package adds
+the device-side signal, in the classic sample-on-device / aggregate-centrally
+/ act-on-it shape (TensorFlow's device-stats plumbing; NotebookOS argues
+interactive platforms live or die on per-session utilization, PAPERS.md):
+
+- ``agent.py`` — the in-pod agent: samples duty cycle, HBM occupancy, and
+  step timing from JAX (``jax.local_devices()`` memory stats + a step-hook
+  ring buffer; a deterministic fake device backend for tests/chaos) and
+  serves them in Prometheus text on a ``/metrics``-style endpoint.
+- ``collector.py`` — the controller-side collector: scrapes the whole fleet
+  in ONE parallel pass per interval (the ``culler/probe.py`` native-prober
+  pattern — never on the reconcile path) into per-session ring buffers plus
+  histograms/gauges on the shared ``utils/metrics.py`` registry, exported
+  at ``/debug/telemetry``.
+
+Consumers: the culler's duty-cycle idleness policy (telemetry-when-present,
+kernel-activity fallback — ``culler/culler.py``), the scheduler's true
+per-pool duty-cycle/HBM gauges alongside its allocation gauge, and the
+JWA/dashboard per-notebook + fleet series.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+# agent's scrape endpoint inside the pod (a second tiny server next to
+# Jupyter's :8888). The notebook Service routes this port to the gang's
+# COORDINATOR pod (notebook_controller.generate_service adds it alongside
+# the UI port), so the collector addresses sessions the same way the
+# culler's kernel probe does — and like kernel idleness, a session's
+# telemetry is the coordinator host's view.
+TELEMETRY_PORT = 8890
+TELEMETRY_PATH = "/metrics"
+
+# exposition family names the agent emits and the collector consumes —
+# shared constants so the two sides cannot drift apart silently
+FAMILY_DUTY_CYCLE = "tpu_duty_cycle"
+# 1 when the duty-cycle value is a real measurement (hardware counter or
+# step-hook evidence), 0 when the agent has NO duty signal (public-JAX
+# backend + a notebook that never instrumented agent.step()). An unknown
+# duty must never read as "idle" — the culler falls back to kernel
+# activity, so enabling telemetry cannot make culling less safe.
+FAMILY_DUTY_KNOWN = "tpu_duty_cycle_known"
+FAMILY_HBM_USED = "tpu_hbm_used_bytes"
+FAMILY_HBM_TOTAL = "tpu_hbm_total_bytes"
+FAMILY_DEVICE_COUNT = "tpu_device_count"
+FAMILY_STEP_TOTAL = "tpu_step_total"
+
+
+@dataclasses.dataclass(frozen=True)
+class ActivitySample:
+    """One aggregated telemetry observation for a session (whole gang).
+
+    ``at`` is the collector's scrape timestamp — consumers judge freshness
+    against it; the collector's ``activity()`` already returns ``None`` for
+    stale sessions, so holders of a sample know it was fresh when handed
+    out. ``duty_cycle`` is ``None`` when the agent reported it unknown —
+    HBM data is still valid, but idleness consumers must fall back.
+    """
+
+    at: float
+    duty_cycle: float | None  # 0..1 mean across devices; None = unknown
+    hbm_used_bytes: float     # summed across devices
+    hbm_total_bytes: float
+    steps_total: float = 0.0
+
+    @property
+    def hbm_utilization(self) -> float:
+        if self.hbm_total_bytes <= 0:
+            return 0.0
+        return min(1.0, self.hbm_used_bytes / self.hbm_total_bytes)
+
+
+__all__ = [
+    "ActivitySample",
+    "TELEMETRY_PORT",
+    "TELEMETRY_PATH",
+    "FAMILY_DUTY_CYCLE",
+    "FAMILY_DUTY_KNOWN",
+    "FAMILY_HBM_USED",
+    "FAMILY_HBM_TOTAL",
+    "FAMILY_DEVICE_COUNT",
+    "FAMILY_STEP_TOTAL",
+]

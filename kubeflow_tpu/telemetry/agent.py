@@ -1,0 +1,348 @@
+"""In-pod telemetry agent: device duty cycle, HBM occupancy, step timing.
+
+Runs next to the Jupyter server on every host of a slice and answers the
+collector's scrape with Prometheus text (the platform's own ``Registry`` —
+no prometheus_client in the image). Signals:
+
+- **HBM occupancy** — ``jax.local_devices()`` → ``memory_stats()``
+  (``bytes_in_use`` / ``bytes_limit``), summed across the host's devices.
+- **duty cycle** — fraction of the trailing window the devices spent inside
+  user steps, from the step-hook ring buffer. libtpu's own duty-cycle
+  counter is not exposed through public JAX, so the agent derives it from
+  the only ground truth a notebook has: time spent executing steps. A
+  backend that *does* know the hardware number (the fake, or a future
+  libtpu reader) reports it directly and wins.
+- **step timing** — every ``agent.step()`` block is timed into a histogram
+  and wrapped in ``utils/profiling.step_annotation``, so the agent's step
+  numbers and a captured profiler trace agree.
+
+``FakeDeviceBackend`` is the deterministic test/chaos double: explicit duty
+cycle + HBM, optional seeded jitter — the soak scripts "idle-spinning under
+a live kernel" with it.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Iterator, Sequence
+
+from kubeflow_tpu.telemetry import (
+    FAMILY_DEVICE_COUNT,
+    FAMILY_DUTY_CYCLE,
+    FAMILY_DUTY_KNOWN,
+    FAMILY_HBM_TOTAL,
+    FAMILY_HBM_USED,
+    FAMILY_STEP_TOTAL,
+)
+from kubeflow_tpu.utils.metrics import Registry
+
+# step durations span ms (decode loops) to minutes (full eval passes)
+STEP_BUCKETS = (0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0)
+DEFAULT_WINDOW_S = 60.0
+DEFAULT_RING_LEN = 512
+
+
+class DeviceSample:
+    """One device's reading. ``duty_cycle=None`` means the backend cannot
+    measure it (public JAX) — the agent derives it from step timing."""
+
+    __slots__ = ("duty_cycle", "hbm_used_bytes", "hbm_total_bytes")
+
+    def __init__(
+        self,
+        *,
+        duty_cycle: float | None,
+        hbm_used_bytes: float,
+        hbm_total_bytes: float,
+    ) -> None:
+        self.duty_cycle = duty_cycle
+        self.hbm_used_bytes = hbm_used_bytes
+        self.hbm_total_bytes = hbm_total_bytes
+
+
+class JaxDeviceBackend:
+    """Reads the host's real devices through public JAX APIs."""
+
+    def samples(self) -> list[DeviceSample]:
+        import jax
+
+        out = []
+        for dev in jax.local_devices():
+            stats: dict = {}
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:
+                # CPU/interpret platforms raise or return None; a device
+                # without stats still counts toward device_count
+                stats = {}
+            out.append(
+                DeviceSample(
+                    duty_cycle=None,  # derived from the step ring
+                    hbm_used_bytes=float(stats.get("bytes_in_use", 0)),
+                    hbm_total_bytes=float(stats.get("bytes_limit", 0)),
+                )
+            )
+        return out
+
+
+class FakeDeviceBackend:
+    """Deterministic device double for tests and the chaos soak.
+
+    Reports an explicit duty cycle / HBM split across ``devices`` fake
+    chips; ``jitter`` perturbs the duty cycle per read from a seeded PRNG,
+    so repeated samples vary realistically yet identically per seed.
+    """
+
+    def __init__(
+        self,
+        *,
+        duty_cycle: float = 0.0,
+        hbm_used_bytes: float = 0.0,
+        hbm_total_bytes: float = float(16 << 30),
+        devices: int = 4,
+        jitter: float = 0.0,
+        seed: int = 0,
+    ) -> None:
+        import random
+
+        self.duty_cycle = duty_cycle
+        self.hbm_used_bytes = hbm_used_bytes
+        self.hbm_total_bytes = hbm_total_bytes
+        self.devices = max(1, devices)
+        self.jitter = jitter
+        self._rng = random.Random(f"fake-devices-{seed}")
+
+    def set_duty_cycle(self, duty_cycle: float) -> None:
+        self.duty_cycle = duty_cycle
+
+    def set_hbm(self, used_bytes: float, total_bytes: float | None = None) -> None:
+        self.hbm_used_bytes = used_bytes
+        if total_bytes is not None:
+            self.hbm_total_bytes = total_bytes
+
+    def samples(self) -> list[DeviceSample]:
+        out = []
+        for _ in range(self.devices):
+            duty = self.duty_cycle
+            if self.jitter:
+                duty += self._rng.uniform(-self.jitter, self.jitter)
+            out.append(
+                DeviceSample(
+                    duty_cycle=min(1.0, max(0.0, duty)),
+                    hbm_used_bytes=self.hbm_used_bytes / self.devices,
+                    hbm_total_bytes=self.hbm_total_bytes / self.devices,
+                )
+            )
+        return out
+
+
+class StepRing:
+    """Bounded ring of (step, start, end) intervals; duty cycle is the
+    fraction of a trailing window covered by them. Steps never overlap (one
+    kernel executes at a time on a notebook), so plain overlap-summing is
+    exact, not an approximation.
+
+    The currently-executing step is tracked as an OPEN interval counted up
+    to ``now`` — a single step longer than the window (a long eval pass, a
+    huge compile) must read busy while it runs, not idle-until-it-finishes.
+    ``has_signal()`` says whether the notebook ever instrumented steps at
+    all; without it the derived duty cycle is meaningless, not zero.
+    """
+
+    def __init__(self, maxlen: int = DEFAULT_RING_LEN) -> None:
+        self.maxlen = maxlen
+        self._steps: list[tuple[int, float, float]] = []
+        self._open: tuple[int, float] | None = None
+        self._lock = threading.Lock()
+
+    def begin(self, step: int, start: float) -> None:
+        with self._lock:
+            self._open = (step, start)
+
+    def add(self, step: int, start: float, end: float) -> None:
+        with self._lock:
+            if self._open is not None and self._open[0] == step:
+                self._open = None
+            self._steps.append((step, start, max(start, end)))
+            if len(self._steps) > self.maxlen:
+                del self._steps[: len(self._steps) - self.maxlen]
+
+    def has_signal(self) -> bool:
+        with self._lock:
+            return bool(self._steps) or self._open is not None
+
+    def busy_fraction(self, window_s: float, now: float) -> float:
+        if window_s <= 0:
+            return 0.0
+        cutoff = now - window_s
+        with self._lock:
+            busy = sum(
+                max(0.0, min(end, now) - max(start, cutoff))
+                for _, start, end in self._steps
+                if end > cutoff
+            )
+            if self._open is not None:
+                busy += max(0.0, now - max(self._open[1], cutoff))
+        return min(1.0, busy / window_s)
+
+    def last(self) -> tuple[int, float, float] | None:
+        with self._lock:
+            return self._steps[-1] if self._steps else None
+
+
+class TelemetryAgent:
+    """Aggregates one host's device + step signals into a registry and
+    serves them as Prometheus text.
+
+    The exposition is PRE-aggregated across local devices (mean duty cycle,
+    summed HBM) into unlabeled families: the collector's per-family parse
+    then needs no label awareness, and a gang's hosts sum/average cleanly.
+    """
+
+    def __init__(
+        self,
+        backend=None,
+        *,
+        registry: Registry | None = None,
+        clock: Callable[[], float] = time.time,
+        window_s: float = DEFAULT_WINDOW_S,
+        ring_len: int = DEFAULT_RING_LEN,
+    ) -> None:
+        self.backend = backend or JaxDeviceBackend()
+        self.clock = clock
+        self.window_s = window_s
+        self.ring = StepRing(ring_len)
+        self.registry = registry or Registry()
+        self.duty = self.registry.gauge(
+            FAMILY_DUTY_CYCLE,
+            "Fraction of the trailing window the TPU devices were busy, 0..1",
+        )
+        self.duty_known = self.registry.gauge(
+            FAMILY_DUTY_KNOWN,
+            "1 when tpu_duty_cycle is a real measurement; 0 when the agent "
+            "has no duty signal (unknown must not read as idle)",
+        )
+        self.hbm_used = self.registry.gauge(
+            FAMILY_HBM_USED, "HBM bytes in use across this host's devices"
+        )
+        self.hbm_total = self.registry.gauge(
+            FAMILY_HBM_TOTAL, "HBM bytes available across this host's devices"
+        )
+        self.device_count = self.registry.gauge(
+            FAMILY_DEVICE_COUNT, "TPU devices visible to this host"
+        )
+        self.steps = self.registry.counter(
+            FAMILY_STEP_TOTAL, "Steps executed through the agent's step hook"
+        )
+        self.step_duration = self.registry.histogram(
+            "tpu_step_duration_seconds",
+            "Wall time of one user step (agent step hook)",
+            buckets=STEP_BUCKETS,
+        )
+        self._step_counter = 0
+        self._step_lock = threading.Lock()
+        # scrapes sample live (the reference's custom-collector idiom)
+        self.registry.pre_expose(self.sample)
+
+    # -------------------------------------------------------------- stepping
+
+    @contextlib.contextmanager
+    def step(self) -> Iterator[int]:
+        """Time one user step; shares numbering with the profiler's
+        StepTraceAnnotation (utils/profiling.step_annotation) so "step N"
+        means the same thing in the scrape and in a captured trace."""
+        with self._step_lock:
+            self._step_counter += 1
+            n = self._step_counter
+        try:
+            from kubeflow_tpu.utils.profiling import step_annotation
+
+            ann = step_annotation(n)
+        except Exception:
+            ann = contextlib.nullcontext()  # no jax in this interpreter
+        t0 = self.clock()
+        self.ring.begin(n, t0)  # scrapes mid-step see the open interval
+        try:
+            with ann:
+                yield n
+        finally:
+            t1 = self.clock()
+            self.ring.add(n, t0, t1)
+            self.steps.inc()
+            self.step_duration.observe(max(0.0, t1 - t0))
+
+    # -------------------------------------------------------------- sampling
+
+    def sample(self) -> None:
+        """Refresh the gauges from the backend (and the step ring when the
+        backend cannot measure duty cycle itself)."""
+        try:
+            samples: Sequence[DeviceSample] = self.backend.samples()
+        except Exception:
+            samples = []  # device runtime hiccup: keep serving last values
+        if not samples:
+            return
+        duties = [s.duty_cycle for s in samples if s.duty_cycle is not None]
+        if duties:
+            duty, known = sum(duties) / len(duties), True
+        elif self.ring.has_signal():
+            # derived from step timing (incl. the currently-open step)
+            duty, known = self.ring.busy_fraction(
+                self.window_s, self.clock()
+            ), True
+        else:
+            # blind backend + never-instrumented notebook: UNKNOWN, not
+            # idle — advertising 0 here would let the culler kill a busy
+            # uninstrumented session
+            duty, known = 0.0, False
+        self.duty.set(duty)
+        self.duty_known.set(1.0 if known else 0.0)
+        self.hbm_used.set(sum(s.hbm_used_bytes for s in samples))
+        self.hbm_total.set(sum(s.hbm_total_bytes for s in samples))
+        self.device_count.set(len(samples))
+
+    def exposition(self) -> str:
+        return self.registry.expose()  # pre_expose hook runs sample()
+
+    # --------------------------------------------------------------- serving
+
+    def wsgi(self, environ, start_response):
+        """Minimal WSGI app: the scrape endpoint only (GET <any path>)."""
+        body = self.exposition().encode()
+        start_response(
+            "200 OK",
+            [
+                ("Content-Type", "text/plain; version=0.0.4"),
+                ("Content-Length", str(len(body))),
+            ],
+        )
+        return [body]
+
+    def serve(self, port: int, host: str = "0.0.0.0") -> threading.Thread:
+        """Serve the scrape endpoint in a daemon thread; returns it."""
+        from wsgiref.simple_server import make_server
+
+        server = make_server(host, port, self.wsgi)
+        t = threading.Thread(
+            target=server.serve_forever, daemon=True, name="telemetry-agent"
+        )
+        t.start()
+        return t
+
+
+def main() -> None:
+    """Entry point for the notebook image: serve device telemetry on
+    TELEMETRY_PORT (env-overridable) until the pod dies."""
+    import os
+
+    from kubeflow_tpu.telemetry import TELEMETRY_PORT
+
+    agent = TelemetryAgent()
+    port = int(os.environ.get("TELEMETRY_PORT", str(TELEMETRY_PORT)))
+    agent.serve(port)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

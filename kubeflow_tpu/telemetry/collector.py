@@ -1,0 +1,488 @@
+"""Fleet telemetry collector: one parallel scrape pass per interval.
+
+The controller-side half of the telemetry pipeline. Like the fleet kernel
+prober (``cmd/controller.py:FleetKernelFetcher``) it probes every running
+TPU notebook in ONE native parallel pass (``culler/probe.py``) — and like
+it, it runs off the reconcile path: reconcilers and the culler only ever
+read the in-memory store, never wait on a scrape. A wedged agent costs one
+probe slot against the pass deadline, nothing else.
+
+Per session the collector keeps a bounded ring of (timestamp, value) points
+per signal (the dashboard's ``SeriesStore``) plus freshness bookkeeping:
+
+- **fresh** — last good scrape within ``staleness_s``: the sample feeds the
+  culler's duty-cycle policy and the per-pool/fleet gauges.
+- **stale** — older than that: consumers fall back (the culler to kernel
+  activity); the session stops contributing to aggregates but keeps its
+  history.
+- **evicted** — no good scrape for ``evict_after_s`` (default 4× staleness)
+  or the Notebook is gone/stopped: the entry is dropped entirely, so a
+  churning fleet cannot grow the store without bound.
+
+Cull decisions taken on this signal are recorded (policy, sample, the
+reconcile trace ids from obs/tracing.py) so a cull is *explainable*: the
+chaos soak's telemetry audit checks every duty-cycle cull against the
+recorded series. Everything is exported at ``/debug/telemetry``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Mapping, Sequence
+
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.culler import probe
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.telemetry import (
+    FAMILY_DUTY_CYCLE,
+    FAMILY_DUTY_KNOWN,
+    FAMILY_HBM_TOTAL,
+    FAMILY_HBM_USED,
+    FAMILY_STEP_TOTAL,
+    TELEMETRY_PATH,
+    TELEMETRY_PORT,
+    ActivitySample,
+)
+from kubeflow_tpu.utils.metrics import TelemetryMetrics
+from kubeflow_tpu.webapps.metrics_source import SeriesStore, parse_prometheus_text
+
+DEFAULT_INTERVAL_S = 15.0
+DEFAULT_STALENESS_S = 60.0
+DEFAULT_HISTORY = 240          # 1 h of 15 s passes per signal
+DEFAULT_TIMEOUT_S = 3.0
+EVICT_FACTOR = 4.0             # evict after this many staleness windows
+MAX_DECISIONS = 256            # bounded cull-decision provenance log
+
+SIGNALS = ("duty_cycle", "hbm_used", "hbm_total", "steps")
+
+
+class _Session:
+    __slots__ = (
+        "store", "created_at", "last_ok", "last_attempt", "failures",
+        "pool", "latest",
+    )
+
+    def __init__(self, history: int, now: float) -> None:
+        self.store = SeriesStore(maxlen=history)
+        self.created_at = now
+        self.last_ok = float("-inf")
+        self.last_attempt = float("-inf")
+        self.failures = 0
+        self.pool = ""
+        self.latest: ActivitySample | None = None
+
+    def anchor(self) -> float:
+        """Last proof of life: the most recent good scrape, or creation
+        time for a session that never produced one."""
+        return max(self.last_ok, self.created_at)
+
+
+def default_target_for(cluster_domain: str, port: int = TELEMETRY_PORT):
+    """(host, port, path) for a notebook's in-pod agent: the gang's
+    coordinator pod via its headless-DNS-compatible Service name (the same
+    addressing shape the culler's kernel probe uses)."""
+
+    def target(nb: Mapping) -> tuple[str, int, str]:
+        ns, name = ko.namespace(nb), ko.name(nb)
+        return (f"{name}.{ns}.svc.{cluster_domain}", port, TELEMETRY_PATH)
+
+    return target
+
+
+class FleetTelemetryCollector:
+    """Scrapes the fleet's agents into per-session ring buffers + the
+    shared metrics registry. ``collect()`` is the only method that performs
+    I/O; every read-side method serves from memory."""
+
+    def __init__(
+        self,
+        cluster,
+        metrics: TelemetryMetrics | None = None,
+        *,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        staleness_s: float = DEFAULT_STALENESS_S,
+        history: int = DEFAULT_HISTORY,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+        clock: Callable[[], float] = time.time,
+        target_for: Callable[[Mapping], tuple[str, int, str]] | None = None,
+        probe_fn=probe.probe_many,
+        tracer=None,
+        cluster_domain: str = "cluster.local",
+        port: int = TELEMETRY_PORT,
+    ) -> None:
+        self.cluster = cluster
+        self.metrics = metrics or TelemetryMetrics()
+        self.interval_s = interval_s
+        self.staleness_s = staleness_s
+        self.evict_after_s = staleness_s * EVICT_FACTOR
+        self.history = history
+        self.timeout_s = timeout_s
+        self.clock = clock
+        self.target_for = target_for or default_target_for(cluster_domain, port)
+        self.probe_fn = probe_fn
+        self.tracer = tracer
+        self._sessions: dict[tuple[str, str], _Session] = {}
+        self._decisions: list[dict] = []
+        self._lock = threading.Lock()
+        self._last_pass = float("-inf")
+        # audit counters: the soak asserts scrape_passes never moves inside
+        # a reconcile tick (zero reconcile-path scrapes)
+        self.scrape_passes = 0
+        self.sessions_scraped = 0
+
+    # ------------------------------------------------------------- scraping
+
+    def _scrape_targets(self) -> list[tuple[tuple[str, str], Mapping]]:
+        """TPU notebooks worth probing: a CPU notebook has no device agent,
+        and a stopping/stopped gang's endpoint is going away by design —
+        probing it would only manufacture failure noise."""
+        out = []
+        for nb in self.cluster.list("Notebook"):
+            if api.notebook_topology(nb) is None:
+                continue
+            if api.STOP_ANNOTATION in ko.annotations(nb):
+                continue
+            out.append(((ko.namespace(nb), ko.name(nb)), nb))
+        return out
+
+    def collect(self, force: bool = False) -> int:
+        """One whole-fleet parallel pass; returns sessions scraped. Gated
+        on ``interval_s`` so callers can invoke it from any loop cadence
+        (``force=True`` for tests/benchmarks)."""
+        now = self.clock()
+        if not force and now - self._last_pass < self.interval_s:
+            return 0
+        self._last_pass = now
+        scrapees = self._scrape_targets()
+        t0 = time.perf_counter()
+        results: Sequence[probe.ProbeResult] = []
+        if scrapees:
+            results = self.probe_fn(
+                [self.target_for(nb) for _, nb in scrapees],
+                timeout=self.timeout_s,
+            )
+        with self._lock:
+            for (key, nb), res in zip(scrapees, results):
+                self._ingest(key, nb, res, now)
+            self._evict_and_aggregate(now, {key for key, _ in scrapees})
+            self.scrape_passes += 1
+            self.sessions_scraped += len(scrapees)
+        self.metrics.pass_duration.observe(time.perf_counter() - t0)
+        return len(scrapees)
+
+    def _ingest(
+        self, key: tuple[str, str], nb: Mapping, res: probe.ProbeResult, now: float
+    ) -> None:
+        sess = self._sessions.get(key)
+        families = (
+            parse_prometheus_text(res.body) if res.ok else {}
+        )
+        # a reachable server speaking something else (an agentless image)
+        # is a failed scrape, not a zero; a target that has NEVER answered
+        # gets no session entry at all — tracking starts at first data, so
+        # dead endpoints cannot grow the store
+        if not res.ok or FAMILY_DUTY_CYCLE not in families:
+            if sess is not None:
+                sess.last_attempt = now
+                sess.failures += 1
+            self.metrics.scrapes.inc(outcome="failed")
+            return
+        if sess is None:
+            sess = self._sessions[key] = _Session(self.history, now)
+        sess.last_attempt = now
+        placement = sched.placement_of(nb)
+        if placement and placement.get("slices"):
+            sess.pool = placement["slices"][0].get("pool", "") or ""
+        # an agent that advertises its duty cycle as unknown (blind backend
+        # + uninstrumented notebook) yields duty None: HBM stays usable,
+        # but idleness consumers must fall back — unknown is not idle.
+        # Absent flag (older agent) = known, preserving the plain reading.
+        known = families.get(FAMILY_DUTY_KNOWN, 1.0) >= 0.5
+        sample = ActivitySample(
+            at=now,
+            duty_cycle=(
+                families.get(FAMILY_DUTY_CYCLE, 0.0) if known else None
+            ),
+            hbm_used_bytes=families.get(FAMILY_HBM_USED, 0.0),
+            hbm_total_bytes=families.get(FAMILY_HBM_TOTAL, 0.0),
+            steps_total=families.get(FAMILY_STEP_TOTAL, 0.0),
+        )
+        sess.last_ok = now
+        sess.latest = sample
+        if sample.duty_cycle is not None:
+            sess.store.append("duty_cycle", now, sample.duty_cycle)
+        sess.store.append("hbm_used", now, sample.hbm_used_bytes)
+        sess.store.append("hbm_total", now, sample.hbm_total_bytes)
+        sess.store.append("steps", now, sample.steps_total)
+        self.metrics.scrapes.inc(outcome="ok")
+
+    def _evict_and_aggregate(self, now: float, live_keys: set) -> None:
+        """Bounded staleness: entries past the eviction bound — or whose
+        Notebook no longer qualifies for scraping — are dropped, then the
+        per-session/pool/fleet gauges are rebuilt from fresh sessions only
+        (clear-and-set, the live-scrape collector idiom)."""
+        m = self.metrics
+        evict = [
+            key
+            for key, sess in self._sessions.items()
+            # gone/stopped notebooks drop immediately; a tracked one drops
+            # once it has gone a full eviction window without a good scrape
+            # (never-succeeding agents count from session creation)
+            if key not in live_keys or now - sess.anchor() > self.evict_after_s
+        ]
+        for key in evict:
+            del self._sessions[key]
+            m.evicted.inc()
+        m.session_duty_cycle.clear()
+        m.session_hbm_used.clear()
+        m.session_hbm_total.clear()
+        m.pool_duty_cycle.clear()
+        m.pool_hbm_utilization.clear()
+        stale = 0
+        pools: dict[str, list[ActivitySample]] = {}
+        fresh: list[ActivitySample] = []
+        for (ns, name), sess in self._sessions.items():
+            if sess.latest is None or now - sess.last_ok > self.staleness_s:
+                stale += 1
+                continue
+            s = sess.latest
+            fresh.append(s)
+            pools.setdefault(sess.pool, []).append(s)
+            if s.duty_cycle is not None:
+                m.session_duty_cycle.set(
+                    s.duty_cycle, namespace=ns, notebook=name
+                )
+            m.session_hbm_used.set(s.hbm_used_bytes, namespace=ns, notebook=name)
+            m.session_hbm_total.set(s.hbm_total_bytes, namespace=ns, notebook=name)
+        for pool, samples in pools.items():
+            if not pool:
+                continue  # unbound gangs have no pool to attribute
+            duties = [
+                s.duty_cycle for s in samples if s.duty_cycle is not None
+            ]
+            if duties:  # unknown-duty sessions don't drag the mean to 0
+                m.pool_duty_cycle.set(sum(duties) / len(duties), pool=pool)
+            total = sum(s.hbm_total_bytes for s in samples)
+            used = sum(s.hbm_used_bytes for s in samples)
+            m.pool_hbm_utilization.set(
+                used / total if total > 0 else 0.0, pool=pool
+            )
+        m.sessions.set(len(self._sessions))
+        m.stale_sessions.set(stale)
+        duties = [s.duty_cycle for s in fresh if s.duty_cycle is not None]
+        m.fleet_duty_cycle.set(sum(duties) / len(duties) if duties else 0.0)
+        if fresh:
+            total = sum(s.hbm_total_bytes for s in fresh)
+            used = sum(s.hbm_used_bytes for s in fresh)
+            m.fleet_hbm_utilization.set(used / total if total > 0 else 0.0)
+        else:
+            m.fleet_hbm_utilization.set(0.0)
+
+    # ------------------------------------------------------------ read side
+
+    def activity(self, namespace: str, name: str) -> ActivitySample | None:
+        """The culler's view: latest sample iff fresh, else None (the
+        fallback signal). Pure memory read — never a scrape."""
+        with self._lock:
+            sess = self._sessions.get((namespace, name))
+            if sess is None or sess.latest is None:
+                return None
+            if self.clock() - sess.last_ok > self.staleness_s:
+                return None
+            return sess.latest
+
+    def series(
+        self, namespace: str, name: str, signal: str, window_s: float = 900.0
+    ) -> list[dict]:
+        if signal not in SIGNALS:
+            raise KeyError(signal)
+        with self._lock:
+            sess = self._sessions.get((namespace, name))
+            if sess is None:
+                return []
+            return sess.store.window(signal, window_s, self.clock())
+
+    def fleet_duty_cycle(self) -> float:
+        return self.metrics.fleet_duty_cycle.get()
+
+    def fleet_hbm_utilization(self) -> float:
+        return self.metrics.fleet_hbm_utilization.get()
+
+    def session_payload(
+        self, namespace: str, name: str, window_s: float = 900.0
+    ) -> dict | None:
+        """Detail-view payload for JWA: latest sample + freshness + series."""
+        with self._lock:
+            sess = self._sessions.get((namespace, name))
+            if sess is None or sess.latest is None:
+                return None
+            now = self.clock()
+            s = sess.latest
+            return {
+                "dutyCycle": s.duty_cycle,
+                "hbmUsedBytes": s.hbm_used_bytes,
+                "hbmTotalBytes": s.hbm_total_bytes,
+                "hbmUtilization": s.hbm_utilization,
+                "stepsTotal": s.steps_total,
+                "ageS": round(now - sess.last_ok, 1),
+                "fresh": now - sess.last_ok <= self.staleness_s,
+                "pool": sess.pool,
+                "series": {
+                    sig: sess.store.window(sig, window_s, now)
+                    for sig in ("duty_cycle", "hbm_used")
+                },
+            }
+
+    # --------------------------------------------------------- provenance
+
+    def record_cull(
+        self,
+        namespace: str,
+        name: str,
+        *,
+        policy: str,
+        sample: ActivitySample | None,
+        threshold: float,
+    ) -> None:
+        """Decision provenance: which signal culled this session, backed by
+        which recorded sample, caused by which reconcile (the trace ids
+        ride along from the enclosing span — obs/tracing.py)."""
+        span = self.tracer.current_span() if self.tracer is not None else None
+        with self._lock:
+            sess = self._sessions.get((namespace, name))
+            # freeze the supporting evidence NOW: the culled session leaves
+            # the scrape set (stop annotation) and is evicted on the next
+            # pass, so the audit must be able to replay the decision from
+            # the decision record alone
+            series = (
+                sess.store.window("duty_cycle", float("inf"), self.clock())
+                if sess is not None
+                else []
+            )
+        if not series and sample is not None:
+            # a concurrent pass already evicted the session (the cull's own
+            # stop annotation removes it from the scrape set): the sample
+            # the culler acted on IS collector-recorded data — keep it as
+            # the one-point evidence rather than an unexplainable decision
+            series = [{"timestamp": sample.at, "value": sample.duty_cycle}]
+        decision = {
+            "namespace": namespace,
+            "notebook": name,
+            "policy": policy,
+            "threshold": threshold,
+            "at": self.clock(),
+            "sampleAt": sample.at if sample else None,
+            "dutyCycle": sample.duty_cycle if sample else None,
+            "traceIds": list(span.trace_ids) if span else [],
+            "series": series,
+        }
+        with self._lock:
+            self._decisions.append(decision)
+            if len(self._decisions) > MAX_DECISIONS:
+                del self._decisions[: len(self._decisions) - MAX_DECISIONS]
+        self.metrics.culls.inc(policy=policy)
+
+    def decisions(self) -> list[dict]:
+        with self._lock:
+            return [dict(d) for d in self._decisions]
+
+    # ------------------------------------------------------------- exports
+
+    def debug_payload(self) -> dict:
+        with self._lock:
+            now = self.clock()
+            sessions = {}
+            for (ns, name), sess in sorted(self._sessions.items()):
+                sessions[f"{ns}/{name}"] = {
+                    "pool": sess.pool,
+                    "failures": sess.failures,
+                    "lastOkAgeS": (
+                        round(now - sess.last_ok, 1)
+                        if sess.last_ok != float("-inf")
+                        else None
+                    ),
+                    "fresh": now - sess.last_ok <= self.staleness_s,
+                    "latest": (
+                        {
+                            "dutyCycle": sess.latest.duty_cycle,
+                            "hbmUsedBytes": sess.latest.hbm_used_bytes,
+                            "hbmTotalBytes": sess.latest.hbm_total_bytes,
+                        }
+                        if sess.latest
+                        else None
+                    ),
+                }
+            return {
+                "intervalS": self.interval_s,
+                "stalenessS": self.staleness_s,
+                "evictAfterS": self.evict_after_s,
+                "scrapePasses": self.scrape_passes,
+                "sessionsScraped": self.sessions_scraped,
+                "fleet": {
+                    "dutyCycle": self.metrics.fleet_duty_cycle.get(),
+                    "hbmUtilization": self.metrics.fleet_hbm_utilization.get(),
+                },
+                "sessions": sessions,
+                "cullDecisions": [dict(d) for d in self._decisions],
+            }
+
+    # ---------------------------------------------------------------- audit
+
+    def audit(self, where: str = "telemetry") -> list[str]:
+        """Soak invariants (docs/chaos.md):
+
+        - **bounded staleness** — no tracked session may outlive the
+          eviction bound (a failed/vanished agent ages out, never
+          accumulates).
+        - **explainable culls** — every duty-cycle cull decision must be
+          backed by a point actually present in that session's recorded
+          series, below the threshold it claims: the decision came from
+          the store, not thin air.
+        """
+        out: list[str] = []
+        with self._lock:
+            now = self.clock()
+            for (ns, name), sess in self._sessions.items():
+                # one interval of slack: eviction happens at pass time, so
+                # an entry may exceed the bound by at most one interval
+                if now - sess.anchor() > self.evict_after_s + self.interval_s:
+                    out.append(
+                        f"{where}: session {ns}/{name} outlived the "
+                        f"eviction bound ({now - sess.anchor():.0f}s > "
+                        f"{self.evict_after_s:.0f}s)"
+                    )
+            for d in self._decisions:
+                if d["policy"] != "duty-cycle":
+                    continue
+                pts = {p["timestamp"]: p["value"] for p in d.get("series", [])}
+                val = pts.get(d["sampleAt"])
+                if val is None:
+                    out.append(
+                        f"{where}: duty-cycle cull of "
+                        f"{d['namespace']}/{d['notebook']} cites sample "
+                        f"t={d['sampleAt']} absent from the recorded series"
+                    )
+                elif val >= d["threshold"]:
+                    out.append(
+                        f"{where}: duty-cycle cull of "
+                        f"{d['namespace']}/{d['notebook']} not supported by "
+                        f"its series (recorded {val:.3f} >= threshold "
+                        f"{d['threshold']:.3f})"
+                    )
+        return out
+
+
+def install_telemetry_route(app, collector: FleetTelemetryCollector) -> None:
+    """Mount /debug/telemetry on a web App (rides the probes port next to
+    /debug/traces — cluster-internal, never the gateway)."""
+    import json
+
+    from werkzeug.wrappers import Response
+
+    @app.route("/debug/telemetry")
+    def debug_telemetry(request):
+        return Response(
+            json.dumps(collector.debug_payload(), sort_keys=True),
+            mimetype="application/json",
+        )

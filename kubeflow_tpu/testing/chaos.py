@@ -508,6 +508,14 @@ class Scenario:
                 annotations={INJECT_ANNOTATION: "true"}
             )
         self.active = {n for n in sorted(self.notebooks) if rng.random() < 0.4}
+        # idle-spinners: a LIVE "busy" kernel whose devices do nothing — the
+        # case kernel presence can never cull and the duty-cycle policy
+        # exists for. Drawn from the active TPU notebooks so the kernel
+        # fetcher reports them busy while their fake devices read idle.
+        self.idle_spin = {
+            n for n in sorted(self.active)
+            if "tpu_accelerator" in self.notebooks[n] and rng.random() < 0.5
+        }
         self.profiles = ["team-a"] + (["team-b"] if rng.random() < 0.5 else [])
         self.tensorboards = (
             {"tb-0": "pvc://logs-claim/runs"} if rng.random() < 0.6 else {}
@@ -641,6 +649,7 @@ class SeedResult:
     violations: list[str]
     restarts: int
     fault_counts: collections.Counter
+    telemetry: bool = False
 
     @property
     def ok(self) -> bool:
@@ -653,8 +662,10 @@ class SeedResult:
                 f"seed {self.seed}: converged "
                 f"({faults} faults, {self.restarts} controller restarts)"
             )
+        flag = " --telemetry" if self.telemetry else ""
         lines = [f"seed {self.seed}: FAILED "
-                 f"(repro: python tools/chaos_soak.py --seed {self.seed})"]
+                 f"(repro: python tools/chaos_soak.py --seed {self.seed}"
+                 f"{flag})"]
         if not self.converged:
             lines.append("  final state diverged from fault-free fixed point")
         lines += [f"  invariant: {v}" for v in self.violations[:10]]
@@ -667,10 +678,19 @@ def run_scenario(
     seed: int,
     faults: ChaosConfig | None = None,
     *,
+    telemetry: bool = False,
     max_restarts_per_tick: int = 6,
 ) -> ScenarioRun:
     """One full scenario run on the virtual clock. ``faults=None`` is the
-    fault-free reference run whose final state is the fixed point."""
+    fault-free reference run whose final state is the fixed point.
+
+    ``telemetry=True`` arms the data-plane pipeline (telemetry/): every TPU
+    notebook gets a fake in-pod agent (idle-spinners report busy kernels
+    but idle devices), ONE collector outlives controller restarts (an
+    observer, like the tracer), scrapes run ONLY from the harness driver
+    (never inside a reconcile tick — audited), and scrape failures are
+    chaos faults. The telemetry audit rides the run's violations.
+    """
     scenario = Scenario(seed)
     base = FakeCluster()
     tpu_env.install(base)
@@ -679,13 +699,6 @@ def run_scenario(
     cluster = chaos if chaos is not None else base
     clock = _Clock(1_000_000.0)
     cfg = ControllerConfig()
-    culler = Culler(
-        enabled=scenario.culling,
-        cull_idle_minutes=1.0,
-        check_period_minutes=0.5,
-        fetch_kernels=scenario.make_fetcher(),
-        clock=clock,
-    )
 
     # ONE tracer across controller restarts: the trace-audit invariant is a
     # property of the whole run (every write attributable), and the span
@@ -694,6 +707,80 @@ def run_scenario(
     # and must rediscover existing Events (AlreadyExists → count bump), which
     # is exactly the storm-shaped path the bounded-events audit guards.
     tracer = Tracer(clock=clock)
+
+    collector = None
+    if telemetry:
+        from kubeflow_tpu.culler.probe import ProbeResult
+        from kubeflow_tpu.telemetry.agent import FakeDeviceBackend, TelemetryAgent
+        from kubeflow_tpu.telemetry.collector import FleetTelemetryCollector
+        from kubeflow_tpu.utils.metrics import TelemetryMetrics
+
+        agents: dict[str, TelemetryAgent] = {}
+        for name, spec in scenario.notebooks.items():
+            if "tpu_accelerator" not in spec:
+                continue  # CPU notebooks have no device agent (fallback path)
+            if name in scenario.idle_spin:
+                duty = 0.01   # live kernel, idle chips: cullable ONLY here
+            elif name in scenario.active:
+                duty = 0.9    # genuinely working
+            else:
+                duty = 0.0    # no kernels AND idle devices
+            agents[name] = TelemetryAgent(
+                FakeDeviceBackend(
+                    duty_cycle=duty, hbm_used_bytes=float(duty * (8 << 30)),
+                    jitter=0.005, seed=seed,
+                ),
+                clock=clock,
+            )
+        # faulted runs draw scrape failures/timeouts from their own seeded
+        # stream (a wedged agent is a -2, a dead one a -1); the fault-free
+        # reference never fails a scrape
+        tel_rng = random.Random(f"telemetry-{seed}")
+
+        def fake_probe(targets, timeout=5.0, max_concurrency=64):
+            out = []
+            for ns, _port, name in targets:
+                agent = agents.get(name)
+                if agent is None:
+                    out.append(ProbeResult(-1, ""))
+                elif (
+                    chaos is not None
+                    and not chaos._healed
+                    and tel_rng.random() < 0.15
+                ):
+                    out.append(
+                        ProbeResult(-2 if tel_rng.random() < 0.5 else -1, "")
+                    )
+                else:
+                    out.append(ProbeResult(200, agent.exposition()))
+            return out
+
+        # ONE collector across controller restarts (an observer, like the
+        # tracer); it reads the store directly — its list is harness-side,
+        # the faults under test are the scrape failures above
+        collector = FleetTelemetryCollector(
+            base,
+            TelemetryMetrics(),
+            interval_s=10.0,
+            staleness_s=30.0,
+            clock=clock,
+            probe_fn=fake_probe,
+            target_for=lambda nb: (ko.namespace(nb), 0, ko.name(nb)),
+            tracer=tracer,
+        )
+
+    # the culler outlives restarts (annotation state lives on the CRs); its
+    # telemetry view is the collector's in-memory store — a pure read, so a
+    # wedged agent can never block a cull decision
+    culler = Culler(
+        enabled=scenario.culling,
+        cull_idle_minutes=1.0,
+        check_period_minutes=0.5,
+        fetch_kernels=scenario.make_fetcher(),
+        clock=clock,
+        telemetry=collector,
+        duty_cycle_idle_threshold=0.05,
+    )
 
     def build() -> Manager:
         m = Manager(cluster, clock=clock, tracer=tracer)
@@ -719,6 +806,11 @@ def run_scenario(
 
     def tick(where: str) -> None:
         nonlocal mgr, restarts
+        # zero reconcile-path scrapes: the collector's pass counter must not
+        # move while reconcile workers run — the culler reads the store,
+        # it never scrapes. A regression wiring collect() into a reconciler
+        # (or the culler) trips this on every seed.
+        passes_before = collector.scrape_passes if collector is not None else 0
         for _ in range(max_restarts_per_tick):
             crashed = False
             try:
@@ -730,20 +822,30 @@ def run_scenario(
             if chaos is not None and chaos.take_crash():
                 crashed = True
             if not crashed:
-                return
+                break
             # controller crash-restart: rebuild the Manager from scratch —
             # fresh workqueue, fresh watch sync — and resume over whatever
             # partial writes the dead incarnation left behind
             restarts += 1
             mgr.shutdown()
             mgr = build()
-        # crash storm exhausted the budget this tick; next tick retries
+        # (crash storm may have exhausted the budget; next tick retries)
+        if collector is not None and collector.scrape_passes != passes_before:
+            violations.append(
+                f"{where}: telemetry scrape ran on the reconcile path "
+                f"({collector.scrape_passes - passes_before} pass(es) "
+                f"during a manager tick)"
+            )
 
     def drive(where: str, *, sub_ticks: int = 3, dt: float = 10.0) -> None:
         for s in range(sub_ticks):
             cluster.step_kubelet()
             if chaos is not None:
                 chaos.tick_watches()
+            if collector is not None:
+                # the controller-manager's dedicated loop (cmd/controller):
+                # a scrape pass between ticks, interval-gated, never inside
+                collector.collect()
             tick(where)
             if chaos is not None:
                 lat = chaos.take_latency()
@@ -776,6 +878,8 @@ def run_scenario(
     quiesced = False
     for s in range(20):
         cluster.step_kubelet()
+        if collector is not None:
+            collector.collect()
         tick(f"quiesce {s}")
         fp = fingerprint(base)
         if fp == prev:
@@ -796,6 +900,11 @@ def run_scenario(
     # bounded events: dedup must bump counts, never multiply objects —
     # crash-restart loops re-emitting transitions are the storm risk
     violations.extend(audit_events(base, where="final"))
+    if collector is not None:
+        # telemetry audit (docs/chaos.md): stale/failed scrapes aged out
+        # bounded, and every duty-cycle cull explainable from the recorded
+        # series (zero reconcile-path scrapes is asserted per tick above)
+        violations.extend(collector.audit(where="final"))
     return ScenarioRun(
         fingerprint=prev or fingerprint(base),
         violations=violations,
@@ -805,10 +914,19 @@ def run_scenario(
     )
 
 
-def run_seed(seed: int, faults: ChaosConfig | None = None) -> SeedResult:
-    """The soak unit: fault-free fixed point vs faulted run, same seed."""
-    reference = run_scenario(seed, None)
-    chaotic = run_scenario(seed, faults or ChaosConfig())
+def run_seed(
+    seed: int,
+    faults: ChaosConfig | None = None,
+    *,
+    telemetry: bool = False,
+) -> SeedResult:
+    """The soak unit: fault-free fixed point vs faulted run, same seed.
+    ``telemetry=True`` runs BOTH with the data-plane pipeline armed — the
+    fixed point then includes duty-cycle culls of idle-spinners, so
+    convergence proves the faulted run's telemetry decisions match the
+    fault-free run's."""
+    reference = run_scenario(seed, None, telemetry=telemetry)
+    chaotic = run_scenario(seed, faults or ChaosConfig(), telemetry=telemetry)
     violations = list(chaotic.violations)
     if reference.violations:
         violations += [f"(fault-free!) {v}" for v in reference.violations]
@@ -821,13 +939,23 @@ def run_seed(seed: int, faults: ChaosConfig | None = None) -> SeedResult:
         violations=violations,
         restarts=chaotic.restarts,
         fault_counts=chaotic.fault_counts,
+        telemetry=telemetry,
     )
 
 
-def diff_states(seed: int, faults: ChaosConfig | None = None) -> str:
+def diff_states(
+    seed: int,
+    faults: ChaosConfig | None = None,
+    *,
+    telemetry: bool = False,
+) -> str:
     """Debug helper: where the faulted fixed point diverges (chaos_soak -v)."""
-    ref = json.loads(run_scenario(seed, None).fingerprint)
-    got = json.loads(run_scenario(seed, faults or ChaosConfig()).fingerprint)
+    ref = json.loads(run_scenario(seed, None, telemetry=telemetry).fingerprint)
+    got = json.loads(
+        run_scenario(
+            seed, faults or ChaosConfig(), telemetry=telemetry
+        ).fingerprint
+    )
 
     def index(objs):
         return {

@@ -61,6 +61,18 @@ class ControllerConfig:
     # shipped controller-manager process enables it (SESSIONS_ENABLED).
     sessions_enabled: bool = False
     suspend_deadline_s: float = 120.0
+    # Session telemetry (kubeflow_tpu/telemetry/): when enabled, the fleet
+    # collector scrapes every TPU notebook's in-pod agent in one parallel
+    # pass per interval, and the culler prefers the device duty-cycle
+    # signal over kernel activity (telemetry-when-present, kernel-activity
+    # fallback). Off by default for programmatic construction (same
+    # rationale as scheduler_enabled); the shipped controller-manager
+    # process enables it (TELEMETRY_ENABLED).
+    telemetry_enabled: bool = False
+    telemetry_interval_s: float = 15.0
+    telemetry_staleness_s: float = 60.0
+    telemetry_duty_cycle_idle: float = 0.05
+    telemetry_port: int = 8890
     # Profile defaults (ref --namespace-labels-path flag, profile-controller
     # main.go; the mounted file is hot-reloaded, go:356-405)
     namespace_labels_path: str = ""
@@ -85,6 +97,13 @@ class ControllerConfig:
             scheduler_enabled=_env_bool("SCHEDULER_ENABLED", True),
             sessions_enabled=_env_bool("SESSIONS_ENABLED", True),
             suspend_deadline_s=_env_float("SUSPEND_DEADLINE_S", 120.0),
+            telemetry_enabled=_env_bool("TELEMETRY_ENABLED", True),
+            telemetry_interval_s=_env_float("TELEMETRY_INTERVAL_S", 15.0),
+            telemetry_staleness_s=_env_float("TELEMETRY_STALENESS_S", 60.0),
+            telemetry_duty_cycle_idle=_env_float(
+                "TELEMETRY_DUTY_CYCLE_IDLE", 0.05
+            ),
+            telemetry_port=int(_env_float("TELEMETRY_PORT", 8890)),
             namespace_labels_path=os.environ.get("NAMESPACE_LABELS_PATH", ""),
             enable_oauth_controller=_env_bool("ENABLE_OAUTH_CONTROLLER", False),
         )

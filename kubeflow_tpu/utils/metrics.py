@@ -540,3 +540,86 @@ class SchedulerMetrics:
         self.time_to_bind.observe(seconds)
         if seconds > self.bind_seconds_max.get():
             self.bind_seconds_max.set(seconds)
+
+
+class TelemetryMetrics:
+    """Data-plane telemetry (docs/observability.md): per-session duty cycle
+    and HBM occupancy scraped from the in-pod agents, rolled up per node
+    pool and fleet-wide, plus the collector's own scrape health.
+
+    The ``scheduler_pool_*`` / ``scheduler_fleet_*`` families sit next to
+    the scheduler's allocation gauges on purpose: `scheduler_fleet_
+    utilization` says how many chips are *held*, `scheduler_fleet_duty_
+    cycle` says how hard they are actually *working* — the gap between the
+    two is the reclamation opportunity the culler's duty-cycle policy acts
+    on. Shares a registry with the other collectors so one /metrics scrape
+    carries the whole story.
+    """
+
+    # a full-fleet parallel scrape pass: ms (in-memory fakes) to seconds
+    # (thousands of pods behind a shared deadline)
+    PASS_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0)
+
+    def __init__(self, registry: Registry | None = None) -> None:
+        self.registry = registry or Registry()
+        self.session_duty_cycle = self.registry.gauge(
+            "telemetry_session_duty_cycle",
+            "Device duty cycle per session (0..1), from the last fresh scrape",
+            labelnames=("namespace", "notebook"),
+        )
+        self.session_hbm_used = self.registry.gauge(
+            "telemetry_session_hbm_used_bytes",
+            "HBM bytes in use per session, from the last fresh scrape",
+            labelnames=("namespace", "notebook"),
+        )
+        self.session_hbm_total = self.registry.gauge(
+            "telemetry_session_hbm_total_bytes",
+            "HBM bytes available per session, from the last fresh scrape",
+            labelnames=("namespace", "notebook"),
+        )
+        self.pool_duty_cycle = self.registry.gauge(
+            "scheduler_pool_duty_cycle",
+            "Mean device duty cycle of the sessions bound to a node pool",
+            labelnames=("pool",),
+        )
+        self.pool_hbm_utilization = self.registry.gauge(
+            "scheduler_pool_hbm_utilization",
+            "HBM used/available of the sessions bound to a node pool, 0..1",
+            labelnames=("pool",),
+        )
+        self.fleet_duty_cycle = self.registry.gauge(
+            "scheduler_fleet_duty_cycle",
+            "Mean device duty cycle across all fresh sessions (burned, not "
+            "allocated — compare scheduler_fleet_utilization)",
+        )
+        self.fleet_hbm_utilization = self.registry.gauge(
+            "scheduler_fleet_hbm_utilization",
+            "Fleet-wide HBM used/available across fresh sessions, 0..1",
+        )
+        self.sessions = self.registry.gauge(
+            "telemetry_sessions", "Sessions the collector currently tracks"
+        )
+        self.stale_sessions = self.registry.gauge(
+            "telemetry_stale_sessions",
+            "Tracked sessions whose last good scrape is older than the "
+            "staleness bound (still aging toward eviction)",
+        )
+        self.scrapes = self.registry.counter(
+            "telemetry_scrape_total",
+            "Per-session scrape outcomes (ok|failed)",
+            labelnames=("outcome",),
+        )
+        self.evicted = self.registry.counter(
+            "telemetry_session_evicted_total",
+            "Sessions dropped after exceeding the eviction bound",
+        )
+        self.pass_duration = self.registry.histogram(
+            "telemetry_scrape_pass_seconds",
+            "Wall time of one whole-fleet parallel scrape pass",
+            buckets=self.PASS_BUCKETS,
+        )
+        self.culls = self.registry.counter(
+            "telemetry_cull_total",
+            "Culls decided on the duty-cycle signal (vs kernel fallback)",
+            labelnames=("policy",),
+        )

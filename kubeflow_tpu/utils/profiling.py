@@ -58,6 +58,17 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def step_annotation(step_num: int, name: str = "train"):
+    """Step marker in the trace (``jax.profiler.StepTraceAnnotation``): the
+    TraceViewer groups device ops under step ``step_num``. The telemetry
+    agent's step hook (``telemetry/agent.py``) wraps every recorded step in
+    this, so the agent's step counter and a captured profile share one
+    numbering — "step 1234 was slow" means the same step in both."""
+    import jax
+
+    return jax.profiler.StepTraceAnnotation(name, step_num=step_num)
+
+
 def _block(tree) -> None:
     import jax
 

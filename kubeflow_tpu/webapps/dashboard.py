@@ -65,6 +65,7 @@ def create_app(
     metrics: NotebookMetrics | None = None,
     metrics_source: MetricsSource | None = None,
     links: dict | None = None,
+    telemetry=None,
 ) -> App:
     metrics = metrics or NotebookMetrics()
 
@@ -77,6 +78,12 @@ def create_app(
         "notebooks": _gauge_total(metrics.running),
         "tpus": _gauge_total(metrics.tpu_chips_in_use),
     }
+    if telemetry is not None:
+        # data-plane series (telemetry/collector.py): burned utilization
+        # next to the allocation counts above — memory reads off the
+        # collector's last pass, so the dashboard ticker never scrapes
+        readers["duty_cycle"] = telemetry.fleet_duty_cycle
+        readers["hbm"] = telemetry.fleet_hbm_utilization
     owned_source = None
     if metrics_source is None:
         if os.environ.get("METRICS_SOURCE"):
@@ -316,6 +323,10 @@ def create_app(
             values = metrics.running.samples()
         elif metric_type == "tpus":
             values = metrics.tpu_chips_in_use.samples()
+        elif telemetry is not None and metric_type == "duty_cycle":
+            values = telemetry.metrics.session_duty_cycle.samples()
+        elif telemetry is not None and metric_type == "hbm":
+            values = telemetry.metrics.session_hbm_used.samples()
         else:
             raise ValueError(f"unknown metric type {metric_type!r}")
         try:

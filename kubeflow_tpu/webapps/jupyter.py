@@ -141,6 +141,7 @@ def create_app(
     authorizer: Authorizer | None = None,
     config_path: str | None = None,
     metrics: NotebookMetrics | None = None,
+    telemetry=None,
 ) -> App:
     metrics = metrics or NotebookMetrics()
     app = App(
@@ -234,6 +235,13 @@ def create_app(
                                        e.get("metadata", {}).get("name", ""))
             )
         ]
+        if telemetry is not None:
+            # device telemetry on the detail payload (telemetry/): current
+            # duty cycle + HBM with freshness and the recent series — the
+            # "is my slice actually working" answer next to the status.
+            # None (vs absent) for a session the collector has never seen,
+            # so the UI can distinguish "no agent" from "telemetry off".
+            summary["telemetry"] = telemetry.session_payload(namespace, name)
         return success("notebook", summary, raw=nb)
 
     @app.route("/api/namespaces/<namespace>/notebooks/<name>/pod")

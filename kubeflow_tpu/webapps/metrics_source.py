@@ -196,15 +196,25 @@ class RegistrySource(MetricsSource):
         return self._store.window(metric_type, window_s, self._sampler.now())
 
 
+# The label block is NOT "anything up to the first }": label values are
+# quoted strings with \\ \" \n escapes (utils/metrics.py emits them), so a
+# value may legally contain both `}` and escaped quotes. Outside quotes we
+# accept anything but a brace or quote; inside, any escaped char or any
+# non-quote — the same grammar the exposition writer produces.
 _PROM_LINE = re.compile(
-    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{[^}]*\})?\s+(?P<value>[^\s]+)"
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r'(?:\{(?:[^{}"]|"(?:\\.|[^"\\])*")*\})?'
+    r"\s+(?P<value>[^\s]+)"
 )
 
 
 def parse_prometheus_text(text: str) -> dict[str, float]:
     """Per-family totals from Prometheus text exposition: all samples of a
     family (across label sets) are summed — the dashboard charts cluster
-    totals, the per-label breakdown stays on the scrape endpoint."""
+    totals, the per-label breakdown stays on the scrape endpoint. Label
+    values containing escaped quotes or `}` (legal since the registry's
+    exposition escaping landed) parse correctly instead of truncating the
+    sample line mid-label."""
     totals: dict[str, float] = {}
     for line in text.splitlines():
         if not line or line.startswith("#"):

@@ -192,6 +192,28 @@ def combined_registry() -> Registry:
                      tpu_topology="2x2x2")
     )
     cluster.settle(mgr, rounds=4)
+    # data-plane telemetry on the same registry (telemetry/collector.py):
+    # one scrape pass against a fake agent populates every family
+    from kubeflow_tpu.culler.probe import ProbeResult
+    from kubeflow_tpu.runtime import objects as ko
+    from kubeflow_tpu.telemetry.agent import FakeDeviceBackend, TelemetryAgent
+    from kubeflow_tpu.telemetry.collector import FleetTelemetryCollector
+    from kubeflow_tpu.utils.metrics import TelemetryMetrics
+
+    agent = TelemetryAgent(FakeDeviceBackend(duty_cycle=0.5))
+    telem = FleetTelemetryCollector(
+        cluster, TelemetryMetrics(nm.registry),
+        probe_fn=lambda targets, **kw: [
+            ProbeResult(200, agent.exposition()) for _ in targets
+        ],
+        target_for=lambda nb: (ko.namespace(nb), 0, ko.name(nb)),
+        tracer=tracer,
+    )
+    telem.collect(force=True)
+    telem.record_cull(
+        "team-metrics", "nb-lint", policy="duty-cycle",
+        sample=telem.activity("team-metrics", "nb-lint"), threshold=0.6,
+    )
     # one suspend through the barrier so the session histograms carry data
     cluster.patch("Notebook", "nb-lint", "team-metrics",
                   {"metadata": {"annotations": {

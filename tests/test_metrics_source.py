@@ -17,6 +17,7 @@ from kubeflow_tpu.webapps.metrics_source import (
     PrometheusSource,
     RegistrySource,
     SeriesStore,
+    _TickSampler,
     metrics_source_from_env,
     parse_prometheus_text,
 )
@@ -64,6 +65,70 @@ class TestSeriesStore:
         assert store.window("x", 100.0, 5.0) == [
             {"timestamp": 5.0, "value": 2.0}
         ]
+
+    def test_window_eviction_exact_at_maxlen(self):
+        """Eviction at maxlen is exact: the store holds exactly the newest
+        maxlen points, per metric type, with other types untouched."""
+        store = SeriesStore(maxlen=5)
+        for i in range(100):
+            store.append("a", float(i), float(i))
+        store.append("b", 0.0, 42.0)  # a sibling series must not be evicted
+        a = store.window("a", 1e9, 100.0)
+        assert len(a) == 5
+        assert [p["timestamp"] for p in a] == [95.0, 96.0, 97.0, 98.0, 99.0]
+        assert store.window("b", 1e9, 100.0) == [
+            {"timestamp": 0.0, "value": 42.0}
+        ]
+
+
+class TestTickSamplerReplicaAgreement:
+    def test_skewed_clocks_same_interval_identical_grid(self):
+        """Two replicas whose clocks disagree WITHIN a tick must emit the
+        identical (timestamp, value) grid: the sampler timestamps AT the
+        tick, so sub-interval skew cannot leak into the series."""
+        ca, cb = FakeClock(1000.0), FakeClock(1003.7)  # 3.7 s skew
+        sa, sb = _TickSampler(15.0, ca), _TickSampler(15.0, cb)
+        grid_a, grid_b = [], []
+        for step in range(6):
+            ca.t = 1000.0 + step * 15.0
+            cb.t = ca.t + 3.7  # skew stays under the interval
+            ta, tb = sa.due(), sb.due()
+            if ta is not None:
+                grid_a.append(ta)
+            if tb is not None:
+                grid_b.append(tb)
+        assert grid_a == grid_b
+        assert grid_a == [990.0 + 15.0 * i for i in range(6)]
+
+    def test_skewed_registry_sources_emit_identical_series(self):
+        """End to end: two RegistrySources (two dashboard replicas) reading
+        the same ground truth on skewed clocks produce identical
+        (timestamp, value) points — the agreement contract is the sampler's,
+        not luck."""
+        truth = {"v": 1.0}
+        ca, cb = FakeClock(0.0), FakeClock(0.0)
+        mk = lambda c: RegistrySource(
+            {"nb": lambda: truth["v"]}, interval_s=10.0, clock=c
+        )
+        a, b = mk(ca), mk(cb)
+        for step in range(1, 5):
+            truth["v"] = float(step)
+            ca.t = step * 10.0 + 1.0   # replica A reads just after the tick
+            cb.t = step * 10.0 + 8.9   # replica B reads much later in it
+            assert a.series("nb", window_s=1e6) == b.series("nb", window_s=1e6)
+        assert [p["timestamp"] for p in a.series("nb", window_s=1e6)] == [
+            10.0, 20.0, 30.0, 40.0,
+        ]
+
+    def test_due_returns_each_tick_once(self):
+        clock = FakeClock(100.0)
+        s = _TickSampler(10.0, clock)
+        assert s.due() == 100.0
+        assert s.due() is None
+        clock.t = 109.9
+        assert s.due() is None
+        clock.t = 110.0
+        assert s.due() == 110.0
 
 
 class TestRegistrySource:
@@ -137,6 +202,63 @@ notebook_running{namespace="bob"} 3
 notebook_tpu_chips_in_use{namespace="alice"} 8
 garbage line without a value
 """
+
+
+class TestParseEscapedLabels:
+    """Satellite regression: PR 3's exposition escaping made `\\"`, `\\\\`,
+    and raw `}` legal inside label values; the old `\\{[^}]*\\}` regex
+    truncated the label block at the first `}` and dropped (or mis-read)
+    the sample."""
+
+    def test_label_value_containing_close_brace(self):
+        text = 'm{path="/a/{b}/c"} 3\nm{path="plain"} 4\n'
+        assert parse_prometheus_text(text)["m"] == 7.0
+
+    def test_label_value_with_escaped_quotes(self):
+        text = 'm{msg="she said \\"hi\\""} 2\n'
+        assert parse_prometheus_text(text)["m"] == 2.0
+
+    def test_label_value_with_trailing_backslash_escape(self):
+        # `\\\\"` = escaped backslash then closing quote — a naive
+        # escaped-quote scanner reads the quote as escaped and runs away
+        text = 'm{p="C:\\\\"} 1\nm{p="x"} 2\n'
+        assert parse_prometheus_text(text)["m"] == 3.0
+
+    def test_round_trip_through_registry_exposition(self):
+        """The real producer/consumer pair: values the registry legally
+        escapes must come back through the parser intact."""
+        from kubeflow_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        g = reg.gauge("nasty_gauge", "gauge with hostile label values")
+        hostile = [
+            'quote " inside',
+            "brace } inside",
+            "back\\slash",
+            "new\nline",
+            '{"json": "value}"}',
+        ]
+        for i, v in enumerate(hostile):
+            g.set(float(i + 1), label=v)
+        totals = parse_prometheus_text(reg.expose())
+        assert totals["nasty_gauge"] == float(
+            sum(range(1, len(hostile) + 1))
+        )
+
+    def test_histogram_exposition_round_trips(self):
+        from kubeflow_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        h = reg.histogram(
+            "h_seconds", "histogram", buckets=(0.1, 1.0)
+        )
+        h.observe(0.05, op='write"}')
+        h.observe(5.0, op='write"}')
+        totals = parse_prometheus_text(reg.expose())
+        assert totals["h_seconds_count"] == 2.0
+        assert totals["h_seconds_sum"] == 5.05
+        # cumulative buckets: 1 + 1 + 2 across le=0.1, 1.0, +Inf
+        assert totals["h_seconds_bucket"] == 4.0
 
 
 class TestPrometheusSource:

@@ -279,7 +279,16 @@ class TestWorkQueueParity:
 
 class _KernelsHandler(http.server.BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
-        if self.path.endswith("/api/kernels"):
+        if self.path == "/slow":
+            # stall past any sub-second probe deadline: the timeout case
+            # (the connection succeeded, the response never comes)
+            import time as _time
+
+            _time.sleep(2.0)
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+        elif self.path.endswith("/api/kernels"):
             body = json.dumps(
                 [{"execution_state": "idle", "last_activity": "2026-01-01T00:00:00Z"}]
             ).encode()
@@ -339,6 +348,29 @@ class TestProbe:
         python = probemod._probe_python(targets, 5.0, 4)
         assert native[0].status == python[0].status == 200
         assert native[0].kernels() == python[0].kernels()
+
+    def test_fallback_and_native_classify_errors_identically(
+        self, kernel_server
+    ):
+        """Differential error-classification parity: the urllib fallback
+        must report the SAME negative statuses as the native prober —
+        -1 connect/resolve failure, -2 deadline expired. (The fallback used
+        to collapse timeouts into -1, so telemetry/culler consumers could
+        not tell a dead endpoint from a wedged one depending on which
+        prober the host happened to load.)"""
+        host, port = kernel_server
+        targets = [
+            ("127.0.0.1", 1, "/x"),      # closed port: connect refused
+            (host, port, "/slow"),        # server stalls past the deadline
+            (host, port, "/nope"),        # plain 404 for good measure
+        ]
+        python = probemod._probe_python(targets, 0.5, 4)
+        assert [r.status for r in python] == [-1, -2, 404]
+        lib = probemod._wq._load_library()
+        if lib is None:
+            pytest.skip("native library unavailable; python half verified")
+        native = probemod._probe_native(lib, targets, 0.5, 4)
+        assert [r.status for r in native] == [r.status for r in python]
 
 
 class TestPlacement:

@@ -1,0 +1,850 @@
+"""Session telemetry: agent, fleet collector, and the duty-cycle cull policy.
+
+Pins the data-plane pipeline (``kubeflow_tpu/telemetry/``,
+docs/observability.md): the in-pod agent's exposition and step hook, the
+collector's parallel-pass scrape/staleness/eviction semantics, the culler's
+telemetry-when-present / kernel-activity-fallback precedence — including
+the acceptance scenario: a notebook with a LIVE busy kernel but idle
+devices is culled by duty cycle, while the same notebook under the
+kernel-activity-only signal is not.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+from werkzeug.test import Client
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.culler.culler import Culler, stop_annotation_is_set
+from kubeflow_tpu.culler.probe import ProbeResult
+from kubeflow_tpu.obs.events import EventRecorder
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.telemetry import ActivitySample
+from kubeflow_tpu.telemetry.agent import (
+    FakeDeviceBackend,
+    StepRing,
+    TelemetryAgent,
+)
+from kubeflow_tpu.telemetry.collector import (
+    FleetTelemetryCollector,
+    install_telemetry_route,
+)
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import TelemetryMetrics
+from kubeflow_tpu.webapps.metrics_source import parse_prometheus_text
+from kubeflow_tpu.webhooks import tpu_env
+
+NS = "team-a"
+
+
+class FakeClock:
+    def __init__(self, t: float = 1_000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+# --------------------------------------------------------------------- agent
+
+
+class TestAgent:
+    def test_exposition_carries_device_signals(self):
+        agent = TelemetryAgent(
+            FakeDeviceBackend(
+                duty_cycle=0.75, hbm_used_bytes=float(4 << 30),
+                hbm_total_bytes=float(16 << 30), devices=4,
+            ),
+            clock=FakeClock(),
+        )
+        families = parse_prometheus_text(agent.exposition())
+        assert families["tpu_duty_cycle"] == pytest.approx(0.75)
+        assert families["tpu_hbm_used_bytes"] == pytest.approx(4 << 30)
+        assert families["tpu_hbm_total_bytes"] == pytest.approx(16 << 30)
+        assert families["tpu_device_count"] == 4
+
+    def test_fake_backend_jitter_is_deterministic(self):
+        mk = lambda: FakeDeviceBackend(duty_cycle=0.5, jitter=0.05, seed=7)
+        a, b = mk(), mk()
+        sa = [s.duty_cycle for s in a.samples()]
+        sb = [s.duty_cycle for s in b.samples()]
+        assert sa == sb
+        assert any(abs(d - 0.5) > 1e-9 for d in sa)  # jitter actually applied
+
+    def test_step_hook_times_into_ring_and_histogram(self):
+        clock = FakeClock(100.0)
+        agent = TelemetryAgent(
+            FakeDeviceBackend(duty_cycle=0.0), clock=clock, window_s=60.0
+        )
+        with agent.step() as n:
+            clock.advance(2.0)
+        assert n == 1
+        with agent.step() as n:
+            clock.advance(3.0)
+        assert n == 2
+        assert agent.steps.get() == 2
+        assert agent.step_duration.count() == 2
+        assert agent.step_duration.sum() == pytest.approx(5.0)
+        # 5 s busy over the trailing 60 s window
+        assert agent.ring.busy_fraction(60.0, clock()) == pytest.approx(5 / 60)
+
+    def test_duty_cycle_derived_from_steps_when_backend_blind(self):
+        """Public JAX exposes no duty-cycle counter: a backend returning
+        duty_cycle=None makes the agent derive it from step timing."""
+
+        class BlindBackend:
+            def samples(self):
+                from kubeflow_tpu.telemetry.agent import DeviceSample
+
+                return [
+                    DeviceSample(
+                        duty_cycle=None,
+                        hbm_used_bytes=1.0,
+                        hbm_total_bytes=2.0,
+                    )
+                ]
+
+        clock = FakeClock(0.0)
+        agent = TelemetryAgent(BlindBackend(), clock=clock, window_s=10.0)
+        with agent.step():
+            clock.advance(5.0)
+        families = parse_prometheus_text(agent.exposition())
+        assert families["tpu_duty_cycle"] == pytest.approx(0.5)
+
+    def test_uninstrumented_blind_backend_reports_duty_unknown(self):
+        """No hardware counter AND no step hook ever = duty UNKNOWN (flag
+        0), never a false idle 0 a culler could act on."""
+
+        class BlindBackend:
+            def samples(self):
+                from kubeflow_tpu.telemetry.agent import DeviceSample
+
+                return [
+                    DeviceSample(
+                        duty_cycle=None, hbm_used_bytes=1.0, hbm_total_bytes=2.0
+                    )
+                ]
+
+        clock = FakeClock(0.0)
+        agent = TelemetryAgent(BlindBackend(), clock=clock, window_s=10.0)
+        families = parse_prometheus_text(agent.exposition())
+        assert families["tpu_duty_cycle_known"] == 0.0
+        # the first step() flips it to a real (known) measurement
+        with agent.step():
+            clock.advance(1.0)
+        families = parse_prometheus_text(agent.exposition())
+        assert families["tpu_duty_cycle_known"] == 1.0
+
+    def test_open_step_counts_as_busy_mid_flight(self):
+        """A single step longer than the window must read busy WHILE it
+        runs — scrapes land mid-step, and idle-until-it-finishes would
+        expose a long eval pass to the duty-cycle culler."""
+
+        class BlindBackend:
+            def samples(self):
+                from kubeflow_tpu.telemetry.agent import DeviceSample
+
+                return [
+                    DeviceSample(
+                        duty_cycle=None, hbm_used_bytes=0.0, hbm_total_bytes=1.0
+                    )
+                ]
+
+        clock = FakeClock(0.0)
+        agent = TelemetryAgent(BlindBackend(), clock=clock, window_s=10.0)
+        step = agent.step()
+        step.__enter__()  # a step is executing right now
+        clock.advance(100.0)  # far longer than the window
+        families = parse_prometheus_text(agent.exposition())
+        assert families["tpu_duty_cycle"] == pytest.approx(1.0)
+        assert families["tpu_duty_cycle_known"] == 1.0
+        step.__exit__(None, None, None)
+
+    def test_step_ring_evicts_at_maxlen(self):
+        ring = StepRing(maxlen=3)
+        for i in range(10):
+            ring.add(i, float(i), float(i) + 0.5)
+        assert ring.last()[0] == 9
+        # only the surviving 3 intervals contribute
+        assert ring.busy_fraction(100.0, 10.0) == pytest.approx(1.5 / 100.0)
+
+    def test_wsgi_serves_exposition(self):
+        agent = TelemetryAgent(
+            FakeDeviceBackend(duty_cycle=0.25), clock=FakeClock()
+        )
+        client = Client(agent.wsgi)
+        resp = client.get("/metrics")
+        assert resp.status_code == 200
+        families = parse_prometheus_text(resp.get_data(as_text=True))
+        assert families["tpu_duty_cycle"] == pytest.approx(0.25)
+
+
+class TestStepAnnotationSharing:
+    def test_agent_step_uses_profiler_annotation(self, monkeypatch):
+        """Satellite: the agent's step hook and the profiler share one step
+        numbering through utils/profiling.step_annotation."""
+        import kubeflow_tpu.utils.profiling as prof
+
+        seen = []
+
+        class _Ann:
+            def __init__(self, name, step_num=None):
+                seen.append((name, step_num))
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        monkeypatch.setattr(
+            prof, "step_annotation", lambda n, name="train": _Ann(name, n)
+        )
+        agent = TelemetryAgent(FakeDeviceBackend(), clock=FakeClock())
+        with agent.step():
+            pass
+        with agent.step():
+            pass
+        assert seen == [("train", 1), ("train", 2)]
+
+    def test_step_annotation_builds_jax_annotation(self, monkeypatch):
+        """step_annotation() itself, with jax.profiler stubbed."""
+        import sys
+        import types
+
+        import kubeflow_tpu.utils.profiling as prof
+
+        calls = []
+
+        class _Stub:
+            def __init__(self, name, step_num=None):
+                calls.append((name, step_num))
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        fake_jax = types.SimpleNamespace(
+            profiler=types.SimpleNamespace(StepTraceAnnotation=_Stub)
+        )
+        monkeypatch.setitem(sys.modules, "jax", fake_jax)
+        with prof.step_annotation(42):
+            pass
+        with prof.step_annotation(7, name="eval"):
+            pass
+        assert calls == [("train", 42), ("eval", 7)]
+
+    def test_trace_context_manager_with_profiler_stubbed(self, monkeypatch):
+        import sys
+        import types
+
+        import kubeflow_tpu.utils.profiling as prof
+
+        events = []
+        fake_jax = types.SimpleNamespace(
+            profiler=types.SimpleNamespace(
+                start_trace=lambda d: events.append(("start", d)),
+                stop_trace=lambda: events.append(("stop",)),
+            )
+        )
+        monkeypatch.setitem(sys.modules, "jax", fake_jax)
+        with prof.trace("gs://bucket/run1"):
+            events.append(("body",))
+        assert events == [("start", "gs://bucket/run1"), ("body",), ("stop",)]
+
+
+# ----------------------------------------------------------------- collector
+
+
+def _tpu_world(names=("nb",)):
+    cluster = FakeCluster()
+    tpu_env.install(cluster)
+    for name in names:
+        cluster.create(
+            api.notebook(name, NS, tpu_accelerator="v4", tpu_topology="2x2x2")
+        )
+    return cluster
+
+
+def _mk_collector(cluster, agents, clock, *, fail=None, **kw):
+    """Collector over fake agents; ``fail`` is a set of names whose scrape
+    times out (the wedged-agent case)."""
+
+    def fake_probe(targets, timeout=5.0, max_concurrency=64):
+        out = []
+        for _ns, _port, name in targets:
+            if fail and name in fail:
+                out.append(ProbeResult(-2, ""))
+            elif name in agents:
+                out.append(ProbeResult(200, agents[name].exposition()))
+            else:
+                out.append(ProbeResult(-1, ""))
+        return out
+
+    kw.setdefault("interval_s", 10.0)
+    kw.setdefault("staleness_s", 30.0)
+    return FleetTelemetryCollector(
+        cluster,
+        TelemetryMetrics(),
+        clock=clock,
+        probe_fn=fake_probe,
+        target_for=lambda nb: (ko.namespace(nb), 0, ko.name(nb)),
+        **kw,
+    )
+
+
+class TestCollector:
+    def test_parallel_pass_fills_sessions_and_gauges(self):
+        clock = FakeClock()
+        cluster = _tpu_world(("nb-a", "nb-b"))
+        agents = {
+            "nb-a": TelemetryAgent(
+                FakeDeviceBackend(
+                    duty_cycle=0.8, hbm_used_bytes=1e9, hbm_total_bytes=2e9
+                ),
+                clock=clock,
+            ),
+            "nb-b": TelemetryAgent(
+                FakeDeviceBackend(
+                    duty_cycle=0.2, hbm_used_bytes=0.0, hbm_total_bytes=2e9
+                ),
+                clock=clock,
+            ),
+        }
+        col = _mk_collector(cluster, agents, clock)
+        assert col.collect() == 2
+        a = col.activity(NS, "nb-a")
+        assert a is not None and a.duty_cycle == pytest.approx(0.8)
+        m = col.metrics
+        assert m.sessions.get() == 2
+        assert m.fleet_duty_cycle.get() == pytest.approx(0.5)
+        assert m.fleet_hbm_utilization.get() == pytest.approx(0.25)
+        assert m.session_duty_cycle.get(
+            namespace=NS, notebook="nb-a"
+        ) == pytest.approx(0.8)
+
+    def test_interval_gates_passes(self):
+        clock = FakeClock()
+        cluster = _tpu_world()
+        agents = {"nb": TelemetryAgent(FakeDeviceBackend(), clock=clock)}
+        col = _mk_collector(cluster, agents, clock)
+        assert col.collect() == 1
+        assert col.collect() == 0  # same tick: gated
+        clock.advance(10.0)
+        assert col.collect() == 1
+        assert col.scrape_passes == 2
+
+    def test_cpu_and_stopped_notebooks_not_probed(self):
+        clock = FakeClock()
+        cluster = _tpu_world(("nb-tpu",))
+        cluster.create(api.notebook("nb-cpu", NS))
+        cluster.patch(
+            "Notebook", "nb-tpu", NS,
+            {"metadata": {"annotations": {
+                api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}},
+        )
+        probed = []
+
+        def probe(targets, timeout=5.0, max_concurrency=64):
+            probed.extend(targets)
+            return [ProbeResult(-1, "") for _ in targets]
+
+        col = FleetTelemetryCollector(
+            cluster, TelemetryMetrics(), clock=clock, probe_fn=probe,
+            target_for=lambda nb: (ko.namespace(nb), 0, ko.name(nb)),
+        )
+        col.collect()
+        assert probed == []
+
+    def test_failed_scrape_leaves_gap_then_recovers(self):
+        clock = FakeClock()
+        cluster = _tpu_world()
+        agents = {
+            "nb": TelemetryAgent(FakeDeviceBackend(duty_cycle=0.9), clock=clock)
+        }
+        col = _mk_collector(cluster, agents, clock)
+        col.collect()
+        healthy_probe = col.probe_fn  # swap probe to a failing one mid-life
+
+        def failing(targets, timeout=5.0, max_concurrency=64):
+            return [ProbeResult(-2, "") for _ in targets]
+
+        col.probe_fn = failing
+        clock.advance(10.0)
+        col.collect()
+        # one good + one failed attempt: still fresh (10 s < 30 s staleness)
+        assert col.activity(NS, "nb") is not None
+        clock.advance(31.0)
+        col.collect()
+        assert col.activity(NS, "nb") is None  # stale now
+        assert col.metrics.stale_sessions.get() == 1
+        col.probe_fn = healthy_probe
+        clock.advance(10.0)
+        col.collect()
+        assert col.activity(NS, "nb") is not None  # recovered
+        series = col.series(NS, "nb", "duty_cycle", window_s=1e6)
+        assert len(series) == 2  # the failed ticks left gaps, not zeros
+
+    def test_stale_sessions_age_out_bounded(self):
+        """Bounded staleness: a dead agent's entry is evicted after the
+        eviction window — the store cannot grow without bound."""
+        clock = FakeClock()
+        cluster = _tpu_world()
+        agents = {
+            "nb": TelemetryAgent(FakeDeviceBackend(duty_cycle=0.9), clock=clock)
+        }
+        col = _mk_collector(cluster, agents, clock)
+        col.collect()
+        col.probe_fn = lambda targets, **kw: [
+            ProbeResult(-1, "") for _ in targets
+        ]
+        for _ in range(14):
+            clock.advance(10.0)
+            col.collect()
+            assert col.audit() == []  # bound holds at every pass
+        assert col.metrics.sessions.get() == 0
+        assert col.metrics.evicted.get() >= 1
+
+    def test_deleted_notebook_session_dropped(self):
+        clock = FakeClock()
+        cluster = _tpu_world()
+        agents = {
+            "nb": TelemetryAgent(FakeDeviceBackend(duty_cycle=0.5), clock=clock)
+        }
+        col = _mk_collector(cluster, agents, clock)
+        col.collect()
+        assert col.metrics.sessions.get() == 1
+        cluster.delete("Notebook", "nb", NS)
+        clock.advance(10.0)
+        col.collect()
+        assert col.metrics.sessions.get() == 0
+        assert col.activity(NS, "nb") is None
+
+    def test_pool_aggregation_from_placement(self):
+        from kubeflow_tpu import scheduler as sched
+
+        clock = FakeClock()
+        cluster = _tpu_world(("nb-a", "nb-b"))
+        for name, pool in (("nb-a", "pool-1"), ("nb-b", "pool-2")):
+            cluster.patch(
+                "Notebook", name, NS,
+                {"metadata": {"annotations": {
+                    sched.PLACEMENT_ANNOTATION: sched.encode_placement(
+                        [{
+                            "pool": pool, "accelerator": "v4",
+                            "shape": [2, 2, 2], "poolTopology": "2x2x2",
+                        }],
+                        bound_at=1.0,
+                    ),
+                }}},
+            )
+        agents = {
+            "nb-a": TelemetryAgent(
+                FakeDeviceBackend(
+                    duty_cycle=1.0, hbm_used_bytes=2e9, hbm_total_bytes=2e9
+                ),
+                clock=clock,
+            ),
+            "nb-b": TelemetryAgent(
+                FakeDeviceBackend(
+                    duty_cycle=0.0, hbm_used_bytes=0.0, hbm_total_bytes=2e9
+                ),
+                clock=clock,
+            ),
+        }
+        col = _mk_collector(cluster, agents, clock)
+        col.collect()
+        m = col.metrics
+        assert m.pool_duty_cycle.get(pool="pool-1") == pytest.approx(1.0)
+        assert m.pool_duty_cycle.get(pool="pool-2") == pytest.approx(0.0)
+        assert m.pool_hbm_utilization.get(pool="pool-1") == pytest.approx(1.0)
+        # allocation vs burn, side by side on one registry
+        assert m.fleet_duty_cycle.get() == pytest.approx(0.5)
+
+    def test_debug_telemetry_route(self):
+        from kubeflow_tpu.webapps.base import App
+
+        clock = FakeClock()
+        cluster = _tpu_world()
+        agents = {
+            "nb": TelemetryAgent(FakeDeviceBackend(duty_cycle=0.4), clock=clock)
+        }
+        col = _mk_collector(cluster, agents, clock)
+        col.collect()
+        app = App("probes", csrf_protect=False)
+        install_telemetry_route(app, col)
+        resp = Client(app).get("/debug/telemetry")
+        assert resp.status_code == 200
+        payload = json.loads(resp.get_data(as_text=True))
+        assert payload["scrapePasses"] == 1
+        assert payload["sessions"][f"{NS}/nb"]["fresh"] is True
+        assert payload["sessions"][f"{NS}/nb"]["latest"]["dutyCycle"] == (
+            pytest.approx(0.4)
+        )
+
+    def test_audit_rejects_unexplainable_cull(self):
+        """The audit itself must catch planted violations — a decision whose
+        cited sample is absent from (or contradicts) the recorded series."""
+        clock = FakeClock()
+        cluster = _tpu_world()
+        agents = {
+            "nb": TelemetryAgent(FakeDeviceBackend(duty_cycle=0.9), clock=clock)
+        }
+        col = _mk_collector(cluster, agents, clock)
+        col.collect()
+        # planted: claims duty-cycle cull but the recorded point is 0.9
+        col.record_cull(
+            NS, "nb", policy="duty-cycle",
+            sample=ActivitySample(
+                at=clock(), duty_cycle=0.9,
+                hbm_used_bytes=0, hbm_total_bytes=1,
+            ),
+            threshold=0.05,
+        )
+        assert any("not supported" in v for v in col.audit())
+        # and one citing a timestamp that was never recorded
+        col._decisions.clear()
+        col.record_cull(
+            NS, "nb", policy="duty-cycle",
+            sample=ActivitySample(
+                at=123.0, duty_cycle=0.0,
+                hbm_used_bytes=0, hbm_total_bytes=1,
+            ),
+            threshold=0.05,
+        )
+        assert any("absent" in v for v in col.audit())
+
+
+# -------------------------------------------------- culler policy precedence
+
+
+def _culled_world(
+    *, telemetry_duty: float | None, kernels_busy: bool = True
+):
+    """A reconciled TPU notebook world with culling armed; returns
+    (cluster, mgr, clock, collector). ``telemetry_duty=None`` = no agent
+    (kernel-activity fallback)."""
+    clock = FakeClock(1_000_000.0)
+    cluster = _tpu_world()
+    agents = {}
+    if telemetry_duty is not None:
+        agents["nb"] = TelemetryAgent(
+            FakeDeviceBackend(duty_cycle=telemetry_duty), clock=clock
+        )
+    col = _mk_collector(cluster, agents, clock)
+    fetch = lambda ns, name: (
+        [{"execution_state": "busy"}] if kernels_busy else []
+    )
+    culler = Culler(
+        enabled=True,
+        cull_idle_minutes=1.0,
+        check_period_minutes=0.5,
+        fetch_kernels=fetch,
+        clock=clock,
+        telemetry=col,
+        duty_cycle_idle_threshold=0.05,
+    )
+    mgr = Manager(cluster, clock=clock)
+    mgr.register(
+        NotebookReconciler(
+            ControllerConfig(enable_culling=True), culler=culler,
+            recorder=EventRecorder(clock=clock),
+        )
+    )
+    return cluster, mgr, clock, col
+
+
+def _drive(cluster, mgr, clock, col, rounds=8, dt=35.0):
+    for _ in range(rounds):
+        cluster.step_kubelet()
+        col.collect()
+        mgr.tick()  # external clock: tick() fires due requeues itself
+        clock.advance(dt)
+
+
+class TestDutyCyclePolicy:
+    def test_live_but_idle_kernel_culled_by_duty_cycle_only(self):
+        """THE acceptance scenario: same notebook, same busy kernel — the
+        telemetry signal culls it, the kernel-activity signal does not.
+        Proves the new signal, not the old probe, makes the decision."""
+        # with telemetry: idle devices under a live busy kernel → culled
+        cluster, mgr, clock, col = _culled_world(telemetry_duty=0.01)
+        _drive(cluster, mgr, clock, col)
+        nb = cluster.get("Notebook", "nb", NS)
+        assert stop_annotation_is_set(nb), "duty-cycle idleness must cull"
+        culled = [
+            e for e in cluster.events_for(nb) if e.get("reason") == "Culled"
+        ]
+        assert culled and "duty-cycle" in culled[0]["message"]
+        # provenance recorded for the audit, backed by the series
+        decisions = col.decisions()
+        assert decisions and decisions[0]["policy"] == "duty-cycle"
+        assert col.audit() == []
+
+        # without telemetry: the same busy kernel keeps it alive forever
+        cluster2, mgr2, clock2, col2 = _culled_world(telemetry_duty=None)
+        _drive(cluster2, mgr2, clock2, col2)
+        nb2 = cluster2.get("Notebook", "nb", NS)
+        assert not stop_annotation_is_set(nb2), (
+            "kernel-activity-only signal must NOT cull a busy kernel"
+        )
+
+    def test_busy_devices_protected_even_with_idle_kernels(self):
+        """The converse: hot devices refresh the idle clock even when the
+        kernel API reads idle (a long sync-free training loop)."""
+        cluster, mgr, clock, col = _culled_world(
+            telemetry_duty=0.95, kernels_busy=False
+        )
+        _drive(cluster, mgr, clock, col)
+        nb = cluster.get("Notebook", "nb", NS)
+        assert not stop_annotation_is_set(nb)
+
+    def test_stale_telemetry_falls_back_to_kernels(self):
+        """Collector outage mid-life: the culler must degrade to the
+        reference's kernel-activity behavior, not keep acting on a stale
+        idle sample."""
+        cluster, mgr, clock, col = _culled_world(
+            telemetry_duty=0.01, kernels_busy=True
+        )
+        # kill the scrape before anything accumulates idleness
+        col.probe_fn = lambda targets, **kw: [
+            ProbeResult(-2, "") for _ in targets
+        ]
+        _drive(cluster, mgr, clock, col)
+        nb = cluster.get("Notebook", "nb", NS)
+        # busy kernels + no fresh telemetry → alive (fallback protected it)
+        assert not stop_annotation_is_set(nb)
+
+    def test_unknown_duty_falls_back_to_kernels_not_cull(self):
+        """A busy but UNINSTRUMENTED notebook (blind backend, no step
+        hook): scrapes succeed, duty is unknown — the culler must fall
+        back to the busy kernel signal, not treat unknown as idle.
+        Enabling telemetry can never make culling less safe."""
+        from kubeflow_tpu.telemetry.agent import DeviceSample
+
+        class BlindBackend:
+            def samples(self):
+                return [
+                    DeviceSample(
+                        duty_cycle=None, hbm_used_bytes=1e9,
+                        hbm_total_bytes=2e9,
+                    )
+                ]
+
+        clock = FakeClock(1_000_000.0)
+        cluster = _tpu_world()
+        agents = {"nb": TelemetryAgent(BlindBackend(), clock=clock)}
+        col = _mk_collector(cluster, agents, clock)
+        culler = Culler(
+            enabled=True, cull_idle_minutes=1.0, check_period_minutes=0.5,
+            fetch_kernels=lambda ns, name: [{"execution_state": "busy"}],
+            clock=clock, telemetry=col,
+        )
+        mgr = Manager(cluster, clock=clock)
+        mgr.register(
+            NotebookReconciler(
+                ControllerConfig(enable_culling=True), culler=culler,
+                recorder=EventRecorder(clock=clock),
+            )
+        )
+        _drive(cluster, mgr, clock, col)
+        nb = cluster.get("Notebook", "nb", NS)
+        # HBM telemetry flowed (the scrape is healthy)...
+        col.collect()  # _drive ends with a clock advance; take a fresh pass
+        sample = col.activity(NS, "nb")
+        assert sample is not None and sample.duty_cycle is None
+        assert sample.hbm_used_bytes == pytest.approx(1e9)
+        # ...but the busy kernel kept the session alive
+        assert not stop_annotation_is_set(nb)
+
+    def test_provenance_survives_collector_outage_at_commit(self):
+        """The policy that RAN the idle clock labels the cull — not a
+        re-sample at commit time. A collector that goes stale between the
+        last duty-cycle check and the cull commit must not relabel the
+        decision kernel-activity (which would hide it from the telemetry
+        audit and the telemetry_cull_total counter)."""
+        clock = FakeClock(0.0)
+        cluster = _tpu_world()
+        agents = {
+            "nb": TelemetryAgent(FakeDeviceBackend(duty_cycle=0.01), clock=clock)
+        }
+        col = _mk_collector(cluster, agents, clock)
+        culler = Culler(
+            enabled=True, cull_idle_minutes=1.0, check_period_minutes=0.5,
+            fetch_kernels=lambda ns, name: [{"execution_state": "busy"}],
+            clock=clock, telemetry=col,
+        )
+        nb = cluster.get("Notebook", "nb", NS)
+        col.collect()
+        culler.update_last_activity(nb)   # first touch seeds the clock
+        clock.advance(30.0)
+        col.collect()
+        culler.update_last_activity(nb)   # duty-cycle check: idle, recorded
+        # collector dies; the sample goes stale before the cull commits
+        col.probe_fn = lambda targets, **kw: [
+            ProbeResult(-2, "") for _ in targets
+        ]
+        clock.advance(31.0)
+        col.collect()
+        assert col.activity(NS, "nb") is None  # stale at commit time
+        policy, sample = culler.cull_provenance(nb)
+        assert policy == "duty-cycle"
+        assert sample is not None and sample.duty_cycle == pytest.approx(0.01)
+        # consumed at commit: a SECOND read (no new check ran) re-derives
+        policy2, _ = culler.cull_provenance(nb)
+        assert policy2 == "kernel-activity"
+
+    def test_kernel_fallback_cull_has_kernel_provenance(self):
+        cluster, mgr, clock, col = _culled_world(
+            telemetry_duty=None, kernels_busy=False
+        )
+        _drive(cluster, mgr, clock, col)
+        nb = cluster.get("Notebook", "nb", NS)
+        assert stop_annotation_is_set(nb)
+        culled = [
+            e for e in cluster.events_for(nb) if e.get("reason") == "Culled"
+        ]
+        assert culled and "kernel-activity" in culled[0]["message"]
+
+
+class TestScrapeRouting:
+    def test_tpu_notebook_service_routes_agent_port(self):
+        """The notebook Service must expose the telemetry port (routed to
+        the coordinator gang) or the collector's default target has no
+        path to the agent and the whole plane silently degrades."""
+        from kubeflow_tpu.telemetry import TELEMETRY_PORT
+
+        rec = NotebookReconciler(ControllerConfig())
+        nb = api.notebook("nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2")
+        svc = rec.generate_service(nb)
+        ports = {p["name"]: p for p in svc["spec"]["ports"]}
+        assert ports["http-telemetry"]["port"] == TELEMETRY_PORT
+        assert ports["http-telemetry"]["targetPort"] == TELEMETRY_PORT
+        # the UI port stays first (existing consumers index ports[0])
+        assert svc["spec"]["ports"][0]["name"] == "http-nb"
+        # CPU notebooks have no agent: no extra port
+        cpu = rec.generate_service(api.notebook("cpu-nb", NS))
+        assert [p["name"] for p in cpu["spec"]["ports"]] == ["http-cpu-nb"]
+
+    def test_default_target_matches_service_route(self):
+        """default_target_for and generate_service agree on (DNS, port,
+        path) — the contract that makes the production scrape actually
+        land on an agent."""
+        from kubeflow_tpu.telemetry import TELEMETRY_PATH, TELEMETRY_PORT
+        from kubeflow_tpu.telemetry.collector import default_target_for
+
+        nb = api.notebook("nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2")
+        host, port, path = default_target_for("cluster.local")(nb)
+        assert host == f"nb.{NS}.svc.cluster.local"
+        assert port == TELEMETRY_PORT
+        assert path == TELEMETRY_PATH
+
+
+# ---------------------------------------------------------------- web layer
+
+
+class TestWebIntegration:
+    def _authed(self):
+        return {"kubeflow-userid": "alice@x.io"}
+
+    def test_jwa_detail_carries_telemetry(self):
+        from kubeflow_tpu.controllers.profile_controller import (
+            ProfileReconciler,
+        )
+        from kubeflow_tpu.webapps import jupyter as jwa
+
+        clock = FakeClock()
+        cluster = _tpu_world()
+        cluster.create(api.profile("team-a", "alice@x.io"))
+        m = Manager(cluster)
+        m.register(ProfileReconciler())
+        m.run_until_idle()  # provision alice's RBAC in team-a
+        agents = {
+            "nb": TelemetryAgent(
+                FakeDeviceBackend(
+                    duty_cycle=0.6, hbm_used_bytes=1e9, hbm_total_bytes=4e9
+                ),
+                clock=clock,
+            )
+        }
+        col = _mk_collector(cluster, agents, clock)
+        col.collect()
+        app = jwa.create_app(cluster, telemetry=col)
+        resp = Client(app).get(
+            f"/api/namespaces/{NS}/notebooks/nb", headers=self._authed()
+        )
+        assert resp.status_code == 200
+        payload = json.loads(resp.get_data(as_text=True))
+        tel = payload["notebook"]["telemetry"]
+        assert tel["fresh"] is True
+        assert tel["dutyCycle"] == pytest.approx(0.6)
+        assert tel["hbmUtilization"] == pytest.approx(0.25)
+        assert tel["series"]["duty_cycle"]
+
+    def test_dashboard_serves_fleet_series(self):
+        from kubeflow_tpu.webapps import dashboard
+        from kubeflow_tpu.webapps.metrics_source import RegistrySource
+
+        clock = FakeClock(500.0)
+        cluster = _tpu_world()
+        cluster.create(api.profile("alice", "alice@x.io"))
+        agents = {
+            "nb": TelemetryAgent(FakeDeviceBackend(duty_cycle=0.5), clock=clock)
+        }
+        col = _mk_collector(cluster, agents, clock)
+        col.collect()
+        source = RegistrySource(
+            {
+                "notebooks": lambda: 0.0,
+                "tpus": lambda: 0.0,
+                "duty_cycle": col.fleet_duty_cycle,
+                "hbm": col.fleet_hbm_utilization,
+            },
+            interval_s=10.0,
+            clock=clock,
+        )
+        app = dashboard.create_app(
+            cluster, metrics_source=source, telemetry=col
+        )
+        resp = Client(app).get(
+            "/api/metrics/duty_cycle", headers=self._authed()
+        )
+        assert resp.status_code == 200
+        payload = json.loads(resp.get_data(as_text=True))
+        assert payload["series"][-1]["value"] == pytest.approx(0.5)
+        assert payload["values"][0]["labels"]["notebook"] == "nb"
+
+
+# ------------------------------------------------------------- exposition
+
+
+class TestRegistryIntegration:
+    def test_telemetry_families_lint_clean(self):
+        """TelemetryMetrics on the shared registry must produce valid
+        exposition (the CI metrics lint covers the combined registry)."""
+        from tests.test_metrics_exposition import parse_exposition
+
+        clock = FakeClock()
+        cluster = _tpu_world()
+        agents = {
+            "nb": TelemetryAgent(FakeDeviceBackend(duty_cycle=0.3), clock=clock)
+        }
+        col = _mk_collector(cluster, agents, clock)
+        col.collect()
+        col.record_cull(
+            NS, "nb", policy="duty-cycle",
+            sample=col.activity(NS, "nb"), threshold=0.5,
+        )
+        families = parse_exposition(col.metrics.registry.expose())
+        assert "telemetry_session_duty_cycle" in families
+        assert "scheduler_fleet_duty_cycle" in families
+        assert "telemetry_scrape_pass_seconds" in families

@@ -40,6 +40,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="override ChaosConfig.error_rate")
     ap.add_argument("--crash-rate", type=float, default=None,
                     help="override ChaosConfig.crash_rate")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="arm the data-plane telemetry pipeline: fake "
+                         "in-pod agents, fleet collector, duty-cycle "
+                         "culling, and the telemetry audit (docs/chaos.md)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="per-seed lines; on failure, a fixed-point diff")
     args = ap.parse_args(argv)
@@ -63,7 +67,7 @@ def main(argv: list[str] | None = None) -> int:
     total_faults = 0
     total_restarts = 0
     for seed in seeds:
-        result = run_seed(seed, cfg)
+        result = run_seed(seed, cfg, telemetry=args.telemetry)
         total_faults += sum(result.fault_counts.values())
         total_restarts += result.restarts
         if result.ok:
@@ -73,7 +77,7 @@ def main(argv: list[str] | None = None) -> int:
             failures += 1
             print(result.describe())
             if args.verbose and not result.converged:
-                print(diff_states(seed, cfg))
+                print(diff_states(seed, cfg, telemetry=args.telemetry))
     n = len(list(seeds))
     dt = time.monotonic() - t0
     print(

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Startup-latency benchmark: click-to-ready percentiles and the per-phase
+breakdown off the REAL SLO histograms (docs/observability.md).
+
+Drives N TPU gangs through spawner-stamped timelines — request → scheduler
+queue → bind → pod start → ready — on the virtual clock against a fleet
+sized to hold K gangs at once, so the queue phase carries real contention.
+Then reads p50/p99 straight off ``session_startup_seconds`` and the
+dominant-phase attribution off ``session_startup_phase_seconds`` — the same
+numbers a `histogram_quantile` query returns in production, so CI records a
+startup-latency trajectory PRs can be judged against.
+
+    python benchmarks/bench_timeline.py                 # 60 gangs
+    python benchmarks/bench_timeline.py --notebooks 20
+
+Emits one STARTUP_BENCH JSON line (consumed by CI artifacts).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from kubeflow_tpu.api import types as api  # noqa: E402
+from kubeflow_tpu.controllers.notebook_controller import (  # noqa: E402
+    NotebookReconciler,
+)
+from kubeflow_tpu.obs.slo import SLOMetrics  # noqa: E402
+from kubeflow_tpu.obs.timeline import (  # noqa: E402
+    TIMELINE_ANNOTATION,
+    TimelineRecorder,
+    audit_timeline,
+    encode_marks,
+)
+from kubeflow_tpu.runtime import objects as ko  # noqa: E402
+from kubeflow_tpu.runtime.fake import FakeCluster  # noqa: E402
+from kubeflow_tpu.runtime.manager import Manager  # noqa: E402
+from kubeflow_tpu.scheduler.controller import SchedulerReconciler  # noqa: E402
+from kubeflow_tpu.scheduler.soak import make_pool  # noqa: E402
+from kubeflow_tpu.utils.config import ControllerConfig  # noqa: E402
+from kubeflow_tpu.webhooks import tpu_env  # noqa: E402
+
+NS = "bench"
+PHASES = ("requested", "created", "queued", "bound", "pods-starting",
+          "restoring", "running")
+
+
+class _Clock:
+    def __init__(self, t: float = 1_000_000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def run(notebooks: int, pools: int = 4) -> dict:
+    cluster = FakeCluster()
+    tpu_env.install(cluster)
+    for i in range(pools):  # each pool holds exactly one 2x2x2 gang
+        make_pool(cluster, "v4", "2x2x2", f"pool-{i}")
+    clock = _Clock()
+    slo = SLOMetrics(clock=clock, target_s=300.0)
+    mgr = Manager(cluster, clock=clock)
+    cfg = ControllerConfig(scheduler_enabled=True)
+    mgr.register(NotebookReconciler(
+        cfg, clock=clock, timeline=TimelineRecorder(slo=slo, clock=clock),
+    ))
+    mgr.register(SchedulerReconciler(clock=clock, aging_interval_s=300.0))
+
+    done: set[str] = set()
+    for i in range(notebooks):
+        nb = api.notebook(
+            f"nb-{i}", NS, tpu_accelerator="v4", tpu_topology="2x2x2"
+        )
+        # the spawner's origin stamp: the click is the timeline's t0
+        ko.set_annotation(
+            nb, TIMELINE_ANNOTATION, encode_marks({"requestedAt": clock.t})
+        )
+        cluster.create(nb)
+    ticks = 0
+    # run gangs through to ready, stopping each once measured so its pool
+    # frees for the next — the queue phase accrues real contention
+    while len(done) < notebooks and ticks < notebooks * 30:
+        ticks += 1
+        cluster.step_kubelet()
+        mgr.run_until_idle()
+        for i in range(notebooks):
+            name = f"nb-{i}"
+            if name in done:
+                continue
+            nb = cluster.try_get("Notebook", name, NS)
+            if nb is None:
+                continue
+            from kubeflow_tpu.obs.timeline import marks_of
+
+            if "runningAt" in marks_of(nb):
+                done.add(name)
+                cluster.patch("Notebook", name, NS, {
+                    "metadata": {"annotations": {
+                        api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        clock.advance(7.0)
+
+    violations = audit_timeline(cluster)
+    h = slo.startup_total
+    phase_h = slo.startup_phase
+    slo.refresh()
+    return {
+        "bench": "STARTUP_BENCH",
+        "notebooks": notebooks,
+        "pools": pools,
+        "measured": int(h.count()),
+        "click_to_ready_s": {
+            "p50": round(h.quantile(0.50), 3),
+            "p99": round(h.quantile(0.99), 3),
+            "mean": round(h.sum() / max(1, h.count()), 3),
+        },
+        "phase_mean_s": {
+            p: round(
+                phase_h.sum(phase=p) / max(1, phase_h.count(phase=p)), 3
+            )
+            for p in PHASES
+            if phase_h.count(phase=p)
+        },
+        "slo": {
+            "target_s": slo.target_s,
+            "within_target": int(slo.startups.get(within_target="true")),
+            "breaches": int(slo.startups.get(within_target="false")),
+            "budget_remaining": round(
+                slo.error_budget_remaining.get(), 4
+            ),
+        },
+        "timeline_audit_violations": len(violations),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--notebooks", type=int, default=60)
+    ap.add_argument("--pools", type=int, default=4)
+    args = ap.parse_args(argv)
+    logging.disable(logging.ERROR)
+    result = run(args.notebooks, args.pools)
+    print("STARTUP_BENCH " + json.dumps(result, sort_keys=True))
+    if result["measured"] < args.notebooks:
+        print(
+            f"WARNING: only {result['measured']}/{args.notebooks} gangs "
+            f"reached ready", file=sys.stderr,
+        )
+        return 1
+    return 0 if result["timeline_audit_violations"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,8 +21,12 @@ from kubeflow_tpu.culler.culler import Culler
 from kubeflow_tpu.obs import (
     EventRecorder,
     HealthState,
+    SLOMetrics,
+    TimelineBuilder,
+    TimelineRecorder,
     Tracer,
     install_probe_routes,
+    install_timeline_route,
 )
 from kubeflow_tpu.runtime.manager import Manager
 from kubeflow_tpu.utils.config import ControllerConfig
@@ -146,12 +150,21 @@ def build_manager(
         telemetry=telemetry,
         duty_cycle_idle_threshold=cfg.telemetry_duty_cycle_idle,
     )
+    # startup timeline + SLO plane (obs/timeline.py, obs/slo.py): the
+    # notebook controller stamps click-to-ready marks on every CR; the
+    # recorder feeds the phase-attributed startup histograms and the
+    # burn-rate gauges on the shared registry; the builder serves
+    # /debug/timeline and the JWA detail view
+    slo = SLOMetrics(metrics.registry)
+    timeline_rec = TimelineRecorder(slo=slo, clock=time.time)
     manager = Manager(
         cluster, clock=time.time, tracer=tracer, metrics=cp_metrics
     )
     # the ops listeners and main loop read it off the manager (build_manager
     # keeps its two-value return for every existing caller)
     manager.telemetry = telemetry
+    manager.slo = slo
+    manager.timeline_builder = TimelineBuilder(cluster, telemetry=telemetry)
     if hasattr(cluster, "session"):  # KubeClient: per-verb latency/retries.
         # NOT cluster.tracer: the Manager already wraps this cluster in a
         # TracingCluster, so a client-level tracer would double-record every
@@ -160,7 +173,8 @@ def build_manager(
         cluster.metrics = cp_metrics
     manager.register(
         NotebookReconciler(
-            cfg, culler=culler, metrics=metrics, recorder=recorder
+            cfg, culler=culler, metrics=metrics, recorder=recorder,
+            timeline=timeline_rec,
         )
     )
     manager.register(ProfileReconciler())
@@ -290,6 +304,11 @@ def serve_ops(
             from kubeflow_tpu.telemetry.collector import install_telemetry_route
 
             install_telemetry_route(probes, telemetry)
+        # /debug/timeline/<ns>/<name>: the assembled click-to-ready
+        # timeline, same cluster-internal surface as /debug/traces
+        builder = getattr(manager, "timeline_builder", None) if manager else None
+        if builder is not None:
+            install_timeline_route(probes, builder)
         _spawn(probes, port)
     if metrics_port:
         if manager is not None:

@@ -84,6 +84,7 @@ def build_platform(
         dashboard.create_app(
             cluster, cluster_admins=admins, metrics=metrics,
             telemetry=telemetry,
+            slo=getattr(manager, "slo", None),
         ),
         {
             "/jupyter": jupyter.create_app(
@@ -91,6 +92,7 @@ def build_platform(
                 authorizer=Authorizer(cluster, cluster_admins=admins),
                 metrics=metrics,
                 telemetry=telemetry,
+                timeline=getattr(manager, "timeline_builder", None),
             ),
             "/volumes": volumes.create_app(
                 cluster, authorizer=Authorizer(cluster, cluster_admins=admins)

@@ -64,6 +64,7 @@ class NotebookReconciler(Reconciler):
         metrics=None,
         recorder=None,
         clock=None,
+        timeline=None,
     ) -> None:
         self.config = config or ControllerConfig()
         self.culler = culler
@@ -72,6 +73,12 @@ class NotebookReconciler(Reconciler):
         # deduplicated Event objects on the CR — what the spawner's detail
         # view and `kubectl describe notebook` show users
         self.recorder = recorder
+        # TimelineRecorder (obs/timeline.py): this controller is the one
+        # reconciler that already observes every startup boundary (queue
+        # admission, bind, scale-up, session restore, gang all-ready), so
+        # it stamps the click-to-ready timeline marks — and through the
+        # recorder's SLOMetrics, the phase-attributed startup histograms
+        self.timeline = timeline
         # the suspend barrier compares the force deadline against this clock
         self.clock = clock or (culler.clock if culler else time.time)
 
@@ -230,7 +237,11 @@ class NotebookReconciler(Reconciler):
             )
 
         self._reemit_child_events(cluster, nb)
-        self._update_status(cluster, nb, topo, num_slices)
+        ready, expected = self._update_status(cluster, nb, topo, num_slices)
+        if self.timeline is not None:
+            self._record_timeline(
+                cluster, nb, placement, desired_stses, ready, expected
+            )
 
         requeue = None
         if self.culler is not None:
@@ -534,9 +545,83 @@ class NotebookReconciler(Reconciler):
                 stses.append(single)
         return stses
 
+    def _record_timeline(
+        self,
+        cluster: FakeCluster,
+        nb: dict,
+        placement: dict | None,
+        desired_stses: list[dict],
+        ready: int,
+        expected: int,
+    ) -> None:
+        """One timeline observation per reconcile (obs/timeline.py): this
+        reconcile already derived every startup boundary, so pass them to
+        the recorder, which stamps only what is new (zero writes at steady
+        state) and clears the marks on teardown (each start measures its
+        own click-to-ready)."""
+        queued_at = None
+        if self.config.scheduler_enabled:
+            raw = ko.annotations(nb).get(sched.QUEUED_AT_ANNOTATION)
+            if raw is not None:
+                try:
+                    queued_at = float(raw)
+                except ValueError:
+                    queued_at = None
+        bound_at = None
+        if placement is not None:
+            raw_bound = placement.get("boundAt")
+            if isinstance(raw_bound, (int, float)):
+                bound_at = float(raw_bound)
+            else:
+                bound_at = self.clock()  # committed, instant unrecorded
+        restoring_at = None
+        teardown = stop_annotation_is_set(nb)
+        if self.config.sessions_enabled:
+            state = sess.session_state(nb)
+            # a suspend barrier is a generation boundary exactly like a
+            # stop: the session is going down and its next incarnation (a
+            # resume) measures its OWN click-to-ready — keeping the old
+            # marks would splice two starts and stamp restoringAt after a
+            # long-past runningAt (non-monotone; the sessions soak caught
+            # this on preemption handoffs, which never set the stop
+            # annotation). state=resuming is the new generation, not the
+            # teardown, even while the spent stop-reason request lingers.
+            if state in (sess.STATE_SUSPENDING, sess.STATE_SUSPENDED):
+                teardown = True
+            elif (
+                sess.suspend_request(nb) is not None
+                and state != sess.STATE_RESUMING
+            ):
+                teardown = True
+            if (
+                state == sess.STATE_RESUMING
+                and sess.snapshot_record(nb) is not None
+            ):
+                raw_resume = ko.annotations(nb).get(
+                    sess.RESUMING_AT_ANNOTATION
+                )
+                try:
+                    restoring_at = (
+                        float(raw_resume) if raw_resume else self.clock()
+                    )
+                except (TypeError, ValueError):
+                    restoring_at = self.clock()
+        self.timeline.record(
+            cluster, nb,
+            stopping=teardown,
+            queued_at=queued_at,
+            bound_at=bound_at,
+            restoring_at=restoring_at,
+            pods_started=any(
+                (sts.get("spec") or {}).get("replicas", 0) > 0
+                for sts in desired_stses
+            ),
+            running=expected > 0 and ready >= expected,
+        )
+
     def _update_status(
         self, cluster: FakeCluster, nb: dict, topo, num_slices: int = 1
-    ) -> None:
+    ) -> tuple[int, int]:
         name, ns = ko.name(nb), ko.namespace(nb)
         stses = self._owned_statefulsets(cluster, nb)
         ready = sum(
@@ -604,6 +689,7 @@ class NotebookReconciler(Reconciler):
                 cluster.update_status(current)
         if self.metrics is not None:
             self.metrics.observe_notebooks(cluster)
+        return ready, expected
 
     def _emit(
         self,

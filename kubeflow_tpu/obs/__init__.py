@@ -16,15 +16,34 @@ plane (docs/observability.md):
   crash-restart loop bumps one object instead of storming new ones).
 - ``health.py`` — ``/healthz`` + ``/readyz`` state: leader flag, watch
   freshness, workqueue liveness.
+- ``timeline.py`` — the cross-layer session timeline: click → created →
+  queued → bound → pods-starting → restoring → running → first-step, as
+  crash-safe first-wins marks on the CR, assembled at
+  ``/debug/timeline/<ns>/<name>`` and audited by the soaks (gap-free,
+  phase-partitioned, fault-attributable).
+- ``slo.py`` — phase-attributed startup histograms plus click-to-ready SLO
+  objectives with error-budget burn-rate gauges.
 """
 from kubeflow_tpu.obs.events import EventRecorder
 from kubeflow_tpu.obs.health import HealthState, install_probe_routes
+from kubeflow_tpu.obs.slo import SLOMetrics
+from kubeflow_tpu.obs.timeline import (
+    TimelineBuilder,
+    TimelineRecorder,
+    audit_timeline,
+    install_timeline_route,
+)
 from kubeflow_tpu.obs.tracing import Span, Tracer, TracingCluster
 
 __all__ = [
     "EventRecorder",
     "HealthState",
+    "SLOMetrics",
+    "TimelineBuilder",
+    "TimelineRecorder",
+    "audit_timeline",
     "install_probe_routes",
+    "install_timeline_route",
     "Span",
     "Tracer",
     "TracingCluster",

@@ -159,6 +159,15 @@ def install_probe_routes(app, health: HealthState, tracer=None) -> None:
                 limit = int(request.args.get("limit", "0")) or None
             except ValueError:
                 limit = None
+            # deep-link filters (?trace_id= / ?kind= / ?key=): a timeline
+            # entry links straight to its exact reconcile spans instead of
+            # paging the whole ring buffer
             return Response(
-                tracer.export_json(limit), mimetype="application/json"
+                tracer.export_json(
+                    limit,
+                    trace_id=request.args.get("trace_id") or None,
+                    kind=request.args.get("kind") or None,
+                    key=request.args.get("key") or None,
+                ),
+                mimetype="application/json",
             )

@@ -1,0 +1,169 @@
+"""Startup SLOs: phase-attributed latency histograms + error-budget burn.
+
+Turns the per-session timelines (``obs/timeline.py``) into the aggregate
+the operator actually pages on:
+
+- ``session_startup_phase_seconds{phase}`` — where click-to-ready time goes,
+  per owning layer (the per-phase breakdown ``STARTUP_BENCH`` records);
+- ``session_startup_seconds`` — the click-to-ready distribution itself;
+- ``slo_startup_total{within_target}`` — every measured start, judged
+  against the click-to-ready target;
+- ``slo_startup_error_budget_remaining`` — the fraction of the objective's
+  error budget left over the slow window (1 = untouched, 0 = exhausted);
+- ``slo_startup_burn_rate{window}`` — the SRE-workbook burn rate per
+  window: (observed breach ratio) / (allowed breach ratio). 1.0 burns the
+  budget exactly at sustainment; a fast-window burn of 14 is the classic
+  page-now threshold, the slow window confirms it is not a blip.
+
+Observations arrive exactly once per session start: the notebook
+controller's ``TimelineRecorder`` calls :meth:`observe_startup` in the same
+reconcile that stamps the first-wins ``runningAt`` mark, so crash-restart
+loops cannot double-count a start. Windowed state is a bounded ring of
+(timestamp, ok) outcomes on an injectable clock — deterministic under the
+soak's virtual time.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Mapping
+
+from kubeflow_tpu.utils.metrics import Registry
+
+# click-to-ready spans "warm pool hit" (seconds) to "queued behind a full
+# fleet" (tens of minutes)
+STARTUP_BUCKETS = (
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 900.0, 3600.0,
+)
+
+DEFAULT_TARGET_S = 300.0   # click-to-ready objective threshold
+DEFAULT_OBJECTIVE = 0.99   # fraction of starts that must meet the target
+DEFAULT_FAST_WINDOW_S = 3600.0
+DEFAULT_SLOW_WINDOW_S = 6 * 3600.0
+
+
+class SLOMetrics:
+    """Shares a registry with the other collectors so one /metrics scrape
+    carries the whole startup story next to the reconcile/scheduler/session
+    families it attributes time to."""
+
+    def __init__(
+        self,
+        registry: Registry | None = None,
+        *,
+        target_s: float = DEFAULT_TARGET_S,
+        objective: float = DEFAULT_OBJECTIVE,
+        fast_window_s: float = DEFAULT_FAST_WINDOW_S,
+        slow_window_s: float = DEFAULT_SLOW_WINDOW_S,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        if not 0.0 < objective < 1.0:
+            raise ValueError(
+                f"objective must be in (0, 1), got {objective!r}"
+            )
+        self.registry = registry or Registry()
+        self.target_s = target_s
+        self.objective = objective
+        self.fast_window_s = fast_window_s
+        self.slow_window_s = slow_window_s
+        self.clock = clock
+        self.startup_phase = self.registry.histogram(
+            "session_startup_phase_seconds",
+            "Click-to-ready time attributed per startup phase",
+            labelnames=("phase",),
+            buckets=STARTUP_BUCKETS,
+        )
+        self.startup_total = self.registry.histogram(
+            "session_startup_seconds",
+            "Click-to-ready latency (first mark to runningAt)",
+            buckets=STARTUP_BUCKETS,
+        )
+        self.startups = self.registry.counter(
+            "slo_startup_total",
+            "Session starts measured against the click-to-ready target",
+            labelnames=("within_target",),
+        )
+        self.error_budget_remaining = self.registry.gauge(
+            "slo_startup_error_budget_remaining",
+            "Fraction of the startup error budget left (slow window), 0..1",
+        )
+        self.burn_rate = self.registry.gauge(
+            "slo_startup_burn_rate",
+            "Startup error-budget burn rate per alert window "
+            "(1.0 = burning exactly the budget)",
+            labelnames=("window",),
+        )
+        # (timestamp, ok) ring bounded by the slow window; refreshed on
+        # every observation and on scrape (pre_expose) so the gauges decay
+        # as breaches age out even when no new start lands
+        self._outcomes: collections.deque[tuple[float, bool]] = (
+            collections.deque()
+        )
+        self._lock = threading.Lock()
+        self.registry.pre_expose(self.refresh)
+        self.refresh()  # expose well-defined zeros before the first start
+
+    # ------------------------------------------------------------- observe
+
+    def observe_startup(self, marks: Mapping[str, float]) -> None:
+        """One completed start: phase durations + total + SLO judgement.
+        ``marks`` is the timeline mark map at the moment runningAt landed;
+        phases past runningAt (first-step) are the data plane's and are not
+        part of the click-to-ready objective."""
+        from kubeflow_tpu.obs.timeline import build_phases
+
+        total = None
+        for p in build_phases(marks):
+            if p["phase"] == "running":
+                continue  # ready → first-step: past the objective boundary
+            self.startup_phase.observe(p["durationS"], phase=p["phase"])
+            total = (total or 0.0) + p["durationS"]
+        if total is None:
+            return  # fewer than two marks: nothing measurable
+        self.startup_total.observe(total)
+        ok = total <= self.target_s
+        self.startups.inc(within_target="true" if ok else "false")
+        with self._lock:
+            self._outcomes.append((self.clock(), ok))
+        self.refresh()
+
+    # -------------------------------------------------------------- gauges
+
+    def _window_burn(self, now: float, window_s: float) -> float:
+        bad = total = 0
+        for ts, ok in self._outcomes:
+            if now - ts <= window_s:
+                total += 1
+                if not ok:
+                    bad += 1
+        if total == 0:
+            return 0.0
+        return (bad / total) / (1.0 - self.objective)
+
+    def refresh(self) -> None:
+        now = self.clock()
+        with self._lock:
+            while self._outcomes and (
+                now - self._outcomes[0][0] > self.slow_window_s
+            ):
+                self._outcomes.popleft()
+            fast = self._window_burn(now, self.fast_window_s)
+            slow = self._window_burn(now, self.slow_window_s)
+        self.burn_rate.set(fast, window="fast")
+        self.burn_rate.set(slow, window="slow")
+        # burn 1.0 over the whole slow window consumes the budget exactly;
+        # remaining = 1 - consumed fraction, floored at 0
+        self.error_budget_remaining.set(max(0.0, 1.0 - slow))
+
+    # ------------------------------------------------------------ read side
+
+    def startup_p99(self) -> float:
+        """Click-to-ready p99 off the real histogram (clamped to the
+        largest finite bucket bound — never inf, the dashboard divides and
+        charts this)."""
+        return self.startup_total.quantile(0.99)
+
+    def fast_burn(self) -> float:
+        self.refresh()
+        return self.burn_rate.get(window="fast")

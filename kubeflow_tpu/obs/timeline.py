@@ -1,0 +1,428 @@
+"""End-to-end session timelines: click → first step, phase-attributed.
+
+The platform can explain each component in isolation — reconcile traces
+(``obs/tracing.py``), deduped Events (``obs/events.py``), device telemetry
+(``telemetry/``) — but none of them answers the only question a user asks:
+*"why did my notebook take 4 minutes to become usable, and which layer ate
+the time?"* (NotebookOS, PAPERS.md: interactive-accelerator platforms are
+judged on session-start latency above all else.) This module assembles that
+answer as a **contiguous phase sequence** per session::
+
+    requested → created → queued → bound → pods-starting → restoring
+              → running → first-step
+
+Each boundary is a **mark** (a float timestamp); each phase is the interval
+between consecutive marks and is owned by exactly one layer:
+
+| phase         | interval                       | owner               |
+|---------------|--------------------------------|---------------------|
+| requested     | click → CR visible             | webapp + apiserver  |
+| created       | CR visible → queue admission   | notebook controller |
+| queued        | queue admission → bind commit  | scheduler           |
+| bound         | bind commit → gang scaled up   | notebook controller |
+| pods-starting | scale-up → all hosts ready     | kubelet/data plane  |
+| restoring     | snapshot restore → delivered   | sessions            |
+| running       | ready → first telemetry step   | user runtime        |
+
+Marks live in ONE annotation (``observability.kubeflow.org/timeline``, a
+JSON ``{mark: t}`` map) so the record is crash-restart safe like the bind
+and suspend annotations: a restarted controller re-derives what it already
+stamped instead of forgetting it. Stamping discipline:
+
+- **first-wins** — a mark, once written, is never moved (the first
+  observation of a transition is the transition);
+- **monotone by construction** — a new mark is clamped to be >= every
+  existing mark, so phases can never be negative and the sequence is
+  gap-free and partitions click-to-ready *by construction* (the soak audit
+  then checks the construction held, not a tolerance band);
+- **generation-scoped** — a stop/cull teardown clears the marks: every
+  start (first spawn or resume) measures its own timeline. The aggregate
+  history lives in the SLO histograms (``obs/slo.py``), observed exactly
+  once per start at the moment ``runningAt`` is stamped.
+
+The origin mark comes from the web layer: ``webapps/base.py`` assigns every
+request an ``X-Request-Id`` and the spawner stamps it (plus ``requestedAt``)
+on the Notebook CR it creates, so reconcile spans, scheduler bind writes,
+and sessions-barrier writes all link back to the originating user action.
+``firstStepAt`` is the one mark with no annotation: it belongs to the data
+plane (the telemetry collector's first recorded step), and writing it from
+the collector would put an unattributed write on the trace audit — the
+builder reads it from the collector's memory instead.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Mapping
+
+from kubeflow_tpu.runtime import objects as ko
+
+# One annotation carries every mark: {mark: float-seconds}. Stamped by the
+# spawner (requestedAt at create/start) and the notebook controller (every
+# other mark, inside its reconcile so the writes are trace-attributed).
+TIMELINE_ANNOTATION = "observability.kubeflow.org/timeline"
+# The originating request's trace id (webapps/base.py X-Request-Id): the
+# deep link from a timeline back to the HTTP request that caused it.
+REQUEST_ID_ANNOTATION = "observability.kubeflow.org/request-id"
+
+# Mark order IS the phase order; every stamp is clamped monotone against it.
+MARKS = (
+    "requestedAt",
+    "createdAt",
+    "queuedAt",
+    "boundAt",
+    "podsStartingAt",
+    "restoringAt",
+    "runningAt",
+    "firstStepAt",
+)
+
+# (phase name, start mark, end mark, owning layer). A phase whose start
+# mark was never observed collapses to zero length at the next present
+# mark — attributed to nobody, exactly because nothing happened there.
+PHASES = (
+    ("requested", "requestedAt", "createdAt", "webapp"),
+    ("created", "createdAt", "queuedAt", "notebook-controller"),
+    ("queued", "queuedAt", "boundAt", "scheduler"),
+    ("bound", "boundAt", "podsStartingAt", "notebook-controller"),
+    ("pods-starting", "podsStartingAt", "restoringAt", "kubelet"),
+    ("restoring", "restoringAt", "runningAt", "sessions"),
+    ("running", "runningAt", "firstStepAt", "runtime"),
+)
+
+PHASE_OWNERS = {name: owner for name, _, _, owner in PHASES}
+
+
+def marks_of(nb: Mapping) -> dict[str, float]:
+    """Decode the timeline marks, or {}. Malformed JSON / unknown keys /
+    non-numeric values read as absent (users can kubectl-edit garbage in;
+    a timeline is telemetry and must never wedge a controller)."""
+    raw = ko.annotations(nb).get(TIMELINE_ANNOTATION)
+    if not raw:
+        return {}
+    try:
+        decoded = json.loads(raw)
+    except ValueError:
+        return {}
+    if not isinstance(decoded, dict):
+        return {}
+    out: dict[str, float] = {}
+    for mark in MARKS:
+        v = decoded.get(mark)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[mark] = float(v)
+    return out
+
+
+def encode_marks(marks: Mapping[str, float]) -> str:
+    return json.dumps(
+        {k: float(v) for k, v in marks.items()}, sort_keys=True
+    )
+
+
+def build_phases(marks: Mapping[str, float]) -> list[dict]:
+    """The contiguous phase sequence for a mark set. Missing interior marks
+    collapse to zero-length phases at the next present mark; by telescoping,
+    the durations always sum exactly to (last mark - first mark) — the
+    partition property the soak audit asserts."""
+    present = [m for m in MARKS if m in marks]
+    if len(present) < 2:
+        return []
+    # resolve every mark to a concrete time: a missing mark inherits the
+    # next present one (zero-length phase); trailing missing marks inherit
+    # the last present one (phases past it are zero / not-yet-reached) and
+    # leading missing marks the first present one, by the same sweep
+    resolved: dict[str, float] = {}
+    nxt = marks[present[-1]]
+    for m in reversed(MARKS):
+        if m in marks:
+            nxt = marks[m]
+        resolved[m] = nxt
+    out = []
+    for name, start_mark, end_mark, owner in PHASES:
+        start, end = resolved[start_mark], resolved[end_mark]
+        out.append(
+            {
+                "phase": name,
+                "owner": owner,
+                "start": start,
+                "end": end,
+                "durationS": max(0.0, end - start),
+                "observed": start_mark in marks or end_mark in marks,
+            }
+        )
+    return out
+
+
+def dominant_phase(marks: Mapping[str, float]) -> str | None:
+    """The phase that ate the most wall time — the headline attribution."""
+    phases = build_phases(marks)
+    if not phases:
+        return None
+    best = max(phases, key=lambda p: p["durationS"])
+    return best["phase"] if best["durationS"] > 0 else None
+
+
+class TimelineRecorder:
+    """The controller-side half: stamps marks on the CR from inside the
+    notebook controller's reconcile (so every write is a trace-attributed
+    child span). Stateless — all state lives in the annotation, so a
+    crash-restarted controller resumes exactly where the last one stopped.
+    """
+
+    def __init__(
+        self,
+        *,
+        slo=None,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        # SLOMetrics (obs/slo.py): observed exactly once per start, at the
+        # reconcile that stamps runningAt — the first-wins mark is what
+        # makes the observation exactly-once across crash-restarts.
+        self.slo = slo
+        self.clock = clock
+
+    def record(
+        self,
+        cluster,
+        nb: dict,
+        *,
+        stopping: bool,
+        queued_at: float | None,
+        bound_at: float | None,
+        restoring_at: float | None,
+        pods_started: bool,
+        running: bool,
+    ) -> None:
+        """One observation pass, called once per notebook reconcile with
+        the state the reconcile already derived. At most ONE patch per call
+        (all newly-observed marks together); zero writes at steady state."""
+        marks = marks_of(nb)
+        if stopping:
+            # generation reset: the teardown ends this start's timeline.
+            # The aggregate already landed in the SLO histograms when
+            # runningAt was stamped; keeping stale marks would splice two
+            # starts into one sequence and misorder every later mark.
+            if marks:
+                self._patch(cluster, nb, None)
+            return
+        new: dict[str, float] = {}
+        floor = max(marks.values()) if marks else None
+        order = {m: i for i, m in enumerate(MARKS)}
+        latest_idx = max(
+            (order[m] for m in marks), default=-1
+        )
+
+        def stamp(mark: str, t: float) -> bool:
+            nonlocal floor, latest_idx
+            if mark in marks or mark in new:
+                return False
+            # phase-order discipline: a mark earlier in the sequence than
+            # one already present arrived too late to mean anything for
+            # THIS start (e.g. a transition first observed after a later
+            # boundary already landed) — stamping it would break the
+            # monotone-in-phase-order invariant the audit asserts
+            if order[mark] < latest_idx:
+                return False
+            # monotone clamp: a source timestamp that predates an existing
+            # mark (a resume re-stamping the gang's ORIGINAL queued-at, a
+            # resuming-at written before the re-bind) lands at the floor —
+            # attribution stays a partition instead of going negative
+            if floor is not None:
+                t = max(t, floor)
+            floor = t
+            latest_idx = order[mark]
+            new[mark] = t
+            return True
+
+        now = self.clock()
+        stamp("createdAt", now)
+        if queued_at is not None:
+            stamp("queuedAt", queued_at)
+        if bound_at is not None:
+            stamp("boundAt", bound_at)
+        if pods_started:
+            stamp("podsStartingAt", now)
+        if restoring_at is not None:
+            stamp("restoringAt", restoring_at)
+        newly_running = running and stamp("runningAt", now)
+        if not new:
+            return
+        merged = {**marks, **new}
+        if not self._patch(cluster, nb, encode_marks(merged)):
+            # the write did not land: the annotation still lacks runningAt,
+            # so the NEXT reconcile will stamp (and observe) this start —
+            # observing now as well would double-count it in the SLO
+            return
+        if newly_running and self.slo is not None:
+            self.slo.observe_startup(merged)
+
+    def _patch(self, cluster, nb: dict, value: str | None) -> bool:
+        """Best-effort single-annotation write, mirrored into the in-memory
+        copy; True iff it landed. A timeline is telemetry: a raced
+        Conflict/NotFound drops this observation (the next reconcile
+        re-derives it), never fails the reconcile that carried it."""
+        from kubeflow_tpu.runtime.fake import Conflict, NotFound
+
+        try:
+            cluster.patch(
+                "Notebook", ko.name(nb), ko.namespace(nb),
+                {"metadata": {"annotations": {TIMELINE_ANNOTATION: value}}},
+            )
+        except (Conflict, NotFound):
+            return False
+        if value is None:
+            ko.remove_annotation(nb, TIMELINE_ANNOTATION)
+        else:
+            ko.set_annotation(nb, TIMELINE_ANNOTATION, value)
+        return True
+
+
+class TimelineBuilder:
+    """The read-side half: assembles one session's timeline payload from
+    the annotation marks plus the telemetry collector's first recorded step
+    (the one boundary the control plane cannot see). Served at
+    ``/debug/timeline/<ns>/<name>`` on the probe port and inlined in the
+    JWA detail view."""
+
+    def __init__(
+        self,
+        cluster,
+        *,
+        telemetry=None,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self.cluster = cluster
+        self.telemetry = telemetry
+        self.clock = clock
+
+    def build(self, namespace: str, name: str) -> dict | None:
+        nb = self.cluster.try_get("Notebook", name, namespace)
+        if nb is None:
+            return None
+        marks = marks_of(nb)
+        if self.telemetry is not None and "runningAt" in marks:
+            # bounded to THIS start: the collector's ring survives
+            # suspend/resume, and a step recorded before runningAt is the
+            # previous incarnation's tail (belt: the since bound scopes the
+            # scan; braces: reject anything earlier that slips through)
+            first_step = self.telemetry.first_step_at(
+                namespace, name, since=marks["runningAt"]
+            )
+            if first_step is not None and first_step >= marks["runningAt"]:
+                marks = {**marks, "firstStepAt": first_step}
+        phases = build_phases(marks)
+        present = [m for m in MARKS if m in marks]
+        total = marks[present[-1]] - marks[present[0]] if len(present) > 1 else 0.0
+        payload: dict = {
+            "namespace": namespace,
+            "name": name,
+            "requestId": ko.annotations(nb).get(REQUEST_ID_ANNOTATION, ""),
+            "marks": {m: marks[m] for m in present},
+            "phases": phases,
+            "totalS": total,
+            "complete": "runningAt" in marks,
+            "dominantPhase": dominant_phase(marks),
+        }
+        if "runningAt" in marks and present:
+            payload["clickToReadyS"] = marks["runningAt"] - marks[present[0]]
+        # deep links into the other observability planes for the same
+        # session: the reconcile spans that produced these transitions, and
+        # the device series past first-step
+        payload["links"] = {
+            "traces": f"/debug/traces?key={namespace}/{name}&kind=reconcile",
+            "telemetry": "/debug/telemetry",
+        }
+        return payload
+
+
+def install_timeline_route(app, builder: TimelineBuilder) -> None:
+    """Mount /debug/timeline/<ns>/<name> on a web App (the probe port,
+    next to /debug/traces — cluster-internal, never the gateway)."""
+    from werkzeug.wrappers import Response
+
+    @app.route("/debug/timeline/<namespace>/<name>")
+    def debug_timeline(request, namespace, name):
+        payload = builder.build(namespace, name)
+        if payload is None:
+            return Response(
+                json.dumps({"error": "no such notebook"}),
+                status=404, mimetype="application/json",
+            )
+        return Response(
+            json.dumps(payload, sort_keys=True), mimetype="application/json"
+        )
+
+
+def audit_timeline(base, *, where: str = "timeline") -> list[str]:
+    """Soak invariants (docs/chaos.md): for every notebook carrying marks,
+
+    - marks are **monotone** in phase order (a later boundary never
+      precedes an earlier one) — the data invariant the recorder's
+      first-wins/clamp/ordering discipline must uphold under any replay;
+    - marks are **no earlier than their sources**: a mark recording a
+      transition (queue admission, bind commit) can never predate the
+      source timestamp the transition wrote — the clamp may push a mark
+      later, never earlier (checked against the LIVE queued-at and
+      placement annotations, data the recorder does not own);
+    - the phase sequence is **gap-free and partitions** click-to-ready
+      (each phase starts where the previous ended; durations sum to
+      last−first). For monotone marks this is ``build_phases``'s
+      construction, so it is a self-check on the construction itself —
+      e.g. a duration clamped at 0 hiding a negative resolved interval
+      breaks the sum and fires here — not an independent data check.
+
+    Together with convergence this upgrades the soak from "the state is
+    right" to "the latency story of how it got there is right".
+    """
+    out: list[str] = []
+    for nb in base.list("Notebook"):
+        key = f"{ko.namespace(nb)}/{ko.name(nb)}"
+        marks = marks_of(nb)
+        if not marks:
+            continue
+        ordered = [marks[m] for m in MARKS if m in marks]
+        if any(b < a for a, b in zip(ordered, ordered[1:])):
+            out.append(
+                f"{where}: {key}: marks not monotone in phase order: "
+                f"{ {m: marks[m] for m in MARKS if m in marks} }"
+            )
+            continue
+        # cross-source consistency: the mark may sit AT or AFTER the
+        # transition's own recorded time (monotone clamp), never before it
+        from kubeflow_tpu import scheduler as sched
+
+        anns = ko.annotations(nb)
+        if "queuedAt" in marks and anns.get(sched.QUEUED_AT_ANNOTATION):
+            try:
+                src = float(anns[sched.QUEUED_AT_ANNOTATION])
+            except ValueError:
+                src = None
+            if src is not None and marks["queuedAt"] < src - 1e-6:
+                out.append(
+                    f"{where}: {key}: queuedAt mark {marks['queuedAt']} "
+                    f"predates the queue admission it records ({src})"
+                )
+        # (no analogous boundAt-vs-placement check: a resize or legacy
+        # eviction legitimately re-binds with a NEWER boundAt while the
+        # first-wins mark keeps the start's original — queued-at is the
+        # one source whose live value can only ever be the mark's own
+        # origin or an older re-stamped seniority)
+        phases = build_phases(marks)
+        if not phases:
+            continue
+        for prev, cur in zip(phases, phases[1:]):
+            if abs(cur["start"] - prev["end"]) > 1e-6:
+                out.append(
+                    f"{where}: {key}: phase {cur['phase']} starts at "
+                    f"{cur['start']} but {prev['phase']} ended at "
+                    f"{prev['end']} (gap/overlap)"
+                )
+        total = ordered[-1] - ordered[0]
+        summed = sum(p["durationS"] for p in phases)
+        if abs(summed - total) > 1e-6:
+            out.append(
+                f"{where}: {key}: phases sum to {summed:.3f}s but "
+                f"click-to-ready spans {total:.3f}s (not a partition)"
+            )
+    return out

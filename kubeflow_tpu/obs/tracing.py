@@ -232,9 +232,39 @@ class Tracer:
 
     # -------------------------------------------------------------- export
 
-    def export(self, limit: int | None = None) -> list[dict]:
+    def export(
+        self,
+        limit: int | None = None,
+        *,
+        trace_id: str | None = None,
+        kind: str | None = None,
+        key: str | None = None,
+    ) -> list[dict]:
+        """Span dump, optionally filtered (the /debug/traces deep-link
+        surface a timeline entry uses to pull its exact reconcile spans):
+
+        - ``trace_id`` — spans carrying this id (an event's whole causal
+          chain: origin event, the reconcile it funneled into, its writes);
+        - ``kind`` — span kind (``event`` | ``reconcile`` | ``write``);
+        - ``key`` — the object key (``ns/name``): a reconcile span's key or
+          a write span's objectKey.
+
+        Filters apply before ``limit``, so "the last 20 reconciles of this
+        notebook" is expressible."""
         with self._lock:
-            spans = self._spans[-limit:] if limit else list(self._spans)
+            spans = list(self._spans)
+        if trace_id is not None:
+            spans = [s for s in spans if trace_id in s.trace_ids]
+        if kind is not None:
+            spans = [s for s in spans if s.kind == kind]
+        if key is not None:
+            spans = [
+                s for s in spans
+                if s.attrs.get("key") == key
+                or s.attrs.get("objectKey") == key
+            ]
+        if limit:
+            spans = spans[-limit:]
         return [s.to_dict() for s in spans]
 
     def summary(self) -> dict:
@@ -269,11 +299,30 @@ class Tracer:
         out["writeSpans"] = writes
         return out
 
-    def export_json(self, limit: int | None = None) -> str:
-        return json.dumps(
-            {"summary": self.summary(), "spans": self.export(limit)},
-            sort_keys=True,
-        )
+    def export_json(
+        self,
+        limit: int | None = None,
+        *,
+        trace_id: str | None = None,
+        kind: str | None = None,
+        key: str | None = None,
+    ) -> str:
+        out: dict = {
+            "summary": self.summary(),
+            "spans": self.export(
+                limit, trace_id=trace_id, kind=kind, key=key
+            ),
+        }
+        filters = {
+            k: v
+            for k, v in (
+                ("trace_id", trace_id), ("kind", kind), ("key", key),
+            )
+            if v is not None
+        }
+        if filters:
+            out["filters"] = filters
+        return json.dumps(out, sort_keys=True)
 
     # --------------------------------------------------------------- audit
 

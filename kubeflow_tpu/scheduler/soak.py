@@ -37,6 +37,8 @@ from kubeflow_tpu.api import types as api
 from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
 from kubeflow_tpu.culler.culler import Culler
 from kubeflow_tpu.obs.events import EventRecorder, audit_events
+from kubeflow_tpu.obs.slo import SLOMetrics
+from kubeflow_tpu.obs.timeline import TimelineRecorder, audit_timeline
 from kubeflow_tpu.obs.tracing import Tracer
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import (
@@ -559,11 +561,16 @@ def run_sched_seed(
     # and must rediscover Events instead of storming new ones
     tracer = Tracer(clock=clock)
 
+    # one SLO ring across restarts (an observer, like the tracer); the
+    # timeline recorder itself is stateless — marks live on the CRs
+    slo = SLOMetrics(clock=clock)
+
     def build() -> Manager:
         m = Manager(cluster, clock=clock, tracer=tracer)
         m.register(
             NotebookReconciler(
-                cfg, culler=culler, recorder=EventRecorder(clock=clock)
+                cfg, culler=culler, recorder=EventRecorder(clock=clock),
+                timeline=TimelineRecorder(slo=slo, clock=clock),
             )
         )
         # a crash-restart loses every bit of in-memory scheduler state —
@@ -660,6 +667,10 @@ def run_sched_seed(
     # reconcile span; Event dedup bounded under crash-restart loops
     violations.extend(tracer.audit())
     violations.extend(audit_events(base, where="final"))
+    # timeline audit: every gang's startup timeline gap-free, monotone,
+    # phase-partitioned — queue waits must land in the scheduler-owned
+    # 'queued' phase, never smeared across layers (docs/observability.md)
+    violations.extend(audit_timeline(base, where="final"))
     return SchedSeedResult(
         seed=seed,
         violations=violations,

@@ -39,6 +39,8 @@ from kubeflow_tpu.api import types as api
 from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
 from kubeflow_tpu.culler.culler import Culler
 from kubeflow_tpu.obs.events import EventRecorder, audit_events
+from kubeflow_tpu.obs.slo import SLOMetrics
+from kubeflow_tpu.obs.timeline import TimelineRecorder, audit_timeline
 from kubeflow_tpu.obs.tracing import Tracer
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import (
@@ -437,12 +439,16 @@ def run_session_seed(
     sched_metrics = SchedulerMetrics()
     session_metrics = SessionMetrics(sched_metrics.registry)
     tracer = Tracer(clock=clock)
+    # one SLO ring across restarts (an observer, like the tracer); the
+    # timeline recorder itself is stateless — marks live on the CRs
+    slo = SLOMetrics(clock=clock)
 
     def build() -> Manager:
         m = Manager(cluster, clock=clock, tracer=tracer)
         m.register(
             NotebookReconciler(
-                cfg, culler=culler, recorder=EventRecorder(clock=clock)
+                cfg, culler=culler, recorder=EventRecorder(clock=clock),
+                timeline=TimelineRecorder(slo=slo, clock=clock),
             )
         )
         m.register(
@@ -557,6 +563,10 @@ def run_session_seed(
     )
     violations.extend(tracer.audit())
     violations.extend(audit_events(base, where="final"))
+    # timeline audit: suspend/resume cycles must still leave every gang's
+    # startup timeline gap-free and phase-partitioned (restore time lands
+    # in the sessions-owned 'restoring' phase)
+    violations.extend(audit_timeline(base, where="final"))
     return SessionSeedResult(
         seed=seed,
         violations=violations,

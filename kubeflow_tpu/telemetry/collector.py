@@ -303,6 +303,32 @@ class FleetTelemetryCollector:
                 return []
             return sess.store.window(signal, window_s, self.clock())
 
+    def first_step_at(
+        self, namespace: str, name: str, since: float | None = None
+    ) -> float | None:
+        """The session's first recorded device step — the timeline's
+        ``firstStepAt`` boundary (obs/timeline.py). First point of the
+        steps series with a positive count at or after ``since`` (the
+        current start's runningAt: the ring buffer survives suspend/resume
+        cycles, so an unbounded scan would forever return the PREVIOUS
+        incarnation's first step); a session scraped but never stepping
+        falls back to its first heartbeat in the window (the devices
+        answered, the user just has not run anything). Pure memory read."""
+        cutoff = since if since is not None else float("-inf")
+        with self._lock:
+            sess = self._sessions.get((namespace, name))
+            if sess is None:
+                return None
+            pts = [
+                p
+                for p in sess.store.window("steps", float("inf"), self.clock())
+                if p["timestamp"] >= cutoff
+            ]
+            for p in pts:
+                if p["value"] > 0:
+                    return p["timestamp"]
+            return pts[0]["timestamp"] if pts else None
+
     def fleet_duty_cycle(self) -> float:
         return self.metrics.fleet_duty_cycle.get()
 

@@ -14,8 +14,8 @@ Apps are plain WSGI callables — servable by any WSGI server and testable with
 from __future__ import annotations
 
 import json
+import logging
 import secrets
-import traceback
 from typing import Any, Callable
 
 from werkzeug.exceptions import HTTPException, NotFound
@@ -27,9 +27,39 @@ from kubeflow_tpu.runtime.fake import AdmissionDenied, AlreadyExists, Conflict
 from kubeflow_tpu.runtime.fake import NotFound as ClusterNotFound
 from kubeflow_tpu.utils.metrics import Registry
 
+log = logging.getLogger("webapps")
+
 CSRF_COOKIE = "XSRF-TOKEN"
 CSRF_HEADER = "X-XSRF-TOKEN"
 SAFE_METHODS = {"GET", "HEAD", "OPTIONS"}
+
+# Request-trace propagation (obs/timeline.py origin point): every request
+# gets an id — the caller's, if it sent one, else freshly minted — echoed
+# on the response and available to handlers via request_id(). The spawner
+# stamps it on the Notebook CR it creates, linking reconcile spans,
+# scheduler bind writes, and session-barrier writes back to the click.
+REQUEST_ID_HEADER = "X-Request-Id"
+_REQUEST_ID_ENV = "kubeflow_tpu.request_id"
+# bound + charset-restricted: the id lands in log lines, response headers,
+# and CR annotations — a hostile header must not smuggle content into any
+_REQUEST_ID_MAX = 64
+
+
+def request_id(request: Request) -> str:
+    """The request's trace id (middleware-assigned; '' outside an App)."""
+    return request.environ.get(_REQUEST_ID_ENV, "")
+
+
+def _assign_request_id(request: Request) -> str:
+    rid = request.environ.get(_REQUEST_ID_ENV)
+    if rid:
+        return rid
+    raw = (request.headers.get(REQUEST_ID_HEADER) or "")[:_REQUEST_ID_MAX]
+    rid = "".join(c for c in raw if c.isalnum() or c in "-._")
+    if not rid:
+        rid = f"req-{secrets.token_hex(8)}"
+    request.environ[_REQUEST_ID_ENV] = rid
+    return rid
 
 
 def success(key: str | None = None, value: Any = None, **extra) -> Response:
@@ -207,6 +237,7 @@ class App:
 
     def __call__(self, environ, start_response):
         request = Request(environ)
+        rid = _assign_request_id(request)
         adapter = self.url_map.bind_to_environ(environ)
         try:
             csrf_fail = self._check_csrf(request)
@@ -217,6 +248,7 @@ class App:
                     self._requests_total.inc(
                         method=request.method, code=str(csrf_fail.status_code)
                     )
+                csrf_fail.headers[REQUEST_ID_HEADER] = rid
                 return csrf_fail(environ, start_response)
             endpoint, args = adapter.match()
             response = self.endpoints[endpoint](request, **args)
@@ -237,7 +269,18 @@ class App:
         except HTTPException as e:
             response = error(e.code or 500, e.description or str(e))
         except Exception:
-            response = error(500, traceback.format_exc(limit=3))
+            # the traceback is server-side material: frames leak code
+            # paths, line numbers, and internal values to any client that
+            # can trigger a 500. Log it keyed by the request trace id and
+            # hand the client only that opaque id to quote at support.
+            log.exception(
+                "%s: unhandled error serving %s %s (request id %s)",
+                self.name, request.method, request.path, rid,
+            )
+            response = error(
+                500, f"Internal server error (request id {rid})"
+            )
+        response.headers[REQUEST_ID_HEADER] = rid
         if self.count_requests:
             self._requests_total.inc(
                 method=request.method, code=str(response.status_code)

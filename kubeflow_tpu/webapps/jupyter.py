@@ -26,6 +26,7 @@ from __future__ import annotations
 from kubeflow_tpu import scheduler as sched
 from kubeflow_tpu import sessions as sess
 from kubeflow_tpu.api import types as api
+from kubeflow_tpu.obs import timeline as tl
 from kubeflow_tpu.auth.rbac import Authorizer
 from kubeflow_tpu.controllers.notebook_controller import REWRITE_ANNOTATION
 from kubeflow_tpu.culler.culler import format_time
@@ -142,6 +143,7 @@ def create_app(
     config_path: str | None = None,
     metrics: NotebookMetrics | None = None,
     telemetry=None,
+    timeline=None,
 ) -> App:
     metrics = metrics or NotebookMetrics()
     app = App(
@@ -242,6 +244,11 @@ def create_app(
             # None (vs absent) for a session the collector has never seen,
             # so the UI can distinguish "no agent" from "telemetry off".
             summary["telemetry"] = telemetry.session_payload(namespace, name)
+        if timeline is not None:
+            # the click-to-ready timeline (obs/timeline.py): per-phase
+            # attribution of this session's startup — "which layer ate the
+            # time" rendered right on the overview tab
+            summary["timeline"] = timeline.build(namespace, name)
         return success("notebook", summary, raw=nb)
 
     @app.route("/api/namespaces/<namespace>/notebooks/<name>/pod")
@@ -311,6 +318,14 @@ def create_app(
         body = get_json(request, "name")
         defaults = spawner_config.load_config(config_path)
         nb, new_pvcs = build_notebook(body, namespace, defaults, user.name)
+        # origin propagation (obs/timeline.py): the request trace id and
+        # the click time ride the CR, so reconcile spans, scheduler bind
+        # writes, and the startup timeline all link back to this POST
+        ko.set_annotation(nb, tl.REQUEST_ID_ANNOTATION, base.request_id(request))
+        ko.set_annotation(
+            nb, tl.TIMELINE_ANNOTATION,
+            tl.encode_marks({"requestedAt": time.time()}),
+        )
 
         # dry-run everything first (ref post.py:48-54): all-or-nothing UX
         api_errors = api.validate_notebook(nb)
@@ -341,6 +356,22 @@ def create_app(
                 ko.set_annotation(nb, api.STOP_ANNOTATION, format_time(time.time()))
                 ko.remove_annotation(nb, api.LAST_ACTIVITY_ANNOTATION)
             else:
+                # a restart of a STOPPED notebook is a new click: fresh
+                # timeline generation with this request as its origin (the
+                # controller cleared the previous generation's marks at
+                # teardown). A stopped=false on an already-running notebook
+                # (client retry/double-send) is a no-op — overwriting the
+                # live generation would wipe its marks and make the next
+                # reconcile observe a fake ~0s start into the SLO.
+                if api.STOP_ANNOTATION in ko.annotations(nb):
+                    ko.set_annotation(
+                        nb, tl.REQUEST_ID_ANNOTATION,
+                        base.request_id(request),
+                    )
+                    ko.set_annotation(
+                        nb, tl.TIMELINE_ANNOTATION,
+                        tl.encode_marks({"requestedAt": time.time()}),
+                    )
                 ko.remove_annotation(nb, api.STOP_ANNOTATION)
             cluster.update(nb)
         return success("message", "Notebook updated")

@@ -149,10 +149,14 @@ def check_histograms(families: dict[str, dict]) -> None:
 def combined_registry() -> Registry:
     """The full production wiring: one registry, every family, populated by
     actually running the control plane (not by poking counters)."""
+    from kubeflow_tpu.obs.slo import SLOMetrics
+    from kubeflow_tpu.obs.timeline import TimelineRecorder
+
     nm = NotebookMetrics()
     sm = SchedulerMetrics(nm.registry)
     cpm = ControlPlaneMetrics(nm.registry)
     sessm = SessionMetrics(nm.registry)
+    slo = SLOMetrics(nm.registry)
     wq_gauge = nm.registry.gauge(
         "workqueue_stat", "Reconcile workqueue counters (native core)"
     )
@@ -173,7 +177,10 @@ def combined_registry() -> Registry:
     mgr = Manager(cluster, tracer=tracer, metrics=cpm)
     cfg = ControllerConfig(scheduler_enabled=True, sessions_enabled=True)
     mgr.register(
-        NotebookReconciler(cfg, metrics=nm, recorder=EventRecorder())
+        NotebookReconciler(
+            cfg, metrics=nm, recorder=EventRecorder(),
+            timeline=TimelineRecorder(slo=slo),
+        )
     )
     mgr.register(
         SchedulerReconciler(
@@ -236,8 +243,23 @@ class TestExpositionFormat:
             "scheduler_time_to_bind_seconds",
             "session_suspend_seconds",
             "session_resume_seconds",
+            "session_startup_seconds",
+            "session_startup_phase_seconds",
         ):
             assert families[name]["type"] == "histogram", name
+        # the SLO families (obs/slo.py) ride the same registry: the burn
+        # gauges and objective counter must lint alongside the histograms
+        assert families["slo_startup_burn_rate"]["type"] == "gauge"
+        assert families[
+            "slo_startup_error_budget_remaining"]["type"] == "gauge"
+        assert families["slo_startup_total"]["type"] == "counter"
+        # the settle drove the gang to ready: the startup histogram carries
+        # the click-to-ready observation (the lint is not vacuous)
+        assert any(
+            v > 0
+            for s, _, v in families["session_startup_seconds"]["samples"]
+            if s.endswith("_count")
+        )
         # the settle's stop ran the suspend barrier end to end: the suspend
         # histogram must carry the observation
         assert any(
@@ -340,6 +362,52 @@ class TestHistogramSemantics:
         assert h.quantile(0.99) <= 8.0
         assert h.count() == 4
         assert h.sum() == pytest.approx(12.0)
+
+    def test_quantile_empty_histogram_is_zero(self):
+        """The SLO/bench consumers divide by quantiles: an empty histogram
+        must read 0.0, not raise or return garbage — before ANY observation
+        and for a never-observed label set of a populated family."""
+        reg = Registry()
+        h = reg.histogram("e_seconds", "h", buckets=(1.0, 2.0))
+        assert h.quantile(0.5) == 0.0
+        assert h.quantile(0.99) == 0.0
+        labeled = reg.histogram(
+            "l_seconds", "h", labelnames=("kind",), buckets=(1.0, 2.0)
+        )
+        labeled.observe(0.5, kind="a")
+        assert labeled.quantile(0.99, kind="never-observed") == 0.0
+
+    def test_quantile_in_first_bucket_interpolates_from_zero(self):
+        """q landing in the first bucket interpolates on [0, bound), never
+        below 0 and never the whole bound for a tiny rank."""
+        reg = Registry()
+        h = reg.histogram("f_seconds", "h", buckets=(10.0, 20.0))
+        for _ in range(4):
+            h.observe(5.0)
+        # all 4 observations in [0, 10): p50 = rank 2 of 4 → 5.0 exactly
+        assert h.quantile(0.5) == pytest.approx(5.0)
+        assert 0.0 < h.quantile(0.01) < 10.0
+        assert h.quantile(1.0) == pytest.approx(10.0)
+
+    def test_quantile_in_inf_bucket_clamps_to_highest_finite_bound(self):
+        """q landing in the +Inf bucket must clamp to the largest finite
+        bound — returning inf would poison every SLO gauge and dashboard
+        series that divides by or charts the value."""
+        import math
+
+        reg = Registry()
+        h = reg.histogram("i_seconds", "h", buckets=(1.0, 2.0, 4.0))
+        h.observe(100.0)   # only observation: the +Inf bucket
+        h.observe(1000.0)
+        for q in (0.01, 0.5, 0.99, 1.0):
+            v = h.quantile(q)
+            assert math.isfinite(v)
+            assert v == pytest.approx(4.0)
+        # mixed: p99 still clamps while p25 interpolates a finite bucket
+        h.observe(0.5)
+        h.observe(0.6)
+        assert h.quantile(0.99) == pytest.approx(4.0)
+        assert 0.0 < h.quantile(0.25) <= 1.0
 
     def test_time_to_bind_exposes_sum_and_count(self):
         """ISSUE satellite: rate(sum)/rate(count) must be possible — the old

@@ -143,6 +143,64 @@ class TestTracer:
         assert "summary" in out and "spans" in out
         assert out["summary"]["tracesStarted"] == 1
 
+    def test_export_filters_by_trace_id_kind_and_key(self):
+        """The /debug/traces deep-link surface: a timeline entry pulls its
+        exact reconcile spans instead of paging the whole ring buffer."""
+        cluster = FakeCluster()
+        tracer = Tracer()
+        mgr = Manager(cluster, tracer=tracer)
+        mgr.register(NotebookReconciler(ControllerConfig()))
+        cluster.create(api.notebook("nb-a", "ns"))
+        cluster.create(api.notebook("nb-b", "ns"))
+        mgr.run_until_idle()
+        # by key: only nb-a's reconciles
+        spans = tracer.export(kind="reconcile", key="ns/nb-a")
+        assert spans and all(
+            s["kind"] == "reconcile" and s["attrs"]["key"] == "ns/nb-a"
+            for s in spans
+        )
+        # key matches write spans through objectKey too
+        writes = tracer.export(kind="write", key="ns/nb-a")
+        assert writes and all(
+            s["attrs"]["objectKey"] == "ns/nb-a" for s in writes
+        )
+        # by trace id: the event's whole causal chain, nothing else's
+        tid = next(
+            s for s in tracer.export(kind="event")
+            if "nb-b" in s["name"]
+        )["traceIds"][0]
+        chain = tracer.export(trace_id=tid)
+        assert chain and all(tid in s["traceIds"] for s in chain)
+        assert {s["kind"] for s in chain} >= {"event", "reconcile"}
+        # filters apply before limit: last-1 of nb-a, not of everything
+        (last,) = tracer.export(1, kind="reconcile", key="ns/nb-a")
+        assert last["attrs"]["key"] == "ns/nb-a"
+
+    def test_debug_traces_route_honors_filters(self):
+        cluster = FakeCluster()
+        tracer = Tracer()
+        mgr = Manager(cluster, tracer=tracer)
+        mgr.register(NotebookReconciler(ControllerConfig()))
+        cluster.create(api.notebook("nb", "ns"))
+        mgr.run_until_idle()
+        health = HealthState()
+        health.attach_manager(mgr)
+        app = App("probes", csrf_protect=False)
+        install_probe_routes(app, health, tracer=tracer)
+        client = Client(app)
+        body = json.loads(
+            client.get("/debug/traces?kind=reconcile&key=ns/nb").data
+        )
+        assert body["filters"] == {"kind": "reconcile", "key": "ns/nb"}
+        assert body["spans"] and all(
+            s["kind"] == "reconcile" and s["attrs"]["key"] == "ns/nb"
+            for s in body["spans"]
+        )
+        # unfiltered stays the full dump (no filters echo)
+        full = json.loads(client.get("/debug/traces").data)
+        assert "filters" not in full
+        assert len(full["spans"]) > len(body["spans"])
+
 
 class TestManagerMetrics:
     def test_reconcile_outcomes_and_queue_wait(self):
@@ -206,6 +264,37 @@ class TestEventRecorder:
         events = cluster.events_for(nb)
         assert len(events) == 1
         assert events[0]["count"] == 2
+
+    def test_bump_refreshes_last_timestamp_and_message(self):
+        """Timeline assembly orders occurrences by lastTimestamp: a
+        count-only bump would leave the timestamp stale and misorder the
+        stream — every bump (warm cache AND cold-cache restart) must carry
+        the occurrence's time and message along with the count."""
+        cluster = FakeCluster()
+        nb = cluster.create(api.notebook("nb", "ns"))
+        clock = _Clock(t=1000.0)
+        EventRecorder(clock=clock).emit(cluster, nb, "Queued", "position 3")
+        (ev,) = cluster.events_for(nb)
+        first_ts = ev["lastTimestamp"]
+        assert ev["firstTimestamp"] == first_ts
+        clock.advance(3600.0)
+        # warm-cache bump: same recorder instance
+        rec = EventRecorder(clock=clock)
+        rec.emit(cluster, nb, "Queued", "position 2")
+        (ev,) = cluster.events_for(nb)
+        assert ev["count"] == 2
+        assert ev["message"] == "position 2"
+        assert ev["lastTimestamp"] > first_ts
+        assert ev["firstTimestamp"] == first_ts  # first occurrence sticks
+        mid_ts = ev["lastTimestamp"]
+        clock.advance(3600.0)
+        # cold-cache restart bump: fresh recorder finds the object and
+        # still refreshes the ordering fields, not just the count
+        EventRecorder(clock=clock).emit(cluster, nb, "Queued", "position 1")
+        (ev,) = cluster.events_for(nb)
+        assert ev["count"] == 3
+        assert ev["message"] == "position 1"
+        assert ev["lastTimestamp"] > mid_ts
 
     def test_new_incarnation_gets_new_object(self):
         cluster = FakeCluster()

@@ -374,6 +374,83 @@ class TestJupyterApp:
         assert "CSRF" in get_json_body(r)["log"]
 
 
+class TestRequestTraceAndErrorHandling:
+    """The App-level request-trace middleware (webapps/base.py): every
+    response carries an X-Request-Id, and a 500 returns ONLY that opaque id
+    — the traceback (frames, paths, values) stays server-side."""
+
+    def _crashing_app(self):
+        from kubeflow_tpu.webapps.base import App
+
+        app = App("boom", csrf_protect=False)
+
+        @app.route("/explode")
+        def explode(request):
+            raise RuntimeError("secret internal detail")
+
+        return app
+
+    def test_500_body_leaks_no_traceback(self, caplog):
+        import logging
+
+        client = Client(self._crashing_app())
+        with caplog.at_level(logging.ERROR, logger="webapps"):
+            r = client.get("/explode")
+        assert r.status_code == 500
+        body = get_json_body(r)
+        assert body["success"] is False
+        # no frame/path/source text in the client-visible body
+        for leak in (
+            "Traceback", "File \"", ".py", "line ", "RuntimeError",
+            "secret internal detail",
+        ):
+            assert leak not in body["log"], (leak, body["log"])
+        # the opaque id in the body is the response's request id, and the
+        # server-side log carries BOTH the id and the real traceback
+        rid = r.headers["X-Request-Id"]
+        assert rid in body["log"]
+        assert rid in caplog.text
+        assert "secret internal detail" in caplog.text
+
+    def test_request_id_echoed_and_accepted(self):
+        client = Client(self._crashing_app())
+        # caller-supplied id round-trips (sanitized charset)
+        r = client.get(
+            "/healthz/liveness", headers={"X-Request-Id": "my-trace-1"}
+        )
+        assert r.headers["X-Request-Id"] == "my-trace-1"
+        # no inbound id: one is minted
+        r = client.get("/healthz/liveness")
+        assert r.headers["X-Request-Id"].startswith("req-")
+
+    def test_hostile_request_id_is_sanitized(self):
+        client = Client(self._crashing_app())
+        r = client.get(
+            "/healthz/liveness",
+            headers={"X-Request-Id": "x" * 500 + "$(rm -rf)"},
+        )
+        rid = r.headers["X-Request-Id"]
+        assert len(rid) <= 64
+        assert all(c.isalnum() or c in "-._" for c in rid)
+
+    def test_known_error_classes_keep_their_messages(self):
+        """The opaque-500 rule is for UNHANDLED errors only: mapped classes
+        (404/400/...) keep their user-facing text."""
+        from kubeflow_tpu.runtime.fake import FakeCluster
+        from kubeflow_tpu.webapps.base import App
+
+        app = App("known", csrf_protect=False)
+        cluster = FakeCluster()
+
+        @app.route("/missing")
+        def missing(request):
+            return {"nb": cluster.get("Notebook", "ghost", "ns")}
+
+        r = Client(app).get("/missing")
+        assert r.status_code == 404
+        assert "ghost" in get_json_body(r)["log"]
+
+
 class TestVolumesApp:
     def test_pvc_lifecycle_and_in_use_guard(self, platform):
         cluster, m = platform

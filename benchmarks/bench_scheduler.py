@@ -13,8 +13,14 @@ the world, replaying occupancy, packing, and writing conditions.
 
     python benchmarks/bench_scheduler.py                 # 10k gangs
     python benchmarks/bench_scheduler.py --gangs 1000    # quick local run
+    python benchmarks/bench_scheduler.py --profile       # pack-path hotspots
+    python benchmarks/bench_scheduler.py \
+        --check-against benchmarks/sched_baseline.json   # CI perf gate
 
-Emits one SCHED_BENCH JSON line (consumed by CI artifacts / perf tracking).
+Emits one SCHED_BENCH JSON line (consumed by CI artifacts / perf tracking)
+carrying, beyond the headline placements/s: per-phase cycle cost
+(list/replay/pack/write p50/p99 — which layer eats the cycle) and the
+queue-depth decay series (how the backlog drains over cycles).
 """
 from __future__ import annotations
 
@@ -44,13 +50,17 @@ _SHAPES = ["2x2x1", "2x2x1", "2x2x2", "2x2x2", "2x2x4", "4x4x4"]
 
 
 class _RecordingMetrics:
-    """Duck-typed SchedulerMetrics that keeps every bind latency sample (the
-    shipped metrics expose sum/count; a benchmark needs the distribution)."""
+    """Duck-typed SchedulerMetrics that keeps every sample (the shipped
+    metrics expose histograms; a benchmark needs the raw distributions)."""
 
     def __init__(self) -> None:
         self.bind_latencies: list[float] = []
         self.cycles = 0
         self.preempt_count = 0
+        self.phase_samples: dict[str, list[float]] = {}
+        self.queue_depths: list[int] = []
+        self.fit_cache_hits = 0
+        self.fit_cache_misses = 0
 
         class _Ctr:
             def __init__(self, outer):
@@ -61,11 +71,20 @@ class _RecordingMetrics:
 
         self.preemptions = _Ctr(self)
 
-    def observe_cycle(self, fleet, *, queue_depth, unschedulable, **_kw):
+    def observe_cycle(
+        self, fleet, *, queue_depth, unschedulable, phases=None, **_kw
+    ):
         self.cycles += 1
+        self.queue_depths.append(queue_depth)
+        for phase, seconds in (phases or {}).items():
+            self.phase_samples.setdefault(phase, []).append(seconds)
 
     def observe_bind(self, seconds: float) -> None:
         self.bind_latencies.append(seconds)
+
+    def observe_fit_cache(self, hits: int, misses: int) -> None:
+        self.fit_cache_hits += hits
+        self.fit_cache_misses += misses
 
 
 def _percentile(samples: list[float], q: float) -> float:
@@ -74,6 +93,17 @@ def _percentile(samples: list[float], q: float) -> float:
     xs = sorted(samples)
     idx = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
     return xs[idx]
+
+
+def _decimate(series: list[int], max_points: int = 50) -> list[int]:
+    """Every cycle's queue depth, downsampled to a bounded series (the
+    decay *shape* is the signal; 300 raw points are noise in a JSON line)."""
+    if len(series) <= max_points:
+        return list(series)
+    step = len(series) / max_points
+    out = [series[int(i * step)] for i in range(max_points)]
+    out[-1] = series[-1]
+    return out
 
 
 def run(gangs: int, pools: int, seed: int) -> dict:
@@ -95,6 +125,20 @@ def run(gangs: int, pools: int, seed: int) -> dict:
     metrics = _RecordingMetrics()
     rec = SchedulerReconciler(metrics=metrics, clock=time.monotonic)
 
+    # Bound gangs surface through the watch stream (placement annotation
+    # appearing) instead of a full 10k-object list per cycle — the bench
+    # harness must not dominate the wall clock it is measuring.
+    bound_names: set[str] = set()
+
+    def _on_event(event: str, obj: dict) -> None:
+        if event == "DELETED":
+            return
+        anns = (obj.get("metadata") or {}).get("annotations") or {}
+        if sched.PLACEMENT_ANNOTATION in anns:
+            bound_names.add(ko.name(obj))
+
+    cluster.watch("Notebook", _on_event)
+
     t0 = time.monotonic()
     remaining = gangs
     cycles = 0
@@ -102,21 +146,18 @@ def run(gangs: int, pools: int, seed: int) -> dict:
         before = len(metrics.bind_latencies)
         rec.reconcile(cluster, "", FLEET_KEY)
         cycles += 1
-        bound = [
-            nb for nb in cluster.list("Notebook", NS)
-            if sched.placement_of(nb) is not None
-        ]
-        if len(metrics.bind_latencies) == before and not bound:
+        if len(metrics.bind_latencies) == before and not bound_names:
             raise RuntimeError(
                 f"scheduler stalled with {remaining} gangs unbound"
             )
         # gang "completes": frees its chips for the queue behind it
-        for nb in bound:
+        for name in sorted(bound_names):
             try:
-                cluster.delete("Notebook", ko.name(nb), NS)
+                cluster.delete("Notebook", name, NS)
             except NotFound:
                 pass
-        remaining -= len(bound)
+        remaining -= len(bound_names)
+        bound_names.clear()
     wall = time.monotonic() - t0
 
     lat = metrics.bind_latencies
@@ -134,8 +175,66 @@ def run(gangs: int, pools: int, seed: int) -> dict:
             "p99": round(_percentile(lat, 0.99), 4),
             "max": round(max(lat), 4) if lat else 0.0,
         },
+        "phases": {
+            phase: {
+                "p50": round(_percentile(samples, 0.50), 5),
+                "p99": round(_percentile(samples, 0.99), 5),
+            }
+            for phase, samples in sorted(metrics.phase_samples.items())
+        },
+        "queue_depth_decay": _decimate(metrics.queue_depths),
+        "fit_cache": {
+            "hits": metrics.fit_cache_hits,
+            "misses": metrics.fit_cache_misses,
+        },
         "preemptions": metrics.preempt_count,
     }
+
+
+def _run_profiled(gangs: int, pools: int, seed: int) -> dict:
+    """Wrap the drain loop in cProfile and print the top pack-path
+    hotspots (scheduler modules only, by cumulative time) to stderr."""
+    import cProfile
+    import pstats
+
+    prof = cProfile.Profile()
+    prof.enable()
+    result = run(gangs, pools, seed)
+    prof.disable()
+    stats = pstats.Stats(prof, stream=sys.stderr)
+    stats.sort_stats("cumulative")
+    print("\n--- pack-path hotspots (kubeflow_tpu/scheduler) ---",
+          file=sys.stderr)
+    stats.print_stats(r"kubeflow_tpu[/\\]scheduler", 15)
+    print("--- overall hotspots ---", file=sys.stderr)
+    stats.print_stats(15)
+    return result
+
+
+def check_against(result: dict, baseline_path: str, tolerance: float) -> int:
+    """CI perf gate: fail when placements/s regressed beyond tolerance
+    against the committed baseline (benchmarks/sched_baseline.json)."""
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    base_pps = float(baseline["placements_per_s"])
+    new_pps = float(result["placements_per_s"])
+    floor = base_pps * (1.0 - tolerance)
+    verdict = "ok" if new_pps >= floor else "REGRESSED"
+    print(
+        f"SCHED_BENCH gate: {new_pps:.1f} placements/s vs baseline "
+        f"{base_pps:.1f} (floor {floor:.1f} at {tolerance:.0%} tolerance) "
+        f"{verdict}",
+        file=sys.stderr,
+    )
+    if verdict == "REGRESSED":
+        print(
+            "PERF GATE FAILED: scheduler bind-path throughput regressed — "
+            "either fix the regression or re-record "
+            "benchmarks/sched_baseline.json with a justified new number",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -145,10 +244,21 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--pools", type=int, default=8,
                     help="v4-4x4x4 node pools in the fleet (default 8)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--profile", action="store_true",
+                    help="cProfile the drain and print pack-path hotspots")
+    ap.add_argument("--check-against", metavar="BASELINE_JSON",
+                    help="compare placements/s against a committed baseline "
+                         "and exit 1 on regression beyond --tolerance")
+    ap.add_argument("--tolerance", type=float, default=0.20,
+                    help="allowed fractional throughput regression for "
+                         "--check-against (default 0.20)")
     args = ap.parse_args(argv)
     logging.disable(logging.ERROR)
-    result = run(args.gangs, args.pools, args.seed)
+    runner = _run_profiled if args.profile else run
+    result = runner(args.gangs, args.pools, args.seed)
     print("SCHED_BENCH " + json.dumps(result, sort_keys=True))
+    if args.check_against:
+        return check_against(result, args.check_against, args.tolerance)
     return 0
 
 

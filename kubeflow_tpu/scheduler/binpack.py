@@ -21,6 +21,7 @@ gang eventually binds" against the scheduler's own feasibility notion.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import itertools
 import math
 from typing import Iterable, Iterator, Sequence
@@ -70,24 +71,70 @@ def ceil_div_shape(
     return tuple(-(-d // b) for d, b in zip(chip_shape, host_block))
 
 
-def orientations(
-    accel: TpuAccelerator, chip_shape: Sequence[int]
-) -> list[tuple[tuple[int, ...], tuple[int, ...]]]:
-    """Valid axis permutations of a request, as (chip_shape, block_shape).
-
-    A slice request can be rotated onto the pool torus — the sub-cuboid is
-    the same mesh up to axis relabeling — but only rotations that still map
-    onto whole hosts are usable (same admission rule as ``parse_topology``).
-    """
+def _orientations_uncached(
+    accel: TpuAccelerator, chip_shape: tuple[int, ...]
+) -> tuple[tuple[tuple[int, ...], tuple[int, ...]], ...]:
     out: list[tuple[tuple[int, ...], tuple[int, ...]]] = []
     seen: set[tuple[int, ...]] = set()
-    for perm in itertools.permutations(tuple(chip_shape)):
+    for perm in itertools.permutations(chip_shape):
         if perm in seen:
             continue
         seen.add(perm)
         tiles = all(d % b == 0 for d, b in zip(perm, accel.host_block))
         if tiles or perm in accel.supports_single_host_sub_blocks:
             out.append((perm, ceil_div_shape(perm, accel.host_block)))
+    return tuple(out)
+
+
+_orientations_cached = functools.lru_cache(maxsize=None)(_orientations_uncached)
+
+
+def orientations(
+    accel: TpuAccelerator, chip_shape: Sequence[int]
+) -> tuple[tuple[tuple[int, ...], tuple[int, ...]], ...]:
+    """Valid axis permutations of a request, as (chip_shape, block_shape).
+
+    A slice request can be rotated onto the pool torus — the sub-cuboid is
+    the same mesh up to axis relabeling — but only rotations that still map
+    onto whole hosts are usable (same admission rule as ``parse_topology``).
+
+    Memoized: shape tuples are tiny and immutable, the accelerator table is
+    fixed, and the scheduler asks for the same handful of shapes once per
+    fit attempt across thousands of attempts per cycle.
+    """
+    return _orientations_cached(accel, tuple(chip_shape))
+
+
+def _greedy_sweep(
+    grid: Sequence[int], free: set[tuple[int, ...]]
+) -> list[Cuboid]:
+    """The canonical decomposition sweep over a free-cell set (consumed).
+
+    Deterministic: take the lexicographically smallest free cell, grow the
+    box axis-by-axis (last axis first, so runs follow the host-ordinal
+    direction) as far as every covered cell stays free, emit, repeat. Each
+    growth step only probes the newly-added slab — the cells already inside
+    the box are free by construction.
+    """
+    out: list[Cuboid] = []
+    n = len(grid)
+    while free:
+        origin = min(free)
+        shape = [1] * n
+        for axis in range(n - 1, -1, -1):
+            while origin[axis] + shape[axis] < grid[axis]:
+                pos = origin[axis] + shape[axis]
+                slab = itertools.product(*(
+                    (range(o, o + s) if a != axis else (pos,))
+                    for a, (o, s) in enumerate(zip(origin, shape))
+                ))
+                if all(cell in free for cell in slab):
+                    shape[axis] += 1
+                else:
+                    break
+        box = Cuboid(origin, tuple(shape))
+        free.difference_update(box.cells())
+        out.append(box)
     return out
 
 
@@ -96,35 +143,96 @@ def decompose_free(
 ) -> list[Cuboid]:
     """Canonical decomposition of the free space into disjoint cuboids.
 
-    Deterministic greedy sweep: take the lexicographically smallest free
-    cell, grow the box axis-by-axis (last axis first, so runs follow the
-    host-ordinal direction) as far as every covered cell stays free, emit,
-    repeat. Pure function of the used set — freeing a gang and re-running
-    yields exactly the pre-placement free set (the coalescing contract).
+    Pure function of the used set — freeing a gang and re-running yields
+    exactly the pre-placement free set (the coalescing contract). This is
+    the from-scratch reference; :class:`FreeSet` maintains the identical
+    decomposition incrementally and is differentially audited against it.
     """
     free: set[tuple[int, ...]] = set(
         itertools.product(*(range(g) for g in grid))
     )
     for c in used:
         free.difference_update(c.cells())
-    out: list[Cuboid] = []
-    while free:
-        origin = min(free)
-        shape = [1] * len(grid)
-        # grow along each axis, last axis first (innermost runs)
-        for axis in range(len(grid) - 1, -1, -1):
-            while origin[axis] + shape[axis] < grid[axis]:
-                grown = list(shape)
-                grown[axis] += 1
-                candidate = Cuboid(origin, tuple(grown))
-                if all(cell in free for cell in candidate.cells()):
-                    shape = grown
-                else:
-                    break
-        box = Cuboid(origin, tuple(shape))
-        free.difference_update(box.cells())
-        out.append(box)
-    return out
+    return _greedy_sweep(grid, free)
+
+
+def _probe_overlaps(c: Cuboid, box: Cuboid) -> bool:
+    """Does ``box`` intersect the region the sweep *probed* while growing
+    ``c``? Growth along each axis peeks one slab past the final shape, so
+    the probed region is contained in ``c`` inflated by +1 in every positive
+    axis direction — a conservative superset is all the prefix rule needs."""
+    return all(
+        bo < co + cs + 1 and co < bo + bs
+        for bo, bs, co, cs in zip(box.offset, box.shape, c.offset, c.shape)
+    )
+
+
+class FreeSet:
+    """Incrementally-maintained canonical free decomposition of one grid.
+
+    ``cuboids`` is always cell-for-cell identical to
+    ``decompose_free(grid, used)`` (property-tested in test_binpack.py) —
+    but a ``carve``/``release`` updates it in time proportional to the
+    *suffix* of the sweep the change can influence, not the whole grid.
+
+    The prefix rule: the greedy sweep emits cuboids in lexicographic origin
+    order, each one a deterministic function of (a) the smallest remaining
+    free cell and (b) the free cells its growth probed. A cuboid of the old
+    decomposition therefore survives a change verbatim iff no released cell
+    precedes its origin (released cells were used, so they are covered by no
+    earlier cuboid and would steal the origin) and the changed box misses
+    its probe region entirely; the first cuboid failing either test starts
+    the re-swept suffix. Carved cells before a kept origin are inside an
+    earlier cuboid by construction, so they fail the probe test there first.
+    """
+
+    __slots__ = ("grid", "cells", "cuboids")
+
+    def __init__(
+        self, grid: Sequence[int], used: Iterable[Cuboid] = ()
+    ) -> None:
+        self.grid = tuple(grid)
+        self.cells: set[tuple[int, ...]] = set(
+            itertools.product(*(range(g) for g in self.grid))
+        )
+        for c in used:
+            self.cells.difference_update(c.cells())
+        self.cuboids: list[Cuboid] = _greedy_sweep(self.grid, set(self.cells))
+
+    def carve(self, box: Cuboid) -> None:
+        """Remove a fully-free box from the free space (a placement)."""
+        self._apply(box, adding=False)
+
+    def release(self, box: Cuboid) -> None:
+        """Return a previously-carved box to the free space (coalescing is
+        automatic: the suffix re-sweep re-derives the canonical cuboids)."""
+        self._apply(box, adding=True)
+
+    def _apply(self, box: Cuboid, *, adding: bool) -> None:
+        changed = set(box.cells())
+        if adding:
+            self.cells |= changed
+        else:
+            self.cells -= changed
+        min_released = min(changed) if adding else None
+        prefix: list[Cuboid] = []
+        for c in self.cuboids:
+            if min_released is not None and not (c.offset < min_released):
+                break
+            if _probe_overlaps(c, box):
+                break
+            prefix.append(c)
+        remaining = set(self.cells)
+        for c in prefix:
+            remaining.difference_update(c.cells())
+        self.cuboids = prefix + _greedy_sweep(self.grid, remaining)
+
+    def clone(self) -> "FreeSet":
+        out = FreeSet.__new__(FreeSet)
+        out.grid = self.grid
+        out.cells = set(self.cells)
+        out.cuboids = list(self.cuboids)  # Cuboids are frozen
+        return out
 
 
 def _scan_fit(
@@ -143,25 +251,27 @@ def _scan_fit(
     return None
 
 
-def best_fit(
-    grid: Sequence[int],
-    used: Iterable[Cuboid],
+def best_fit_free(
+    free: FreeSet,
     accel: TpuAccelerator,
     chip_shape: Sequence[int],
 ) -> tuple[Cuboid, tuple[int, ...]] | None:
-    """Place one slice request into one pool grid.
+    """Place one slice request against a maintained :class:`FreeSet`.
 
     Returns ``(block_cuboid, oriented_chip_shape)`` or None. Score order:
     least leftover volume in the hosting free cuboid (best-fit), then
     lexicographic offset, then orientation order — fully deterministic, so
     a restarted scheduler re-derives identical decisions from identical
-    state.
+    state. Orientations whose block volume exceeds the free cell count are
+    rejected without touching geometry (a necessary-condition fast path).
     """
-    frees = decompose_free(grid, used)
     options = orientations(accel, chip_shape)
+    n_free = len(free.cells)
     best: tuple[tuple[int, int, tuple[int, ...]], Cuboid, tuple[int, ...]] | None = None
     for i, (chips, blocks) in enumerate(options):
-        for f in frees:
+        if math.prod(blocks) > n_free:
+            continue
+        for f in free.cuboids:
             if all(b <= fs for b, fs in zip(blocks, f.shape)):
                 score = (f.volume - math.prod(blocks), i, f.offset)
                 if best is None or score < best[0]:
@@ -169,13 +279,21 @@ def best_fit(
     if best is not None:
         return best[1], best[2]
     # fall back to the exact scan (free region exists but was split)
-    free_cells: set[tuple[int, ...]] = set(
-        itertools.product(*(range(g) for g in grid))
-    )
-    for c in used:
-        free_cells.difference_update(c.cells())
     for chips, blocks in options:
-        offset = _scan_fit(grid, free_cells, blocks)
+        if math.prod(blocks) > n_free:
+            continue
+        offset = _scan_fit(free.grid, free.cells, blocks)
         if offset is not None:
             return Cuboid(offset, blocks), chips
     return None
+
+
+def best_fit(
+    grid: Sequence[int],
+    used: Iterable[Cuboid],
+    accel: TpuAccelerator,
+    chip_shape: Sequence[int],
+) -> tuple[Cuboid, tuple[int, ...]] | None:
+    """From-scratch convenience wrapper over :func:`best_fit_free` (the
+    scheduler's pools carry a persistent FreeSet and skip the rebuild)."""
+    return best_fit_free(FreeSet(grid, used), accel, chip_shape)

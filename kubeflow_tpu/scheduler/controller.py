@@ -67,7 +67,7 @@ from kubeflow_tpu.scheduler import (
     placement_of,
 )
 from kubeflow_tpu.scheduler import preemption as preempt
-from kubeflow_tpu.scheduler.fleet import Fleet
+from kubeflow_tpu.scheduler.fleet import Fleet, FitCache, FleetModel
 from kubeflow_tpu.scheduler.preemption import BoundGang
 from kubeflow_tpu.scheduler.queue import (
     DEFAULT_AGING_INTERVAL_S,
@@ -79,10 +79,17 @@ log = logging.getLogger(__name__)
 
 FLEET_KEY = "@fleet"  # the single coalesced reconcile key
 
+# Condition-signature constants for the write phase's fast path.
+_SIG_BOUND = ("bound",)
+_SIG_OFF = ("off",)
+
 # Beyond this queue depth, Queued messages stop carrying exact positions:
-# every bind shifts every position behind it, and rewriting 10k conditions
-# per cycle is write-amplification with no reader (the spawner shows tens).
-POSITION_MESSAGE_DEPTH = 1000
+# every bind shifts every position behind it (and shrinks the "of n"), so
+# exact messages mean one status write per queued notebook per cycle —
+# write-amplification whose only reader is the spawner, which shows tens.
+# 100 keeps exact positions for every queue a human actually watches while
+# a 10k burst stays on the static message until it drains near the front.
+POSITION_MESSAGE_DEPTH = 100
 
 
 class SchedulerReconciler(Reconciler):
@@ -104,6 +111,7 @@ class SchedulerReconciler(Reconciler):
         backfill_window: int = preempt.DEFAULT_BACKFILL_WINDOW,
         resync_s: float = 30.0,
         suspend_deadline_s: float | None = None,
+        differential_audit: bool = False,
     ) -> None:
         self.metrics = metrics
         # EventRecorder (obs/events.py): Queued/Bound/Preempted/Unschedulable
@@ -125,6 +133,22 @@ class SchedulerReconciler(Reconciler):
         # The workqueue already serializes the single key; the lock is a
         # belt-and-braces guard for direct _cycle() callers (bench, tests).
         self._cycle_lock = threading.Lock()
+        # --- the incremental fast path (docs/scheduler.md) ---------------
+        # All of this is in-memory acceleration over the same annotations-
+        # are-the-store-of-record contract: a crash-restart builds a fresh
+        # reconciler whose first cycle rebuilds everything from scratch.
+        self._model = FleetModel()
+        self._nb_cache = _NotebookCache()
+        self._fit_cache = FitCache()
+        self._fit_seen = (0, 0)  # (hits, misses) already flushed to metrics
+        self._feasible: dict[tuple, bool] = {}
+        self._feasible_sig: tuple | None = None
+        self._geo_gen = 0  # bumps when fleet geometry changes (adm cache)
+        # When True, every cycle cross-checks the incremental model against
+        # a from-scratch rebuild + full replay (the soak's differential
+        # audit); mismatches accumulate in audit_failures.
+        self.differential_audit = differential_audit
+        self.audit_failures: list[str] = []
 
     def watches(self):
         return [("Notebook", _map_to_fleet), ("Node", _map_to_fleet)]
@@ -146,43 +170,52 @@ class SchedulerReconciler(Reconciler):
     # ----------------------------------------------------------- the cycle
 
     def _cycle(self, cluster: FakeCluster) -> tuple[int, bool]:
-        """One full scheduling pass. Returns (queue depth, barrier pending)."""
+        """One full scheduling pass. Returns (queue depth, barrier pending).
+
+        The pass is phase-structured and incremental (docs/scheduler.md
+        fast path): **list** polls the resourceVersion index and re-fetches
+        only moved Notebook bodies; **replay** folds node deltas into the
+        persistent fleet model (rebuilding only changed pools) and diffs
+        committed placements as carve/release deltas instead of replaying
+        every annotation; **pack** runs admission + scheduling with the
+        negative-fit cache; **write** batches the status-condition updates.
+        Each phase's wall time lands in the cycle-phase histogram.
+        """
         cycle_started = time.perf_counter()
         barrier_pending = False
         now = self.clock()
-        fleet = Fleet.from_nodes(cluster.list("Node"))
-        notebooks: list[tuple[dict, object, int]] = []
-        for nb in cluster.list("Notebook"):
-            try:
-                topo = api.notebook_topology(nb)
-                num_slices = api.notebook_num_slices(nb)
-            except ValueError:
-                continue  # malformed spec.tpu: admission's problem, not ours
-            if topo is None:
-                continue  # CPU notebook: no chips wanted
-            notebooks.append((nb, topo, num_slices))
 
+        # -- list phase ---------------------------------------------------
+        nodes = cluster.list("Node")
+        views = [
+            v for v in self._nb_cache.refresh(cluster) if v.topo is not None
+        ]  # malformed spec.tpu is admission's problem; CPU wants no chips
+        t_list = time.perf_counter()
+
+        model = self._model
+        fleet = model.fleet
         queue = GangQueue(aging_interval_s=self.aging_interval_s)
         bound: dict[str, BoundGang] = {}
-        nb_by_key = {_nb_key(nb): nb for nb, _, _ in notebooks}
+        nb_by_key = {v.key: v.nb for v in views}
         preempted_now: dict[str, str] = {}  # key -> human reason
         released: set[str] = set()  # suspend handoffs completed this cycle
         handoff_accels: set[str] = set()  # accels with a handoff in flight
 
-        # -- replay committed placements (deterministic order: bind time
-        #    then key, so an overlap after a drain always evicts the same
-        #    gang regardless of list order) --------------------------------
-        with_placement = [
-            (nb, topo, num_slices, placement_of(nb))
-            for nb, topo, num_slices in notebooks
-        ]
-        with_placement.sort(
-            key=lambda t: ((t[3] or {}).get("boundAt", 0.0), _nb_key(t[0]))
+        # -- replay phase: placement diff against the persistent model ----
+        # Desired-occupancy build runs in deterministic order (bind time
+        # then key), so when a rebuilt pool can no longer hold everything,
+        # the same gang loses regardless of list order.
+        model.refresh_nodes(nodes)
+        desired: dict[str, list[dict]] = {}  # insertion order = apply order
+        barrier_hold: set[str] = set()  # teardown-barrier keys in desired
+        replaying: dict[str, BoundGang] = {}  # live keys in desired
+        with_placement = sorted(
+            (v for v in views if v.placement is not None),
+            key=lambda v: (v.placement.get("boundAt", 0.0), v.key),
         )
-        for nb, topo, num_slices, placement in with_placement:
-            if placement is None:
-                continue
-            key = _nb_key(nb)
+        for view in with_placement:
+            nb, key, topo = view.nb, view.key, view.topo
+            num_slices, placement = view.num_slices, view.placement
             if not _wants_capacity(nb):
                 if (
                     self.suspend_deadline_s is not None
@@ -194,9 +227,9 @@ class SchedulerReconciler(Reconciler):
                     # release now would bind a second gang onto hosts whose
                     # pods have not exited). Occupancy failing means the
                     # capacity itself is gone (drain/flap): nothing to hold.
-                    if fleet.occupy_gang(key, placement["slices"]):
-                        barrier_pending = True
-                        continue
+                    desired[key] = placement["slices"]
+                    barrier_hold.add(key)
+                    continue
                 # stopped/culled while bound: release the chips and clear
                 # every scheduler mark — a restart re-queues from scratch
                 self._unbind(cluster, nb, drop_queued_at=True)
@@ -222,7 +255,8 @@ class SchedulerReconciler(Reconciler):
                     # placement and retires the spent request, so a crash on
                     # either side replays cleanly (chips still held, or
                     # victim fully queued — never half). The victim keeps
-                    # its queued-at: seniority survives suspension.
+                    # its queued-at: seniority survives suspension. Left out
+                    # of the desired set, the diff releases its chips now.
                     self._release_suspended(cluster, nb)
                     preempted_now[key] = (
                         "suspended for a higher-priority gang"
@@ -233,79 +267,64 @@ class SchedulerReconciler(Reconciler):
                 # snapshot commits or the force deadline passes
                 barrier_pending = True
                 handoff_accels.add(topo.accelerator.name)
-            if fleet.occupy_gang(key, placement["slices"]):
-                bound[key] = BoundGang(
-                    key=key,
-                    priority=gang_priority(nb),
-                    queued_at=_queued_at(nb, now),
-                    chips=topo.num_chips * num_slices,
-                    topo=topo,
-                    num_slices=num_slices,
-                )
-            else:
-                # node drain / capacity flap invalidated the placement
-                self._unbind(cluster, nb)
-                preempted_now[key] = "placement lost to node drain"
-
-        # -- queue admission ----------------------------------------------
-        unschedulable: dict[str, str] = {}
-        feasible_cache: dict[tuple, bool] = {}
-        for nb, topo, num_slices in notebooks:
-            key = _nb_key(nb)
-            if key in bound:
-                continue
-            if not _wants_capacity(nb):
-                # stopped while still queued: the queue entry must go with
-                # it — a ghost queued-at would hold a phantom capacity claim
-                # and resurrect stale seniority on restart. A raced delete
-                # or conflicting write must not abort the whole fleet cycle
-                # for a gang that holds no geometry claim; the clear is
-                # retried next cycle.
-                if QUEUED_AT_ANNOTATION in ko.annotations(nb):
-                    try:
-                        self._patch_annotations(
-                            cluster, nb, {QUEUED_AT_ANNOTATION: None}
-                        )
-                    except (NotFound, Conflict):
-                        pass
-                continue
-            shape_key = (topo.accelerator.name, topo.shape, num_slices)
-            feasible = feasible_cache.get(shape_key)
-            if feasible is None:
-                feasible = fleet.feasible_on_empty(topo, num_slices)
-                feasible_cache[shape_key] = feasible
-            if not feasible:
-                unschedulable[key] = (
-                    f"no node pool can hold {topo.slice_name}"
-                    + (f" x{num_slices}" if num_slices > 1 else "")
-                )
-                continue
-            queued_at = _queued_at(nb, None)
-            if queued_at is None:
-                queued_at = now
-                try:
-                    self._patch_annotations(
-                        cluster, nb, {QUEUED_AT_ANNOTATION: repr(queued_at)}
-                    )
-                except (NotFound, Conflict):
-                    continue  # deleted/raced: next cycle re-admits
-                # first admission is the transition worth an Event; the
-                # queued-at annotation makes it exactly-once per wait
-                self._emit(
-                    cluster, nb, "Queued",
-                    f"gang admitted to the TPU capacity queue "
-                    f"({topo.slice_name}"
-                    + (f" x{num_slices}" if num_slices > 1 else "") + ")",
-                )
-            queue.push(GangRequest(
+            desired[key] = placement["slices"]
+            replaying[key] = BoundGang(
                 key=key,
-                priority=gang_priority(nb),
-                queued_at=queued_at,
+                priority=view.priority,
+                queued_at=_queued_at(nb, now),
+                chips=topo.num_chips * num_slices,
                 topo=topo,
                 num_slices=num_slices,
-            ))
+            )
+        failed = set(model.sync_placements(desired))
+        for key in desired:
+            if key in failed:
+                nb = nb_by_key.get(key)
+                if key in barrier_hold:
+                    if nb is not None:
+                        self._unbind(cluster, nb, drop_queued_at=True)
+                else:
+                    # node drain / capacity flap invalidated the placement
+                    if nb is not None:
+                        self._unbind(cluster, nb)
+                    preempted_now[key] = "placement lost to node drain"
+            elif key in barrier_hold:
+                barrier_pending = True
+            else:
+                bound[key] = replaying[key]
+        t_replay = time.perf_counter()
 
-        # -- scheduling pass ----------------------------------------------
+        # -- pack phase: queue admission ----------------------------------
+        unschedulable: dict[str, str] = {}
+        sig = fleet.geometry_signature()
+        if sig != self._feasible_sig:
+            self._feasible_sig = sig
+            self._feasible.clear()
+            self._geo_gen += 1
+        geo_gen = self._geo_gen
+        for view in views:
+            if view.key in bound:
+                continue
+            # admission is a pure function of (notebook body, fleet
+            # geometry); cache the verdict per view so 10k unchanged queued
+            # gangs cost two comparisons each, not a re-parse
+            adm = (
+                view.admission
+                if view.adm_rv == view.rv and view.adm_sig == geo_gen
+                else None
+            )
+            if adm is None:
+                adm = self._admit(cluster, fleet, view, now)
+                if adm is None:
+                    continue  # raced a delete/write: next cycle re-admits
+                view.admission = adm
+                view.adm_rv = view.rv
+                view.adm_sig = geo_gen
+            if adm[0] == "queued":
+                queue.push(adm[1])
+            elif adm[0] == "unschedulable":
+                unschedulable[view.key] = adm[1]
+
         # Victims already released while a same-accel handoff is still in
         # flight (multi-victim preemption resolving ack by ack) carry the
         # same re-bind hazard as this cycle's releases: their preserved
@@ -314,57 +333,78 @@ class SchedulerReconciler(Reconciler):
         # until re-bind) identifies them durably across cycles.
         deferred = set(released)
         if handoff_accels:
-            for nb, topo, num_slices in notebooks:
-                key = _nb_key(nb)
+            for view in views:
                 if (
-                    key not in bound
-                    and topo.accelerator.name in handoff_accels
-                    and (condition(nb, COND_PREEMPTED) or {}).get("status")
-                    == "True"
+                    view.key not in bound
+                    and view.topo.accelerator.name in handoff_accels
+                    and (condition(view.nb, COND_PREEMPTED) or {}).get(
+                        "status") == "True"
                 ):
-                    deferred.add(key)
+                    deferred.add(view.key)
 
-        # -- scheduling pass ----------------------------------------------
+        # -- pack phase: the scheduling pass ------------------------------
         newly_bound, handoffs = self._schedule(
             cluster, fleet, queue, bound, preempted_now, now, nb_by_key,
             deferred,
         )
         barrier_pending = barrier_pending or handoffs
+        t_pack = time.perf_counter()
 
-        # -- status conditions + metrics ----------------------------------
-        order = queue.ordered(now)
-        positions = {r.key: i + 1 for i, r in enumerate(order)}
-        for nb, topo, num_slices in notebooks:
-            key = _nb_key(nb)
-            if not _wants_capacity(nb):
-                self._write_conditions(cluster, nb, [])
-            elif key in bound or key in newly_bound:
-                self._write_conditions(cluster, nb, [{
+        # -- write phase: status conditions + metrics ---------------------
+        # The loop is the batched write pass: desired conditions reduce to
+        # a cheap signature per view, checked against the last written one
+        # BEFORE any condition dicts are built or status lists scanned —
+        # at 10k steady queued gangs the whole phase is signature compares.
+        depth = len(queue)
+        if depth <= POSITION_MESSAGE_DEPTH:
+            positions = {
+                r.key: i + 1 for i, r in enumerate(queue.ordered(now))
+            }
+        else:
+            # deep queue: every message is the static one, so the ordering
+            # (a second 10k-entry sort) has no reader at all
+            positions = None
+        for view in views:
+            key = view.key
+            if key in bound or key in newly_bound:
+                if _SIG_BOUND == view.conds_sig and view.rv == view.conds_rv:
+                    continue
+                self._write_conditions(cluster, view, [{
                     "type": COND_QUEUED, "status": "False",
                     "reason": "Bound", "message": "",
-                }])
+                }], _SIG_BOUND)
             elif key in unschedulable:
+                msg = unschedulable[key]
+                sig = ("unschedulable", msg)
+                if sig == view.conds_sig and view.rv == view.conds_rv:
+                    continue
                 if not (
-                    (condition(nb, COND_UNSCHEDULABLE) or {}).get("status")
-                    == "True"
+                    (condition(view.nb, COND_UNSCHEDULABLE) or {}).get(
+                        "status") == "True"
                 ):
                     # transition into Unschedulable (not the steady state)
                     self._emit(
-                        cluster, nb, "Unschedulable", unschedulable[key],
+                        cluster, view.nb, "Unschedulable", msg,
                         type_="Warning",
                     )
-                self._write_conditions(cluster, nb, [{
+                self._write_conditions(cluster, view, [{
                     "type": COND_UNSCHEDULABLE, "status": "True",
                     "reason": "NoFittingPool",
-                    "message": unschedulable[key],
-                }])
-            elif key in positions:
-                if len(order) <= POSITION_MESSAGE_DEPTH:
-                    msg = f"position {positions[key]} of {len(order)}"
+                    "message": msg,
+                }], sig)
+            elif key in queue:
+                if positions is not None:
+                    msg = f"position {positions[key]} of {depth}"
                 else:
                     # depth changes every cycle; putting it in the message
                     # would rewrite every queued notebook's status per cycle
                     msg = "waiting for TPU capacity"
+                # the carried Preempted condition is NOT in the signature:
+                # it is derived from .status, which cannot change without
+                # an rv bump, and the rv is part of the fast-path check
+                sig = ("queued", msg, preempted_now.get(key) or "")
+                if sig == view.conds_sig and view.rv == view.conds_rv:
+                    continue
                 conds = [{
                     "type": COND_QUEUED, "status": "True",
                     "reason": "WaitingForCapacity", "message": msg,
@@ -377,19 +417,112 @@ class SchedulerReconciler(Reconciler):
                     })
                 else:
                     # a victim stays marked Preempted until it binds again
-                    existing = condition(nb, COND_PREEMPTED)
+                    existing = condition(view.nb, COND_PREEMPTED)
                     if existing is not None and existing.get("status") == "True":
                         conds.append(existing)
-                self._write_conditions(cluster, nb, conds)
+                self._write_conditions(cluster, view, conds, sig)
+            elif not _wants_capacity(view.nb):
+                if _SIG_OFF == view.conds_sig and view.rv == view.conds_rv:
+                    continue
+                self._write_conditions(cluster, view, [], _SIG_OFF)
+            # any other state (raced writes, transient gaps): leave the
+            # conditions untouched — the next cycle re-derives them
+        t_write = time.perf_counter()
 
+        if self.differential_audit:
+            self.audit_failures.extend(model.audit(nodes))
         if self.metrics is not None:
             self.metrics.observe_cycle(
                 fleet,
-                queue_depth=len(order),
+                queue_depth=depth,
                 unschedulable=len(unschedulable),
-                duration_s=time.perf_counter() - cycle_started,
+                duration_s=t_write - cycle_started,
+                phases={
+                    "list": t_list - cycle_started,
+                    "replay": t_replay - t_list,
+                    "pack": t_pack - t_replay,
+                    "write": t_write - t_pack,
+                },
             )
-        return len(order), barrier_pending
+            hits, misses = self._fit_cache.hits, self._fit_cache.misses
+            seen_h, seen_m = self._fit_seen
+            self.metrics.observe_fit_cache(hits - seen_h, misses - seen_m)
+            self._fit_seen = (hits, misses)
+        return depth, barrier_pending
+
+    def _admit(
+        self,
+        cluster: FakeCluster,
+        fleet: Fleet,
+        view: "_NbView",
+        now: float,
+    ) -> tuple | None:
+        """One gang's admission verdict: ``("stopped",)``,
+        ``("unschedulable", message)``, or ``("queued", request)`` —
+        or None when a raced write means the next cycle must retry.
+        Side-effecting transitions (clearing a stopped gang's queued-at,
+        stamping first admission + its Event) happen here, so a cached
+        verdict is always side-effect-free to replay."""
+        nb, topo, num_slices = view.nb, view.topo, view.num_slices
+        if not _wants_capacity(nb):
+            # stopped while still queued: the queue entry must go with
+            # it — a ghost queued-at would hold a phantom capacity claim
+            # and resurrect stale seniority on restart. A raced delete
+            # or conflicting write must not abort the whole fleet cycle
+            # for a gang that holds no geometry claim; the clear is
+            # retried next cycle.
+            if QUEUED_AT_ANNOTATION in ko.annotations(nb):
+                try:
+                    self._patch_annotations(
+                        cluster, nb, {QUEUED_AT_ANNOTATION: None}
+                    )
+                except (NotFound, Conflict):
+                    return None
+            return ("stopped",)
+        shape_key = (topo.accelerator.name, topo.shape, num_slices)
+        feasible = self._feasible.get(shape_key)
+        if feasible is None:
+            feasible = fleet.feasible_on_empty(topo, num_slices)
+            self._feasible[shape_key] = feasible
+        if not feasible:
+            return ("unschedulable", (
+                f"no node pool can hold {topo.slice_name}"
+                + (f" x{num_slices}" if num_slices > 1 else "")
+            ))
+        queued_at = _queued_at(nb, None)
+        if queued_at is None:
+            queued_at = now
+            try:
+                self._patch_annotations(
+                    cluster, nb, {QUEUED_AT_ANNOTATION: repr(queued_at)}
+                )
+            except (NotFound, Conflict):
+                return None  # deleted/raced: next cycle re-admits
+            # first admission is the transition worth an Event; the
+            # queued-at annotation makes it exactly-once per wait
+            self._emit(
+                cluster, nb, "Queued",
+                f"gang admitted to the TPU capacity queue "
+                f"({topo.slice_name}"
+                + (f" x{num_slices}" if num_slices > 1 else "") + ")",
+            )
+        return ("queued", self._request_for(view, queued_at))
+
+    @staticmethod
+    def _request_for(view: "_NbView", queued_at: float) -> GangRequest:
+        """The view's GangRequest, rebuilt only when its inputs moved
+        (an rv change resets it; a queued-at (re)stamp changes the value)."""
+        req = view.request
+        if req is None or req.queued_at != queued_at:
+            req = GangRequest(
+                key=view.key,
+                priority=view.priority,
+                queued_at=queued_at,
+                topo=view.topo,
+                num_slices=view.num_slices,
+            )
+            view.request = req
+        return req
 
     def _schedule(
         self,
@@ -454,13 +587,23 @@ class SchedulerReconciler(Reconciler):
                     continue
                 if req.chips >= head.chips:
                     continue
-                slices = fleet.place_gang(req.key, req.topo, req.num_slices)
+                if fleet.accel_free_cells(accel) == 0:
+                    # saturation short-circuit: zero free host cells means
+                    # no backfill can possibly fit — skip the attempt (the
+                    # head already ran its preemption trial above)
+                    continue
+                slices = fleet.place_gang(
+                    req.key, req.topo, req.num_slices,
+                    fit_cache=self._fit_cache,
+                )
                 if slices is not None:
                     self._commit_bind(cluster, req, slices, now)
                     queue.discard(req.key)
                     newly_bound.add(req.key)
                 continue
-            slices = fleet.place_gang(req.key, req.topo, req.num_slices)
+            slices = fleet.place_gang(
+                req.key, req.topo, req.num_slices, fit_cache=self._fit_cache
+            )
             if slices is not None:
                 self._commit_bind(cluster, req, slices, now)
                 queue.discard(req.key)
@@ -469,7 +612,9 @@ class SchedulerReconciler(Reconciler):
             # victims: only gangs bound by a PREVIOUS cycle — same-cycle
             # binds were just scheduled by current policy; evicting them
             # now would churn annotations for a decision the next cycle
-            # reaches anyway
+            # reaches anyway. The trial runs on a clone with NO fit cache:
+            # victim space is not free space, so cached "doesn't fit"
+            # verdicts must never veto an eviction that would make it fit.
             victims = preempt.select_victims(fleet, list(bound.values()), req)
             if victims is not None:
                 if self.suspend_deadline_s is not None:
@@ -488,7 +633,7 @@ class SchedulerReconciler(Reconciler):
                     continue
                 for v in victims:
                     self._evict(cluster, v, req, preempted_now)
-                    fleet.free_gang(v.key)
+                    self._model.release(v.key)  # epoch bump un-sticks fits
                     bound.pop(v.key, None)
                     # the victim re-queues with its real request and its
                     # original seniority; this cycle reconsiders it after
@@ -520,13 +665,18 @@ class SchedulerReconciler(Reconciler):
         now: float,
     ) -> None:
         ns, name = req.key.split("/", 1)
+        # the fleet already carries the carve (place_gang committed it);
+        # record it in the applied map so next cycle's diff treats it as
+        # replayed — or, if the annotation write below is lost, releases it
+        self._model.applied[req.key] = slices
         try:
-            cluster.patch(
+            stored = cluster.patch(
                 "Notebook", name, ns,
                 {"metadata": {"annotations": {
                     PLACEMENT_ANNOTATION: encode_placement(slices, now),
                 }}},
             )
+            self._nb_cache.store(stored)
         except NotFound:
             return  # deleted under us; the fleet model re-derives next cycle
         if self.metrics is not None:
@@ -663,31 +813,48 @@ class SchedulerReconciler(Reconciler):
     def _patch_annotations(
         self, cluster: FakeCluster, nb: dict, anns: dict
     ) -> None:
-        cluster.patch(
+        stored = cluster.patch(
             "Notebook", ko.name(nb), ko.namespace(nb),
             {"metadata": {"annotations": anns}},
         )
-        # keep the in-memory copy coherent for the rest of the cycle
+        # keep the in-memory copy coherent for the rest of the cycle (the
+        # caller may hold a reference to this exact dict) and fold the
+        # stored result into the view cache so the next cycle needs no
+        # re-fetch for our own write
         for k, v in anns.items():
             if v is None:
                 ko.remove_annotation(nb, k)
             else:
                 ko.set_annotation(nb, k, v)
+        self._nb_cache.store(stored)
 
     def _write_conditions(
-        self, cluster: FakeCluster, nb: dict, conds: list[dict]
+        self,
+        cluster: FakeCluster,
+        view: "_NbView",
+        conds: list[dict],
+        sig: tuple,
     ) -> None:
         """Own exactly the scheduler condition types: strip ours, append the
         given ones in the shared canonical layout (``merge_conditions`` —
         the notebook controller writes the same layout, or the two would
         rewrite each other's status forever), write only on change
         (idempotent cycles must produce zero writes, or the manager would
-        never settle). The no-op check runs against the cycle's own listed
-        copy — re-reading every notebook every cycle would be a get per
-        object per cycle."""
+        never settle).
+
+        ``sig`` is the cheap identity of the desired condition set: when it
+        matches what this controller last wrote/verified for the view AND
+        the object hasn't moved since (rv check — any other writer resets
+        it), the whole merge-and-compare is skipped. At 10k steady queued
+        gangs that fast path is the difference between a write phase that
+        scales with the queue and one that scales with the delta."""
+        nb = view.nb
+        if sig == view.conds_sig and view.rv == view.conds_rv:
+            return
         current = (nb.get("status") or {}).get("conditions", []) or []
         new = merge_conditions(current, conds)
         if new == current:
+            view.conds_sig, view.conds_rv = sig, view.rv
             return
         fresh = cluster.try_get("Notebook", ko.name(nb), ko.namespace(nb))
         if fresh is None:
@@ -697,9 +864,118 @@ class SchedulerReconciler(Reconciler):
         new = merge_conditions(live, conds)
         if new != live:
             status["conditions"] = new
-            cluster.update_status(fresh)
+            stored = cluster.update_status(fresh)
+            self._nb_cache.store(stored)
         # mirror into the local copy so the same cycle sees its own writes
         nb.setdefault("status", {})["conditions"] = new
+        view.nb.setdefault("status", {})["conditions"] = new
+        view.conds_sig, view.conds_rv = sig, view.rv
+
+
+class _NbView:
+    """One Notebook as the scheduler sees it: the cached body plus every
+    derived field a cycle needs (parsed topology, placement, priority, the
+    queue request, the last-written condition signature) — re-parsed only
+    when the object's resourceVersion moves."""
+
+    __slots__ = (
+        "key", "rv", "nb", "topo", "num_slices", "placement", "priority",
+        "request", "conds_sig", "conds_rv",
+        "admission", "adm_rv", "adm_sig",
+    )
+
+
+class _NotebookCache:
+    """Informer-style Notebook cache for the scheduling cycle.
+
+    Level-triggered, like everything else in the scheduler: every cycle
+    polls the store's cheap resourceVersion index and re-fetches only the
+    bodies that moved, so a cold cycle costs one full read of the world and
+    a steady cycle costs O(objects that changed). No watch is involved —
+    a dropped watch cannot desynchronize it — and a fresh incarnation
+    starts empty, so crash-restart keeps the from-scratch safety story.
+    """
+
+    def __init__(self) -> None:
+        self.views: dict[str, _NbView] = {}
+        self._keystr: dict[tuple[str, str], str] = {}  # (ns, name) -> key
+        self._sorted: list[_NbView] | None = None  # None = membership moved
+
+    def refresh(self, cluster: FakeCluster) -> list[_NbView]:
+        rv_index = getattr(cluster, "resource_versions", None)
+        if rv_index is None:
+            # client surface without the index: degrade to a full re-list
+            self.views.clear()
+            self._sorted = None
+            for nb in cluster.list("Notebook"):
+                self.store(nb)
+            return self._ordered()
+        views, keystr = self.views, self._keystr
+        rvs = rv_index("Notebook")
+        missed = False
+        for nk, rv in rvs.items():
+            key = keystr.get(nk)
+            if key is None:
+                key = keystr[nk] = f"{nk[0]}/{nk[1]}"
+            view = views.get(key)
+            if view is not None and view.rv == rv:
+                continue
+            if view is None:
+                missed = True
+            nb = cluster.try_get("Notebook", nk[1], nk[0])
+            if nb is None:
+                # deleted between the index poll and the get
+                if views.pop(key, None) is not None:
+                    self._sorted = None
+                continue
+            self.store(nb)
+        if missed or len(views) != len(rvs):
+            live = {keystr[nk] for nk in rvs}
+            for key in [k for k in views if k not in live]:
+                del views[key]
+            if len(keystr) > len(rvs):
+                # drop dead name→key entries too, or churn (create/delete
+                # at launch-burst scale) grows the map without bound
+                for nk in [n for n, k in keystr.items() if k not in live]:
+                    del keystr[nk]
+            self._sorted = None
+        return self._ordered()
+
+    def _ordered(self) -> list[_NbView]:
+        if self._sorted is None:
+            self._sorted = sorted(
+                self.views.values(), key=lambda v: v.key
+            )
+        return self._sorted
+
+    def store(self, nb: dict) -> _NbView:
+        """Fold one fresh body in (from the index diff or from a write's
+        returned object), re-deriving every parsed field. The view object
+        is identity-stable per key so in-flight cycle state stays attached."""
+        key = _nb_key(nb)
+        view = self.views.get(key)
+        if view is None:
+            view = _NbView()
+            view.key = key
+            view.conds_sig = None
+            view.conds_rv = None
+            self.views[key] = view
+            self._sorted = None
+        view.admission = None
+        view.adm_rv = None
+        view.adm_sig = None
+        view.nb = nb
+        view.rv = (nb.get("metadata") or {}).get("resourceVersion", "")
+        try:
+            view.topo = api.notebook_topology(nb)
+            view.num_slices = api.notebook_num_slices(nb)
+        except ValueError:
+            view.topo = None  # malformed spec.tpu: not a gang
+            view.num_slices = 1
+        view.placement = placement_of(nb)
+        view.priority = gang_priority(nb)
+        view.request = None
+        return view
 
 
 def _nb_key(nb: dict) -> str:

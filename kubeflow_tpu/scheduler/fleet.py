@@ -9,16 +9,27 @@ cells for unavailable hosts); the free set is always derived from it
 ``Fleet`` aggregates pools from live Node objects and carries the gang
 operations the scheduler controller uses: all-or-nothing trial placement of
 a multi-slice gang, occupancy replay from committed placement annotations,
-and the accounting the metrics layer scrapes. The fleet is rebuilt from the
-cluster every scheduling cycle — the annotation set IS the store of record,
-which is what makes crash-restart between bind writes safe: a restarted
-scheduler replays committed placements before computing new ones.
+and the accounting the metrics layer scrapes. The annotation set IS the
+store of record, which is what makes crash-restart between bind writes
+safe: a restarted scheduler replays committed placements before computing
+new ones.
+
+``FleetModel`` is the incremental fast path over that contract: a fleet
+carried *across* scheduling cycles. Node changes rebuild only the pool they
+touch (per-pool fingerprints), committed placements are applied/released as
+carve/coalesce deltas against each pool's persistent free decomposition
+(``binpack.FreeSet``), and every event that can turn a failed fit into a
+successful one — a release, a drain-undo, a capacity grant — bumps the
+pool's ``epoch``, the negative-fit cache's invalidation token. Correctness
+still rests on the from-scratch semantics: a fresh incarnation rebuilds
+everything, and the soak differentially audits the incremental model
+against ``Fleet.from_nodes`` + full replay every cycle.
 """
 from __future__ import annotations
 
 import math
 import re
-from typing import Iterable, Mapping, Sequence
+from typing import Iterable, Mapping, MutableMapping, Sequence
 
 from kubeflow_tpu.scheduler import HOST_INDEX_LABEL, POOL_LABEL
 from kubeflow_tpu.scheduler import binpack
@@ -79,7 +90,17 @@ class Pool:
         # host ordinal -> node name, C-order over the block grid (matches
         # add_tpu_node_pool's per-host fan-out and GKE's worker numbering)
         self.nodes: dict[int, str] = {}
+        # the used map and the free decomposition move in lockstep: mutate
+        # only through occupy()/free()/block_host()/clear_used(), never the
+        # dict directly (the FreeSet would silently drift)
         self.used: dict[str, Cuboid] = {}
+        self.free_space = binpack.FreeSet(self.grid)
+        # Invalidation token for the negative-fit cache: bumped by every
+        # event that can turn "doesn't fit" into "fits" — a release, a
+        # rebuild after node changes (FleetModel keeps it monotonic across
+        # rebuilds). Carves never bump it: shrinking free space cannot
+        # un-prove a failed fit.
+        self.epoch = 0
 
     # ------------------------------------------------------------- geometry
 
@@ -106,9 +127,12 @@ class Pool:
 
     def block_host(self, index: int) -> None:
         """Mark one host cell unusable (drained / cordoned / NotReady)."""
-        self.used[f"{_BLOCKED_PREFIX}{index}"] = Cuboid(
-            self._coord(index), (1,) * len(self.grid)
-        )
+        key = f"{_BLOCKED_PREFIX}{index}"
+        if key in self.used:
+            return
+        cub = Cuboid(self._coord(index), (1,) * len(self.grid))
+        self.used[key] = cub
+        self.free_space.carve(cub)
 
     def missing_hosts(self) -> None:
         """Block every host cell with no backing Node (capacity flap: the
@@ -128,21 +152,33 @@ class Pool:
     def place(
         self, topo: SliceTopology
     ) -> tuple[Cuboid, tuple[int, ...]] | None:
-        return binpack.best_fit(
-            self.grid, self.used.values(), self.accel, topo.shape
-        )
+        return binpack.best_fit_free(self.free_space, self.accel, topo.shape)
 
     def occupy(self, key: str, block_cuboid: Cuboid) -> bool:
-        """Commit (or replay) an allocation; False if invalid/conflicting."""
-        if not block_cuboid.within(self.grid):
+        """Commit (or replay) an allocation; False if invalid/conflicting.
+        Conflict detection is O(request cells): free = grid minus used, so
+        "every requested cell is free" is exactly "overlaps nothing"."""
+        if key in self.used or not block_cuboid.within(self.grid):
             return False
-        if any(block_cuboid.overlaps(c) for c in self.used.values()):
+        free_cells = self.free_space.cells
+        if any(c not in free_cells for c in block_cuboid.cells()):
             return False
         self.used[key] = block_cuboid
+        self.free_space.carve(block_cuboid)
         return True
 
     def free(self, key: str) -> None:
-        self.used.pop(key, None)
+        cub = self.used.pop(key, None)
+        if cub is not None:
+            self.free_space.release(cub)
+            self.epoch += 1
+
+    def clear_used(self) -> None:
+        """Drop every occupant and blocked cell (audit helper: judge
+        geometry against a fully healthy, empty pool)."""
+        self.used.clear()
+        self.free_space = binpack.FreeSet(self.grid)
+        self.epoch += 1
 
     def gang_keys(self) -> list[str]:
         return [k for k in self.used if not k.startswith(_BLOCKED_PREFIX)]
@@ -158,18 +194,91 @@ class Pool:
         return self.accel.chips_per_host
 
     def used_chips(self) -> int:
-        return sum(
-            c.volume * self.chips_per_block for c in self.used.values()
-        )
+        # free cells are tracked, so occupancy is O(1) per query
+        return (self.num_hosts - len(self.free_space.cells)) * self.chips_per_block
 
     def free_chips(self) -> int:
         return self.total_chips - self.used_chips()
 
+    def free_cells(self) -> int:
+        return len(self.free_space.cells)
+
     def clone(self) -> "Pool":
-        out = Pool(self.name, self.accel, self.chip_shape, labeled=self.labeled)
+        out = Pool.__new__(Pool)
+        out.name = self.name
+        out.accel = self.accel
+        out.labeled = self.labeled
+        out.chip_shape = self.chip_shape
+        out.grid = self.grid
+        out.num_hosts = self.num_hosts
         out.nodes = dict(self.nodes)
         out.used = dict(self.used)  # Cuboids are frozen; shallow is enough
+        out.free_space = self.free_space.clone()
+        out.epoch = self.epoch
         return out
+
+
+# One TPU node flattened into the fields the pool model is a function of:
+# (accel name, topology label, labeled, host index, node name, available).
+# A pool's node-entry list IS its fingerprint — two node snapshots yielding
+# equal entry lists build equal pools, which is what lets FleetModel skip
+# rebuilding untouched pools.
+_NodeEntry = tuple[str, str, bool, int | None, str, bool]
+
+
+def group_tpu_nodes(
+    nodes: Iterable[Mapping],
+) -> dict[str, list[_NodeEntry]]:
+    """Group Node objects into per-pool entry lists, preserving iteration
+    order (first node wins the pool's shape, as in ``Fleet.from_nodes``).
+    Nodes without the TPU labels are not TPU hosts and are ignored."""
+    groups: dict[str, list[_NodeEntry]] = {}
+    for node in nodes:
+        labels = node.get("metadata", {}).get("labels", {}) or {}
+        gke_accel = labels.get("cloud.google.com/gke-tpu-accelerator")
+        topology = labels.get("cloud.google.com/gke-tpu-topology")
+        if not gke_accel or not topology:
+            continue
+        accel = next(
+            (a for a in ACCELERATORS.values()
+             if a.gke_accelerator == gke_accel),
+            None,
+        )
+        if accel is None:
+            continue
+        labeled = POOL_LABEL in labels
+        pool_name = labels.get(POOL_LABEL) or f"{accel.name}-{topology}"
+        groups.setdefault(pool_name, []).append((
+            accel.name,
+            topology,
+            labeled,
+            _host_index(node),
+            node.get("metadata", {}).get("name", ""),
+            node_is_available(node),
+        ))
+    return groups
+
+
+def build_pool(name: str, entries: Sequence[_NodeEntry]) -> Pool | None:
+    """One pool from its node entries: the first entry whose topology
+    parses defines the torus (a mislabeled straggler cannot corrupt the
+    whole pool); hosts without a backing node end up blocked."""
+    pool: Pool | None = None
+    for accel_name, topology, labeled, idx, node_name, available in entries:
+        if pool is None:
+            try:
+                topo = parse_topology(accel_name, topology)
+            except ValueError:
+                continue
+            pool = Pool(
+                name, ACCELERATORS[accel_name], topo.shape, labeled=labeled
+            )
+        if idx is None:
+            continue
+        pool.add_host(idx, node_name, available)
+    if pool is not None:
+        pool.missing_hosts()
+    return pool
 
 
 class Fleet:
@@ -180,43 +289,14 @@ class Fleet:
 
     @classmethod
     def from_nodes(cls, nodes: Iterable[Mapping]) -> "Fleet":
-        """Build the capacity model from live Node objects. Nodes without
-        the TPU topology labels are not TPU hosts and are ignored; a pool's
-        torus shape must be consistent across its nodes (first node wins —
-        a mislabeled straggler cannot corrupt the whole pool)."""
+        """Build the capacity model from live Node objects — the from-
+        scratch reference path (fresh incarnations, audits, trials);
+        :class:`FleetModel` maintains the same state incrementally."""
         fleet = cls()
-        for node in nodes:
-            labels = node.get("metadata", {}).get("labels", {}) or {}
-            gke_accel = labels.get("cloud.google.com/gke-tpu-accelerator")
-            topology = labels.get("cloud.google.com/gke-tpu-topology")
-            if not gke_accel or not topology:
-                continue
-            accel = next(
-                (a for a in ACCELERATORS.values()
-                 if a.gke_accelerator == gke_accel),
-                None,
-            )
-            if accel is None:
-                continue
-            labeled = POOL_LABEL in labels
-            pool_name = labels.get(POOL_LABEL) or f"{accel.name}-{topology}"
-            pool = fleet.pools.get(pool_name)
-            if pool is None:
-                try:
-                    topo = parse_topology(accel.name, topology)
-                except ValueError:
-                    continue
-                pool = Pool(pool_name, accel, topo.shape, labeled=labeled)
+        for pool_name, entries in group_tpu_nodes(nodes).items():
+            pool = build_pool(pool_name, entries)
+            if pool is not None:
                 fleet.pools[pool_name] = pool
-            idx = _host_index(node)
-            if idx is None:
-                continue
-            pool.add_host(
-                idx, node.get("metadata", {}).get("name", ""),
-                node_is_available(node),
-            )
-        for pool in fleet.pools.values():
-            pool.missing_hosts()
         return fleet
 
     def clone(self) -> "Fleet":
@@ -225,7 +305,12 @@ class Fleet:
     # ------------------------------------------------------ gang operations
 
     def place_gang(
-        self, key: str, topo: SliceTopology, num_slices: int = 1
+        self,
+        key: str,
+        topo: SliceTopology,
+        num_slices: int = 1,
+        *,
+        fit_cache: "FitCache | None" = None,
     ) -> list[dict] | None:
         """All-or-nothing placement of every slice of a gang.
 
@@ -233,16 +318,29 @@ class Fleet:
         may land in different pools); each takes the best-fit across all
         pools. Commits into this fleet on success; on any slice missing,
         rolls back and returns None.
+
+        ``fit_cache`` (controller-owned) skips pools whose current epoch
+        already proved this shape unplaceable. New negatives are recorded
+        only against pools untouched by this gang's own trial carves — a
+        rollback restores their space without an epoch bump, so a negative
+        observed mid-trial could go stale. Preemption trials run on clones
+        and pass no cache: victim space is not free space.
         """
         committed: list[tuple[Pool, str]] = []
         slices: list[dict] = []
+        trial_pools: set[str] = set()
+        pools = sorted(self.pools.values(), key=lambda p: p.name)
         for j in range(num_slices):
             best: tuple[tuple[int, str], Pool, Cuboid, tuple[int, ...]] | None = None
-            for pool in sorted(self.pools.values(), key=lambda p: p.name):
+            for pool in pools:
                 if pool.accel.name != topo.accelerator.name:
+                    continue
+                if fit_cache is not None and fit_cache.hit(pool, topo):
                     continue
                 fit = pool.place(topo)
                 if fit is None:
+                    if fit_cache is not None and pool.name not in trial_pools:
+                        fit_cache.record_miss(pool, topo)
                     continue
                 block_cuboid, chips = fit
                 # tightest pool first: least free chips remaining after the
@@ -258,6 +356,7 @@ class Fleet:
             slice_key = f"{key}/s{j}"
             pool.occupy(slice_key, block_cuboid)
             committed.append((pool, slice_key))
+            trial_pools.add(pool.name)
             slices.append(
                 {
                     "pool": pool.name,
@@ -342,6 +441,24 @@ class Fleet:
         total = self.total_chips()
         return (self.used_chips() / total) if total else 0.0
 
+    def accel_free_cells(self, accel_name: str) -> int:
+        """Free host cells across an accelerator's pools — zero means the
+        schedule loop can stop attempting fits for that accelerator
+        entirely (saturation short-circuit)."""
+        return sum(
+            p.free_cells()
+            for p in self.pools.values()
+            if p.accel.name == accel_name
+        )
+
+    def geometry_signature(self) -> tuple:
+        """Hashable summary of what exists (not what's occupied): the
+        feasibility cache is valid exactly while this is unchanged."""
+        return tuple(sorted(
+            (p.name, p.accel.name, p.chip_shape)
+            for p in self.pools.values()
+        ))
+
     def assert_no_overlap(self) -> list[str]:
         """Double-booking audit over the in-memory model (the soak audits
         the cluster-state analog from annotations). Empty == healthy."""
@@ -356,4 +473,186 @@ class Fleet:
                         out.append(
                             f"{pool.name}: {ka} overlaps {kb} ({ca} vs {cb})"
                         )
+        return out
+
+
+class FitCache:
+    """Negative-fit cache: (pool, oriented shape) → the pool epoch at which
+    the shape was proven unplaceable.
+
+    A hit is valid exactly while the pool's epoch is unchanged — carves
+    only shrink free space (negatives stay proven), while every release,
+    drain-undo, or capacity rebuild bumps the epoch and un-sticks every
+    cached verdict for that pool in one comparison. The key uses the
+    *sorted* chip shape: orientations are axis permutations, so rotation-
+    equivalent requests share one verdict. Cache state is advisory only —
+    a fresh scheduler incarnation starts empty and merely re-proves.
+    """
+
+    __slots__ = ("entries", "hits", "misses")
+
+    def __init__(self) -> None:
+        self.entries: MutableMapping[tuple, int] = {}
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _key(pool: Pool, topo: SliceTopology) -> tuple:
+        return (pool.name, topo.accelerator.name, tuple(sorted(topo.shape)))
+
+    def hit(self, pool: Pool, topo: SliceTopology) -> bool:
+        if self.entries.get(self._key(pool, topo)) == pool.epoch:
+            self.hits += 1
+            return True
+        return False
+
+    def record_miss(self, pool: Pool, topo: SliceTopology) -> None:
+        self.misses += 1
+        self.entries[self._key(pool, topo)] = pool.epoch
+
+
+class FleetModel:
+    """The fleet carried across scheduling cycles.
+
+    Holds the live :class:`Fleet` plus the bookkeeping that makes cycle
+    cost proportional to the delta: per-pool node fingerprints (a node
+    add/drain/label change rebuilds only its pool) and the applied-
+    placement map (committed placements are applied/released as carve/
+    coalesce deltas instead of replayed from scratch). ``audit`` is the
+    differential check the soak runs every cycle: the incremental state
+    must equal a from-scratch ``Fleet.from_nodes`` + full replay, and each
+    pool's maintained free decomposition must equal ``decompose_free`` of
+    its used set.
+    """
+
+    def __init__(self) -> None:
+        self.fleet = Fleet()
+        self.applied: dict[str, list[dict]] = {}
+        self._fingerprints: dict[str, tuple] = {}
+        # epochs survive pool rebuilds (and deletions) so a rebuilt pool
+        # can never alias a stale negative-fit entry
+        self._epochs: dict[str, int] = {}
+
+    # ------------------------------------------------------------- node side
+
+    def refresh_nodes(self, nodes: Iterable[Mapping]) -> bool:
+        """Fold a Node snapshot in; returns True if any pool changed.
+        Unchanged pools (equal node-entry fingerprint) keep their object,
+        their applied carves, and their epoch untouched."""
+        groups = group_tpu_nodes(nodes)
+        changed = False
+        for name in list(self._fingerprints):
+            if name not in groups:
+                self._drop_pool(name)
+                changed = True
+        for name, entries in groups.items():
+            fp = tuple(entries)
+            if self._fingerprints.get(name) == fp:
+                continue
+            changed = True
+            self._drop_pool(name)
+            self._fingerprints[name] = fp
+            pool = build_pool(name, entries)
+            if pool is None:
+                continue
+            # a rebuild may have healed capacity (undrain, node back):
+            # the epoch bump is what un-sticks cached negative verdicts
+            epoch = self._epochs.get(name, -1) + 1
+            self._epochs[name] = epoch
+            pool.epoch = epoch
+            self.fleet.pools[name] = pool
+        return changed
+
+    def _drop_pool(self, name: str) -> None:
+        self._fingerprints.pop(name, None)
+        pool = self.fleet.pools.pop(name, None)
+        if pool is None:
+            return
+        self._epochs[name] = max(self._epochs.get(name, -1), pool.epoch)
+        # gangs with a slice here lose their whole application (their
+        # carves died with the pool object); the placement diff re-applies
+        # or unbinds them against the rebuilt geometry
+        for key in [
+            k for k, slices in self.applied.items()
+            if any(s.get("pool") == name for s in slices)
+        ]:
+            self.release(key)
+
+    # -------------------------------------------------------- placement side
+
+    def apply(self, key: str, slices: list[dict]) -> bool:
+        ok = self.fleet.occupy_gang(key, slices)
+        if ok:
+            self.applied[key] = slices
+        return ok
+
+    def release(self, key: str) -> None:
+        self.fleet.free_gang(key)
+        self.applied.pop(key, None)
+
+    def sync_placements(
+        self, desired: Mapping[str, list[dict]]
+    ) -> list[str]:
+        """Diff the applied set to ``desired`` (an ordered mapping — apply
+        order is the caller's deterministic replay order). Releases run
+        first so re-applies land in freed space. Returns the keys whose
+        apply failed (capacity gone: drained/blocked/overlapping)."""
+        for key in [
+            k for k, s in list(self.applied.items())
+            if desired.get(k) != s
+        ]:
+            self.release(key)
+        failed = []
+        for key, slices in desired.items():
+            if key in self.applied:
+                continue
+            if not self.apply(key, slices):
+                failed.append(key)
+        return failed
+
+    # ------------------------------------------------------------- the audit
+
+    def audit(self, nodes: Iterable[Mapping]) -> list[str]:
+        """Differential audit: incremental model == from-scratch rebuild.
+
+        Rebuilds the fleet from the same Node snapshot, replays every
+        applied placement, and compares pool-for-pool: geometry, the used
+        map, the free-cell set, and the canonical free decomposition
+        (which also cross-checks every pool's FreeSet against
+        ``decompose_free`` from scratch). Empty == healthy.
+        """
+        out: list[str] = []
+        scratch = Fleet.from_nodes(nodes)
+        for key in sorted(self.applied):
+            if not scratch.occupy_gang(key, self.applied[key]):
+                out.append(
+                    f"differential: {key} applied incrementally but "
+                    f"rejected by from-scratch replay"
+                )
+        live, ref = self.fleet.pools, scratch.pools
+        if set(live) != set(ref):
+            out.append(
+                f"differential: pool sets differ "
+                f"(incremental {sorted(live)} vs scratch {sorted(ref)})"
+            )
+        for name in sorted(set(live) & set(ref)):
+            p, s = live[name], ref[name]
+            if (p.grid, p.chip_shape, p.accel.name, p.labeled, p.nodes) != (
+                s.grid, s.chip_shape, s.accel.name, s.labeled, s.nodes
+            ):
+                out.append(f"differential: pool {name} geometry drifted")
+                continue
+            if p.used != s.used:
+                out.append(
+                    f"differential: pool {name} used sets differ "
+                    f"({sorted(p.used)} vs {sorted(s.used)})"
+                )
+            if p.free_space.cells != s.free_space.cells:
+                out.append(f"differential: pool {name} free cells drifted")
+            canonical = binpack.decompose_free(p.grid, p.used.values())
+            if p.free_space.cuboids != canonical:
+                out.append(
+                    f"differential: pool {name} incremental free "
+                    f"decomposition != decompose_free from scratch"
+                )
         return out

@@ -65,10 +65,13 @@ def select_victims(
     Pure trial: simulates on a clone, never mutates ``fleet`` — the caller
     commits evictions through the cluster (annotation removal) so a crash
     between evict and bind leaves only re-queued victims, never a
-    double-booking. Candidates are scoped to the head's accelerator:
-    evicting a gang whose chips the head cannot use frees nothing for it
-    (the greedy prefix would evict junior cross-accel gangs pointlessly
-    before reaching a victim that matters).
+    double-booking. The clone also means the trial is blind to the
+    controller's negative-fit cache by construction: victim space is not
+    free space, so a cached "doesn't fit" verdict must never veto an
+    eviction that would make the head fit. Candidates are scoped to the
+    head's accelerator: evicting a gang whose chips the head cannot use
+    frees nothing for it (the greedy prefix would evict junior cross-accel
+    gangs pointlessly before reaching a victim that matters).
     """
     accel = head.topo.accelerator.name
     candidates = sorted(

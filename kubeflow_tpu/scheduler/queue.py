@@ -75,7 +75,12 @@ class GangQueue:
         """Scheduling order: effective priority desc, then FIFO
         (queued_at asc), then key — a total, deterministic order. The
         1-based positions the spawner UI shows are this list's indices
-        (the controller derives them all in one pass per cycle)."""
+        (the controller derives them all in one pass per cycle). For gangs
+        admitted in the past (the only kind the controller stamps) the
+        order is time-invariant — the boost difference between two waiters
+        is a constant — so one sort per cycle is the whole ordering cost;
+        the ``max(0, ...)`` clamp only matters for future-dated admission
+        times, where a fresh arrival must not carry a negative boost."""
         return sorted(
             self._gangs.values(),
             key=lambda r: (

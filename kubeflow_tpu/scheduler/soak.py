@@ -109,7 +109,7 @@ def _healthy_fleet(base: FakeCluster) -> Fleet:
     its gang was bound to; it does not hand them to a second gang)."""
     fleet = Fleet.from_nodes(base.list("Node"))
     for pool in fleet.pools.values():
-        pool.used.clear()  # drop blocked cells: gang-vs-gang only
+        pool.clear_used()  # drop blocked cells: gang-vs-gang only
     return fleet
 
 
@@ -565,6 +565,13 @@ def run_sched_seed(
     # timeline recorder itself is stateless — marks live on the CRs
     slo = SLOMetrics(clock=clock)
 
+    # Differential-audit sink shared across scheduler incarnations: every
+    # cycle of every incarnation cross-checks the incremental fleet model
+    # (persistent pools, carve/release deltas, notebook rv-cache) against a
+    # from-scratch rebuild + full replay. One surviving mismatch anywhere
+    # in the hostile timeline fails the seed.
+    diff_failures: list[str] = []
+
     def build() -> Manager:
         m = Manager(cluster, clock=clock, tracer=tracer)
         m.register(
@@ -574,15 +581,17 @@ def run_sched_seed(
             )
         )
         # a crash-restart loses every bit of in-memory scheduler state —
-        # a fresh reconciler instance models exactly that
-        m.register(
-            SchedulerReconciler(
-                metrics=metrics,
-                recorder=EventRecorder(clock=clock),
-                clock=clock,
-                aging_interval_s=SOAK_AGING_INTERVAL_S,
-            )
+        # a fresh reconciler instance models exactly that (the incremental
+        # model, fit cache, and notebook cache all start cold)
+        sched_rec = SchedulerReconciler(
+            metrics=metrics,
+            recorder=EventRecorder(clock=clock),
+            clock=clock,
+            aging_interval_s=SOAK_AGING_INTERVAL_S,
+            differential_audit=True,
         )
+        sched_rec.audit_failures = diff_failures
+        m.register(sched_rec)
         return m
 
     scenario.setup(base)
@@ -663,6 +672,8 @@ def run_sched_seed(
         )
     )
     violations.extend(audit_fixed_point(base, clock()))
+    # incremental-vs-from-scratch model divergence anywhere in the run
+    violations.extend(diff_failures)
     # causality + event-storm audits (obs/): every write attributable to a
     # reconcile span; Event dedup bounded under crash-restart loops
     violations.extend(tracer.audit())

@@ -443,6 +443,9 @@ def run_session_seed(
     # timeline recorder itself is stateless — marks live on the CRs
     slo = SLOMetrics(clock=clock)
 
+    # shared across scheduler incarnations (crash-restarts)
+    sched_diff_failures: list[str] = []
+
     def build() -> Manager:
         m = Manager(cluster, clock=clock, tracer=tracer)
         m.register(
@@ -451,15 +454,19 @@ def run_session_seed(
                 timeline=TimelineRecorder(slo=slo, clock=clock),
             )
         )
-        m.register(
-            SchedulerReconciler(
-                metrics=sched_metrics,
-                recorder=EventRecorder(clock=clock),
-                clock=clock,
-                aging_interval_s=SOAK_AGING_INTERVAL_S,
-                suspend_deadline_s=SOAK_SUSPEND_DEADLINE_S,
-            )
+        # differential audit on: the suspend-barrier churn (handoffs,
+        # releases, re-binds) is exactly the carve/release traffic the
+        # incremental fleet model must survive without drifting
+        sched_rec = SchedulerReconciler(
+            metrics=sched_metrics,
+            recorder=EventRecorder(clock=clock),
+            clock=clock,
+            aging_interval_s=SOAK_AGING_INTERVAL_S,
+            suspend_deadline_s=SOAK_SUSPEND_DEADLINE_S,
+            differential_audit=True,
         )
+        sched_rec.audit_failures = sched_diff_failures
+        m.register(sched_rec)
         m.register(
             SessionReconciler(
                 store, agent,
@@ -561,6 +568,8 @@ def run_session_seed(
     violations.extend(
         audit_sessions_fixed_point(base, store, agent, clock())
     )
+    # incremental-vs-from-scratch scheduler model divergence anywhere
+    violations.extend(sched_diff_failures)
     violations.extend(tracer.audit())
     violations.extend(audit_events(base, where="final"))
     # timeline audit: suspend/resume cycles must still leave every gang's

@@ -363,6 +363,12 @@ class ChaosCluster:
         self._maybe_fault("list", write=False)
         return self.inner.list(kind, namespace, selector)
 
+    def resource_versions(self, kind, namespace=None):
+        # the informer-cache poll is a read like any other: the scheduler's
+        # incremental fast path must survive it failing mid-cycle
+        self._maybe_fault("resource_versions", write=False)
+        return self.inner.resource_versions(kind, namespace)
+
     def events_for(self, involved):
         self._maybe_fault("events_for", write=False)
         return self.inner.events_for(involved)

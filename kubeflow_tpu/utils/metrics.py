@@ -474,6 +474,9 @@ class SchedulerMetrics:
     # queue waits span seconds (idle fleet) to hours (saturated fleet)
     BIND_BUCKETS = (1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 3600.0, 14400.0)
     CYCLE_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+    # phases are sub-cycle: an incremental steady-state phase is sub-ms,
+    # a cold full rebuild can take the whole cycle budget
+    PHASE_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
 
     def __init__(self, registry: Registry | None = None) -> None:
         self.registry = registry or Registry()
@@ -517,6 +520,23 @@ class SchedulerMetrics:
             "Wall time of one full scheduling pass",
             buckets=self.CYCLE_BUCKETS,
         )
+        # phase-attributed cycle cost (docs/scheduler.md fast path): which
+        # of list/replay/pack/write eats the cycle is what distinguishes
+        # "the apiserver is slow" from "the packing is slow"
+        self.cycle_phase = self.registry.histogram(
+            "scheduler_cycle_phase_seconds",
+            "Wall time of one scheduling-cycle phase (list/replay/pack/write)",
+            labelnames=("phase",),
+            buckets=self.PHASE_BUCKETS,
+        )
+        self.fit_cache_hits = self.registry.counter(
+            "scheduler_fit_cache_hits_total",
+            "Fit attempts skipped by the negative-fit cache",
+        )
+        self.fit_cache_misses = self.registry.counter(
+            "scheduler_fit_cache_misses_total",
+            "Failed fit attempts recorded into the negative-fit cache",
+        )
 
     def observe_cycle(
         self,
@@ -525,6 +545,7 @@ class SchedulerMetrics:
         queue_depth: int,
         unschedulable: int,
         duration_s: float | None = None,
+        phases: Mapping[str, float] | None = None,
     ) -> None:
         self.cycles.inc()
         self.queue_depth.set(queue_depth)
@@ -534,6 +555,15 @@ class SchedulerMetrics:
         self.utilization.set(fleet.utilization())
         if duration_s is not None:
             self.cycle_duration.observe(duration_s)
+        for phase, seconds in (phases or {}).items():
+            self.cycle_phase.observe(seconds, phase=phase)
+
+    def observe_fit_cache(self, hits: int, misses: int) -> None:
+        """Per-cycle deltas from the controller's FitCache."""
+        if hits:
+            self.fit_cache_hits.inc(hits)
+        if misses:
+            self.fit_cache_misses.inc(misses)
 
     def observe_bind(self, seconds: float) -> None:
         self.binds.inc()

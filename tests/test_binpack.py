@@ -220,6 +220,162 @@ class TestRandomStreams:
             assert _no_overlaps(pool)
 
 
+class TestFreeSetIncremental:
+    """The incremental fast path's geometric contract: after ANY sequence
+    of carves and releases, the maintained decomposition is cell-for-cell
+    the canonical one — ``decompose_free`` recomputed from scratch — and
+    best-fit answers (including the exhaustive L-shaped-region fallback)
+    are identical through either path."""
+
+    _GRIDS = [(2, 2, 4), (2, 2, 8), (4, 4), (2, 3), (3, 3, 3)]
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_random_carve_release_matches_scratch(self, seed):
+        rng = random.Random(f"freeset-{seed}")
+        grid = self._GRIDS[seed % len(self._GRIDS)]
+        fs = binpack.FreeSet(grid)
+        used: dict[int, Cuboid] = {}
+        counter = 0
+        for step in range(80):
+            if used and rng.random() < 0.45:
+                key = sorted(used)[rng.randrange(len(used))]
+                fs.release(used.pop(key))
+            else:
+                placed = False
+                for _ in range(8):  # rejection-sample a fully-free box
+                    shape = tuple(rng.randint(1, g) for g in grid)
+                    offset = tuple(
+                        rng.randint(0, g - s) for g, s in zip(grid, shape)
+                    )
+                    box = Cuboid(offset, shape)
+                    if all(c in fs.cells for c in box.cells()):
+                        fs.carve(box)
+                        used[counter] = box
+                        counter += 1
+                        placed = True
+                        break
+                if not placed:
+                    continue
+            # cell-for-cell equality with the from-scratch decomposition
+            assert fs.cuboids == binpack.decompose_free(
+                grid, used.values()
+            ), f"decomposition drifted at step {step}"
+            scratch_free = set(
+                itertools.product(*(range(g) for g in grid))
+            )
+            for c in used.values():
+                scratch_free -= set(c.cells())
+            assert fs.cells == scratch_free
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_best_fit_parity_through_either_path(self, seed):
+        """best_fit over a carved/released FreeSet must answer exactly as
+        best_fit recomputed from the used set — for every request shape,
+        including ones only the exhaustive scan fallback can place."""
+        rng = random.Random(f"fitparity-{seed}")
+        accel_name, pool_topo, requests = _CASES[seed % len(_CASES)]
+        topo = parse_topology(accel_name, pool_topo)
+        grid = ceil_div_shape(topo.shape, topo.accelerator.host_block)
+        fs = binpack.FreeSet(grid)
+        used: dict[int, Cuboid] = {}
+        counter = 0
+        for _ in range(60):
+            if used and rng.random() < 0.4:
+                key = sorted(used)[rng.randrange(len(used))]
+                fs.release(used.pop(key))
+            else:
+                shape = tuple(rng.randint(1, g) for g in grid)
+                offset = tuple(
+                    rng.randint(0, g - s) for g, s in zip(grid, shape)
+                )
+                box = Cuboid(offset, shape)
+                if all(c in fs.cells for c in box.cells()):
+                    fs.carve(box)
+                    used[counter] = box
+                    counter += 1
+            for req in requests:
+                chip_shape = parse_topology(accel_name, req).shape
+                assert binpack.best_fit_free(
+                    fs, topo.accelerator, chip_shape
+                ) == binpack.best_fit(
+                    grid, used.values(), topo.accelerator, chip_shape
+                )
+
+    def test_l_shaped_region_fallback_after_carve_release(self):
+        """The L-shaped split the greedy decomposition cannot express: the
+        scan fallback must still find the placement when the free region
+        was produced incrementally (carves + releases), not from scratch."""
+        # v5e 4x6 chips -> 2x3 host cells; carve the corner so the free
+        # region is an L the greedy sweep splits across cuboid boundaries
+        grid = (2, 3)
+        fs = binpack.FreeSet(grid)
+        corner = Cuboid((0, 0), (1, 1))
+        fs.carve(corner)
+        assert fs.cuboids == binpack.decompose_free(grid, [corner])
+        assert len(fs.cuboids) >= 2  # the region really was split
+        # a 4x4-chip request (2x1 host column) spans both greedy cuboids:
+        # only the exhaustive fallback can place it
+        fit = binpack.best_fit_free(fs, V5E, (4, 4))
+        assert fit is not None
+        block, _ = fit
+        assert not block.overlaps(corner) and block.within(grid)
+        # release the corner: the decomposition coalesces back to one box
+        fs.release(corner)
+        assert fs.cuboids == [Cuboid((0, 0), grid)]
+
+    def test_pool_free_space_tracks_occupancy(self):
+        """The Pool surface keeps used/free in lockstep through
+        occupy/free — and a full free() round-trip coalesces exactly."""
+        pool = _pool("v4", "2x2x4")
+        topo = parse_topology("v4", "2x2x2")
+        fit = pool.place(topo)
+        assert fit is not None
+        assert pool.occupy("g0", fit[0])
+        assert pool.free_space.cuboids == binpack.decompose_free(
+            pool.grid, pool.used.values()
+        )
+        epoch_before = pool.epoch
+        pool.free("g0")
+        assert pool.epoch > epoch_before  # releases un-stick cached fits
+        assert pool.free_space.cuboids == [
+            Cuboid((0,) * len(pool.grid), pool.grid)
+        ]
+
+
+class TestOrientationsMemo:
+    def test_cached_and_uncached_identical(self):
+        """The memoized orientations must equal a fresh computation for
+        every case — including the axis-mapping filter (rotations that do
+        not tile host blocks are dropped unless whitelisted as single-host
+        sub-blocks)."""
+        cases = [
+            (V4, (2, 2, 4)),   # asymmetric: some rotations don't tile 2x2x1
+            (V4, (4, 4, 4)),   # symmetric: one orientation
+            (V4, (8, 2, 2)),   # rotation required on long pools
+            (V5E, (1, 1)),     # single-host sub-block whitelist
+            (V5E, (2, 2)),     # single-host sub-block whitelist
+            (V5E, (4, 8)),     # 2-d tiling filter
+            (V5E, (2, 4)),
+        ]
+        for accel, shape in cases:
+            fresh = binpack._orientations_uncached(accel, tuple(shape))
+            assert binpack.orientations(accel, shape) == fresh, (
+                accel.name, shape)
+            # second call returns the cached object with identical content
+            assert binpack.orientations(accel, list(shape)) == fresh
+
+    def test_axis_mapping_filter_survives_caching(self):
+        # v4 host block is 2x2x1: the (1, 2, ...) style rotations of an
+        # asymmetric shape must stay filtered on every (cached) call
+        for _ in range(3):
+            opts = binpack.orientations(V4, (2, 2, 4))
+            for chips, blocks in opts:
+                assert all(
+                    d % b == 0 for d, b in zip(chips, V4.host_block)
+                ) or chips in V4.supports_single_host_sub_blocks
+                assert blocks == ceil_div_shape(chips, V4.host_block)
+
+
 class TestFleetGangOps:
     def _fleet(self) -> Fleet:
         return Fleet({

@@ -570,6 +570,186 @@ class TestSchedulerReconciler:
         assert metrics.cycles.get() > 0
         exposition = metrics.registry.expose()
         assert "scheduler_queue_depth 1" in exposition
+        # per-phase cycle cost is attributable from the exposition alone
+        for phase in ("list", "replay", "pack", "write"):
+            assert (
+                f'scheduler_cycle_phase_seconds_count{{phase="{phase}"}}'
+                in exposition
+            ), f"missing cycle-phase histogram for {phase!r}"
+        assert "scheduler_fit_cache_hits_total" in exposition
+
+
+class TestFitCacheInvalidation:
+    """The negative-fit cache must never serve a stale "doesn't fit":
+    every capacity-returning event — a release, a drain-undo, a capacity
+    grant — must un-stick a previously blocked gang within ONE scheduling
+    cycle of the event, and preemption must bypass the cache entirely
+    (victim space is not free space). Cycles are driven one at a time so
+    "within one cycle" is literal, not a settle-loop accident."""
+
+    def _rec(self):
+        return SchedulerReconciler()
+
+    def _cycle(self, rec, cluster):
+        from kubeflow_tpu.scheduler.controller import FLEET_KEY
+        rec.reconcile(cluster, "", FLEET_KEY)
+
+    def _placement(self, cluster, name):
+        return sched.placement_of(cluster.get("Notebook", name, NS))
+
+    def test_release_unsticks_within_one_cycle(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")  # one gang's worth
+        rec = self._rec()
+        cluster.create(api.notebook("holder", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.create(api.notebook("waiting", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        for _ in range(3):  # extra cycles so the negative is truly cached
+            self._cycle(rec, cluster)
+        assert self._placement(cluster, "holder") is not None
+        assert self._placement(cluster, "waiting") is None
+        assert rec._fit_cache.hits > 0  # the cache is really in play
+        cluster.patch("Notebook", "holder", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        self._cycle(rec, cluster)
+        assert self._placement(cluster, "waiting") is not None
+
+    def test_drain_undo_unsticks_within_one_cycle(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        cluster.patch("Node", "tiny-0", "", {"spec": {"unschedulable": True}})
+        rec = self._rec()
+        cluster.create(api.notebook("nb", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        for _ in range(3):
+            self._cycle(rec, cluster)
+        nb = cluster.get("Notebook", "nb", NS)
+        assert sched.placement_of(nb) is None
+        assert sched.condition_is_true(nb, sched.COND_QUEUED)
+        cluster.patch("Node", "tiny-0", "", {"spec": {"unschedulable": None}})
+        self._cycle(rec, cluster)
+        assert self._placement(cluster, "nb") is not None
+
+    def test_capacity_grant_unsticks_within_one_cycle(self, cluster):
+        """The fleet-level quota bump: capacity granted as a new node pool
+        (namespace ResourceQuota is enforced at pod admission, so chips
+        arriving IS what a quota increase looks like to the scheduler)."""
+        make_pool(cluster, "v4", "2x2x2", "small")
+        rec = self._rec()
+        cluster.create(api.notebook("holder", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.create(api.notebook("waiting", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        for _ in range(3):
+            self._cycle(rec, cluster)
+        assert self._placement(cluster, "waiting") is None
+        make_pool(cluster, "v4", "2x2x2", "granted")
+        self._cycle(rec, cluster)
+        placement = self._placement(cluster, "waiting")
+        assert placement is not None
+        assert placement["slices"][0]["pool"] == "granted"
+
+    def test_preemption_bypasses_cache(self, cluster):
+        """A cached "doesn't fit in free space" verdict must never veto an
+        eviction that would make it fit: the trial simulates on a clone and
+        consults no cache."""
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        rec = self._rec()
+        cluster.create(api.notebook("victim", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        self._cycle(rec, cluster)  # victim binds the whole pool
+        cluster.create(api.notebook("urgent", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        for _ in range(3):  # equal priority, later queued: urgent blocks
+            self._cycle(rec, cluster)
+        assert self._placement(cluster, "urgent") is None
+        assert rec._fit_cache.hits > 0
+        cluster.patch("Notebook", "urgent", NS, {"metadata": {"annotations": {
+            sched.PRIORITY_ANNOTATION: "10"}}})
+        self._cycle(rec, cluster)
+        assert self._placement(cluster, "urgent") is not None
+        assert self._placement(cluster, "victim") is None
+
+
+class TestIncrementalModel:
+    """The persistent fleet model against its from-scratch reference."""
+
+    def _cycle(self, rec, cluster):
+        from kubeflow_tpu.scheduler.controller import FLEET_KEY
+        rec.reconcile(cluster, "", FLEET_KEY)
+
+    def test_differential_audit_clean_through_churn(self, cluster):
+        """Node drains/undrains/flaps, binds, stops, and spec edits — after
+        every cycle the incremental model (pool fingerprints, carve/release
+        deltas, rv-cached notebooks) must equal a from-scratch rebuild plus
+        full replay, cell for cell."""
+        rec = SchedulerReconciler(differential_audit=True)
+        make_pool(cluster, "v4", "2x2x4", "pa")
+        make_pool(cluster, "v4", "2x2x2", "pb")
+        for i in range(4):
+            cluster.create(api.notebook(f"g{i}", NS, tpu_accelerator="v4",
+                                        tpu_topology="2x2x2"))
+        self._cycle(rec, cluster)
+        cluster.patch("Node", "pa-1", "", {"spec": {"unschedulable": True}})
+        self._cycle(rec, cluster)
+        cluster.patch("Notebook", "g0", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        self._cycle(rec, cluster)
+        cluster.patch("Node", "pa-1", "", {"spec": {"unschedulable": None}})
+        cluster.patch("Notebook", "g1", NS,
+                      {"spec": {"tpu": {"topology": "2x2x1"}}})
+        self._cycle(rec, cluster)
+        cluster.delete("Node", "pb-0")
+        self._cycle(rec, cluster)
+        self._cycle(rec, cluster)
+        assert rec.audit_failures == []
+
+    def test_unchanged_pool_is_not_rebuilt(self, cluster):
+        """Node deltas rebuild only the pool they touch: the untouched
+        pool's object (and its applied carves) survives by identity."""
+        from kubeflow_tpu.scheduler.fleet import FleetModel
+        make_pool(cluster, "v4", "2x2x2", "pa")
+        make_pool(cluster, "v4", "2x2x2", "pb")
+        model = FleetModel()
+        model.refresh_nodes(cluster.list("Node"))
+        pa, pb = model.fleet.pools["pa"], model.fleet.pools["pb"]
+        cluster.patch("Node", "pa-0", "", {"spec": {"unschedulable": True}})
+        assert model.refresh_nodes(cluster.list("Node"))
+        assert model.fleet.pools["pa"] is not pa   # rebuilt
+        assert model.fleet.pools["pb"] is pb       # untouched by identity
+        assert model.fleet.pools["pa"].epoch > pa.epoch  # un-sticks fits
+        assert not model.refresh_nodes(cluster.list("Node"))  # stable
+
+    def test_notebook_cache_prunes_deleted_entries(self, cluster):
+        """Create/delete churn at launch-burst scale must not grow the
+        cache without bound — views AND the name→key map both prune."""
+        from kubeflow_tpu.scheduler.controller import _NotebookCache
+        cache = _NotebookCache()
+        for i in range(30):
+            cluster.create(api.notebook(f"g{i}", NS, tpu_accelerator="v4",
+                                        tpu_topology="2x2x2"))
+        assert len(cache.refresh(cluster)) == 30
+        for i in range(30):
+            cluster.delete("Notebook", f"g{i}", NS)
+        assert cache.refresh(cluster) == []
+        assert len(cache.views) == 0
+        assert len(cache._keystr) == 0
+
+    def test_resource_versions_index(self, cluster):
+        """The informer-cache poll the notebook cache diffs against: no
+        body copies, moves exactly with writes."""
+        cluster.create(api.notebook("a", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        cluster.create(api.notebook("b", NS, tpu_accelerator="v4",
+                                    tpu_topology="2x2x2"))
+        before = cluster.resource_versions("Notebook")
+        assert set(before) == {(NS, "a"), (NS, "b")}
+        cluster.patch("Notebook", "a", NS, {"metadata": {"annotations": {
+            "x": "y"}}})
+        after = cluster.resource_versions("Notebook")
+        assert after[(NS, "a")] != before[(NS, "a")]
+        assert after[(NS, "b")] == before[(NS, "b")]
+        cluster.delete("Notebook", "b", NS)
+        assert set(cluster.resource_versions("Notebook")) == {(NS, "a")}
 
 
 class TestSpawnerStatusText:

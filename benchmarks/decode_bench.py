@@ -187,8 +187,62 @@ def long_mode() -> None:
     }))
 
 
+def cpu_smoke() -> None:
+    """CPU-host decode number: the same generate() window-subtraction
+    methodology as main(), on a model small enough for a CPU-only driver
+    container. The absolute tok/s is NOT comparable with the TPU-recorded
+    rounds (r02-r03) — it exists so the DECODE_BENCH family carries a
+    measured, same-methodology ``value`` that FUTURE rounds on this class
+    of host gate against (tools/perf_gate.py), instead of the family going
+    silently metric-less."""
+    batch, prompt_len, new = 2, 32, 32
+    base = TransformerConfig(
+        vocab_size=1024, num_layers=2, num_heads=4, embed_dim=128,
+        mlp_dim=256, max_seq_len=256, num_kv_heads=2,
+        attention_impl="xla", dtype=jnp.float32,
+    )
+    model = TransformerLM(decode_config(base))
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, base.vocab_size, (batch, prompt_len)), jnp.int32
+    )
+    params = jax.jit(
+        lambda k: TransformerLM(base).init(k, prompt)["params"]
+    )(jax.random.PRNGKey(0))
+
+    def run(n, seed0):
+        t = time.perf_counter()
+        out = None
+        for i in range(n):
+            out = generate(
+                model, params, prompt, max_new_tokens=new,
+                temperature=0.8, top_k=40, rng=jax.random.PRNGKey(seed0 + i),
+            )
+        int(out[0, -1])  # one value fetch per window
+        return time.perf_counter() - t
+
+    run(1, 0)  # compile + warm
+    rates = []
+    for r in range(3):
+        t1 = run(1, 10 + r)
+        t3 = run(3, 20 + r)
+        rates.append(new / ((t3 - t1) / 2))
+    per_row = statistics.median(rates)
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_row",
+        "value": round(per_row, 1),
+        "unit": "tok/s/row",
+        "impl": "cpu-smoke",
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new,
+    }))
+
+
 if __name__ == "__main__":
     if "--long" in sys.argv:
         long_mode()
+    elif "--cpu-smoke" in sys.argv:
+        cpu_smoke()
     else:
         main()

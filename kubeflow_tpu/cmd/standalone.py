@@ -31,6 +31,7 @@ from kubeflow_tpu.cmd.controller import build_manager
 from kubeflow_tpu.runtime.fake import FakeCluster
 from kubeflow_tpu.utils.config import ControllerConfig
 from kubeflow_tpu.webapps import dashboard, jupyter, kfam_app, tensorboards, volumes
+from kubeflow_tpu.webapps.cache import ReadCache
 from kubeflow_tpu.webhooks import poddefaults, tpu_env
 
 log = logging.getLogger("standalone")
@@ -80,11 +81,16 @@ def build_platform(
     # collector off the manager when a caller-supplied config enables
     # telemetry, and the webapps then serve its series
     telemetry = getattr(manager, "telemetry", None)
+    # ONE watch-backed read layer for every app (webapps/cache.py): each
+    # create_app adds its kinds to the shared cache instead of building its
+    # own, so one watch set feeds every serving surface
+    read_cache = ReadCache(cluster).start()
     wsgi = DispatcherMiddleware(
         dashboard.create_app(
             cluster, cluster_admins=admins, metrics=metrics,
             telemetry=telemetry,
             slo=getattr(manager, "slo", None),
+            cache=read_cache,
         ),
         {
             "/jupyter": jupyter.create_app(
@@ -93,12 +99,17 @@ def build_platform(
                 metrics=metrics,
                 telemetry=telemetry,
                 timeline=getattr(manager, "timeline_builder", None),
+                cache=read_cache,
             ),
             "/volumes": volumes.create_app(
-                cluster, authorizer=Authorizer(cluster, cluster_admins=admins)
+                cluster,
+                authorizer=Authorizer(cluster, cluster_admins=admins),
+                cache=read_cache,
             ),
             "/tensorboards": tensorboards.create_app(
-                cluster, authorizer=Authorizer(cluster, cluster_admins=admins)
+                cluster,
+                authorizer=Authorizer(cluster, cluster_admins=admins),
+                cache=read_cache,
             ),
             "/kfam": kfam_app.create_app(cluster, cluster_admins=admins),
         },

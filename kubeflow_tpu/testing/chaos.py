@@ -69,6 +69,7 @@ from kubeflow_tpu.runtime.fake import (
 )
 from kubeflow_tpu.runtime.manager import Manager
 from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webapps.cache import ReadCache
 from kubeflow_tpu.webhooks import tpu_env
 
 
@@ -386,6 +387,21 @@ class ChaosCluster:
 # backoff cap (64 s). Anything beyond is a backoff-escape bug.
 SOAK_MAX_REQUEUE_S = 65.0
 
+# Read-path audit (webapps/cache.py): the web apps' watch-backed ReadCache
+# runs over the SAME faulted client surface as the controllers — its watch
+# streams drop, its rv polls and re-lists fault. Two properties are audited
+# per seed:
+#  - bounded staleness: the cache never serves an object deleted more than
+#    READ_STALENESS_S ago (a read that ERRORS is fine; a stale ANSWER is not)
+#  - read-your-writes: a write acknowledged to the "web session" is visible
+#    in that session's immediate re-list, watch drops notwithstanding
+READ_STALENESS_S = 30.0
+READ_RESYNC_S = 5.0
+# the RYW probe's marker annotation: pure harness bookkeeping, normalized
+# out of the convergence fingerprint (faulted runs legitimately skip probes
+# whose write the chaos layer rejected)
+READ_PROBE_ANNOTATION = "webapp.kubeflow.org/read-probe"
+
 _TS_ANNOTATIONS = (
     api.STOP_ANNOTATION,
     api.LAST_ACTIVITY_ANNOTATION,
@@ -474,6 +490,9 @@ def _normalize(obj: dict) -> dict:
         # the per-run timeline AUDIT judges it, the fixed point must not
         anns.pop(TIMELINE_ANNOTATION, None)
         anns.pop(REQUEST_ID_ANNOTATION, None)
+        # the read-path audit's RYW probe marker: harness bookkeeping whose
+        # success depends on the fault schedule, not converged state
+        anns.pop(READ_PROBE_ANNOTATION, None)
     if o.get("kind") == "Secret":
         for field in ("data", "stringData"):
             if field in o:
@@ -828,6 +847,97 @@ def run_scenario(
     violations: list[str] = []
     restarts = 0
 
+    # ---- read path (webapps/cache.py): the JWA serving surface runs over
+    # the SAME faulted client as the controllers — its watch streams drop
+    # and re-list, its rv polls and fallback lists fault. ONE cache across
+    # controller restarts (the web apps are a separate process). The
+    # harness tracks ground-truth deletion times on the unfaulted base.
+    read_cache = ReadCache(
+        cluster, ("Notebook", "Event"), clock=clock,
+        resync_interval_s=READ_RESYNC_S, staleness_bound_s=READ_STALENESS_S,
+    )
+    deleted_at: dict[tuple[str, str], float] = {}
+
+    def _track_deletes(event: str, obj: dict) -> None:
+        key = (ko.namespace(obj), ko.name(obj))
+        if event == "DELETED":
+            deleted_at[key] = clock()
+        else:
+            deleted_at.pop(key, None)
+
+    base.watch("Notebook", _track_deletes)
+    read_cache.start()
+
+    def read_audit(where: str) -> None:
+        """Bounded staleness: a cache read may FAIL (chaos read fault — the
+        client retries) but may never ANSWER with an object deleted more
+        than READ_STALENESS_S ago."""
+        try:
+            served = read_cache.list("Notebook", Scenario.NAMESPACE)
+        except Exception:
+            return
+        live = {
+            (ko.namespace(nb), ko.name(nb))
+            for nb in base.list("Notebook", Scenario.NAMESPACE)
+        }
+        for nb in served:
+            key = (ko.namespace(nb), ko.name(nb))
+            if key in live:
+                continue
+            dt = deleted_at.get(key)
+            if dt is None or clock() - dt > READ_STALENESS_S + 1e-6:
+                age = "unknown" if dt is None else f"{clock() - dt:.1f}s"
+                violations.append(
+                    f"{where}: read path served deleted notebook "
+                    f"{key[0]}/{key[1]} (deleted {age} ago; bound "
+                    f"{READ_STALENESS_S:.0f}s)"
+                )
+
+    def ryw_probe(tag: str) -> None:
+        """Read-your-writes: emulate the JWA mutating-handler flow — write
+        through the faulted surface with bounded retries; if (and only if)
+        the write was ACKED, write it through the cache, pin the session,
+        and assert the immediate re-list shows it."""
+        nbs = base.list("Notebook", Scenario.NAMESPACE)
+        if not nbs:
+            return
+        target = ko.name(nbs[0])
+        marker = f"probe-{tag}"
+        stored = None
+        for _ in range(4):  # the handler's transient-retry budget
+            try:
+                stored = cluster.patch(
+                    "Notebook", target, Scenario.NAMESPACE,
+                    {"metadata": {"annotations": {
+                        READ_PROBE_ANNOTATION: marker}}},
+                )
+                break
+            except ControllerCrash:
+                return  # chaos killed the call; nothing acked to the user
+            except NotFound:
+                return  # a scripted delete raced the probe
+            except Exception:
+                continue
+        if stored is None:
+            return  # write never acked: no read-your-writes obligation
+        read_cache.note_write(stored, principal="jwa-user")
+        try:
+            served = read_cache.list(
+                "Notebook", Scenario.NAMESPACE, principal="jwa-user"
+            )
+        except Exception:
+            return  # loud failure, not a stale answer
+        got = {
+            ko.name(nb): ko.annotations(nb).get(READ_PROBE_ANNOTATION)
+            for nb in served
+        }
+        if got.get(target) != marker:
+            violations.append(
+                f"ryw {tag}: write acked at rv "
+                f"{stored['metadata'].get('resourceVersion')} but the "
+                f"immediate re-list served {got.get(target)!r} for {target}"
+            )
+
     def tick(where: str) -> None:
         nonlocal mgr, restarts
         # zero reconcile-path scrapes: the collector's pass counter must not
@@ -882,11 +992,13 @@ def run_scenario(
                     where=f"{where}.{s}",
                 )
             )
+            read_audit(f"{where}.{s}")
         clock.advance(dt)
 
     for r, ops in enumerate(scenario.rounds):
         for op in ops:
             scenario.apply(base, op, r)
+        ryw_probe(f"r{r}")
         drive(f"round {r}")
 
     if chaos is not None:
@@ -895,6 +1007,7 @@ def run_scenario(
     # settle: push the clock far past the cull-idle threshold (60 s) and the
     # error-backoff cap (64 s) so both runs reach the same steady state
     for s in range(8):
+        ryw_probe(f"settle{s}")
         drive(f"settle {s}", sub_ticks=2, dt=45.0)
 
     # quiesce: iterate until the normalized fingerprint is stable

@@ -347,6 +347,73 @@ class NotebookMetrics:
         self.culled.inc(namespace=namespace)
 
 
+class WebAppMetrics:
+    """Read-path observability for the web apps (docs/observability.md):
+    per-route request latency, HTTP-revalidation and gzip counters from the
+    shared App plumbing, and the ReadCache's health — hit/fallback ratio,
+    live object counts, positive-confirmation age (staleness), and re-list
+    churn. One instance rides each app's registry (``App.web_metrics``); a
+    shared registry (standalone, controller+webapp colocations) dedups the
+    families, so two apps never emit duplicates."""
+
+    # in-proc serve path: 304s are ~100µs, cached 200s low ms, fallback
+    # full lists can reach hundreds of ms at fleet scale
+    REQUEST_BUCKETS = (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5,
+    )
+
+    def __init__(self, registry: Registry | None = None) -> None:
+        self.registry = registry or Registry()
+        self.request_seconds = self.registry.histogram(
+            "webapp_request_seconds",
+            "Web-app request latency by route pattern and response status",
+            labelnames=("route", "status"),
+            buckets=self.REQUEST_BUCKETS,
+        )
+        self.not_modified = self.registry.counter(
+            "webapp_responses_not_modified_total",
+            "Responses served as 304 via If-None-Match (no serialization)",
+            labelnames=("route",),
+        )
+        self.gzipped = self.registry.counter(
+            "webapp_responses_gzipped_total",
+            "Responses compressed for an Accept-Encoding: gzip client",
+        )
+        self.cache_reads = self.registry.counter(
+            "webapp_cache_reads_total",
+            "ReadCache reads by kind and source (cache|fallback)",
+            labelnames=("kind", "source"),
+        )
+        self.cache_objects = self.registry.gauge(
+            "webapp_cache_objects",
+            "Objects currently held in the ReadCache, per kind",
+            labelnames=("kind",),
+        )
+        self.cache_staleness = self.registry.gauge(
+            "webapp_cache_staleness_seconds",
+            "Age of the last positive freshness confirmation (watch prime, "
+            "rv poll, or re-list), per kind — refreshed at confirm cadence",
+            labelnames=("kind",),
+        )
+        self.cache_relists = self.registry.counter(
+            "webapp_cache_relists_total",
+            "Full re-lists the ReadCache ran (cold start, rv divergence, "
+            "or staleness recovery), per kind",
+            labelnames=("kind",),
+        )
+        self.cache_watch_events = self.registry.counter(
+            "webapp_cache_watch_events_total",
+            "Watch events ingested into the ReadCache, per kind",
+            labelnames=("kind",),
+        )
+
+    def observe_request(self, route: str, status: int, seconds: float) -> None:
+        self.request_seconds.observe(
+            max(0.0, seconds), route=route, status=str(status)
+        )
+
+
 class ControlPlaneMetrics:
     """controller-runtime's standard families for the reconcile hot path
     (docs/observability.md): reconcile duration + outcome per kind

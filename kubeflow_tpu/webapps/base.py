@@ -13,9 +13,11 @@ Apps are plain WSGI callables — servable by any WSGI server and testable with
 """
 from __future__ import annotations
 
+import gzip as gzip_mod
 import json
 import logging
 import secrets
+import time
 from typing import Any, Callable
 
 from werkzeug.exceptions import HTTPException, NotFound
@@ -25,7 +27,7 @@ from werkzeug.wrappers import Request, Response
 from kubeflow_tpu.auth.rbac import AuthError, Authorizer, User, authenticate
 from kubeflow_tpu.runtime.fake import AdmissionDenied, AlreadyExists, Conflict
 from kubeflow_tpu.runtime.fake import NotFound as ClusterNotFound
-from kubeflow_tpu.utils.metrics import Registry
+from kubeflow_tpu.utils.metrics import Registry, WebAppMetrics
 
 log = logging.getLogger("webapps")
 
@@ -60,6 +62,55 @@ def _assign_request_id(request: Request) -> str:
         rid = f"req-{secrets.token_hex(8)}"
     request.environ[_REQUEST_ID_ENV] = rid
     return rid
+
+
+# responses below this many bytes aren't worth the gzip round trip
+_GZIP_MIN_BYTES = 512
+_GZIP_MIMES = ("application/json", "text/plain", "text/html", "text/css",
+               "application/javascript")
+
+
+def not_modified(request: Request, etag: str | None) -> Response | None:
+    """HTTP revalidation: a request whose If-None-Match covers ``etag``
+    gets a 304 with no body and no serialization work. ``etag`` is the
+    ReadCache signature (None = unserviceable → always render fully)."""
+    if not etag:
+        return None
+    inm = request.headers.get("If-None-Match", "")
+    candidates = {t.strip().strip('"') for t in inm.split(",") if t.strip()}
+    if etag in candidates or "*" in candidates:
+        resp = Response(status=304)
+        resp.headers["ETag"] = f'"{etag}"'
+        return resp
+    return None
+
+
+def set_etag(resp: Response, etag: str | None) -> Response:
+    if etag:
+        resp.headers["ETag"] = f'"{etag}"'
+    return resp
+
+
+def maybe_gzip(request: Request, response: Response) -> bool:
+    """Compress a sizable compressible 200 for a gzip-accepting client.
+    Returns True when the body was compressed."""
+    if response.status_code != 200:
+        return False
+    if response.headers.get("Content-Encoding"):
+        return False
+    if "gzip" not in request.headers.get("Accept-Encoding", "").lower():
+        return False
+    if response.mimetype not in _GZIP_MIMES:
+        return False
+    body = response.get_data()
+    if len(body) < _GZIP_MIN_BYTES:
+        return False
+    # level 1: the point is wire bytes at the UI's poll cadence, not
+    # archive ratios — higher levels just burn serve-path CPU
+    response.set_data(gzip_mod.compress(body, compresslevel=1))
+    response.headers["Content-Encoding"] = "gzip"
+    response.headers["Vary"] = "Accept-Encoding"
+    return True
 
 
 def success(key: str | None = None, value: Any = None, **extra) -> Response:
@@ -107,6 +158,10 @@ class App:
         self._requests_total = metrics_registry.counter(
             "http_requests_total", "HTTP requests served, by method and code"
         )
+        # read-path observability (docs/observability.md): per-route latency
+        # histogram + revalidation/gzip counters; the ReadCache families ride
+        # the same instance when a cache is attached to this app
+        self.web_metrics = WebAppMetrics(metrics_registry)
         self.url_map = Map()
         self.endpoints: dict[str, Callable] = {}
         # probes (ref probes.py:8-17)
@@ -239,6 +294,8 @@ class App:
         request = Request(environ)
         rid = _assign_request_id(request)
         adapter = self.url_map.bind_to_environ(environ)
+        started = time.perf_counter()
+        route = "<unmatched>"
         try:
             csrf_fail = self._check_csrf(request)
             if csrf_fail is not None:
@@ -251,6 +308,10 @@ class App:
                 csrf_fail.headers[REQUEST_ID_HEADER] = rid
                 return csrf_fail(environ, start_response)
             endpoint, args = adapter.match()
+            # endpoint is "fn:rule:methods" — the rule pattern is the
+            # bounded-cardinality route label (never the raw path: object
+            # names would explode the series space)
+            route = endpoint.split(":", 2)[1] if ":" in endpoint else endpoint
             response = self.endpoints[endpoint](request, **args)
             if isinstance(response, dict):
                 response = success(**response)
@@ -281,10 +342,17 @@ class App:
                 500, f"Internal server error (request id {rid})"
             )
         response.headers[REQUEST_ID_HEADER] = rid
+        if maybe_gzip(request, response) and self.count_requests:
+            self.web_metrics.gzipped.inc()
         if self.count_requests:
             self._requests_total.inc(
                 method=request.method, code=str(response.status_code)
             )
+            self.web_metrics.observe_request(
+                route, response.status_code, time.perf_counter() - started
+            )
+            if response.status_code == 304:
+                self.web_metrics.not_modified.inc(route=route)
         # seed the CSRF cookie on safe responses (double-submit bootstrap)
         if (
             self.csrf_protect
@@ -363,14 +431,19 @@ def apply_edited_cr(
 def handle_cr_put(
     request: Request, cluster, kind: str, name: str, namespace: str,
     *, validate: Callable[[dict], list] | None = None,
+    cache=None, principal: str | None = None,
 ) -> Response:
     """The PUT-handler body every editable CR shares: parse the JSON body,
-    honor ?dryRun, apply via ``apply_edited_cr``. Callers do authz first."""
+    honor ?dryRun, apply via ``apply_edited_cr``. Callers do authz first.
+    With a ReadCache attached, the committed object writes through and pins
+    the principal (read-your-writes for the editor's immediate re-get)."""
     body = get_json(request)
     dry = request.args.get("dryRun", "").lower() in ("1", "true", "all")
-    apply_edited_cr(
+    stored = apply_edited_cr(
         cluster, kind, name, namespace, body, validate=validate, dry_run=dry
     )
+    if cache is not None and not dry:
+        cache.note_write(stored, principal=principal)
     return success("message", "Valid (dry run)." if dry else f"{kind} updated")
 
 

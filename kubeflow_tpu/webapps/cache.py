@@ -1,0 +1,625 @@
+"""Watch-backed read layer for the web apps (the NotebookOS argument:
+serve interactive reads from replicated in-memory state, never from the
+authoritative store).
+
+Every JWA/dashboard read used to be O(fleet): ``list_notebooks`` re-listed
+all Notebooks AND all Events per request and joined them per notebook;
+``get_tpus`` re-listed every Node per click. The scheduler's informer cache
+(PR 8) proved the pattern pays ~5x on this codebase; :class:`ReadCache`
+generalizes it for the serving path:
+
+- **Per-kind stores fed by watches** — the same watch machinery the
+  controllers use (``cluster.watch``), so under the chaos harness the cache
+  is faultable like any client: streams drop, reconnects replay the current
+  list as ADDED, duplicates arrive. Out-of-order and duplicate deliveries
+  are absorbed by resourceVersion comparison; deletions replayed stale are
+  absorbed by tombstones.
+- **Positive freshness** — absence of watch events is indistinguishable
+  from a severed stream, so freshness comes from confirmation, not silence:
+  every ``resync_interval_s`` the read path polls the store's rv index
+  (``resource_versions`` — no body copies) and falls back to a full re-list
+  on divergence. A cache that cannot confirm within ``staleness_bound_s``
+  refuses to serve from memory and reads through to the cluster (a cold
+  start — watches installed but never synced — serves the same way). This
+  is the bound the chaos soak's read-path audit enforces: the cache never
+  serves an object deleted more than ``staleness_bound_s`` ago.
+- **Secondary indexes** — notebooks-by-namespace, events-by-involved-object
+  (killing the O(events x notebooks) join), nodes-by-accelerator,
+  pods-by-claim and pods-by-notebook. Maintained incrementally at ingest.
+- **Read-your-writes** — mutating handlers write through (``note_write`` /
+  ``note_delete``) and pin the writing principal to at-least-that-rv; a
+  read whose pin the store cannot prove falls back to the authoritative
+  list, so the UI's immediate re-list after a POST/PATCH/DELETE always
+  shows the change even if the watch stream is down.
+- **ETags** — ``etag()`` derives a content signature from the backing
+  objects' (key, resourceVersion) pairs, no serialization. A matching
+  If-None-Match turns the whole list/detail render into a 304.
+
+Thread-safe; reads return deep copies by default (``copy=False`` is for
+handlers that provably only read, e.g. summary builders).
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Callable, Iterable, Mapping
+
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import NotFound
+
+# index-key builders per kind: index name -> fn(obj) -> iterable of keys
+IndexFn = Callable[[dict], Iterable[str]]
+
+
+def _rv_int(obj: Mapping) -> int:
+    try:
+        return int(ko.meta(dict(obj)).get("resourceVersion", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def _event_involved_key(ev: Mapping) -> Iterable[str]:
+    io = ev.get("involvedObject") or {}
+    if io.get("name"):
+        yield f"{io.get('namespace', '')}/{io.get('kind', '')}/{io['name']}"
+
+
+def _node_accelerator_key(node: Mapping) -> Iterable[str]:
+    accel = (node.get("metadata", {}).get("labels") or {}).get(
+        "cloud.google.com/gke-tpu-accelerator"
+    )
+    if accel:
+        yield accel
+
+
+def _pod_claim_keys(pod: Mapping) -> Iterable[str]:
+    for vol in pod.get("spec", {}).get("volumes", []) or []:
+        claim = (vol.get("persistentVolumeClaim") or {}).get("claimName")
+        if claim:
+            yield f"{ko.namespace(pod)}/{claim}"
+
+
+def _pod_notebook_key(pod: Mapping) -> Iterable[str]:
+    name = (pod.get("metadata", {}).get("labels") or {}).get("notebook-name")
+    if name:
+        yield f"{ko.namespace(pod)}/{name}"
+
+
+INDEXERS: dict[str, dict[str, IndexFn]] = {
+    "Event": {"involved": _event_involved_key},
+    "Node": {"accelerator": _node_accelerator_key},
+    "Pod": {"claim": _pod_claim_keys, "notebook": _pod_notebook_key},
+}
+
+DEFAULT_KINDS = (
+    "Notebook",
+    "Event",
+    "Node",
+    "Pod",
+    "PersistentVolumeClaim",
+    "PodDefault",
+)
+
+
+class _KindStore:
+    """One kind's objects + rv bookkeeping + secondary indexes. All methods
+    assume the owning ReadCache's lock is held."""
+
+    def __init__(self, kind: str) -> None:
+        self.kind = kind
+        self.objects: dict[tuple[str, str], dict] = {}
+        self.rvs: dict[tuple[str, str], int] = {}
+        self.by_namespace: dict[str, set[tuple[str, str]]] = {}
+        self.rv_high = 0
+        # highest rv ever ingested per namespace: with monotonic, never-reused
+        # rvs, (live count, max rv) is a sound O(1) change signature — any
+        # add/update raises max, any delete changes count, and max can never
+        # return to an old value, so no two distinct states ever collide
+        self.ns_max_rv: dict[str, int] = {}
+        # key -> (rv at removal, removal time): ignores stale re-list ADDEDs
+        # of a deleted object (rv <= tombstone) while letting a genuine
+        # recreate (fresh, higher rv) through
+        self.tombstones: dict[tuple[str, str], tuple[int, float]] = {}
+        self.index_fns: dict[str, IndexFn] = dict(INDEXERS.get(kind, {}))
+        self.indexes: dict[str, dict[str, set[tuple[str, str]]]] = {
+            name: {} for name in self.index_fns
+        }
+        self._index_membership: dict[
+            tuple[str, str], dict[str, tuple[str, ...]]
+        ] = {}
+        self.last_confirmed = 0.0  # 0 = never: cold caches must read through
+
+    # ------------------------------------------------------------- mutation
+
+    def ingest(self, obj: dict, now: float) -> bool:
+        key = (ko.namespace(obj), ko.name(obj))
+        rv = _rv_int(obj)
+        tomb = self.tombstones.get(key)
+        if tomb is not None:
+            if rv <= tomb[0]:
+                return False  # stale replay of an object we saw deleted
+            del self.tombstones[key]
+        old_rv = self.rvs.get(key)
+        if old_rv is not None and rv <= old_rv:
+            return False  # duplicate / out-of-order delivery
+        self._unindex(key)
+        self.objects[key] = obj
+        self.rvs[key] = rv
+        self.by_namespace.setdefault(key[0], set()).add(key)
+        membership: dict[str, tuple[str, ...]] = {}
+        for name, fn in self.index_fns.items():
+            idx_keys = tuple(fn(obj))
+            for ik in idx_keys:
+                self.indexes[name].setdefault(ik, set()).add(key)
+            membership[name] = idx_keys
+        self._index_membership[key] = membership
+        self.rv_high = max(self.rv_high, rv)
+        if rv > self.ns_max_rv.get(key[0], 0):
+            self.ns_max_rv[key[0]] = rv
+        return True
+
+    def remove(self, key: tuple[str, str], now: float, rv: int = 0) -> None:
+        self._unindex(key)
+        self.objects.pop(key, None)
+        known_rv = self.rvs.pop(key, 0)
+        ns_set = self.by_namespace.get(key[0])
+        if ns_set is not None:
+            ns_set.discard(key)
+            if not ns_set:
+                del self.by_namespace[key[0]]
+        # preserve an existing tombstone's rv: a second remove of an
+        # already-removed key (handler note_delete after the synchronous
+        # watch DELETED) knows no rv, and clobbering the recorded one with
+        # 0 would let a stale replay resurrect the deleted object
+        prior = self.tombstones.get(key, (0, 0.0))[0]
+        self.tombstones[key] = (max(rv, known_rv, prior), now)
+
+    def _unindex(self, key: tuple[str, str]) -> None:
+        membership = self._index_membership.pop(key, None)
+        if not membership:
+            return
+        for name, idx_keys in membership.items():
+            index = self.indexes[name]
+            for ik in idx_keys:
+                members = index.get(ik)
+                if members is not None:
+                    members.discard(key)
+                    if not members:
+                        del index[ik]
+
+    def replace_all(self, objs: Iterable[dict], now: float) -> None:
+        """Absorb a full authoritative list: ingest everything, drop keys
+        the list no longer contains (the missed-DELETE recovery path)."""
+        seen: set[tuple[str, str]] = set()
+        for obj in objs:
+            key = (ko.namespace(obj), ko.name(obj))
+            seen.add(key)
+            self.ingest(obj, now)
+        for key in [k for k in self.objects if k not in seen]:
+            self.remove(key, now)
+
+    def prune_tombstones(self, now: float, keep_s: float) -> None:
+        for key in [
+            k for k, (_, t) in self.tombstones.items() if now - t > keep_s
+        ]:
+            del self.tombstones[key]
+
+
+class ReadCache:
+    """Shared watch-backed read layer the web apps serve from.
+
+    ``start()`` installs one watch per kind and primes each store from an
+    initial list. Reads confirm freshness lazily (rv poll / re-list) on the
+    caller's thread — there is no background loop to leak, which also keeps
+    the cache deterministic under the chaos harness's virtual clock.
+    """
+
+    def __init__(
+        self,
+        cluster,
+        kinds: Iterable[str] = DEFAULT_KINDS,
+        *,
+        clock: Callable[[], float] = time.time,
+        resync_interval_s: float = 5.0,
+        staleness_bound_s: float = 30.0,
+        metrics=None,
+    ) -> None:
+        self.cluster = cluster
+        self.clock = clock
+        self.resync_interval_s = resync_interval_s
+        self.staleness_bound_s = staleness_bound_s
+        self.metrics = metrics
+        self._lock = threading.RLock()
+        self._stores: dict[str, _KindStore] = {}
+        self._handlers: list = []
+        self._started = False
+        # (principal, kind) -> rv the principal's reads must reflect
+        self._pins: dict[tuple[str, str], int] = {}
+        for kind in kinds:
+            self._stores[kind] = _KindStore(kind)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "ReadCache":
+        """Install watches and prime every store (idempotent). A prime
+        failure leaves that kind cold — reads fall back until a later
+        confirm succeeds, which is the cold-start contract."""
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+            for kind in list(self._stores):
+                self._install(kind)
+        return self
+
+    def _install(self, kind: str) -> None:
+        handler = self._make_handler(kind)
+        self.cluster.watch(kind, handler)
+        self._handlers.append(handler)
+        try:
+            objs = self.cluster.list(kind)
+        except Exception:
+            return  # cold: the first read confirms via fallback
+        now = self.clock()
+        with self._lock:
+            store = self._stores[kind]
+            store.replace_all(objs, now)
+            store.last_confirmed = now
+        if self.metrics is not None:
+            self._observe_store(kind, store, now)
+
+    def ensure_kinds(self, kinds: Iterable[str]) -> "ReadCache":
+        """Lazily add kinds to an already-started cache (one shared cache
+        serving several apps with different kind sets)."""
+        with self._lock:
+            for kind in kinds:
+                if kind in self._stores:
+                    continue
+                self._stores[kind] = _KindStore(kind)
+                if self._started:
+                    self._install(kind)
+        return self
+
+    def close(self) -> None:
+        unwatch = getattr(self.cluster, "unwatch", None)
+        if unwatch is not None:
+            for handler in self._handlers:
+                unwatch(handler)
+        self._handlers = []
+        self._started = False
+
+    def _make_handler(self, kind: str):
+        def handle(event: str, obj: dict) -> None:
+            now = self.clock()
+            with self._lock:
+                store = self._stores.get(kind)
+                if store is None:
+                    return
+                if event == "DELETED":
+                    store.remove(
+                        (ko.namespace(obj), ko.name(obj)), now, rv=_rv_int(obj)
+                    )
+                else:
+                    store.ingest(obj, now)
+            if self.metrics is not None:
+                self.metrics.cache_watch_events.inc(kind=kind)
+
+        return handle
+
+    # ------------------------------------------------------------ freshness
+
+    def _confirm(self, kind: str, now: float) -> bool:
+        """Positive freshness: True when the store is provably current
+        within the staleness bound. Cheap rv poll first; full re-list on
+        divergence or when the cluster has no rv index. Confirmation
+        failures (transient read faults) keep serving from memory only
+        while inside the bound."""
+        store = self._stores[kind]
+        if now - store.last_confirmed < self.resync_interval_s and (
+            store.last_confirmed > 0
+        ):
+            return True
+        rv_fn = getattr(self.cluster, "resource_versions", None)
+        try:
+            if rv_fn is not None and store.last_confirmed > 0:
+                current = rv_fn(kind)
+                with self._lock:
+                    mine = {k: str(v) for k, v in store.rvs.items()}
+                    if mine == current:
+                        store.last_confirmed = now
+                        store.prune_tombstones(
+                            now, 4 * self.staleness_bound_s
+                        )
+                        confirmed = True
+                    else:
+                        confirmed = False
+                if confirmed:
+                    if self.metrics is not None:
+                        self._observe_store(kind, store, now)
+                    return True
+            objs = self.cluster.list(kind)
+        except Exception:
+            # transient read fault: within the bound the memory copy is
+            # still certified; beyond it the caller must read through
+            return 0 < now - store.last_confirmed <= self.staleness_bound_s
+        with self._lock:
+            store.replace_all(objs, now)
+            store.last_confirmed = now
+            store.prune_tombstones(now, 4 * self.staleness_bound_s)
+        if self.metrics is not None:
+            self.metrics.cache_relists.inc(kind=kind)
+            self._observe_store(kind, store, now)
+        return True
+
+    def _observe_store(self, kind: str, store: _KindStore, now: float) -> None:
+        """Gauge refresh at confirmation cadence (NOT per read — a 1k-row
+        render makes thousands of store reads)."""
+        self.metrics.cache_staleness.set(
+            max(0.0, now - store.last_confirmed)
+            if store.last_confirmed
+            else float("inf"),
+            kind=kind,
+        )
+        self.metrics.cache_objects.set(len(store.objects), kind=kind)
+
+    def _serviceable(
+        self, kind: str, principal: str | None, now: float
+    ) -> bool:
+        store = self._stores.get(kind)
+        if store is None:
+            return False
+        if not self._confirm(kind, now):
+            return False
+        if principal:
+            pin = self._pins.get((principal, kind), 0)
+            if pin > store.rv_high:
+                return False  # read-your-writes: the store hasn't proven it
+        return True
+
+    def _count_read(self, kind: str, source: str) -> None:
+        if self.metrics is not None:
+            self.metrics.cache_reads.inc(kind=kind, source=source)
+
+    # ---------------------------------------------------------------- reads
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        *,
+        principal: str | None = None,
+        copy: bool = True,
+    ) -> list[dict]:
+        now = self.clock()
+        if not self._serviceable(kind, principal, now):
+            objs = self.cluster.list(kind, namespace)
+            self._absorb(kind, objs, now)
+            self._count_read(kind, "fallback")
+            return objs
+        self._count_read(kind, "cache")
+        with self._lock:
+            store = self._stores[kind]
+            keys = (
+                store.by_namespace.get(namespace, set())
+                if namespace is not None
+                else store.objects.keys()
+            )
+            out = [store.objects[k] for k in sorted(keys)]
+        return [ko.deep_copy(o) for o in out] if copy else out
+
+    def get(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        *,
+        principal: str | None = None,
+    ) -> dict:
+        now = self.clock()
+        if self._serviceable(kind, principal, now):
+            with self._lock:
+                obj = self._stores[kind].objects.get((namespace, name))
+            if obj is not None:
+                self._count_read(kind, "cache")
+                return ko.deep_copy(obj)
+        # miss or unserviceable: the authoritative answer (NotFound
+        # propagates — a just-created object the watch hasn't delivered yet
+        # must not 404)
+        obj = self.cluster.get(kind, name, namespace)
+        self._absorb(kind, [obj], now)
+        self._count_read(kind, "fallback")
+        return ko.deep_copy(obj)
+
+    def events_for(
+        self,
+        involved: Mapping,
+        *,
+        principal: str | None = None,
+        copy: bool = True,
+    ) -> list[dict]:
+        """The involved-object index: the O(1) replacement for every
+        full-namespace Event scan on a request path."""
+        now = self.clock()
+        if not self._serviceable("Event", principal, now):
+            self._count_read("Event", "fallback")
+            return self.cluster.events_for(involved)
+        self._count_read("Event", "cache")
+        ns = ko.namespace(involved)
+        ik = f"{ns}/{involved.get('kind', '')}/{ko.name(involved)}"
+        uid = (involved.get("metadata") or {}).get("uid")
+        with self._lock:
+            store = self._stores["Event"]
+            keys = sorted(store.indexes["involved"].get(ik, set()))
+            out = []
+            for key in keys:
+                ev = store.objects[key]
+                ev_uid = (ev.get("involvedObject") or {}).get("uid")
+                # uid-aware like FakeCluster.events_for (kubectl describe
+                # semantics): a recreated object does not inherit history
+                if uid and ev_uid and ev_uid != uid:
+                    continue
+                out.append(ko.deep_copy(ev) if copy else ev)
+        return out
+
+    def events_in(
+        self, namespace: str, *, principal: str | None = None
+    ) -> list[dict]:
+        return self.list("Event", namespace, principal=principal)
+
+    def nodes_for_accelerator(self, gke_accelerator: str) -> list[dict]:
+        """Nodes carrying the given gke-tpu-accelerator label (the
+        /api/tpus availability probe's working set)."""
+        now = self.clock()
+        if not self._serviceable("Node", None, now):
+            self._count_read("Node", "fallback")
+            return [
+                n
+                for n in self.cluster.list("Node")
+                if (n.get("metadata", {}).get("labels") or {}).get(
+                    "cloud.google.com/gke-tpu-accelerator"
+                )
+                == gke_accelerator
+            ]
+        self._count_read("Node", "cache")
+        with self._lock:
+            store = self._stores["Node"]
+            keys = sorted(
+                store.indexes["accelerator"].get(gke_accelerator, set())
+            )
+            return [ko.deep_copy(store.objects[k]) for k in keys]
+
+    def pods_using_claim(self, namespace: str, claim: str) -> list[str]:
+        now = self.clock()
+        if not self._serviceable("Pod", None, now):
+            self._count_read("Pod", "fallback")
+            return [
+                ko.name(p)
+                for p in self.cluster.list("Pod", namespace)
+                if any(
+                    v.get("persistentVolumeClaim", {}).get("claimName")
+                    == claim
+                    for v in p.get("spec", {}).get("volumes", []) or []
+                )
+            ]
+        self._count_read("Pod", "cache")
+        with self._lock:
+            store = self._stores["Pod"]
+            keys = sorted(store.indexes["claim"].get(f"{namespace}/{claim}", set()))
+            return [k[1] for k in keys]
+
+    def pods_for_notebook(
+        self, namespace: str, name: str, *, principal: str | None = None
+    ) -> list[dict]:
+        now = self.clock()
+        if not self._serviceable("Pod", principal, now):
+            self._count_read("Pod", "fallback")
+            return self.cluster.list(
+                "Pod", namespace, {"matchLabels": {"notebook-name": name}}
+            )
+        self._count_read("Pod", "cache")
+        with self._lock:
+            store = self._stores["Pod"]
+            keys = sorted(
+                store.indexes["notebook"].get(f"{namespace}/{name}", set())
+            )
+            return [ko.deep_copy(store.objects[k]) for k in keys]
+
+    def _absorb(self, kind: str, objs: Iterable[dict], now: float) -> None:
+        """Opportunistically ingest fallback-read results (no removals —
+        a scoped list proves nothing about other namespaces)."""
+        store = self._stores.get(kind)
+        if store is None:
+            return
+        with self._lock:
+            for obj in objs:
+                store.ingest(ko.deep_copy(obj), now)
+
+    # -------------------------------------------------------------- writes
+
+    def note_write(self, stored: Mapping, *, principal: str | None = None) -> None:
+        """Write-through after a successful mutating handler: the returned
+        object (with its committed resourceVersion) lands in the store
+        immediately, and the principal is pinned to at-least-that-rv so a
+        cache replaced behind their back still serves their write."""
+        kind = stored.get("kind", "")
+        store = self._stores.get(kind)
+        if store is None:
+            return
+        now = self.clock()
+        rv = _rv_int(stored)
+        with self._lock:
+            store.ingest(ko.deep_copy(dict(stored)), now)
+            if principal:
+                key = (principal, kind)
+                self._pins[key] = max(self._pins.get(key, 0), rv)
+
+    def note_delete(
+        self, kind: str, name: str, namespace: str = "", *, principal: str | None = None
+    ) -> None:
+        store = self._stores.get(kind)
+        if store is None:
+            return
+        with self._lock:
+            store.remove((namespace, name), self.clock())
+            if principal:
+                # deletes carry no rv; pin to everything the store has seen
+                # so this session's reads can never be satisfied by an older
+                # replacement of the cache than the one that saw the delete
+                key = (principal, kind)
+                self._pins[key] = max(self._pins.get(key, 0), store.rv_high)
+
+    # ---------------------------------------------------------------- etag
+
+    def etag(
+        self,
+        *scopes: tuple[str, str | None],
+        principal: str | None = None,
+        extra: str = "",
+    ) -> str | None:
+        """Content signature over the backing object sets: sha1 over each
+        ``(kind, namespace)`` scope's (live count, max ingested rv) pair —
+        O(1) per scope, and sound because rvs are monotonic and never
+        reused (any add/update raises max, any delete changes count, and
+        max can never revisit an old value) — plus ``extra`` material
+        (e.g. a telemetry freshness stamp). None when any scope is
+        unserviceable for this principal — the handler then serves a full
+        response and skips revalidation, never a wrong 304."""
+        now = self.clock()
+        h = hashlib.sha1()
+        for kind, namespace in scopes:
+            if not self._serviceable(kind, principal, now):
+                return None
+            with self._lock:
+                store = self._stores[kind]
+                if namespace is None:
+                    count, high = len(store.objects), store.rv_high
+                else:
+                    count = len(store.by_namespace.get(namespace, ()))
+                    high = store.ns_max_rv.get(namespace, 0)
+            h.update(f"{kind}/{namespace}:{count}@{high};".encode())
+        if extra:
+            h.update(extra.encode())
+        return h.hexdigest()
+
+    # ---------------------------------------------------------------- debug
+
+    def stats(self) -> dict:
+        now = self.clock()
+        with self._lock:
+            return {
+                kind: {
+                    "objects": len(store.objects),
+                    "rv_high": store.rv_high,
+                    "tombstones": len(store.tombstones),
+                    "staleness_s": (
+                        round(now - store.last_confirmed, 3)
+                        if store.last_confirmed
+                        else None
+                    ),
+                }
+                for kind, store in self._stores.items()
+            }
+
+
+__all__ = ["ReadCache", "DEFAULT_KINDS", "NotFound"]

@@ -27,6 +27,7 @@ from kubeflow_tpu.runtime.fake import FakeCluster
 from kubeflow_tpu.utils.metrics import NotebookMetrics
 from kubeflow_tpu.webapps import base
 from kubeflow_tpu.webapps.base import App, get_json, success
+from kubeflow_tpu.webapps.cache import ReadCache
 from kubeflow_tpu.webapps.metrics_source import (
     MetricsSource,
     RegistrySource,
@@ -67,6 +68,8 @@ def create_app(
     links: dict | None = None,
     telemetry=None,
     slo=None,
+    cache: ReadCache | None = None,
+    use_cache: bool = True,
 ) -> App:
     metrics = metrics or NotebookMetrics()
 
@@ -122,6 +125,13 @@ def create_app(
     )
     if owned_source is not None:
         app.on_close(owned_source.stop_background)
+    if cache is not None:
+        cache.ensure_kinds(("Event",))
+    elif use_cache:
+        # the activity feed is the dashboard's poll loop; Events come from
+        # the watch-backed store instead of a per-request namespace list
+        cache = ReadCache(cluster, ("Event",), metrics=app.web_metrics).start()
+        app.on_close(cache.close)
     bindings = BindingClient(cluster)
     profiles = ProfileClient(cluster, cluster_admins=cluster_admins)
 
@@ -275,9 +285,20 @@ def create_app(
     def activities(request, namespace):
         # per-namespace authz: events leak tenant activity (object names,
         # failure messages) — same guard as JWA's events endpoint
-        app.ensure(request, "list", "events", namespace)
-        events = cluster.list("Event", namespace)
-        return success(
+        user = app.ensure(request, "list", "events", namespace)
+        etag = (
+            cache.etag(("Event", namespace), principal=user.name)
+            if cache is not None else None
+        )
+        hit = base.not_modified(request, etag)
+        if hit is not None:
+            return hit
+        events = (
+            cache.events_in(namespace, principal=user.name)
+            if cache is not None
+            else cluster.list("Event", namespace)
+        )
+        return base.set_etag(success(
             "activities",
             [
                 {
@@ -288,7 +309,7 @@ def create_app(
                 }
                 for e in events[-50:]
             ],
-        )
+        ), etag)
 
     @app.route("/api/dashboard-links")
     def dashboard_links(request):

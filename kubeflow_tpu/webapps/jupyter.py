@@ -32,11 +32,16 @@ from kubeflow_tpu.controllers.notebook_controller import REWRITE_ANNOTATION
 from kubeflow_tpu.culler.culler import format_time
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import FakeCluster
-from kubeflow_tpu.tpu.topology import parse_topology, validate_against_node_capacity
+from kubeflow_tpu.tpu.topology import (
+    ACCELERATORS,
+    parse_topology,
+    validate_against_node_capacity,
+)
 from kubeflow_tpu.utils.metrics import NotebookMetrics
 from kubeflow_tpu.webapps import spawner_config
 from kubeflow_tpu.webapps import base
 from kubeflow_tpu.webapps.base import App, get_json, success
+from kubeflow_tpu.webapps.cache import ReadCache
 
 import time
 
@@ -136,6 +141,11 @@ def notebook_summary(nb: dict, events: list[dict]) -> dict:
     }
 
 
+JWA_KINDS = (
+    "Notebook", "Event", "Node", "Pod", "PersistentVolumeClaim", "PodDefault",
+)
+
+
 def create_app(
     cluster: FakeCluster,
     *,
@@ -144,6 +154,8 @@ def create_app(
     metrics: NotebookMetrics | None = None,
     telemetry=None,
     timeline=None,
+    cache: ReadCache | None = None,
+    use_cache: bool = True,
 ) -> App:
     metrics = metrics or NotebookMetrics()
     app = App(
@@ -151,6 +163,33 @@ def create_app(
         authorizer=authorizer or Authorizer(cluster),
         metrics_registry=metrics.registry,
     )
+    # watch-backed read layer (webapps/cache.py): every GET below serves
+    # from replicated in-memory state, never the authoritative store; an
+    # injected cache (standalone: one cache shared by every app) is reused,
+    # use_cache=False keeps the direct O(fleet) reads (the loadtest's
+    # uncached A/B arm)
+    if cache is not None:
+        cache.ensure_kinds(JWA_KINDS)
+    elif use_cache:
+        cache = ReadCache(
+            cluster, JWA_KINDS, metrics=app.web_metrics
+        ).start()
+        app.on_close(cache.close)
+
+    def _etag(*scopes, principal=None, extra=""):
+        if cache is None:
+            return None
+        return cache.etag(*scopes, principal=principal, extra=extra)
+
+    def _tel_extra() -> str:
+        # telemetry/timeline payloads change without any CR rv moving; the
+        # collector's pass counter folds that freshness into the ETag
+        tel = telemetry if telemetry is not None else getattr(
+            timeline, "telemetry", None
+        )
+        if tel is None:
+            return ""
+        return f"tel:{getattr(tel, 'scrape_passes', 0)}"
 
     app.attach_frontend("jupyter")
     base.add_namespaces_route(app, cluster)
@@ -165,11 +204,23 @@ def create_app(
         """Available (accelerator, topology) pairs probed from node capacity —
         the TPU generalization of the reference's GPU vendor intersection."""
         app.current_user(request)  # node capacity is cluster-internal info
-        nodes = cluster.list("Node")
         config = spawner_config.load_config(config_path)
         tpu_cfg = config["spawnerFormDefaults"].get("tpu", {})
+        all_nodes = None  # lazy: only listed when an accel needs the scan
         available = []
         for accel in tpu_cfg.get("accelerators", []):
+            known = ACCELERATORS.get(accel["name"])
+            if cache is not None and known is not None:
+                # nodes-by-accelerator index: probe only this generation's
+                # pool instead of re-listing every Node per click
+                nodes = cache.nodes_for_accelerator(known.gke_accelerator)
+            else:
+                if all_nodes is None:
+                    all_nodes = (
+                        cache.list("Node") if cache is not None
+                        else cluster.list("Node")
+                    )
+                nodes = all_nodes
             topologies = [
                 t for t in accel.get("topologies", [])
                 if validate_against_node_capacity(
@@ -184,27 +235,60 @@ def create_app(
 
     @app.route("/api/namespaces/<namespace>/notebooks")
     def list_notebooks(request, namespace):
-        app.ensure(request, "list", "notebooks", namespace)
-        # one Events list per render, grouped by object — not one per notebook
-        # (N+1 against the real API server at the UI's poll cadence)
-        events_by_name: dict[str, list] = {}
-        for ev in cluster.list("Event", namespace):
-            io = ev.get("involvedObject", {})
-            if io.get("kind") == "Notebook":
-                events_by_name.setdefault(io.get("name", ""), []).append(ev)
-        out = [
-            notebook_summary(nb, events_by_name.get(ko.name(nb), []))
-            for nb in cluster.list("Notebook", namespace)
-        ]
-        return success("notebooks", out)
+        user = app.ensure(request, "list", "notebooks", namespace)
+        # the UI polls this route; revalidation first — a matching
+        # If-None-Match skips the whole join+serialize for a 304
+        etag = _etag(
+            ("Notebook", namespace), ("Event", namespace),
+            principal=user.name,
+        )
+        hit = base.not_modified(request, etag)
+        if hit is not None:
+            return hit
+        if cache is not None:
+            # involved-object index: per-notebook event lookups, not an
+            # O(events x notebooks) namespace join per render (copy=False:
+            # summary building only reads)
+            out = [
+                notebook_summary(
+                    nb, cache.events_for(nb, principal=user.name, copy=False)
+                )
+                for nb in cache.list(
+                    "Notebook", namespace, principal=user.name, copy=False
+                )
+            ]
+        else:
+            # one Events list per render, grouped by object — not one per
+            # notebook (N+1 against the real API server at poll cadence)
+            events_by_name: dict[str, list] = {}
+            for ev in cluster.list("Event", namespace):
+                io = ev.get("involvedObject", {})
+                if io.get("kind") == "Notebook":
+                    events_by_name.setdefault(io.get("name", ""), []).append(ev)
+            out = [
+                notebook_summary(nb, events_by_name.get(ko.name(nb), []))
+                for nb in cluster.list("Notebook", namespace)
+            ]
+        return base.set_etag(success("notebooks", out), etag)
 
     @app.route("/api/namespaces/<namespace>/notebooks/<name>")
     def get_notebook(request, namespace, name):
         """Detail-page payload: the index summary enriched with the CR's
         conditions/age (ref notebook-page overview tab) plus the raw CR."""
-        app.ensure(request, "get", "notebooks", namespace)
-        nb = cluster.get("Notebook", name, namespace)
-        events = cluster.events_for(nb)
+        user = app.ensure(request, "get", "notebooks", namespace)
+        etag = _etag(
+            ("Notebook", namespace), ("Event", namespace),
+            principal=user.name, extra=_tel_extra(),
+        )
+        hit = base.not_modified(request, etag)
+        if hit is not None:
+            return hit
+        if cache is not None:
+            nb = cache.get("Notebook", name, namespace, principal=user.name)
+            events = cache.events_for(nb, principal=user.name)
+        else:
+            nb = cluster.get("Notebook", name, namespace)
+            events = cluster.events_for(nb)
         summary = notebook_summary(nb, events)
         summary["status"]["conditions"] = nb.get("status", {}).get(
             "conditions", []
@@ -249,13 +333,17 @@ def create_app(
             # attribution of this session's startup — "which layer ate the
             # time" rendered right on the overview tab
             summary["timeline"] = timeline.build(namespace, name)
-        return success("notebook", summary, raw=nb)
+        return base.set_etag(success("notebook", summary, raw=nb), etag)
 
     @app.route("/api/namespaces/<namespace>/notebooks/<name>/pod")
     def get_notebook_pod(request, namespace, name):
-        app.ensure(request, "get", "pods", namespace)
-        pods = cluster.list(
-            "Pod", namespace, {"matchLabels": {"notebook-name": name}}
+        user = app.ensure(request, "get", "pods", namespace)
+        pods = (
+            cache.pods_for_notebook(namespace, name, principal=user.name)
+            if cache is not None
+            else cluster.list(
+                "Pod", namespace, {"matchLabels": {"notebook-name": name}}
+            )
         )
         if not pods:
             from werkzeug.exceptions import NotFound
@@ -270,9 +358,13 @@ def create_app(
         # ref crud_backend/api/pod.py: authorize the pods/log subresource
         # (not just pod read) and return only the notebook container's logs —
         # sidecar (istio-proxy/oauth-proxy) logs must not leak to users.
-        app.ensure(request, "get", "pods/log", namespace)
-        pods = cluster.list(
-            "Pod", namespace, {"matchLabels": {"notebook-name": name}}
+        user = app.ensure(request, "get", "pods/log", namespace)
+        pods = (
+            cache.pods_for_notebook(namespace, name, principal=user.name)
+            if cache is not None
+            else cluster.list(
+                "Pod", namespace, {"matchLabels": {"notebook-name": name}}
+            )
         )
         if not any(ko.name(p) == pod for p in pods):
             from werkzeug.exceptions import NotFound
@@ -283,34 +375,73 @@ def create_app(
 
     @app.route("/api/namespaces/<namespace>/notebooks/<name>/events")
     def get_notebook_events(request, namespace, name):
-        app.ensure(request, "list", "events", namespace)
-        nb = cluster.get("Notebook", name, namespace)
-        return success("events", cluster.events_for(nb))
+        user = app.ensure(request, "list", "events", namespace)
+        etag = _etag(
+            ("Notebook", namespace), ("Event", namespace),
+            principal=user.name,
+        )
+        hit = base.not_modified(request, etag)
+        if hit is not None:
+            return hit
+        if cache is not None:
+            nb = cache.get("Notebook", name, namespace, principal=user.name)
+            events = cache.events_for(nb, principal=user.name)
+        else:
+            nb = cluster.get("Notebook", name, namespace)
+            events = cluster.events_for(nb)
+        return base.set_etag(success("events", events), etag)
 
     @app.route("/api/namespaces/<namespace>/pvcs")
     def list_pvcs(request, namespace):
-        app.ensure(request, "list", "persistentvolumeclaims", namespace)
+        user = app.ensure(request, "list", "persistentvolumeclaims", namespace)
+        etag = _etag(
+            ("PersistentVolumeClaim", namespace), principal=user.name
+        )
+        hit = base.not_modified(request, etag)
+        if hit is not None:
+            return hit
+        pvcs = (
+            cache.list(
+                "PersistentVolumeClaim", namespace,
+                principal=user.name, copy=False,
+            )
+            if cache is not None
+            else cluster.list("PersistentVolumeClaim", namespace)
+        )
         out = [
             {
                 "name": ko.name(pvc),
                 "size": pvc.get("spec", {}).get("resources", {}).get("requests", {}).get("storage"),
                 "mode": (pvc.get("spec", {}).get("accessModes") or [None])[0],
             }
-            for pvc in cluster.list("PersistentVolumeClaim", namespace)
+            for pvc in pvcs
         ]
-        return success("pvcs", out)
+        return base.set_etag(success("pvcs", out), etag)
 
     @app.route("/api/namespaces/<namespace>/poddefaults")
     def list_poddefaults(request, namespace):
-        app.ensure(request, "list", "poddefaults", namespace)
+        user = app.ensure(request, "list", "poddefaults", namespace)
+        etag = _etag(("PodDefault", namespace), principal=user.name)
+        hit = base.not_modified(request, etag)
+        if hit is not None:
+            return hit
+        pds = (
+            # copy=False: the loop below deep-copies each pd itself before
+            # decorating it
+            cache.list(
+                "PodDefault", namespace, principal=user.name, copy=False
+            )
+            if cache is not None
+            else cluster.list("PodDefault", namespace)
+        )
         out = []
-        for pd in cluster.list("PodDefault", namespace):
+        for pd in pds:
             labels = pd["spec"].get("selector", {}).get("matchLabels", {})
             pd = ko.deep_copy(pd)
             pd["label"] = next(iter(labels), "")
             pd["desc"] = pd["spec"].get("desc") or ko.name(pd)
             out.append(pd)
-        return success("poddefaults", out)
+        return base.set_etag(success("poddefaults", out), etag)
 
     @app.route("/api/namespaces/<namespace>/notebooks", methods=("POST",))
     def post_notebook(request, namespace):
@@ -338,8 +469,16 @@ def create_app(
                 raise ValueError(f"PVC {ko.name(pvc)} already exists")
 
         for pvc in new_pvcs:
-            cluster.create(pvc)
-        cluster.create(nb)
+            stored_pvc = cluster.create(pvc)
+            if cache is not None:
+                cache.note_write(stored_pvc, principal=user.name)
+        stored = cluster.create(nb)
+        if cache is not None:
+            # read-your-writes: the committed CR lands in the cache NOW and
+            # the creating session is pinned to its rv — the spawner's
+            # immediate redirect-to-list must show the new notebook even if
+            # the watch stream is down
+            cache.note_write(stored, principal=user.name)
         metrics.notebook_created(namespace)
         return success("message", "Notebook created successfully.")
 
@@ -347,7 +486,7 @@ def create_app(
         "/api/namespaces/<namespace>/notebooks/<name>", methods=("PATCH",)
     )
     def patch_notebook(request, namespace, name):
-        app.ensure(request, "patch", "notebooks", namespace)
+        user = app.ensure(request, "patch", "notebooks", namespace)
         body = get_json(request)
         nb = cluster.get("Notebook", name, namespace)
         if "stopped" in body:
@@ -373,7 +512,9 @@ def create_app(
                         tl.encode_marks({"requestedAt": time.time()}),
                     )
                 ko.remove_annotation(nb, api.STOP_ANNOTATION)
-            cluster.update(nb)
+            stored = cluster.update(nb)
+            if cache is not None:
+                cache.note_write(stored, principal=user.name)
         return success("message", "Notebook updated")
 
     @app.route(
@@ -383,18 +524,23 @@ def create_app(
         """Editable-YAML apply (detail page's editor tab): the full edited
         CR replaces the stored spec, authz'd as update, schema-checked, with
         ?dryRun=true validating without persisting."""
-        app.ensure(request, "update", "notebooks", namespace)
+        user = app.ensure(request, "update", "notebooks", namespace)
         return base.handle_cr_put(
             request, cluster, "Notebook", name, namespace,
             validate=api.validate_notebook,
+            cache=cache, principal=user.name,
         )
 
     @app.route(
         "/api/namespaces/<namespace>/notebooks/<name>", methods=("DELETE",)
     )
     def delete_notebook(request, namespace, name):
-        app.ensure(request, "delete", "notebooks", namespace)
+        user = app.ensure(request, "delete", "notebooks", namespace)
         cluster.delete("Notebook", name, namespace)
+        if cache is not None:
+            cache.note_delete(
+                "Notebook", name, namespace, principal=user.name
+            )
         return success("message", "Notebook deleted")
 
     return app

@@ -12,19 +12,49 @@ from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import FakeCluster
 from kubeflow_tpu.webapps import base
 from kubeflow_tpu.webapps.base import App, get_json, success
+from kubeflow_tpu.webapps.cache import ReadCache
+
+TWA_KINDS = ("Tensorboard",)
 
 
-def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) -> App:
+def create_app(
+    cluster: FakeCluster,
+    *,
+    authorizer: Authorizer | None = None,
+    cache: ReadCache | None = None,
+    use_cache: bool = True,
+) -> App:
     app = App("tensorboards-web-app", authorizer=authorizer or Authorizer(cluster))
+    if cache is not None:
+        cache.ensure_kinds(TWA_KINDS)
+    elif use_cache:
+        cache = ReadCache(
+            cluster, TWA_KINDS, metrics=app.web_metrics
+        ).start()
+        app.on_close(cache.close)
 
     app.attach_frontend("tensorboards")
     base.add_namespaces_route(app, cluster)
 
     @app.route("/api/namespaces/<namespace>/tensorboards")
     def list_tensorboards(request, namespace):
-        app.ensure(request, "list", "tensorboards", namespace)
+        user = app.ensure(request, "list", "tensorboards", namespace)
+        etag = (
+            cache.etag(("Tensorboard", namespace), principal=user.name)
+            if cache is not None else None
+        )
+        hit = base.not_modified(request, etag)
+        if hit is not None:
+            return hit
+        tbs = (
+            cache.list(
+                "Tensorboard", namespace, principal=user.name, copy=False
+            )
+            if cache is not None
+            else cluster.list("Tensorboard", namespace)
+        )
         out = []
-        for tb in cluster.list("Tensorboard", namespace):
+        for tb in tbs:
             scheme, _ = parse_logspath(tb["spec"].get("logspath", ""))
             ready = tb.get("status", {}).get("readyReplicas", 0)
             out.append(
@@ -36,19 +66,28 @@ def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) ->
                     "phase": "ready" if ready else "waiting",
                 }
             )
-        return success("tensorboards", out)
+        return base.set_etag(success("tensorboards", out), etag)
 
     @app.route("/api/namespaces/<namespace>/tensorboards", methods=("POST",))
     def post_tensorboard(request, namespace):
-        app.ensure(request, "create", "tensorboards", namespace)
+        user = app.ensure(request, "create", "tensorboards", namespace)
         body = get_json(request, "name", "logspath")
-        cluster.create(api.tensorboard(body["name"], namespace, body["logspath"]))
+        stored = cluster.create(
+            api.tensorboard(body["name"], namespace, body["logspath"])
+        )
+        if cache is not None:
+            cache.note_write(stored, principal=user.name)
         return success("message", "Tensorboard created successfully.")
 
     @app.route("/api/namespaces/<namespace>/tensorboards/<name>")
     def get_tensorboard(request, namespace, name):
-        app.ensure(request, "get", "tensorboards", namespace)
-        return success("tensorboard", cluster.get("Tensorboard", name, namespace))
+        user = app.ensure(request, "get", "tensorboards", namespace)
+        tb = (
+            cache.get("Tensorboard", name, namespace, principal=user.name)
+            if cache is not None
+            else cluster.get("Tensorboard", name, namespace)
+        )
+        return success("tensorboard", tb)
 
     @app.route(
         "/api/namespaces/<namespace>/tensorboards/<name>", methods=("PUT",)
@@ -56,7 +95,7 @@ def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) ->
     def put_tensorboard(request, namespace, name):
         """Editable-YAML apply (editor module save path), authz'd as update;
         ?dryRun=true validates without persisting."""
-        app.ensure(request, "update", "tensorboards", namespace)
+        user = app.ensure(request, "update", "tensorboards", namespace)
 
         def validate(tb: dict) -> list[str]:
             logspath = (tb.get("spec") or {}).get("logspath")
@@ -70,15 +109,20 @@ def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) ->
             return []
 
         return base.handle_cr_put(
-            request, cluster, "Tensorboard", name, namespace, validate=validate
+            request, cluster, "Tensorboard", name, namespace,
+            validate=validate, cache=cache, principal=user.name,
         )
 
     @app.route(
         "/api/namespaces/<namespace>/tensorboards/<name>", methods=("DELETE",)
     )
     def delete_tensorboard(request, namespace, name):
-        app.ensure(request, "delete", "tensorboards", namespace)
+        user = app.ensure(request, "delete", "tensorboards", namespace)
         cluster.delete("Tensorboard", name, namespace)
+        if cache is not None:
+            cache.note_delete(
+                "Tensorboard", name, namespace, principal=user.name
+            )
         return success("message", "Tensorboard deleted")
 
     return app

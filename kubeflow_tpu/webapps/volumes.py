@@ -11,6 +11,9 @@ from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.runtime.fake import FakeCluster
 from kubeflow_tpu.webapps import base
 from kubeflow_tpu.webapps.base import App, get_json, success
+from kubeflow_tpu.webapps.cache import ReadCache
+
+VWA_KINDS = ("PersistentVolumeClaim", "Pod")
 
 
 def pods_using_pvc(cluster: FakeCluster, namespace: str, claim: str) -> list[str]:
@@ -22,17 +25,55 @@ def pods_using_pvc(cluster: FakeCluster, namespace: str, claim: str) -> list[str
     return out
 
 
-def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) -> App:
+def create_app(
+    cluster: FakeCluster,
+    *,
+    authorizer: Authorizer | None = None,
+    cache: ReadCache | None = None,
+    use_cache: bool = True,
+) -> App:
     app = App("volumes-web-app", authorizer=authorizer or Authorizer(cluster))
+    if cache is not None:
+        cache.ensure_kinds(VWA_KINDS)
+    elif use_cache:
+        cache = ReadCache(
+            cluster, VWA_KINDS, metrics=app.web_metrics
+        ).start()
+        app.on_close(cache.close)
+
+    def _used_by(namespace: str, claim: str) -> list[str]:
+        # pods-by-claim index: the "used by" column without an
+        # O(pvcs x pods) scan per render
+        if cache is not None:
+            return cache.pods_using_claim(namespace, claim)
+        return pods_using_pvc(cluster, namespace, claim)
 
     app.attach_frontend("volumes")
     base.add_namespaces_route(app, cluster)
 
     @app.route("/api/namespaces/<namespace>/pvcs")
     def list_pvcs(request, namespace):
-        app.ensure(request, "list", "persistentvolumeclaims", namespace)
+        user = app.ensure(request, "list", "persistentvolumeclaims", namespace)
+        etag = (
+            cache.etag(
+                ("PersistentVolumeClaim", namespace), ("Pod", namespace),
+                principal=user.name,
+            )
+            if cache is not None else None
+        )
+        hit = base.not_modified(request, etag)
+        if hit is not None:
+            return hit
+        pvcs = (
+            cache.list(
+                "PersistentVolumeClaim", namespace,
+                principal=user.name, copy=False,
+            )
+            if cache is not None
+            else cluster.list("PersistentVolumeClaim", namespace)
+        )
         out = []
-        for pvc in cluster.list("PersistentVolumeClaim", namespace):
+        for pvc in pvcs:
             out.append(
                 {
                     "name": ko.name(pvc),
@@ -43,15 +84,15 @@ def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) ->
                     .get("storage"),
                     "modes": pvc.get("spec", {}).get("accessModes", []),
                     "class": pvc.get("spec", {}).get("storageClassName"),
-                    "usedBy": pods_using_pvc(cluster, namespace, ko.name(pvc)),
+                    "usedBy": _used_by(namespace, ko.name(pvc)),
                     "status": pvc.get("status", {}).get("phase", "Bound"),
                 }
             )
-        return success("pvcs", out)
+        return base.set_etag(success("pvcs", out), etag)
 
     @app.route("/api/namespaces/<namespace>/pvcs", methods=("POST",))
     def post_pvc(request, namespace):
-        app.ensure(request, "create", "persistentvolumeclaims", namespace)
+        user = app.ensure(request, "create", "persistentvolumeclaims", namespace)
         body = get_json(request, "name", "size", "mode")
         pvc = {
             "apiVersion": "v1",
@@ -64,18 +105,26 @@ def create_app(cluster: FakeCluster, *, authorizer: Authorizer | None = None) ->
         }
         if body.get("class"):
             pvc["spec"]["storageClassName"] = body["class"]
-        cluster.create(pvc)
+        stored = cluster.create(pvc)
+        if cache is not None:
+            cache.note_write(stored, principal=user.name)
         return success("message", "PVC created successfully.")
 
     @app.route("/api/namespaces/<namespace>/pvcs/<name>", methods=("DELETE",))
     def delete_pvc(request, namespace, name):
-        app.ensure(request, "delete", "persistentvolumeclaims", namespace)
+        user = app.ensure(request, "delete", "persistentvolumeclaims", namespace)
+        # in-use protection reads the authoritative store, not the cache: a
+        # pod bound seconds ago must block the delete even mid-staleness
         users = pods_using_pvc(cluster, namespace, name)
         if users:
             raise ValueError(
                 f"PVC {name} is in use by pods: {', '.join(users)}"
             )
         cluster.delete("PersistentVolumeClaim", name, namespace)
+        if cache is not None:
+            cache.note_delete(
+                "PersistentVolumeClaim", name, namespace, principal=user.name
+            )
         return success("message", "PVC deleted")
 
     return app

@@ -1,0 +1,226 @@
+"""Read-path serve loadtest (the LOADTEST_SERVE family).
+
+The BFF read fast path's claim (webapps/cache.py, NotebookOS argument) is
+that serving interactive reads from replicated in-memory state — instead of
+O(fleet) list+join against the authoritative store — is worth multiples of
+requests/s at fleet scale. This driver measures that claim as an A/B on the
+SAME host in the SAME artifact:
+
+- builds an in-proc world: N notebook sessions (+2 Events each, so the
+  per-render status join is real) in one namespace;
+- **uncached** arm: the JWA built with ``use_cache=False`` — every GET
+  re-lists all Notebooks and all Events and joins them per notebook;
+- **cached** arm: the JWA on the watch-backed ReadCache with revalidating
+  readers (each reader echoes the last ETag via If-None-Match, the UI's
+  real poll behavior) — unchanged worlds serve as 304 with no
+  serialization, changed worlds serve indexed 200s;
+- M concurrent readers hammer ``GET /api/namespaces/<ns>/notebooks`` for a
+  fixed window per arm; reports requests/s + p50/p99 per arm and the
+  cached/uncached speedup.
+
+Prints one JSON line (bench.py contract). ``--check-against`` gates the
+number against the committed baseline (``benchmarks/serve_baseline.json``),
+same contract as bench_scheduler's SCHED_BENCH gate: requests/s within
+``--tolerance`` of the baseline AND the A/B speedup at least the baseline's
+``min_speedup`` floor — losing the read fast path (a >=5x cliff) can never
+ship green.
+
+Usage:
+    python loadtest/serve_latency.py --sessions 1000 --readers 4
+    python loadtest/serve_latency.py --check-against benchmarks/serve_baseline.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from werkzeug.test import Client
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.auth.rbac import Authorizer
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.webapps import jupyter
+
+NAMESPACE = "load"
+USER = "bench@loadtest"
+HEADERS = {"kubeflow-userid": USER}
+
+
+def percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    values = sorted(values)
+    idx = min(len(values) - 1, int(q * len(values)))
+    return values[idx]
+
+
+def build_world(sessions: int) -> FakeCluster:
+    cluster = FakeCluster()
+    cluster.create({"apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": NAMESPACE}})
+    for i in range(sessions):
+        nb = cluster.create(api.notebook(f"session-{i:05d}", NAMESPACE))
+        # two Events per session: the status join the index exists to kill
+        cluster.emit_event(nb, "Created", "Created StatefulSet session")
+        cluster.emit_event(nb, "Started", "Notebook server started")
+    return cluster
+
+
+def run_phase(
+    app, *, readers: int, seconds: float, revalidate: bool
+) -> dict:
+    path = f"/api/namespaces/{NAMESPACE}/notebooks"
+    stop_at = time.perf_counter() + seconds
+    lock = threading.Lock()
+    latencies: list[float] = []
+    statuses = {"200": 0, "304": 0, "other": 0}
+
+    def reader() -> None:
+        client = Client(app)
+        etag: str | None = None
+        local_lat: list[float] = []
+        local_status = {"200": 0, "304": 0, "other": 0}
+        while time.perf_counter() < stop_at:
+            headers = dict(HEADERS)
+            if revalidate and etag:
+                headers["If-None-Match"] = etag
+            t0 = time.perf_counter()
+            resp = client.get(path, headers=headers)
+            local_lat.append(time.perf_counter() - t0)
+            code = str(resp.status_code)
+            local_status[code if code in local_status else "other"] += 1
+            if revalidate:
+                etag = resp.headers.get("ETag") or etag
+            resp.close()
+        with lock:
+            latencies.extend(local_lat)
+            for k, v in local_status.items():
+                statuses[k] += v
+
+    threads = [threading.Thread(target=reader) for _ in range(readers)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    n = len(latencies)
+    return {
+        "rps": round(n / elapsed, 1) if elapsed else 0.0,
+        "p50_ms": round(percentile(latencies, 0.5) * 1e3, 3),
+        "p99_ms": round(percentile(latencies, 0.99) * 1e3, 3),
+        "n": n,
+        "status_200": statuses["200"],
+        "status_304": statuses["304"],
+        "status_other": statuses["other"],
+    }
+
+
+def run(sessions: int, readers: int, seconds: float) -> dict:
+    cluster = build_world(sessions)
+    authorizer = Authorizer(cluster, cluster_admins={USER})
+
+    uncached_app = jupyter.create_app(
+        cluster, authorizer=authorizer, use_cache=False
+    )
+    uncached = run_phase(
+        uncached_app, readers=readers, seconds=seconds, revalidate=False
+    )
+    uncached_app.close()
+
+    cached_app = jupyter.create_app(cluster, authorizer=authorizer)
+    # revalidating readers: the UI's actual poll loop (ETag echo). A warm-up
+    # request primes each reader's ETag outside the measured window.
+    cached = run_phase(
+        cached_app, readers=readers, seconds=seconds, revalidate=True
+    )
+    # full-render arm (no If-None-Match): what a cold client pays against
+    # the cache — indexes without the 304 shortcut
+    cached_full = run_phase(
+        cached_app, readers=readers, seconds=seconds, revalidate=False
+    )
+    cached_app.close()
+
+    speedup = (
+        round(cached["rps"] / uncached["rps"], 2) if uncached["rps"] else 0.0
+    )
+    return {
+        "metric": "serve_list_requests_per_s",
+        "value": cached["rps"],
+        "unit": "req/s",
+        "sessions": sessions,
+        "readers": readers,
+        "window_s": seconds,
+        "cached": cached,
+        "cached_full": cached_full,
+        "uncached": uncached,
+        "speedup_vs_uncached": speedup,
+        "host_cores": os.cpu_count(),
+    }
+
+
+def check_against(result: dict, baseline_path: str, tolerance: float) -> int:
+    """CI perf gate (bench.yaml): requests/s within tolerance of the
+    committed baseline AND the A/B speedup at least the baseline floor."""
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    base_rps = float(baseline["requests_per_s"])
+    min_speedup = float(baseline.get("min_speedup", 5.0))
+    new_rps = float(result["value"])
+    speedup = float(result["speedup_vs_uncached"])
+    floor = base_rps * (1.0 - tolerance)
+    print(
+        f"LOADTEST_SERVE gate: {new_rps:.1f} req/s vs baseline "
+        f"{base_rps:.1f} (floor {floor:.1f} at {tolerance:.0%} tolerance); "
+        f"A/B speedup {speedup:.1f}x vs floor {min_speedup:.1f}x",
+        file=sys.stderr,
+    )
+    failed = False
+    if new_rps < floor:
+        print(
+            "LOADTEST_SERVE REGRESSED: re-establish the read fast path or "
+            "re-record benchmarks/serve_baseline.json with a justified new "
+            "number",
+            file=sys.stderr,
+        )
+        failed = True
+    if speedup < min_speedup:
+        print(
+            f"LOADTEST_SERVE A/B speedup {speedup:.1f}x fell below the "
+            f"{min_speedup:.1f}x floor — the cache is no longer paying for "
+            "itself on the list endpoint",
+            file=sys.stderr,
+        )
+        failed = True
+    return 1 if failed else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sessions", type=int, default=1000)
+    ap.add_argument("--readers", type=int, default=4)
+    ap.add_argument("--seconds", type=float, default=2.0,
+                    help="measured window per arm")
+    ap.add_argument("--check-against", metavar="BASELINE_JSON",
+                    help="compare against a committed baseline "
+                         "(benchmarks/serve_baseline.json)")
+    ap.add_argument("--tolerance", type=float, default=0.20,
+                    help="allowed fractional requests/s regression with "
+                         "--check-against (default 0.20)")
+    args = ap.parse_args(argv)
+    result = run(args.sessions, args.readers, args.seconds)
+    print(json.dumps(result))
+    if args.check_against:
+        return check_against(result, args.check_against, args.tolerance)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -278,6 +278,59 @@ class TestExpositionFormat:
             "histogram"
         )
 
+    def test_webapp_and_readcache_families_lint(self):
+        """The BFF read-path families (utils/metrics.py WebAppMetrics +
+        webapps/cache.py): a served-and-revalidated JWA on the combined
+        registry exposes webapp_request_seconds{route,status} plus the
+        cache hit/staleness/watch families, all grammar-valid."""
+        from werkzeug.test import Client
+
+        from kubeflow_tpu.auth.rbac import Authorizer
+        from kubeflow_tpu.webapps import jupyter
+
+        nm = NotebookMetrics()
+        ControlPlaneMetrics(nm.registry)
+        cluster = FakeCluster()
+        app = jupyter.create_app(
+            cluster, authorizer=Authorizer(cluster, cluster_admins={"m@x"}),
+            metrics=nm,
+        )
+        client = Client(app)
+        headers = {"kubeflow-userid": "m@x"}
+        cluster.create(api.notebook("lint-nb", "lint-ns"))
+        first = client.get("/api/namespaces/lint-ns/notebooks", headers=headers)
+        client.get(
+            "/api/namespaces/lint-ns/notebooks",
+            headers={**headers, "If-None-Match": first.headers["ETag"]},
+        )
+        families = parse_exposition(nm.registry.expose())
+        check_histograms(families)
+        assert families["webapp_request_seconds"]["type"] == "histogram"
+        for name in (
+            "webapp_responses_not_modified_total",
+            "webapp_responses_gzipped_total",
+            "webapp_cache_reads_total",
+            "webapp_cache_relists_total",
+            "webapp_cache_watch_events_total",
+        ):
+            assert families[name]["type"] == "counter", name
+        for name in ("webapp_cache_objects", "webapp_cache_staleness_seconds"):
+            assert families[name]["type"] == "gauge", name
+        # the histogram carries the served requests, labeled by route
+        # pattern and status — and the 304 counted as such
+        samples = families["webapp_request_seconds"]["samples"]
+        assert any(
+            l.get("route") == "/api/namespaces/<namespace>/notebooks"
+            and l.get("status") == "304"
+            for s, l, v in samples
+            if s.endswith("_count") and v > 0
+        )
+        assert any(
+            l.get("kind") == "Notebook" and l.get("source") == "cache" and v > 0
+            for _, l, v in families["webapp_cache_reads_total"]["samples"]
+        )
+        app.close()
+
     def test_no_duplicate_families_with_web_apps(self):
         # two Apps + the domain registries on one registry (the ops-port
         # sharing pattern): still one HELP/TYPE per family

@@ -1,0 +1,459 @@
+"""BFF read fast path (webapps/cache.py): watch-backed ReadCache semantics
+over real WSGI requests plus direct cache-level properties.
+
+What ISSUE 9 pins down:
+- read-your-writes: a POST/PATCH/PUT/DELETE acknowledged to a session is
+  visible in that session's immediate re-list even when every watch stream
+  is severed (write-through + rv pin);
+- HTTP revalidation: If-None-Match hit -> 304 with no body, miss -> 200
+  with a fresh ETag, any write -> the old ETag stops matching;
+- gzip negotiation: large JSON compresses only for Accept-Encoding: gzip;
+- cold start: a cache whose watches never synced serves via fallback list;
+- bounded staleness: stale replays of deleted objects are tombstoned, and
+  a cache that cannot confirm freshness inside the bound reads through
+  (erroring loudly rather than answering stale).
+"""
+from __future__ import annotations
+
+import gzip
+import json
+
+import pytest
+from werkzeug.test import Client
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.auth.rbac import Authorizer
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.controllers.profile_controller import ProfileReconciler
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import ServerError
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.testing.chaos import ChaosCluster, ChaosConfig
+from kubeflow_tpu.webapps import jupyter, volumes
+from kubeflow_tpu.webapps.cache import ReadCache
+from kubeflow_tpu.webhooks import tpu_env
+
+ALICE = {"kubeflow-userid": "alice@x.io"}
+
+from conftest import cookie_value as _cookie_value  # noqa: E402
+
+
+def auth(client, headers=ALICE):
+    value = _cookie_value(client, "XSRF-TOKEN")
+    if value is None:
+        client.get("/healthz/liveness")
+        value = _cookie_value(client, "XSRF-TOKEN")
+    return {**headers, "X-XSRF-TOKEN": value}
+
+
+def body_of(resp):
+    return json.loads(resp.get_data(as_text=True))
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture()
+def platform(cluster):
+    m = Manager(cluster)
+    m.register(NotebookReconciler())
+    m.register(ProfileReconciler())
+    tpu_env.install(cluster)
+    cluster.create(api.profile("alice", "alice@x.io"))
+    m.run_until_idle()
+    return cluster, m
+
+
+# ----------------------------------------------------------- read-your-writes
+
+
+class TestReadYourWrites:
+    def test_post_then_immediate_list_with_watches_severed(self, platform):
+        """The RYW acceptance case: every watch stream drops BEFORE the
+        write (injected infinite watch latency) — the spawner's immediate
+        redirect-to-list must still show the new notebook."""
+        cluster, m = platform
+        chaos = ChaosCluster(cluster, seed=1, config=ChaosConfig.quiet())
+        app = jupyter.create_app(
+            chaos, authorizer=Authorizer(cluster)
+        )
+        client = Client(app)
+        chaos.drop_all_watches()  # cache now sees no events at all
+
+        r = client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "ryw-nb", "cpu": "1", "memory": "2Gi"},
+            headers=auth(client),
+        )
+        assert body_of(r)["success"], r.get_data()
+        r = client.get("/api/namespaces/alice/notebooks", headers=ALICE)
+        names = [nb["name"] for nb in body_of(r)["notebooks"]]
+        assert "ryw-nb" in names
+        app.close()
+
+    def test_patch_then_detail_sees_stop_annotation(self, platform):
+        cluster, m = platform
+        chaos = ChaosCluster(cluster, seed=2, config=ChaosConfig.quiet())
+        app = jupyter.create_app(chaos, authorizer=Authorizer(cluster))
+        client = Client(app)
+        client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "stopme", "cpu": "1", "memory": "2Gi"},
+            headers=auth(client),
+        )
+        chaos.drop_all_watches()
+        r = client.patch(
+            "/api/namespaces/alice/notebooks/stopme",
+            json={"stopped": True},
+            headers=auth(client),
+        )
+        assert body_of(r)["success"]
+        r = client.get("/api/namespaces/alice/notebooks", headers=ALICE)
+        nb = next(
+            n for n in body_of(r)["notebooks"] if n["name"] == "stopme"
+        )
+        assert nb["status"]["phase"] in ("terminating", "stopped")
+        app.close()
+
+    def test_delete_then_immediate_list_excludes(self, platform):
+        cluster, m = platform
+        chaos = ChaosCluster(cluster, seed=3, config=ChaosConfig.quiet())
+        app = jupyter.create_app(chaos, authorizer=Authorizer(cluster))
+        client = Client(app)
+        client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "gone", "cpu": "1", "memory": "2Gi"},
+            headers=auth(client),
+        )
+        chaos.drop_all_watches()
+        r = client.delete(
+            "/api/namespaces/alice/notebooks/gone", headers=auth(client)
+        )
+        assert body_of(r)["success"]
+        r = client.get("/api/namespaces/alice/notebooks", headers=ALICE)
+        assert "gone" not in [n["name"] for n in body_of(r)["notebooks"]]
+        app.close()
+
+
+# -------------------------------------------------------------------- ETags
+
+
+class TestETags:
+    def test_if_none_match_hit_miss_and_after_write(self, platform):
+        cluster, m = platform
+        app = jupyter.create_app(cluster, authorizer=Authorizer(cluster))
+        client = Client(app)
+        client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "etag-nb", "cpu": "1", "memory": "2Gi"},
+            headers=auth(client),
+        )
+
+        r1 = client.get("/api/namespaces/alice/notebooks", headers=ALICE)
+        assert r1.status_code == 200
+        etag = r1.headers.get("ETag")
+        assert etag, "list response must carry an ETag"
+
+        # hit: unchanged world revalidates to an empty 304
+        r2 = client.get(
+            "/api/namespaces/alice/notebooks",
+            headers={**ALICE, "If-None-Match": etag},
+        )
+        assert r2.status_code == 304
+        assert r2.get_data() == b""
+        assert r2.headers.get("ETag") == etag
+
+        # miss: a wrong tag serves the full 200
+        r3 = client.get(
+            "/api/namespaces/alice/notebooks",
+            headers={**ALICE, "If-None-Match": '"bogus"'},
+        )
+        assert r3.status_code == 200
+
+        # after-write: any mutation invalidates the old tag
+        client.patch(
+            "/api/namespaces/alice/notebooks/etag-nb",
+            json={"stopped": True},
+            headers=auth(client),
+        )
+        r4 = client.get(
+            "/api/namespaces/alice/notebooks",
+            headers={**ALICE, "If-None-Match": etag},
+        )
+        assert r4.status_code == 200
+        assert r4.headers.get("ETag") != etag
+        app.close()
+
+    def test_etag_changes_when_an_event_lands(self, platform):
+        """The list ETag covers the Event scope too: a new Event changes
+        the derived status column, so the old tag must stop matching."""
+        cluster, m = platform
+        app = jupyter.create_app(cluster, authorizer=Authorizer(cluster))
+        client = Client(app)
+        client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "ev-nb", "cpu": "1", "memory": "2Gi"},
+            headers=auth(client),
+        )
+        r1 = client.get("/api/namespaces/alice/notebooks", headers=ALICE)
+        etag = r1.headers["ETag"]
+        nb = cluster.get("Notebook", "ev-nb", "alice")
+        cluster.emit_event(nb, "OOM", "host 3 died", "Warning")
+        r2 = client.get(
+            "/api/namespaces/alice/notebooks",
+            headers={**ALICE, "If-None-Match": etag},
+        )
+        assert r2.status_code == 200  # not a stale 304
+        assert any(
+            n["status"]["message"] == "host 3 died"
+            for n in body_of(r2)["notebooks"]
+        )
+        app.close()
+
+
+# --------------------------------------------------------------------- gzip
+
+
+class TestGzip:
+    def test_gzip_negotiation(self, platform):
+        cluster, m = platform
+        app = jupyter.create_app(cluster, authorizer=Authorizer(cluster))
+        client = Client(app)
+        for i in range(30):  # enough rows to clear the size floor
+            client.post(
+                "/api/namespaces/alice/notebooks",
+                json={"name": f"z-{i:02d}", "cpu": "1", "memory": "2Gi"},
+                headers=auth(client),
+            )
+        r = client.get(
+            "/api/namespaces/alice/notebooks",
+            headers={**ALICE, "Accept-Encoding": "gzip"},
+        )
+        assert r.headers.get("Content-Encoding") == "gzip"
+        assert r.headers.get("Vary") == "Accept-Encoding"
+        payload = json.loads(gzip.decompress(r.get_data()))
+        assert len(payload["notebooks"]) == 30
+
+        plain = client.get("/api/namespaces/alice/notebooks", headers=ALICE)
+        assert plain.headers.get("Content-Encoding") is None
+        assert len(body_of(plain)["notebooks"]) == 30
+
+        # a 304 never compresses (it has no body to compress)
+        etag = r.headers["ETag"]
+        r304 = client.get(
+            "/api/namespaces/alice/notebooks",
+            headers={**ALICE, "Accept-Encoding": "gzip",
+                     "If-None-Match": etag},
+        )
+        assert r304.status_code == 304
+        assert r304.headers.get("Content-Encoding") is None
+        app.close()
+
+
+# ---------------------------------------------------------- cache semantics
+
+
+class TestReadCacheSemantics:
+    def test_cold_start_serves_via_fallback_list(self, cluster):
+        """A cache whose watches never synced (start() not called — the
+        KubeClient watch thread hasn't connected yet) still answers, via
+        the authoritative list, and warms itself from it."""
+        cluster.create(api.notebook("cold", "ns1"))
+        clock = _Clock()
+        cache = ReadCache(cluster, ("Notebook",), clock=clock)
+        out = cache.list("Notebook", "ns1")
+        assert [ko.name(o) for o in out] == ["cold"]
+        # the fallback confirmed freshness: the next read inside the resync
+        # interval serves from memory
+        out2 = cache.list("Notebook", "ns1")
+        assert [ko.name(o) for o in out2] == ["cold"]
+
+    def test_stale_readd_of_deleted_object_is_tombstoned(self, cluster):
+        clock = _Clock()
+        cache = ReadCache(cluster, ("Notebook",), clock=clock).start()
+        nb = cluster.create(api.notebook("ghost", "ns1"))
+        cluster.delete("Notebook", "ghost", "ns1")
+        # a severed-then-reconnected stream replays the OLD object as ADDED
+        handler = cache._handlers[0]
+        handler("ADDED", nb)
+        assert cache.list("Notebook", "ns1") == []
+        # a genuine recreate (fresh, higher rv) goes through
+        cluster.create(api.notebook("ghost", "ns1"))
+        assert [ko.name(o) for o in cache.list("Notebook", "ns1")] == ["ghost"]
+
+    def test_note_delete_after_watch_delete_keeps_tombstone_rv(self, cluster):
+        """The handler-delete flow: cluster.delete notifies the watch
+        handler synchronously (tombstone at the final rv), then the handler
+        calls note_delete on the now-absent key. The second remove must not
+        clobber the recorded rv — a stale re-list replay of the deleted
+        object would otherwise resurrect it."""
+        clock = _Clock()
+        cache = ReadCache(cluster, ("Notebook",), clock=clock).start()
+        nb = cluster.create(api.notebook("twice", "ns1"))
+        cluster.delete("Notebook", "twice", "ns1")  # watch DELETED fires
+        cache.note_delete("Notebook", "twice", "ns1", principal="u")
+        handler = cache._handlers[0]
+        handler("ADDED", nb)  # stale replay from a reconnecting stream
+        assert cache.list("Notebook", "ns1") == []
+
+    def test_missed_delete_recovered_by_resync(self, cluster):
+        clock = _Clock()
+        chaos = ChaosCluster(cluster, seed=7, config=ChaosConfig.quiet())
+        cache = ReadCache(
+            chaos, ("Notebook",), clock=clock,
+            resync_interval_s=5.0, staleness_bound_s=30.0,
+        ).start()
+        cluster.create(api.notebook("doomed", "ns1"))
+        clock.advance(6.0)
+        assert [ko.name(o) for o in cache.list("Notebook", "ns1")] == ["doomed"]
+        chaos.drop_all_watches()
+        cluster.delete("Notebook", "doomed", "ns1")  # DELETED never arrives
+        clock.advance(6.0)  # past the resync interval: the rv poll diverges
+        assert cache.list("Notebook", "ns1") == []
+
+    def test_unconfirmable_past_bound_reads_through_and_errors_loudly(
+        self, cluster
+    ):
+        """Beyond the staleness bound an unconfirmable cache must NOT keep
+        answering from memory: it reads through, and if the cluster is
+        down the request fails (a loud error, never a stale answer)."""
+        clock = _Clock()
+        chaos = ChaosCluster(cluster, seed=8, config=ChaosConfig.quiet())
+        cache = ReadCache(
+            chaos, ("Notebook",), clock=clock,
+            resync_interval_s=5.0, staleness_bound_s=30.0,
+        ).start()
+        cluster.create(api.notebook("held", "ns1"))
+        assert len(cache.list("Notebook", "ns1")) == 1
+        chaos.outage = True  # total blackout: confirms and fallbacks fail
+        clock.advance(10.0)  # inside the bound: memory still certified
+        assert len(cache.list("Notebook", "ns1")) == 1
+        clock.advance(40.0)  # past the bound
+        with pytest.raises(ServerError):
+            cache.list("Notebook", "ns1")
+
+    def test_events_involved_index_matches_events_for(self, cluster):
+        cache = ReadCache(cluster, ("Event",)).start()
+        nb = cluster.create(api.notebook("idx", "ns1"))
+        other = cluster.create(api.notebook("other", "ns1"))
+        cluster.emit_event(nb, "Created", "m1")
+        cluster.emit_event(other, "Created", "m2")
+        cluster.emit_event(nb, "Started", "m3")
+        got = {e["message"] for e in cache.events_for(nb)}
+        want = {e["message"] for e in cluster.events_for(nb)}
+        assert got == want == {"m1", "m3"}
+
+    def test_events_index_is_uid_aware_across_recreate(self, cluster):
+        cache = ReadCache(cluster, ("Event",)).start()
+        nb = cluster.create(api.notebook("reborn", "ns1"))
+        cluster.emit_event(nb, "Created", "old incarnation")
+        cluster.delete("Notebook", "reborn", "ns1")
+        nb2 = cluster.create(api.notebook("reborn", "ns1"))
+        cluster.emit_event(nb2, "Created", "new incarnation")
+        assert [e["message"] for e in cache.events_for(nb2)] == [
+            "new incarnation"
+        ]
+
+    def test_nodes_by_accelerator_index(self, cluster):
+        cache = ReadCache(cluster, ("Node",)).start()
+        cluster.add_tpu_node_pool("v4", "2x2x2")
+        cluster.add_tpu_node_pool("v5e", "4x4")
+        v4 = cache.nodes_for_accelerator("tpu-v4-podslice")
+        assert v4 and all(
+            n["metadata"]["labels"]["cloud.google.com/gke-tpu-accelerator"]
+            == "tpu-v4-podslice"
+            for n in v4
+        )
+
+    def test_pods_by_claim_index(self, platform):
+        cluster, m = platform
+        cache = ReadCache(cluster, ("Pod", "PersistentVolumeClaim")).start()
+        app = jupyter.create_app(cluster, authorizer=Authorizer(cluster))
+        client = Client(app)
+        client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "vol-nb", "cpu": "1", "memory": "2Gi"},
+            headers=auth(client),
+        )
+        cluster.settle(m)
+        claim = "vol-nb-workspace"
+        assert cache.pods_using_claim("alice", claim) == [
+            p
+            for p in (
+                ko.name(pod) for pod in cluster.list("Pod", "alice")
+            )
+        ]
+        app.close()
+
+    def test_shared_cache_across_apps_lazily_adds_kinds(self, platform):
+        cluster, m = platform
+        shared = ReadCache(cluster, ("Notebook",)).start()
+        vapp = volumes.create_app(
+            cluster, authorizer=Authorizer(cluster), cache=shared
+        )
+        assert "PersistentVolumeClaim" in shared._stores  # ensure_kinds ran
+        client = Client(vapp)
+        r = client.get("/api/namespaces/alice/pvcs", headers=ALICE)
+        assert body_of(r)["success"]
+        vapp.close()
+
+
+# ----------------------------------------------------------------- metrics
+
+
+class TestWebAppMetricsExposition:
+    def test_request_and_cache_families_exposed(self, platform):
+        from tests.test_metrics_exposition import (
+            check_histograms,
+            parse_exposition,
+        )
+
+        cluster, m = platform
+        app = jupyter.create_app(cluster, authorizer=Authorizer(cluster))
+        client = Client(app)
+        client.post(
+            "/api/namespaces/alice/notebooks",
+            json={"name": "m-nb", "cpu": "1", "memory": "2Gi"},
+            headers=auth(client),
+        )
+        r = client.get("/api/namespaces/alice/notebooks", headers=ALICE)
+        etag = r.headers["ETag"]
+        client.get(
+            "/api/namespaces/alice/notebooks",
+            headers={**ALICE, "If-None-Match": etag},
+        )
+        families = parse_exposition(app.metrics_registry.expose())
+        check_histograms(families)
+        for family in (
+            "webapp_request_seconds",
+            "webapp_responses_not_modified_total",
+            "webapp_cache_reads_total",
+            "webapp_cache_objects",
+            "webapp_cache_staleness_seconds",
+            "webapp_cache_relists_total",
+            "webapp_cache_watch_events_total",
+        ):
+            assert family in families, f"{family} missing from exposition"
+        # the request histogram labels by route pattern, not raw path
+        routes = {
+            labels.get("route")
+            for _, labels, _ in families["webapp_request_seconds"]["samples"]
+        }
+        assert "/api/namespaces/<namespace>/notebooks" in routes
+        # the revalidated poll was counted as a 304
+        nm = {
+            labels["route"]: value
+            for _, labels, value in families[
+                "webapp_responses_not_modified_total"
+            ]["samples"]
+        }
+        assert nm.get("/api/namespaces/<namespace>/notebooks", 0) >= 1
+        app.close()

@@ -209,12 +209,17 @@ def build_manager(
         store_root = os.environ.get(
             "SESSIONS_STORE_DIR", "/var/lib/kubeflow-tpu/sessions"
         )
+        session_metrics = SessionMetrics(metrics.registry)
         manager.register(
             SessionReconciler(
-                SnapshotStore(FileObjectStore(store_root)),
+                # the store emits the chunk-level families itself (bytes,
+                # dedup ratio, chunk-pool queue depth)
+                SnapshotStore(
+                    FileObjectStore(store_root), metrics=session_metrics
+                ),
                 HttpSessionAgent(cfg.cluster_domain),
                 config=cfg,
-                metrics=SessionMetrics(metrics.registry),
+                metrics=session_metrics,
                 recorder=EventRecorder(),
             )
         )

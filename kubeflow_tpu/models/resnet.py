@@ -169,6 +169,14 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        if self.bn_impl not in ("xla", "pallas", "mxu"):
+            # a typo like 'MXU' would otherwise silently select the Pallas
+            # path — the one the comment above documents as a net loss
+            # inside the conv step
+            raise ValueError(
+                f"bn_impl must be one of ('xla', 'pallas', 'mxu'), "
+                f"got {self.bn_impl!r}"
+            )
         conv = partial(nn.Conv, dtype=self.dtype, param_dtype=jnp.float32)
         if self.bn_impl == "xla":
             norm = partial(

@@ -206,6 +206,20 @@ class TimelineRecorder:
             if marks:
                 self._patch(cluster, nb, None)
             return
+        if (
+            queued_at is not None
+            and "queuedAt" in marks
+            and queued_at > marks["queuedAt"] + 1e-6
+        ):
+            # a queue admission NEWER than the one the marks record: these
+            # marks belong to a PREVIOUS start whose teardown wipe was
+            # lost (the stop dropped the gang's seniority, the wipe patch
+            # hit an API fault, and the gang restarted before the retry).
+            # Level-triggered self-repair: this reconcile is observing a
+            # new start, so rebuild the timeline from scratch instead of
+            # splicing two starts into one sequence — the stale-mark
+            # inconsistency the soak's cross-source audit flags.
+            marks = {}
         new: dict[str, float] = {}
         floor = max(marks.values()) if marks else None
         order = {m: i for i, m in enumerate(MARKS)}

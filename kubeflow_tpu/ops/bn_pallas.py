@@ -224,7 +224,10 @@ def channel_moments_mxu(x):
     )
     s2 = jnp.diagonal(gram)
     mean = s1 / m
-    var = s2 / m - mean * mean
+    # clamp like every other path: cancellation in E[x^2] - mean^2 goes
+    # negative for large-mean/low-variance channels, and a negative var
+    # NaNs rsqrt AND poisons the running-var EMA
+    var = jnp.maximum(s2 / m - mean * mean, 0.0)
     return mean, var
 
 
@@ -255,7 +258,10 @@ def _moments(x, strategy: str):
         # small-m/large-C tail: the XLA reduce is already cheap there
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=tuple(range(x.ndim - 1)))
-        var = jnp.mean(xf * xf, axis=tuple(range(x.ndim - 1))) - mean * mean
+        var = jnp.maximum(
+            jnp.mean(xf * xf, axis=tuple(range(x.ndim - 1))) - mean * mean,
+            0.0,
+        )
         return mean, var
     return channel_moments(x)
 
@@ -311,5 +317,10 @@ def batch_norm_train(x, scale, bias, eps: float = 1e-5,
     """Train-mode BN: returns (y, (mean, var)); stats carry stop-gradient
     semantics (they exist to update the running averages). ``strategy``:
     'pallas' (single-sweep kernels) or 'mxu' (reductions as XLA dots)."""
+    if strategy not in ("pallas", "mxu"):
+        # anything else would silently fall through to the Pallas kernels
+        raise ValueError(
+            f"strategy must be 'pallas' or 'mxu', got {strategy!r}"
+        )
     y, stats = _bn_train_vjp(x, scale, bias, eps, strategy)
     return y, jax.tree_util.tree_map(jax.lax.stop_gradient, stats)

@@ -48,6 +48,11 @@ VMEM_ROW_BUDGET = 12 << 20  # resident [R, M] source/dest per batch row
 
 UNROLL = 8
 
+# The unrolled gather/scatter row loops run BLOCK_J // UNROLL iterations; a
+# retuned BLOCK_J that is not a multiple would silently drop the tail rows
+# (wrong data, no error), so the divisibility is asserted at import.
+assert BLOCK_J % UNROLL == 0, "BLOCK_J must be a multiple of UNROLL"
+
 
 def _gather_kernel(idx_ref, x_ref, out_ref, tab_scr, *, bj, br, n_load):
     """Phase 1 (steps < n_load): copy x tiles into the scratch table.

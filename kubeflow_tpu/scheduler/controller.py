@@ -258,6 +258,12 @@ class SchedulerReconciler(Reconciler):
                     # its queued-at: seniority survives suspension. Left out
                     # of the desired set, the diff releases its chips now.
                     self._release_suspended(cluster, nb)
+                    if self.metrics is not None:
+                        # handoff hold time: how long the preemptor's chips
+                        # were gated on the victim's snapshot barrier
+                        self.metrics.observe_handoff(
+                            now - request["requestedAt"]
+                        )
                     preempted_now[key] = (
                         "suspended for a higher-priority gang"
                     )
@@ -548,6 +554,60 @@ class SchedulerReconciler(Reconciler):
         newly_bound: set[str] = set()
         handoffs = False
         order = queue.ordered(now)
+        if nb_by_key is not None:
+            # Cross-cycle victim deferral: a Preempted victim stays behind
+            # any STRICTLY senior gang still waiting on its accelerator —
+            # that senior is (or stands in for) the preemptor it was
+            # suspended for. Release and head-bind usually land in one
+            # cycle (the `released` deferral below covers that), but a
+            # faulted bind write leaves the preemptor queued with NO
+            # handoff in flight; in plain aged order the victim's
+            # preserved seniority would re-bind it straight into its own
+            # freed chips, get it re-preempted, and ping-pong forever.
+            # Strictly-senior scoping keeps aged fairness: once the senior
+            # binds (or leaves), the victim's order is its own.
+            senior: dict[str, int] = {}
+            victims: list[tuple[str, str, int]] = []
+            for r in order:
+                nb = nb_by_key.get(r.key)
+                if nb is None:
+                    continue
+                accel = r.topo.accelerator.name
+                if (condition(nb, COND_PREEMPTED) or {}).get(
+                        "status") == "True":
+                    victims.append((r.key, accel, r.priority))
+                elif accel not in senior or r.priority > senior[accel]:
+                    senior[accel] = r.priority
+            extra = {
+                key for key, accel, prio in victims
+                if senior.get(accel, prio) > prio
+            }
+            if extra:
+                deferred = (deferred or set()) | extra
+        if deferred:
+            # A deferred gang that is STRICTLY senior to every
+            # non-deferred waiter on its accelerator is not yielding to a
+            # preemptor — it IS the head (e.g. a former victim whose
+            # priority was bumped while its Preempted condition lingered).
+            # Deferring the head hands the very space its preemption
+            # trials free to the juniors behind it, re-preempting them
+            # forever (sessions soak seed 698: a suspend/resume livelock
+            # at thousands of cycles per seed).
+            by_key = {r.key: r for r in order}
+            top_other: dict[str, int] = {}
+            for r in order:
+                if r.key in deferred:
+                    continue
+                a = r.topo.accelerator.name
+                if a not in top_other or r.priority > top_other[a]:
+                    top_other[a] = r.priority
+            deferred = {
+                k for k in deferred
+                if k not in by_key
+                or by_key[k].priority <= top_other.get(
+                    by_key[k].topo.accelerator.name, by_key[k].priority
+                )
+            }
         if deferred:
             # A suspend-released victim must be considered AFTER the head
             # that suspended it — its preserved submit time usually

@@ -7,10 +7,12 @@ before this package both paths destroyed the user's session: a teardown was a
 kill, and a restart was always cold. This subsystem makes every gang teardown
 a *suspend* and every start a potential *resume*:
 
-- ``store.py``      — durable snapshot store with write-ahead manifest +
-  atomic commit (torn/uncommitted snapshots are never restored — the
-  torn-``latest_step`` discipline from ``utils/checkpoint.py`` at the
-  control-plane layer);
+- ``store.py``      — durable snapshot store: content-addressed chunks +
+  write-ahead manifest + atomic commit (torn/uncommitted snapshots are
+  never restored — the torn-``latest_step`` discipline from
+  ``utils/checkpoint.py`` at the control-plane layer; warm snapshots
+  write only dirty chunks, and a pre-copy pass keeps the suspend
+  barrier's stop-the-world window proportional to the residual delta);
 - ``controller.py`` — the sessions reconciler under ``runtime/manager.py``
   driving the state machine Running → Suspending → Suspended → Resuming →
   Running, with every transition carried in CR annotations so a controller

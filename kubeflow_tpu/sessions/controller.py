@@ -96,10 +96,21 @@ class SessionReconciler(Reconciler):
         self.scheduler_gated = bool(
             config is not None and getattr(config, "scheduler_enabled", False)
         )
+        # snapshot fast path: pre-copy chunks while the session still runs,
+        # so the barrier's save writes only the residual delta
+        self.precopy_enabled = bool(
+            config is None or getattr(config, "sessions_precopy", True)
+        ) and hasattr(store, "precopy")
+        # (session key, snapshot id) -> PrecopyState; in-memory only — a
+        # crash just loses the head start, the retry re-copies
+        self._precopied: dict[tuple[str, str], object] = {}
         self.metrics = metrics
         self.recorder = recorder
         self.clock = clock
         self.retry_s = retry_s
+        if metrics is not None and getattr(store, "metrics", None) is None:
+            # the store emits the byte/dedup/queue-depth families itself
+            store.metrics = metrics
 
     def watches(self):
         # pod phase transitions drive both ends of the machine: Running pods
@@ -114,6 +125,9 @@ class SessionReconciler(Reconciler):
     ) -> Result | None:
         nb = cluster.try_get("Notebook", name, namespace)
         if nb is None or not sess.session_engaged(nb):
+            # deleted or fully resumed: drop any pre-copy head start held
+            # in memory (and its GC pins) for this session
+            self._drop_precopy(f"{namespace}/{name}")
             return None
         now = self.clock()
         req = sess.suspend_request(nb)
@@ -136,6 +150,8 @@ class SessionReconciler(Reconciler):
     ) -> Result | None:
         ns, name = ko.namespace(nb), ko.name(nb)
         key = f"{ns}/{name}"
+        uid = nb.get("metadata", {}).get("uid", "")
+        sid = snapstore.snapshot_id(key, uid, req["requestedAt"])
         if (
             req.get("reason") == sess.REASON_STOP
             and api.STOP_ANNOTATION not in ko.annotations(nb)
@@ -145,6 +161,7 @@ class SessionReconciler(Reconciler):
             # nothing to preserve — abort the barrier instead of suspending
             # a gang the user just started (preemption suspends, whose
             # initiator is the scheduler, are NOT aborted here)
+            self._drop_precopy(key, sid)
             self._patch(cluster, nb, {
                 sess.SUSPEND_ANNOTATION: None,
                 sess.STATE_ANNOTATION: None,
@@ -156,11 +173,30 @@ class SessionReconciler(Reconciler):
             })
         payload = self.agent.snapshot(ns, name)
         if payload is not None:
-            uid = nb.get("metadata", {}).get("uid", "")
-            sid = snapstore.snapshot_id(key, uid, req["requestedAt"])
+            if (
+                self.precopy_enabled
+                and (key, sid) not in self._precopied
+                # no point pre-copying when the force deadline would land
+                # before the residual pass comes back
+                and now + self.retry_s < req["deadline"]
+            ):
+                try:
+                    pre_state = self.store.precopy(
+                        key, payload, snapshot_id=sid
+                    )
+                except StoreError:
+                    pre_state = None  # best-effort: fall back to a plain save
+                if pre_state is not None:
+                    self._precopied[(key, sid)] = pre_state
+                    # chunks are streaming while the session still runs; the
+                    # next pass diffs the final payload and commits only the
+                    # residual delta inside the barrier
+                    return Result(requeue_after=min(self.retry_s, 1.0))
+            pre = self._precopied.get((key, sid))
             try:
                 record = self.store.save(
-                    key, payload, snapshot_id=sid, now=now
+                    key, payload, snapshot_id=sid, now=now,
+                    **({"precopy": pre} if pre is not None else {}),
                 )
             except StoreError as e:
                 # NOT committed: no ack may be written. Surface and retry —
@@ -173,6 +209,13 @@ class SessionReconciler(Reconciler):
                 if self.metrics is not None:
                     self.metrics.snapshot_failures.inc()
                 return Result(requeue_after=self.retry_s)
+            self._precopied.pop((key, sid), None)
+            if self.metrics is not None and pre is not None:
+                # the stop-the-world residual: bytes the barrier itself had
+                # to write after the live pre-copy pass
+                self.metrics.precopy_residual_bytes.observe(
+                    float(record.get("physicalBytes", 0))
+                )
             # commit verified durable: the ack + the state flip are ONE
             # write — a crash leaves either no ack (retry re-saves, same id)
             # or the complete commit record, never a half-acked session
@@ -192,11 +235,18 @@ class SessionReconciler(Reconciler):
                 self.metrics.observe_suspend(
                     now - req["requestedAt"], req.get("reason", "unknown")
                 )
+            if hasattr(self.store, "maintain"):
+                # housekeeping (prune + chunk GC) runs only now, AFTER the
+                # ack released the barrier — never inside the
+                # stop-the-world window
+                self.store.maintain(key, keep_id=sid)
             return None
         if now >= req["deadline"]:
             # force path: nothing was ever acked, so nothing can be lost
             # that the platform promised to keep — the teardown proceeds
-            # cold rather than holding chips forever
+            # cold rather than holding chips forever. Any pre-copied chunks
+            # are unpinned; GC sweeps them later.
+            self._drop_precopy(key, sid)
             self._patch(cluster, nb, {
                 sess.STATE_ANNOTATION: sess.STATE_SUSPENDED,
             })
@@ -313,6 +363,21 @@ class SessionReconciler(Reconciler):
 
     # -------------------------------------------------------------- plumbing
 
+    def _drop_precopy(self, key: str, sid: str | None = None) -> None:
+        """Forget pre-copied state for a session (one snapshot id, or all)
+        and release its GC pins — the chunks become sweepable debris. Pins
+        can outlive this reconciler's in-memory bookkeeping (the store
+        survives a controller crash-restart), so the store is always told,
+        not just when a state entry exists."""
+        for k in list(self._precopied):
+            if k[0] == key and (sid is None or k[1] == sid):
+                self._precopied.pop(k, None)
+        if sid is not None:
+            if hasattr(self.store, "unpin"):
+                self.store.unpin(key, sid)
+        elif hasattr(self.store, "unpin_session"):
+            self.store.unpin_session(key)
+
     def _patch(self, cluster: FakeCluster, nb: dict, anns: dict) -> None:
         """One annotation write, mirrored into the in-memory copy so the
         same reconcile pass sees its own transition. NotFound (deleted under
@@ -378,10 +443,13 @@ class HttpSessionAgent:
     the same in-cluster URL shape the culler probes kernels on. The notebook
     image's session extension implements ``GET /api/sessions/snapshot``
     (returns the serialized session after ``snapshot_for_suspend`` — the
-    save MUST have passed ``wait_until_finished()``) and ``POST
-    /api/sessions/restore``. Unreachable servers answer None/False — the
-    controller retries until the force deadline, exactly like an idle-probe
-    miss."""
+    save MUST have passed ``wait_until_finished()``; the extension may
+    serve the controller's FIRST request of a suspend from
+    ``snapshot_for_precopy`` instead — the already-durable step, no forced
+    save, nothing stops the world — since the pre-copy pass tolerates
+    drift by construction) and ``POST /api/sessions/restore``. Unreachable
+    servers answer None/False — the controller retries until the force
+    deadline, exactly like an idle-probe miss."""
 
     def __init__(self, cluster_domain: str = "cluster.local", timeout: float = 10.0) -> None:
         self.cluster_domain = cluster_domain

@@ -15,12 +15,16 @@ torn manifests), and audits:
 - **temporal** (every sub-tick, via :class:`SessionAuditor`): a placement
   never disappears while its suspend barrier holds; an acked snapshot never
   leaves the CR without its restore being delivered; every ack points at a
-  store commit that verifies (parse + digest); plus the scheduler soak's
+  store commit that verifies — with the content-addressed store that means
+  the manifest parses, hashes to the commit digest, and every chunk it
+  references is present and digest-valid; plus the scheduler soak's
   placement overlap audit (zero double-booking at every observable state);
 - **final** (fixed point, faults healed): the scheduler's own fixed-point
   audit, every bound active gang fully resumed (no session machinery left),
   every suspended gang actually scaled to zero with its snapshot restorable,
-  the trace audit, and the bounded-events audit.
+  the trace audit, the bounded-events audit, and the chunk-store audit
+  (:func:`audit_chunk_store`: zero premature GC, zero orphans, zero pin
+  leaks across every crash-restart in the run).
 
 Everything flows from the seed: fleet, gangs, op timeline, API faults,
 store faults. A printed failure reproduces with
@@ -110,6 +114,10 @@ class _Obs:
     ack_id: str | None
     complete: bool
     scaled_down: bool
+    # the in-flight request's force deadline: the release that RETIRES the
+    # request erases this from the CR, so judging a release observed after
+    # the fact needs the deadline remembered from before it
+    deadline: float | None
 
 
 class SessionAuditor:
@@ -132,13 +140,15 @@ class SessionAuditor:
             seen.add(key)
             uid = nb.get("metadata", {}).get("uid", "")
             ack = sess.snapshot_record(nb)
+            req = sess.suspend_request(nb)
             obs = _Obs(
                 uid=uid,
                 placed=sched.placement_of(nb) is not None,
-                requested=sess.suspend_request(nb) is not None,
+                requested=req is not None,
                 ack_id=ack.get("snapshotId") if ack else None,
                 complete=sess.suspend_complete(nb, now),
                 scaled_down=_gang_scaled_down(base, nb),
+                deadline=req.get("deadline") if req else None,
             )
             prev = self.last.get(key)
             if prev is not None and prev.uid == uid:
@@ -146,12 +156,17 @@ class SessionAuditor:
                     # chips were released between the two observations: the
                     # barrier demands a committed snapshot, a passed
                     # deadline, or a gang that had already finished tearing
-                    # down — provable from either endpoint of the interval
+                    # down — provable from either endpoint of the interval.
+                    # A force-deadline release RETIRES the request in the
+                    # same write, so the deadline it crossed is only
+                    # visible from the PREVIOUS observation.
                     allowed = (
                         prev.complete
                         or obs.complete
                         or obs.ack_id is not None
                         or prev.scaled_down
+                        or (prev.deadline is not None
+                            and now >= prev.deadline)
                     )
                     if not allowed:
                         out.append(
@@ -223,6 +238,44 @@ def audit_sessions_fixed_point(
                     f"{where}: {key}: resting ack {ack['snapshotId']} is "
                     f"not restorable from the store"
                 )
+    return out
+
+
+def audit_chunk_store(store: SnapshotStore, *, where: str = "final"
+                      ) -> list[str]:
+    """Chunk-level invariants of the snapshot fast path, checked at the
+    healed fixed point (docs/sessions.md "snapshot fast path"):
+
+    - **no premature GC**: every chunk any parseable manifest references is
+      present — mark-and-sweep may never have collected a referenced chunk,
+      across every crash-restart and fault in the run (the acked-snapshot
+      restorability check above additionally digest-verifies the chunks an
+      ack depends on);
+    - **no pin leaks**: no in-flight pre-copy/restore pins survive the
+      fixed point (a leaked pin would shield debris from GC forever);
+    - **no orphans**: after one final sweep, every chunk still in the store
+      is referenced — crash windows between chunk-write and manifest-commit
+      leak nothing GC cannot reclaim.
+    """
+    out = []
+    present = store.chunk_digests()
+    for digest in sorted(store.referenced_digests() - present):
+        out.append(
+            f"{where}: chunk {digest[:12]} is referenced by a manifest but "
+            f"missing from the store (prematurely GC'd or lost)"
+        )
+    pinned = store.pinned_digests()
+    if pinned:
+        out.append(
+            f"{where}: {len(pinned)} chunk pin(s) leaked past the fixed "
+            f"point (pre-copy/restore pins must not outlive their suspend)"
+        )
+    store.gc()
+    for digest in sorted(store.chunk_digests() - store.referenced_digests()):
+        out.append(
+            f"{where}: chunk {digest[:12]} survived GC with no manifest "
+            f"referencing it (orphaned debris never reclaimed)"
+        )
     return out
 
 
@@ -434,10 +487,17 @@ def run_session_seed(
         if store_faults is not None
         else (StoreChaosConfig() if faults is not None else None),
     )
-    store = SnapshotStore(objects)
-    agent = FakeSessionAgent(base)
     sched_metrics = SchedulerMetrics()
     session_metrics = SessionMetrics(sched_metrics.registry)
+    # pin TTL on the soak's virtual clock, a few force deadlines out: a
+    # suspend that is still unsaved then is structurally dead (forced
+    # cold or its notebook deleted) and its pre-copy pins must not shield
+    # debris from GC forever — the settle phase advances well past it
+    store = SnapshotStore(
+        objects, metrics=session_metrics, clock=clock,
+        pin_ttl_s=4 * SOAK_SUSPEND_DEADLINE_S,
+    )
+    agent = FakeSessionAgent(base)
     tracer = Tracer(clock=clock)
     # one SLO ring across restarts (an observer, like the tracer); the
     # timeline recorder itself is stateless — marks live on the CRs
@@ -568,6 +628,9 @@ def run_session_seed(
     violations.extend(
         audit_sessions_fixed_point(base, store, agent, clock())
     )
+    # chunk-level no-loss: nothing referenced missing, nothing orphaned,
+    # no pin leaks — across every crash-restart and store fault in the run
+    violations.extend(audit_chunk_store(store))
     # incremental-vs-from-scratch scheduler model divergence anywhere
     violations.extend(sched_diff_failures)
     violations.extend(tracer.audit())

@@ -1,47 +1,89 @@
-"""Durable snapshot store: write-ahead manifest + atomic commit.
+"""Durable snapshot store: content-addressed chunks + write-ahead manifest
+commit.
 
-The store of record for suspended sessions. The layout under one session
-prefix (``sessions/<namespace>/<name>``):
+The store of record for suspended sessions. Snapshot payloads are split
+into fixed-size chunks keyed by content digest in one shared, deduplicated
+chunk space; a snapshot is a *manifest* (the ordered chunk digest list)
+committed through the same WAL→verify→commit discipline the monolithic
+store used. The layout:
 
-    <sid>.wal      write-ahead intent — "a snapshot <sid> is being written"
-    <sid>.data     the session payload (opaque bytes from the session agent)
-    <sid>.commit   the commit record {snapshotId, digest, size, committedAt}
+    chunks/<d0d1>/<digest>         chunk bytes, content-addressed, SHARED
+                                   across snapshots and sessions
+    sessions/<namespace>/<name>/
+        <sid>.wal                  write-ahead intent
+        <sid>.manifest             {snapshotId, chunkSize, size,
+                                    chunks: [[digest, size], ...]}
+        <sid>.commit               commit record — its ``digest`` is the
+                                   sha256 of the manifest bytes (a Merkle
+                                   root over the chunk digests)
+
+Because chunks are content-addressed, a warm suspend writes only the
+chunks that changed since the last snapshot — snapshot cost is
+proportional to *dirty state*, not session size. ``precopy()`` streams a
+best-effort chunk pass while the session is still running; the barrier's
+``save()`` then diffs the final payload against the pre-copied one
+(chunk-wise compare, digest reuse) and writes only the residual delta
+before the small manifest+commit writes — the stop-the-world window the
+preemption handoff waits on shrinks to the residual.
 
 The **commit record is the only thing that makes a snapshot restorable**,
-and it is written last, then read back and verified. The discipline is the
-torn-``latest_step`` one from ``utils/checkpoint.py``, lifted to the control
-plane:
+and it is written last, then read back and verified:
 
-- a crash after wal/data but before commit leaves an *uncommitted* snapshot
-  — never restored, invisible to ``committed()``;
-- a torn commit write (the writer died mid-write; the store holds half a
-  record) fails JSON parse or digest verification — never restored; restore
-  falls back to the newest *older* commit that verifies, exactly like
-  ``resume_or_init`` walking back over torn checkpoint steps;
+- a crash after wal/chunks/manifest but before commit leaves an
+  *uncommitted* snapshot — never restored, invisible to ``committed()``,
+  and its unreferenced chunks are swept by :meth:`gc`;
+- a torn commit or torn manifest write fails parse or digest verification
+  — never restored; restore falls back to the newest *older* commit that
+  verifies, exactly like ``resume_or_init`` walking back over torn
+  checkpoint steps;
+- a chunk-digest mismatch at restore time makes the snapshot structurally
+  unrestorable — ``load`` refuses rather than return partial bytes;
 - a lost commit write (applied, but the response was lost) is absorbed by
   the read-back verify: ``save`` only returns success once the commit it
   just wrote is readable and matches, so the caller's ack (the CR
   annotation) is never written for a commit that may not exist. Retries
   reuse the same deterministic snapshot id, so a replayed save after a
   crash-restart overwrites its own half-finished objects instead of
-  leaking new ones.
+  leaking new ones. Each chunk write is individually read back and
+  compared before it counts, and an existing chunk is reused only when
+  its stored size matches (a torn chunk write truncates — rewritten);
+  the restore path re-verifies every chunk digest regardless.
 
-Object-store faults surface as :class:`StoreError` (the caller requeues and
-retries); a missing/ torn snapshot at restore time surfaces as
-:class:`SnapshotUnavailable` (the caller must NOT restart the session cold
-if an ack exists — blocking beats silent loss).
+Garbage collection is mark-and-sweep from the manifests (never a stored
+refcount that a crash could tear): a chunk is live iff some parseable
+manifest references it or an in-flight operation holds a pin — pre-copied
+chunks are pinned until their manifest commits (or the caller abandons
+the suspend), and a restore pins its manifest's chunks while it reads.
+A crash between manifest-commit and GC therefore can never orphan a
+referenced chunk: the next sweep re-derives liveness from the manifests
+themselves. Chunk I/O (writes, dedup probes, restore prefetch) runs on a
+bounded worker pool; failures are raised only after every chunk in the
+batch was attempted, so a seeded fault schedule replays deterministically
+regardless of thread interleaving.
 
-Backends implement the four-verb :class:`ObjectStore` protocol. Production
-gets :class:`FileObjectStore` (atomic tmp+rename puts on a mounted volume or
-FUSE-mounted bucket); the soaks get the fault-injecting fake in
-``testing/sessionstore.py``.
+Object-store faults surface as :class:`StoreError` (the caller requeues
+and retries); a missing/torn snapshot at restore time surfaces as
+:class:`SnapshotUnavailable` (the caller must NOT restart the session
+cold if an ack exists — blocking beats silent loss).
+
+Backends implement the :class:`ObjectStore` protocol (``stat`` is an
+optional fast-path). Production gets :class:`FileObjectStore` (atomic
+tmp+rename puts on a mounted volume or FUSE-mounted bucket); the soaks
+get the fault-injecting fake in ``testing/sessionstore.py``. Snapshots
+committed by the pre-chunking store (a ``.data`` object, commit digest
+over the payload) remain restorable — ``_verified`` falls back to the
+legacy layout when the commit record carries no manifest marker.
 """
 from __future__ import annotations
 
+import collections
+import concurrent.futures
 import hashlib
 import json
 import os
-from typing import Protocol
+import threading
+import time
+from typing import Iterable, Protocol
 
 
 class StoreError(Exception):
@@ -53,10 +95,32 @@ class SnapshotUnavailable(Exception):
 
 
 class ObjectStore(Protocol):
+    """Four required verbs; backends MAY also provide ``stat(key) -> int |
+    None`` (size without a read — the chunk dedup probe falls back to
+    ``get``) and ``sync()`` (group-commit durability barrier — absent
+    means puts are already durable)."""
+
     def put(self, key: str, data: bytes) -> None: ...
     def get(self, key: str) -> bytes: ...            # KeyError if absent
     def list(self, prefix: str) -> list[str]: ...
     def delete(self, key: str) -> None: ...
+
+
+# 4 MiB: large enough that per-object overhead (fsync / journal commit,
+# request round-trip) stays a small multiple of one monolithic write even
+# on a local filesystem, small enough that a ~1% dirty pass on a
+# multi-GiB session touches few chunks
+CHUNK_SIZE = 4 << 20
+
+# Pre-copy pins expire: a pin protects chunks between precopy and save,
+# and a suspend that has not committed within a few force deadlines is
+# structurally dead (forced cold, its initiator gone, or the notebook
+# deleted with the watch event dropped — the soak found pins leaking
+# forever on exactly those paths). An expired pin costs nothing but the
+# head start: a save that somehow still arrives re-ensures any swept
+# chunk. 5x the default force deadline.
+DEFAULT_PIN_TTL_S = 600.0
+CHUNK_PREFIX = "chunks"
 
 
 def snapshot_id(session: str, uid: str, requested_at: float) -> str:
@@ -73,34 +137,328 @@ def _digest(payload: bytes) -> str:
     return hashlib.sha256(payload).hexdigest()
 
 
-class SnapshotStore:
-    """Policy layer over an :class:`ObjectStore`: WAL, atomic commit,
-    read-back verification, torn-commit fallback."""
+def chunk_key(digest: str) -> str:
+    return f"{CHUNK_PREFIX}/{digest[:2]}/{digest}"
 
-    def __init__(self, objects: ObjectStore, *, keep: int = 2) -> None:
+
+def _dirty_chunks(payload: bytes, prev: bytes, cs: int, n_chunks: int
+                  ) -> set[int]:
+    """Chunk indices where ``payload`` differs from ``prev`` (the pre-copied
+    bytes). Vectorized over the aligned prefix — per-chunk Python slicing
+    would copy the entire payload just to discover that nothing changed,
+    which is exactly the stop-the-world cost the pre-copy exists to kill."""
+    if payload == prev:  # one C-level memcmp: the common warm case
+        return set()
+    common = min(len(payload), len(prev))
+    whole = common // cs  # chunks fully covered by BOTH payloads
+    dirty: set[int] = set()
+    if whole:
+        try:
+            import numpy as np
+
+            a = np.frombuffer(payload, dtype=np.uint8, count=whole * cs)
+            b = np.frombuffer(prev, dtype=np.uint8, count=whole * cs)
+            # compare in bounded strips: the != temp is one bool per byte,
+            # and a payload-sized temp inside the barrier is exactly the
+            # O(session) memory spike the fast path exists to avoid
+            strip = max(1, (64 << 20) // cs)
+            for s0 in range(0, whole, strip):
+                s1 = min(s0 + strip, whole)
+                neq = (
+                    a[s0 * cs:s1 * cs].reshape(s1 - s0, cs)
+                    != b[s0 * cs:s1 * cs].reshape(s1 - s0, cs)
+                ).any(axis=1)
+                dirty.update(s0 + int(i) for i in np.nonzero(neq)[0])
+        except ImportError:  # pragma: no cover - numpy rides in with jax
+            dirty.update(
+                i for i in range(whole)
+                if payload[i * cs:(i + 1) * cs] != prev[i * cs:(i + 1) * cs]
+            )
+    # everything past the aligned prefix (tail chunk, or a grown/shrunk
+    # payload) is conservatively dirty unless byte-identical
+    for i in range(whole, n_chunks):
+        if payload[i * cs:(i + 1) * cs] != prev[i * cs:(i + 1) * cs]:
+            dirty.add(i)
+    return dirty
+
+
+class PrecopyState:
+    """What one ``precopy`` pass learned: the payload it streamed and the
+    ordered chunk digests it ensured durable. ``save`` diffs the final
+    payload against this to write only the residual delta inside the
+    barrier. In-memory only — a controller crash just loses the head
+    start, never correctness (the retry re-ensures any missing chunk)."""
+
+    __slots__ = ("snapshot_id", "chunk_size", "payload", "digests",
+                 "written_bytes")
+
+    def __init__(self, snapshot_id: str, chunk_size: int, payload: bytes,
+                 digests: list[str], written_bytes: int) -> None:
+        self.snapshot_id = snapshot_id
+        self.chunk_size = chunk_size
+        self.payload = payload
+        self.digests = digests
+        self.written_bytes = written_bytes
+
+
+class ChunkPool:
+    """Bounded worker pool for chunk I/O. ``map`` submits every item, then
+    collects every result before raising the first failure — all-attempted
+    semantics keep seeded fault draws deterministic under concurrency."""
+
+    def __init__(self, workers: int = 8) -> None:
+        self.workers = max(0, int(workers))
+        self._executor: concurrent.futures.ThreadPoolExecutor | None = None
+
+    def _ex(self) -> concurrent.futures.ThreadPoolExecutor:
+        if self._executor is None:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.workers, thread_name_prefix="chunk-io"
+            )
+        return self._executor
+
+    def map(self, fn, items: Iterable, *, gauge=None) -> list:
+        items = list(items)
+        if self.workers <= 1 or len(items) <= 1:
+            return [fn(it) for it in items]
+        if gauge is not None:
+            gauge.set(len(items))
+        try:
+            futures = [self._ex().submit(fn, it) for it in items]
+            results, first_err = [], None
+            for f in futures:
+                try:
+                    results.append(f.result())
+                except Exception as e:  # noqa: BLE001 — re-raised below
+                    if first_err is None:
+                        first_err = e
+                    results.append(None)
+            if first_err is not None:
+                raise first_err
+            return results
+        finally:
+            if gauge is not None:
+                gauge.set(0)
+
+    def close(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
+
+
+class SnapshotStore:
+    """Policy layer over an :class:`ObjectStore`: content-addressed chunks,
+    WAL, atomic manifest commit, read-back verification, torn-commit
+    fallback, pin-aware mark-and-sweep GC."""
+
+    def __init__(
+        self,
+        objects: ObjectStore,
+        *,
+        keep: int = 2,
+        chunk_size: int = CHUNK_SIZE,
+        workers: int = 8,
+        metrics=None,
+        clock=None,
+        pin_ttl_s: float = DEFAULT_PIN_TTL_S,
+        gc_every: int = 8,
+    ) -> None:
         self.objects = objects
         # older committed snapshots kept as fallback for a torn newest
         # commit; everything older is pruned at save time
         self.keep = keep
+        self.chunk_size = max(1, int(chunk_size))
+        self.pool = ChunkPool(workers)
+        self.metrics = metrics  # SessionMetrics (bytes/dedup/queue families)
+        self.clock = clock if clock is not None else time.time
+        self.pin_ttl_s = pin_ttl_s
+        self.gc_every = max(1, int(gc_every))
+        self._maintains = 0
+        self._lock = threading.Lock()
+        # (session, snapshot_id) -> (pre-copied digests awaiting a
+        # manifest, pin expiry)
+        self._pins: dict[tuple[str, str], tuple[list[str], float]] = {}
+        # digest -> in-flight restore count
+        self._load_pins: collections.Counter = collections.Counter()
 
     @staticmethod
     def _prefix(session: str) -> str:
         return f"sessions/{session}"
 
+    def _queue_gauge(self):
+        return getattr(self.metrics, "chunk_pool_queue_depth", None)
+
+    # --------------------------------------------------------------- chunks
+
+    def _split(self, payload: bytes) -> list[bytes]:
+        cs = self.chunk_size
+        return [payload[o:o + cs] for o in range(0, len(payload), cs)] or [b""]
+
+    def _stat(self, key: str) -> int | None:
+        stat = getattr(self.objects, "stat", None)
+        if stat is not None:
+            return stat(key)
+        try:
+            return len(self.objects.get(key))
+        except KeyError:
+            return None
+
+    def _ensure_chunk(self, data: bytes, digest: str) -> int:
+        """Make one chunk durable; returns bytes physically written (0 on a
+        dedup hit). A same-size existing object under a content-addressed
+        key IS the chunk (torn writes truncate; collisions don't happen);
+        new writes are read back and compared before they count."""
+        key = chunk_key(digest)
+        if self._stat(key) == len(data):
+            # dedup hit — but a barrier-mode backend restarted since the
+            # bytes were written cannot know they were ever flushed, so
+            # hand the key to the durability barrier anyway (no-op for
+            # chunks this process already synced)
+            ensure = getattr(self.objects, "ensure_durable", None)
+            if ensure is not None:
+                ensure(key)
+            return 0
+        self.objects.put(key, data)
+        try:
+            back = self.objects.get(key)
+        except KeyError:
+            back = None
+        if back != data:
+            raise StoreError(f"chunk {digest[:12]} did not verify after write")
+        return len(data)
+
+    def _ensure_chunks(
+        self, chunks: list[bytes], digests: list[str]
+    ) -> int:
+        """Hash-addressed write of every chunk not already durable, on the
+        worker pool; total bytes physically written. Raises StoreError only
+        after every chunk was attempted."""
+        def work(item):
+            data, digest = item
+            return self._ensure_chunk(data, digest)
+
+        try:
+            written = self.pool.map(
+                work, zip(chunks, digests), gauge=self._queue_gauge()
+            )
+        except StoreError:
+            raise
+        except Exception as e:  # backend-specific failure shapes
+            raise StoreError(f"chunk write failed: {e}") from e
+        return sum(w for w in written if w)
+
+    # -------------------------------------------------------------- precopy
+
+    def precopy(self, session: str, payload: bytes, *, snapshot_id: str
+                ) -> PrecopyState:
+        """Best-effort dirty-chunk pass while the session is still running:
+        hash + ensure every chunk durable WITHOUT committing anything. The
+        ensured digests are pinned against GC until ``save`` commits their
+        manifest (or :meth:`unpin` abandons the suspend). Raises StoreError
+        on any failure — the caller just falls back to a plain save."""
+        chunks = self._split(payload)
+        digests = [_digest(c) for c in chunks]
+        written = self._ensure_chunks(chunks, digests)
+        # flush HERE, while the session still runs — the barrier's save
+        # then syncs only its residual, not this pass's bulk
+        self._sync_objects()
+        with self._lock:
+            self._pins[(session, snapshot_id)] = (
+                list(digests), self.clock() + self.pin_ttl_s
+            )
+        if self.metrics is not None:
+            self.metrics.observe_precopy(len(payload), written)
+        return PrecopyState(
+            snapshot_id, self.chunk_size, payload, digests, written
+        )
+
+    def _pin_live(self, session: str, snapshot_id: str) -> bool:
+        with self._lock:
+            entry = self._pins.get((session, snapshot_id))
+        return entry is not None and entry[1] > self.clock()
+
+    def unpin(self, session: str, snapshot_id: str) -> None:
+        """Abandon a pre-copied suspend (stop retracted, force deadline):
+        release its GC pins. The orphaned chunks are swept later."""
+        with self._lock:
+            self._pins.pop((session, snapshot_id), None)
+
+    def unpin_session(self, session: str) -> None:
+        """Release every pre-copy pin a session holds (the session was
+        deleted or fully resumed — no in-flight suspend can remain)."""
+        with self._lock:
+            for k in [k for k in self._pins if k[0] == session]:
+                del self._pins[k]
+
     # ---------------------------------------------------------------- save
 
     def save(
-        self, session: str, payload: bytes, *, snapshot_id: str, now: float
+        self,
+        session: str,
+        payload: bytes,
+        *,
+        snapshot_id: str,
+        now: float,
+        precopy: PrecopyState | None = None,
     ) -> dict:
-        """Write one snapshot through the WAL→data→commit sequence and verify
-        the commit landed. Returns the commit record. Raises StoreError on
-        any failure — the caller retries with the SAME snapshot id."""
+        """Write one snapshot through the WAL→chunks→manifest→commit
+        sequence and verify the commit landed. With a ``precopy`` state for
+        the same snapshot, unchanged chunks are detected by byte compare
+        against the pre-copied payload (digest reuse, no re-hash, no
+        write) — only the residual delta touches the store inside the
+        barrier. Returns the commit record. Raises StoreError on any
+        failure — the caller retries with the SAME snapshot id."""
         prefix = self._prefix(session)
-        digest = _digest(payload)
+        cs = self.chunk_size
+        n_chunks = max(1, -(-len(payload) // cs))
+        sizes = [min(cs, len(payload) - i * cs) for i in range(n_chunks)]
+        if (
+            precopy is not None
+            and precopy.snapshot_id == snapshot_id
+            and precopy.chunk_size == cs
+            # digest reuse is sound ONLY while the pre-copy pin still
+            # protects those chunks from GC: past the pin TTL a sweep may
+            # have reclaimed them, and reusing the digests would commit an
+            # acked manifest referencing missing chunks. An expired pin
+            # falls back to the full dedup path, whose stat probe
+            # re-ensures every chunk.
+            and self._pin_live(session, snapshot_id)
+        ):
+            # the stop-the-world diff: payload slices are materialized ONLY
+            # for dirty chunks (slicing a clean 100GB payload chunk-by-chunk
+            # would copy the whole session inside the barrier)
+            dirty = _dirty_chunks(payload, precopy.payload, cs, n_chunks)
+            digests = list(precopy.digests[:n_chunks])
+            digests += [""] * (n_chunks - len(digests))
+            residual: list[tuple[bytes, str]] = []
+            for i in sorted(dirty):
+                data = payload[i * cs:(i + 1) * cs]
+                digests[i] = _digest(data)
+                residual.append((data, digests[i]))
+            written = self._ensure_chunks(
+                [c for c, _ in residual], [d for _, d in residual]
+            )
+        else:
+            chunks = self._split(payload)
+            digests = [_digest(c) for c in chunks]
+            written = self._ensure_chunks(chunks, digests)
+        manifest = {
+            "snapshotId": snapshot_id,
+            "chunkSize": cs,
+            "size": len(payload),
+            "chunks": [[d, s] for d, s in zip(digests, sizes)],
+        }
+        manifest_bytes = json.dumps(manifest, sort_keys=True).encode()
         record = {
             "snapshotId": snapshot_id,
-            "digest": digest,
+            "manifest": True,
+            # the Merkle root: sha256 of the manifest bytes, which embed
+            # every chunk digest — full-payload integrity without a flat
+            # payload hash inside the barrier
+            "digest": _digest(manifest_bytes),
             "size": len(payload),
+            "chunks": n_chunks,
+            "physicalBytes": written,
             "committedAt": now,
         }
         try:
@@ -111,7 +469,7 @@ class SnapshotStore:
                     sort_keys=True,
                 ).encode(),
             )
-            self.objects.put(f"{prefix}/{snapshot_id}.data", payload)
+            self.objects.put(f"{prefix}/{snapshot_id}.manifest", manifest_bytes)
             self.objects.put(
                 f"{prefix}/{snapshot_id}.commit",
                 json.dumps(record, sort_keys=True).encode(),
@@ -120,17 +478,85 @@ class SnapshotStore:
             raise
         except Exception as e:  # backend-specific failure shapes
             raise StoreError(f"snapshot {snapshot_id} write failed: {e}") from e
+        # durability barrier: one flush covers every chunk and control
+        # object this save wrote (group commit — per-chunk fsync would put
+        # N journal flushes inside the stop-the-world window)
+        self._sync_objects()
         # read-back verify: a commit whose write was "lost" (applied-but-
-        # errored, or torn) must never be acked. Only a commit we can read
-        # back, parse, and digest-match counts as durable.
-        verified = self.commit_record(session, snapshot_id)
-        if verified != record:
-            raise StoreError(
-                f"snapshot {snapshot_id} commit did not verify "
-                f"(torn or lost write)"
-            )
-        self._prune(session, keep_id=snapshot_id)
+        # errored, or torn) must never be acked. Chunks were individually
+        # verified at write time, so the barrier re-reads only the small
+        # manifest + commit objects.
+        self._verify_commit(session, snapshot_id, record, manifest_bytes)
+        # the manifest now references every chunk: pins served their purpose
+        self.unpin(session, snapshot_id)
+        if self.metrics is not None:
+            self.metrics.observe_save(len(payload), written)
+        # prune + GC deliberately NOT here: they are post-ack housekeeping
+        # (the caller runs maintain() after the barrier releases), so the
+        # stop-the-world window never pays for a chunk-space sweep
         return record
+
+    def maintain(self, session: str, *, keep_id: str | None = None) -> None:
+        """Post-ack housekeeping: prune this session's old snapshots past
+        the keep budget, and periodically sweep unreferenced chunks.
+        Called by the sessions controller AFTER the snapshot ack is
+        written (the barrier is already released), and by tests/soaks
+        directly. The per-session prune is cheap and runs every time; the
+        global mark-and-sweep is O(store) — every chunk listed, every
+        manifest read — so it runs only every ``gc_every``-th call
+        (orphaned debris is bounded by that window, never unbounded)."""
+        if keep_id is None:
+            records = [
+                r
+                for r in (
+                    self._light_record(session, sid)
+                    for sid in self._snapshot_ids(session)
+                )
+                if r is not None
+            ]
+            if records:
+                keep_id = max(
+                    records,
+                    key=lambda r: (r.get("committedAt", 0.0),
+                                   r.get("snapshotId", "")),
+                )["snapshotId"]
+        if keep_id is not None:
+            self._prune(session, keep_id=keep_id)
+        with self._lock:
+            self._maintains += 1
+            sweep = self._maintains % self.gc_every == 0
+        if sweep:
+            self.gc()
+
+    def _sync_objects(self) -> None:
+        sync = getattr(self.objects, "sync", None)
+        if sync is not None:
+            try:
+                sync()
+            except StoreError:
+                raise
+            except Exception as e:
+                raise StoreError(f"durability barrier failed: {e}") from e
+
+    def _verify_commit(
+        self, session: str, sid: str, record: dict, manifest_bytes: bytes
+    ) -> None:
+        prefix = self._prefix(session)
+        try:
+            raw = self.objects.get(f"{prefix}/{sid}.commit")
+            back_manifest = self.objects.get(f"{prefix}/{sid}.manifest")
+        except KeyError:
+            raise StoreError(
+                f"snapshot {sid} commit did not verify (lost write)"
+            ) from None
+        try:
+            parsed = json.loads(raw)
+        except ValueError:
+            parsed = None
+        if parsed != record or back_manifest != manifest_bytes:
+            raise StoreError(
+                f"snapshot {sid} commit did not verify (torn or lost write)"
+            )
 
     # ------------------------------------------------------------- restore
 
@@ -149,14 +575,86 @@ class SnapshotStore:
             return None
         return record
 
+    def _manifest_for(self, session: str, sid: str,
+                      record: dict) -> dict | None:
+        """The parsed manifest iff its bytes hash to the commit's digest."""
+        try:
+            raw = self.objects.get(f"{self._prefix(session)}/{sid}.manifest")
+        except KeyError:
+            return None
+        if _digest(raw) != record.get("digest"):
+            return None  # torn manifest write
+        try:
+            manifest = json.loads(raw)
+        except ValueError:
+            return None
+        if not isinstance(manifest, dict):
+            return None  # valid JSON, wrong shape: unrestorable, not fatal
+        if not isinstance(manifest.get("chunks"), list):
+            return None
+        return manifest
+
     def _verified(self, session: str, sid: str) -> tuple[dict, bytes] | None:
-        """(record, payload) iff the commit parses AND its data object
-        exists with a matching digest — torn commits and torn data both
-        read as 'not committed'. Returning the verified bytes lets restore
-        use exactly what the digest check covered (one payload read)."""
+        """(record, payload) iff the commit parses AND every byte it claims
+        verifies — torn commits, torn manifests, and chunk-digest
+        mismatches all read as 'not committed'. NEVER returns partial
+        bytes: one bad chunk makes the whole snapshot unrestorable.
+        Returning the verified bytes lets restore use exactly what the
+        digest checks covered."""
         record = self._light_record(session, sid)
         if record is None:
             return None
+        if not record.get("manifest"):
+            return self._verified_legacy(session, sid, record)
+        manifest = self._manifest_for(session, sid, record)
+        if manifest is None:
+            return None
+        entries = []
+        for entry in manifest["chunks"]:
+            if (
+                not isinstance(entry, (list, tuple)) or len(entry) != 2
+                or not isinstance(entry[0], str)
+            ):
+                return None
+            entries.append((entry[0], entry[1]))
+        # pin against GC while the chunks are read: a concurrent sweep must
+        # never collect out from under an in-flight restore
+        with self._lock:
+            for d, _ in entries:
+                self._load_pins[d] += 1
+        try:
+            def fetch(entry):
+                digest, size = entry
+                try:
+                    data = self.objects.get(chunk_key(digest))
+                except KeyError:
+                    return None
+                if len(data) != size or _digest(data) != digest:
+                    return None  # torn/corrupt chunk: structurally bad
+                return data
+
+            parts = self.pool.map(
+                fetch, entries, gauge=self._queue_gauge()
+            )
+        finally:
+            with self._lock:
+                for d, _ in entries:
+                    self._load_pins[d] -= 1
+                    if self._load_pins[d] <= 0:
+                        del self._load_pins[d]
+        if any(p is None for p in parts):
+            return None
+        payload = b"".join(parts)
+        if len(payload) != record.get("size"):
+            return None
+        return record, payload
+
+    def _verified_legacy(
+        self, session: str, sid: str, record: dict
+    ) -> tuple[dict, bytes] | None:
+        """Pre-chunking layout: one ``.data`` object, commit digest over the
+        payload bytes. Kept readable so snapshots committed before the fast
+        path still restore."""
         try:
             payload = self.objects.get(f"{self._prefix(session)}/{sid}.data")
         except KeyError:
@@ -209,6 +707,76 @@ class SnapshotStore:
             )
         return verified[1]
 
+    # ------------------------------------------------------------------- gc
+
+    def sessions(self) -> list[str]:
+        """Every session key with any snapshot object in the store."""
+        out = set()
+        for key in self.objects.list("sessions"):
+            parts = key.split("/")
+            if len(parts) >= 4:
+                out.add("/".join(parts[1:-1]))
+        return sorted(out)
+
+    def referenced_digests(self) -> set[str]:
+        """Chunk digests referenced by ANY parseable manifest (committed or
+        not — an in-flight manifest's chunks are just as live)."""
+        refs: set[str] = set()
+        for key in self.objects.list("sessions"):
+            if not key.endswith(".manifest"):
+                continue
+            try:
+                manifest = json.loads(self.objects.get(key))
+            except (KeyError, ValueError):
+                continue  # torn manifest: its chunks are debris
+            chunks = (
+                manifest.get("chunks") if isinstance(manifest, dict) else None
+            )
+            if not isinstance(chunks, list):
+                continue
+            for entry in chunks:
+                if isinstance(entry, (list, tuple)) and entry \
+                        and isinstance(entry[0], str):
+                    refs.add(entry[0])
+        return refs
+
+    def chunk_digests(self) -> set[str]:
+        return {
+            key.rsplit("/", 1)[-1]
+            for key in self.objects.list(CHUNK_PREFIX)
+        }
+
+    def pinned_digests(self) -> set[str]:
+        now = self.clock()
+        with self._lock:
+            # expired pre-copy pins are dead suspends: drop the entries so
+            # neither GC protection nor memory outlives them
+            for k in [k for k, (_, exp) in self._pins.items() if exp <= now]:
+                del self._pins[k]
+            pinned = {d for ds, _ in self._pins.values() for d in ds}
+            pinned.update(self._load_pins)
+        return pinned
+
+    def gc(self) -> list[str]:
+        """Mark-and-sweep: delete every chunk no parseable manifest
+        references and no in-flight pre-copy/restore pins. Liveness is
+        re-derived from the manifests on every sweep, so a crash anywhere
+        (incl. between manifest-commit and GC) can never orphan a
+        referenced chunk. Best-effort: a failed delete leaves garbage for
+        the next sweep, never breaks the caller."""
+        live = self.referenced_digests() | self.pinned_digests()
+        swept = []
+        for key in self.objects.list(CHUNK_PREFIX):
+            digest = key.rsplit("/", 1)[-1]
+            if digest in live:
+                continue
+            try:
+                self.objects.delete(key)
+                swept.append(key)
+            except Exception:
+                pass
+        return swept
+
     # ------------------------------------------------------------ plumbing
 
     def _snapshot_ids(self, session: str) -> list[str]:
@@ -216,15 +784,17 @@ class SnapshotStore:
         ids = set()
         for key in self.objects.list(prefix):
             leaf = key[len(prefix) + 1:]
-            for suffix in (".commit", ".data", ".wal"):
+            for suffix in (".commit", ".manifest", ".data", ".wal"):
                 if leaf.endswith(suffix):
                     ids.add(leaf[: -len(suffix)])
         return sorted(ids)
 
     def _prune(self, session: str, *, keep_id: str) -> None:
         """Drop all but the newest ``keep`` committed snapshots (plus any
-        uncommitted debris older than them). Best-effort: a failed delete
-        leaves garbage, never breaks a save."""
+        uncommitted debris older than them). Chunks are NOT deleted here —
+        :meth:`gc`'s mark-and-sweep reclaims whatever the surviving
+        manifests no longer reference. Best-effort: a failed delete leaves
+        garbage, never breaks a save."""
         # light records rank the commits without re-reading every retained
         # payload; a torn commit does not parse, so it never counts toward
         # the keep budget (it is debris either way)
@@ -245,7 +815,7 @@ class SnapshotStore:
         for sid in self._snapshot_ids(session):
             if sid in keep:
                 continue
-            for suffix in (".wal", ".data", ".commit"):
+            for suffix in (".commit", ".manifest", ".data", ".wal"):
                 try:
                     self.objects.delete(f"{prefix}/{sid}{suffix}")
                 except Exception:
@@ -255,11 +825,33 @@ class SnapshotStore:
 class FileObjectStore:
     """Filesystem-backed object store for production single-writer use (a
     mounted PVC or FUSE bucket). Puts are atomic at the object level via
-    tmp-file + fsync + rename — a torn write leaves the old object, matching
-    the store discipline the fake injects faults against."""
+    tmp-file + rename — a torn write leaves the old object, matching the
+    store discipline the fake injects faults against.
 
-    def __init__(self, root: str) -> None:
+    Durability policy: ``sync='barrier'`` (default) skips the per-put
+    fsync; :meth:`sync` then fsyncs exactly the files written (or
+    dedup-probed after a restart, via :meth:`ensure_durable`) since the
+    last barrier, in parallel — the chunk store calls it once per save,
+    before the commit's read-back verify, so N chunk writes cost ~one
+    journal group-commit instead of N flushes. A power loss before the
+    barrier can leave a renamed-but-unflushed object truncated; the
+    store's verification reads truncation as a torn write and falls back,
+    so the no-loss discipline is unchanged. ``sync='always'`` restores the
+    per-put fsync."""
+
+    def __init__(self, root: str, sync: str = "barrier") -> None:
         self.root = root
+        if sync not in ("barrier", "always"):
+            raise ValueError(f"sync must be 'barrier' or 'always', got {sync!r}")
+        self.sync_policy = sync
+        self._lock = threading.Lock()
+        self._pending: set[str] = set()  # paths written since last sync()
+        # paths THIS process has flushed: a restarted process starts empty,
+        # so the first save that dedups against a pre-crash chunk re-fsyncs
+        # it once (cheap — no dirty pages) instead of trusting a write the
+        # dead process never barriered
+        self._durable: set[str] = set()
+        self._sync_pool: concurrent.futures.ThreadPoolExecutor | None = None
 
     def _path(self, key: str) -> str:
         # keys are forward-slash namespaced; keep them inside root
@@ -274,10 +866,69 @@ class FileObjectStore:
             with open(tmp, "wb") as f:
                 f.write(data)
                 f.flush()
-                os.fsync(f.fileno())
+                if self.sync_policy == "always":
+                    os.fsync(f.fileno())
             os.replace(tmp, path)
+            if self.sync_policy == "always":
+                # the rename is durable only once the parent directory's
+                # entry is — without this, a power loss can lose a
+                # "verified" object whose data was fsync'd but whose name
+                # was not
+                fd = os.open(os.path.dirname(path), os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
         except OSError as e:
             raise StoreError(f"put {key}: {e}") from e
+        if self.sync_policy == "barrier":
+            with self._lock:
+                self._pending.add(path)
+
+    def ensure_durable(self, key: str) -> None:
+        """Queue an EXISTING object for the next barrier unless this
+        process already flushed it — how a dedup hit stays durable across
+        a crash-restart of the writer (the dead process may never have
+        barriered its write; page cache makes it look fine)."""
+        if self.sync_policy != "barrier":
+            return
+        path = self._path(key)
+        with self._lock:
+            if path not in self._durable:
+                self._pending.add(path)
+
+    def sync(self) -> None:
+        """The durability barrier for ``sync='barrier'`` puts: fsync every
+        file written since the last barrier, in parallel (the journal
+        group-commits concurrent fsyncs, so N files cost ~one flush)."""
+        if self.sync_policy != "barrier":
+            return
+        with self._lock:
+            pending, self._pending = self._pending, set()
+            if self._sync_pool is None:
+                self._sync_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="store-sync"
+                )
+            pool = self._sync_pool
+
+        def flush(path: str) -> None:
+            try:
+                fd = os.open(path, os.O_RDONLY)
+            except FileNotFoundError:
+                return  # replaced or pruned since: nothing left to flush
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+
+        try:
+            list(pool.map(flush, sorted(pending)))
+        except OSError as e:
+            with self._lock:
+                self._pending |= pending  # retryable
+            raise StoreError(f"sync: {e}") from e
+        with self._lock:
+            self._durable |= pending
 
     def get(self, key: str) -> bytes:
         try:
@@ -290,6 +941,15 @@ class FileObjectStore:
             # store contract's StoreError so callers requeue-and-retry
             # instead of treating it as a controller bug
             raise StoreError(f"get {key}: {e}") from e
+
+    def stat(self, key: str) -> int | None:
+        """Object size without reading it (the chunk dedup probe)."""
+        try:
+            return os.stat(self._path(key)).st_size
+        except FileNotFoundError:
+            return None
+        except OSError as e:
+            raise StoreError(f"stat {key}: {e}") from e
 
     def list(self, prefix: str) -> list[str]:
         base = self._path(prefix)
@@ -305,8 +965,14 @@ class FileObjectStore:
         return sorted(out)
 
     def delete(self, key: str) -> None:
+        path = self._path(key)
+        with self._lock:
+            # bound the bookkeeping: a deleted path re-enters _pending via
+            # put() if it is ever recreated
+            self._pending.discard(path)
+            self._durable.discard(path)
         try:
-            os.remove(self._path(key))
+            os.remove(path)
         except FileNotFoundError:
             pass
         except OSError as e:

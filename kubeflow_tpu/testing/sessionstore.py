@@ -3,16 +3,26 @@ in-pod session agent stand-in.
 
 :class:`FakeObjectStore` is the soak's durable store. Its faults model a
 real object store misbehaving at exactly the writes the snapshot discipline
-exists for (``sessions/store.py``):
+exists for (``sessions/store.py``) — chunk writes, manifest writes, and
+commit writes alike:
 
 - **error**: the write never applied (plain 5xx);
 - **lost**: the write APPLIED but the response was lost — the retry-on-
   success case the read-back verify absorbs;
 - **torn**: the writer died mid-write — the store holds a truncated object
-  and the caller saw an error. A torn ``.commit`` must never be restored.
+  and the caller saw an error. A torn ``.commit``/``.manifest`` must never
+  be restored, and a torn chunk must never be reused.
 
-All draws come from one seeded PRNG in call order, so a failing sessions
-soak seed replays exactly.
+Every draw is derived from (seed, write stream, per-stream attempt
+number), NOT from one PRNG in call order: the chunk store writes chunks
+on a worker pool, and per-stream derivation makes the fault schedule
+independent of thread interleaving — a failing sessions soak seed still
+replays exactly. The stream name normalizes the snapshot id out of
+session-object keys (``sessions/<ns>/<nb>/<sid>.commit`` →
+``sessions/<ns>/<nb>/*.commit``): snapshot ids embed the CR uid, which
+the fake cluster mints randomly, and keying the seeded draw on them
+would smuggle uuid4 into the schedule. Chunk keys are content digests —
+already deterministic — and stay as-is.
 
 :class:`FakeSessionAgent` stands in for the in-pod agent (a Jupyter server
 extension that calls ``utils/checkpoint.snapshot_for_suspend`` — save,
@@ -29,6 +39,7 @@ from __future__ import annotations
 import collections
 import json
 import random
+import threading
 
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.runtime import objects as ko
@@ -56,48 +67,78 @@ class StoreChaosConfig:
 class FakeObjectStore:
     """In-memory object store with seeded write faults (reads are the local
     volume / GET path and stay reliable — the discipline under test is the
-    write side)."""
+    write side). Thread-safe: the chunk store's worker pool writes chunks
+    concurrently, and per-(key, attempt) fault derivation keeps the
+    schedule deterministic no matter how the threads interleave."""
 
     def __init__(
         self, *, seed: int = 0, chaos: StoreChaosConfig | None = None
     ) -> None:
         self._objects: dict[str, bytes] = {}
         self.cfg = chaos or StoreChaosConfig.quiet()
-        self.rng = random.Random(f"store-{seed}")
+        self.seed = seed
         self._healed = False
+        self._lock = threading.Lock()
+        self._attempts: collections.Counter = collections.Counter()
         self.fault_counts: collections.Counter = collections.Counter()
 
     def heal(self) -> None:
         self._healed = True
 
+    @staticmethod
+    def _fault_stream(key: str) -> str:
+        if key.startswith("sessions/"):
+            prefix, leaf = key.rsplit("/", 1)
+            if "." in leaf:
+                return f"{prefix}/*{leaf[leaf.rindex('.'):]}"
+        return key
+
     def put(self, key: str, data: bytes) -> None:
         if isinstance(data, str):  # tolerate str payloads from tests
             data = data.encode()
-        if not self._healed:
-            r = self.rng.random()
-            if r < self.cfg.error_rate:
-                self.fault_counts["error"] += 1
-                raise StoreError(f"chaos: put {key} failed (not applied)")
-            if r < self.cfg.error_rate + self.cfg.lost_rate:
-                self._objects[key] = bytes(data)
-                self.fault_counts["lost"] += 1
-                raise StoreError(f"chaos: put {key} response lost (applied)")
-            if r < self.cfg.error_rate + self.cfg.lost_rate + self.cfg.torn_rate:
-                self._objects[key] = bytes(data[: max(0, len(data) // 2)])
-                self.fault_counts["torn"] += 1
-                raise StoreError(f"chaos: writer died mid-put {key} (torn)")
-        self._objects[key] = bytes(data)
+        with self._lock:
+            if not self._healed:
+                stream = self._fault_stream(key)
+                self._attempts[stream] += 1
+                r = random.Random(
+                    f"store-{self.seed}|{stream}|{self._attempts[stream]}"
+                ).random()
+                if r < self.cfg.error_rate:
+                    self.fault_counts["error"] += 1
+                    raise StoreError(f"chaos: put {key} failed (not applied)")
+                if r < self.cfg.error_rate + self.cfg.lost_rate:
+                    self._objects[key] = bytes(data)
+                    self.fault_counts["lost"] += 1
+                    raise StoreError(
+                        f"chaos: put {key} response lost (applied)"
+                    )
+                if r < (self.cfg.error_rate + self.cfg.lost_rate
+                        + self.cfg.torn_rate):
+                    self._objects[key] = bytes(data[: max(0, len(data) // 2)])
+                    self.fault_counts["torn"] += 1
+                    raise StoreError(f"chaos: writer died mid-put {key} (torn)")
+            self._objects[key] = bytes(data)
 
     def get(self, key: str) -> bytes:
-        if key not in self._objects:
-            raise KeyError(key)
-        return self._objects[key]
+        with self._lock:
+            if key not in self._objects:
+                raise KeyError(key)
+            return self._objects[key]
+
+    def stat(self, key: str) -> int | None:
+        with self._lock:
+            data = self._objects.get(key)
+        return None if data is None else len(data)
 
     def list(self, prefix: str) -> list[str]:
-        return sorted(k for k in self._objects if k.startswith(prefix + "/"))
+        with self._lock:
+            return sorted(
+                k for k in self._objects if k.startswith(prefix + "/")
+            )
 
     def delete(self, key: str) -> None:
-        self._objects.pop(key, None)
+        with self._lock:
+            self._objects.pop(key, None)
 
 
 class FakeSessionAgent:

@@ -104,6 +104,20 @@ def snapshot_for_suspend(manager: CheckpointManager, step: int, state: Any) -> i
     return manager.latest_step()
 
 
+def snapshot_for_precopy(manager: CheckpointManager) -> int | None:
+    """The suspend PRE-COPY pass's read: the newest step that is ALREADY
+    durable, without forcing a save and without blocking the kernel.
+
+    The sessions controller streams a best-effort chunk pass while the
+    session is still running (docs/sessions.md "snapshot fast path"); the
+    session extension serves that first snapshot request from here — the
+    user's cells keep executing, nothing stops the world. Drift between
+    this step and the final forced ``snapshot_for_suspend`` is exactly the
+    residual delta the barrier's save then writes. Returns None when no
+    step has landed yet (the pre-copy is skipped, never waited on)."""
+    return manager.latest_step()
+
+
 def resume_or_init(directory: str, init_fn, *args, **kwargs):
     """The notebook-friendly entrypoint: restore the latest checkpoint if one
     exists, else build fresh state. Combined with the platform's stop/restart

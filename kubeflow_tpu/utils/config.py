@@ -61,6 +61,11 @@ class ControllerConfig:
     # shipped controller-manager process enables it (SESSIONS_ENABLED).
     sessions_enabled: bool = False
     suspend_deadline_s: float = 120.0
+    # Snapshot fast path: stream a best-effort dirty-chunk pass while the
+    # session is still running, so the suspend barrier writes only the
+    # residual delta (docs/sessions.md "snapshot fast path"). Safe to
+    # disable (every suspend then pays the full blocking save).
+    sessions_precopy: bool = True
     # Session telemetry (kubeflow_tpu/telemetry/): when enabled, the fleet
     # collector scrapes every TPU notebook's in-pod agent in one parallel
     # pass per interval, and the culler prefers the device duty-cycle
@@ -97,6 +102,7 @@ class ControllerConfig:
             scheduler_enabled=_env_bool("SCHEDULER_ENABLED", True),
             sessions_enabled=_env_bool("SESSIONS_ENABLED", True),
             suspend_deadline_s=_env_float("SUSPEND_DEADLINE_S", 120.0),
+            sessions_precopy=_env_bool("SESSIONS_PRECOPY", True),
             telemetry_enabled=_env_bool("TELEMETRY_ENABLED", True),
             telemetry_interval_s=_env_float("TELEMETRY_INTERVAL_S", 15.0),
             telemetry_staleness_s=_env_float("TELEMETRY_STALENESS_S", 60.0),

@@ -481,6 +481,11 @@ class SessionMetrics:
     # by the queue wait + gang start (seconds to hours)
     SUSPEND_BUCKETS = (0.5, 1.0, 5.0, 15.0, 60.0, 120.0, 300.0, 900.0)
     RESUME_BUCKETS = (1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 3600.0, 14400.0)
+    # residual bytes span "nothing changed" (first bucket) to a full
+    # re-copy of a large session
+    RESIDUAL_BUCKETS = (
+        1024.0, 65536.0, 1048576.0, 16777216.0, 268435456.0, 1073741824.0,
+    )
 
     def __init__(self, registry: Registry | None = None) -> None:
         self.registry = registry or Registry()
@@ -516,6 +521,31 @@ class SessionMetrics:
             "Resume-start→restore-complete latency (includes any queue wait)",
             buckets=self.RESUME_BUCKETS,
         )
+        # snapshot fast path (docs/sessions.md): logical vs physical bytes
+        # is the dedup story — physical ≪ logical means warm suspends are
+        # writing only dirty chunks, the whole point of the chunk store
+        self.snapshot_logical_bytes = self.registry.counter(
+            "session_snapshot_logical_bytes_total",
+            "Payload bytes committed through snapshot saves",
+        )
+        self.snapshot_physical_bytes = self.registry.counter(
+            "session_snapshot_physical_bytes_total",
+            "Chunk bytes physically written (after dedup; incl. pre-copy)",
+        )
+        self.dedup_ratio = self.registry.gauge(
+            "session_snapshot_dedup_ratio",
+            "Cumulative logical/physical byte ratio (1.0 = no dedup)",
+        )
+        self.chunk_pool_queue_depth = self.registry.gauge(
+            "session_chunk_pool_queue_depth",
+            "Chunk I/O operations queued on the store's worker pool",
+        )
+        self.precopy_residual_bytes = self.registry.histogram(
+            "session_precopy_residual_bytes",
+            "Bytes written INSIDE the suspend barrier after a pre-copy "
+            "pass (the stop-the-world residual)",
+            buckets=self.RESIDUAL_BUCKETS,
+        )
 
     def observe_suspend(self, seconds: float, reason: str) -> None:
         self.suspends.inc(reason=reason)
@@ -524,6 +554,28 @@ class SessionMetrics:
     def observe_resume(self, seconds: float, *, from_snapshot: bool) -> None:
         self.resumes.inc(from_snapshot="true" if from_snapshot else "false")
         self.time_to_resume.observe(max(0.0, seconds))
+
+    def _update_dedup(self) -> None:
+        physical = self.snapshot_physical_bytes.get()
+        if physical > 0:
+            self.dedup_ratio.set(
+                self.snapshot_logical_bytes.get() / physical
+            )
+
+    def observe_precopy(self, logical: int, written: int) -> None:
+        """One pre-copy pass: counts toward physical bytes (the chunks are
+        durable) but NOT logical (nothing committed yet)."""
+        if written:
+            self.snapshot_physical_bytes.inc(written)
+        self._update_dedup()
+
+    def observe_save(self, logical: int, written: int) -> None:
+        """One committed save: the payload's logical size and the residual
+        chunk bytes the barrier actually wrote."""
+        self.snapshot_logical_bytes.inc(logical)
+        if written:
+            self.snapshot_physical_bytes.inc(written)
+        self._update_dedup()
 
 
 class SchedulerMetrics:
@@ -544,6 +596,9 @@ class SchedulerMetrics:
     # phases are sub-cycle: an incremental steady-state phase is sub-ms,
     # a cold full rebuild can take the whole cycle budget
     PHASE_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+    # handoff hold: snapshot-commit bound (sub-second warm, the force
+    # deadline worst-case)
+    HANDOFF_BUCKETS = (0.5, 1.0, 5.0, 15.0, 60.0, 120.0, 300.0, 900.0)
 
     def __init__(self, registry: Registry | None = None) -> None:
         self.registry = registry or Registry()
@@ -604,6 +659,15 @@ class SchedulerMetrics:
             "scheduler_fit_cache_misses_total",
             "Failed fit attempts recorded into the negative-fit cache",
         )
+        # preemption handoff hold time: suspend-request→chip-release. The
+        # preemptor's time-to-bind is bounded below by this — the snapshot
+        # fast path (docs/sessions.md) exists to shrink it
+        self.handoff_seconds = self.registry.histogram(
+            "scheduler_handoff_seconds",
+            "Suspend-request→placement-release latency of preemption "
+            "handoffs",
+            buckets=self.HANDOFF_BUCKETS,
+        )
 
     def observe_cycle(
         self,
@@ -637,6 +701,9 @@ class SchedulerMetrics:
         self.time_to_bind.observe(seconds)
         if seconds > self.bind_seconds_max.get():
             self.bind_seconds_max.set(seconds)
+
+    def observe_handoff(self, seconds: float) -> None:
+        self.handoff_seconds.observe(max(0.0, seconds))
 
 
 class TelemetryMetrics:

@@ -146,6 +146,30 @@ class TestTornCheckpoint:
         assert state == {"step": 0}
         assert calls == []
 
+    def test_snapshot_for_precopy_reads_without_forcing_a_save(
+        self, monkeypatch, tmp_path
+    ):
+        """The pre-copy pass must not stop the world: it reports the newest
+        ALREADY-durable step (None when nothing landed) and never calls
+        save() or wait_until_finished() — drift to the final forced save is
+        the residual delta the barrier then writes."""
+        from kubeflow_tpu.utils.checkpoint import snapshot_for_precopy
+
+        self._stub_orbax(monkeypatch, steps=[4, 7], torn=set(),
+                         restore_calls=[])
+        mgr = CheckpointManager(str(tmp_path))
+        forbidden = []
+        monkeypatch.setattr(
+            mgr, "save", lambda *a, **k: forbidden.append("save"))
+        monkeypatch.setattr(
+            mgr, "wait_until_finished",
+            lambda: forbidden.append("wait"))
+        assert snapshot_for_precopy(mgr) == 7
+        assert forbidden == []
+
+        self._stub_orbax(monkeypatch, steps=[], torn=set(), restore_calls=[])
+        assert snapshot_for_precopy(CheckpointManager(str(tmp_path))) is None
+
 
 class TestProfiling:
     def test_trace_writes_profile_dir(self, tmp_path):

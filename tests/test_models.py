@@ -363,3 +363,43 @@ class TestMXUBatchNorm:
         y, mutated = model.apply(vars_, x, mutable=["batch_stats"])
         assert y.shape == (2, 10)
         assert "batch_stats" in mutated
+
+    @pytest.mark.parametrize(
+        "shape",
+        [(4, 8, 8, 16),     # rows 256 >= ch 16: the dot path
+         (2, 2, 1, 64)],    # rows 4 < ch 64: the small-m XLA fallback
+        ids=["gram-dots", "small-m-fallback"],
+    )
+    def test_large_mean_low_variance_never_negative(self, shape):
+        """Regression (ADVICE r5 high): E[x^2] - mean^2 cancels to a
+        NEGATIVE variance for large-mean/low-variance channels; unclamped,
+        rsqrt NaNs the bf16 output and the negative var poisons the
+        running-var EMA. Both MXU paths must clamp like the others do."""
+        from kubeflow_tpu.ops.bn_pallas import _moments, batch_norm_train
+
+        x = (jax.random.normal(jax.random.PRNGKey(7), shape) * 1e-3
+             + 4096.0).astype(jnp.float32)
+        mean, var = _moments(x, "mxu")
+        assert np.all(np.asarray(var) >= 0.0), np.asarray(var).min()
+        y, (_, var2) = batch_norm_train(
+            x.astype(jnp.bfloat16),
+            jnp.ones((shape[-1],)), jnp.zeros((shape[-1],)),
+            strategy="mxu",
+        )
+        assert np.all(np.isfinite(np.asarray(y, np.float32)))
+        assert np.all(np.asarray(var2) >= 0.0)
+
+    def test_unknown_strategy_and_bn_impl_raise(self):
+        """Regression (ADVICE r5 low): a typo like 'MXU' must raise, not
+        silently select the Pallas path."""
+        from kubeflow_tpu.models.resnet import ResNet18
+        from kubeflow_tpu.ops.bn_pallas import batch_norm_train
+
+        x = jnp.ones((2, 4, 4, 8))
+        with pytest.raises(ValueError, match="strategy"):
+            batch_norm_train(x, jnp.ones((8,)), jnp.zeros((8,)),
+                             strategy="MXU")
+        model = ResNet18(num_classes=10, width=8, dtype=jnp.float32,
+                         bn_impl="cudnn")
+        with pytest.raises(ValueError, match="bn_impl"):
+            model.init(jax.random.PRNGKey(0), jnp.ones((1, 32, 32, 3)))

@@ -799,7 +799,7 @@ class TestPreemptionSuspendBarrier:
     (docs/sessions.md): victim suspend → snapshot commit → chip release →
     preemptor bound, with the victim resumable from its snapshot."""
 
-    def _platform(self, cluster, clock, agent, store):
+    def _platform(self, cluster, clock, agent, store, sched_metrics=None):
         from kubeflow_tpu.obs.events import EventRecorder
         from kubeflow_tpu.sessions.controller import SessionReconciler
 
@@ -812,6 +812,7 @@ class TestPreemptionSuspendBarrier:
             cfg, clock=clock, recorder=EventRecorder(clock=clock)))
         m.register(SchedulerReconciler(
             clock=clock, suspend_deadline_s=120.0,
+            metrics=sched_metrics,
             recorder=EventRecorder(clock=clock)))
         m.register(SessionReconciler(
             store, agent, config=cfg, clock=clock,
@@ -843,8 +844,9 @@ class TestPreemptionSuspendBarrier:
         clock = Clock()
         agent = GatedAgent(cluster)
         store = SnapshotStore(FakeObjectStore())
+        sched_metrics = SchedulerMetrics()
         make_pool(cluster, "v4", "2x2x2", "tiny")  # one gang's worth
-        mgr = self._platform(cluster, clock, agent, store)
+        mgr = self._platform(cluster, clock, agent, store, sched_metrics)
 
         cluster.create(api.notebook("victim", NS, tpu_accelerator="v4",
                                     tpu_topology="2x2x2"))
@@ -892,6 +894,10 @@ class TestPreemptionSuspendBarrier:
         conds = _conds(victim)
         assert conds["Preempted"]["status"] == "True"
         assert conds["Queued"]["status"] == "True"
+        # the handoff hold time (request → release) landed in the histogram
+        # the snapshot fast path is judged by
+        assert sched_metrics.handoff_seconds.count() == 1
+        assert sched_metrics.handoff_seconds.quantile(0.5) > 0.0
 
         # capacity returns: the victim re-binds and resumes FROM THE
         # SNAPSHOT (never cold) — the no-loss promise, end to end

@@ -115,10 +115,229 @@ class TestSnapshotStore:
         for i in range(5):
             store.save("ns/nb", f"v{i}".encode(),
                        snapshot_id=f"sid{i}", now=float(i))
+            # what the controller runs after each ack (post-barrier)
+            store.maintain("ns/nb", keep_id=f"sid{i}")
         ids = {k.split("/")[-1].split(".")[0]
                for k in objects.list("sessions/ns/nb")}
         assert ids == {"sid3", "sid4"}  # keep=2
         assert store.load("ns/nb") == b"v4"
+
+
+# ------------------------------------------------------------- chunk store
+
+
+class TestChunkStore:
+    """The snapshot fast path's crash matrix (docs/sessions.md "snapshot
+    fast path"): content-addressed dedup, torn-manifest fallback, chunk
+    corruption structurally unrestorable, GC vs pins, legacy layout."""
+
+    CS = 1024  # small chunks so a few KiB of payload spans many
+
+    def _store(self, **kw):
+        objects = FakeObjectStore()
+        return SnapshotStore(objects, chunk_size=self.CS, **kw), objects
+
+    def test_warm_save_writes_only_dirty_chunks(self):
+        store, objects = self._store()
+        p1 = bytes(bytearray(random_bytes(8 * self.CS, seed=1)))
+        rec1 = store.save("ns/nb", p1, snapshot_id="s1", now=1.0)
+        assert rec1["physicalBytes"] == len(p1)
+        # dirty exactly one chunk
+        p2 = bytearray(p1)
+        p2[3 * self.CS + 10] ^= 0xFF
+        rec2 = store.save("ns/nb", bytes(p2), snapshot_id="s2", now=2.0)
+        assert rec2["physicalBytes"] == self.CS  # one chunk, not 8
+        assert store.load("ns/nb", "s2") == bytes(p2)
+        assert store.load("ns/nb", "s1") == p1  # old generation intact
+
+    def test_precopy_then_save_commits_residual_only(self):
+        store, objects = self._store()
+        p1 = random_bytes(8 * self.CS, seed=2)
+        pre = store.precopy("ns/nb", p1, snapshot_id="s1")
+        assert pre.written_bytes == len(p1)
+        # the session kept running: one chunk drifted before the barrier
+        p2 = bytearray(p1)
+        p2[5 * self.CS:5 * self.CS + 4] = b"drft"
+        rec = store.save(
+            "ns/nb", bytes(p2), snapshot_id="s1", now=1.0, precopy=pre
+        )
+        # the barrier wrote ONLY the drifted chunk (the residual delta)
+        assert rec["physicalBytes"] == self.CS
+        assert store.load("ns/nb", "s1") == bytes(p2)
+
+    def test_precopy_digest_reuse_is_correct_across_lengths(self):
+        """Digest reuse via the byte-diff must never mislabel a chunk —
+        including grown/shrunk payloads and partial tail chunks."""
+        store, _ = self._store()
+        base = random_bytes(4 * self.CS + 100, seed=3)
+        for newlen in (4 * self.CS + 100, 2 * self.CS + 7,
+                       6 * self.CS, 4 * self.CS + 101, 0):
+            pre = store.precopy("ns/nb", base, snapshot_id=f"s{newlen}")
+            grown = random_bytes(newlen, seed=newlen)
+            rec = store.save(
+                "ns/nb", grown, snapshot_id=f"s{newlen}", now=1.0,
+                precopy=pre,
+            )
+            assert store.load("ns/nb", f"s{newlen}") == grown, newlen
+            assert rec["size"] == newlen
+
+    def test_torn_manifest_falls_back_to_previous_snapshot(self):
+        store, objects = self._store()
+        old = random_bytes(3 * self.CS, seed=4)
+        store.save("ns/nb", old, snapshot_id="old1", now=1.0)
+        new = random_bytes(3 * self.CS, seed=5)
+        store.save("ns/nb", new, snapshot_id="new2", now=2.0)
+        # the writer died mid-manifest-write: truncate it in place
+        mkey = "sessions/ns/nb/new2.manifest"
+        objects.put(mkey, objects.get(mkey)[: len(objects.get(mkey)) // 2])
+        assert store.commit_record("ns/nb", "new2") is None
+        assert store.committed("ns/nb")["snapshotId"] == "old1"
+        assert store.load("ns/nb") == old
+
+    def test_chunk_digest_mismatch_is_structurally_unrestorable(self):
+        """A corrupt chunk must never yield a PARTIAL restore — the whole
+        snapshot reads as not-committed."""
+        store, objects = self._store()
+        payload = random_bytes(4 * self.CS, seed=6)
+        rec = store.save("ns/nb", payload, snapshot_id="s1", now=1.0)
+        assert rec is not None
+        # corrupt one chunk at rest (same size, different bytes)
+        victim = sorted(objects.list("chunks"))[0]
+        data = bytearray(objects.get(victim))
+        data[0] ^= 0xFF
+        objects.put(victim, bytes(data))
+        assert store.commit_record("ns/nb", "s1") is None
+        with pytest.raises(SnapshotUnavailable):
+            store.load("ns/nb", "s1")
+        with pytest.raises(SnapshotUnavailable):
+            store.load("ns/nb")
+
+    def test_crash_between_chunk_write_and_manifest_leaks_nothing(self):
+        """Chunks written by a save whose manifest never committed are
+        unreferenced debris; one GC sweep reclaims every byte."""
+        store, objects = self._store()
+        # fault EVERY put: the chunk writes apply ("lost"), the save fails
+        objects.cfg = StoreChaosConfig(error_rate=0.0, lost_rate=1.0,
+                                       torn_rate=0.0)
+        with pytest.raises(StoreError):
+            store.save("ns/nb", random_bytes(4 * self.CS, seed=7),
+                       snapshot_id="s1", now=1.0)
+        assert objects.list("chunks")  # the leak exists...
+        store.gc()
+        assert objects.list("chunks") == []  # ...and GC reclaims it all
+
+    def test_gc_never_collects_precopy_pinned_chunks(self):
+        store, objects = self._store()
+        payload = random_bytes(4 * self.CS, seed=8)
+        store.precopy("ns/nb", payload, snapshot_id="s1")
+        # no manifest references these chunks yet — only the pin protects
+        store.gc()
+        assert len(objects.list("chunks")) == 4
+        store.unpin("ns/nb", "s1")  # suspend abandoned
+        store.gc()
+        assert objects.list("chunks") == []
+
+    def test_precopy_pin_expires_so_dead_suspends_cannot_leak(self):
+        """A suspend that never saves (notebook deleted with the watch
+        event dropped, initiator gone) must not shield its pre-copied
+        chunks from GC forever: past the pin TTL the pin is dead and the
+        sweep reclaims."""
+        t = {"now": 1000.0}
+        objects = FakeObjectStore()
+        store = SnapshotStore(
+            objects, chunk_size=self.CS, clock=lambda: t["now"],
+            pin_ttl_s=100.0,
+        )
+        store.precopy("ns/nb", random_bytes(4 * self.CS, seed=12),
+                      snapshot_id="s1")
+        store.gc()
+        assert len(objects.list("chunks")) == 4  # pinned: protected
+        t["now"] += 101.0
+        assert store.pinned_digests() == set()  # expired
+        store.gc()
+        assert objects.list("chunks") == []
+
+    def test_gc_never_collects_chunks_of_inflight_restore(self):
+        """A sweep racing an in-flight restore must not pull chunks out
+        from under it — the load pins them for its duration, even when the
+        snapshot's own manifest is pruned mid-read (the exact window where
+        refcount-free GC would eat it)."""
+        store, objects = self._store(workers=0)
+        payload = random_bytes(4 * self.CS, seed=9)
+        store.save("ns/nb", payload, snapshot_id="s1", now=1.0)
+
+        real_get = objects.get
+        fired = {"n": 0}
+
+        def hostile_get(key):
+            data = real_get(key)
+            if key.startswith("chunks/") and fired["n"] == 0:
+                fired["n"] = 1
+                objects.delete("sessions/ns/nb/s1.manifest")
+                objects.delete("sessions/ns/nb/s1.commit")
+                store.gc()
+            return data
+
+        objects.get = hostile_get
+        try:
+            # the pin keeps every chunk readable: the restore completes
+            assert store.load("ns/nb", "s1") == payload
+        finally:
+            objects.get = real_get
+        # with the restore done and the manifest gone, the next sweep may
+        # reclaim — but not a byte earlier
+        store.gc()
+        assert objects.list("chunks") == []
+
+    def test_chunks_shared_across_sessions_survive_one_sessions_prune(self):
+        store, objects = self._store()
+        payload = random_bytes(4 * self.CS, seed=11)
+        store.save("ns/a", payload, snapshot_id="a1", now=1.0)
+        store.save("ns/b", payload, snapshot_id="b1", now=2.0)
+        # dedup: the second save wrote nothing new
+        assert store.committed("ns/b")["physicalBytes"] == 0
+        # session a prunes everything (simulate teardown of its snapshots)
+        for suffix in (".commit", ".manifest", ".wal"):
+            objects.delete(f"sessions/ns/a/a1{suffix}")
+        store.gc()
+        assert store.load("ns/b") == payload  # b's reference kept them live
+
+    def test_legacy_monolithic_snapshot_still_restores(self):
+        """Snapshots committed by the pre-chunking store must stay
+        restorable (a controller upgrade must not strand suspended
+        sessions)."""
+        store, objects = self._store()
+        payload = b"legacy session bytes"
+        import hashlib as _h
+        objects.put("sessions/ns/nb/leg1.wal", b"{}")
+        objects.put("sessions/ns/nb/leg1.data", payload)
+        objects.put("sessions/ns/nb/leg1.commit", json.dumps({
+            "snapshotId": "leg1",
+            "digest": _h.sha256(payload).hexdigest(),
+            "size": len(payload), "committedAt": 5.0,
+        }, sort_keys=True).encode())
+        assert store.committed("ns/nb")["snapshotId"] == "leg1"
+        assert store.load("ns/nb") == payload
+
+    def test_lost_manifest_write_retries_idempotently(self):
+        store, objects = self._store()
+        objects.cfg = StoreChaosConfig(error_rate=0.0, lost_rate=1.0,
+                                       torn_rate=0.0)
+        with pytest.raises(StoreError):
+            store.save("ns/nb", b"x" * (2 * self.CS), snapshot_id="s1",
+                       now=1.0)
+        objects.heal()
+        rec = store.save("ns/nb", b"x" * (2 * self.CS), snapshot_id="s1",
+                         now=2.0)
+        assert rec["snapshotId"] == "s1"
+        assert store.load("ns/nb") == b"x" * (2 * self.CS)
+        assert len(objects.list("sessions/ns/nb")) == 3
+
+
+def random_bytes(n: int, *, seed: int) -> bytes:
+    import random as _random
+
+    return _random.Random(seed).randbytes(n)
 
 
 # ------------------------------------------------------ integration harness
@@ -376,6 +595,56 @@ class TestSuspendResume:
         ids = {k.split("/")[-1].split(".")[0]
                for k in objects.list("sessions/team-a/nb")}
         assert ids == {ack["snapshotId"]}
+
+    def test_suspend_precopies_then_commits_residual(self):
+        """The snapshot fast path end-to-end: the first Suspending pass
+        streams chunks while the pods are still up (pre-copy), the next
+        pass commits only the residual inside the barrier, and the byte/
+        dedup/residual metrics tell the story."""
+        from kubeflow_tpu.utils.metrics import SessionMetrics
+
+        cluster = FakeCluster()
+        clock = _Clock()
+        cfg = ControllerConfig(sessions_enabled=True, suspend_deadline_s=60.0)
+        metrics = SessionMetrics()
+        objects = FakeObjectStore()
+        store = SnapshotStore(objects, metrics=metrics)
+        agent = FakeSessionAgent(cluster)
+        mgr = Manager(cluster, clock=clock)
+        mgr.register(NotebookReconciler(cfg, clock=clock))
+        mgr.register(
+            SessionReconciler(store, agent, config=cfg, metrics=metrics,
+                              clock=clock)
+        )
+        cluster.create(api.notebook("nb", NS))
+        _drive(cluster, mgr, clock, rounds=3)
+        agent.work["team-a/nb"] = 5
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        _drive(cluster, mgr, clock, rounds=4, dt=2.0)
+        nb = cluster.get("Notebook", "nb", NS)
+        ack = sess.snapshot_record(nb)
+        assert ack is not None
+        # the pre-copy pass ran: residual histogram observed exactly once,
+        # and physical bytes were written (counted through the pre-copy)
+        assert metrics.precopy_residual_bytes.count() == 1
+        assert metrics.snapshot_physical_bytes.get() > 0
+        assert metrics.snapshot_logical_bytes.get() > 0
+        # no pin survives the ack, and nothing orphaned after housekeeping
+        assert store.pinned_digests() == set()
+        store.gc()
+        assert store.chunk_digests() <= store.referenced_digests()
+
+    def test_suspend_with_precopy_disabled_commits_directly(self):
+        cluster, mgr, clock, store, agent = _world()
+        mgr._reconcilers[1].precopy_enabled = False
+        cluster.create(api.notebook("nb", NS))
+        _drive(cluster, mgr, clock, rounds=3)
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        _drive(cluster, mgr, clock, rounds=3, dt=2.0)
+        nb = cluster.get("Notebook", "nb", NS)
+        assert sess.snapshot_record(nb) is not None
 
     def test_resume_restores_original_queue_seniority(self):
         """The ack carries queued-at; a resume re-stamps it so the scheduler

@@ -218,6 +218,41 @@ class TestTimelineRecorder:
         assert marks["queuedAt"] == marks["createdAt"]  # clamped, not before
         assert audit_timeline(cluster) == []
 
+    def test_lost_generation_wipe_self_repairs_on_fresh_admission(self):
+        """Regression (sessions soak seeds 211/349): a stop drops the
+        gang's seniority, the timeline wipe patch is lost to an API fault,
+        and the gang restarts — the stale marks then record a queuedAt
+        OLDER than the fresh queue admission, the exact inconsistency the
+        cross-source audit flags. Observing the newer admission must
+        rebuild the timeline (a new start), never splice onto the old."""
+        from kubeflow_tpu import scheduler as sched
+        from kubeflow_tpu.obs.timeline import marks_of
+
+        clock = _Clock()
+        rec = TimelineRecorder(clock=clock)
+        cluster = FakeCluster()
+        nb = cluster.create(api.notebook("nb", NS))
+        rec.record(
+            cluster, nb, stopping=False, queued_at=clock.t, bound_at=None,
+            restoring_at=None, pods_started=False, running=False,
+        )
+        stale = marks_of(cluster.get("Notebook", "nb", NS))
+        assert "queuedAt" in stale
+        # ...stop + lost wipe + restart: the live annotation now records a
+        # FRESH admission, while the stale marks survived
+        clock.advance(300.0)
+        fresh = clock.t
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            sched.QUEUED_AT_ANNOTATION: repr(fresh)}}})
+        nb = cluster.get("Notebook", "nb", NS)
+        rec.record(
+            cluster, nb, stopping=False, queued_at=fresh, bound_at=None,
+            restoring_at=None, pods_started=False, running=False,
+        )
+        marks = _nb_marks(cluster, "nb")
+        assert marks["queuedAt"] >= fresh - 1e-6  # rebuilt, not spliced
+        assert audit_timeline(cluster) == []
+
     def test_dropped_patch_defers_slo_observation(self):
         """A raced Conflict on the runningAt write must NOT observe the
         start: the annotation still lacks runningAt, so the next reconcile

@@ -88,6 +88,12 @@ def notebook(
         if tpu_num_slices > 1:
             # multislice: N identical slices joined over DCN (MEGASCALE)
             spec["tpu"]["numSlices"] = int(tpu_num_slices)
+        # family label (runtime/sharding.py): lets a sharded scheduler's
+        # list/watch select only its own families server-side. Stamped from
+        # the validated spec at construction; the owning shard heals drift.
+        from kubeflow_tpu.runtime.sharding import FAMILY_LABEL
+
+        labels = {**(labels or {}), FAMILY_LABEL: tpu_accelerator}
     return {
         "apiVersion": NOTEBOOK_API_VERSION,
         "kind": "Notebook",

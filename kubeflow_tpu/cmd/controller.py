@@ -113,64 +113,106 @@ def build_manager(
     config: ControllerConfig | None = None,
     *,
     fetch_kernels=fetch_kernels_http,
+    router=None,
+    shard_id: int = 0,
+    shared: dict | None = None,
 ) -> tuple[Manager, NotebookMetrics]:
+    """One manager — the whole control plane when ``router`` is None (the
+    historical single-loop behavior, unchanged), or one SHARD of it when a
+    :class:`~kubeflow_tpu.runtime.sharding.ShardRouter` is passed: the
+    manager's enqueue filter drops unowned namespaces, its scheduler owns
+    only its accelerator families, and its per-manager metric families
+    carry a ``shard`` label. ``shared`` carries the process-wide singletons
+    (metrics registry, tracer, telemetry collector, SLO plane, culler,
+    snapshot store) so N shard managers in one process — or the soaks'
+    in-process fleets — share one observability plane."""
     cfg = config or ControllerConfig.from_env()
-    metrics = NotebookMetrics()
+    shared = shared if shared is not None else {}
+    metrics = shared.setdefault("metrics", NotebookMetrics())
     # control-plane telemetry (docs/observability.md): reconcile tracing
     # (/debug/traces), reconcile/queue-wait/apiserver histograms (shared
     # registry → one /metrics), deduplicated Kubernetes Events
-    tracer = Tracer()
-    cp_metrics = ControlPlaneMetrics(metrics.registry)
+    tracer = shared.setdefault("tracer", Tracer())
+    shard_label = str(shard_id) if router is not None else None
+    cp_metrics = ControlPlaneMetrics(metrics.registry, shard=shard_label)
     recorder = EventRecorder()
-    telemetry = None
-    if cfg.telemetry_enabled:
-        # data-plane telemetry (kubeflow_tpu/telemetry/): the fleet
-        # collector scrapes every TPU notebook's in-pod agent in one
-        # parallel pass per interval — driven by its own loop in main(),
-        # NEVER from a reconcile — and feeds the culler's duty-cycle
-        # policy, the per-pool/fleet gauges, and /debug/telemetry
-        from kubeflow_tpu.telemetry.collector import FleetTelemetryCollector
-        from kubeflow_tpu.utils.metrics import TelemetryMetrics
+    if "telemetry" not in shared:
+        telemetry = None
+        if cfg.telemetry_enabled:
+            # data-plane telemetry (kubeflow_tpu/telemetry/): the fleet
+            # collector scrapes every TPU notebook's in-pod agent in one
+            # parallel pass per interval — driven by its own loop in
+            # main(), NEVER from a reconcile — and feeds the culler's
+            # duty-cycle policy, the per-pool/fleet gauges, and
+            # /debug/telemetry. ONE collector per process, even sharded:
+            # the scrape pass is already fleet-parallel.
+            from kubeflow_tpu.telemetry.collector import FleetTelemetryCollector
+            from kubeflow_tpu.utils.metrics import TelemetryMetrics
 
-        telemetry = FleetTelemetryCollector(
-            cluster,
-            TelemetryMetrics(metrics.registry),
-            interval_s=cfg.telemetry_interval_s,
-            staleness_s=cfg.telemetry_staleness_s,
-            tracer=tracer,
-            cluster_domain=cfg.cluster_domain,
-            port=cfg.telemetry_port,
+            telemetry = FleetTelemetryCollector(
+                cluster,
+                TelemetryMetrics(metrics.registry),
+                interval_s=cfg.telemetry_interval_s,
+                staleness_s=cfg.telemetry_staleness_s,
+                tracer=tracer,
+                cluster_domain=cfg.cluster_domain,
+                port=cfg.telemetry_port,
+            )
+        shared["telemetry"] = telemetry
+    telemetry = shared["telemetry"]
+    if "culler" not in shared:
+        # one culler: its per-notebook state is keyed by (ns, name) and
+        # namespaces are shard-disjoint, so shards never contend on it
+        shared["culler"] = Culler(
+            enabled=cfg.enable_culling,
+            cull_idle_minutes=cfg.cull_idle_minutes,
+            check_period_minutes=cfg.idleness_check_minutes,
+            fetch_kernels=fetch_kernels,
+            clock=time.time,
+            telemetry=telemetry,
+            duty_cycle_idle_threshold=cfg.telemetry_duty_cycle_idle,
         )
-    culler = Culler(
-        enabled=cfg.enable_culling,
-        cull_idle_minutes=cfg.cull_idle_minutes,
-        check_period_minutes=cfg.idleness_check_minutes,
-        fetch_kernels=fetch_kernels,
-        clock=time.time,
-        telemetry=telemetry,
-        duty_cycle_idle_threshold=cfg.telemetry_duty_cycle_idle,
-    )
+    culler = shared["culler"]
     # startup timeline + SLO plane (obs/timeline.py, obs/slo.py): the
     # notebook controller stamps click-to-ready marks on every CR; the
     # recorder feeds the phase-attributed startup histograms and the
     # burn-rate gauges on the shared registry; the builder serves
-    # /debug/timeline and the JWA detail view
-    slo = SLOMetrics(metrics.registry)
+    # /debug/timeline and the JWA detail view. Fleet-wide families (each
+    # notebook starts under exactly one shard, so counts add) — shared.
+    slo = shared.setdefault("slo", SLOMetrics(metrics.registry))
     timeline_rec = TimelineRecorder(slo=slo, clock=time.time)
+    if router is not None:
+        from kubeflow_tpu.runtime.sharding import shard_enqueue_filter
+
+        enqueue_filter = shard_enqueue_filter(router, shard_id)
+    else:
+        enqueue_filter = None
     manager = Manager(
-        cluster, clock=time.time, tracer=tracer, metrics=cp_metrics
+        cluster, clock=time.time, tracer=tracer, metrics=cp_metrics,
+        enqueue_filter=enqueue_filter,
     )
     # the ops listeners and main loop read it off the manager (build_manager
     # keeps its two-value return for every existing caller)
     manager.telemetry = telemetry
     manager.slo = slo
-    manager.timeline_builder = TimelineBuilder(cluster, telemetry=telemetry)
-    if hasattr(cluster, "session"):  # KubeClient: per-verb latency/retries.
-        # NOT cluster.tracer: the Manager already wraps this cluster in a
-        # TracingCluster, so a client-level tracer would double-record every
-        # reconcile write and flag non-reconcile writers (the leader lease
-        # renewal loop) as unattributed forever.
-        cluster.metrics = cp_metrics
+    manager.timeline_builder = shared.setdefault(
+        "timeline_builder", TimelineBuilder(cluster, telemetry=telemetry)
+    )
+    manager.shard_id = shard_id if router is not None else None
+    if hasattr(cluster, "session") and "client_metrics" not in shared:
+        # KubeClient: per-verb latency/retries. NOT cluster.tracer: the
+        # Manager already wraps this cluster in a TracingCluster, so a
+        # client-level tracer would double-record every reconcile write and
+        # flag non-reconcile writers (the leader lease renewal loop) as
+        # unattributed forever. Sharded, the one shared client gets its own
+        # shard="client" series — attributing its latency to whichever
+        # shard happened to register first would lie per shard.
+        shared["client_metrics"] = (
+            ControlPlaneMetrics(metrics.registry, shard="client")
+            if router is not None
+            else cp_metrics
+        )
+        cluster.metrics = shared["client_metrics"]
     manager.register(
         NotebookReconciler(
             cfg, culler=culler, metrics=metrics, recorder=recorder,
@@ -184,16 +226,26 @@ def build_manager(
         # placement annotation; shares the metrics registry so one /metrics
         # endpoint carries queue depth / time-to-bind / utilization too.
         # With sessions enabled its preemption path runs the suspend
-        # barrier instead of killing victims outright.
+        # barrier instead of killing victims outright. Sharded, this
+        # manager's scheduler owns only its accelerator families — pools
+        # belong to exactly one family, so per-family schedulers share no
+        # free space (docs/architecture.md "control-plane sharding").
         from kubeflow_tpu.scheduler.controller import SchedulerReconciler
 
         manager.register(
             SchedulerReconciler(
-                metrics=SchedulerMetrics(metrics.registry),
+                metrics=SchedulerMetrics(metrics.registry, shard=shard_label),
                 recorder=EventRecorder(),
                 suspend_deadline_s=(
                     cfg.suspend_deadline_s if cfg.sessions_enabled else None
                 ),
+                families=(
+                    router.families_for(shard_id)
+                    if router is not None
+                    else None
+                ),
+                router=router,
+                shard_id=shard_id,
             )
         )
     if cfg.sessions_enabled:
@@ -206,20 +258,27 @@ def build_manager(
         )
         from kubeflow_tpu.sessions.store import FileObjectStore, SnapshotStore
 
-        store_root = os.environ.get(
-            "SESSIONS_STORE_DIR", "/var/lib/kubeflow-tpu/sessions"
-        )
-        session_metrics = SessionMetrics(metrics.registry)
+        if "snapshot_store" not in shared:
+            store_root = os.environ.get(
+                "SESSIONS_STORE_DIR", "/var/lib/kubeflow-tpu/sessions"
+            )
+            session_metrics = SessionMetrics(metrics.registry)
+            # ONE store across shard managers in a process: chunk dedup is
+            # cross-session by design and the pre-copy/restore pins live in
+            # the store — per-shard stores would let one shard's GC sweep
+            # chunks another shard still pins
+            shared["snapshot_store"] = SnapshotStore(
+                FileObjectStore(store_root), metrics=session_metrics
+            )
+            shared["session_metrics"] = session_metrics
         manager.register(
             SessionReconciler(
                 # the store emits the chunk-level families itself (bytes,
                 # dedup ratio, chunk-pool queue depth)
-                SnapshotStore(
-                    FileObjectStore(store_root), metrics=session_metrics
-                ),
+                shared["snapshot_store"],
                 HttpSessionAgent(cfg.cluster_domain),
                 config=cfg,
-                metrics=session_metrics,
+                metrics=shared["session_metrics"],
                 recorder=EventRecorder(),
             )
         )
@@ -232,17 +291,70 @@ def build_manager(
     return manager, metrics
 
 
-def watch_namespace_labels(path: str, manager: Manager, cluster):
+def build_managers(
+    cluster,
+    config: ControllerConfig | None = None,
+    *,
+    fetch_kernels=fetch_kernels_http,
+) -> tuple[list[Manager], NotebookMetrics]:
+    """The sharded control plane: one manager per shard this process runs.
+
+    ``SHARDS=1`` (default) returns exactly the single historical manager.
+    ``SHARDS=N`` with ``SHARD_ID=i`` builds shard i only — the production
+    layout, one process per shard (e.g. a StatefulSet ordinal), each behind
+    its own leader lease. ``SHARDS=N`` without ``SHARD_ID`` builds all N in
+    this process (standalone/demo — parallelism then comes from worker
+    threads, not processes, but the partition and its invariants are the
+    same ones the soaks audit)."""
+    cfg = config or ControllerConfig.from_env()
+    if cfg.shards <= 1:
+        manager, metrics = build_manager(
+            cluster, cfg, fetch_kernels=fetch_kernels
+        )
+        return [manager], metrics
+    from kubeflow_tpu.runtime.sharding import ShardRouter
+
+    router = ShardRouter(cfg.shards)
+    if cfg.shard_id is not None:
+        if not (0 <= cfg.shard_id < cfg.shards):
+            raise ValueError(
+                f"SHARD_ID {cfg.shard_id} outside [0, {cfg.shards})"
+            )
+        shard_ids = [cfg.shard_id]
+    else:
+        shard_ids = list(range(cfg.shards))
+    shared: dict = {}
+    managers = []
+    for i in shard_ids:
+        manager, _ = build_manager(
+            cluster, cfg, fetch_kernels=fetch_kernels,
+            router=router, shard_id=i, shared=shared,
+        )
+        managers.append(manager)
+    return managers, shared["metrics"]
+
+
+def watch_namespace_labels(path: str, manager, cluster):
     """Hot-reload the profile controller's default namespace labels from a
     mounted YAML file (ref fsnotify watch, profile_controller.go:356-405 +
     readDefaultLabelsFromFile :743-758). Loads once eagerly, then returns a
-    FileWatcher (caller starts it; tests drive poll_once)."""
+    FileWatcher (caller starts it; tests drive poll_once).
+
+    ``manager`` may be one Manager or a list of them: sharded Profiles
+    partition by namespace hash across EVERY shard's manager, so a reload
+    delivered only to shard 0 would leave the other shards' namespaces on
+    the built-in defaults forever."""
     import yaml
 
     from kubeflow_tpu.utils.filewatch import FileWatcher
 
-    profile_rec = manager.reconciler_for("Profile")
-    if profile_rec is None:
+    managers = manager if isinstance(manager, list) else [manager]
+    targets = [
+        (m, m.reconciler_for("Profile"))
+        for m in managers
+        if m.reconciler_for("Profile") is not None
+    ]
+    if not targets:
         return None
 
     def reload():
@@ -262,7 +374,8 @@ def watch_namespace_labels(path: str, manager: Manager, cluster):
         # unmarshals those to "" — match it
         labels = {str(k): "" if v is None else str(v) for k, v in labels.items()}
         log.info("default namespace labels ← %s: %s", path, labels)
-        profile_rec.set_default_labels(labels, manager=manager, cluster=cluster)
+        for m, profile_rec in targets:
+            profile_rec.set_default_labels(labels, manager=m, cluster=cluster)
 
     reload()
     return FileWatcher(path, reload)
@@ -348,7 +461,10 @@ def main() -> None:
         cluster = KubeClient()
     cfg = ControllerConfig.from_env()
     fleet = FleetKernelFetcher(cluster, cfg)
-    manager, metrics = build_manager(cluster, cfg, fetch_kernels=fleet)
+    managers, metrics = build_managers(cluster, cfg, fetch_kernels=fleet)
+    # probes/debug routes ride the first manager this process runs; in the
+    # production sharded layout that is THE shard (one process per SHARD_ID)
+    manager = managers[0]
     leader_elect = os.environ.get("LEADER_ELECT", "").lower() in ("1", "true")
     # under election a replica starts as standby (readyz 503 until elected);
     # without election the single replica is born leader
@@ -370,7 +486,7 @@ def main() -> None:
     )
     if cfg.namespace_labels_path:
         labels_watch = watch_namespace_labels(
-            cfg.namespace_labels_path, manager, cluster
+            cfg.namespace_labels_path, managers, cluster
         )
         if labels_watch is not None:
             labels_watch.start()
@@ -379,26 +495,46 @@ def main() -> None:
 
     reconciling = threading.Event()
 
-    def start_workers():
-        manager.run_workers(n_workers, stop)
+    def start_workers(mgr, shard_id=None):
+        mgr.run_workers(n_workers, stop)
         reconciling.set()
         health.set_leader(True)
-        log.info("controller manager running with %d workers", n_workers)
+        log.info(
+            "controller manager running with %d workers%s",
+            n_workers,
+            "" if shard_id is None else f" (shard {shard_id}/{cfg.shards})",
+        )
+
+    def lease_name(shard_id) -> str:
+        # sharded leases embed shard AND count: shard leaders of one
+        # generation never contend with each other, and a mixed-SHARDS
+        # rollout (two generations leading at once — operator error, see
+        # docs/architecture.md) is visible in the Lease listing instead of
+        # silently split-braining one lock
+        if shard_id is None or cfg.shards <= 1:
+            return "kubeflow-tpu-controller"
+        return f"kubeflow-tpu-controller-shard-{shard_id}-of-{cfg.shards}"
 
     if leader_elect:
-        # ref main.go:84-91: only the lease holder reconciles; standbys wait.
+        # ref main.go:84-91: only the lease holder reconciles; standbys
+        # wait. One elector per shard manager, each on its own lease.
         from kubeflow_tpu.runtime.leader import LeaderElector
 
-        elector = LeaderElector(
-            cluster,
-            name="kubeflow-tpu-controller",
-            namespace=os.environ.get("POD_NAMESPACE", "kubeflow-system"),
-        )
-        threading.Thread(
-            target=elector.run, args=(start_workers,), daemon=True
-        ).start()
+        for mgr in managers:
+            shard_id = getattr(mgr, "shard_id", None)
+            elector = LeaderElector(
+                cluster,
+                name=lease_name(shard_id),
+                namespace=os.environ.get("POD_NAMESPACE", "kubeflow-system"),
+            )
+            threading.Thread(
+                target=elector.run,
+                args=(lambda m=mgr, s=shard_id: start_workers(m, s),),
+                daemon=True,
+            ).start()
     else:
-        start_workers()
+        for mgr in managers:
+            start_workers(mgr, getattr(mgr, "shard_id", None))
     telemetry = getattr(manager, "telemetry", None)
     if telemetry is not None:
         # the fleet scrape runs on its OWN cadence, decoupled from both the

@@ -71,6 +71,18 @@ class FakeCluster:
         # is quadratic teardown at fleet scale (10k notebooks completing
         # dominated SCHED_BENCH before this).
         self._owned: dict[str, set[tuple[str, str, str]]] = {}
+        # kind -> keys, and (kind, label, value) -> keys: the list/selector
+        # indexes (a real apiserver stores per resource type and the
+        # sharded control plane's selector-scoped polls hit the label
+        # index). Without them every list("Node") walked the whole store —
+        # at 10k notebooks, O(store) per list per scheduling cycle.
+        # insertion-ordered dicts used as sets: index iteration order must
+        # be deterministic or the chaos soaks' seeded fault draws (one draw
+        # per read in iteration order) stop reproducing from their seeds
+        self._by_kind: dict[str, dict[tuple[str, str, str], None]] = {}
+        self._by_label: dict[
+            tuple[str, str, str], dict[tuple[str, str, str], None]
+        ] = {}
         self._rv = itertools.count(1)
         self._watchers: list[tuple[str | None, WatchFn]] = []
         # kind-pattern -> mutator, the MutatingWebhookConfiguration analog
@@ -98,6 +110,7 @@ class FakeCluster:
             m.setdefault("creationTimestamp", "1970-01-01T00:00:00Z")
             self._objects[k] = obj
             self._index_owned(k, None, obj)
+            self._index_store(k, None, obj)
             stored = ko.deep_copy(obj)
         self._notify("ADDED", stored)
         return stored
@@ -115,6 +128,30 @@ class FakeCluster:
         except NotFound:
             return None
 
+    def _candidate_keys(
+        self, kind: str, selector: Mapping | None
+    ) -> "dict[tuple[str, str, str], None] | list[tuple[str, str, str]]":
+        """Keys to consider for a (kind, selector) read, off the indexes
+        (caller holds the lock). With matchLabels, iterate the smallest
+        matching label index and membership-check the rest — deterministic
+        insertion order either way (seeded soak draws depend on it)."""
+        kind_keys = self._by_kind.get(kind)
+        if not kind_keys:
+            return {}
+        match = (selector or {}).get("matchLabels")
+        if not match:
+            return kind_keys
+        sets = [
+            self._by_label.get((kind, lk, lv), {})
+            for lk, lv in match.items()
+        ]
+        sets.sort(key=len)
+        smallest, rest = sets[0], sets[1:]
+        return [
+            k for k in smallest
+            if k in kind_keys and all(k in s for s in rest)
+        ]
+
     def list(
         self,
         kind: str,
@@ -123,27 +160,36 @@ class FakeCluster:
     ) -> list[dict]:
         with self._lock:
             out = [
-                ko.deep_copy(o)
-                for (k, ns, _), o in self._objects.items()
-                if k == kind
-                and (namespace is None or ns == namespace)
-                and ko.matches_selector(o, selector)
+                ko.deep_copy(self._objects[key])
+                for key in self._candidate_keys(kind, selector)
+                if (namespace is None or key[1] == namespace)
+                and ko.matches_selector(self._objects[key], selector)
             ]
         return sorted(out, key=lambda o: (ko.namespace(o), ko.name(o)))
 
     def resource_versions(
-        self, kind: str, namespace: str | None = None
+        self,
+        kind: str,
+        namespace: str | None = None,
+        selector: Mapping | None = None,
     ) -> dict[tuple[str, str], str]:
         """``{(namespace, name): resourceVersion}`` for one kind, with no
         body copies — the poll an informer-style cache diffs against to
         fetch only objects that actually moved (a full ``list`` deep-copies
         every object, which at tens of thousands of objects per cycle is
-        the read path's dominant cost)."""
+        the read path's dominant cost). ``selector`` is the label selector
+        a real API server applies server-side to a list — what lets a
+        scheduler SHARD poll only its own families' notebooks instead of
+        the whole fleet (runtime/sharding.py); the label index answers it
+        in O(matching), not O(store)."""
         with self._lock:
             return {
-                (ns, n): ko.meta(o).get("resourceVersion", "")
-                for (k, ns, n), o in self._objects.items()
-                if k == kind and (namespace is None or ns == namespace)
+                (key[1], key[2]): ko.meta(self._objects[key]).get(
+                    "resourceVersion", ""
+                )
+                for key in self._candidate_keys(kind, selector)
+                if (namespace is None or key[1] == namespace)
+                and ko.matches_selector(self._objects[key], selector)
             }
 
     def update(self, obj: Mapping) -> dict:
@@ -161,6 +207,7 @@ class FakeCluster:
             ko.meta(obj)["resourceVersion"] = str(next(self._rv))
             self._objects[k] = obj
             self._index_owned(k, current, obj)
+            self._index_store(k, current, obj)
             stored = ko.deep_copy(obj)
         self._notify("MODIFIED", stored)
         return stored
@@ -209,6 +256,7 @@ class FakeCluster:
             else:
                 del self._objects[k]
                 self._index_owned(k, obj, None)
+                self._index_store(k, obj, None)
                 if kind == "Pod":
                     self._pod_logs.pop((namespace, name), None)
                 stored = ko.deep_copy(obj)
@@ -229,6 +277,7 @@ class FakeCluster:
                 return
             del self._objects[k]
             self._index_owned(k, current, None)
+            self._index_store(k, current, None)
             stored = ko.deep_copy(current)
         self._notify("DELETED", stored)
         self._garbage_collect(stored)
@@ -239,6 +288,32 @@ class FakeCluster:
             return ()
         refs = (obj.get("metadata") or {}).get("ownerReferences") or []
         return tuple(r.get("uid") for r in refs if r.get("uid"))
+
+    def _index_store(
+        self, k: tuple[str, str, str], old: Mapping | None, new: Mapping | None
+    ) -> None:
+        """Keep the kind and label indexes in step with one store mutation
+        (caller holds the lock). Labels rarely change on update, so the
+        common path is one dict compare."""
+        kind = k[0]
+        if old is None and new is not None:
+            self._by_kind.setdefault(kind, {})[k] = None
+        elif new is None and old is not None:
+            kk = self._by_kind.get(kind)
+            if kk is not None:
+                kk.pop(k, None)
+        old_labels = ko.labels(old) if old is not None else {}
+        new_labels = ko.labels(new) if new is not None else {}
+        if old_labels == new_labels:
+            return
+        for lk, lv in old_labels.items():
+            if new_labels.get(lk) != lv:
+                lkeys = self._by_label.get((kind, lk, lv))
+                if lkeys is not None:
+                    lkeys.pop(k, None)
+        for lk, lv in new_labels.items():
+            if old_labels.get(lk) != lv:
+                self._by_label.setdefault((kind, lk, lv), {})[k] = None
 
     def _index_owned(
         self, k: tuple[str, str, str], old: Mapping | None, new: Mapping | None

@@ -89,8 +89,15 @@ class Manager:
         error_backoff_max: float = 64.0,
         tracer=None,
         metrics=None,
+        enqueue_filter: Callable[[Reconciler, str, str], bool] | None = None,
     ) -> None:
         self.cluster = cluster
+        # Control-plane sharding (runtime/sharding.py): a sharded manager
+        # drops keys it does not own at the single enqueue choke point —
+        # watch handlers, the initial cache-sync replay, and direct enqueues
+        # all pass through here, so an unowned key can never reach a worker.
+        # None (the default) accepts everything: the unsharded manager.
+        self.enqueue_filter = enqueue_filter
         # reconcile tracing (obs/tracing.py): reconcilers see the traced
         # client surface so every write they issue lands as a child span of
         # the reconcile that caused it; the manager's own watch/list plumbing
@@ -182,7 +189,13 @@ class Manager:
     def shutdown(self) -> None:
         """Tear the manager down: detach its watch handlers (when the cluster
         supports it) and shut the workqueue so blocked workers drain out.
-        The chaos harness uses this to model a controller process dying."""
+        The chaos harness uses this to model a controller process dying.
+
+        Must be a clean no-op on a manager that never started — a sharded
+        standby that never won its lease (so never installed watches, never
+        ran a worker) is still shut down on process exit, and the teardown
+        path dying on it would mask the real exit reason. Idempotent for the
+        same reason: crash-restart loops shut down whatever they hold."""
         unwatch = getattr(self.cluster, "unwatch", None)
         if unwatch is not None:
             for handler in self._installed_watches:
@@ -248,6 +261,10 @@ class Manager:
         name: str,
         trace_id: str | None = None,
     ) -> None:
+        if self.enqueue_filter is not None and not self.enqueue_filter(
+            rec, namespace, name
+        ):
+            return
         key = self._key(rec, namespace, name)
         if self.tracer is not None or self.metrics is not None:
             with self._trace_lock:

@@ -51,6 +51,7 @@ from typing import Callable, Iterable
 from kubeflow_tpu import sessions as sess
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime import sharding
 from kubeflow_tpu.runtime.fake import Conflict, FakeCluster, NotFound
 from kubeflow_tpu.runtime.manager import Reconciler, Result
 from kubeflow_tpu.scheduler import (
@@ -74,6 +75,7 @@ from kubeflow_tpu.scheduler.queue import (
     GangQueue,
     GangRequest,
 )
+from kubeflow_tpu.tpu.topology import ACCELERATORS
 
 log = logging.getLogger(__name__)
 
@@ -112,6 +114,9 @@ class SchedulerReconciler(Reconciler):
         resync_s: float = 30.0,
         suspend_deadline_s: float | None = None,
         differential_audit: bool = False,
+        families: frozenset[str] | None = None,
+        router: "sharding.ShardRouter | None" = None,
+        shard_id: int = 0,
     ) -> None:
         self.metrics = metrics
         # EventRecorder (obs/events.py): Queued/Bound/Preempted/Unschedulable
@@ -149,9 +154,52 @@ class SchedulerReconciler(Reconciler):
         # audit); mismatches accumulate in audit_failures.
         self.differential_audit = differential_audit
         self.audit_failures: list[str] = []
+        # --- control-plane sharding (runtime/sharding.py) ----------------
+        # families: the accelerator families this scheduler shard owns —
+        # None (the default) is the unsharded scheduler, bit-identical to
+        # the pre-sharding behavior. Pools belong to exactly one family and
+        # a gang can only bind into pools of its own family, so per-family
+        # shards share no free space: no chip is ever visible as free to
+        # two shards, with no coordination beyond the deterministic
+        # family→shard map. router/shard_id drive the ownership stamp:
+        # fresh gangs are stamped inside the admission (queued-at) write;
+        # gangs stamped by another generation (a SHARDS change) or shard
+        # (a family edit) are adopted — re-stamped in one write — before
+        # this shard schedules them.
+        self.families = frozenset(families) if families is not None else None
+        self._router = router
+        self.shard_id = shard_id
+        # Event hints (sharded only): the cycle's notebook ingest polls the
+        # FAMILY_LABEL-selected rv index — O(owned slice), not O(fleet) —
+        # so gangs the filtered index cannot see (created unlabeled, or
+        # label drifting after a spec edit) reach the cycle through the
+        # watch mapper instead: it records every owned-family event's key
+        # here, and the refresh fetches hinted bodies directly. Hints are
+        # cleared only after a successful refresh (at-least-once), and a
+        # restart re-populates them via the manager's initial watch replay.
+        self._hints: set[tuple[str, str]] = set()
+        self._hints_lock = threading.Lock()
 
     def watches(self):
-        return [("Notebook", _map_to_fleet), ("Node", _map_to_fleet)]
+        if self.families is None:
+            return [("Notebook", _map_to_fleet), ("Node", _map_to_fleet)]
+        # Sharded watch ingest: only events for owned-family gangs wake this
+        # shard's cycle (a CPU notebook or a foreign family is never our
+        # work), and each such event leaves a hint for the filtered
+        # refresh. Node events stay unfiltered — a watch event carries only
+        # the node's NEW labels, so a family-label edit would be invisible
+        # to the losing shard; waking every shard costs one coalesced key
+        # and the cycle's node list is selector-scoped to owned families.
+        return [
+            ("Notebook", self._map_owned_notebook),
+            ("Node", _map_to_fleet),
+        ]
+
+    def _map_owned_notebook(self, obj: dict) -> Iterable[tuple[str, str]]:
+        if sharding.notebook_family(obj) in self.families:
+            with self._hints_lock:
+                self._hints.add((ko.namespace(obj), ko.name(obj)))
+            yield ("", FLEET_KEY)
 
     def reconcile(self, cluster: FakeCluster, namespace: str, name: str) -> Result | None:
         with self._cycle_lock:
@@ -186,10 +234,54 @@ class SchedulerReconciler(Reconciler):
         now = self.clock()
 
         # -- list phase ---------------------------------------------------
-        nodes = cluster.list("Node")
-        views = [
-            v for v in self._nb_cache.refresh(cluster) if v.topo is not None
-        ]  # malformed spec.tpu is admission's problem; CPU wants no chips
+        if self.families is None:
+            nodes = cluster.list("Node")
+            views = [
+                v for v in self._nb_cache.refresh(cluster)
+                if v.topo is not None
+            ]  # malformed spec.tpu is admission's problem; CPU has no chips
+        else:
+            # Sharded scheduler: this cycle's world is the owned accelerator
+            # families only, selected SERVER-SIDE — both the node list and
+            # the notebook rv poll carry a label selector, so the shard's
+            # list phase costs O(owned slice), not O(fleet). Foreign-family
+            # pools never enter the fleet model and foreign-family gangs
+            # never enter the queue, so the shard cannot bind into (or even
+            # see) another shard's space. Selector-scoping the NODE list is
+            # also what makes a pool family-label edit converge: the node
+            # vanishes from the losing shard's list, its pool fingerprint
+            # changes, and the model drops the pool.
+            nodes = []
+            for fam in sorted(self.families):
+                accel = ACCELERATORS.get(fam)
+                if accel is None:
+                    continue
+                nodes.extend(cluster.list("Node", None, {"matchLabels": {
+                    "cloud.google.com/gke-tpu-accelerator":
+                        accel.gke_accelerator,
+                }}))
+            with self._hints_lock:
+                hints = set(self._hints)
+            views = [
+                v
+                for v in self._nb_cache.refresh_filtered(
+                    cluster,
+                    [
+                        {"matchLabels": {sharding.FAMILY_LABEL: fam}}
+                        for fam in sorted(self.families)
+                    ],
+                    hints,
+                    self.families,
+                )
+                if v.topo is not None
+                and v.topo.accelerator.name in self.families
+            ]
+            with self._hints_lock:
+                # consumed only on success: a refresh that faulted replays
+                # the same hints next cycle (at-least-once ingest)
+                self._hints -= hints
+            if self._router is not None:
+                self._adopt_orphans(cluster, views)
         t_list = time.perf_counter()
 
         model = self._model
@@ -456,6 +548,47 @@ class SchedulerReconciler(Reconciler):
             self._fit_seen = (hits, misses)
         return depth, barrier_pending
 
+    def _adopt_orphans(self, cluster: FakeCluster, views: list) -> None:
+        """Ownership stamping for gangs that already carry scheduler state
+        (a queued-at claim or a committed placement) but whose stamp names
+        another generation or shard: a SHARDS change, a family edit, or an
+        upgrade from the pre-sharding control plane. Adoption is ONE
+        annotation write and everything else replays level-triggered from
+        the CR — the placement, the preserved seniority, even a suspend
+        handoff mid-flight all continue under the new owner. Fresh gangs
+        (no scheduler footprint yet) are NOT stamped here: their stamp is
+        folded into the admission write, so entering the queue costs no
+        extra write. A raced delete/write just retries next cycle."""
+        stamp = self._router.stamp(self.shard_id)
+        for view in views:
+            anns = ko.annotations(view.nb)
+            fam = view.topo.accelerator.name
+            need_stamp = anns.get(sharding.SHARD_ANNOTATION) != stamp
+            # heal the family label alongside the stamp: after a spec
+            # family edit the old label keeps the gang in the LOSING
+            # shard's filtered index and out of ours — one write moves the
+            # server-side filter to the new owner
+            need_label = (
+                ko.labels(view.nb).get(sharding.FAMILY_LABEL) != fam
+            )
+            if not (need_stamp or need_label):
+                continue
+            if (
+                QUEUED_AT_ANNOTATION not in anns
+                and view.placement is None
+            ):
+                continue  # no footprint: admission will stamp
+            try:
+                self._patch_annotations(
+                    cluster, view.nb,
+                    {sharding.SHARD_ANNOTATION: stamp} if need_stamp else {},
+                    labels={sharding.FAMILY_LABEL: fam} if need_label else None,
+                )
+            except (NotFound, Conflict):
+                continue
+            # _patch_annotations folded the stored body back into the view
+            # cache, so the rest of this cycle sees the adopted stamp
+
     def _admit(
         self,
         cluster: FakeCluster,
@@ -498,10 +631,20 @@ class SchedulerReconciler(Reconciler):
         queued_at = _queued_at(nb, None)
         if queued_at is None:
             queued_at = now
-            try:
-                self._patch_annotations(
-                    cluster, nb, {QUEUED_AT_ANNOTATION: repr(queued_at)}
+            anns: dict = {QUEUED_AT_ANNOTATION: repr(queued_at)}
+            labels = None
+            if self._router is not None:
+                # the ownership stamp (and, when drifted, the family
+                # label the filtered ingest selects on) rides the
+                # admission write: one patch claims AND admits the gang
+                anns[sharding.SHARD_ANNOTATION] = self._router.stamp(
+                    self.shard_id
                 )
+                fam = topo.accelerator.name
+                if ko.labels(nb).get(sharding.FAMILY_LABEL) != fam:
+                    labels = {sharding.FAMILY_LABEL: fam}
+            try:
+                self._patch_annotations(cluster, nb, anns, labels=labels)
             except (NotFound, Conflict):
                 return None  # deleted/raced: next cycle re-admits
             # first admission is the transition worth an Event; the
@@ -871,11 +1014,19 @@ class SchedulerReconciler(Reconciler):
             self.recorder.emit(cluster, nb, reason, message, type_)
 
     def _patch_annotations(
-        self, cluster: FakeCluster, nb: dict, anns: dict
+        self,
+        cluster: FakeCluster,
+        nb: dict,
+        anns: dict,
+        labels: dict | None = None,
     ) -> None:
+        patch: dict = {"metadata": {}}
+        if anns:
+            patch["metadata"]["annotations"] = anns
+        if labels:
+            patch["metadata"]["labels"] = labels
         stored = cluster.patch(
-            "Notebook", ko.name(nb), ko.namespace(nb),
-            {"metadata": {"annotations": anns}},
+            "Notebook", ko.name(nb), ko.namespace(nb), patch
         )
         # keep the in-memory copy coherent for the rest of the cycle (the
         # caller may hold a reference to this exact dict) and fold the
@@ -886,6 +1037,10 @@ class SchedulerReconciler(Reconciler):
                 ko.remove_annotation(nb, k)
             else:
                 ko.set_annotation(nb, k, v)
+        if labels:
+            nb.setdefault("metadata", {}).setdefault("labels", {}).update(
+                labels
+            )
         self._nb_cache.store(stored)
 
     def _write_conditions(
@@ -960,6 +1115,11 @@ class _NotebookCache:
         self.views: dict[str, _NbView] = {}
         self._keystr: dict[tuple[str, str], str] = {}  # (ns, name) -> key
         self._sorted: list[_NbView] | None = None  # None = membership moved
+        # keys held OUTSIDE the filtered index (sharded refresh only):
+        # gangs the family-label selector cannot see — created unlabeled,
+        # or label drifting after a spec edit. Tracked so their rv moves
+        # and deletions are polled directly until the label heals.
+        self._offindex: set[str] = set()
 
     def refresh(self, cluster: FakeCluster) -> list[_NbView]:
         rv_index = getattr(cluster, "resource_versions", None)
@@ -999,6 +1159,110 @@ class _NotebookCache:
                 for nk in [n for n, k in keystr.items() if k not in live]:
                     del keystr[nk]
             self._sorted = None
+        return self._ordered()
+
+    def refresh_filtered(
+        self,
+        cluster: FakeCluster,
+        selectors: list[dict],
+        hints: set[tuple[str, str]],
+        families: frozenset[str] | None = None,
+    ) -> list[_NbView]:
+        """The sharded refresh: poll the FAMILY_LABEL-selected rv index —
+        O(owned slice) server-side, the whole point of sharding the ingest
+        — and cover what the selector cannot see through two side channels:
+        ``hints`` (owned-family watch events recorded by the reconciler's
+        mapper; the initial watch replay re-seeds them on restart) and the
+        ``_offindex`` set (hinted keys that stay invisible to the selector
+        — unlabeled or label-drifted gangs — polled directly each cycle
+        until the owning shard heals their label). Same crash posture as
+        :meth:`refresh`: no watch feeds the cache, a fresh incarnation
+        starts cold, faults propagate and the cycle retries."""
+        views, keystr = self.views, self._keystr
+        rv_index = getattr(cluster, "resource_versions", None)
+        if rv_index is None:
+            # client surface without the index: degrade to filtered lists
+            self.views.clear()
+            self._offindex.clear()
+            self._sorted = None
+            for sel in selectors:
+                for nb in cluster.list("Notebook", None, sel):
+                    self.store(nb)
+            for ns, name in sorted(hints):
+                nb = cluster.try_get("Notebook", name, ns)
+                if nb is not None:
+                    self.store(nb)
+            return self._ordered()
+        rvs: dict[tuple[str, str], str] = {}
+        for sel in selectors:
+            rvs.update(rv_index("Notebook", None, sel))
+        for nk, rv in rvs.items():
+            key = keystr.get(nk)
+            if key is None:
+                key = keystr[nk] = f"{nk[0]}/{nk[1]}"
+            view = views.get(key)
+            if view is not None and view.rv == rv:
+                continue
+            nb = cluster.try_get("Notebook", nk[1], nk[0])
+            if nb is None:
+                if views.pop(key, None) is not None:
+                    self._sorted = None
+                continue
+            self.store(nb)
+        index_keys = {keystr[nk] for nk in rvs}
+        # hinted keys the filtered index cannot see: fetch directly
+        for nk in sorted(hints):
+            key = keystr.get(nk)
+            if key is None:
+                key = keystr[nk] = f"{nk[0]}/{nk[1]}"
+            if key in index_keys:
+                self._offindex.discard(key)
+                continue
+            nb = cluster.try_get("Notebook", nk[1], nk[0])
+            if nb is None:
+                if views.pop(key, None) is not None:
+                    self._sorted = None
+                self._offindex.discard(key)
+            else:
+                self.store(nb)
+                self._offindex.add(key)
+        # surviving off-index keys not hinted this cycle: their rv moves
+        # and deletions are invisible to the selector — poll them directly.
+        # A body whose spec family left the owned set is dropped outright:
+        # its NEW owner adopts it (hint + label heal on that side), and
+        # keeping it here would poll a foreign gang forever.
+        for key in sorted(self._offindex):
+            if key in index_keys or key not in views:
+                self._offindex.discard(key)
+                continue
+            ns, name = key.split("/", 1)
+            nb = cluster.try_get("Notebook", name, ns)
+            if nb is None or (
+                families is not None
+                and sharding.notebook_family(nb) not in families
+            ):
+                del views[key]
+                self._sorted = None
+                self._offindex.discard(key)
+            elif views[key].rv != (nb.get("metadata") or {}).get(
+                "resourceVersion", ""
+            ):
+                self.store(nb)
+        # purge: anything neither indexed nor off-index is gone (deleted,
+        # or drifted to a family another shard owns and now labels).
+        # Unconditional set difference — a size compare is not a set
+        # compare: a phantom index key (body deleted between the rv poll
+        # and its get) can mask exactly one truly-stale view and serve a
+        # deleted gang for a cycle.
+        live = index_keys | self._offindex
+        stale = [k for k in views if k not in live]
+        if stale:
+            for key in stale:
+                del views[key]
+            self._sorted = None
+        if len(keystr) > 2 * max(len(live), 1):
+            for nk in [n for n, k in keystr.items() if k not in live]:
+                del keystr[nk]
         return self._ordered()
 
     def _ordered(self) -> list[_NbView]:

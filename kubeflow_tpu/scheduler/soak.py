@@ -41,6 +41,7 @@ from kubeflow_tpu.obs.slo import SLOMetrics
 from kubeflow_tpu.obs.timeline import TimelineRecorder, audit_timeline
 from kubeflow_tpu.obs.tracing import Tracer
 from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime import sharding
 from kubeflow_tpu.runtime.fake import (
     AlreadyExists,
     Conflict,
@@ -298,6 +299,66 @@ def audit_fixed_point(
     return out
 
 
+def audit_shards(
+    base: FakeCluster, router, *, where: str = "final"
+) -> list[str]:
+    """Cross-shard invariants of the sharded control plane
+    (docs/architecture.md "control-plane sharding"), re-derived from the
+    store alone:
+
+    - every gang with a scheduler footprint (queued-at claim or committed
+      placement) carries the ownership stamp of the shard the CURRENT
+      router computes as its owner — orphans from killed leaders, crashed
+      adoptions, and generation changes must all have converged;
+    - no placement ever lands in a pool of a different accelerator family
+      than the gang's own — the structural guarantee that per-family
+      scheduler shards share no free space (combined with the global
+      overlap audit in :func:`audit_placements`, this is the zero
+      cross-shard double-booking proof).
+    """
+    out: list[str] = []
+    fleet = _healthy_fleet(base)
+    for nb in base.list("Notebook"):
+        try:
+            topo = api.notebook_topology(nb)
+        except ValueError:
+            continue
+        key = _nb_key(nb)
+        anns = ko.annotations(nb)
+        if topo is None:
+            if sharding.SHARD_ANNOTATION in anns:
+                out.append(f"{where}: {key}: non-gang carries a shard stamp")
+            continue
+        fam = topo.accelerator.name
+        owner = router.shard_for_family(fam)
+        placement = sched.placement_of(nb)
+        stamped = sharding.owner_of(nb)
+        if sched.QUEUED_AT_ANNOTATION in anns or placement is not None:
+            if stamped != (router.shards, owner):
+                out.append(
+                    f"{where}: {key}: scheduler footprint with stamp "
+                    f"{anns.get(sharding.SHARD_ANNOTATION)!r}, owner is "
+                    f"shard {owner} of {router.shards}"
+                )
+            got_label = ko.labels(nb).get(sharding.FAMILY_LABEL)
+            if got_label != fam:
+                out.append(
+                    f"{where}: {key}: family label {got_label!r} drifted "
+                    f"from spec family {fam!r} (the owner's filtered "
+                    f"ingest must heal it)"
+                )
+        if placement is not None:
+            for j, s in enumerate(placement["slices"]):
+                pool = fleet.pools.get(s.get("pool", ""))
+                if pool is not None and pool.accel.name != fam:
+                    out.append(
+                        f"{where}: {key}/s{j}: {fam} gang placed in "
+                        f"{pool.accel.name} pool {pool.name} (cross-family "
+                        f"bind — shards would share this space)"
+                    )
+    return out
+
+
 # ----------------------------------------------------------------- scenario
 
 # (accelerator, pool topology): small enough that seeds run fast, varied
@@ -318,14 +379,23 @@ _INFEASIBLE = [("v4", "8x8x8"), ("v5e", "8x16"), ("v5p", "4x4x8")]
 
 
 class SchedScenario:
-    """A seeded fleet + gang workload + hostile op timeline."""
+    """A seeded fleet + gang workload + hostile op timeline.
+
+    ``namespaces``: the sharded soak spreads gangs over several namespaces
+    (manager shards partition by namespace hash) from a *separate* RNG
+    stream, so the default single-namespace scenario draws — and therefore
+    every existing seed's timeline — are bit-identical to before.
+    """
 
     N_ROUNDS = 6
     NAMESPACE = "team-a"
 
-    def __init__(self, seed: int) -> None:
+    def __init__(
+        self, seed: int, namespaces: tuple[str, ...] | None = None
+    ) -> None:
         rng = random.Random(f"sched-scenario-{seed}")
         self.seed = seed
+        self.namespaces = tuple(namespaces) if namespaces else (self.NAMESPACE,)
         self.culling = rng.random() < 0.3
         n_pools = 1 + (rng.random() < 0.6) + (rng.random() < 0.2)
         picks = rng.sample(_POOL_CHOICES, k=min(n_pools, len(_POOL_CHOICES)))
@@ -351,6 +421,14 @@ class SchedScenario:
             self.gangs[f"g{i}"] = gang
         # busy gangs survive the culler; the rest are idle and cullable
         self.busy = {g for g in sorted(self.gangs) if rng.random() < 0.7}
+        if len(self.namespaces) > 1:
+            ns_rng = random.Random(f"sched-ns-{seed}")
+            self.gang_ns = {
+                g: self.namespaces[ns_rng.randrange(len(self.namespaces))]
+                for g in sorted(self.gangs)
+            }
+        else:
+            self.gang_ns = {g: self.namespaces[0] for g in self.gangs}
         self.node_specs: dict[str, dict] = {}
         self.rounds = self._op_timeline(rng)
 
@@ -408,7 +486,7 @@ class SchedScenario:
     # -- world construction (user / API-server side: never faulted) --------
 
     def _nb(self, name: str) -> dict:
-        return api.notebook(name, self.NAMESPACE, **self.gangs[name])
+        return api.notebook(name, self.gang_ns[name], **self.gangs[name])
 
     def setup(self, base: FakeCluster) -> None:
         for pool, (accel, topo) in sorted(self.pools.items()):
@@ -427,7 +505,7 @@ class SchedScenario:
 
     def apply(self, base: FakeCluster, op: tuple[str, str], round_no: int) -> None:
         verb, target = op
-        ns = self.NAMESPACE
+        ns = self.gang_ns.get(target.split(":", 1)[0], self.NAMESPACE)
         try:
             if verb == "stop":
                 base.patch("Notebook", target, ns, {"metadata": {"annotations": {
@@ -505,6 +583,7 @@ class SchedSeedResult:
     binds: int
     preemptions: int
     fault_counts: collections.Counter
+    shards: int = 1
 
     @property
     def ok(self) -> bool:
@@ -518,8 +597,10 @@ class SchedSeedResult:
                 f"{self.preemptions} preemptions, {faults} faults, "
                 f"{self.restarts} scheduler restarts)"
             )
+        flag = f" --shards {self.shards}" if self.shards > 1 else ""
         lines = [f"seed {self.seed}: FAILED "
-                 f"(repro: python tools/sched_soak.py --seed {self.seed})"]
+                 f"(repro: python tools/sched_soak.py --seed {self.seed}"
+                 f"{flag})"]
         if not self.quiesced:
             lines.append("  state never quiesced after faults healed")
         lines += [f"  invariant: {v}" for v in self.violations[:10]]
@@ -532,12 +613,28 @@ def run_sched_seed(
     seed: int,
     faults: ChaosConfig | None = None,
     *,
+    shards: int = 1,
     max_restarts_per_tick: int = 6,
 ) -> SchedSeedResult:
     """One seeded soak run: hostile timeline under chaos, heal, settle,
     quiesce, then the fixed-point audit. ``faults=None`` runs the same
-    timeline fault-free (a sanity baseline for targeted tests)."""
-    scenario = SchedScenario(seed)
+    timeline fault-free (a sanity baseline for targeted tests).
+
+    ``shards=1`` (the default) is the historical single-manager run,
+    bit-identical to before sharding existed. ``shards=N`` runs the SHARDED
+    control plane over the same store: N managers (namespace-hash filtered
+    notebook controllers, per-family scheduler shards with ownership
+    stamping), gangs spread across four namespaces, one shard's leader
+    killed EVERY round (shutdown + cold rebuild — the stand-down/takeover
+    cycle), and the per-seed audits extended with the cross-shard checks
+    (:func:`audit_shards`): converged stamps, zero cross-family binds,
+    and — together with the global overlap audit — zero cross-shard chip
+    double-booking."""
+    router = sharding.ShardRouter(shards) if shards > 1 else None
+    namespaces = (
+        ("team-a", "team-b", "team-c", "team-d") if shards > 1 else None
+    )
+    scenario = SchedScenario(seed, namespaces=namespaces)
     base = FakeCluster()
     tpu_env.install(base)
     chaos = (
@@ -555,7 +652,21 @@ def run_sched_seed(
         fetch_kernels=scenario.make_fetcher(),
         clock=clock,
     )
-    metrics = SchedulerMetrics()
+    # per-shard SchedulerMetrics on one registry (the shard label keeps the
+    # series disjoint — exactly the production layout); shards==1 keeps the
+    # historical unlabeled schema. The shared registry must start BARE: a
+    # throwaway unsharded instance would freeze the label schemas without
+    # ``shard`` and every sharded observation would then raise (Registry
+    # rejects exactly that mix at registration now).
+    if router is None:
+        shard_metrics = [SchedulerMetrics()]
+    else:
+        from kubeflow_tpu.utils.metrics import Registry
+
+        registry = Registry()
+        shard_metrics = [
+            SchedulerMetrics(registry, shard=str(i)) for i in range(shards)
+        ]
     # one tracer spans the whole run (the trace audit is a run property);
     # recorders are per-incarnation — a restart loses the dedup hot cache
     # and must rediscover Events instead of storming new ones
@@ -572,8 +683,15 @@ def run_sched_seed(
     # in the hostile timeline fails the seed.
     diff_failures: list[str] = []
 
-    def build() -> Manager:
-        m = Manager(cluster, clock=clock, tracer=tracer)
+    def build(shard_id: int = 0) -> Manager:
+        m = Manager(
+            cluster, clock=clock, tracer=tracer,
+            enqueue_filter=(
+                sharding.shard_enqueue_filter(router, shard_id)
+                if router is not None
+                else None
+            ),
+        )
         m.register(
             NotebookReconciler(
                 cfg, culler=culler, recorder=EventRecorder(clock=clock),
@@ -584,36 +702,46 @@ def run_sched_seed(
         # a fresh reconciler instance models exactly that (the incremental
         # model, fit cache, and notebook cache all start cold)
         sched_rec = SchedulerReconciler(
-            metrics=metrics,
+            metrics=shard_metrics[shard_id],
             recorder=EventRecorder(clock=clock),
             clock=clock,
             aging_interval_s=SOAK_AGING_INTERVAL_S,
             differential_audit=True,
+            families=(
+                router.families_for(shard_id) if router is not None else None
+            ),
+            router=router,
+            shard_id=shard_id,
         )
         sched_rec.audit_failures = diff_failures
         m.register(sched_rec)
         return m
 
     scenario.setup(base)
-    mgr = build()
+    managers = [build(i) for i in range(shards if router is not None else 1)]
     violations: list[str] = []
     restarts = 0
+    # the leader-kill target: ONE shard's leader dies repeatedly, every
+    # round — the other shards must keep converging their slices while the
+    # victim's takeover starts cold and adopts whatever it finds
+    kill_target = seed % shards if router is not None else None
 
     def tick() -> None:
-        nonlocal mgr, restarts
-        for _ in range(max_restarts_per_tick):
-            crashed = False
-            try:
-                mgr.tick()
-            except Exception:
-                crashed = True
-            if chaos is not None and chaos.take_crash():
-                crashed = True
-            if not crashed:
-                return
-            restarts += 1
-            mgr.shutdown()
-            mgr = build()
+        nonlocal restarts
+        for idx in range(len(managers)):
+            for _ in range(max_restarts_per_tick):
+                crashed = False
+                try:
+                    managers[idx].tick()
+                except Exception:
+                    crashed = True
+                if chaos is not None and chaos.take_crash():
+                    crashed = True
+                if not crashed:
+                    break
+                restarts += 1
+                managers[idx].shutdown()
+                managers[idx] = build(idx)
 
     def drive(where: str, *, sub_ticks: int = 3, dt: float = 10.0) -> None:
         for s in range(sub_ticks):
@@ -629,18 +757,25 @@ def run_sched_seed(
             violations.extend(
                 audit_placements(base, strict=False, where=sub_where)
             )
-            violations.extend(
-                check_invariants(
-                    base, mgr,
-                    max_requeue_s=SOAK_MAX_REQUEUE_S,
-                    where=sub_where,
+            for m in managers:
+                violations.extend(
+                    check_invariants(
+                        base, m,
+                        max_requeue_s=SOAK_MAX_REQUEUE_S,
+                        where=sub_where,
+                    )
                 )
-            )
         clock.advance(dt)
 
     for r, ops in enumerate(scenario.rounds):
         for op in ops:
             scenario.apply(base, op, r)
+        if kill_target is not None:
+            # that shard's leader loses its lease: stand-down tears the
+            # manager away mid-whatever, the takeover builds a cold one
+            restarts += 1
+            managers[kill_target].shutdown()
+            managers[kill_target] = build(kill_target)
         drive(f"round {r}")
 
     scenario.heal_data_plane(base)
@@ -664,14 +799,17 @@ def run_sched_seed(
             break
         prev = fp
         clock.advance(65.0)
-    violations.extend(
-        check_invariants(
-            base, mgr,
-            max_requeue_s=SOAK_MAX_REQUEUE_S,
-            where="final", final=True,
+    for m in managers:
+        violations.extend(
+            check_invariants(
+                base, m,
+                max_requeue_s=SOAK_MAX_REQUEUE_S,
+                where="final", final=True,
+            )
         )
-    )
     violations.extend(audit_fixed_point(base, clock()))
+    if router is not None:
+        violations.extend(audit_shards(base, router, where="final"))
     # incremental-vs-from-scratch model divergence anywhere in the run
     violations.extend(diff_failures)
     # causality + event-storm audits (obs/): every write attributable to a
@@ -687,9 +825,10 @@ def run_sched_seed(
         violations=violations,
         quiesced=quiesced,
         restarts=restarts,
-        binds=int(metrics.binds.get()),
-        preemptions=int(metrics.preemptions.get()),
+        binds=int(sum(m.binds.get() for m in shard_metrics)),
+        preemptions=int(sum(m.preemptions.get() for m in shard_metrics)),
         fault_counts=(
             chaos.fault_counts if chaos is not None else collections.Counter()
         ),
+        shards=shards,
     )

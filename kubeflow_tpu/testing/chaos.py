@@ -364,11 +364,11 @@ class ChaosCluster:
         self._maybe_fault("list", write=False)
         return self.inner.list(kind, namespace, selector)
 
-    def resource_versions(self, kind, namespace=None):
+    def resource_versions(self, kind, namespace=None, selector=None):
         # the informer-cache poll is a read like any other: the scheduler's
         # incremental fast path must survive it failing mid-cycle
         self._maybe_fault("resource_versions", write=False)
-        return self.inner.resource_versions(kind, namespace)
+        return self.inner.resource_versions(kind, namespace, selector)
 
     def events_for(self, involved):
         self._maybe_fault("events_for", write=False)
@@ -522,14 +522,25 @@ def fingerprint(base: FakeCluster) -> str:
 
 class Scenario:
     """A seeded workload + operation timeline, identical for the fault-free
-    and faulted runs of the same seed."""
+    and faulted runs of the same seed.
+
+    ``namespaces``: the sharded soak (docs/chaos.md) spreads notebooks over
+    several namespaces — manager shards partition by namespace hash — using
+    a *separate* RNG stream, so the default single-namespace scenario's
+    draws (and every existing seed's timeline) stay bit-identical.
+    Tensorboard/Profile ops stay in the first namespace; the extra
+    namespaces get profiles of their own at setup, outside the op timeline.
+    """
 
     N_ROUNDS = 8
     NAMESPACE = "team-a"
 
-    def __init__(self, seed: int) -> None:
+    def __init__(
+        self, seed: int, namespaces: tuple[str, ...] | None = None
+    ) -> None:
         rng = random.Random(f"scenario-{seed}")
         self.seed = seed
+        self.namespaces = tuple(namespaces) if namespaces else (self.NAMESPACE,)
         self.culling = rng.random() < 0.5
         self.notebooks: dict[str, dict] = {"nb-cpu": {}}
         if rng.random() < 0.8:
@@ -557,6 +568,14 @@ class Scenario:
         self.tensorboards = (
             {"tb-0": "pvc://logs-claim/runs"} if rng.random() < 0.6 else {}
         )
+        if len(self.namespaces) > 1:
+            ns_rng = random.Random(f"scenario-ns-{seed}")
+            self.nb_ns = {
+                n: self.namespaces[ns_rng.randrange(len(self.namespaces))]
+                for n in sorted(self.notebooks)
+            }
+        else:
+            self.nb_ns = {n: self.namespaces[0] for n in self.notebooks}
         self.rounds = self._op_timeline(rng)
 
     def _op_timeline(self, rng: random.Random) -> list[list[tuple[str, str]]]:
@@ -601,11 +620,18 @@ class Scenario:
     # -- world construction (user / API-server side: never faulted) ---------
 
     def _nb(self, name: str) -> dict:
-        return api.notebook(name, self.NAMESPACE, **self.notebooks[name])
+        return api.notebook(name, self.nb_ns[name], **self.notebooks[name])
 
     def setup(self, base: FakeCluster) -> None:
         for p in self.profiles:
             base.create(api.profile(p, owner_name=f"{p}-owner@example.com"))
+        for ns in self.namespaces:
+            if ns not in self.profiles:
+                # sharded mode: every namespace notebooks land in gets a
+                # profile, created here and never touched by the op
+                # timeline (a deletable profile under live notebooks is a
+                # different scenario than the one being sharded)
+                base.create(api.profile(ns, owner_name=f"{ns}-owner@example.com"))
         for nb in sorted(self.notebooks):
             base.create(self._nb(nb))
         for tb, path in sorted(self.tensorboards.items()):
@@ -613,7 +639,7 @@ class Scenario:
 
     def apply(self, base: FakeCluster, op: tuple[str, str], round_no: int) -> None:
         verb, target = op
-        ns = self.NAMESPACE
+        ns = self.nb_ns.get(target, self.NAMESPACE)
         try:
             if verb == "stop":
                 base.patch("Notebook", target, ns, {"metadata": {"annotations": {
@@ -687,6 +713,7 @@ class SeedResult:
     restarts: int
     fault_counts: collections.Counter
     telemetry: bool = False
+    shards: int = 1
 
     @property
     def ok(self) -> bool:
@@ -700,6 +727,8 @@ class SeedResult:
                 f"({faults} faults, {self.restarts} controller restarts)"
             )
         flag = " --telemetry" if self.telemetry else ""
+        if self.shards > 1:
+            flag += f" --shards {self.shards}"
         lines = [f"seed {self.seed}: FAILED "
                  f"(repro: python tools/chaos_soak.py --seed {self.seed}"
                  f"{flag})"]
@@ -716,6 +745,7 @@ def run_scenario(
     faults: ChaosConfig | None = None,
     *,
     telemetry: bool = False,
+    shards: int = 1,
     max_restarts_per_tick: int = 6,
 ) -> ScenarioRun:
     """One full scenario run on the virtual clock. ``faults=None`` is the
@@ -727,8 +757,32 @@ def run_scenario(
     observer, like the tracer), scrapes run ONLY from the harness driver
     (never inside a reconcile tick — audited), and scrape failures are
     chaos faults. The telemetry audit rides the run's violations.
-    """
-    scenario = Scenario(seed)
+
+    ``shards=N`` (docs/chaos.md "sharded soak") runs N managers over the
+    same store, each enqueue-filtered to its namespace-hash slice
+    (runtime/sharding.py), with the scenario's notebooks spread over four
+    namespaces and one shard's leader killed every round. The convergence
+    verdict is unchanged — the sharded faulted run must reach the sharded
+    fault-free fixed point — which, because the reference run shards
+    identically, proves the partition itself never changes outcomes.
+    ``shards=1`` is the historical single-manager run, bit-identical."""
+    if shards > 1:
+        from kubeflow_tpu.runtime.sharding import (
+            ShardRouter,
+            shard_enqueue_filter,
+        )
+
+        router = ShardRouter(shards)
+        # hashes to shards {1, 2, 0, 3} under ShardRouter(4): every shard
+        # owns at least one namespace, so the per-round leader kill always
+        # hits a manager with real work (team-b would also cover shard 3
+        # but sits in the scenario's deletable-profile op pool; team-m is
+        # outside it)
+        namespaces = ("team-a", "team-c", "team-d", "team-m")
+    else:
+        router = None
+        namespaces = None
+    scenario = Scenario(seed, namespaces=namespaces)
     base = FakeCluster()
     tpu_env.install(base)
     _install_oauth(base)
@@ -824,8 +878,15 @@ def run_scenario(
     # controller restarts, so the audit sees the whole run's story
     slo = SLOMetrics(clock=clock)
 
-    def build() -> Manager:
-        m = Manager(cluster, clock=clock, tracer=tracer)
+    def build(shard_id: int = 0) -> Manager:
+        m = Manager(
+            cluster, clock=clock, tracer=tracer,
+            enqueue_filter=(
+                shard_enqueue_filter(router, shard_id)
+                if router is not None
+                else None
+            ),
+        )
         m.register(
             NotebookReconciler(
                 cfg, culler=culler, recorder=EventRecorder(clock=clock),
@@ -843,9 +904,12 @@ def run_scenario(
     # tensorboards, and the initial notebooks never exercised their
     # controllers until a delete/recreate op happened to fire)
     scenario.setup(base)
-    mgr = build()
+    managers = [build(i) for i in range(shards if router is not None else 1)]
     violations: list[str] = []
     restarts = 0
+    # sharded mode: ONE shard's leader dies every round (stand-down +
+    # cold-rebuild takeover); the other shards' slices must keep converging
+    kill_target = seed % shards if router is not None else None
 
     # ---- read path (webapps/cache.py): the JWA serving surface runs over
     # the SAME faulted client as the controllers — its watch streams drop
@@ -872,97 +936,104 @@ def run_scenario(
         """Bounded staleness: a cache read may FAIL (chaos read fault — the
         client retries) but may never ANSWER with an object deleted more
         than READ_STALENESS_S ago."""
-        try:
-            served = read_cache.list("Notebook", Scenario.NAMESPACE)
-        except Exception:
-            return
-        live = {
-            (ko.namespace(nb), ko.name(nb))
-            for nb in base.list("Notebook", Scenario.NAMESPACE)
-        }
-        for nb in served:
-            key = (ko.namespace(nb), ko.name(nb))
-            if key in live:
+        for namespace in scenario.namespaces:
+            try:
+                served = read_cache.list("Notebook", namespace)
+            except Exception:
                 continue
-            dt = deleted_at.get(key)
-            if dt is None or clock() - dt > READ_STALENESS_S + 1e-6:
-                age = "unknown" if dt is None else f"{clock() - dt:.1f}s"
-                violations.append(
-                    f"{where}: read path served deleted notebook "
-                    f"{key[0]}/{key[1]} (deleted {age} ago; bound "
-                    f"{READ_STALENESS_S:.0f}s)"
-                )
+            live = {
+                (ko.namespace(nb), ko.name(nb))
+                for nb in base.list("Notebook", namespace)
+            }
+            for nb in served:
+                key = (ko.namespace(nb), ko.name(nb))
+                if key in live:
+                    continue
+                dt = deleted_at.get(key)
+                if dt is None or clock() - dt > READ_STALENESS_S + 1e-6:
+                    age = "unknown" if dt is None else f"{clock() - dt:.1f}s"
+                    violations.append(
+                        f"{where}: read path served deleted notebook "
+                        f"{key[0]}/{key[1]} (deleted {age} ago; bound "
+                        f"{READ_STALENESS_S:.0f}s)"
+                    )
 
     def ryw_probe(tag: str) -> None:
         """Read-your-writes: emulate the JWA mutating-handler flow — write
         through the faulted surface with bounded retries; if (and only if)
         the write was ACKED, write it through the cache, pin the session,
-        and assert the immediate re-list shows it."""
-        nbs = base.list("Notebook", Scenario.NAMESPACE)
-        if not nbs:
-            return
-        target = ko.name(nbs[0])
-        marker = f"probe-{tag}"
-        stored = None
-        for _ in range(4):  # the handler's transient-retry budget
-            try:
-                stored = cluster.patch(
-                    "Notebook", target, Scenario.NAMESPACE,
-                    {"metadata": {"annotations": {
-                        READ_PROBE_ANNOTATION: marker}}},
-                )
-                break
-            except ControllerCrash:
-                return  # chaos killed the call; nothing acked to the user
-            except NotFound:
-                return  # a scripted delete raced the probe
-            except Exception:
+        and assert the immediate re-list shows it. One probe per namespace:
+        sharded, every shard's slice carries the same obligation."""
+        for namespace in scenario.namespaces:
+            nbs = base.list("Notebook", namespace)
+            if not nbs:
                 continue
-        if stored is None:
-            return  # write never acked: no read-your-writes obligation
-        read_cache.note_write(stored, principal="jwa-user")
-        try:
-            served = read_cache.list(
-                "Notebook", Scenario.NAMESPACE, principal="jwa-user"
-            )
-        except Exception:
-            return  # loud failure, not a stale answer
-        got = {
-            ko.name(nb): ko.annotations(nb).get(READ_PROBE_ANNOTATION)
-            for nb in served
-        }
-        if got.get(target) != marker:
-            violations.append(
-                f"ryw {tag}: write acked at rv "
-                f"{stored['metadata'].get('resourceVersion')} but the "
-                f"immediate re-list served {got.get(target)!r} for {target}"
-            )
+            target = ko.name(nbs[0])
+            marker = f"probe-{tag}"
+            stored = None
+            for _ in range(4):  # the handler's transient-retry budget
+                try:
+                    stored = cluster.patch(
+                        "Notebook", target, namespace,
+                        {"metadata": {"annotations": {
+                            READ_PROBE_ANNOTATION: marker}}},
+                    )
+                    break
+                except ControllerCrash:
+                    stored = None
+                    break  # chaos killed the call; nothing acked to the user
+                except NotFound:
+                    stored = None
+                    break  # a scripted delete raced the probe
+                except Exception:
+                    continue
+            if stored is None:
+                continue  # write never acked: no read-your-writes obligation
+            read_cache.note_write(stored, principal="jwa-user")
+            try:
+                served = read_cache.list(
+                    "Notebook", namespace, principal="jwa-user"
+                )
+            except Exception:
+                continue  # loud failure, not a stale answer
+            got = {
+                ko.name(nb): ko.annotations(nb).get(READ_PROBE_ANNOTATION)
+                for nb in served
+            }
+            if got.get(target) != marker:
+                violations.append(
+                    f"ryw {tag}: write acked at rv "
+                    f"{stored['metadata'].get('resourceVersion')} but the "
+                    f"immediate re-list served {got.get(target)!r} for "
+                    f"{target}"
+                )
 
     def tick(where: str) -> None:
-        nonlocal mgr, restarts
+        nonlocal restarts
         # zero reconcile-path scrapes: the collector's pass counter must not
         # move while reconcile workers run — the culler reads the store,
         # it never scrapes. A regression wiring collect() into a reconciler
         # (or the culler) trips this on every seed.
         passes_before = collector.scrape_passes if collector is not None else 0
-        for _ in range(max_restarts_per_tick):
-            crashed = False
-            try:
-                mgr.tick()
-            except Exception:
-                # start_watches faulted mid-install (rolled back) or the
-                # reconcile loop blew up: either way the process would die
-                crashed = True
-            if chaos is not None and chaos.take_crash():
-                crashed = True
-            if not crashed:
-                break
-            # controller crash-restart: rebuild the Manager from scratch —
-            # fresh workqueue, fresh watch sync — and resume over whatever
-            # partial writes the dead incarnation left behind
-            restarts += 1
-            mgr.shutdown()
-            mgr = build()
+        for idx in range(len(managers)):
+            for _ in range(max_restarts_per_tick):
+                crashed = False
+                try:
+                    managers[idx].tick()
+                except Exception:
+                    # start_watches faulted mid-install (rolled back) or the
+                    # reconcile loop blew up: either way the process would die
+                    crashed = True
+                if chaos is not None and chaos.take_crash():
+                    crashed = True
+                if not crashed:
+                    break
+                # controller crash-restart: rebuild the Manager from scratch
+                # — fresh workqueue, fresh watch sync — and resume over
+                # whatever partial writes the dead incarnation left behind
+                restarts += 1
+                managers[idx].shutdown()
+                managers[idx] = build(idx)
         # (crash storm may have exhausted the budget; next tick retries)
         if collector is not None and collector.scrape_passes != passes_before:
             violations.append(
@@ -985,19 +1056,26 @@ def run_scenario(
                 lat = chaos.take_latency()
                 if lat:
                     clock.advance(lat)
-            violations.extend(
-                check_invariants(
-                    base, mgr,
-                    max_requeue_s=SOAK_MAX_REQUEUE_S,
-                    where=f"{where}.{s}",
+            for m in managers:
+                violations.extend(
+                    check_invariants(
+                        base, m,
+                        max_requeue_s=SOAK_MAX_REQUEUE_S,
+                        where=f"{where}.{s}",
+                    )
                 )
-            )
             read_audit(f"{where}.{s}")
         clock.advance(dt)
 
     for r, ops in enumerate(scenario.rounds):
         for op in ops:
             scenario.apply(base, op, r)
+        if kill_target is not None:
+            # the targeted shard's leader loses its lease mid-run; the
+            # takeover starts a cold manager over the same store
+            restarts += 1
+            managers[kill_target].shutdown()
+            managers[kill_target] = build(kill_target)
         ryw_probe(f"r{r}")
         drive(f"round {r}")
 
@@ -1024,13 +1102,14 @@ def run_scenario(
             break
         prev = fp
         clock.advance(65.0)
-    violations.extend(
-        check_invariants(
-            base, mgr,
-            max_requeue_s=SOAK_MAX_REQUEUE_S,
-            where="final", final=True,
+    for m in managers:
+        violations.extend(
+            check_invariants(
+                base, m,
+                max_requeue_s=SOAK_MAX_REQUEUE_S,
+                where="final", final=True,
+            )
         )
-    )
     # trace audit: convergence says the state is right; this says every
     # write that produced it is attributable to an event-triggered reconcile
     violations.extend(tracer.audit())
@@ -1061,14 +1140,19 @@ def run_seed(
     faults: ChaosConfig | None = None,
     *,
     telemetry: bool = False,
+    shards: int = 1,
 ) -> SeedResult:
     """The soak unit: fault-free fixed point vs faulted run, same seed.
     ``telemetry=True`` runs BOTH with the data-plane pipeline armed — the
     fixed point then includes duty-cycle culls of idle-spinners, so
     convergence proves the faulted run's telemetry decisions match the
-    fault-free run's."""
-    reference = run_scenario(seed, None, telemetry=telemetry)
-    chaotic = run_scenario(seed, faults or ChaosConfig(), telemetry=telemetry)
+    fault-free run's. ``shards=N`` runs BOTH with the sharded control plane
+    (N namespace-filtered managers, one shard's leader killed per round) —
+    convergence then proves the partition changes no outcomes."""
+    reference = run_scenario(seed, None, telemetry=telemetry, shards=shards)
+    chaotic = run_scenario(
+        seed, faults or ChaosConfig(), telemetry=telemetry, shards=shards
+    )
     violations = list(chaotic.violations)
     if reference.violations:
         violations += [f"(fault-free!) {v}" for v in reference.violations]
@@ -1082,6 +1166,7 @@ def run_seed(
         restarts=chaotic.restarts,
         fault_counts=chaotic.fault_counts,
         telemetry=telemetry,
+        shards=shards,
     )
 
 
@@ -1090,12 +1175,17 @@ def diff_states(
     faults: ChaosConfig | None = None,
     *,
     telemetry: bool = False,
+    shards: int = 1,
 ) -> str:
     """Debug helper: where the faulted fixed point diverges (chaos_soak -v)."""
-    ref = json.loads(run_scenario(seed, None, telemetry=telemetry).fingerprint)
+    ref = json.loads(
+        run_scenario(
+            seed, None, telemetry=telemetry, shards=shards
+        ).fingerprint
+    )
     got = json.loads(
         run_scenario(
-            seed, faults or ChaosConfig(), telemetry=telemetry
+            seed, faults or ChaosConfig(), telemetry=telemetry, shards=shards
         ).fingerprint
     )
 

@@ -78,6 +78,16 @@ class ControllerConfig:
     telemetry_staleness_s: float = 60.0
     telemetry_duty_cycle_idle: float = 0.05
     telemetry_port: int = 8890
+    # Control-plane sharding (runtime/sharding.py): partition the manager
+    # plane by namespace hash and the scheduler by accelerator family into
+    # SHARDS independent shards, each behind its own leader lease. 1 (the
+    # default) is the single-loop control plane, bit-identical to the
+    # pre-sharding behavior. shard_id: which shard THIS process runs
+    # (SHARD_ID env — the production layout is one process per shard, e.g.
+    # a StatefulSet ordinal); None runs every shard in one process
+    # (standalone / demo / soak harnesses).
+    shards: int = 1
+    shard_id: int | None = None
     # Profile defaults (ref --namespace-labels-path flag, profile-controller
     # main.go; the mounted file is hot-reloaded, go:356-405)
     namespace_labels_path: str = ""
@@ -110,6 +120,12 @@ class ControllerConfig:
                 "TELEMETRY_DUTY_CYCLE_IDLE", 0.05
             ),
             telemetry_port=int(_env_float("TELEMETRY_PORT", 8890)),
+            shards=max(1, int(_env_float("SHARDS", 1))),
+            shard_id=(
+                int(_env_float("SHARD_ID", -1))
+                if os.environ.get("SHARD_ID") is not None
+                else None
+            ),
             namespace_labels_path=os.environ.get("NAMESPACE_LABELS_PATH", ""),
             enable_oauth_controller=_env_bool("ENABLE_OAUTH_CONTROLLER", False),
         )

@@ -240,6 +240,94 @@ class _Metric:
 builtins_sum = sum  # _Metric defines .sum(); keep the builtin reachable
 
 
+class _Bound:
+    """A metric with preset labels (the ``shard`` label under control-plane
+    sharding): every observation merges the bound labels in, so N shards
+    sharing one registry write disjoint series instead of colliding on one
+    unlabeled sample (gauges would last-writer-win, counters double-count).
+    Call sites keep the unlabeled API — ``metrics.queue_retries.inc()``
+    works identically whether the family is shard-labeled or not."""
+
+    __slots__ = ("_metric", "_labels")
+
+    def __init__(self, metric: _Metric, labels: Mapping[str, str]) -> None:
+        self._metric = metric
+        self._labels = dict(labels)
+
+    def _merge(self, labels: Mapping[str, str]) -> dict:
+        merged = dict(self._labels)
+        merged.update(labels)
+        return merged
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        self._metric.inc(amount, **self._merge(labels))
+
+    def set(self, value: float, **labels: str) -> None:
+        self._metric.set(value, **self._merge(labels))
+
+    def get(self, **labels: str) -> float:
+        return self._metric.get(**self._merge(labels))
+
+    def observe(self, value: float, **labels: str) -> None:
+        self._metric.observe(value, **self._merge(labels))
+
+    def sum(self, **labels: str) -> float:
+        return self._metric.sum(**self._merge(labels))
+
+    def count(self, **labels: str) -> int:
+        return self._metric.count(**self._merge(labels))
+
+    def quantile(self, q: float, **labels: str) -> float:
+        return self._metric.quantile(q, **self._merge(labels))
+
+    @property
+    def name(self) -> str:
+        return self._metric.name
+
+    @property
+    def kind(self) -> str:
+        return self._metric.kind
+
+    def samples(self) -> list[dict]:
+        return self._metric.samples()
+
+
+class _ShardScope:
+    """Registration helper for collectors that grow a ``shard`` label when
+    sharded (ControlPlaneMetrics, SchedulerMetrics). With ``shard=None`` it
+    is a transparent pass-through — the single-shard exposition is byte-
+    identical to the pre-sharding one. With a shard id, every family is
+    registered with ``shard`` appended to its label names and every returned
+    handle is bound to that shard's value. Mixing sharded and unsharded
+    instances on one registry raises (the family's label schema is frozen),
+    which is the configuration error it looks like."""
+
+    def __init__(self, registry: "Registry", shard: str | None) -> None:
+        self.registry = registry
+        self.shard = shard
+
+    def _wrap(self, metric: _Metric):
+        if self.shard is None:
+            return metric
+        return _Bound(metric, {"shard": self.shard})
+
+    def _names(self, labelnames: Sequence[str] | None) -> Sequence[str] | None:
+        if self.shard is None:
+            return labelnames
+        return tuple(labelnames or ()) + ("shard",)
+
+    def counter(self, name, help_, labelnames=None):
+        return self._wrap(self.registry.counter(name, help_, self._names(labelnames)))
+
+    def gauge(self, name, help_, labelnames=None):
+        return self._wrap(self.registry.gauge(name, help_, self._names(labelnames)))
+
+    def histogram(self, name, help_, labelnames=None, buckets=None):
+        return self._wrap(
+            self.registry.histogram(name, help_, self._names(labelnames), buckets)
+        )
+
+
 class Registry:
     def __init__(self) -> None:
         self._metrics: list[_Metric] = []
@@ -280,6 +368,26 @@ class Registry:
                         f"metric {m.name!r} already registered as "
                         f"{existing.kind}, not {m.kind}"
                     )
+                if m._label_names is not None:
+                    if existing._label_names is None:
+                        # schema not yet frozen: the declaring registration
+                        # fixes it
+                        existing._label_names = m._label_names
+                    elif tuple(existing._label_names) != tuple(
+                        m._label_names
+                    ):
+                        # a sharded and an unsharded collector (or any two
+                        # conflicting schemas) on one registry is a wiring
+                        # error — fail HERE, at registration, not at some
+                        # arbitrary later observation (the delayed error
+                        # let a soak run a crash-every-cycle scheduler
+                        # while looking green)
+                        raise ValueError(
+                            f"metric {m.name!r} already registered with "
+                            f"labels {sorted(existing._label_names)}, got "
+                            f"{sorted(m._label_names)} — one registry, one "
+                            f"schema per family"
+                        )
                 return existing
         self._metrics.append(m)
         return m
@@ -420,41 +528,50 @@ class ControlPlaneMetrics:
     (``manager.py``), workqueue queue-wait and retry churn, and per-verb
     apiserver request latency (``kubeclient.py``). One instance is shared by
     the manager and the API client so a single /metrics scrape answers
-    "where did the reconcile's time go"."""
+    "where did the reconcile's time go".
+
+    ``shard`` (control-plane sharding, runtime/sharding.py): N shard
+    managers share one registry — each instance passes its shard id so the
+    families carry a ``shard`` label and per-shard series never collide or
+    double-count. ``shard=None`` (the unsharded default) registers the
+    exact pre-sharding schema."""
 
     # reconcile/queue-wait spans ms..minutes; apiserver requests ms..seconds
     RECONCILE_BUCKETS = (
         0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
     )
 
-    def __init__(self, registry: Registry | None = None) -> None:
+    def __init__(
+        self, registry: Registry | None = None, *, shard: str | None = None
+    ) -> None:
         self.registry = registry or Registry()
-        self.reconcile_duration = self.registry.histogram(
+        scoped = _ShardScope(self.registry, shard)
+        self.reconcile_duration = scoped.histogram(
             "controller_reconcile_duration_seconds",
             "Time spent in reconcile(), per primary kind",
             labelnames=("kind",),
             buckets=self.RECONCILE_BUCKETS,
         )
-        self.reconcile_total = self.registry.counter(
+        self.reconcile_total = scoped.counter(
             "controller_reconcile_total",
             "Reconcile outcomes per kind (success|error|requeue)",
             labelnames=("kind", "outcome"),
         )
-        self.queue_wait = self.registry.histogram(
+        self.queue_wait = scoped.histogram(
             "workqueue_queue_wait_seconds",
             "Time a key waited in the workqueue before a worker picked it up",
             buckets=self.RECONCILE_BUCKETS,
         )
-        self.queue_retries = self.registry.counter(
+        self.queue_retries = scoped.counter(
             "workqueue_retries_total",
             "Keys re-enqueued through per-key error backoff",
         )
-        self.api_latency = self.registry.histogram(
+        self.api_latency = scoped.histogram(
             "apiserver_request_duration_seconds",
             "Kubernetes API request latency, per verb",
             labelnames=("verb",),
         )
-        self.api_retries = self.registry.counter(
+        self.api_retries = scoped.counter(
             "apiserver_request_retries_total",
             "Transient-error retries inside one logical API request, per verb",
             labelnames=("verb",),
@@ -588,6 +705,12 @@ class SchedulerMetrics:
     `rate(sum)/rate(count)` gives the mean and `histogram_quantile` the
     tail — the old sum-only counter made both impossible. The max gauge
     stays: a single pathological wait must survive bucket averaging.
+
+    ``shard`` (control-plane sharding, runtime/sharding.py): each scheduler
+    shard is an independent scheduler over its own accelerator families —
+    N of them share one registry, so every family carries a ``shard`` label
+    when sharded (unlabeled gauges would last-writer-win across shards and
+    read as one fleet). ``shard=None`` keeps the pre-sharding schema.
     """
 
     # queue waits span seconds (idle fleet) to hours (saturated fleet)
@@ -600,44 +723,47 @@ class SchedulerMetrics:
     # deadline worst-case)
     HANDOFF_BUCKETS = (0.5, 1.0, 5.0, 15.0, 60.0, 120.0, 300.0, 900.0)
 
-    def __init__(self, registry: Registry | None = None) -> None:
+    def __init__(
+        self, registry: Registry | None = None, *, shard: str | None = None
+    ) -> None:
         self.registry = registry or Registry()
-        self.queue_depth = self.registry.gauge(
+        scoped = _ShardScope(self.registry, shard)
+        self.queue_depth = scoped.gauge(
             "scheduler_queue_depth", "Gangs waiting for TPU capacity"
         )
-        self.unschedulable = self.registry.gauge(
+        self.unschedulable = scoped.gauge(
             "scheduler_unschedulable",
             "Gangs no node pool could ever hold (bad topology for this fleet)",
         )
-        self.fleet_chips_total = self.registry.gauge(
+        self.fleet_chips_total = scoped.gauge(
             "scheduler_fleet_chips_total", "TPU chips the fleet models"
         )
-        self.fleet_chips_used = self.registry.gauge(
+        self.fleet_chips_used = scoped.gauge(
             "scheduler_fleet_chips_used",
             "TPU chips held by bound gangs or blocked hosts",
         )
-        self.utilization = self.registry.gauge(
+        self.utilization = scoped.gauge(
             "scheduler_fleet_utilization", "used/total chips, 0..1"
         )
-        self.binds = self.registry.counter(
+        self.binds = scoped.counter(
             "scheduler_bind_total", "Gang placements committed"
         )
-        self.preemptions = self.registry.counter(
+        self.preemptions = scoped.counter(
             "scheduler_preemption_total", "Gangs evicted for a senior gang"
         )
-        self.time_to_bind = self.registry.histogram(
+        self.time_to_bind = scoped.histogram(
             "scheduler_time_to_bind_seconds",
             "Queue-admission→bind latency distribution",
             buckets=self.BIND_BUCKETS,
         )
-        self.bind_seconds_max = self.registry.gauge(
+        self.bind_seconds_max = scoped.gauge(
             "scheduler_time_to_bind_seconds_max",
             "Largest time-to-bind observed",
         )
-        self.cycles = self.registry.counter(
+        self.cycles = scoped.counter(
             "scheduler_cycle_total", "Scheduling cycles run"
         )
-        self.cycle_duration = self.registry.histogram(
+        self.cycle_duration = scoped.histogram(
             "scheduler_cycle_duration_seconds",
             "Wall time of one full scheduling pass",
             buckets=self.CYCLE_BUCKETS,
@@ -645,24 +771,24 @@ class SchedulerMetrics:
         # phase-attributed cycle cost (docs/scheduler.md fast path): which
         # of list/replay/pack/write eats the cycle is what distinguishes
         # "the apiserver is slow" from "the packing is slow"
-        self.cycle_phase = self.registry.histogram(
+        self.cycle_phase = scoped.histogram(
             "scheduler_cycle_phase_seconds",
             "Wall time of one scheduling-cycle phase (list/replay/pack/write)",
             labelnames=("phase",),
             buckets=self.PHASE_BUCKETS,
         )
-        self.fit_cache_hits = self.registry.counter(
+        self.fit_cache_hits = scoped.counter(
             "scheduler_fit_cache_hits_total",
             "Fit attempts skipped by the negative-fit cache",
         )
-        self.fit_cache_misses = self.registry.counter(
+        self.fit_cache_misses = scoped.counter(
             "scheduler_fit_cache_misses_total",
             "Failed fit attempts recorded into the negative-fit cache",
         )
         # preemption handoff hold time: suspend-request→chip-release. The
         # preemptor's time-to-bind is bounded below by this — the snapshot
         # fast path (docs/sessions.md) exists to shrink it
-        self.handoff_seconds = self.registry.histogram(
+        self.handoff_seconds = scoped.histogram(
             "scheduler_handoff_seconds",
             "Suspend-request→placement-release latency of preemption "
             "handoffs",

@@ -79,6 +79,43 @@ class TestConvergenceSoak:
         assert result.ok, _fail_message(result)
 
 
+# Sharded control plane (docs/chaos.md "sharded soak"): four namespace-
+# filtered managers over one store, one shard's leader killed every round;
+# the faulted run must reach the equally-sharded fault-free fixed point.
+# Fewer tier-1 seeds (each runs 2x4 managers); the workflow's
+# --shards step covers 11-20, nightlies the rest.
+SHARDED_CI_SEEDS = range(1, 11)
+SHARDED_NIGHTLY_SEEDS = range(1, 201)
+
+
+class TestShardedConvergenceSoak:
+    def test_sharded_same_seed_identical_run(self):
+        a = run_scenario(17, ChaosConfig(), shards=4)
+        b = run_scenario(17, ChaosConfig(), shards=4)
+        assert a.fingerprint == b.fingerprint
+        assert a.fault_counts == b.fault_counts
+        assert a.violations == b.violations
+
+    def test_single_shard_run_matches_historical_runner(self):
+        """`--shards 1` is the historical single-manager runner — same
+        fixed point, same fault schedule, not merely 'also converges'."""
+        a = run_scenario(17, ChaosConfig())
+        b = run_scenario(17, ChaosConfig(), shards=1)
+        assert a.fingerprint == b.fingerprint
+        assert a.fault_counts == b.fault_counts
+
+    @pytest.mark.parametrize("seed", SHARDED_CI_SEEDS)
+    def test_sharded_seed_converges(self, seed):
+        result = run_seed(seed, shards=4)
+        assert result.ok, _fail_message(result)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", SHARDED_NIGHTLY_SEEDS)
+    def test_sharded_seed_converges_nightly(self, seed):
+        result = run_seed(seed, shards=4)
+        assert result.ok, _fail_message(result)
+
+
 def _single_notebook_world():
     """FakeCluster + quiet ChaosCluster + Manager over one TPU notebook."""
     base = FakeCluster()

@@ -218,6 +218,103 @@ class TestElection:
         b = make(base, "b", clock)
         assert b.try_acquire_or_renew() is True
 
+    def test_distinct_leases_in_one_process_never_interfere(self):
+        """Control-plane sharding runs one elector per shard, all in one
+        process against one store (runtime/sharding.py): distinct lease
+        names are independent locks — every shard acquires its own, renewals
+        never cross, and a challenger on one lease is blocked without
+        affecting the others."""
+        cluster, clock = FakeCluster(), FakeClock()
+        electors = [
+            LeaderElector(
+                cluster, name=f"shard-{i}-of-4", identity=f"replica-{i}",
+                lease_duration=15.0, retry_period=0.01, clock=clock,
+            )
+            for i in range(4)
+        ]
+        for e in electors:
+            assert e.try_acquire_or_renew() is True
+        for i, e in enumerate(electors):
+            lease = cluster.get("Lease", f"shard-{i}-of-4", "kubeflow-system")
+            assert lease["spec"]["holderIdentity"] == f"replica-{i}"
+        # renewals interleave without cross-talk
+        for _ in range(3):
+            clock.t += 10
+            for e in electors:
+                assert e.try_acquire_or_renew() is True
+        # a standby challenging shard 2's fresh lease is blocked; every
+        # other shard's leadership is untouched
+        challenger = LeaderElector(
+            cluster, name="shard-2-of-4", identity="standby",
+            lease_duration=15.0, retry_period=0.01, clock=clock,
+        )
+        assert challenger.try_acquire_or_renew() is False
+        for e in electors:
+            assert e.try_acquire_or_renew() is True
+
+    def test_interleaved_stand_downs_fire_stop_exactly_once_per_lease(self):
+        """Sharded stand-downs: steal each shard's lease at a different
+        time — each elector fires ``on_stopped_leading`` exactly once (for
+        ITS lease), its run() returns, and the shards not yet stolen keep
+        leading throughout."""
+        from kubeflow_tpu.runtime.leader import _format
+
+        cluster, clock = FakeCluster(), FakeClock()
+        n = 3
+        stopped: dict[int, list[float]] = {i: [] for i in range(n)}
+        started = [threading.Event() for _ in range(n)]
+        threads = []
+        electors = []
+        for i in range(n):
+            e = LeaderElector(
+                cluster, name=f"lease-{i}", identity=f"holder-{i}",
+                lease_duration=15.0, retry_period=0.01, clock=clock,
+            )
+            electors.append(e)
+            t = threading.Thread(
+                target=e.run, args=(started[i].set,),
+                kwargs={
+                    "on_stopped_leading": (
+                        lambda i=i: stopped[i].append(clock())
+                    )
+                },
+                daemon=True,
+            )
+            threads.append(t)
+            t.start()
+        for ev in started:
+            assert ev.wait(timeout=5)
+
+        def steal(i: int) -> None:
+            from kubeflow_tpu.runtime.fake import Conflict
+
+            for _ in range(200):  # retry around concurrent renewals
+                try:
+                    lease = cluster.get("Lease", f"lease-{i}", "kubeflow-system")
+                    lease["spec"]["holderIdentity"] = "usurper"
+                    lease["spec"]["renewTime"] = _format(clock() + 1000)
+                    cluster.update(lease)
+                    return
+                except Conflict:
+                    continue
+            raise AssertionError(f"could not steal lease-{i}")
+
+        import time as _t
+
+        for i in range(n):
+            steal(i)
+            threads[i].join(timeout=5)
+            assert not threads[i].is_alive()
+            assert len(stopped[i]) == 1, (
+                f"lease-{i} fired on_stopped_leading {len(stopped[i])}x"
+            )
+            _t.sleep(0.05)
+            # the not-yet-stolen shards are still leading
+            for j in range(i + 1, n):
+                assert electors[j].is_leader
+                assert not stopped[j]
+        assert all(len(v) == 1 for v in stopped.values())
+
     def test_transient_renew_conflict_does_not_stand_down(self):
         """A 409 blip on the leader's OWN renew write (chaos write_errors
         treats Conflict as transient) must ride the renew_deadline grace, not

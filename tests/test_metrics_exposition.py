@@ -480,3 +480,77 @@ class TestHistogramSemantics:
             pytest.approx(712.0)
         )
         assert sm.bind_seconds_max.get() == 700.0
+
+
+class TestShardLabel:
+    """Control-plane sharding (runtime/sharding.py): N shard managers share
+    ONE registry, so the per-manager families carry a ``shard`` label —
+    without it, gauges last-writer-win across shards and counters
+    double-count into one series. The unsharded schema stays label-free
+    (``SHARDS=1`` exposition is byte-identical to pre-sharding)."""
+
+    def test_sharded_families_on_one_registry_do_not_collide(self):
+        registry = Registry()
+        cps = [ControlPlaneMetrics(registry, shard=str(i)) for i in range(4)]
+        sms = [SchedulerMetrics(registry, shard=str(i)) for i in range(4)]
+        for i in range(4):
+            cps[i].observe_reconcile("Notebook", 0.01 * (i + 1), "success")
+            cps[i].queue_retries.inc()
+            sms[i].queue_depth.set(float(10 + i))
+            sms[i].observe_bind(1.0 + i)
+            sms[i].preemptions.inc()
+        families = parse_exposition(registry.expose())
+        check_histograms(families)
+        # one family each (no duplicates — the parser asserts that), with
+        # four disjoint per-shard series
+        depth = {
+            labels["shard"]: value
+            for _, labels, value in families["scheduler_queue_depth"]["samples"]
+        }
+        assert depth == {"0": 10.0, "1": 11.0, "2": 12.0, "3": 13.0}
+        retries = families["workqueue_retries_total"]["samples"]
+        assert len(retries) == 4
+        assert all(value == 1.0 for _, _, value in retries)
+        binds = {
+            labels["shard"]: value
+            for name, labels, value in families[
+                "scheduler_time_to_bind_seconds"]["samples"]
+            if name.endswith("_count")
+        }
+        assert binds == {"0": 1.0, "1": 1.0, "2": 1.0, "3": 1.0}
+        # per-kind labels compose with the shard label on one series
+        recon = {
+            (labels["kind"], labels["outcome"], labels["shard"])
+            for _, labels, _ in families["controller_reconcile_total"]["samples"]
+        }
+        assert ("Notebook", "success", "2") in recon
+        # bound-metric reads see their own shard's series only
+        assert sms[1].queue_depth.get() == 11.0
+        assert sms[3].bind_seconds_max.get() == 4.0
+
+    def test_unsharded_schema_is_unchanged(self):
+        registry = Registry()
+        sm = SchedulerMetrics(registry)
+        sm.queue_depth.set(3)
+        families = parse_exposition(registry.expose())
+        (sample,) = families["scheduler_queue_depth"]["samples"]
+        assert sample[1] == {}  # no shard label in the single-loop plane
+
+    def test_mixing_sharded_and_unsharded_instances_raises(self):
+        """A sharded and an unsharded collector on one registry is a wiring
+        error — it must fail LOUDLY at registration (families with declared
+        labelnames, e.g. the phase histogram) or at the first observation
+        (families whose schema froze on first use), never by silently
+        corrupting series. The delayed-error variant let a soak run a
+        crash-every-cycle scheduler while its audits looked green."""
+        registry = Registry()
+        SchedulerMetrics(registry, shard="0").queue_depth.set(1)
+        with pytest.raises(ValueError):
+            # cycle_phase is declared ("phase",) unsharded vs
+            # ("phase","shard") sharded: registration itself conflicts
+            plain = SchedulerMetrics(registry)
+            plain.queue_depth.set(2)  # and first use would too
+        registry2 = Registry()
+        ControlPlaneMetrics(registry2)  # unsharded first, never observed
+        with pytest.raises(ValueError):
+            ControlPlaneMetrics(registry2, shard="1")

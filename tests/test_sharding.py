@@ -1,0 +1,466 @@
+"""Control-plane sharding (runtime/sharding.py, docs/architecture.md).
+
+Partition correctness through the store, never through internals: the
+router's stable maps, the manager-plane enqueue filter, per-family scheduler
+shards over ONE shared cluster (disjoint binds, zero cross-shard writes),
+the ownership stamp's adoption protocol across shard-count changes, and the
+two crash boundaries the tentpole names — a controller crash between the
+ownership-stamp write and the first owned reconcile, and a reshard while a
+gang is mid-suspend-handoff.
+"""
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu import sessions as sess
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.runtime import sharding
+from kubeflow_tpu.runtime.manager import Manager, Reconciler, Result
+from kubeflow_tpu.runtime.sharding import (
+    ADOPT,
+    FOREIGN,
+    OWNED,
+    SHARD_ANNOTATION,
+    ShardRouter,
+    shard_enqueue_filter,
+)
+from kubeflow_tpu.scheduler.controller import FLEET_KEY, SchedulerReconciler
+from kubeflow_tpu.scheduler.soak import audit_shards, make_pool
+from kubeflow_tpu.testing.chaos import ChaosCluster, ChaosConfig
+from kubeflow_tpu.utils.config import ControllerConfig
+
+NS = "team-a"
+
+
+def _nb(name, accel="v4", topo="2x2x2", ns=NS, **kw):
+    return api.notebook(name, ns, tpu_accelerator=accel, tpu_topology=topo, **kw)
+
+
+def _sched(shards=None, shard_id=0, clock=None, **kw):
+    router = ShardRouter(shards) if shards else None
+    return SchedulerReconciler(
+        clock=clock or (lambda: 1_000.0),
+        families=router.families_for(shard_id) if router else None,
+        router=router,
+        shard_id=shard_id,
+        **kw,
+    )
+
+
+class TestShardRouter:
+    def test_shard_count_validated(self):
+        with pytest.raises(ValueError):
+            ShardRouter(0)
+
+    def test_namespace_map_is_stable_and_in_range(self):
+        a, b = ShardRouter(4), ShardRouter(4)
+        for ns in ("team-a", "team-b", "kubeflow", "u" * 63, ""):
+            assert a.shard_for_namespace(ns) == b.shard_for_namespace(ns)
+            assert 0 <= a.shard_for_namespace(ns) < 4
+        # sha-based, not hash(): the map must agree ACROSS processes, and
+        # PYTHONHASHSEED makes hash() disagree — pin one known value so a
+        # hash-function change cannot slip by as "still self-consistent"
+        assert sharding.stable_hash("ns:team-a") == int.from_bytes(
+            __import__("hashlib").sha256(b"ns:team-a").digest()[:8], "big"
+        )
+
+    def test_families_partition_exactly(self):
+        router = ShardRouter(4)
+        owned = [router.families_for(i) for i in range(4)]
+        assert set().union(*owned) == {"v4", "v5e", "v5p", "v6e"}
+        assert sum(len(f) for f in owned) == 4  # disjoint: no family twice
+        # balanced by construction (index map, not a hash over 4 items)
+        assert all(len(f) == 1 for f in owned)
+        # two shards: two families each
+        r2 = ShardRouter(2)
+        assert all(len(r2.families_for(i)) == 2 for i in range(2))
+        # one shard owns everything (the unsharded degenerate)
+        assert ShardRouter(1).families_for(0) == {"v4", "v5e", "v5p", "v6e"}
+
+    def test_unknown_family_still_routes(self):
+        router = ShardRouter(4)
+        assert 0 <= router.shard_for_family("v9x") < 4
+
+    def test_claim_verdicts(self):
+        router = ShardRouter(4)
+        owner = router.shard_for_family("v4")
+        other = (owner + 1) % 4
+        nb = _nb("g")
+        # no stamp: the owner adopts, everyone else keeps hands off
+        assert router.claim(nb, owner, family="v4") == ADOPT
+        assert router.claim(nb, other, family="v4") == FOREIGN
+        nb["metadata"]["annotations"] = {
+            SHARD_ANNOTATION: router.stamp(owner)
+        }
+        assert router.claim(nb, owner, family="v4") == OWNED
+        # another GENERATION's stamp (shard-count change): adopt again
+        nb["metadata"]["annotations"][SHARD_ANNOTATION] = "2:0"
+        assert router.claim(nb, owner, family="v4") == ADOPT
+
+    def test_parse_owner_malformed_reads_as_absent(self):
+        for raw in (None, "", "4", "4:9", "x:y", "0:0", "4:-1", "a:b:c"):
+            assert sharding.parse_owner(raw) is None
+        assert sharding.parse_owner("4:2") == (4, 2)
+
+
+class TestManagerSharding:
+    class _Spy(Reconciler):
+        kind = "Notebook"
+
+        def __init__(self):
+            self.seen = []
+
+        def reconcile(self, cluster, namespace, name):
+            self.seen.append((namespace, name))
+            return Result()
+
+    def test_enqueue_filter_partitions_namespaces(self, cluster):
+        router = ShardRouter(4)
+        spies, managers = [], []
+        for i in range(4):
+            spy = self._Spy()
+            m = Manager(
+                cluster, enqueue_filter=shard_enqueue_filter(router, i)
+            )
+            m.register(spy)
+            spies.append(spy)
+            managers.append(m)
+        namespaces = ["team-a", "team-b", "team-c", "team-d", "prod-x"]
+        for ns in namespaces:
+            cluster.create(_nb("nb", ns=ns))
+        for m in managers:
+            m.run_until_idle()
+        for ns in namespaces:
+            owner = router.shard_for_namespace(ns)
+            for i, spy in enumerate(spies):
+                hits = [k for k in spy.seen if k == (ns, "nb")]
+                assert len(hits) == (1 if i == owner else 0), (
+                    f"{ns} reconciled by shard {i}, owner {owner}"
+                )
+
+    def test_scheduler_pseudo_kind_passes_every_filter(self):
+        router = ShardRouter(4)
+        rec = SchedulerReconciler()
+        for i in range(4):
+            assert shard_enqueue_filter(router, i)(rec, "", FLEET_KEY)
+
+    def test_shutdown_on_never_started_manager_is_a_clean_noop(self, cluster):
+        """A sharded standby that never won its lease never started watches
+        or workers — process teardown still calls shutdown(), which must
+        not raise (an AttributeError here masks the real exit reason)."""
+        m = Manager(cluster)
+        m.shutdown()   # never started: no watches, no workers, no ticks
+        m.shutdown()   # idempotent: crash-restart loops shut down twice
+        assert m.watches_started is False
+        # registering + shutting down without ever executing is equally fine
+        m2 = Manager(cluster)
+        m2.register(self._Spy())
+        m2.shutdown()
+        # and a shut-down manager can still report queue metrics (probes
+        # scrape whatever replica they land on)
+        assert m2.queue_metrics()["depth"] == 0
+
+
+class TestControllerWiring:
+    def test_build_managers_partitions_families_and_labels_metrics(self, cluster):
+        from kubeflow_tpu.cmd.controller import build_managers
+
+        cfg = ControllerConfig(scheduler_enabled=True, shards=4)
+        managers, metrics = build_managers(cluster, cfg)
+        assert [m.shard_id for m in managers] == [0, 1, 2, 3]
+        fams = [
+            r.families
+            for m in managers
+            for r in m._reconcilers
+            if r.kind == "SchedulerCycle"
+        ]
+        assert set().union(*fams) == {"v4", "v5e", "v5p", "v6e"}
+        assert sum(len(f) for f in fams) == 4
+        # one registry, shard-labeled per-manager families
+        text = metrics.registry.expose()
+        assert 'shard="3"' in text or "scheduler_queue_depth" in text
+
+    def test_build_managers_shard_id_selects_one_shard(self, cluster):
+        from kubeflow_tpu.cmd.controller import build_managers
+
+        cfg = ControllerConfig(scheduler_enabled=True, shards=4, shard_id=2)
+        managers, _ = build_managers(cluster, cfg)
+        assert len(managers) == 1 and managers[0].shard_id == 2
+        with pytest.raises(ValueError):
+            build_managers(
+                cluster,
+                ControllerConfig(shards=4, shard_id=7),
+            )
+
+    def test_build_managers_single_shard_is_the_unsharded_manager(self, cluster):
+        from kubeflow_tpu.cmd.controller import build_managers
+
+        managers, _ = build_managers(
+            cluster, ControllerConfig(scheduler_enabled=True)
+        )
+        assert len(managers) == 1
+        assert managers[0].shard_id is None
+        assert managers[0].enqueue_filter is None
+        (rec,) = [
+            r for r in managers[0]._reconcilers
+            if r.kind == "SchedulerCycle"
+        ]
+        assert rec.families is None  # the historical single-loop scheduler
+
+
+def _two_family_world(cluster):
+    """v4 + v5e pools, one gang of each family; returns (v4_shard, v5e_shard)
+    under a 2-way router."""
+    make_pool(cluster, "v4", "2x2x2", "pool-v4")
+    make_pool(cluster, "v5e", "4x8", "pool-v5e")
+    cluster.create(_nb("g-v4", accel="v4", topo="2x2x2"))
+    cluster.create(_nb("g-v5e", accel="v5e", topo="2x4"))
+    router = ShardRouter(2)
+    return router, router.shard_for_family("v4"), router.shard_for_family("v5e")
+
+
+class TestSchedulerSharding:
+    def test_shards_bind_only_owned_families_no_cross_writes(self, cluster):
+        router, s_v4, s_v5e = _two_family_world(cluster)
+        assert s_v4 != s_v5e
+        recs = {
+            i: _sched(shards=2, shard_id=i) for i in (0, 1)
+        }
+        # the v4 shard's cycle binds the v4 gang and NEVER touches the v5e
+        # notebook (no stamp, no conditions, no queued-at — rv unmoved)
+        v5e_rv_before = cluster.get("Notebook", "g-v5e", NS)["metadata"][
+            "resourceVersion"]
+        recs[s_v4].reconcile(cluster, "", FLEET_KEY)
+        v4 = cluster.get("Notebook", "g-v4", NS)
+        v5e = cluster.get("Notebook", "g-v5e", NS)
+        assert sched.placement_of(v4) is not None
+        assert sched.placement_of(v5e) is None
+        assert v5e["metadata"]["resourceVersion"] == v5e_rv_before
+        # its placement lives in its own family's pool, stamped to itself
+        assert all(
+            s["pool"] == "pool-v4" for s in sched.placement_of(v4)["slices"]
+        )
+        assert sharding.owner_of(v4) == (2, s_v4)
+        # the v5e shard picks up its own gang; the audit sees a clean world
+        recs[s_v5e].reconcile(cluster, "", FLEET_KEY)
+        v5e = cluster.get("Notebook", "g-v5e", NS)
+        assert sched.placement_of(v5e) is not None
+        assert sharding.owner_of(v5e) == (2, s_v5e)
+        assert audit_shards(cluster, router) == []
+
+    def test_unsharded_scheduler_leaves_no_stamp(self, cluster):
+        """SHARDS=1 must be bit-identical to the pre-sharding control
+        plane: no router, no ownership annotations, nothing for the soak
+        fingerprints to diverge on."""
+        make_pool(cluster, "v4", "2x2x2", "pool-v4")
+        cluster.create(_nb("g"))
+        SchedulerReconciler(clock=lambda: 1000.0).reconcile(
+            cluster, "", FLEET_KEY
+        )
+        nb = cluster.get("Notebook", "g", NS)
+        assert sched.placement_of(nb) is not None
+        assert SHARD_ANNOTATION not in nb["metadata"]["annotations"]
+
+    def test_admission_stamps_in_the_queued_at_write(self, cluster):
+        """The ownership stamp rides the admission patch — entering a
+        shard's queue costs no extra write."""
+        make_pool(cluster, "v4", "2x2x2", "pool-v4")
+        # no capacity for a second gang: it queues (stays unbound) and the
+        # stamp must still be there, from the same write as queued-at
+        cluster.create(_nb("a"))
+        cluster.create(_nb("b"))
+        rec = _sched(shards=2, shard_id=ShardRouter(2).shard_for_family("v4"))
+        rec.reconcile(cluster, "", FLEET_KEY)
+        queued = [
+            nb for nb in cluster.list("Notebook")
+            if sched.placement_of(nb) is None
+        ]
+        assert len(queued) == 1
+        anns = queued[0]["metadata"]["annotations"]
+        assert sched.QUEUED_AT_ANNOTATION in anns
+        assert sharding.parse_owner(anns[SHARD_ANNOTATION]) is not None
+
+    def test_reshard_adopts_orphans_and_keeps_seniority(self, cluster):
+        """Shard-count change 1→2: gangs stamped by the old generation are
+        re-stamped by their new owner in one write; a queued gang keeps its
+        queued-at (seniority survives resharding), a bound gang keeps its
+        placement untouched."""
+        make_pool(cluster, "v4", "2x2x2", "pool-v4")
+        cluster.create(_nb("bound"))
+        cluster.create(_nb("waiting"))
+        old = _sched(shards=1, shard_id=0)
+        old.reconcile(cluster, "", FLEET_KEY)
+        bound = cluster.get("Notebook", "bound", NS)
+        waiting = cluster.get("Notebook", "waiting", NS)
+        assert sharding.owner_of(bound) == (1, 0)
+        placement_before = bound["metadata"]["annotations"][
+            sched.PLACEMENT_ANNOTATION]
+        queued_at_before = waiting["metadata"]["annotations"][
+            sched.QUEUED_AT_ANNOTATION]
+
+        router = ShardRouter(2)
+        new_owner = router.shard_for_family("v4")
+        rec = _sched(shards=2, shard_id=new_owner)
+        rec.reconcile(cluster, "", FLEET_KEY)
+        bound = cluster.get("Notebook", "bound", NS)
+        waiting = cluster.get("Notebook", "waiting", NS)
+        assert sharding.owner_of(bound) == (2, new_owner)
+        assert sharding.owner_of(waiting) == (2, new_owner)
+        assert bound["metadata"]["annotations"][
+            sched.PLACEMENT_ANNOTATION] == placement_before
+        assert waiting["metadata"]["annotations"][
+            sched.QUEUED_AT_ANNOTATION] == queued_at_before
+        assert audit_shards(cluster, router) == []
+        # the NON-owner shard under the new generation never adopts
+        foreign = _sched(shards=2, shard_id=1 - new_owner)
+        foreign.reconcile(cluster, "", FLEET_KEY)
+        assert sharding.owner_of(
+            cluster.get("Notebook", "bound", NS)) == (2, new_owner)
+
+    def test_family_edit_moves_gang_to_its_new_owner_shard(self, cluster):
+        """A kubectl edit of spec.tpu moving a queued gang across families:
+        the new owner adopts it (stamp + family-label heal in one write)
+        and schedules it with its preserved seniority; the old owner drops
+        it from its off-index polling instead of tracking it forever."""
+        router, s_v4, s_v5e = _two_family_world(cluster)
+        cluster.delete("Notebook", "g-v5e", NS)
+        # saturate the v4 pool so the second v4 gang queues
+        cluster.create(_nb("filler", accel="v4", topo="2x2x2"))
+        old_owner = _sched(shards=2, shard_id=s_v4)
+        new_owner = _sched(shards=2, shard_id=s_v5e)
+        old_owner.reconcile(cluster, "", FLEET_KEY)
+        g = cluster.get("Notebook", "g-v4", NS)
+        queued_at = g["metadata"]["annotations"].get(
+            sched.QUEUED_AT_ANNOTATION
+        ) or cluster.get("Notebook", "filler", NS)["metadata"][
+            "annotations"][sched.QUEUED_AT_ANNOTATION]
+        # whichever of the two queued: edit g-v4 (bound or queued) to v5e
+        cluster.patch("Notebook", "g-v4", NS, {"spec": {"tpu": {
+            "accelerator": "v5e", "topology": "2x4"}}})
+        # old owner: releases any stale-shape placement, stops tracking
+        old_owner.reconcile(cluster, "", FLEET_KEY)
+        old_owner.reconcile(cluster, "", FLEET_KEY)
+        # new owner: its watch would hint the edit event; simulate delivery
+        list(new_owner._map_owned_notebook(
+            cluster.get("Notebook", "g-v4", NS)))
+        new_owner.reconcile(cluster, "", FLEET_KEY)
+        new_owner.reconcile(cluster, "", FLEET_KEY)
+        g = cluster.get("Notebook", "g-v4", NS)
+        assert sharding.owner_of(g) == (2, s_v5e)
+        assert g["metadata"]["labels"][sharding.FAMILY_LABEL] == "v5e"
+        assert sched.placement_of(g) is not None  # bound in the v5e pool
+        assert all(
+            s["pool"] == "pool-v5e"
+            for s in sched.placement_of(g)["slices"]
+        )
+        assert audit_shards(cluster, router) == []
+        assert queued_at  # seniority existed and survived the move
+
+    def test_crash_between_stamp_write_and_first_owned_reconcile(self, cluster):
+        """The tentpole's first crash boundary: the adoption stamp lands,
+        the controller dies before reconciling anything it adopted. The
+        stamp is a claim, not state — the restarted shard (cold caches)
+        sees its own stamp, replays the CR annotations, and converges with
+        nothing lost and nothing double-stamped."""
+        make_pool(cluster, "v4", "2x2x2", "pool-v4")
+        cluster.create(_nb("g"))
+        _sched(shards=1, shard_id=0).reconcile(cluster, "", FLEET_KEY)
+        g = cluster.get("Notebook", "g", NS)
+        placement_before = g["metadata"]["annotations"][
+            sched.PLACEMENT_ANNOTATION]
+
+        chaos = ChaosCluster(cluster, seed=1, config=ChaosConfig.quiet())
+        router = ShardRouter(2)
+        owner = router.shard_for_family("v4")
+        rec = _sched(shards=2, shard_id=owner)
+        chaos.arm_crash(after_writes=1)  # the adoption stamp IS write #1:
+        # the controller dies on its next API call after the stamp lands
+        try:
+            rec.reconcile(chaos, "", FLEET_KEY)
+            rec.reconcile(chaos, "", FLEET_KEY)
+        except Exception:
+            pass
+        assert chaos.take_crash(), "the armed crash never fired"
+        g = cluster.get("Notebook", "g", NS)
+        assert sharding.owner_of(g) == (2, owner)  # stamp committed...
+        chaos.heal()
+        fresh = _sched(shards=2, shard_id=owner)  # ...incarnation restarts
+        fresh.reconcile(chaos, "", FLEET_KEY)
+        fresh.reconcile(chaos, "", FLEET_KEY)
+        g = cluster.get("Notebook", "g", NS)
+        assert g["metadata"]["annotations"][
+            sched.PLACEMENT_ANNOTATION] == placement_before
+        assert audit_shards(cluster, router) == []
+
+    def test_reshard_mid_suspend_handoff_releases_under_new_owner(self, cluster):
+        """The tentpole's second crash boundary: a preemption suspend
+        handoff is in flight (victim holds chips behind the barrier) when
+        the shard count changes. The new owner adopts BOTH gangs and drives
+        the handoff to its commit point from the annotations alone: ack →
+        ONE write releasing placement + retiring the request → preemptor
+        bound. No chips were ever double-visible across the reshard."""
+        import json as _json
+
+        clock_t = [1_000_000.0]
+        clock = lambda: clock_t[0]  # noqa: E731
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        cfg = ControllerConfig(scheduler_enabled=True, sessions_enabled=True)
+        mgr = Manager(cluster, clock=clock)
+        mgr.register(NotebookReconciler(cfg, clock=clock))
+        old = SchedulerReconciler(
+            clock=clock, suspend_deadline_s=120.0,
+            families=ShardRouter(1).families_for(0),
+            router=ShardRouter(1), shard_id=0,
+        )
+        mgr.register(old)
+        cluster.create(_nb("victim"))
+        cluster.settle(mgr)
+        victim = cluster.get("Notebook", "victim", NS)
+        assert sched.placement_of(victim) is not None
+        cluster.create(_nb(
+            "urgent", annotations={sched.PRIORITY_ANNOTATION: "10"}
+        ))
+        cluster.settle(mgr)
+        victim = cluster.get("Notebook", "victim", NS)
+        req = sess.suspend_request(victim)
+        assert req is not None  # the barrier holds under the OLD generation
+        assert sharding.owner_of(victim) == (1, 0)
+
+        # --- reshard: the old generation stands down, 2 shards take over
+        mgr.shutdown()
+        router = ShardRouter(2)
+        owner = router.shard_for_family("v4")
+        mgr2 = Manager(cluster, clock=clock)
+        mgr2.register(NotebookReconciler(cfg, clock=clock))
+        new = SchedulerReconciler(
+            clock=clock, suspend_deadline_s=120.0,
+            families=router.families_for(owner),
+            router=router, shard_id=owner,
+        )
+        mgr2.register(new)
+        cluster.settle(mgr2)
+        victim = cluster.get("Notebook", "victim", NS)
+        assert sharding.owner_of(victim) == (2, owner)  # adopted mid-handoff
+        assert sess.suspend_request(victim) is not None  # barrier preserved
+        assert sched.placement_of(victim) is not None    # chips still held
+
+        # the sessions side acks a committed snapshot (as its controller
+        # would); the NEW owner must complete the handoff it never started
+        cluster.patch("Notebook", "victim", NS, {"metadata": {"annotations": {
+            sess.SNAPSHOT_ANNOTATION: _json.dumps({
+                "snapshotId": "snap-1", "digest": "d" * 64,
+                "committedAt": clock(), "queuedAt": _json.loads("0"),
+            }, sort_keys=True),
+            sess.STATE_ANNOTATION: sess.STATE_SUSPENDED,
+        }}})
+        for _ in range(4):
+            clock_t[0] += 10.0
+            cluster.settle(mgr2)
+        victim = cluster.get("Notebook", "victim", NS)
+        urgent = cluster.get("Notebook", "urgent", NS)
+        assert sched.placement_of(victim) is None
+        assert sess.suspend_request(victim) is None  # retired in one write
+        assert sched.placement_of(urgent) is not None
+        assert audit_shards(cluster, router) == []

@@ -44,6 +44,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="arm the data-plane telemetry pipeline: fake "
                          "in-pod agents, fleet collector, duty-cycle "
                          "culling, and the telemetry audit (docs/chaos.md)")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="run the SHARDED control plane: N namespace-hash "
+                         "manager shards over one store, notebooks spread "
+                         "across namespaces, one shard's leader killed "
+                         "every round; the faulted run must converge to "
+                         "the equally-sharded fault-free fixed point. "
+                         "1 = the historical single-loop run")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="per-seed lines; on failure, a fixed-point diff")
     args = ap.parse_args(argv)
@@ -67,7 +74,9 @@ def main(argv: list[str] | None = None) -> int:
     total_faults = 0
     total_restarts = 0
     for seed in seeds:
-        result = run_seed(seed, cfg, telemetry=args.telemetry)
+        result = run_seed(
+            seed, cfg, telemetry=args.telemetry, shards=args.shards
+        )
         total_faults += sum(result.fault_counts.values())
         total_restarts += result.restarts
         if result.ok:
@@ -77,7 +86,9 @@ def main(argv: list[str] | None = None) -> int:
             failures += 1
             print(result.describe())
             if args.verbose and not result.converged:
-                print(diff_states(seed, cfg, telemetry=args.telemetry))
+                print(diff_states(
+                    seed, cfg, telemetry=args.telemetry, shards=args.shards
+                ))
     n = len(list(seeds))
     dt = time.monotonic() - t0
     print(

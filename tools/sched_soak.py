@@ -41,6 +41,14 @@ def main(argv: list[str] | None = None) -> int:
                     help="override ChaosConfig.error_rate")
     ap.add_argument("--crash-rate", type=float, default=None,
                     help="override ChaosConfig.crash_rate")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="run the SHARDED control plane: N per-family "
+                         "scheduler shards + namespace-hash manager shards "
+                         "over one store, one shard's leader killed every "
+                         "round; adds the cross-shard audit (ownership "
+                         "stamps converged, zero cross-family binds, zero "
+                         "cross-shard double-booking). 1 = the historical "
+                         "single-loop run (docs/architecture.md)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print a line per seed, not just failures")
     args = ap.parse_args(argv)
@@ -66,7 +74,7 @@ def main(argv: list[str] | None = None) -> int:
     failures = 0
     binds = preemptions = restarts = faults = 0
     for seed in seeds:
-        result = run_sched_seed(seed, cfg)
+        result = run_sched_seed(seed, cfg, shards=args.shards)
         binds += result.binds
         preemptions += result.preemptions
         restarts += result.restarts

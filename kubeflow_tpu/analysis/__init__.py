@@ -1,0 +1,27 @@
+"""tpulint: project-invariant static analysis (docs/analysis.md).
+
+The AST engine, the five project rule families (TPU001-TPU005), and the
+justified-baseline machinery behind ``tools/tpulint.py``. The dynamic half
+of the same program — the lost-update race detector — lives with the chaos
+layer in ``kubeflow_tpu/testing/chaos.py``.
+"""
+from kubeflow_tpu.analysis.engine import (
+    Baseline,
+    BaselineEntry,
+    BaselineResult,
+    Finding,
+    LintEngine,
+    Rule,
+)
+from kubeflow_tpu.analysis.rules import RULE_IDS, default_rules
+
+__all__ = [
+    "Baseline",
+    "BaselineEntry",
+    "BaselineResult",
+    "Finding",
+    "LintEngine",
+    "Rule",
+    "RULE_IDS",
+    "default_rules",
+]

@@ -1,0 +1,415 @@
+"""tpulint engine: AST-based project-invariant lint with a justified baseline.
+
+Eleven PRs of control-plane growth rest on invariants that were, until now,
+enforced only dynamically — by thousand-seed chaos soaks that catch
+violations late (PR 10's seed-replay nondeterminism from uuid4-keyed fault
+draws, PR 4's ack-loss race, PR 2's double-booking all shipped first and
+were caught by soak luck). This package moves the machine-checkable part of
+those contracts to commit time, the way TensorFlow moved graph invariants
+into static validation (PAPERS.md):
+
+- :class:`Rule` subclasses (``analysis/rules/``) each codify ONE project
+  invariant as an AST check, with an id (TPU001..TPU005), a one-line
+  invariant statement, and a rationale linking back to the soak/PR that
+  motivated it (``tools/tpulint.py --explain TPU001``);
+- :class:`LintEngine` parses each file once and fans the tree out to every
+  applicable rule; rules may also carry cross-file state resolved in
+  :meth:`Rule.finalize` (TPU005's registered-once check needs the whole
+  tree);
+- :class:`Baseline` grandfathers pre-existing findings: a committed JSON
+  file maps finding fingerprints (line-number independent) to one-line
+  justifications. A finding not in the baseline fails the build; a baseline
+  entry whose finding disappeared is STALE and also fails the build (the
+  baseline can only shrink or be consciously re-justified); an entry with
+  an empty justification is rejected. ``--update-baseline`` rewrites the
+  file from the current tree, preserving existing justifications;
+- inline suppression: ``# tpulint: disable=TPU001 — <why>`` on the
+  offending line suppresses that rule there. The justification text is
+  REQUIRED — a bare pragma suppresses nothing.
+
+Stdlib-only (the astlint precedent: a gate nobody can run locally rots).
+Static analysis is necessarily approximate; every rule documents what it
+can and cannot see in its ``--explain`` text, and the chaos soaks keep the
+dynamic half of each contract (docs/analysis.md).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import re
+from pathlib import Path
+from typing import Iterable, Sequence
+
+# one pragma grammar everywhere: "# tpulint: disable=TPU001[,TPU002] — why"
+PRAGMA_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Z0-9,]+)\s*(?:[-—–:]+\s*)?(.*?)\s*$"
+)
+
+SKIP_DIR_PARTS = {"__pycache__", ".git", "node_modules"}
+
+# the default scan: the package plus every production-adjacent script dir,
+# so cross-file rules (TPU005's registered-once check) really do see the
+# whole tree a process could import at runtime
+DEFAULT_SCAN_DIRS = ("kubeflow_tpu", "tools", "benchmarks", "loadtest")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    The fingerprint deliberately excludes the line number: moving code must
+    not churn the baseline. It hashes (rule, path, enclosing qualname,
+    message); messages therefore name symbols, never positions.
+    """
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    context: str = "<module>"
+
+    @property
+    def fingerprint(self) -> str:
+        raw = f"{self.rule}|{self.path}|{self.context}|{self.message}"
+        return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "context": self.context,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: {self.rule} {self.message} "
+            f"[{self.context}] {{{self.fingerprint}}}"
+        )
+
+
+class Rule:
+    """One project invariant as an AST check.
+
+    Subclasses set the class attributes and implement :meth:`check`. Rules
+    are stateful per engine run (TPU005 accumulates registrations across
+    files); construct fresh instances per run via :func:`default_rules`.
+    """
+
+    id: str = ""
+    title: str = ""
+    invariant: str = ""       # one line: what must hold
+    rationale: str = ""       # why: the soak/PR that motivated it
+    approximation: str = ""   # what the static check can and cannot see
+
+    def applies_to(self, path: str) -> bool:
+        return path.startswith("kubeflow_tpu/")
+
+    def check(self, path: str, tree: ast.Module, source: str) -> list[Finding]:
+        raise NotImplementedError
+
+    def finalize(self) -> list[Finding]:
+        """Cross-file findings, reported once after every file was checked."""
+        return []
+
+    @classmethod
+    def explain(cls) -> str:
+        lines = [
+            f"{cls.id} — {cls.title}",
+            "",
+            f"Invariant: {cls.invariant}",
+            "",
+            f"Why: {cls.rationale}",
+        ]
+        if cls.approximation:
+            lines += ["", f"Approximation: {cls.approximation}"]
+        lines += [
+            "",
+            "Suppress: add the finding's fingerprint to the committed",
+            "baseline (tools/tpulint.py --update-baseline, then fill in a",
+            "one-line justification), or inline on the offending line:",
+            f"  # tpulint: disable={cls.id} — <why this site is exempt>",
+            "Both forms REQUIRE the justification text (docs/analysis.md).",
+        ]
+        return "\n".join(lines)
+
+
+def annotate_parents(tree: ast.AST) -> None:
+    """One parent-link pass per parsed file, done by the engine before any
+    rule runs — rules' ``qualname_of`` walks these links."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._tpulint_parent = node  # type: ignore[attr-defined]
+
+
+def parse_pragmas(source: str) -> dict[int, tuple[set[str], str]]:
+    """``{line: (rule_ids, justification)}`` for every tpulint pragma."""
+    out: dict[int, tuple[set[str], str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = PRAGMA_RE.search(text)
+        if m:
+            out[i] = (set(m.group(1).split(",")), m.group(2).strip())
+    return out
+
+
+def _suppressed(finding: Finding, pragmas: dict[int, tuple[set[str], str]]) -> bool:
+    entry = pragmas.get(finding.line)
+    if entry is None:
+        return False
+    rules, justification = entry
+    # a pragma with no justification suppresses nothing — the rule catalog
+    # promises every exemption carries its why
+    return finding.rule in rules and bool(justification)
+
+
+class LintEngine:
+    """Parses each file once; fans the tree out to every applicable rule."""
+
+    def __init__(self, root: Path | str, rules: Sequence[Rule] | None = None) -> None:
+        self.root = Path(root)
+        if rules is None:
+            from kubeflow_tpu.analysis.rules import default_rules
+
+            rules = default_rules()
+        self.rules = list(rules)
+        self.parse_errors: list[Finding] = []
+        self.scanned_paths: set[str] = set()
+
+    # ------------------------------------------------------------- file walk
+
+    def iter_sources(self, paths: Sequence[str] | None = None) -> Iterable[tuple[str, str]]:
+        """Yield (repo-relative posix path, source) for every .py file."""
+        pairs = (
+            [(p, self.root / p) for p in paths]
+            if paths
+            else [
+                (d, self.root / d)
+                for d in DEFAULT_SCAN_DIRS
+                if (self.root / d).exists()
+            ]
+        )
+        for given, target in pairs:
+            # a typo'd or out-of-tree path must not read as "0 findings,
+            # exit 0" — that would silently disable every gate while green
+            if not target.exists():
+                raise FileNotFoundError(
+                    f"tpulint: no such file or directory: {given}"
+                )
+            try:
+                target.relative_to(self.root)
+            except ValueError:
+                raise FileNotFoundError(
+                    f"tpulint: path is outside the repo root: {given}"
+                )
+            files = [target] if target.is_file() else sorted(target.rglob("*.py"))
+            for f in files:
+                if SKIP_DIR_PARTS.intersection(f.parts):
+                    continue
+                rel = f.relative_to(self.root).as_posix()
+                yield rel, f.read_text()
+
+    # ------------------------------------------------------------------- run
+
+    def run(
+        self,
+        paths: Sequence[str] | None = None,
+        only: set[str] | None = None,
+    ) -> list[Finding]:
+        return self.run_sources(self.iter_sources(paths), only=only)
+
+    def run_sources(
+        self,
+        sources: Iterable[tuple[str, str]],
+        only: set[str] | None = None,
+    ) -> list[Finding]:
+        """Lint in-memory (path, source) pairs — the engine's real entry
+        point; ``run`` feeds it from disk, tests feed planted fixtures."""
+        rules = [r for r in self.rules if only is None or r.id in only]
+        pragma_maps: dict[str, dict[int, tuple[set[str], str]]] = {}
+        findings: list[Finding] = []
+        self.parse_errors = []
+        self.scanned_paths = set()
+        for rel, source in sources:
+            self.scanned_paths.add(rel)
+            applicable = [r for r in rules if r.applies_to(rel)]
+            if not applicable:
+                continue
+            try:
+                tree = ast.parse(source, filename=rel)
+            except SyntaxError as e:
+                # a file that does not parse is astlint/ruff's finding, not
+                # ours — but silently skipping it would hide every invariant
+                # in it, so surface it as an engine-level parse error
+                self.parse_errors.append(
+                    Finding("PARSE", rel, e.lineno or 0, f"syntax error: {e.msg}")
+                )
+                continue
+            annotate_parents(tree)
+            pragmas = parse_pragmas(source)
+            pragma_maps[rel] = pragmas
+            for rule in applicable:
+                for f in rule.check(rel, tree, source):
+                    if not _suppressed(f, pragmas):
+                        findings.append(f)
+        for rule in rules:
+            for f in rule.finalize():
+                if not _suppressed(f, pragma_maps.get(f.path, {})):
+                    findings.append(f)
+        findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+        return findings
+
+
+# ------------------------------------------------------------------ baseline
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    fingerprint: str
+    rule: str
+    path: str
+    context: str
+    message: str
+    justification: str = ""
+    # identical violations in one context share a fingerprint (it is
+    # line-independent by design); the count pins HOW MANY are
+    # grandfathered, so adding one more identical violation next to a
+    # baselined one still fails the gate
+    count: int = 1
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class BaselineResult:
+    new: list[Finding]              # findings with no baseline entry
+    matched: list[Finding]          # grandfathered findings
+    stale: list[BaselineEntry]      # entries whose finding disappeared
+    unjustified: list[BaselineEntry]  # matched entries missing their why
+
+    @property
+    def clean(self) -> bool:
+        return not (self.new or self.stale or self.unjustified)
+
+
+class Baseline:
+    """Committed set of grandfathered findings, each with a justification.
+
+    The contract (docs/analysis.md): the baseline can only shrink or be
+    consciously re-justified. New findings fail; stale entries fail (fixing
+    a finding must delete its entry, or the file rots into an allowlist of
+    things that no longer exist); empty justifications fail.
+    """
+
+    def __init__(self, entries: Iterable[BaselineEntry] = ()) -> None:
+        self.entries: dict[str, BaselineEntry] = {
+            e.fingerprint: e for e in entries
+        }
+
+    @classmethod
+    def load(cls, path: Path | str) -> "Baseline":
+        p = Path(path)
+        if not p.exists():
+            return cls()
+        data = json.loads(p.read_text())
+        return cls(
+            BaselineEntry(**e) for e in data.get("entries", [])
+        )
+
+    def save(self, path: Path | str) -> None:
+        entries = sorted(
+            self.entries.values(), key=lambda e: (e.rule, e.path, e.message)
+        )
+        Path(path).write_text(
+            json.dumps(
+                {"version": 1, "entries": [e.to_dict() for e in entries]},
+                indent=1,
+            )
+            + "\n"
+        )
+
+    def apply(
+        self,
+        findings: Sequence[Finding],
+        only: set[str] | None = None,
+        paths: set[str] | None = None,
+    ) -> BaselineResult:
+        """``only``/``paths`` scope STALENESS the same way they scoped the
+        run: an entry whose rule was not run, or whose file was not
+        scanned, cannot be judged gone — only the full-tree run (CI's
+        gate) can shrink the baseline.
+
+        Counts are exact per fingerprint: an entry grandfathers exactly
+        ``count`` identical findings — the (count+1)th identical violation
+        is NEW, and a count that shrank makes the entry stale (fixing one
+        of three must re-record, or the headroom silently grandfathers a
+        future regression)."""
+        current: dict[str, int] = {}
+        for f in findings:
+            current[f.fingerprint] = current.get(f.fingerprint, 0) + 1
+        new, matched = [], []
+        used: dict[str, int] = {}
+        for f in findings:
+            entry = self.entries.get(f.fingerprint)
+            used[f.fingerprint] = used.get(f.fingerprint, 0) + 1
+            if entry is not None and used[f.fingerprint] <= entry.count:
+                matched.append(f)
+            else:
+                new.append(f)
+        stale = [
+            e
+            for fp, e in sorted(self.entries.items())
+            if current.get(fp, 0) < e.count
+            and (only is None or e.rule in only)
+            and (paths is None or e.path in paths)
+        ]
+        unjustified = [
+            self.entries[fp]
+            for fp in sorted({f.fingerprint for f in matched})
+            if not self.entries[fp].justification.strip()
+        ]
+        return BaselineResult(new, matched, stale, unjustified)
+
+    def updated_with(
+        self,
+        findings: Sequence[Finding],
+        paths: set[str] | None = None,
+        only: set[str] | None = None,
+    ) -> "Baseline":
+        """The ``--update-baseline`` rewrite: one entry per current finding,
+        preserving the justification of entries that still match (new ones
+        get an empty justification the operator must fill in — an empty
+        justification fails the next run, so the TODO cannot ship silently).
+        Entries outside the run's scope — a file not in ``paths``, a rule
+        not in ``only`` — are kept verbatim: a scoped update must not
+        silently ungrandfather (and unjustify) the rest of the tree."""
+        out = [
+            e for e in self.entries.values()
+            if (paths is not None and e.path not in paths)
+            or (only is not None and e.rule not in only)
+        ]
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+        seen: set[str] = set()
+        for f in findings:
+            if f.fingerprint in seen:
+                continue
+            seen.add(f.fingerprint)
+            prev = self.entries.get(f.fingerprint)
+            out.append(
+                BaselineEntry(
+                    fingerprint=f.fingerprint,
+                    rule=f.rule,
+                    path=f.path,
+                    context=f.context,
+                    message=f.message,
+                    justification=prev.justification if prev else "",
+                    count=counts[f.fingerprint],
+                )
+            )
+        return Baseline(out)

@@ -1,0 +1,85 @@
+"""tpulint rule families: one module per project invariant.
+
+Shared AST helpers live here; each rule module imports them. The registry
+(:func:`default_rules`) constructs FRESH rule instances per engine run —
+TPU005 accumulates cross-file state, so instances must not be reused.
+"""
+from __future__ import annotations
+
+import ast
+
+# ------------------------------------------------------------- AST helpers
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, None for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def chain_parts(node: ast.AST) -> list[str]:
+    """Attribute-chain parts left to right (``cluster.inner.patch`` →
+    ``["cluster", "inner", "patch"]``); empty when the root is dynamic."""
+    d = dotted(node)
+    return d.split(".") if d else []
+
+
+def qualname_of(node: ast.AST) -> str:
+    """Enclosing ``Class.method`` / ``function`` qualname (the engine
+    annotates parent links once per parsed file, before any rule runs)."""
+    parts: list[str] = []
+    cur = getattr(node, "_tpulint_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            parts.append(cur.name)
+        cur = getattr(cur, "_tpulint_parent", None)
+    return ".".join(reversed(parts)) or "<module>"
+
+
+def reconciler_classes(tree: ast.Module) -> list[ast.ClassDef]:
+    """Classes that define a ``reconcile`` method — the reconciler shape
+    TPU002/TPU003 scope to (subclassing is invisible across modules to a
+    single-file AST pass; defining reconcile() is the honest local signal)."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and any(
+            isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and item.name == "reconcile"
+            for item in node.body
+        ):
+            out.append(node)
+    return out
+
+
+def const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# --------------------------------------------------------------- registry
+
+
+def default_rules():
+    from kubeflow_tpu.analysis.rules.annotations import AnnotationLiteralRule
+    from kubeflow_tpu.analysis.rules.determinism import DeterminismRule
+    from kubeflow_tpu.analysis.rules.metrics_rules import MetricsRegistrationRule
+    from kubeflow_tpu.analysis.rules.reconcile_io import ReconcileIORule
+    from kubeflow_tpu.analysis.rules.write_surface import WriteSurfaceRule
+
+    return [
+        DeterminismRule(),
+        WriteSurfaceRule(),
+        ReconcileIORule(),
+        AnnotationLiteralRule(),
+        MetricsRegistrationRule(),
+    ]
+
+
+RULE_IDS = ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005")

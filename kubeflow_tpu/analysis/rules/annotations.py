@@ -1,0 +1,81 @@
+"""TPU004: kubeflow.org annotation/label keys are imported constants."""
+from __future__ import annotations
+
+import ast
+import re
+
+from kubeflow_tpu.analysis.engine import Finding, Rule
+from kubeflow_tpu.analysis.rules import qualname_of
+
+# a key-shaped literal: <prefix>.kubeflow.org/<name>. The bare apiGroup form
+# ("kubeflow.org/v1") has no subdomain and never names an annotation key.
+KEY_RE = re.compile(r"^[a-z0-9-]+(\.[a-z0-9-]+)*\.kubeflow\.org/[A-Za-z0-9._/-]+$")
+
+# "tensorboard.kubeflow.org/v1alpha1" is an apiVersion VALUE, not a key
+VERSION_SEGMENT_RE = re.compile(r"^v\d+((alpha|beta)\d+)?$")
+
+CONST_NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+
+
+class AnnotationLiteralRule(Rule):
+    id = "TPU004"
+    title = "annotation keys are named constants"
+    invariant = (
+        "every *.kubeflow.org/* annotation or label key appears exactly "
+        "once as a module-level UPPER_CASE constant; all other sites "
+        "import that constant"
+    )
+    rationale = (
+        "these keys are crash-safe wire contracts: the suspend barrier, the "
+        "bind annotation, the sharding ownership stamp, and the timeline "
+        "marks all survive controller restarts ONLY because reader and "
+        "writer agree on the key byte-for-byte. A retyped literal fails "
+        "silently — the reader just never sees the state — and the soaks "
+        "surface it as a convergence mystery instead of a grep-able "
+        "constant (the sessions/sharding/timeline contracts all centralize "
+        "keys for exactly this reason)."
+    )
+    approximation = (
+        "matches string literals shaped like <subdomain>.kubeflow.org/<name> "
+        "anywhere except the right-hand side of a module-level UPPER_CASE "
+        "assignment. ApiVersion values (path segment v1/v1beta1/...) are "
+        "exempt. Keys built with f-strings or concatenation are invisible; "
+        "so are literals for other API groups."
+    )
+
+    def check(self, path: str, tree: ast.Module, source: str) -> list[Finding]:
+        exempt: set[int] = set()
+        for node in tree.body:
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if all(
+                isinstance(t, ast.Name) and CONST_NAME_RE.match(t.id)
+                for t in targets
+            ):
+                for sub in ast.walk(value):
+                    exempt.add(id(sub))
+        out: list[Finding] = []
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant) and isinstance(node.value, str)):
+                continue
+            if id(node) in exempt or not KEY_RE.match(node.value):
+                continue
+            segment = node.value.split("/", 1)[1].split("/", 1)[0]
+            if VERSION_SEGMENT_RE.match(segment):
+                continue
+            out.append(
+                Finding(
+                    self.id, path, node.lineno,
+                    f'bare annotation key "{node.value}" — import the '
+                    f"module-level constant that owns this wire contract",
+                    qualname_of(node),
+                )
+            )
+        return out

@@ -1,0 +1,138 @@
+"""TPU001: seeded-world determinism in the control plane's replayable core."""
+from __future__ import annotations
+
+import ast
+
+from kubeflow_tpu.analysis.engine import Finding, Rule
+from kubeflow_tpu.analysis.rules import dotted, qualname_of
+
+# directories whose behavior must replay bit-identically from a seed: the
+# chaos/sched/sessions soaks promise "any failure reproduces from its printed
+# seed alone", which is only true while every draw and every timestamp flows
+# from the injected clock / seeded RNG
+SCOPED_DIRS = (
+    "kubeflow_tpu/scheduler/",
+    "kubeflow_tpu/sessions/",
+    "kubeflow_tpu/runtime/",
+    "kubeflow_tpu/testing/",
+)
+
+WALL_CLOCK_CALLS = {
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.process_time",
+}
+
+DATETIME_CALLS = {
+    "datetime.datetime.now",
+    "datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.today",
+    "datetime.date.today",
+    "date.today",
+}
+
+UUID_CALLS = {"uuid.uuid4", "uuid.uuid1"}
+
+# module-level draws consume global (unseeded) state; drawing from a named
+# random.Random(seed) stream is the sanctioned form
+RANDOM_DRAWS = {
+    "random." + f
+    for f in (
+        "random", "uniform", "randint", "randrange", "choice", "choices",
+        "shuffle", "sample", "gauss", "betavariate", "expovariate",
+        "normalvariate", "lognormvariate", "vonmisesvariate", "paretovariate",
+        "weibullvariate", "triangular", "getrandbits", "randbytes", "seed",
+    )
+}
+
+
+class DeterminismRule(Rule):
+    id = "TPU001"
+    title = "seeded-world determinism"
+    invariant = (
+        "scheduler/, sessions/, runtime/, and testing/ never read the wall "
+        "clock, draw from unseeded RNG state, mint uuids, or iterate an "
+        "unordered set — time comes from the injected clock parameter, "
+        "randomness from a named random.Random(seed) stream, iteration "
+        "order from sorted()"
+    )
+    rationale = (
+        "the soaks' whole contract is seed-replay (docs/chaos.md): PR 10 "
+        "shipped a latent nondeterminism where store-fault draws were keyed "
+        "on uuid4-bearing object keys, so two runs of the same seed drew "
+        "different faults — found by luck, fixed by hand. This rule makes "
+        "that class of bug a commit-time failure."
+    )
+    approximation = (
+        "flags direct CALLS (time.time(), random.uniform(), uuid.uuid4(), "
+        "datetime.now()) and iteration whose target is literally a set "
+        "display/comprehension or set()/frozenset() call. Bare references "
+        "(clock: Callable = time.time as a default parameter) are the "
+        "injection seam itself and pass; draws on a local rng variable "
+        "(rng.random()) pass — the seeded-stream discipline is enforced at "
+        "the construction site (random.Random() with no seed is flagged)."
+    )
+
+    def applies_to(self, path: str) -> bool:
+        return path.startswith(SCOPED_DIRS)
+
+    def check(self, path: str, tree: ast.Module, source: str) -> list[Finding]:
+        out: list[Finding] = []
+
+        def flag(node: ast.AST, message: str) -> None:
+            out.append(
+                Finding(
+                    self.id, path, getattr(node, "lineno", 0), message,
+                    qualname_of(node),
+                )
+            )
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name in WALL_CLOCK_CALLS:
+                    flag(node, f"wall-clock call {name}() — take the clock "
+                               f"as an injected parameter")
+                elif name in DATETIME_CALLS:
+                    flag(node, f"wall-clock call {name}() — derive "
+                               f"timestamps from the injected clock")
+                elif name in UUID_CALLS:
+                    flag(node, f"{name}() mints a nondeterministic id — "
+                               f"derive ids from seeded/content state")
+                elif name in RANDOM_DRAWS:
+                    flag(node, f"{name}() draws from the global RNG — draw "
+                               f"from a named random.Random(seed) stream")
+                elif name == "random.Random" and not (node.args or node.keywords):
+                    flag(node, "random.Random() without a seed — name the "
+                               "seed so the stream replays")
+                elif name == "random.SystemRandom":
+                    flag(node, "random.SystemRandom is entropy-backed and "
+                               "can never replay from a seed")
+            iter_expr = None
+            if isinstance(node, ast.For):
+                iter_expr = node.iter
+            elif isinstance(node, ast.comprehension):
+                iter_expr = node.iter
+            if iter_expr is not None and _is_unordered(iter_expr):
+                flag(
+                    iter_expr,
+                    "iteration over an unordered set — wrap in sorted() so "
+                    "visit order replays from the seed",
+                )
+        return out
+
+
+def _is_unordered(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = dotted(node.func)
+        return name in ("set", "frozenset")
+    return False

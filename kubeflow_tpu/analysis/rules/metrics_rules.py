@@ -1,0 +1,158 @@
+"""TPU005: metric families registered once, with valid names and labels."""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from kubeflow_tpu.analysis.engine import Finding, Rule
+from kubeflow_tpu.analysis.rules import const_str, qualname_of
+
+REGISTER_ATTRS = {"counter", "gauge", "histogram"}
+
+METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+@dataclasses.dataclass
+class _Registration:
+    path: str
+    line: int
+    context: str
+    kind: str
+    labels: tuple[str, ...] | None  # None = schema frozen at first use
+
+
+class MetricsRegistrationRule(Rule):
+    id = "TPU005"
+    title = "metric families registered once, labels validated"
+    invariant = (
+        "every registry.counter/gauge/histogram(...) family name is a valid "
+        "Prometheus identifier, its declared label names are valid and not "
+        "__-reserved, and no family name is registered twice with a "
+        "conflicting kind or label schema anywhere in the tree"
+    )
+    rationale = (
+        "the Registry dedups identical re-registration (two apps sharing a "
+        "registry) but a conflicting schema raises at RUNTIME — wherever "
+        "the second process happens to start, which is how a sharded and an "
+        "unsharded collector on one registry once let a crash-every-cycle "
+        "scheduler look green. This folds the CI metrics-lint step into the "
+        "analyzer: the exposition-grammar half stays dynamic "
+        "(tests/test_metrics_exposition.py in the pytest sweep); the "
+        "registration-discipline half is static and fails at commit time."
+    )
+    approximation = (
+        "sees registrations whose family name is a string literal at a "
+        ".counter/.gauge/.histogram call (wrappers forwarding a name "
+        "variable, like the shard scope, are checked at their literal call "
+        "sites). Labelnames are validated when passed as a literal "
+        "list/tuple; identical duplicate registrations are allowed — only "
+        "kind/schema conflicts fail. The schema comparison is "
+        "order-sensitive, exactly like the runtime Registry's."
+    )
+
+    def __init__(self) -> None:
+        self._families: dict[str, list[_Registration]] = {}
+
+    def applies_to(self, path: str) -> bool:
+        # cross-file registered-once needs the WHOLE scanned tree — a
+        # tools/ or benchmarks/ script sharing a registry with the package
+        # is exactly the second-process conflict the rationale cites
+        return path.endswith(".py")
+
+    def check(self, path: str, tree: ast.Module, source: str) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in REGISTER_ATTRS
+                and node.args
+            ):
+                continue
+            name = const_str(node.args[0])
+            if name is None:
+                continue  # dynamic name: a forwarding wrapper, not a family
+            ctx = qualname_of(node)
+            if not METRIC_NAME_RE.match(name):
+                out.append(
+                    Finding(
+                        self.id, path, node.lineno,
+                        f'metric family "{name}" is not a valid Prometheus '
+                        f"metric name",
+                        ctx,
+                    )
+                )
+            labels = _label_names(node)
+            if labels is not None:
+                for label in labels:
+                    if not LABEL_NAME_RE.match(label) or label.startswith("__"):
+                        out.append(
+                            Finding(
+                                self.id, path, node.lineno,
+                                f'label "{label}" on family "{name}" is not '
+                                f"a valid (non-reserved) Prometheus label "
+                                f"name",
+                                ctx,
+                            )
+                        )
+            self._families.setdefault(name, []).append(
+                _Registration(path, node.lineno, ctx, node.func.attr, labels)
+            )
+        return out
+
+    def finalize(self) -> list[Finding]:
+        out: list[Finding] = []
+        for name, regs in sorted(self._families.items()):
+            first = regs[0]
+            for reg in regs[1:]:
+                if reg.kind != first.kind:
+                    out.append(
+                        Finding(
+                            self.id, reg.path, reg.line,
+                            f'family "{name}" registered as {reg.kind} here '
+                            f"but as {first.kind} in {first.path} "
+                            f"({first.context}) — one family, one kind",
+                            reg.context,
+                        )
+                    )
+                elif (
+                    reg.labels is not None
+                    and first.labels is not None
+                    # order-sensitive, like Registry._add: ["a","b"] vs
+                    # ["b","a"] raises at the second process's startup
+                    and tuple(reg.labels) != tuple(first.labels)
+                ):
+                    out.append(
+                        Finding(
+                            self.id, reg.path, reg.line,
+                            f'family "{name}" registered with labels '
+                            f"{list(reg.labels)} here but "
+                            f"{list(first.labels)} in {first.path} "
+                            f"({first.context}) — one registry, one schema "
+                            f"per family (label order included)",
+                            reg.context,
+                        )
+                    )
+        return out
+
+
+def _label_names(node: ast.Call) -> tuple[str, ...] | None:
+    expr = None
+    if len(node.args) >= 3:
+        expr = node.args[2]
+    for kw in node.keywords:
+        if kw.arg == "labelnames":
+            expr = kw.value
+    if expr is None or isinstance(expr, ast.Constant) and expr.value is None:
+        return None
+    if isinstance(expr, (ast.List, ast.Tuple)):
+        labels = []
+        for elt in expr.elts:
+            s = const_str(elt)
+            if s is None:
+                return None  # dynamic element: cannot verify statically
+            labels.append(s)
+        return tuple(labels)
+    return None

@@ -1,0 +1,125 @@
+"""TPU003: no blocking I/O or telemetry scrapes reachable from reconcile()."""
+from __future__ import annotations
+
+import ast
+
+from kubeflow_tpu.analysis.engine import Finding, Rule
+from kubeflow_tpu.analysis.rules import (
+    chain_parts,
+    dotted,
+    reconciler_classes,
+)
+
+# module roots whose calls mean the reconcile worker is waiting on a network
+# or process, holding its workqueue key the whole time
+BANNED_ROOTS = {
+    "socket", "requests", "urllib", "http", "subprocess", "ftplib",
+    "smtplib", "telnetlib", "shutil",
+}
+
+BANNED_CALLS = {"open", "time.sleep", "input"}
+
+# the telemetry collector's verbs; scraping from a reconcile was PR 5's
+# founding prohibition
+SCRAPE_ATTRS = {"collect", "scrape", "probe"}
+SCRAPE_RECEIVER_HINTS = ("collector", "telemetry", "prober")
+
+
+class ReconcileIORule(Rule):
+    id = "TPU003"
+    title = "reconcile bodies never block on I/O"
+    invariant = (
+        "no socket/HTTP/file/subprocess I/O, sleeps, or telemetry scrapes "
+        "are reachable from a reconcile() body through same-module calls — "
+        "slow externals run in dedicated loops (the fleet collector, the "
+        "culler's prober) and reconcilers read their in-memory results"
+    )
+    rationale = (
+        "a reconcile holds its workqueue key; one slow scrape inside it "
+        "head-of-line-blocks every queued event for that key and skews the "
+        "reconcile-duration SLO. PR 5 built the fleet collector around "
+        "exactly this rule (one parallel scrape pass per interval, NEVER on "
+        "the reconcile path) and the chaos soak asserts it dynamically per "
+        "tick; this makes the regression a commit-time failure."
+    )
+    approximation = (
+        "reachability is a same-module call graph: reconcile() plus "
+        "module-level functions and self.* methods it transitively calls. "
+        "Calls that cross modules are not followed (the soak's runtime "
+        "scrape-pass assertion covers those); receivers are matched by "
+        "name, so a collector bound to an innocuous local name passes "
+        "statically."
+    )
+
+    def check(self, path: str, tree: ast.Module, source: str) -> list[Finding]:
+        classes = reconciler_classes(tree)
+        if not classes:
+            return []
+        module_funcs = {
+            n.name: n
+            for n in tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        out: list[Finding] = []
+        for cls in classes:
+            methods = {
+                n.name: n
+                for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            entry = methods.get("reconcile")
+            if entry is None:
+                continue
+            # same-module reachability from reconcile(); `seen` is the
+            # revisit guard, `via` carries the call chain for the finding
+            frontier = [(entry, f"{cls.name}.reconcile")]
+            seen = {id(entry)}
+            while frontier:
+                fn, via = frontier.pop()
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = None
+                    label = None
+                    if isinstance(node.func, ast.Name):
+                        callee = module_funcs.get(node.func.id)
+                        label = node.func.id
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                    ):
+                        callee = methods.get(node.func.attr)
+                        label = f"{cls.name}.{node.func.attr}"
+                    if callee is not None and id(callee) not in seen:
+                        seen.add(id(callee))
+                        frontier.append((callee, f"{via} -> {label}"))
+                    out.extend(self._banned(path, node, via))
+        return out
+
+    def _banned(self, path: str, node: ast.Call, via: str) -> list[Finding]:
+        name = dotted(node.func)
+        findings: list[Finding] = []
+
+        def flag(message: str) -> None:
+            findings.append(Finding(self.id, path, node.lineno, message, via))
+
+        if name in BANNED_CALLS:
+            flag(f"{name}() on the reconcile path ({via}) — reconcilers "
+                 f"must not block; move it to a dedicated loop")
+        elif name is not None and name.split(".")[0] in BANNED_ROOTS:
+            flag(f"{name}(...) on the reconcile path ({via}) — network/"
+                 f"process I/O never runs inside a reconcile")
+        elif isinstance(node.func, ast.Attribute) and node.func.attr in SCRAPE_ATTRS:
+            parts = chain_parts(node.func)[:-1]
+            if any(
+                hint in part.lower()
+                for part in parts
+                for hint in SCRAPE_RECEIVER_HINTS
+            ):
+                flag(
+                    f"telemetry scrape {'.'.join(parts)}.{node.func.attr}() "
+                    f"on the reconcile path ({via}) — the collector runs in "
+                    f"its own loop; reconcilers read its in-memory store"
+                )
+        return findings

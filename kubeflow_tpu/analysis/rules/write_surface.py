@@ -1,0 +1,171 @@
+"""TPU002: cluster mutations flow through the traced client surface, once."""
+from __future__ import annotations
+
+import ast
+
+from kubeflow_tpu.analysis.engine import Finding, Rule
+from kubeflow_tpu.analysis.rules import (
+    chain_parts,
+    qualname_of,
+    reconciler_classes,
+)
+
+WRITE_VERBS = {
+    "create", "update", "update_status", "patch", "strategic_patch",
+    "delete", "finalize", "emit_event",
+}
+
+RAW_HANDLE_CTORS = {"FakeCluster", "KubeClient", "ChaosCluster"}
+
+STATUS_WRITE_VERBS = {"update_status"}
+
+
+class WriteSurfaceRule(Rule):
+    id = "TPU002"
+    title = "one traced write surface, one status write per path"
+    invariant = (
+        "reconcilers mutate the cluster only through the client surface "
+        "injected into reconcile() (the Manager passes the TracingCluster "
+        "wrapper): never through .inner, never through a handle they "
+        "construct themselves — and a single reconcile path issues at most "
+        "one status write to one object"
+    )
+    rationale = (
+        "the trace audit proves every write attributable to a reconcile "
+        "span, and the chaos layer injects faults, ONLY on the wrapped "
+        "surface — a write on a raw handle is invisible to both. The "
+        "one-write barrier is the bind/ack atomicity contract: PR 2's "
+        "double-booking and PR 4's ack-loss race were both cured by "
+        "collapsing multi-write sequences into ONE crash-safe write."
+    )
+    approximation = (
+        "scoped to files defining a class with a reconcile() method. "
+        "Raw-handle writes are caught at the .inner attribute chain and at "
+        "FakeCluster()/KubeClient() construction inside reconciler classes; "
+        "a handle smuggled through another module is invisible (the dynamic "
+        "trace audit still catches it per seed). The one-write check flags "
+        "two update_status calls on the same expression in one function "
+        "unless they sit in mutually exclusive branches of the same "
+        "if/try — write helpers called twice are not followed."
+    )
+
+    def check(self, path: str, tree: ast.Module, source: str) -> list[Finding]:
+        classes = reconciler_classes(tree)
+        if not classes:
+            return []
+        out: list[Finding] = []
+
+        # (a) writes that bypass the wrapped surface — anywhere in the file
+        # (module-level helpers are part of the reconcile path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                continue
+            parts = chain_parts(node.func)
+            if not parts or parts[-1] not in WRITE_VERBS:
+                continue
+            if "inner" in parts[:-1]:
+                out.append(
+                    Finding(
+                        self.id, path, node.lineno,
+                        f"write {'.'.join(parts)}(...) reaches through "
+                        f".inner — bypasses the traced/chaos client surface",
+                        qualname_of(node),
+                    )
+                )
+
+        # (b) raw handle construction inside a reconciler class
+        for cls in classes:
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Call):
+                    parts = chain_parts(node.func)
+                    if parts and parts[-1] in RAW_HANDLE_CTORS:
+                        out.append(
+                            Finding(
+                                self.id, path, node.lineno,
+                                f"{parts[-1]}(...) constructed inside "
+                                f"reconciler {cls.name} — use the client "
+                                f"surface injected into reconcile()",
+                                qualname_of(node),
+                            )
+                        )
+
+        # (c) the one-write barrier: two status writes to one object on one
+        # non-exclusive path through a function
+        for cls in classes:
+            for fn in ast.walk(cls):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.extend(self._double_status_writes(path, fn))
+        return out
+
+    def _double_status_writes(
+        self, path: str, fn: ast.FunctionDef | ast.AsyncFunctionDef
+    ) -> list[Finding]:
+        calls: list[tuple[ast.Call, tuple, str]] = []
+
+        def visit(node: ast.AST, branch_path: tuple) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                return  # nested defs are their own paths
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if node.func.attr in STATUS_WRITE_VERBS and node.args:
+                    calls.append(
+                        (node, branch_path, ast.unparse(node.args[0]))
+                    )
+            if isinstance(node, ast.If):
+                visit_all(node.test, branch_path)
+                for child in node.body:
+                    visit(child, branch_path + ((id(node), "then"),))
+                for child in node.orelse:
+                    visit(child, branch_path + ((id(node), "else"),))
+                return
+            if isinstance(node, ast.Try):
+                for child in node.body:
+                    visit(child, branch_path + ((id(node), "try"),))
+                for i, handler in enumerate(node.handlers):
+                    for child in handler.body:
+                        visit(child, branch_path + ((id(node), f"except{i}"),))
+                for child in node.orelse:
+                    visit(child, branch_path + ((id(node), "try"),))
+                for child in node.finalbody:
+                    visit(child, branch_path)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, branch_path)
+
+        def visit_all(node: ast.AST, branch_path: tuple) -> None:
+            for child in ast.walk(node):
+                if isinstance(child, ast.Call) and isinstance(child.func, ast.Attribute):
+                    if child.func.attr in STATUS_WRITE_VERBS and child.args:
+                        calls.append(
+                            (child, branch_path, ast.unparse(child.args[0]))
+                        )
+
+        for stmt in fn.body:
+            visit(stmt, ())
+
+        out: list[Finding] = []
+        flagged: set[int] = set()
+        for i, (a, pa, arg_a) in enumerate(calls):
+            for b, pb, arg_b in calls[i + 1:]:
+                if arg_a != arg_b or id(b) in flagged:
+                    continue
+                if _mutually_exclusive(pa, pb):
+                    continue
+                flagged.add(id(b))
+                out.append(
+                    Finding(
+                        self.id, path, b.lineno,
+                        f"second status write to {arg_b} on one path "
+                        f"through {fn.name}() — the one-write barrier "
+                        f"requires a single crash-safe status write",
+                        qualname_of(b),
+                    )
+                )
+        return out
+
+
+def _mutually_exclusive(pa: tuple, pb: tuple) -> bool:
+    arms_a = dict(pa)
+    for nid, arm in pb:
+        if nid in arms_a and arms_a[nid] != arm:
+            return True
+    return False

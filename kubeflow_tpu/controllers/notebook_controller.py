@@ -42,6 +42,13 @@ from kubeflow_tpu.runtime.fake import (
 from kubeflow_tpu.runtime.manager import Reconciler, Result
 from kubeflow_tpu.tpu import topology as tputopo
 from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhooks.tpu_env import (
+    ACCEL_ANNOTATION,
+    NOTEBOOK_ANNOTATION,
+    NUM_SLICES_ANNOTATION,
+    SLICE_ANNOTATION,
+    TOPOLOGY_ANNOTATION,
+)
 
 log = logging.getLogger(__name__)
 
@@ -806,13 +813,15 @@ def _tpu_pod_annotations(
 ) -> dict:
     anns = {}
     if topo is not None:
-        # Consumed by the TPU env-injection webhook (webhooks/tpu_env.py).
-        anns["tpu.kubeflow.org/accelerator"] = topo.accelerator.name
-        anns["tpu.kubeflow.org/topology"] = topo.topology_str
-        anns["tpu.kubeflow.org/notebook"] = ko.name(nb)
+        # Consumed by the TPU env-injection webhook (webhooks/tpu_env.py),
+        # which owns these keys — retyping one here would silently strand
+        # every pod without its worker-identity env (TPU004).
+        anns[ACCEL_ANNOTATION] = topo.accelerator.name
+        anns[TOPOLOGY_ANNOTATION] = topo.topology_str
+        anns[NOTEBOOK_ANNOTATION] = ko.name(nb)
         if num_slices > 1:
-            anns["tpu.kubeflow.org/slice-id"] = str(slice_id or 0)
-            anns["tpu.kubeflow.org/num-slices"] = str(num_slices)
+            anns[SLICE_ANNOTATION] = str(slice_id or 0)
+            anns[NUM_SLICES_ANNOTATION] = str(num_slices)
         if placement_slice is not None and placement_slice.get("nodes"):
             import json
 

@@ -23,7 +23,6 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
-import time
 from typing import Callable, Iterable
 
 from kubeflow_tpu.runtime import objects as ko
@@ -339,7 +338,7 @@ class Manager:
             if self.tracer is not None
             else None
         )
-        started = time.perf_counter()
+        started = self.now()
         try:
             result = rec.reconcile(self._rec_cluster, ns, name)
         except Exception:
@@ -363,8 +362,11 @@ class Manager:
         if span is not None:
             self.tracer.end_reconcile(span, outcome)
         if self.metrics is not None:
+            # duration on the injected clock, like the tracer's spans: real
+            # wall time in production, the injected latency (not host
+            # jitter) under the soaks' virtual clock
             self.metrics.observe_reconcile(
-                rec.kind, time.perf_counter() - started, outcome
+                rec.kind, max(0.0, self.now() - started), outcome
             )
         if failed:
             self._wq.done(key)

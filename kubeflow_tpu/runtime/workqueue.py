@@ -220,7 +220,7 @@ class PyWorkQueue:
         }
 
     def _now(self) -> float:
-        return self._vnow if self._virtual else time.monotonic()
+        return self._vnow if self._virtual else time.monotonic()  # tpulint: disable=TPU001 — this IS the virtual/real clock seam: the real branch is the injected default
 
     def _add_locked(self, key: str) -> None:
         if self._shutdown:
@@ -280,7 +280,7 @@ class PyWorkQueue:
             return self._failures.get(key, 0)
 
     def get(self, timeout: float | None = 0.0) -> str | None:
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout  # tpulint: disable=TPU001 — blocking production get(): real threads wait on a real clock; soaks use the virtual branch
         with self._cv:
             while True:
                 self._fire_due_locked()
@@ -294,7 +294,7 @@ class PyWorkQueue:
                     return None
                 waits = []
                 if deadline is not None:
-                    remain = deadline - time.monotonic()
+                    remain = deadline - time.monotonic()  # tpulint: disable=TPU001 — production blocking wait (see deadline above)
                     if remain <= 0:
                         return None
                     waits.append(remain)

@@ -227,9 +227,11 @@ class SchedulerReconciler(Reconciler):
         committed placements as carve/release deltas instead of replaying
         every annotation; **pack** runs admission + scheduling with the
         negative-fit cache; **write** batches the status-condition updates.
-        Each phase's wall time lands in the cycle-phase histogram.
+        Each phase's duration (on the injected clock: real wall time in
+        production and benches, zero on the soaks' virtual clock — counts
+        still attribute) lands in the cycle-phase histogram.
         """
-        cycle_started = time.perf_counter()
+        cycle_started = self.clock()
         barrier_pending = False
         now = self.clock()
 
@@ -282,7 +284,7 @@ class SchedulerReconciler(Reconciler):
                 self._hints -= hints
             if self._router is not None:
                 self._adopt_orphans(cluster, views)
-        t_list = time.perf_counter()
+        t_list = self.clock()
 
         model = self._model
         fleet = model.fleet
@@ -390,7 +392,7 @@ class SchedulerReconciler(Reconciler):
                 barrier_pending = True
             else:
                 bound[key] = replaying[key]
-        t_replay = time.perf_counter()
+        t_replay = self.clock()
 
         # -- pack phase: queue admission ----------------------------------
         unschedulable: dict[str, str] = {}
@@ -446,7 +448,7 @@ class SchedulerReconciler(Reconciler):
             deferred,
         )
         barrier_pending = barrier_pending or handoffs
-        t_pack = time.perf_counter()
+        t_pack = self.clock()
 
         # -- write phase: status conditions + metrics ---------------------
         # The loop is the batched write pass: desired conditions reduce to
@@ -525,21 +527,24 @@ class SchedulerReconciler(Reconciler):
                 self._write_conditions(cluster, view, [], _SIG_OFF)
             # any other state (raced writes, transient gaps): leave the
             # conditions untouched — the next cycle re-derives them
-        t_write = time.perf_counter()
+        t_write = self.clock()
 
         if self.differential_audit:
             self.audit_failures.extend(model.audit(nodes))
         if self.metrics is not None:
+            # clamped like the Manager's reconcile duration: the injected
+            # clock defaults to time.time in production, which can step
+            # backwards (NTP) — the histograms must never see a negative
             self.metrics.observe_cycle(
                 fleet,
                 queue_depth=depth,
                 unschedulable=len(unschedulable),
-                duration_s=t_write - cycle_started,
+                duration_s=max(0.0, t_write - cycle_started),
                 phases={
-                    "list": t_list - cycle_started,
-                    "replay": t_replay - t_list,
-                    "pack": t_pack - t_replay,
-                    "write": t_write - t_pack,
+                    "list": max(0.0, t_list - cycle_started),
+                    "replay": max(0.0, t_replay - t_list),
+                    "pack": max(0.0, t_pack - t_replay),
+                    "write": max(0.0, t_write - t_pack),
                 },
             )
             hits, misses = self._fit_cache.hits, self._fit_cache.misses

@@ -615,6 +615,7 @@ def run_sched_seed(
     *,
     shards: int = 1,
     max_restarts_per_tick: int = 6,
+    lost_update_audit: bool = True,
 ) -> SchedSeedResult:
     """One seeded soak run: hostile timeline under chaos, heal, settle,
     quiesce, then the fixed-point audit. ``faults=None`` runs the same
@@ -638,7 +639,9 @@ def run_sched_seed(
     base = FakeCluster()
     tpu_env.install(base)
     chaos = (
-        ChaosCluster(base, seed=seed, config=faults)
+        ChaosCluster(
+            base, seed=seed, config=faults, lost_update_audit=lost_update_audit
+        )
         if faults is not None
         else None
     )
@@ -820,6 +823,11 @@ def run_sched_seed(
     # phase-partitioned — queue waits must land in the scheduler-owned
     # 'queued' phase, never smeared across layers (docs/observability.md)
     violations.extend(audit_timeline(base, where="final"))
+    if chaos is not None:
+        # lost-update audit (docs/chaos.md): a condition/status write whose
+        # base rv went stale fails the seed at the WRITE, not via whatever
+        # double-booking it would eventually cause
+        violations.extend(chaos.lost_update_findings)
     return SchedSeedResult(
         seed=seed,
         violations=violations,

@@ -453,6 +453,7 @@ def run_session_seed(
     store_faults: StoreChaosConfig | None = None,
     *,
     max_restarts_per_tick: int = 6,
+    lost_update_audit: bool = True,
 ) -> SessionSeedResult:
     """One seeded soak run: hostile timeline under API + store chaos, heal,
     settle past every deadline, quiesce, then the fixed-point audits.
@@ -461,7 +462,9 @@ def run_session_seed(
     base = FakeCluster()
     tpu_env.install(base)
     chaos = (
-        ChaosCluster(base, seed=seed, config=faults)
+        ChaosCluster(
+            base, seed=seed, config=faults, lost_update_audit=lost_update_audit
+        )
         if faults is not None
         else None
     )
@@ -639,6 +642,10 @@ def run_session_seed(
     # startup timeline gap-free and phase-partitioned (restore time lands
     # in the sessions-owned 'restoring' phase)
     violations.extend(audit_timeline(base, where="final"))
+    if chaos is not None:
+        # lost-update audit (docs/chaos.md): the suspend/resume barrier's
+        # one-write discipline checked at every commit's base rv
+        violations.extend(chaos.lost_update_findings)
     return SessionSeedResult(
         seed=seed,
         violations=violations,

@@ -79,6 +79,174 @@ class ControllerCrash(Exception):
     stays in the store — the partial-write state a restart must absorb."""
 
 
+class LostUpdateDetector:
+    """Dynamic lost-update race detector (docs/chaos.md, docs/analysis.md).
+
+    PR 2's double-booking and PR 4's ack-loss race were both, at bottom,
+    lost updates: a write whose base read was stale by commit time silently
+    overwrote another writer's state — and each was caught ONCE, by the
+    luck of a seed whose interleaving made the damage visible at the fixed
+    point. This detector turns that class into a per-seed audit of the
+    write itself, not its downstream wreckage.
+
+    Mechanism: a watch on the *unfaulted* store records every object's
+    (resourceVersion, status digest) history — the ground-truth timeline of
+    who moved what. Each controller-side write through the chaos surface is
+    then judged against the history at commit time:
+
+    - ``update`` with a resourceVersion: the store's optimistic-concurrency
+      check IS the conflict-retry path (a stale base raises Conflict, the
+      workqueue retries) — never flagged.
+    - ``update`` with the resourceVersion stripped: commits blind over
+      whatever is there. Flagged whenever the object moved past the last
+      recorded read (and always when there was no read). A "read" is any
+      delivery through the chaos surface: ``get``/``list``, watch events
+      and re-list replays the controller actually received, and the
+      committed object a write returns. Reads are tracked per OBJECT,
+      not per writer — the surface has no writer identity — so a fresher
+      read by any other component exonerates a stale writer (a false
+      negative, never a false positive); ``update_status`` is unaffected
+      because its base is the rv carried in the written object itself.
+    - ``update_status``: the status subresource has NO rv check — this is
+      the platform's one rv-unguarded write verb. Flagged when the write's
+      base rv (the rv carried in the written object, else the writer's
+      last read) predates a commit that CHANGED the status: the writer is
+      overwriting a status it never saw. Metadata-only bumps after the
+      base read (annotation patches and the writer's own earlier
+      non-status writes) are benign and not flagged; so is ABA (status
+      changed and changed back).
+    - ``patch``: exempt by design — the server-side strategic merge writes
+      only the keys the patch names, which is the platform's sanctioned
+      narrow-write/conflict-avoidance path.
+
+    The soak harnesses append :attr:`findings` to their per-seed
+    violations, so one stale write fails the seed even when the fixed
+    point happens to converge.
+    """
+
+    HISTORY_PER_KEY = 256
+
+    def __init__(self) -> None:
+        # key -> [(rv, status_digest)], appended from the store watch in
+        # commit order (FakeCluster._notify is synchronous)
+        self._hist: dict[tuple, list[tuple[int, int]]] = {}
+        self._last_read_rv: dict[tuple, int] = {}
+        self.findings: list[str] = []
+
+    @staticmethod
+    def _key(obj: dict) -> tuple:
+        return (obj.get("kind", ""), ko.namespace(obj), ko.name(obj))
+
+    @staticmethod
+    def _rv(obj: dict) -> int | None:
+        rv = (obj.get("metadata") or {}).get("resourceVersion")
+        try:
+            return int(rv)
+        except (TypeError, ValueError):
+            return None
+
+    @staticmethod
+    def _digest(obj: dict) -> int:
+        return hash(json.dumps(obj.get("status"), sort_keys=True, default=str))
+
+    # ------------------------------------------------------- history feed
+
+    def observe_event(self, event: str, obj: dict) -> None:
+        if event == "DELETED":
+            # keep the dead life's history: a recreate mints strictly newer
+            # rvs, and a write predicated on the old life's rv is judged
+            # against whatever the new life's status is — which is exactly
+            # the cross-incarnation clobber worth flagging
+            return
+        hist = self._hist.setdefault(self._key(obj), [])
+        rv = self._rv(obj)
+        if rv is None:
+            return
+        hist.append((rv, self._digest(obj)))
+        if len(hist) > self.HISTORY_PER_KEY:
+            del hist[: len(hist) - self.HISTORY_PER_KEY]
+
+    def note_read(self, obj: dict) -> None:
+        rv = self._rv(obj)
+        if rv is not None:
+            key = self._key(obj)
+            if rv > self._last_read_rv.get(key, -1):
+                self._last_read_rv[key] = rv
+
+    # ---------------------------------------------------------- staging
+
+    def _digest_at(self, hist: list[tuple[int, int]], rv: int) -> int | None:
+        for h_rv, digest in reversed(hist):
+            if h_rv == rv:
+                return digest
+            if h_rv < rv:
+                break
+        return None
+
+    def stage_update(self, obj: dict) -> str | None:
+        """Pre-commit check for a full ``update``. Only rv-stripped writes
+        are staged — with a rv, the store's Conflict IS the retry path.
+        The base is the object's last recorded read (see class docstring:
+        per-object, so this errs toward false negatives)."""
+        if self._rv(obj) is not None:
+            return None
+        key = self._key(obj)
+        base = self._last_read_rv.get(key)
+        hist = self._hist.get(key) or []
+        cur = hist[-1][0] if hist else None
+        where = "/".join(str(p) for p in key[1:])
+        if base is None:
+            return (
+                f"lost-update: blind update of {key[0]} {where} — no "
+                f"resourceVersion on the object and no recorded read; the "
+                f"write commits with no conflict check at all"
+            )
+        if cur is not None and cur > base:
+            return (
+                f"lost-update: update of {key[0]} {where} based on a read "
+                f"at rv {base}, but the object moved to rv {cur} and the "
+                f"rv was stripped — the stale write commits with no "
+                f"Conflict to trigger a retry"
+            )
+        return None
+
+    def stage_update_status(self, obj: dict) -> str | None:
+        """Pre-commit check for ``update_status`` (the rv-unguarded verb)."""
+        key = self._key(obj)
+        hist = self._hist.get(key) or []
+        base = self._rv(obj)
+        if base is None:
+            base = self._last_read_rv.get(key)
+        where = "/".join(str(p) for p in key[1:])
+        if base is None:
+            return (
+                f"lost-update: blind status write to {key[0]} {where} — no "
+                f"resourceVersion on the object and no recorded read"
+            )
+        if not hist:
+            return None  # object predates the detector: cannot judge
+        cur_rv, cur_digest = hist[-1]
+        if cur_rv <= base:
+            return None
+        base_digest = self._digest_at(hist, base)
+        if base_digest is None:
+            return None  # base fell off the bounded window: cannot judge
+        if cur_digest != base_digest:
+            return (
+                f"lost-update: status write to {key[0]} {where} based on "
+                f"rv {base}, but the status changed by rv {cur_rv} — the "
+                f"write overwrites a status its writer never saw, and "
+                f"update_status has no conflict-retry path"
+            )
+        return None
+
+    def commit(self, staged: str | None) -> None:
+        """Record a staged finding once its write actually applied (a write
+        the chaos layer rejected pre-apply never clobbered anything)."""
+        if staged is not None:
+            self.findings.append(staged)
+
+
 @dataclasses.dataclass
 class ChaosConfig:
     """Per-fault probabilities. All draws come from one seeded PRNG in call
@@ -127,10 +295,24 @@ class ChaosCluster:
     mutates ``inner`` directly so scenario operations are never faulted.
     """
 
-    def __init__(self, inner: FakeCluster, *, seed: int, config: ChaosConfig | None = None) -> None:
+    def __init__(
+        self,
+        inner: FakeCluster,
+        *,
+        seed: int,
+        config: ChaosConfig | None = None,
+        lost_update_audit: bool = True,
+    ) -> None:
         self.inner = inner
         self.cfg = config or ChaosConfig()
         self.rng = random.Random(f"faults-{seed}")
+        # lost-update race detector: watches the UNFAULTED store (ground
+        # truth, never dropped) and judges every controller-side write at
+        # commit time; the soaks fold .lost_update_findings into their
+        # per-seed violations
+        self._lost = LostUpdateDetector() if lost_update_audit else None
+        if self._lost is not None:
+            inner.watch(None, self._lost.observe_event)
         self.crashed = False
         self._crash_armed = False
         self._crash_after_writes = 0
@@ -181,6 +363,11 @@ class ChaosCluster:
 
     # --------------------------------------------------------- harness knobs
 
+    @property
+    def lost_update_findings(self) -> list[str]:
+        """Stale-base writes that committed (empty when the audit is off)."""
+        return self._lost.findings if self._lost is not None else []
+
     def take_crash(self) -> bool:
         """True once per injected crash; the harness rebuilds the Manager."""
         crashed, self.crashed = self.crashed, False
@@ -227,6 +414,10 @@ class ChaosCluster:
                 sub.dropped = True
                 self.fault_counts["watch_drop"] += 1
                 return
+            # a DELIVERED event is a read: a watch-cache controller that
+            # never get()s has still seen this rv (lost-update audit)
+            if self._lost is not None and event != "DELETED":
+                self._lost.note_read(obj)
             fn(event, obj)
             if not self._healed and self.rng.random() < self.cfg.duplicate_event_rate:
                 self.fault_counts["dup_event"] += 1
@@ -257,6 +448,8 @@ class ChaosCluster:
                     else self.inner.dump()
                 )
                 for obj in objs:
+                    if self._lost is not None:
+                        self._lost.note_read(obj)
                     sub.fn("ADDED", obj)
 
     # --------------------------------------------------------- fake kubelet
@@ -311,24 +504,41 @@ class ChaosCluster:
     def create(self, obj, **kw):
         self._maybe_fault("create", write=True)
         out = self.inner.create(obj, **kw)
+        if self._lost is not None:
+            self._lost.note_read(out)
         self._after_write("create")
         return out
 
     def update(self, obj):
         self._maybe_fault("update", write=True)
+        staged = self._lost.stage_update(obj) if self._lost is not None else None
         out = self.inner.update(obj)
+        # recorded only after the inner write APPLIED (a Conflict/NotFound
+        # from the store means nothing was clobbered); the returned
+        # committed object is itself a read — the writer has seen its rv
+        if self._lost is not None:
+            self._lost.commit(staged)
+            self._lost.note_read(out)
         self._after_write("update")
         return out
 
     def update_status(self, obj):
         self._maybe_fault("update_status", write=True)
+        staged = (
+            self._lost.stage_update_status(obj) if self._lost is not None else None
+        )
         out = self.inner.update_status(obj)
+        if self._lost is not None:
+            self._lost.commit(staged)
+            self._lost.note_read(out)
         self._after_write("update_status")
         return out
 
     def patch(self, kind, name, namespace, patch):
         self._maybe_fault("patch", write=True)
         out = self.inner.patch(kind, name, namespace, patch)
+        if self._lost is not None:
+            self._lost.note_read(out)
         self._after_write("patch")
         return out
 
@@ -352,7 +562,10 @@ class ChaosCluster:
 
     def get(self, kind, name, namespace=""):
         self._maybe_fault("get", write=False)
-        return self.inner.get(kind, name, namespace)
+        out = self.inner.get(kind, name, namespace)
+        if self._lost is not None:
+            self._lost.note_read(out)
+        return out
 
     def try_get(self, kind, name, namespace=""):
         try:
@@ -362,7 +575,11 @@ class ChaosCluster:
 
     def list(self, kind, namespace=None, selector=None):
         self._maybe_fault("list", write=False)
-        return self.inner.list(kind, namespace, selector)
+        out = self.inner.list(kind, namespace, selector)
+        if self._lost is not None:
+            for obj in out:
+                self._lost.note_read(obj)
+        return out
 
     def resource_versions(self, kind, namespace=None, selector=None):
         # the informer-cache poll is a read like any other: the scheduler's
@@ -747,6 +964,7 @@ def run_scenario(
     telemetry: bool = False,
     shards: int = 1,
     max_restarts_per_tick: int = 6,
+    lost_update_audit: bool = True,
 ) -> ScenarioRun:
     """One full scenario run on the virtual clock. ``faults=None`` is the
     fault-free reference run whose final state is the fixed point.
@@ -786,7 +1004,13 @@ def run_scenario(
     base = FakeCluster()
     tpu_env.install(base)
     _install_oauth(base)
-    chaos = ChaosCluster(base, seed=seed, config=faults) if faults else None
+    chaos = (
+        ChaosCluster(
+            base, seed=seed, config=faults, lost_update_audit=lost_update_audit
+        )
+        if faults
+        else None
+    )
     cluster = chaos if chaos is not None else base
     clock = _Clock(1_000_000.0)
     cfg = ControllerConfig()
@@ -1121,6 +1345,11 @@ def run_scenario(
     # click-to-ready) — the convergence proof upgraded to a latency-
     # attribution proof, under the same fault schedules
     violations.extend(audit_timeline(base, where="final"))
+    if chaos is not None:
+        # lost-update audit (docs/chaos.md): every committed write's base
+        # resourceVersion judged at commit time — a stale status overwrite
+        # fails the seed even when the fixed point happens to converge
+        violations.extend(chaos.lost_update_findings)
     if collector is not None:
         # telemetry audit (docs/chaos.md): stale/failed scrapes aged out
         # bounded, and every duty-cycle cull explainable from the recorded
@@ -1141,6 +1370,7 @@ def run_seed(
     *,
     telemetry: bool = False,
     shards: int = 1,
+    lost_update_audit: bool = True,
 ) -> SeedResult:
     """The soak unit: fault-free fixed point vs faulted run, same seed.
     ``telemetry=True`` runs BOTH with the data-plane pipeline armed — the
@@ -1151,7 +1381,8 @@ def run_seed(
     convergence then proves the partition changes no outcomes."""
     reference = run_scenario(seed, None, telemetry=telemetry, shards=shards)
     chaotic = run_scenario(
-        seed, faults or ChaosConfig(), telemetry=telemetry, shards=shards
+        seed, faults or ChaosConfig(), telemetry=telemetry, shards=shards,
+        lost_update_audit=lost_update_audit,
     )
     violations = list(chaotic.violations)
     if reference.violations:

@@ -1,0 +1,601 @@
+"""tpulint: the project-invariant analyzer + the lost-update race detector.
+
+Three layers (docs/analysis.md):
+
+- the ENGINE: baseline add/expire round-trip, pragma suppression with
+  required justification, fingerprint stability under line drift,
+  ``--explain`` for every rule id, the JSON output schema;
+- the RULES: one planted-violation fixture per family (TPU001-TPU005)
+  proving each catches its class, plus clean counterparts proving the
+  sanctioned forms (injected clock, seeded streams, patch-based writes,
+  imported constants) pass;
+- HEAD is clean: ``python tools/tpulint.py`` exits 0 against the committed
+  baseline — the same gate CI runs, executed here so it cannot rot;
+- the DYNAMIC half: the chaos layer's lost-update detector flags a planted
+  stale-resourceVersion status write (within 25 seeds under full fault
+  schedules) and stays silent on the benign forms.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubeflow_tpu.analysis import (
+    Baseline,
+    Finding,
+    LintEngine,
+    RULE_IDS,
+    default_rules,
+)
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.testing.chaos import ChaosCluster, ChaosConfig
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def lint(path: str, source: str, only: str | None = None) -> list[Finding]:
+    engine = LintEngine(REPO_ROOT, rules=default_rules())
+    return engine.run_sources(
+        [(path, source)], only={only} if only else None
+    )
+
+
+def rules_hit(findings: list[Finding]) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------- TPU001
+
+
+class TestDeterminismRule:
+    PLANTED = (
+        "import time, random, uuid, datetime\n"
+        "def schedule(queue):\n"
+        "    now = time.time()\n"
+        "    jitter = random.uniform(0, 1)\n"
+        "    sid = uuid.uuid4()\n"
+        "    stamp = datetime.datetime.now()\n"
+        "    rng = random.Random()\n"
+        "    for item in set(queue):\n"
+        "        pass\n"
+    )
+
+    def test_planted_violations_caught(self):
+        findings = lint("kubeflow_tpu/scheduler/planted.py", self.PLANTED)
+        assert rules_hit(findings) == {"TPU001"}
+        messages = "\n".join(f.message for f in findings)
+        assert "time.time()" in messages
+        assert "random.uniform()" in messages
+        assert "uuid.uuid4()" in messages
+        assert "datetime.datetime.now()" in messages
+        assert "without a seed" in messages
+        assert "unordered set" in messages
+        assert len(findings) == 6
+
+    def test_injected_seams_pass(self):
+        clean = (
+            "import time, random\n"
+            "from typing import Callable\n"
+            "def build(clock: Callable[[], float] = time.time, seed: int = 0):\n"
+            "    rng = random.Random(f'stream-{seed}')\n"
+            "    t = clock()\n"
+            "    draw = rng.random()\n"
+            "    for item in sorted(set([3, 1, 2])):\n"
+            "        pass\n"
+            "    return t, draw\n"
+        )
+        assert lint("kubeflow_tpu/scheduler/clean.py", clean) == []
+
+    def test_out_of_scope_dirs_unflagged(self):
+        findings = lint("kubeflow_tpu/models/whatever.py", self.PLANTED)
+        assert "TPU001" not in rules_hit(findings)
+
+
+# ---------------------------------------------------------------- TPU002
+
+
+class TestWriteSurfaceRule:
+    def test_inner_bypass_caught(self):
+        src = (
+            "class ThingReconciler:\n"
+            "    def reconcile(self, cluster, namespace, name):\n"
+            "        obj = cluster.get('Notebook', name, namespace)\n"
+            "        cluster.inner.update_status(obj)\n"
+        )
+        findings = lint("kubeflow_tpu/controllers/planted.py", src, "TPU002")
+        assert len(findings) == 1 and ".inner" in findings[0].message
+
+    def test_raw_handle_construction_caught(self):
+        src = (
+            "from kubeflow_tpu.runtime.fake import FakeCluster\n"
+            "class ThingReconciler:\n"
+            "    def reconcile(self, cluster, namespace, name):\n"
+            "        side = FakeCluster()\n"
+            "        side.create({'kind': 'Pod'})\n"
+        )
+        findings = lint("kubeflow_tpu/controllers/planted.py", src, "TPU002")
+        assert any("FakeCluster" in f.message for f in findings)
+
+    def test_double_status_write_caught(self):
+        src = (
+            "class ThingReconciler:\n"
+            "    def reconcile(self, cluster, namespace, name):\n"
+            "        nb = cluster.get('Notebook', name, namespace)\n"
+            "        nb['status'] = {'phase': 'a'}\n"
+            "        cluster.update_status(nb)\n"
+            "        nb['status'] = {'phase': 'b'}\n"
+            "        cluster.update_status(nb)\n"
+        )
+        findings = lint("kubeflow_tpu/controllers/planted.py", src, "TPU002")
+        assert len(findings) == 1
+        assert "one-write barrier" in findings[0].message
+
+    def test_exclusive_branches_pass(self):
+        src = (
+            "class ThingReconciler:\n"
+            "    def reconcile(self, cluster, namespace, name):\n"
+            "        nb = cluster.get('Notebook', name, namespace)\n"
+            "        if nb.get('spec'):\n"
+            "            cluster.update_status(nb)\n"
+            "        else:\n"
+            "            cluster.update_status(nb)\n"
+        )
+        assert lint("kubeflow_tpu/controllers/planted.py", src, "TPU002") == []
+
+    def test_non_reconciler_files_unscoped(self):
+        src = "class Wrapper:\n    def send(self, c):\n        c.inner.update(1)\n"
+        assert lint("kubeflow_tpu/obs/whatever.py", src, "TPU002") == []
+
+
+# ---------------------------------------------------------------- TPU003
+
+
+class TestReconcileIORule:
+    def test_direct_io_caught(self):
+        src = (
+            "import requests\n"
+            "class ThingReconciler:\n"
+            "    def reconcile(self, cluster, namespace, name):\n"
+            "        requests.get('http://agent:8890/metrics')\n"
+        )
+        findings = lint("kubeflow_tpu/controllers/planted.py", src, "TPU003")
+        assert len(findings) == 1 and "requests.get" in findings[0].message
+
+    def test_transitive_helper_and_scrape_caught(self):
+        src = (
+            "import time\n"
+            "class ThingReconciler:\n"
+            "    def reconcile(self, cluster, namespace, name):\n"
+            "        self._settle()\n"
+            "        helper()\n"
+            "    def _settle(self):\n"
+            "        time.sleep(1)\n"
+            "def helper():\n"
+            "    open('/tmp/x')\n"
+        )
+        findings = lint("kubeflow_tpu/controllers/planted.py", src, "TPU003")
+        msgs = "\n".join(f.message for f in findings)
+        assert "time.sleep" in msgs and "open()" in msgs
+
+    def test_collector_scrape_caught_and_memory_read_passes(self):
+        src = (
+            "class ThingReconciler:\n"
+            "    def __init__(self, collector):\n"
+            "        self.collector = collector\n"
+            "    def reconcile(self, cluster, namespace, name):\n"
+            "        self.collector.collect()\n"
+            "        sample = self.collector.latest(name)\n"
+        )
+        findings = lint("kubeflow_tpu/controllers/planted.py", src, "TPU003")
+        assert len(findings) == 1 and "scrape" in findings[0].message
+
+    def test_io_outside_reconcile_path_passes(self):
+        src = (
+            "import requests\n"
+            "class ThingReconciler:\n"
+            "    def reconcile(self, cluster, namespace, name):\n"
+            "        return None\n"
+            "def offline_tool():\n"
+            "    requests.get('http://example/debug')\n"
+        )
+        assert lint("kubeflow_tpu/controllers/planted.py", src, "TPU003") == []
+
+
+# ---------------------------------------------------------------- TPU004
+
+
+class TestAnnotationLiteralRule:
+    def test_bare_key_caught(self):
+        src = (
+            "def stamp(anns):\n"
+            "    anns['sessions.kubeflow.org/suspend-requested'] = 'now'\n"
+        )
+        findings = lint("kubeflow_tpu/sessions/planted.py", src, "TPU004")
+        assert len(findings) == 1
+        assert "suspend-requested" in findings[0].message
+
+    def test_module_constant_and_apiversion_pass(self):
+        src = (
+            "SUSPEND = 'sessions.kubeflow.org/suspend-requested'\n"
+            "API_VERSION = 'kubeflow.org/v1'\n"
+            "def stamp(anns, obj):\n"
+            "    anns[SUSPEND] = 'now'\n"
+            "    obj['apiVersion'] = 'tensorboard.kubeflow.org/v1alpha1'\n"
+        )
+        assert lint("kubeflow_tpu/sessions/clean.py", src, "TPU004") == []
+
+
+# ---------------------------------------------------------------- TPU005
+
+
+class TestMetricsRule:
+    def test_bad_label_and_kind_conflict_caught(self):
+        a = (
+            "class M1:\n"
+            "    def __init__(self, reg):\n"
+            "        self.x = reg.counter('jobs_total', 'help',\n"
+            "                             labelnames=['le'])\n"
+            "        self.bad = reg.gauge('ok_family', 'help',\n"
+            "                             labelnames=['__reserved'])\n"
+        )
+        b = (
+            "class M2:\n"
+            "    def __init__(self, reg):\n"
+            "        self.x = reg.gauge('jobs_total', 'help')\n"
+        )
+        engine = LintEngine(REPO_ROOT, rules=default_rules())
+        findings = engine.run_sources(
+            [("kubeflow_tpu/utils/m1.py", a), ("kubeflow_tpu/utils/m2.py", b)],
+            only={"TPU005"},
+        )
+        msgs = "\n".join(f.message for f in findings)
+        assert "__reserved" in msgs
+        assert "one family, one kind" in msgs
+
+    def test_label_schema_conflict_caught(self):
+        a = "x = REG.counter('dup_total', 'h', labelnames=['a'])\n"
+        b = "y = REG.counter('dup_total', 'h', labelnames=['b'])\n"
+        engine = LintEngine(REPO_ROOT, rules=default_rules())
+        findings = engine.run_sources(
+            [("kubeflow_tpu/utils/a.py", a), ("kubeflow_tpu/utils/b.py", b)],
+            only={"TPU005"},
+        )
+        assert len(findings) == 1
+        assert "one registry, one schema" in findings[0].message
+
+    def test_label_order_conflict_caught(self):
+        # Registry._add compares schemas order-sensitively: ["a","b"] vs
+        # ["b","a"] raises at the second process's startup
+        a = "x = REG.counter('ord_total', 'h', labelnames=['a', 'b'])\n"
+        b = "y = REG.counter('ord_total', 'h', labelnames=['b', 'a'])\n"
+        engine = LintEngine(REPO_ROOT, rules=default_rules())
+        findings = engine.run_sources(
+            [("kubeflow_tpu/utils/a.py", a), ("kubeflow_tpu/utils/b.py", b)],
+            only={"TPU005"},
+        )
+        assert len(findings) == 1
+        assert "label order included" in findings[0].message
+
+    def test_identical_shared_registration_passes(self):
+        a = "x = REG.counter('shared_total', 'h', labelnames=['ns'])\n"
+        b = "y = REG.counter('shared_total', 'h', labelnames=['ns'])\n"
+        engine = LintEngine(REPO_ROOT, rules=default_rules())
+        assert engine.run_sources(
+            [("kubeflow_tpu/utils/a.py", a), ("kubeflow_tpu/utils/b.py", b)],
+            only={"TPU005"},
+        ) == []
+
+
+# ----------------------------------------------------------------- engine
+
+
+class TestEngine:
+    def test_pragma_with_justification_suppresses(self):
+        src = (
+            "import time\n"
+            "def f():\n"
+            "    return time.time()  "
+            "# tpulint: disable=TPU001 — planted exemption for this test\n"
+        )
+        assert lint("kubeflow_tpu/runtime/planted.py", src) == []
+
+    def test_pragma_without_justification_suppresses_nothing(self):
+        src = (
+            "import time\n"
+            "def f():\n"
+            "    return time.time()  # tpulint: disable=TPU001\n"
+        )
+        findings = lint("kubeflow_tpu/runtime/planted.py", src)
+        assert len(findings) == 1
+
+    def test_fingerprint_survives_line_drift(self):
+        src = "import time\ndef f():\n    return time.time()\n"
+        shifted = "import time\n\n\n# moved\ndef f():\n    return time.time()\n"
+        (a,) = lint("kubeflow_tpu/runtime/planted.py", src)
+        (b,) = lint("kubeflow_tpu/runtime/planted.py", shifted)
+        assert a.line != b.line and a.fingerprint == b.fingerprint
+
+    def test_syntax_error_is_surfaced_not_skipped(self):
+        engine = LintEngine(REPO_ROOT, rules=default_rules())
+        engine.run_sources([("kubeflow_tpu/runtime/bad.py", "def f(:\n")])
+        assert engine.parse_errors and "syntax error" in engine.parse_errors[0].message
+
+
+class TestBaseline:
+    SRC = "import time\ndef f():\n    return time.time()\n"
+    PATH = "kubeflow_tpu/runtime/planted.py"
+
+    def test_add_justify_expire_round_trip(self, tmp_path):
+        findings = lint(self.PATH, self.SRC)
+        assert len(findings) == 1
+        # add: --update-baseline leaves the justification empty...
+        baseline = Baseline().updated_with(findings)
+        p = tmp_path / "baseline.json"
+        baseline.save(p)
+        loaded = Baseline.load(p)
+        result = loaded.apply(findings)
+        # ...which fails the run until a human writes the why
+        assert not result.new and result.unjustified and not result.clean
+        entry = next(iter(loaded.entries.values()))
+        entry.justification = "planted: exercised by the round-trip test"
+        loaded.save(p)
+        result = Baseline.load(p).apply(findings)
+        assert result.clean and len(result.matched) == 1
+        # expire: fixing the finding makes the entry STALE — the run fails
+        # until the entry is deleted (updated_with drops it)
+        clean_findings = lint(self.PATH, "def f():\n    return 0\n")
+        result = Baseline.load(p).apply(clean_findings)
+        assert result.stale and not result.clean
+        shrunk = Baseline.load(p).updated_with(clean_findings)
+        assert not shrunk.entries
+        shrunk.save(p)
+        assert Baseline.load(p).apply(clean_findings).clean
+
+    def test_missing_baseline_file_is_empty(self, tmp_path):
+        result = Baseline.load(tmp_path / "nope.json").apply(
+            lint(self.PATH, self.SRC)
+        )
+        assert result.new and not result.stale
+
+    def test_only_scopes_staleness(self, tmp_path):
+        baseline = Baseline().updated_with(lint(self.PATH, self.SRC))
+        # a TPU001 entry must not read as stale to a --only TPU005 run
+        assert not baseline.apply([], only={"TPU005"}).stale
+        assert baseline.apply([], only={"TPU001"}).stale
+
+    def test_paths_scope_staleness_and_update(self):
+        baseline = Baseline().updated_with(lint(self.PATH, self.SRC))
+        other = {"kubeflow_tpu/scheduler/other.py"}
+        # a path-scoped run never scanned self.PATH: its entry is not stale
+        assert not baseline.apply([], paths=other).stale
+        assert baseline.apply([], paths={self.PATH}).stale
+        # and a path-scoped --update-baseline keeps the unscanned entry
+        assert baseline.updated_with([], paths=other).entries
+        assert not baseline.updated_with([], paths={self.PATH}).entries
+
+    def test_count_pins_identical_violations(self):
+        # identical violations share a fingerprint by design; the entry's
+        # count pins how many are grandfathered
+        two = "import time\ndef f():\n    a = time.time()\n    b = time.time()\n"
+        findings2 = lint(self.PATH, two)
+        assert len(findings2) == 2
+        assert len({f.fingerprint for f in findings2}) == 1
+        baseline = Baseline().updated_with(findings2)
+        (entry,) = baseline.entries.values()
+        assert entry.count == 2
+        entry.justification = "planted: count round-trip"
+        assert baseline.apply(findings2).clean
+        # a THIRD identical call next to the baselined two is NEW
+        three = two + "    c = time.time()\n"
+        result = baseline.apply(lint(self.PATH, three))
+        assert len(result.new) == 1 and len(result.matched) == 2
+        # fixing one of the two makes the entry STALE: re-record, or the
+        # headroom silently grandfathers a future regression
+        one = "import time\ndef f():\n    a = time.time()\n"
+        result = baseline.apply(lint(self.PATH, one))
+        assert result.stale and len(result.matched) == 1
+
+    def test_only_scopes_update(self):
+        # --only TPU005 --update-baseline must not delete (and unjustify)
+        # the other rules' grandfathered entries
+        baseline = Baseline().updated_with(lint(self.PATH, self.SRC))
+        assert baseline.entries  # a TPU001 entry
+        kept = baseline.updated_with([], only={"TPU005"})
+        assert kept.entries == baseline.entries
+        assert not baseline.updated_with([], only={"TPU001"}).entries
+
+
+class TestCLI:
+    def _run(self, *argv):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "tpulint.py"),
+             *argv],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+        )
+
+    @pytest.mark.parametrize("rule_id", RULE_IDS)
+    def test_explain_every_rule(self, rule_id):
+        proc = self._run("--explain", rule_id)
+        assert proc.returncode == 0
+        out = proc.stdout
+        assert rule_id in out
+        assert "Invariant:" in out and "Why:" in out and "Suppress:" in out
+
+    def test_head_is_clean_against_committed_baseline(self):
+        # the acceptance gate itself: the analyzer exits 0 at HEAD, every
+        # grandfathered finding justified, no stale entries
+        proc = self._run()
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 new finding(s)" in proc.stdout
+        assert "0 stale" in proc.stdout and "0 unjustified" in proc.stdout
+
+    def test_json_schema(self):
+        proc = self._run("--json", "--only", "TPU005")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["version"] == 1
+        assert doc["rules"] == ["TPU005"]
+        assert doc["clean"] is True
+        for key in ("findings", "baselined", "stale_baseline",
+                    "unjustified_baseline"):
+            assert isinstance(doc[key], list)
+        for f in doc["findings"] + doc["baselined"]:
+            assert set(f) == {"rule", "path", "line", "context", "message",
+                              "fingerprint"}
+
+    def test_unknown_rule_id_rejected(self):
+        assert self._run("--only", "TPU999").returncode == 2
+
+    def test_nonexistent_path_errors_instead_of_green(self):
+        proc = self._run("kubeflow_tpu_typo")
+        assert proc.returncode == 2
+        assert "no such file" in proc.stdout
+
+    def test_outside_root_path_errors_cleanly(self, tmp_path):
+        outside = tmp_path / "elsewhere.py"
+        outside.write_text("x = 1\n")
+        proc = self._run(str(outside))
+        assert proc.returncode == 2
+        assert "outside the repo root" in proc.stdout
+
+
+# ------------------------------------------------- lost-update detector
+
+
+def _make(seed: int = 1, config: ChaosConfig | None = None):
+    base = FakeCluster()
+    chaos = ChaosCluster(base, seed=seed, config=config or ChaosConfig.quiet())
+    base.create(api.notebook("nb", "team-a"))
+    return base, chaos
+
+
+class TestLostUpdateDetector:
+    def test_planted_stale_status_write_flagged(self):
+        _, chaos = _make()
+        stale = chaos.get("Notebook", "nb", "team-a")
+        fresh = chaos.get("Notebook", "nb", "team-a")
+        fresh["status"] = {"readyReplicas": 1}
+        chaos.update_status(fresh)
+        stale["status"] = {"readyReplicas": 0}
+        chaos.update_status(stale)
+        assert len(chaos.lost_update_findings) == 1
+        assert "status changed" in chaos.lost_update_findings[0]
+
+    def test_fresh_reread_before_status_write_is_clean(self):
+        _, chaos = _make()
+        fresh = chaos.get("Notebook", "nb", "team-a")
+        fresh["status"] = {"readyReplicas": 1}
+        chaos.update_status(fresh)
+        again = chaos.get("Notebook", "nb", "team-a")
+        again["status"] = {"readyReplicas": 2}
+        chaos.update_status(again)
+        assert chaos.lost_update_findings == []
+
+    def test_metadata_only_bump_is_benign(self):
+        base, chaos = _make()
+        held = chaos.get("Notebook", "nb", "team-a")
+        base.patch("Notebook", "nb", "team-a",
+                   {"metadata": {"annotations": {"x": "y"}}})
+        held["status"] = {"readyReplicas": 1}
+        chaos.update_status(held)
+        assert chaos.lost_update_findings == []
+
+    def test_aba_status_is_benign(self):
+        base, chaos = _make()
+        init = base.get("Notebook", "nb", "team-a")
+        init["status"] = {"readyReplicas": 5}
+        base.update_status(init)
+        held = chaos.get("Notebook", "nb", "team-a")
+        mid = base.get("Notebook", "nb", "team-a")
+        mid["status"] = {"readyReplicas": 9}
+        base.update_status(mid)
+        back = base.get("Notebook", "nb", "team-a")
+        back["status"] = {"readyReplicas": 5}
+        base.update_status(back)
+        held["status"] = {"readyReplicas": 1}
+        chaos.update_status(held)
+        assert chaos.lost_update_findings == []
+
+    def test_blind_update_without_rv_flagged(self):
+        base, chaos = _make()
+        held = chaos.get("Notebook", "nb", "team-a")
+        held["metadata"].pop("resourceVersion")
+        base.patch("Notebook", "nb", "team-a",
+                   {"metadata": {"annotations": {"x": "y"}}})
+        chaos.update(held)
+        assert len(chaos.lost_update_findings) == 1
+        assert "stripped" in chaos.lost_update_findings[0]
+
+    def test_update_with_rv_conflicts_instead_of_flagging(self):
+        from kubeflow_tpu.runtime.fake import Conflict
+
+        base, chaos = _make()
+        held = chaos.get("Notebook", "nb", "team-a")
+        base.patch("Notebook", "nb", "team-a",
+                   {"metadata": {"annotations": {"x": "y"}}})
+        with pytest.raises(Conflict):
+            chaos.update(held)
+        # the Conflict IS the retry path: nothing was clobbered
+        assert chaos.lost_update_findings == []
+
+    def test_patch_is_exempt_by_design(self):
+        base, chaos = _make()
+        chaos.get("Notebook", "nb", "team-a")
+        base.patch("Notebook", "nb", "team-a",
+                   {"metadata": {"annotations": {"x": "y"}}})
+        chaos.patch("Notebook", "nb", "team-a",
+                    {"metadata": {"annotations": {"z": "w"}}})
+        assert chaos.lost_update_findings == []
+
+    def test_audit_off_records_nothing(self):
+        base = FakeCluster()
+        chaos = ChaosCluster(
+            base, seed=1, config=ChaosConfig.quiet(), lost_update_audit=False
+        )
+        base.create(api.notebook("nb", "team-a"))
+        stale = chaos.get("Notebook", "nb", "team-a")
+        fresh = chaos.get("Notebook", "nb", "team-a")
+        fresh["status"] = {"readyReplicas": 1}
+        chaos.update_status(fresh)
+        stale["status"] = {"readyReplicas": 0}
+        chaos.update_status(stale)
+        assert chaos.lost_update_findings == []
+
+    def test_planted_writer_flagged_under_full_fault_schedules(self):
+        """The acceptance shape: a hostile writer planted under the REAL
+        per-seed fault schedules is flagged within 25 seeds (faults may
+        reject some of its writes; the audit must still catch a committing
+        one well inside the CI sweep)."""
+        flagged = 0
+        for seed in range(1, 26):
+            base = FakeCluster()
+            chaos = ChaosCluster(base, seed=seed, config=ChaosConfig())
+            base.create(api.notebook("nb", "team-a"))
+
+            def attempt(fn, tries=6):
+                for _ in range(tries):
+                    try:
+                        return fn()
+                    except Exception:
+                        continue
+                return None
+
+            stale = attempt(lambda: chaos.get("Notebook", "nb", "team-a"))
+            fresh = attempt(lambda: chaos.get("Notebook", "nb", "team-a"))
+            if stale is None or fresh is None:
+                continue
+            fresh["status"] = {"readyReplicas": 1}
+            if attempt(lambda: chaos.update_status(fresh)) is None:
+                continue
+            stale["status"] = {"readyReplicas": 0}
+            attempt(lambda: chaos.update_status(stale))
+            if chaos.lost_update_findings:
+                flagged += 1
+            if flagged and seed >= 1:
+                break
+        assert flagged >= 1, "planted stale write never flagged in 25 seeds"

@@ -216,11 +216,14 @@ class TestTargetedFaults:
         chaos = ChaosCluster(base, seed=3, config=ChaosConfig.quiet())
         mgr = _rebuild(chaos)
         base.create(api.notebook("nb", "team-a"))
+        # observers installed before the manager (the lost-update
+        # detector's ground-truth watch) are not manager subscriptions
+        pre_start = list(base._watchers)
         chaos.outage = True  # initial list raises on every kind
         with pytest.raises(ServerError):
             mgr.start_watches()
         assert not mgr._watches_started
-        assert base._watchers == []
+        assert base._watchers == pre_start
         chaos.outage = False
         mgr.run_until_idle()  # retries installation and reconciles
         assert base.get("StatefulSet", "nb", "team-a") is not None

@@ -51,6 +51,12 @@ def main(argv: list[str] | None = None) -> int:
                          "every round; the faulted run must converge to "
                          "the equally-sharded fault-free fixed point. "
                          "1 = the historical single-loop run")
+    ap.add_argument("--lost-update-audit", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="per-seed lost-update race audit: every committed "
+                         "write's base resourceVersion judged at commit "
+                         "time; a stale status overwrite fails the seed "
+                         "(docs/chaos.md; on by default)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="per-seed lines; on failure, a fixed-point diff")
     args = ap.parse_args(argv)
@@ -75,7 +81,8 @@ def main(argv: list[str] | None = None) -> int:
     total_restarts = 0
     for seed in seeds:
         result = run_seed(
-            seed, cfg, telemetry=args.telemetry, shards=args.shards
+            seed, cfg, telemetry=args.telemetry, shards=args.shards,
+            lost_update_audit=args.lost_update_audit,
         )
         total_faults += sum(result.fault_counts.values())
         total_restarts += result.restarts

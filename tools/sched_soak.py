@@ -49,6 +49,10 @@ def main(argv: list[str] | None = None) -> int:
                          "stamps converged, zero cross-family binds, zero "
                          "cross-shard double-booking). 1 = the historical "
                          "single-loop run (docs/architecture.md)")
+    ap.add_argument("--lost-update-audit", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="per-seed lost-update race audit on every cluster "
+                         "write (docs/chaos.md; on by default)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print a line per seed, not just failures")
     args = ap.parse_args(argv)
@@ -74,7 +78,10 @@ def main(argv: list[str] | None = None) -> int:
     failures = 0
     binds = preemptions = restarts = faults = 0
     for seed in seeds:
-        result = run_sched_seed(seed, cfg, shards=args.shards)
+        result = run_sched_seed(
+            seed, cfg, shards=args.shards,
+            lost_update_audit=args.lost_update_audit,
+        )
         binds += result.binds
         preemptions += result.preemptions
         restarts += result.restarts

@@ -45,6 +45,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="override ChaosConfig.crash_rate")
     ap.add_argument("--store-torn-rate", type=float, default=None,
                     help="override StoreChaosConfig.torn_rate")
+    ap.add_argument("--lost-update-audit", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="per-seed lost-update race audit on every cluster "
+                         "write (docs/chaos.md; on by default)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print a line per seed, not just failures")
     args = ap.parse_args(argv)
@@ -74,7 +78,10 @@ def main(argv: list[str] | None = None) -> int:
     failures = 0
     suspends = resumes = forced = restarts = faults = store_faults = 0
     for seed in seeds:
-        result = run_session_seed(seed, cfg, store_cfg)
+        result = run_session_seed(
+            seed, cfg, store_cfg,
+            lost_update_audit=args.lost_update_audit,
+        )
         suspends += result.suspends
         resumes += result.resumes
         forced += result.force_suspends

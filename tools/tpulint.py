@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""tpulint: the project-invariant analyzer (docs/analysis.md).
+
+    python tools/tpulint.py                    # full tree, baseline-checked
+    python tools/tpulint.py --only TPU005      # one rule family
+    python tools/tpulint.py --explain TPU001   # what a rule means and why
+    python tools/tpulint.py --json             # machine-readable findings
+    python tools/tpulint.py --update-baseline  # regrandfather, keep whys
+
+Exit 0 only when every finding is either absent or baselined WITH a
+justification, and no baseline entry is stale. The committed baseline is
+``tools/tpulint_baseline.json``; it can only shrink or be consciously
+re-justified (an --update-baseline rewrite leaves new entries with an empty
+justification, which fails the next run until a human fills in the why).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, REPO_ROOT)
+
+from kubeflow_tpu.analysis import (  # noqa: E402
+    Baseline,
+    LintEngine,
+    RULE_IDS,
+    default_rules,
+)
+
+DEFAULT_BASELINE = os.path.join("tools", "tpulint_baseline.json")
+
+
+def _explain(rule_id: str) -> int:
+    for rule in default_rules():
+        if rule.id == rule_id:
+            print(rule.explain())
+            return 0
+    print(f"unknown rule {rule_id!r}; known: {', '.join(RULE_IDS)}")
+    return 2
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs relative to the repo root (default: "
+                         "kubeflow_tpu + tools + benchmarks + loadtest, "
+                         "so cross-file rules see every runtime import)")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated rule ids to run (e.g. TPU005)")
+    ap.add_argument("--explain", metavar="RULE", default=None,
+                    help="print a rule's invariant, rationale, and how to "
+                         "suppress with justification")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"baseline file (default {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding; ignore the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current tree, "
+                         "preserving justifications of entries that still "
+                         "match; new entries need a human-written why")
+    args = ap.parse_args(argv)
+
+    if args.explain:
+        return _explain(args.explain)
+
+    only = None
+    if args.only:
+        only = set(args.only.split(","))
+        unknown = only - set(RULE_IDS)
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+            return 2
+
+    engine = LintEngine(REPO_ROOT)
+    try:
+        findings = engine.run(args.paths or None, only=only)
+    except FileNotFoundError as e:
+        print(e)
+        return 2
+    if engine.parse_errors:
+        for f in engine.parse_errors:
+            print(f.render())
+        return 1
+
+    # path-scoped runs judge staleness (and rewrite the baseline) only for
+    # files they actually scanned; the full-tree run is the one that shrinks
+    scanned = engine.scanned_paths if args.paths else None
+
+    baseline_path = os.path.join(REPO_ROOT, args.baseline)
+    if args.update_baseline:
+        baseline = Baseline.load(baseline_path)
+        updated = baseline.updated_with(findings, paths=scanned, only=only)
+        updated.save(baseline_path)
+        empty = sum(
+            1 for e in updated.entries.values() if not e.justification.strip()
+        )
+        print(
+            f"tpulint: baseline rewritten with {len(updated.entries)} "
+            f"entr(ies) at {args.baseline}"
+            + (f"; {empty} need a justification before the next run" if empty else "")
+        )
+        return 0
+
+    if args.no_baseline:
+        result = Baseline().apply(findings, only=only, paths=scanned)
+    else:
+        result = Baseline.load(baseline_path).apply(
+            findings, only=only, paths=scanned
+        )
+
+    if args.as_json:
+        print(json.dumps(
+            {
+                "version": 1,
+                "rules": sorted(only) if only else list(RULE_IDS),
+                "findings": [f.to_dict() for f in result.new],
+                "baselined": [f.to_dict() for f in result.matched],
+                "stale_baseline": [e.to_dict() for e in result.stale],
+                "unjustified_baseline": [e.to_dict() for e in result.unjustified],
+                "clean": result.clean,
+            },
+            indent=1,
+        ))
+        return 0 if result.clean else 1
+
+    for f in result.new:
+        print(f.render())
+    for e in result.stale:
+        print(
+            f"stale baseline entry {e.fingerprint} ({e.rule} {e.path}: "
+            f"{e.message}) — the finding is gone or its count shrank; "
+            f"re-record with --update-baseline (which drops fully-fixed "
+            f"entries and keeps their justifications otherwise)"
+        )
+    for e in result.unjustified:
+        print(
+            f"baseline entry {e.fingerprint} ({e.rule} {e.path}) has no "
+            f"justification — write the one-line why"
+        )
+    print(
+        f"tpulint: {len(result.new)} new finding(s), "
+        f"{len(result.matched)} baselined, {len(result.stale)} stale "
+        f"baseline entr(ies), {len(result.unjustified)} unjustified"
+    )
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,6 +61,9 @@ class _RecordingMetrics:
         self.queue_depths: list[int] = []
         self.fit_cache_hits = 0
         self.fit_cache_misses = 0
+        self.frag_series: list[float] = []
+        self.reason_transitions: dict[str, int] = {}
+        self.would_fit_after_defrag = 0
 
         class _Ctr:
             def __init__(self, outer):
@@ -72,12 +75,19 @@ class _RecordingMetrics:
         self.preemptions = _Ctr(self)
 
     def observe_cycle(
-        self, fleet, *, queue_depth, unschedulable, phases=None, **_kw
+        self, fleet, *, queue_depth, unschedulable, phases=None,
+        pool_stats=None, **_kw
     ):
         self.cycles += 1
         self.queue_depths.append(queue_depth)
         for phase, seconds in (phases or {}).items():
             self.phase_samples.setdefault(phase, []).append(seconds)
+        if pool_stats:
+            # fleet fragmentation index per cycle: the worst pool bounds
+            # what the biggest waiting gang can hope for
+            self.frag_series.append(
+                round(min(frag for frag, _ in pool_stats.values()), 4)
+            )
 
     def observe_bind(self, seconds: float) -> None:
         self.bind_latencies.append(seconds)
@@ -85,6 +95,15 @@ class _RecordingMetrics:
     def observe_fit_cache(self, hits: int, misses: int) -> None:
         self.fit_cache_hits += hits
         self.fit_cache_misses += misses
+
+    def observe_reason_transition(self, reason, *, prev, seconds_in_prev):
+        if reason is not None:
+            self.reason_transitions[reason] = (
+                self.reason_transitions.get(reason, 0) + 1
+            )
+
+    def set_would_fit_after_defrag(self, count: int) -> None:
+        self.would_fit_after_defrag = count
 
 
 def _percentile(samples: list[float], q: float) -> float:
@@ -106,7 +125,7 @@ def _decimate(series: list[int], max_points: int = 50) -> list[int]:
     return out
 
 
-def run(gangs: int, pools: int, seed: int) -> dict:
+def run(gangs: int, pools: int, seed: int, explain: bool = True) -> dict:
     rng = random.Random(seed)
     cluster = FakeCluster()
     for i in range(pools):
@@ -123,7 +142,9 @@ def run(gangs: int, pools: int, seed: int) -> dict:
         cluster.create(nb)
 
     metrics = _RecordingMetrics()
-    rec = SchedulerReconciler(metrics=metrics, clock=time.monotonic)
+    rec = SchedulerReconciler(
+        metrics=metrics, clock=time.monotonic, explain=explain
+    )
 
     # Bound gangs surface through the watch stream (placement annotation
     # appearing) instead of a full 10k-object list per cycle — the bench
@@ -183,15 +204,21 @@ def run(gangs: int, pools: int, seed: int) -> dict:
             for phase, samples in sorted(metrics.phase_samples.items())
         },
         "queue_depth_decay": _decimate(metrics.queue_depths),
+        # fleet fragmentation index per cycle (min over pools, decimated
+        # like the queue decay): how contiguity erodes as the drain packs
+        # and frees — the series bench.yaml archives for perf tracking
+        "fragmentation_index_decay": _decimate(metrics.frag_series),
         "fit_cache": {
             "hits": metrics.fit_cache_hits,
             "misses": metrics.fit_cache_misses,
         },
         "preemptions": metrics.preempt_count,
+        "explain": explain,
+        "reason_transitions": dict(sorted(metrics.reason_transitions.items())),
     }
 
 
-def _run_profiled(gangs: int, pools: int, seed: int) -> dict:
+def _run_profiled(gangs: int, pools: int, seed: int, explain: bool = True) -> dict:
     """Wrap the drain loop in cProfile and print the top pack-path
     hotspots (scheduler modules only, by cumulative time) to stderr."""
     import cProfile
@@ -199,7 +226,7 @@ def _run_profiled(gangs: int, pools: int, seed: int) -> dict:
 
     prof = cProfile.Profile()
     prof.enable()
-    result = run(gangs, pools, seed)
+    result = run(gangs, pools, seed, explain=explain)
     prof.disable()
     stats = pstats.Stats(prof, stream=sys.stderr)
     stats.sort_stats("cumulative")
@@ -246,6 +273,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--profile", action="store_true",
                     help="cProfile the drain and print pack-path hotspots")
+    ap.add_argument("--no-explain", dest="explain", action="store_false",
+                    help="disable the explanation phase (the A/B arm for "
+                         "measuring the explainability layer's overhead; "
+                         "the CI gate runs WITH explain, as shipped)")
     ap.add_argument("--check-against", metavar="BASELINE_JSON",
                     help="compare placements/s against a committed baseline "
                          "and exit 1 on regression beyond --tolerance")
@@ -255,7 +286,7 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
     logging.disable(logging.ERROR)
     runner = _run_profiled if args.profile else run
-    result = runner(args.gangs, args.pools, args.seed)
+    result = runner(args.gangs, args.pools, args.seed, explain=args.explain)
     print("SCHED_BENCH " + json.dumps(result, sort_keys=True))
     if args.check_against:
         return check_against(result, args.check_against, args.tolerance)

@@ -232,9 +232,17 @@ def build_manager(
         # free space (docs/architecture.md "control-plane sharding").
         from kubeflow_tpu.scheduler.controller import SchedulerReconciler
 
+        # per-shard instance (the shard label keeps series disjoint), but
+        # any one is a fleet-wide READ handle — the dashboard's queue-depth/
+        # fragmentation readers scan every label set on the family — so the
+        # first one built is published for webapps/dashboard.py
+        sched_metrics = SchedulerMetrics(metrics.registry, shard=shard_label)
+        manager.scheduler_metrics = shared.setdefault(
+            "scheduler_metrics", sched_metrics
+        )
         manager.register(
             SchedulerReconciler(
-                metrics=SchedulerMetrics(metrics.registry, shard=shard_label),
+                metrics=sched_metrics,
                 recorder=EventRecorder(),
                 suspend_deadline_s=(
                     cfg.suspend_deadline_s if cfg.sessions_enabled else None
@@ -427,6 +435,14 @@ def serve_ops(
         builder = getattr(manager, "timeline_builder", None) if manager else None
         if builder is not None:
             install_timeline_route(probes, builder)
+        # /debug/explain/<ns>/<name>: the decoded placement explanation —
+        # the operator's "why is my notebook still pending" page, same
+        # cluster-internal surface as /debug/traces
+        cluster = getattr(manager, "cluster", None) if manager else None
+        if cluster is not None:
+            from kubeflow_tpu.scheduler.explain import install_explain_route
+
+            install_explain_route(probes, cluster)
         _spawn(probes, port)
     if metrics_port:
         if manager is not None:

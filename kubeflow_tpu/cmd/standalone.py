@@ -90,6 +90,7 @@ def build_platform(
             cluster, cluster_admins=admins, metrics=metrics,
             telemetry=telemetry,
             slo=getattr(manager, "slo", None),
+            scheduler=getattr(manager, "scheduler_metrics", None),
             cache=read_cache,
         ),
         {

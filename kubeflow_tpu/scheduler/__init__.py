@@ -40,6 +40,15 @@ PLACEMENT_ANNOTATION = "scheduling.kubeflow.org/placement"
 QUEUED_AT_ANNOTATION = "scheduling.kubeflow.org/queued-at"
 # User-set gang priority (integer, default 0); larger schedules first.
 PRIORITY_ANNOTATION = "scheduling.kubeflow.org/priority"
+# Structured placement explanation (scheduler/explain.py): ONE annotation
+# write — crash-safe like the bind — carrying the per-pool verdict trail for
+# a gang the pack phase failed to place (why each pool rejected the shape,
+# whether preemption was considered and why it was rejected, whether the
+# fleet is merely fragmented). Written at the unschedulable transition,
+# refreshed when the fleet state it describes moves, cleared by the bind
+# write itself; the soaks re-prove every claim against the ground-truth
+# fleet per seed (explain.audit_explanations).
+EXPLANATION_ANNOTATION = "scheduling.kubeflow.org/explanation"
 
 # Status condition types the scheduler owns on a Notebook. Everything else
 # in .status.conditions belongs to the notebook controller, which preserves
@@ -89,6 +98,34 @@ def encode_placement(slices: list[dict], bound_at: float) -> str:
     return json.dumps(
         {"boundAt": bound_at, "slices": slices}, sort_keys=True
     )
+
+
+def explanation_of(nb: Mapping) -> dict | None:
+    """Decode the placement explanation from a Notebook CR, or None.
+
+    Same posture as :func:`placement_of`: a malformed annotation (user-
+    edited garbage) reads as absent — consumers fall back to the condition
+    message rather than 500 on it, and the scheduler rewrites it on the
+    next refresh."""
+    raw = (nb.get("metadata", {}).get("annotations") or {}).get(
+        EXPLANATION_ANNOTATION
+    )
+    if not raw:
+        return None
+    try:
+        exp = json.loads(raw)
+    except ValueError:
+        return None
+    if not isinstance(exp, dict) or not exp.get("reason"):
+        return None
+    return exp
+
+
+def encode_explanation(payload: Mapping) -> str:
+    """Canonical explanation encoding (sorted keys, like the placement
+    codec: the soaks fingerprint annotations, and write-skipping compares
+    encoded strings, so the encoding must be deterministic)."""
+    return json.dumps(payload, sort_keys=True)
 
 
 def gang_priority(nb: Mapping) -> int:

@@ -43,6 +43,7 @@ instead of double-booking (the sessions soak arms exactly that crash).
 """
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -58,6 +59,7 @@ from kubeflow_tpu.scheduler import (
     COND_PREEMPTED,
     COND_QUEUED,
     COND_UNSCHEDULABLE,
+    EXPLANATION_ANNOTATION,
     PLACEMENT_ANNOTATION,
     QUEUED_AT_ANNOTATION,
     condition,
@@ -67,6 +69,7 @@ from kubeflow_tpu.scheduler import (
     placement_matches,
     placement_of,
 )
+from kubeflow_tpu.scheduler import explain as explain_mod
 from kubeflow_tpu.scheduler import preemption as preempt
 from kubeflow_tpu.scheduler.fleet import Fleet, FitCache, FleetModel
 from kubeflow_tpu.scheduler.preemption import BoundGang
@@ -117,6 +120,8 @@ class SchedulerReconciler(Reconciler):
         families: frozenset[str] | None = None,
         router: "sharding.ShardRouter | None" = None,
         shard_id: int = 0,
+        explain: bool = True,
+        explain_budget: int = explain_mod.DEFAULT_EXPLAIN_BUDGET,
     ) -> None:
         self.metrics = metrics
         # EventRecorder (obs/events.py): Queued/Bound/Preempted/Unschedulable
@@ -149,6 +154,16 @@ class SchedulerReconciler(Reconciler):
         self._feasible: dict[tuple, bool] = {}
         self._feasible_sig: tuple | None = None
         self._geo_gen = 0  # bumps when fleet geometry changes (adm cache)
+        # Placement explainability (scheduler/explain.py): per-gang verdict
+        # state carried across cycles like the fit cache — advisory only, a
+        # crash-restart starts cold and re-adopts reason/since from the
+        # annotations themselves. ``explain=False`` (the bench's A/B arm
+        # for measuring the layer's overhead) skips the phase entirely.
+        self._explainer = (
+            explain_mod.ExplainRecorder(metrics=metrics, budget=explain_budget)
+            if explain
+            else None
+        )
         # When True, every cycle cross-checks the incremental model against
         # a from-scratch rebuild + full replay (the soak's differential
         # audit); mismatches accumulate in audit_failures.
@@ -443,12 +458,24 @@ class SchedulerReconciler(Reconciler):
                     deferred.add(view.key)
 
         # -- pack phase: the scheduling pass ------------------------------
-        newly_bound, handoffs = self._schedule(
+        newly_bound, handoffs, pack_notes = self._schedule(
             cluster, fleet, queue, bound, preempted_now, now, nb_by_key,
             deferred,
         )
         barrier_pending = barrier_pending or handoffs
         t_pack = self.clock()
+
+        # -- explain phase (scheduler/explain.py): every gang the pack
+        # phase actually judged and failed — admission-unschedulable gangs,
+        # blocked heads, attempted-but-failed backfills, handoff-frozen
+        # waiters — gets the structured per-pool verdict trail as ONE
+        # annotation write per transition. Gangs the pass never attempted
+        # (behind a head, outside the backfill window) carry no explanation:
+        # a verdict nobody re-proves each cycle would go stale and lie.
+        if self._explainer is not None:
+            self._explain(cluster, fleet, views, bound, newly_bound,
+                          unschedulable, pack_notes, now)
+        t_explain = self.clock()
 
         # -- write phase: status conditions + metrics ---------------------
         # The loop is the batched write pass: desired conditions reduce to
@@ -478,11 +505,14 @@ class SchedulerReconciler(Reconciler):
                 sig = ("unschedulable", msg)
                 if sig == view.conds_sig and view.rv == view.conds_rv:
                     continue
-                if not (
+                # the transition Event is emitted by the explain phase (it
+                # carries the verdict reason and dedups on it) — unless
+                # explain is off, in which case the historical transition
+                # emit here keeps `kubectl get events` answering at all
+                if self._explainer is None and not (
                     (condition(view.nb, COND_UNSCHEDULABLE) or {}).get(
                         "status") == "True"
                 ):
-                    # transition into Unschedulable (not the steady state)
                     self._emit(
                         cluster, view.nb, "Unschedulable", msg,
                         type_="Warning",
@@ -544,9 +574,32 @@ class SchedulerReconciler(Reconciler):
                     "list": max(0.0, t_list - cycle_started),
                     "replay": max(0.0, t_replay - t_list),
                     "pack": max(0.0, t_pack - t_replay),
-                    "write": max(0.0, t_write - t_pack),
+                    "explain": max(0.0, t_explain - t_pack),
+                    "write": max(0.0, t_write - t_explain),
+                },
+                # every family the fleet models reads a depth (0 when its
+                # queue drained — absence means the family LEFT the fleet,
+                # and its series is retired)
+                family_depths={
+                    **{p.accel.name: 0 for p in fleet.pools.values()},
+                    **queue.family_depths(),
+                },
+                # fragmentation telemetry off the live free decompositions:
+                # O(pools) per cycle, the defrag-trigger series the
+                # live-migration and autoscaling roadmap items consume
+                pool_stats={
+                    name: (
+                        explain_mod.fragmentation_index(p),
+                        explain_mod.largest_free_cuboid_cells(p)
+                        * p.chips_per_block,
+                    )
+                    for name, p in fleet.pools.items()
                 },
             )
+            if self._explainer is not None:
+                self.metrics.set_would_fit_after_defrag(
+                    self._explainer.would_fit_count()
+                )
             hits, misses = self._fit_cache.hits, self._fit_cache.misses
             seen_h, seen_m = self._fit_seen
             self.metrics.observe_fit_cache(hits - seen_h, misses - seen_m)
@@ -593,6 +646,86 @@ class SchedulerReconciler(Reconciler):
                 continue
             # _patch_annotations folded the stored body back into the view
             # cache, so the rest of this cycle sees the adopted stamp
+
+    def _explain(
+        self,
+        cluster: FakeCluster,
+        fleet: Fleet,
+        views: list,
+        bound: dict,
+        newly_bound: set[str],
+        unschedulable: dict[str, str],
+        pack_notes: dict[str, dict],
+        now: float,
+    ) -> None:
+        """The explain phase: reconcile every gang's explanation annotation
+        with what the pack phase just proved about it. Steady state is
+        free — the recorder's signature check (per-pool occupancy versions
+        + the pack note) returns the cached encoding without touching
+        geometry, and equal encodings skip the write entirely; recomputes
+        are budget-bounded per cycle (overflow keeps last cycle's
+        annotation; blocked gangs persist, so the budget catches up)."""
+        self._explainer.begin_cycle()
+        self._explainer.sweep({v.key for v in views})
+        stamp = (
+            self._router.stamp(self.shard_id)
+            if self._router is not None
+            else None
+        )
+        for view in views:
+            key = view.key
+            if key in bound or key in newly_bound:
+                # the bind write itself cleared the annotation; close out
+                # the time-in-reason observation
+                self._explainer.clear(key, now)
+                continue
+            note = pack_notes.get(key)
+            if note is None and key in unschedulable:
+                note = {"role": "unschedulable"}
+            if note is None or not _wants_capacity(view.nb):
+                # not judged this cycle (stopped, or waiting behind a head
+                # outside the attempted set): an explanation nobody
+                # re-proves would go stale — drop it
+                self._explainer.clear(key, now)
+                if EXPLANATION_ANNOTATION in ko.annotations(view.nb):
+                    try:
+                        self._patch_annotations(
+                            cluster, view.nb,
+                            {EXPLANATION_ANNOTATION: None},
+                        )
+                    except (NotFound, Conflict):
+                        pass  # next cycle retries the clear
+                continue
+            # adopt() first: on a fresh incarnation it resumes the persisted
+            # reason/since from the annotation, so a restart neither re-emits
+            # the transition Event nor resets the time-in-reason clock
+            prev_reason = self._explainer.adopt(view, now)
+            encoded = self._explainer.explain(
+                view, fleet, note, now, shard=stamp
+            )
+            if encoded is None:
+                continue  # budget spent: keep last write, catch up later
+            reason = self._explainer.reason_of(key)
+            if reason is not None and reason != prev_reason:
+                # transition INTO a blocking verdict (never the steady
+                # state): the deduped Unschedulable Event carries the
+                # verdict, so `kubectl get events` answers "why not".
+                # Emitted BEFORE the annotation patch: the recorder already
+                # committed the transition (counter, since-clock), so a
+                # raced patch below must not swallow the one Event — the
+                # annotation itself retries via the encoding compare.
+                self._emit(
+                    cluster, view.nb, "Unschedulable",
+                    f"{reason}: {json.loads(encoded).get('message', '')}",
+                    type_="Warning",
+                )
+            if ko.annotations(view.nb).get(EXPLANATION_ANNOTATION) != encoded:
+                try:
+                    self._patch_annotations(
+                        cluster, view.nb, {EXPLANATION_ANNOTATION: encoded}
+                    )
+                except (NotFound, Conflict):
+                    continue  # raced a delete/write; next cycle retries
 
     def _admit(
         self,
@@ -688,7 +821,7 @@ class SchedulerReconciler(Reconciler):
         now: float,
         nb_by_key: dict[str, dict] | None = None,
         deferred: set[str] | None = None,
-    ) -> tuple[set[str], bool]:
+    ) -> tuple[set[str], bool, dict[str, dict]]:
         """Admission in effective-priority order; preemption for a blocked
         head, then hole-backfill of strictly smaller gangs behind it. Heads
         are PER ACCELERATOR: a blocked v4 head says nothing about v5e
@@ -698,8 +831,21 @@ class SchedulerReconciler(Reconciler):
         re-enters *behind* the position it was evicted for, never ahead of
         the head that evicted it). Every bind commits through the cluster
         before the next decision, so the fleet model and the annotation set
-        move in lockstep."""
+        move in lockstep.
+
+        Third return: the pack notes — one entry per gang this pass JUDGED
+        and failed (a blocked head with its preemption trail, a failed or
+        frozen backfill attempt), the raw material the explain phase turns
+        into verdict annotations. Gangs the pass never attempted (behind a
+        head past the backfill window, or not strictly smaller than it)
+        get no note: an explanation nobody re-proves would go stale."""
         newly_bound: set[str] = set()
+        pack_notes: dict[str, dict] = {}
+        # note-taking (incl. the O(bound) juniors scan per blocked head) is
+        # work whose only consumer is the explain phase: with explain off,
+        # skip it entirely so the --no-explain A/B arm measures the whole
+        # layer, not just the phase
+        explaining = self._explainer is not None
         handoffs = False
         order = queue.ordered(now)
         if nb_by_key is not None:
@@ -790,6 +936,16 @@ class SchedulerReconciler(Reconciler):
                 # fixed-point audit re-derives)
                 behind[accel] += 1
                 if accel in barrier_accels:
+                    # judged by the barrier itself: backfill is frozen on
+                    # this accelerator until the handoff resolves
+                    if explaining:
+                        pack_notes[req.key] = {
+                            "role": "waiting", "head": head.key,
+                            "preemption": {
+                                "considered": False, "outcome": "",
+                                "why": explain_mod.PREEMPT_FROZEN,
+                            },
+                        }
                     continue
                 if behind[accel] > self.backfill_window:
                     continue
@@ -797,8 +953,11 @@ class SchedulerReconciler(Reconciler):
                     continue
                 if fleet.accel_free_cells(accel) == 0:
                     # saturation short-circuit: zero free host cells means
-                    # no backfill can possibly fit — skip the attempt (the
-                    # head already ran its preemption trial above)
+                    # no backfill can possibly fit — the judgment IS the
+                    # attempt (the explain phase re-proves it from the same
+                    # zero-free-cells state), so the note still lands
+                    if explaining:
+                        pack_notes[req.key] = _backfill_note(head)
                     continue
                 slices = fleet.place_gang(
                     req.key, req.topo, req.num_slices,
@@ -808,6 +967,8 @@ class SchedulerReconciler(Reconciler):
                     self._commit_bind(cluster, req, slices, now)
                     queue.discard(req.key)
                     newly_bound.add(req.key)
+                elif explaining:
+                    pack_notes[req.key] = _backfill_note(head)
                 continue
             slices = fleet.place_gang(
                 req.key, req.topo, req.num_slices, fit_cache=self._fit_cache
@@ -838,6 +999,14 @@ class SchedulerReconciler(Reconciler):
                     blocked[accel] = req
                     behind[accel] = 0
                     barrier_accels.add(accel)
+                    if explaining:
+                        pack_notes[req.key] = {
+                            "role": "head",
+                            "preemption": {
+                                "considered": True, "outcome": "accepted",
+                                "why": explain_mod.PREEMPT_HANDOFF,
+                            },
+                        }
                     continue
                 for v in victims:
                     self._evict(cluster, v, req, preempted_now)
@@ -858,10 +1027,29 @@ class SchedulerReconciler(Reconciler):
                 continue
             # blocked and nothing junior frees enough: this gang becomes its
             # accelerator's head; everything behind it (same accel) is
-            # backfill-only until capacity changes
+            # backfill-only until capacity changes. The note distinguishes
+            # "no strictly-junior victims exist" from "evicting all of them
+            # still would not fit" — the audit re-proves whichever is
+            # claimed against the real bound set.
+            if explaining:
+                juniors = any(
+                    v.topo.accelerator.name == accel
+                    and preempt.eligible_victim(v, req)
+                    for v in bound.values()
+                )
+                pack_notes[req.key] = {
+                    "role": "head",
+                    "preemption": {
+                        "considered": True, "outcome": "rejected",
+                        "why": (
+                            explain_mod.PREEMPT_INSUFFICIENT_RECLAIM
+                            if juniors else explain_mod.PREEMPT_NO_JUNIORS
+                        ),
+                    },
+                }
             blocked[accel] = req
             behind[accel] = 0
-        return newly_bound, handoffs
+        return newly_bound, handoffs, pack_notes
 
     # ------------------------------------------------------------- commits
 
@@ -882,6 +1070,11 @@ class SchedulerReconciler(Reconciler):
                 "Notebook", name, ns,
                 {"metadata": {"annotations": {
                     PLACEMENT_ANNOTATION: encode_placement(slices, now),
+                    # the bind write IS the explanation clear: one atomic
+                    # patch, so no crash window where a bound gang still
+                    # claims it cannot be placed (the audit checks exactly
+                    # this)
+                    EXPLANATION_ANNOTATION: None,
                 }}},
             )
             self._nb_cache.store(stored)
@@ -1002,6 +1195,10 @@ class SchedulerReconciler(Reconciler):
         anns: dict = {PLACEMENT_ANNOTATION: None}
         if drop_queued_at:
             anns[QUEUED_AT_ANNOTATION] = None
+        if EXPLANATION_ANNOTATION in ko.annotations(nb_obj):
+            # a stale verdict must not outlive the claim it judged (a
+            # stopped gang, or a spec edit re-queueing from scratch)
+            anns[EXPLANATION_ANNOTATION] = None
         try:
             self._patch_annotations(cluster, nb_obj, anns)
         except NotFound:
@@ -1313,6 +1510,18 @@ def _nb_key(nb: dict) -> str:
 
 def _wants_capacity(nb: dict) -> bool:
     return api.STOP_ANNOTATION not in ko.annotations(nb)
+
+
+def _backfill_note(head: GangRequest) -> dict:
+    """Pack note for a gang that tried (or was proven unable) to backfill
+    behind a blocked head: preemption is not considered for non-heads."""
+    return {
+        "role": "backfill", "head": head.key,
+        "preemption": {
+            "considered": False, "outcome": "",
+            "why": explain_mod.PREEMPT_NOT_HEAD,
+        },
+    }
 
 
 

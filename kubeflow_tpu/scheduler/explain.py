@@ -1,0 +1,709 @@
+"""Placement explainability: per-pool scheduling verdicts, fragmentation
+telemetry, and the per-seed explanation audit (docs/scheduler.md
+"explainability").
+
+The platform's contract is that a user who asks for a TPU slice either
+gets chips or gets told *why not* — but the pack phase used to collapse
+every failure to one generic string. The knowledge was all there (which
+pool rejected which orientation and why, whether preemption was even an
+option), computed and thrown away every cycle. This module keeps it:
+
+- :func:`pool_verdict` judges ONE pool against ONE slice shape from the
+  pool's live free decomposition: ``ShapeNeverFits`` (no orientation fits
+  the torus even empty), ``Fragmented`` (free chips suffice but no free
+  cuboid admits any orientation — the defrag signal), ``BlockedHosts``
+  (the fit exists once drained/missing hosts heal), ``InsufficientFree``
+  (capacity genuinely in use), ``SliceFits`` (this pool could take one
+  slice; the gang failed elsewhere — multislice spread).
+- :class:`ExplainRecorder` is the controller-side state machine: pack-
+  phase failures become ONE ``scheduling.kubeflow.org/explanation``
+  annotation write per transition, skipped entirely while the per-pool
+  occupancy ``version`` tokens are unchanged (a steady blocked queue
+  costs a tuple compare per gang, never a re-pack) and bounded per cycle
+  (``budget``) so a pathological cycle cannot turn explanation work into
+  the new hot path. Reason transitions feed
+  ``scheduler_unschedulable_total{reason}`` and the time-in-reason
+  histogram; ``since`` is persisted in the annotation so a crash-restart
+  resumes the clock instead of resetting it.
+- :func:`audit_explanations` is the soak-side prover: every claim in
+  every emitted explanation is re-derived from the ground-truth fleet
+  (Nodes + committed placements). If an explanation says "no v4 pool has
+  a free 2x2x2", the auditor packs the shape against the real free sets
+  and must also fail; a planted false verdict fails the seed. That audit
+  is what makes the surface trustworthy enough to page on.
+
+Fragmentation telemetry rides the same geometry — a pool's fragmentation
+index is largest-free-cuboid ÷ free host cells (1.0 = one contiguous
+hole, →0 = shattered), and ``would_fit_after_defrag`` counts waiting
+gangs whose only blocker is contiguity: the exact trigger signal the
+live-migration and elastic-capacity roadmap items consume ("more chips
+would NOT help; defrag would").
+"""
+from __future__ import annotations
+
+import math
+from typing import Iterable, Mapping
+
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu import sessions as sess
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.scheduler import binpack
+from kubeflow_tpu.scheduler import preemption as preempt
+from kubeflow_tpu.scheduler.fleet import Fleet, Pool, _BLOCKED_PREFIX
+from kubeflow_tpu.scheduler.queue import GangRequest
+from kubeflow_tpu.tpu.topology import SliceTopology
+
+# Per-cycle cap on explanation (re)computations. Each one is a handful of
+# read-only fit probes over the gang's family pools — cheap, but a 10k-gang
+# backlog transitioning at once must not turn the pack phase's tail into
+# explanation work. Overflow simply keeps last cycle's annotation; blocked
+# gangs persist, so the budget catches up within a few cycles (the audit
+# runs at the quiesced fixed point, where it has).
+DEFAULT_EXPLAIN_BUDGET = 32
+
+# Gang-level reasons (the `reason` field — the top blocking verdict).
+REASON_SHAPE_NEVER_FITS = "ShapeNeverFits"
+REASON_FRAGMENTED = "Fragmented"
+REASON_BLOCKED_HOSTS = "BlockedHosts"
+REASON_INSUFFICIENT = "InsufficientCapacity"
+REASON_AWAITING_HANDOFF = "AwaitingHandoff"
+
+# Per-pool verdicts (the `pools[].verdict` field).
+VERDICT_SHAPE_NEVER_FITS = "ShapeNeverFits"
+VERDICT_FRAGMENTED = "Fragmented"
+VERDICT_BLOCKED_HOSTS = "BlockedHosts"
+VERDICT_INSUFFICIENT_FREE = "InsufficientFree"
+VERDICT_SLICE_FITS = "SliceFits"
+
+# Preemption-trail phrasings (the `preemption.why` field).
+PREEMPT_NO_JUNIORS = "no strictly-junior victims"
+PREEMPT_INSUFFICIENT_RECLAIM = (
+    "evicting every junior gang still would not fit this gang"
+)
+PREEMPT_HANDOFF = (
+    "victims are suspending; chips hand over when their snapshots commit"
+)
+PREEMPT_NOT_HEAD = "not at the head of its queue"
+PREEMPT_FROZEN = "backfill frozen while a suspend handoff resolves"
+
+
+# ------------------------------------------------------------ pure geometry
+
+
+def fitting_orientations(
+    pool: Pool, topo: SliceTopology
+) -> list[tuple[tuple[int, ...], tuple[int, ...]]]:
+    """The request orientations that fit this pool's torus when EMPTY —
+    geometry only, independent of occupancy."""
+    return [
+        (chips, blocks)
+        for chips, blocks in binpack.orientations(pool.accel, topo.shape)
+        if all(b <= g for b, g in zip(blocks, pool.grid))
+    ]
+
+
+def min_block_cells(pool: Pool, topo: SliceTopology) -> int | None:
+    """Fewest host cells any geometrically-valid orientation needs in this
+    pool, or None when no orientation fits even an empty torus."""
+    opts = fitting_orientations(pool, topo)
+    if not opts:
+        return None
+    return min(math.prod(blocks) for _, blocks in opts)
+
+
+def slice_fits_now(pool: Pool, topo: SliceTopology) -> bool:
+    """Exact, read-only single-slice fit probe against the live free set
+    (the same ``best_fit_free`` the bind path uses, so "the auditor packs
+    the shape against the real free sets" is literally this call)."""
+    return binpack.best_fit_free(pool.free_space, pool.accel, topo.shape) is not None
+
+
+def slice_fits_if_healthy(pool: Pool, topo: SliceTopology) -> bool:
+    """Would one slice fit if every drained/missing host healed, with the
+    bound gangs keeping their carves? Distinguishes "chips are in use"
+    from "chips are gone" — the verdict a drain causes."""
+    blocked = [
+        cub for key, cub in pool.used.items()
+        if key.startswith(_BLOCKED_PREFIX)
+    ]
+    if not blocked:
+        return False  # nothing to heal; the live probe already answered
+    healthy = pool.free_space.clone()
+    for cub in blocked:
+        healthy.release(cub)
+    return binpack.best_fit_free(healthy, pool.accel, topo.shape) is not None
+
+
+def largest_free_cuboid_cells(pool: Pool) -> int:
+    return max((c.volume for c in pool.free_space.cuboids), default=0)
+
+
+def fragmentation_index(pool: Pool) -> float:
+    """Largest free cuboid ÷ free host cells, in [0, 1]. 1.0 means the free
+    space is one contiguous hole (or the pool is full — nothing to
+    fragment); values toward 0 mean the same chip count is shattered into
+    unusably small holes. Host cells and chips give the identical ratio
+    (chips-per-block cancels), so this is also largest-free-cuboid-chips ÷
+    free chips, the form the dashboard labels it with."""
+    free = pool.free_cells()
+    if free == 0:
+        return 1.0
+    return largest_free_cuboid_cells(pool) / free
+
+
+def pool_verdict(pool: Pool, topo: SliceTopology) -> dict:
+    """One pool's verdict for one slice shape, derived ONLY from the pool's
+    live state — the audit re-runs this exact function on the ground-truth
+    fleet, so every field is a checkable claim, not prose.
+
+    Verdict ranking (first match wins):
+      ShapeNeverFits   — no orientation fits the empty torus;
+      SliceFits        — a slice fits right now (the gang failed elsewhere:
+                         multislice spread, or this pool filled mid-trial);
+      Fragmented       — enough free cells for some orientation, but no
+                         placement exists: contiguity is the only blocker;
+      BlockedHosts     — too few free cells, and healing drained/missing
+                         hosts would admit the slice;
+      InsufficientFree — the capacity is genuinely held by other gangs.
+    """
+    free_cells = pool.free_cells()
+    out = {
+        "pool": pool.name,
+        "freeChips": pool.free_chips(),
+        "largestFreeCuboidChips": largest_free_cuboid_cells(pool)
+        * pool.chips_per_block,
+        "fragmentationIndex": round(fragmentation_index(pool), 4),
+    }
+    need = min_block_cells(pool, topo)
+    if need is None:
+        out["verdict"] = VERDICT_SHAPE_NEVER_FITS
+        return out
+    if slice_fits_now(pool, topo):
+        out["verdict"] = VERDICT_SLICE_FITS
+        return out
+    if free_cells >= need:
+        out["verdict"] = VERDICT_FRAGMENTED
+        return out
+    if slice_fits_if_healthy(pool, topo):
+        out["verdict"] = VERDICT_BLOCKED_HOSTS
+        return out
+    out["verdict"] = VERDICT_INSUFFICIENT_FREE
+    return out
+
+
+def would_fit_after_defrag(
+    pools: Iterable[Pool], topo: SliceTopology, num_slices: int
+) -> bool:
+    """Would the gang fit if free space were compacted (live migration /
+    defrag), with nothing evicted and no hosts healed?
+
+    Free cell COUNTS are invariant under migration, so the gang fits after
+    some defrag only if its slices can be assigned to pools such that each
+    pool has enough free cells for its share and the shape fits the pool's
+    torus at all. Slices of one gang are identical, so the assignment
+    reduces to capacity counting: sum over geometrically-eligible pools of
+    floor(free_cells / min-orientation-cells) ≥ num_slices. This is the
+    optimistic bound — True means "defrag may admit it, more chips
+    definitely aren't needed"; False means only new capacity (or
+    preemption) can help. The roadmap's live-migration and autoscaler
+    items branch on exactly this bit."""
+    capacity = 0
+    for pool in pools:
+        need = min_block_cells(pool, topo)
+        if need is None:
+            continue
+        capacity += pool.free_cells() // need
+        if capacity >= max(1, num_slices):
+            return True
+    return False
+
+
+# ------------------------------------------------------- gang-level verdict
+
+
+def _gang_reason(
+    pool_verdicts: list[dict],
+    topo: SliceTopology,
+    num_slices: int,
+    note: Mapping,
+    wfad: bool,
+) -> tuple[str, str]:
+    """(reason, human message) — the top blocking verdict the spawner
+    shows. Pure function of the per-pool verdicts and the pack note, so
+    the audit can re-derive it."""
+    fam = topo.accelerator.name
+    gang = topo.slice_name + (f" x{num_slices}" if num_slices > 1 else "")
+    pre = note.get("preemption") or {}
+    if note.get("role") == "unschedulable":
+        # admission's verdict (feasible_on_empty == False): no combination
+        # of this fleet's pools can EVER hold the gang — stronger than any
+        # per-pool verdict (a multislice gang can be unschedulable even
+        # when each slice alone would fit somewhere)
+        return (
+            REASON_SHAPE_NEVER_FITS,
+            f"no {fam} node pools can hold {gang} in any orientation, "
+            f"even on an empty fleet",
+        )
+    if pre.get("outcome") == "accepted" or pre.get("why") == PREEMPT_FROZEN:
+        return (
+            REASON_AWAITING_HANDOFF,
+            f"{gang} is next in line: a preemption handoff is in flight on "
+            f"{fam} and chips hand over when the victims' snapshots commit",
+        )
+    if not pool_verdicts:
+        return (
+            REASON_SHAPE_NEVER_FITS,
+            f"no {fam} node pools exist in this fleet",
+        )
+    if all(
+        v["verdict"] == VERDICT_SHAPE_NEVER_FITS for v in pool_verdicts
+    ):
+        return (
+            REASON_SHAPE_NEVER_FITS,
+            f"no {fam} node pool can hold {gang} in any orientation",
+        )
+    free = sum(v["freeChips"] for v in pool_verdicts)
+    if wfad:
+        largest = max(v["largestFreeCuboidChips"] for v in pool_verdicts)
+        return (
+            REASON_FRAGMENTED,
+            f"{fam} capacity is fragmented: {free} chips are free (largest "
+            f"contiguous block {largest}) but no pool offers a contiguous "
+            f"{gang}; defragmentation would admit it",
+        )
+    if any(v["verdict"] == VERDICT_BLOCKED_HOSTS for v in pool_verdicts):
+        return (
+            REASON_BLOCKED_HOSTS,
+            f"{gang} would fit once drained or missing {fam} hosts return",
+        )
+    needed = topo.num_chips * max(1, num_slices)
+    if free >= needed:
+        # enough chips in total, but split across pools in holes too small
+        # for even one slice (per-pool wfad floored to zero) — saying
+        # "exhausted: 24 free, needs 16" would contradict itself
+        msg = (
+            f"{fam} free capacity is unusable for {gang}: {free} chips "
+            f"free but split across pools in holes too small for its slices"
+        )
+    else:
+        msg = (
+            f"{fam} capacity is exhausted: {free} chips free, "
+            f"{gang} needs {needed}"
+        )
+    if pre.get("outcome") == "rejected" and pre.get("why"):
+        msg += f"; preemption rejected ({pre['why']})"
+    return (REASON_INSUFFICIENT, msg)
+
+
+class ExplainRecorder:
+    """Controller-side explanation state, carried across cycles like the
+    fit cache: advisory in-memory acceleration over the annotation-is-the-
+    store contract (a crash-restart starts cold and re-derives everything,
+    `since` included, from the annotations themselves).
+
+    ``explain`` returns the encoded annotation value the gang SHOULD carry
+    — or None when the budget is spent (keep whatever is written; later
+    cycles catch up). The signature check makes the steady state free:
+    while the gang's rv-independent inputs (shape, role, preemption note)
+    and every family pool's occupancy ``version`` are unchanged, the cached
+    encoding is returned without touching geometry."""
+
+    def __init__(self, *, metrics=None, budget: int = DEFAULT_EXPLAIN_BUDGET) -> None:
+        self.metrics = metrics
+        self.budget = budget
+        self._budget_left = budget
+        # key -> {"sig", "encoded", "reason", "since", "wfad"}
+        self._state: dict[str, dict] = {}
+
+    def begin_cycle(self) -> None:
+        self._budget_left = self.budget
+
+    def adopt(self, view, now: float) -> str | None:
+        """Ensure the gang has recorder state and return its current reason.
+
+        On a fresh incarnation the reason + since are adopted from the
+        persisted annotation, so the caller's transition check (emit the
+        Unschedulable Event only when the reason CHANGES) sees a restart as
+        the steady state it is, and the time-in-reason clock keeps running
+        across crashes instead of resetting."""
+        entry = self._state.get(view.key)
+        if entry is None:
+            prev = sched.explanation_of(view.nb)
+            try:
+                since = float(prev.get("since", now)) if prev else now
+            except (TypeError, ValueError):
+                since = now  # user-edited garbage: restart the clock
+            entry = {
+                "sig": None,
+                "encoded": None,
+                "reason": prev.get("reason") if prev else None,
+                "since": since,
+                "wfad": bool(prev.get("wouldFitAfterDefrag"))
+                if prev else False,
+            }
+            self._state[view.key] = entry
+        return entry["reason"]
+
+    def reason_of(self, key: str) -> str | None:
+        entry = self._state.get(key)
+        return entry["reason"] if entry else None
+
+    # ------------------------------------------------------------- recording
+
+    def explain(
+        self,
+        view,
+        fleet: Fleet,
+        note: Mapping,
+        now: float,
+        *,
+        shard: str | None = None,
+    ) -> str | None:
+        topo, num_slices = view.topo, view.num_slices
+        fam = topo.accelerator.name
+        pools = sorted(
+            (p for p in fleet.pools.values() if p.accel.name == fam),
+            key=lambda p: p.name,
+        )
+        pre = note.get("preemption") or {
+            "considered": False, "why": PREEMPT_NOT_HEAD,
+        }
+        sig = (
+            fam,
+            tuple(sorted(topo.shape)),
+            num_slices,
+            note.get("role", ""),
+            note.get("head", ""),
+            pre.get("outcome", ""),
+            pre.get("why", ""),
+            shard or "",
+            tuple((p.name, p.version) for p in pools),
+        )
+        self.adopt(view, now)
+        entry = self._state[view.key]
+        if entry["sig"] == sig and entry["encoded"] is not None:
+            return entry["encoded"]
+        if self._budget_left <= 0:
+            return None
+        self._budget_left -= 1
+
+        verdicts = [pool_verdict(p, topo) for p in pools]
+        wfad = would_fit_after_defrag(pools, topo, num_slices)
+        reason, message = _gang_reason(
+            verdicts, topo, num_slices, note, wfad
+        )
+        if reason != entry["reason"]:
+            if self.metrics is not None:
+                self.metrics.observe_reason_transition(
+                    reason,
+                    prev=entry["reason"],
+                    seconds_in_prev=max(0.0, now - entry["since"]),
+                )
+            entry["reason"] = reason
+            entry["since"] = now
+        payload: dict = {
+            "reason": reason,
+            "message": message,
+            "since": entry["since"],
+            "role": note.get("role", "unschedulable"),
+            "shape": {
+                "accelerator": fam,
+                "chips": sorted(topo.shape),
+                "numSlices": num_slices,
+            },
+            "wouldFitAfterDefrag": wfad,
+            "preemption": dict(pre),
+            "pools": verdicts,
+        }
+        if note.get("head"):
+            payload["headKey"] = note["head"]
+        if shard is not None:
+            payload["shard"] = shard
+        entry["sig"] = sig
+        entry["encoded"] = sched.encode_explanation(payload)
+        entry["wfad"] = wfad
+        return entry["encoded"]
+
+    # ------------------------------------------------------------- lifecycle
+
+    def clear(self, key: str, now: float) -> None:
+        """The gang left the blocked set (bound, stopped, explanation
+        dropped): close out its time-in-reason observation."""
+        entry = self._state.pop(key, None)
+        if entry is None or entry["reason"] is None:
+            return
+        if self.metrics is not None:
+            self.metrics.observe_reason_transition(
+                None,
+                prev=entry["reason"],
+                seconds_in_prev=max(0.0, now - entry["since"]),
+            )
+
+    def sweep(self, alive: set[str]) -> None:
+        """Drop state for gangs that vanished (deleted mid-cycle): nothing
+        to observe — the object, its annotation, and its clock are gone."""
+        for key in [k for k in self._state if k not in alive]:
+            del self._state[key]
+
+    def would_fit_count(self) -> int:
+        return sum(1 for e in self._state.values() if e.get("wfad"))
+
+
+# ----------------------------------------------------------- the probe route
+
+
+def install_explain_route(app, cluster) -> None:
+    """Mount /debug/explain/<ns>/<name> on a web App (the probe port, next
+    to /debug/traces and /debug/timeline — cluster-internal, never the
+    gateway): the decoded explanation plus the scheduler-owned conditions,
+    the "why is my notebook still pending" page for operators."""
+    import json as _json
+
+    from werkzeug.wrappers import Response
+
+    @app.route("/debug/explain/<namespace>/<name>")
+    def debug_explain(request, namespace, name):
+        nb = cluster.try_get("Notebook", name, namespace)
+        if nb is None:
+            return Response(
+                _json.dumps({"error": "no such notebook"}),
+                status=404, mimetype="application/json",
+            )
+        payload = {
+            "namespace": namespace,
+            "name": name,
+            "bound": sched.placement_of(nb) is not None,
+            "explanation": sched.explanation_of(nb),
+            "conditions": [
+                c
+                for c in (nb.get("status") or {}).get("conditions", []) or []
+                if c.get("type") in sched.SCHEDULER_CONDITION_TYPES
+            ],
+        }
+        return Response(
+            _json.dumps(payload, sort_keys=True),
+            mimetype="application/json",
+        )
+
+
+# ------------------------------------------------------------------ the audit
+
+
+def _ground_truth(base) -> tuple[Fleet, list[preempt.BoundGang], list[dict]]:
+    """The real fleet as the scheduler must have seen it: pools from live
+    Nodes (drained/missing hosts BLOCKED — unlike the double-booking
+    audit's healthy fleet, explanations are claims about usable space) with
+    every committed placement replayed in."""
+    fleet = Fleet.from_nodes(base.list("Node"))
+    bound: list[preempt.BoundGang] = []
+    notebooks = []
+    for nb in base.list("Notebook"):
+        try:
+            topo = api.notebook_topology(nb)
+        except ValueError:
+            topo = None
+        if topo is None:
+            continue
+        key = f"{ko.namespace(nb)}/{ko.name(nb)}"
+        num_slices = api.notebook_num_slices(nb)
+        placement = sched.placement_of(nb)
+        if placement is not None:
+            fleet.occupy_gang(key, placement["slices"])
+            anns = ko.annotations(nb)
+            try:
+                queued_at = float(anns.get(sched.QUEUED_AT_ANNOTATION, 0.0))
+            except (TypeError, ValueError):
+                queued_at = 0.0
+            bound.append(preempt.BoundGang(
+                key=key,
+                priority=sched.gang_priority(nb),
+                queued_at=queued_at,
+                chips=topo.num_chips * num_slices,
+                topo=topo,
+                num_slices=num_slices,
+            ))
+        notebooks.append(
+            {"nb": nb, "topo": topo, "key": key,
+             "num_slices": num_slices, "placement": placement}
+        )
+    return fleet, bound, notebooks
+
+
+def audit_explanations(
+    base, *, router=None, where: str = "final"
+) -> list[str]:
+    """The per-seed explanation audit (docs/chaos.md): every emitted
+    explanation's claims re-proven against the ground-truth fleet, plus
+    the lifecycle invariants. Runs at the quiesced fixed point (healed
+    data plane), where the scheduler has had every chance to refresh —
+    any surviving mismatch is a real lie, not a transient.
+
+    - a BOUND or STOPPED gang carries no explanation (cleared on bind /
+      teardown), and an explanation's recorded shape matches the CURRENT
+      spec (wiped on spec.tpu edit);
+    - per-pool verdicts equal :func:`pool_verdict` recomputed on the real
+      pool — which re-packs the shape against the real free set, so
+      "Fragmented"/"InsufficientFree" with a shape that actually fits is
+      caught here (the planted-false-verdict test plants exactly that);
+    - the gang-level reason, would-fit-after-defrag bit, and preemption
+      trail (no-juniors / insufficient-reclaim / handoff) are re-derived
+      from the same store;
+    - sharded: the explanation carries the OWNING shard's stamp — a gang
+      explained by a shard that does not own its family is a routing bug.
+    """
+    out: list[str] = []
+    fleet, bound, notebooks = _ground_truth(base)
+    suspending_fams = {
+        e["topo"].accelerator.name
+        for e in notebooks
+        if (req := sess.suspend_request(e["nb"])) is not None
+        and req.get("reason") == sess.REASON_PREEMPTION
+    }
+    for entry in notebooks:
+        nb, topo, key = entry["nb"], entry["topo"], entry["key"]
+        num_slices, placement = entry["num_slices"], entry["placement"]
+        anns = ko.annotations(nb)
+        active = api.STOP_ANNOTATION not in anns
+        raw = anns.get(sched.EXPLANATION_ANNOTATION)
+        if raw is None:
+            if active and placement is None and sched.condition_is_true(
+                nb, sched.COND_UNSCHEDULABLE
+            ):
+                out.append(
+                    f"{where}: {key}: marked Unschedulable but carries no "
+                    f"explanation"
+                )
+            continue
+        exp = sched.explanation_of(nb)
+        if exp is None:
+            out.append(f"{where}: {key}: unparseable explanation annotation")
+            continue
+        if placement is not None:
+            out.append(
+                f"{where}: {key}: explanation survived the bind (must be "
+                f"cleared in the bind write)"
+            )
+            continue
+        if not active:
+            out.append(f"{where}: {key}: explanation on a stopped gang")
+            continue
+        shape = exp.get("shape") or {}
+        if (
+            shape.get("accelerator") != topo.accelerator.name
+            or list(shape.get("chips") or []) != sorted(topo.shape)
+            or shape.get("numSlices") != num_slices
+        ):
+            out.append(
+                f"{where}: {key}: explanation describes shape {shape}, "
+                f"spec wants {topo.accelerator.name} "
+                f"{sorted(topo.shape)} x{num_slices} (stale after edit)"
+            )
+            continue
+        fam = topo.accelerator.name
+        if router is not None:
+            owner = router.stamp(router.shard_for_family(fam))
+            if exp.get("shard") != owner:
+                out.append(
+                    f"{where}: {key}: explanation stamped by shard "
+                    f"{exp.get('shard')!r}, owner is {owner!r}"
+                )
+        family_pools = sorted(
+            (p for p in fleet.pools.values() if p.accel.name == fam),
+            key=lambda p: p.name,
+        )
+        recorded = {
+            v["pool"]: v
+            for v in exp.get("pools") or []
+            if isinstance(v, dict) and isinstance(v.get("pool"), str)
+        }
+        if sorted(recorded) != [p.name for p in family_pools]:
+            out.append(
+                f"{where}: {key}: explanation covers pools "
+                f"{sorted(recorded)}, fleet has "
+                f"{[p.name for p in family_pools]}"
+            )
+        reproved = []
+        for pool in family_pools:
+            got = recorded.get(pool.name)
+            if got is None:
+                continue
+            want = pool_verdict(pool, topo)
+            reproved.append(want)
+            for field in (
+                "verdict", "freeChips", "largestFreeCuboidChips",
+                "fragmentationIndex",
+            ):
+                if got.get(field) != want[field]:
+                    out.append(
+                        f"{where}: {key}: pool {pool.name} claims "
+                        f"{field}={got.get(field)!r}, ground truth is "
+                        f"{want[field]!r}"
+                    )
+            # the headline claim proven directly against the real free set,
+            # not just by recompute-agreement: a blocking verdict with a
+            # shape that actually packs is a lie wherever it came from
+            if got.get("verdict") in (
+                VERDICT_FRAGMENTED, VERDICT_BLOCKED_HOSTS,
+                VERDICT_INSUFFICIENT_FREE, VERDICT_SHAPE_NEVER_FITS,
+            ) and slice_fits_now(pool, topo):
+                out.append(
+                    f"{where}: {key}: pool {pool.name} verdict "
+                    f"{got.get('verdict')} but {topo.slice_name} packs into "
+                    f"its real free set"
+                )
+        reason = exp.get("reason")
+        if reason == REASON_SHAPE_NEVER_FITS:
+            if fleet.feasible_on_empty(topo, num_slices):
+                out.append(
+                    f"{where}: {key}: claims {REASON_SHAPE_NEVER_FITS} but "
+                    f"the gang is feasible on an empty fleet"
+                )
+        elif fleet.clone().place_gang(key, topo, num_slices) is not None:
+            out.append(
+                f"{where}: {key}: explained as blocked ({reason}) but the "
+                f"gang packs into real free space right now"
+            )
+        wfad = would_fit_after_defrag(family_pools, topo, num_slices)
+        if bool(exp.get("wouldFitAfterDefrag")) != wfad:
+            out.append(
+                f"{where}: {key}: wouldFitAfterDefrag recorded "
+                f"{exp.get('wouldFitAfterDefrag')!r}, ground truth {wfad}"
+            )
+        pre = exp.get("preemption") or {}
+        if pre.get("outcome") == "rejected":
+            try:
+                queued_at = float(anns.get(sched.QUEUED_AT_ANNOTATION, 0.0))
+            except (TypeError, ValueError):
+                queued_at = 0.0
+            req = GangRequest(
+                key=key, priority=sched.gang_priority(nb),
+                queued_at=queued_at, topo=topo, num_slices=num_slices,
+            )
+            juniors = [
+                v for v in bound
+                if v.topo.accelerator.name == fam
+                and preempt.eligible_victim(v, req)
+            ]
+            if pre.get("why") == PREEMPT_NO_JUNIORS and juniors:
+                out.append(
+                    f"{where}: {key}: claims '{PREEMPT_NO_JUNIORS}' but "
+                    f"{[v.key for v in juniors]} are strictly junior"
+                )
+            if pre.get("why") == PREEMPT_INSUFFICIENT_RECLAIM:
+                if not juniors:
+                    out.append(
+                        f"{where}: {key}: claims insufficient reclaim but "
+                        f"no junior victims exist at all"
+                    )
+                elif preempt.select_victims(fleet, bound, req) is not None:
+                    out.append(
+                        f"{where}: {key}: claims insufficient reclaim but "
+                        f"evicting juniors would admit the gang"
+                    )
+        if reason == REASON_AWAITING_HANDOFF and fam not in suspending_fams:
+            out.append(
+                f"{where}: {key}: claims a suspend handoff in flight on "
+                f"{fam} but no gang carries a preemption suspend request"
+            )
+    return out

@@ -101,6 +101,11 @@ class Pool:
         # rebuilds). Carves never bump it: shrinking free space cannot
         # un-prove a failed fit.
         self.epoch = 0
+        # Occupancy version: bumped by EVERY free-space mutation, carves
+        # included (unlike the epoch — a carve can turn "fragmented" into
+        # "insufficient", so the explanation layer must re-judge on it).
+        # FleetModel keeps it monotonic across rebuilds too.
+        self.version = 0
 
     # ------------------------------------------------------------- geometry
 
@@ -133,6 +138,7 @@ class Pool:
         cub = Cuboid(self._coord(index), (1,) * len(self.grid))
         self.used[key] = cub
         self.free_space.carve(cub)
+        self.version += 1
 
     def missing_hosts(self) -> None:
         """Block every host cell with no backing Node (capacity flap: the
@@ -165,6 +171,7 @@ class Pool:
             return False
         self.used[key] = block_cuboid
         self.free_space.carve(block_cuboid)
+        self.version += 1
         return True
 
     def free(self, key: str) -> None:
@@ -172,6 +179,7 @@ class Pool:
         if cub is not None:
             self.free_space.release(cub)
             self.epoch += 1
+            self.version += 1
 
     def clear_used(self) -> None:
         """Drop every occupant and blocked cell (audit helper: judge
@@ -179,6 +187,7 @@ class Pool:
         self.used.clear()
         self.free_space = binpack.FreeSet(self.grid)
         self.epoch += 1
+        self.version += 1
 
     def gang_keys(self) -> list[str]:
         return [k for k in self.used if not k.startswith(_BLOCKED_PREFIX)]
@@ -215,6 +224,7 @@ class Pool:
         out.used = dict(self.used)  # Cuboids are frozen; shallow is enough
         out.free_space = self.free_space.clone()
         out.epoch = self.epoch
+        out.version = self.version
         return out
 
 
@@ -532,6 +542,9 @@ class FleetModel:
         # epochs survive pool rebuilds (and deletions) so a rebuilt pool
         # can never alias a stale negative-fit entry
         self._epochs: dict[str, int] = {}
+        # occupancy versions survive rebuilds for the same aliasing reason
+        # (the explanation layer's per-pool staleness token)
+        self._versions: dict[str, int] = {}
 
     # ------------------------------------------------------------- node side
 
@@ -560,6 +573,10 @@ class FleetModel:
             epoch = self._epochs.get(name, -1) + 1
             self._epochs[name] = epoch
             pool.epoch = epoch
+            # a fresh build already bumped version per blocked cell; lift it
+            # past every version the old pool object ever reached
+            pool.version += self._versions.get(name, -1) + 1
+            self._versions[name] = pool.version
             self.fleet.pools[name] = pool
         return changed
 
@@ -569,6 +586,7 @@ class FleetModel:
         if pool is None:
             return
         self._epochs[name] = max(self._epochs.get(name, -1), pool.epoch)
+        self._versions[name] = max(self._versions.get(name, -1), pool.version)
         # gangs with a slice here lose their whole application (their
         # carves died with the pool object); the placement diff re-applies
         # or unbinds them against the rebuilt geometry

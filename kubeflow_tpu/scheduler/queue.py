@@ -59,6 +59,15 @@ class GangQueue:
     def __len__(self) -> int:
         return len(self._gangs)
 
+    def family_depths(self) -> dict[str, int]:
+        """Waiting gangs per accelerator family (the per-family queue-depth
+        gauge) — one O(depth) pass, no sort."""
+        out: dict[str, int] = {}
+        for r in self._gangs.values():
+            fam = r.topo.accelerator.name
+            out[fam] = out.get(fam, 0) + 1
+        return out
+
     def effective_priority(self, req: GangRequest, now: float) -> float:
         """Continuous aging: one priority class per ``aging_interval_s``
         waited. Continuous (not floored) on purpose — the *relative* rank of

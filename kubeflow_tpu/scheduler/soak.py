@@ -49,6 +49,7 @@ from kubeflow_tpu.runtime.fake import (
     NotFound,
 )
 from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.scheduler import explain as explain_mod
 from kubeflow_tpu.scheduler import preemption as preempt
 from kubeflow_tpu.scheduler.binpack import ceil_div_shape
 from kubeflow_tpu.scheduler.controller import SchedulerReconciler
@@ -616,6 +617,7 @@ def run_sched_seed(
     shards: int = 1,
     max_restarts_per_tick: int = 6,
     lost_update_audit: bool = True,
+    explain_audit: bool = True,
 ) -> SchedSeedResult:
     """One seeded soak run: hostile timeline under chaos, heal, settle,
     quiesce, then the fixed-point audit. ``faults=None`` runs the same
@@ -813,6 +815,16 @@ def run_sched_seed(
     violations.extend(audit_fixed_point(base, clock()))
     if router is not None:
         violations.extend(audit_shards(base, router, where="final"))
+    if explain_audit:
+        # explanation audit (docs/scheduler.md "explainability"): every
+        # claim in every emitted placement explanation re-proven against
+        # the ground-truth fleet — a verdict that says "no pool fits" while
+        # the shape packs into real free space fails the seed. With a
+        # router, also proves each explanation carries its OWNING shard's
+        # stamp.
+        violations.extend(
+            explain_mod.audit_explanations(base, router=router, where="final")
+        )
     # incremental-vs-from-scratch model divergence anywhere in the run
     violations.extend(diff_failures)
     # causality + event-storm audits (obs/): every write attributable to a

@@ -965,6 +965,7 @@ def run_scenario(
     shards: int = 1,
     max_restarts_per_tick: int = 6,
     lost_update_audit: bool = True,
+    explain_audit: bool = True,
 ) -> ScenarioRun:
     """One full scenario run on the virtual clock. ``faults=None`` is the
     fault-free reference run whose final state is the fixed point.
@@ -1345,6 +1346,16 @@ def run_scenario(
     # click-to-ready) — the convergence proof upgraded to a latency-
     # attribution proof, under the same fault schedules
     violations.extend(audit_timeline(base, where="final"))
+    if explain_audit:
+        # explanation audit (docs/scheduler.md "explainability"): any
+        # placement explanation surviving at the fixed point must be
+        # provable — here, with no scheduler registered, it proves the
+        # clearing side of the lifecycle (no bound/stopped notebook retains
+        # a verdict through the hostile timeline); the sched soak proves
+        # the emitting side
+        from kubeflow_tpu.scheduler.explain import audit_explanations
+
+        violations.extend(audit_explanations(base, where="final"))
     if chaos is not None:
         # lost-update audit (docs/chaos.md): every committed write's base
         # resourceVersion judged at commit time — a stale status overwrite
@@ -1371,6 +1382,7 @@ def run_seed(
     telemetry: bool = False,
     shards: int = 1,
     lost_update_audit: bool = True,
+    explain_audit: bool = True,
 ) -> SeedResult:
     """The soak unit: fault-free fixed point vs faulted run, same seed.
     ``telemetry=True`` runs BOTH with the data-plane pipeline armed — the
@@ -1379,10 +1391,13 @@ def run_seed(
     fault-free run's. ``shards=N`` runs BOTH with the sharded control plane
     (N namespace-filtered managers, one shard's leader killed per round) —
     convergence then proves the partition changes no outcomes."""
-    reference = run_scenario(seed, None, telemetry=telemetry, shards=shards)
+    reference = run_scenario(
+        seed, None, telemetry=telemetry, shards=shards,
+        explain_audit=explain_audit,
+    )
     chaotic = run_scenario(
         seed, faults or ChaosConfig(), telemetry=telemetry, shards=shards,
-        lost_update_audit=lost_update_audit,
+        lost_update_audit=lost_update_audit, explain_audit=explain_audit,
     )
     violations = list(chaotic.violations)
     if reference.violations:

@@ -113,6 +113,14 @@ class _Metric:
         with self._lock:
             self._values.clear()
 
+    def remove(self, **labels: str) -> None:
+        """Drop ONE label set's series (a pool/family that left the fleet
+        must stop exposing its last value — a stale gauge reads as live
+        state). Scoped removal, unlike clear(): under sharding several
+        collectors share one family and may only retire their own series."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
     # ----------------------------------------------------------- histograms
 
     def observe(self, value: float, **labels: str) -> None:
@@ -279,6 +287,9 @@ class _Bound:
 
     def quantile(self, q: float, **labels: str) -> float:
         return self._metric.quantile(q, **self._merge(labels))
+
+    def remove(self, **labels: str) -> None:
+        self._metric.remove(**self._merge(labels))
 
     @property
     def name(self) -> str:
@@ -731,9 +742,46 @@ class SchedulerMetrics:
         self.queue_depth = scoped.gauge(
             "scheduler_queue_depth", "Gangs waiting for TPU capacity"
         )
+        self.family_queue_depth = scoped.gauge(
+            "scheduler_family_queue_depth",
+            "Gangs waiting for TPU capacity, per accelerator family",
+            labelnames=("family",),
+        )
         self.unschedulable = scoped.gauge(
             "scheduler_unschedulable",
             "Gangs no node pool could ever hold (bad topology for this fleet)",
+        )
+        # --- placement explainability (scheduler/explain.py) -------------
+        # fragmentation index: largest free cuboid / free chips per pool —
+        # 1.0 is one contiguous hole, →0 is shattered capacity. The defrag
+        # trigger the live-migration roadmap item consumes.
+        self.pool_fragmentation = scoped.gauge(
+            "scheduler_pool_fragmentation_index",
+            "Largest free cuboid over free chips per pool (1.0 = one "
+            "contiguous hole; lower = fragmented)",
+            labelnames=("pool",),
+        )
+        self.pool_largest_free = scoped.gauge(
+            "scheduler_pool_largest_free_cuboid_chips",
+            "Chips in the largest contiguous free cuboid per pool",
+            labelnames=("pool",),
+        )
+        self.would_fit_after_defrag = scoped.gauge(
+            "scheduler_would_fit_after_defrag",
+            "Waiting gangs whose only blocker is fragmentation: enough "
+            "free chips exist, no contiguous slice does",
+        )
+        self.unschedulable_reasons = scoped.counter(
+            "scheduler_unschedulable_total",
+            "Gang transitions into a blocking verdict, per reason",
+            labelnames=("reason",),
+        )
+        self.time_in_reason = scoped.histogram(
+            "scheduler_time_in_reason_seconds",
+            "How long a gang stayed blocked under one verdict before it "
+            "bound, stopped, or the verdict changed",
+            labelnames=("reason",),
+            buckets=self.BIND_BUCKETS,
         )
         self.fleet_chips_total = scoped.gauge(
             "scheduler_fleet_chips_total", "TPU chips the fleet models"
@@ -773,7 +821,8 @@ class SchedulerMetrics:
         # "the apiserver is slow" from "the packing is slow"
         self.cycle_phase = scoped.histogram(
             "scheduler_cycle_phase_seconds",
-            "Wall time of one scheduling-cycle phase (list/replay/pack/write)",
+            "Wall time of one scheduling-cycle phase "
+            "(list/replay/pack/explain/write)",
             labelnames=("phase",),
             buckets=self.PHASE_BUCKETS,
         )
@@ -794,6 +843,11 @@ class SchedulerMetrics:
             "handoffs",
             buckets=self.HANDOFF_BUCKETS,
         )
+        # label universes THIS instance has set (per-shard disjoint by
+        # construction: pools/families belong to exactly one shard), so
+        # stale series can be retired without clearing siblings'
+        self._families_seen: set = set()
+        self._pools_seen: set = set()
 
     def observe_cycle(
         self,
@@ -803,6 +857,8 @@ class SchedulerMetrics:
         unschedulable: int,
         duration_s: float | None = None,
         phases: Mapping[str, float] | None = None,
+        family_depths: Mapping[str, int] | None = None,
+        pool_stats: Mapping[str, tuple] | None = None,
     ) -> None:
         self.cycles.inc()
         self.queue_depth.set(queue_depth)
@@ -814,6 +870,63 @@ class SchedulerMetrics:
             self.cycle_duration.observe(duration_s)
         for phase, seconds in (phases or {}).items():
             self.cycle_phase.observe(seconds, phase=phase)
+        if family_depths is not None:
+            # clear-and-set per THIS instance's label universe: a family
+            # whose queue drained must read 0 (and one that left the fleet
+            # must stop exposing) without touching sibling shards' series
+            for fam in self._families_seen - set(family_depths):
+                self.family_queue_depth.remove(family=fam)
+            for fam, depth in family_depths.items():
+                self.family_queue_depth.set(depth, family=fam)
+            self._families_seen = set(family_depths)
+        if pool_stats is not None:
+            # (fragmentation index, largest free cuboid chips) per pool —
+            # computed by the controller from the live free decompositions
+            # (scheduler/explain.py), O(pools) per cycle
+            for pool in self._pools_seen - set(pool_stats):
+                self.pool_fragmentation.remove(pool=pool)
+                self.pool_largest_free.remove(pool=pool)
+            for pool, (frag, largest) in pool_stats.items():
+                self.pool_fragmentation.set(frag, pool=pool)
+                self.pool_largest_free.set(largest, pool=pool)
+            self._pools_seen = set(pool_stats)
+
+    def observe_reason_transition(
+        self,
+        reason: str | None,
+        *,
+        prev: str | None,
+        seconds_in_prev: float,
+    ) -> None:
+        """A gang's blocking verdict changed (scheduler/explain.py):
+        ``reason=None`` means it left the blocked set entirely (bound or
+        stopped). Counts transitions INTO a reason and closes out the
+        time-in-reason observation for the one it left."""
+        if reason is not None:
+            self.unschedulable_reasons.inc(reason=reason)
+        if prev is not None:
+            self.time_in_reason.observe(
+                max(0.0, seconds_in_prev), reason=prev
+            )
+
+    def set_would_fit_after_defrag(self, count: int) -> None:
+        self.would_fit_after_defrag.set(count)
+
+    def fleet_fragmentation_index(self) -> float:
+        """Worst per-pool fragmentation index across the registry (the
+        dashboard's fleet-level series): the most-shattered pool bounds
+        what the biggest waiting gang can hope for. 1.0 when no pool
+        reports (empty fleet reads as unfragmented)."""
+        vals = [s["value"] for s in self.pool_fragmentation.samples()]
+        return min(vals) if vals else 1.0
+
+    def total_queue_depth(self) -> float:
+        """Queue depth summed across shards (the dashboard series: one
+        number for the fleet even when N shard schedulers share the
+        registry)."""
+        return builtins_sum(
+            s["value"] for s in self.queue_depth.samples()
+        )
 
     def observe_fit_cache(self, hits: int, misses: int) -> None:
         """Per-cycle deltas from the controller's FitCache."""

@@ -68,6 +68,7 @@ def create_app(
     links: dict | None = None,
     telemetry=None,
     slo=None,
+    scheduler=None,
     cache: ReadCache | None = None,
     use_cache: bool = True,
 ) -> App:
@@ -94,6 +95,14 @@ def create_app(
         # numbers the NotebookOS argument says the platform is judged on
         readers["startup_p99"] = slo.startup_p99
         readers["startup_burn_rate"] = slo.fast_burn
+    if scheduler is not None:
+        # placement series (scheduler/explain.py): queue depth summed
+        # across shards, and the fleet fragmentation index — the worst
+        # pool's largest-free-cuboid ÷ free-chips ratio, the "would more
+        # chips even help" signal next to the capacity counts above. Pure
+        # gauge reads: the scheduler's own cycle keeps them current.
+        readers["queue_depth"] = scheduler.total_queue_depth
+        readers["fragmentation"] = scheduler.fleet_fragmentation_index
     owned_source = None
     if metrics_source is None:
         if os.environ.get("METRICS_SOURCE"):
@@ -360,6 +369,13 @@ def create_app(
         elif slo is not None and metric_type == "startup_burn_rate":
             slo.refresh()
             values = slo.burn_rate.samples()
+        elif scheduler is not None and metric_type == "queue_depth":
+            # per-family (and per-shard, when sharded) breakdown as the
+            # labeled values; the fleet total is the series
+            values = scheduler.family_queue_depth.samples()
+        elif scheduler is not None and metric_type == "fragmentation":
+            # per-pool fragmentation indices as the labeled values
+            values = scheduler.pool_fragmentation.samples()
         else:
             raise ValueError(f"unknown metric type {metric_type!r}")
         try:

@@ -46,6 +46,19 @@ from kubeflow_tpu.webapps.cache import ReadCache
 import time
 
 
+def _blocking_detail(nb: dict) -> str | None:
+    """The top blocking verdict from the scheduler's placement explanation
+    (scheduler/explain.py), or None when the gang carries none. The
+    message already names the verdict's substance — which pools rejected
+    the shape and why — and for a merely-fragmented fleet it IS the
+    fragmentation hint ("N chips free, largest contiguous block M,
+    defragmentation would admit it")."""
+    exp = sched.explanation_of(nb)
+    if exp is None:
+        return None
+    return exp.get("message") or exp.get("reason")
+
+
 def notebook_status(nb: dict, events: list[dict]) -> dict:
     """Derive UI status (ref status.py:9-99), extended with the fleet
     scheduler's conditions — a queued gang says WHERE it is in line instead
@@ -84,9 +97,13 @@ def notebook_status(nb: dict, events: list[dict]) -> dict:
         return {"phase": "ready", "message": "Running"}
     unsched = sched.condition(nb, sched.COND_UNSCHEDULABLE)
     if unsched is not None and unsched.get("status") == "True":
+        # the top blocking verdict from the scheduler's explanation
+        # annotation, not the generic string: "why not" is the product
+        # surface here (a malformed/absent annotation falls back to the
+        # condition message — the UI never 500s on a user-edited CR)
         return {
             "phase": "warning",
-            "message": f"Unschedulable: {unsched.get('message') or 'no fitting node pool'}",
+            "message": f"Unschedulable: {_blocking_detail(nb) or unsched.get('message') or 'no fitting node pool'}",
         }
     queued = sched.condition(nb, sched.COND_QUEUED)
     if queued is not None and queued.get("status") == "True":
@@ -98,6 +115,13 @@ def notebook_status(nb: dict, events: list[dict]) -> dict:
                 f"Preempted ({preempted.get('message') or 'by a higher-priority gang'}); "
                 f"re-queued ({detail})."
             )
+        blocking = _blocking_detail(nb)
+        if blocking:
+            # a queued gang the pack phase judged and failed (blocked head,
+            # failed backfill, a re-queued victim still waiting): the
+            # verdict rides along AFTER the position/preemption text —
+            # "position N of M" stays exactly as today for every queued row
+            message += f" Blocked: {blocking}."
         if state == sess.STATE_RESUMING or (
             state == sess.STATE_SUSPENDED and snapshot is not None
         ):
@@ -293,6 +317,11 @@ def create_app(
         summary["status"]["conditions"] = nb.get("status", {}).get(
             "conditions", []
         )
+        # the full decoded placement explanation (scheduler/explain.py) on
+        # the overview tab: per-pool verdicts, fragmentation indices, the
+        # preemption trail — None for a bound/unexplained notebook, so the
+        # UI can distinguish "placed" from "never judged"
+        summary["explanation"] = sched.explanation_of(nb)
         summary["age"] = nb["metadata"].get("creationTimestamp", "")
         # keep CR status fields reachable (status.tpu incl. numSlices)
         summary["status"].update(

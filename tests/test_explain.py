@@ -1,0 +1,531 @@
+"""Placement explainability (scheduler/explain.py, docs/scheduler.md
+"explainability"): per-pool verdicts, the explanation annotation lifecycle,
+fragmentation telemetry, the /debug/explain route, and the audit that
+re-proves every emitted claim against the ground-truth fleet.
+
+The integration tests run the scheduler exactly as shipped (one reconciler
+under the manager against the in-memory cluster) and assert through the
+store: the annotation IS the surface users and the audit both read.
+"""
+from __future__ import annotations
+
+import json
+
+from werkzeug.test import Client
+
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.obs.events import EventRecorder
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.sharding import ShardRouter, shard_enqueue_filter
+from kubeflow_tpu.scheduler import explain
+from kubeflow_tpu.scheduler.controller import SchedulerReconciler
+from kubeflow_tpu.scheduler.fleet import Fleet
+from kubeflow_tpu.scheduler.soak import make_pool
+from kubeflow_tpu.tpu.topology import parse_topology
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import SchedulerMetrics
+from kubeflow_tpu.webapps.base import App
+from kubeflow_tpu.webapps.jupyter import notebook_status
+
+NS = "team-a"
+
+
+def _platform(cluster, *, metrics=None, recorder=None, **sched_kw):
+    cfg = ControllerConfig(scheduler_enabled=True)
+    m = Manager(cluster)
+    m.register(NotebookReconciler(cfg))
+    m.register(
+        SchedulerReconciler(
+            metrics=metrics, recorder=recorder, aging_interval_s=300.0,
+            **sched_kw,
+        )
+    )
+    return m
+
+
+def _nb(name, topo="2x2x2", slices=1, accel="v4"):
+    kw = {"tpu_accelerator": accel, "tpu_topology": topo}
+    if slices > 1:
+        kw["tpu_num_slices"] = slices
+    return api.notebook(name, NS, **kw)
+
+
+def _explanation(cluster, name):
+    return sched.explanation_of(cluster.get("Notebook", name, NS))
+
+
+def _events(cluster, name, reason):
+    return [
+        e for e in cluster.list("Event", NS)
+        if e.get("involvedObject", {}).get("name") == name
+        and e.get("reason") == reason
+    ]
+
+
+# ------------------------------------------------------------ pure geometry
+
+
+class TestPoolVerdict:
+    """pool_verdict judged from live pool state only — every field is the
+    checkable claim the audit re-derives."""
+
+    def _fleet(self, cluster):
+        return Fleet.from_nodes(cluster.list("Node"))
+
+    def test_shape_never_fits_the_torus(self, cluster):
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        pool = self._fleet(cluster).pools["p0"]
+        v = explain.pool_verdict(pool, parse_topology("v4", "8x8x8"))
+        assert v["verdict"] == explain.VERDICT_SHAPE_NEVER_FITS
+
+    def test_slice_fits_on_an_empty_pool(self, cluster):
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        pool = self._fleet(cluster).pools["p0"]
+        v = explain.pool_verdict(pool, parse_topology("v4", "2x2x2"))
+        assert v["verdict"] == explain.VERDICT_SLICE_FITS
+        assert v["freeChips"] == 16
+        assert v["fragmentationIndex"] == 1.0
+
+    def test_fragmented_free_cells_suffice_but_not_contiguous(self, cluster):
+        # v4 2x2x4 = a 1x1x4 line of host cells; fill it with four
+        # single-cell gangs and free the 2nd and 4th: two free cells, but
+        # the 2x2x2 request needs two ADJACENT ones
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        fleet = self._fleet(cluster)
+        one_cell = parse_topology("v4", "2x2x1")
+        for i in range(4):
+            assert fleet.place_gang(f"g{i}", one_cell) is not None
+        pool = fleet.pools["p0"]
+        pool.free("g1/s0")
+        pool.free("g3/s0")
+        v = explain.pool_verdict(pool, parse_topology("v4", "2x2x2"))
+        assert v["verdict"] == explain.VERDICT_FRAGMENTED
+        assert v["freeChips"] == 8
+        assert v["largestFreeCuboidChips"] == 4
+        assert v["fragmentationIndex"] == 0.5
+        assert explain.would_fit_after_defrag(
+            [pool], parse_topology("v4", "2x2x2"), 1
+        )
+        # defrag cannot conjure capacity: a 2x2x4 needs all four cells
+        assert not explain.would_fit_after_defrag(
+            [pool], parse_topology("v4", "2x2x4"), 1
+        )
+
+    def test_blocked_hosts_would_fit_once_healed(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "p0")  # 2 host cells
+        cluster.patch("Node", "p0-1", "", {"spec": {"unschedulable": True}})
+        pool = self._fleet(cluster).pools["p0"]
+        v = explain.pool_verdict(pool, parse_topology("v4", "2x2x2"))
+        assert v["verdict"] == explain.VERDICT_BLOCKED_HOSTS
+
+    def test_insufficient_free_capacity_genuinely_held(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "p0")
+        fleet = self._fleet(cluster)
+        assert fleet.place_gang("holder", parse_topology("v4", "2x2x2"))
+        v = explain.pool_verdict(
+            fleet.pools["p0"], parse_topology("v4", "2x2x2")
+        )
+        assert v["verdict"] == explain.VERDICT_INSUFFICIENT_FREE
+        assert v["freeChips"] == 0
+        # a full pool has nothing to fragment: index pins to 1.0
+        assert v["fragmentationIndex"] == 1.0
+
+
+# ------------------------------------------------------- annotation lifecycle
+
+
+class TestExplanationLifecycle:
+    def test_unschedulable_gang_carries_shape_never_fits(self, cluster):
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        mgr = _platform(cluster)
+        cluster.create(_nb("huge", topo="8x8x8"))
+        cluster.settle(mgr)
+        exp = _explanation(cluster, "huge")
+        assert exp is not None
+        assert exp["reason"] == explain.REASON_SHAPE_NEVER_FITS
+        assert exp["shape"] == {
+            "accelerator": "v4", "chips": [8, 8, 8], "numSlices": 1,
+        }
+        assert explain.audit_explanations(cluster) == []
+
+    def test_blocked_head_explains_no_junior_victims(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        mgr = _platform(cluster)
+        cluster.create(_nb("holder"))
+        cluster.settle(mgr)
+        cluster.create(_nb("waiter"))
+        cluster.settle(mgr)
+        exp = _explanation(cluster, "waiter")
+        assert exp is not None
+        assert exp["reason"] == explain.REASON_INSUFFICIENT
+        assert exp["preemption"]["outcome"] == "rejected"
+        assert exp["preemption"]["why"] == explain.PREEMPT_NO_JUNIORS
+        (pool,) = exp["pools"]
+        assert pool["verdict"] == explain.VERDICT_INSUFFICIENT_FREE
+        # the holder is bound: the bind write itself kept it clean
+        assert _explanation(cluster, "holder") is None
+        assert explain.audit_explanations(cluster) == []
+
+    def test_bind_clears_the_explanation_in_the_bind_write(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        mgr = _platform(cluster)
+        cluster.create(_nb("holder"))
+        cluster.settle(mgr)
+        cluster.create(_nb("waiter"))
+        cluster.settle(mgr)
+        assert _explanation(cluster, "waiter") is not None
+        # stopping the holder frees the chips; the waiter binds and the
+        # SAME patch that writes the placement drops the explanation
+        cluster.patch("Notebook", "holder", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-08-03T00:00:00Z"}}})
+        cluster.settle(mgr)
+        waiter = cluster.get("Notebook", "waiter", NS)
+        assert sched.placement_of(waiter) is not None
+        assert sched.explanation_of(waiter) is None
+        assert explain.audit_explanations(cluster) == []
+
+    def test_spec_edit_refreshes_the_recorded_shape(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        mgr = _platform(cluster)
+        cluster.create(_nb("holder"))
+        cluster.settle(mgr)
+        cluster.create(_nb("waiter", topo="2x2x2"))
+        cluster.settle(mgr)
+        assert _explanation(cluster, "waiter")["shape"]["chips"] == [2, 2, 2]
+        # the user shrinks the request while it waits: the explanation must
+        # describe the CURRENT spec, never the edited-away one
+        cluster.patch("Notebook", "waiter", NS, {"spec": {"tpu": {
+            "topology": "2x2x4"}}})
+        cluster.settle(mgr)
+        exp = _explanation(cluster, "waiter")
+        assert exp["shape"]["chips"] == [2, 2, 4]
+        assert explain.audit_explanations(cluster) == []
+
+    def test_stop_wipes_the_explanation(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        mgr = _platform(cluster)
+        cluster.create(_nb("holder"))
+        cluster.settle(mgr)
+        cluster.create(_nb("waiter"))
+        cluster.settle(mgr)
+        assert _explanation(cluster, "waiter") is not None
+        cluster.patch("Notebook", "waiter", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-08-03T00:00:00Z"}}})
+        cluster.settle(mgr)
+        assert _explanation(cluster, "waiter") is None
+        assert explain.audit_explanations(cluster) == []
+
+    def test_survives_crash_restart_without_event_storm(self, cluster):
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        rec = EventRecorder()
+        mgr = _platform(cluster, recorder=rec)
+        cluster.create(_nb("huge", topo="8x8x8"))
+        cluster.settle(mgr)
+        before = _explanation(cluster, "huge")
+        assert before is not None
+        events = _events(cluster, "huge", "Unschedulable")
+        assert len(events) == 1
+        assert explain.REASON_SHAPE_NEVER_FITS in events[0]["message"]
+        # crash-restart: a cold reconciler (fresh recorder too — a real
+        # restart loses the dedup cache) adopts the persisted explanation
+        mgr2 = _platform(cluster, recorder=EventRecorder())
+        cluster.settle(mgr2)
+        cluster.settle(mgr2)
+        after = _explanation(cluster, "huge")
+        assert after == before  # same verdict, same `since` — clock intact
+        stormed = _events(cluster, "huge", "Unschedulable")
+        # no new transition happened: the restart must not re-emit (dedup
+        # would bump count; a fresh object would be a storm)
+        assert sum(e.get("count", 1) for e in stormed) == 1
+
+    def test_explain_off_keeps_transition_events_and_annotations_absent(
+        self, cluster
+    ):
+        # the --no-explain A/B arm: no annotations, but the historical
+        # Unschedulable transition Event must still fire (once)
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        mgr = _platform(cluster, recorder=EventRecorder(), explain=False)
+        cluster.create(_nb("huge", topo="8x8x8"))
+        cluster.settle(mgr)
+        cluster.settle(mgr)
+        assert _explanation(cluster, "huge") is None
+        events = _events(cluster, "huge", "Unschedulable")
+        assert sum(e.get("count", 1) for e in events) == 1
+
+    def test_recompute_budget_bounds_work_per_cycle(self, cluster):
+        # three admission-unschedulable gangs (each judged EVERY cycle)
+        # against a budget of one recompute per cycle: explanations land
+        # incrementally but ALL land — blocked gangs persist, so the
+        # budget catches up instead of dropping anyone
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        mgr = _platform(cluster, explain_budget=1)
+        for i in range(3):
+            cluster.create(_nb(f"w{i}", topo="8x8x8"))
+        cluster.settle(mgr)
+        cluster.settle(mgr)
+        for i in range(3):
+            assert _explanation(cluster, f"w{i}") is not None
+        assert explain.audit_explanations(cluster) == []
+
+    def test_sharded_explanation_carries_owning_shard_stamp(self, cluster):
+        router = ShardRouter(2)
+        shard = router.shard_for_family("v4")
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        cfg = ControllerConfig(scheduler_enabled=True)
+        mgr = Manager(
+            cluster, enqueue_filter=shard_enqueue_filter(router, shard)
+        )
+        mgr.register(NotebookReconciler(cfg))
+        mgr.register(SchedulerReconciler(
+            families=router.families_for(shard), router=router,
+            shard_id=shard,
+        ))
+        cluster.create(_nb("huge", topo="8x8x8"))
+        cluster.settle(mgr)
+        exp = _explanation(cluster, "huge")
+        assert exp is not None
+        assert exp["shard"] == router.stamp(shard)
+        assert explain.audit_explanations(cluster, router=router) == []
+
+
+# ------------------------------------------------------------------ the audit
+
+
+class TestExplanationAudit:
+    def _blocked_world(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        mgr = _platform(cluster)
+        cluster.create(_nb("holder"))
+        cluster.settle(mgr)
+        cluster.create(_nb("waiter"))
+        cluster.settle(mgr)
+        assert explain.audit_explanations(cluster) == []
+        return mgr
+
+    def test_planted_false_pool_verdict_fails_the_audit(self, cluster):
+        self._blocked_world(cluster)
+        nb = cluster.get("Notebook", "waiter", NS)
+        exp = sched.explanation_of(nb)
+        # the lie: claim the pool is merely fragmented (defrag would fix
+        # it) when its capacity is genuinely held by the holder
+        exp["pools"][0]["verdict"] = explain.VERDICT_FRAGMENTED
+        cluster.patch("Notebook", "waiter", NS, {"metadata": {"annotations": {
+            sched.EXPLANATION_ANNOTATION: sched.encode_explanation(exp)}}})
+        findings = explain.audit_explanations(cluster)
+        assert any("tiny" in f and "verdict" in f for f in findings)
+
+    def test_planted_blocking_verdict_on_fitting_shape_fails(self, cluster):
+        # a fleet with free space and a gang explained as blocked: the
+        # auditor packs the shape against the real free set and catches it
+        # wherever the recompute happens to agree
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        mgr = _platform(cluster)
+        cluster.create(_nb("fits"))
+        cluster.settle(mgr)
+        nb = cluster.get("Notebook", "fits", NS)
+        assert sched.placement_of(nb) is not None
+        # un-bind by hand and plant a verdict the scheduler never wrote
+        fake = {
+            "reason": explain.REASON_INSUFFICIENT,
+            "message": "planted", "since": 0.0, "role": "head",
+            "shape": {"accelerator": "v4", "chips": [2, 2, 2],
+                      "numSlices": 1},
+            "wouldFitAfterDefrag": False,
+            "preemption": {"considered": True, "outcome": "rejected",
+                           "why": explain.PREEMPT_NO_JUNIORS},
+            "pools": [explain.pool_verdict(
+                Fleet.from_nodes(cluster.list("Node")).pools["p0"],
+                parse_topology("v4", "2x2x2"),
+            )],
+        }
+        fake["pools"][0]["verdict"] = explain.VERDICT_INSUFFICIENT_FREE
+        cluster.patch("Notebook", "fits", NS, {"metadata": {"annotations": {
+            sched.PLACEMENT_ANNOTATION: None,
+            sched.EXPLANATION_ANNOTATION: sched.encode_explanation(fake),
+        }}})
+        findings = explain.audit_explanations(cluster)
+        assert any("packs into" in f for f in findings)
+
+    def test_malformed_pools_entry_is_a_violation_not_a_crash(self, cluster):
+        self._blocked_world(cluster)
+        nb = cluster.get("Notebook", "waiter", NS)
+        exp = sched.explanation_of(nb)
+        exp["pools"] = [{}]  # user-edited garbage: no "pool" key
+        cluster.patch("Notebook", "waiter", NS, {"metadata": {"annotations": {
+            sched.EXPLANATION_ANNOTATION: sched.encode_explanation(exp)}}})
+        findings = explain.audit_explanations(cluster)
+        assert any("covers pools" in f for f in findings)
+
+    def test_explanation_surviving_bind_fails_the_audit(self, cluster):
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        mgr = _platform(cluster)
+        cluster.create(_nb("bound"))
+        cluster.settle(mgr)
+        cluster.patch("Notebook", "bound", NS, {"metadata": {"annotations": {
+            sched.EXPLANATION_ANNOTATION: json.dumps(
+                {"reason": explain.REASON_INSUFFICIENT}
+            )}}})
+        findings = explain.audit_explanations(cluster)
+        assert any("survived the bind" in f for f in findings)
+
+    def test_stale_shape_after_spec_edit_fails_the_audit(self, cluster):
+        self._blocked_world(cluster)
+        # the edit happens but the scheduler never runs again (crashed):
+        # the recorded shape no longer matches the spec
+        cluster.patch("Notebook", "waiter", NS, {"spec": {"tpu": {
+            "topology": "2x2x4"}}})
+        findings = explain.audit_explanations(cluster)
+        assert any("stale after edit" in f for f in findings)
+
+    def test_false_would_fit_after_defrag_fails_the_audit(self, cluster):
+        self._blocked_world(cluster)
+        nb = cluster.get("Notebook", "waiter", NS)
+        exp = sched.explanation_of(nb)
+        exp["wouldFitAfterDefrag"] = True  # the lie: defrag cannot help
+        cluster.patch("Notebook", "waiter", NS, {"metadata": {"annotations": {
+            sched.EXPLANATION_ANNOTATION: sched.encode_explanation(exp)}}})
+        findings = explain.audit_explanations(cluster)
+        assert any("wouldFitAfterDefrag" in f for f in findings)
+
+    def test_wrong_shard_stamp_fails_the_audit(self, cluster):
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        router = ShardRouter(2)
+        shard = router.shard_for_family("v4")
+        mgr = Manager(
+            cluster, enqueue_filter=shard_enqueue_filter(router, shard)
+        )
+        mgr.register(NotebookReconciler(
+            ControllerConfig(scheduler_enabled=True)))
+        mgr.register(SchedulerReconciler(
+            families=router.families_for(shard), router=router,
+            shard_id=shard,
+        ))
+        cluster.create(_nb("huge", topo="8x8x8"))
+        cluster.settle(mgr)
+        assert explain.audit_explanations(cluster, router=router) == []
+        nb = cluster.get("Notebook", "huge", NS)
+        exp = sched.explanation_of(nb)
+        exp["shard"] = router.stamp(1 - shard)  # the non-owner
+        cluster.patch("Notebook", "huge", NS, {"metadata": {"annotations": {
+            sched.EXPLANATION_ANNOTATION: sched.encode_explanation(exp)}}})
+        findings = explain.audit_explanations(cluster, router=router)
+        assert any("owner" in f for f in findings)
+
+    def test_unschedulable_without_explanation_fails_the_audit(self, cluster):
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        mgr = _platform(cluster)
+        cluster.create(_nb("huge", topo="8x8x8"))
+        cluster.settle(mgr)
+        cluster.patch("Notebook", "huge", NS, {"metadata": {"annotations": {
+            sched.EXPLANATION_ANNOTATION: None}}})
+        findings = explain.audit_explanations(cluster)
+        assert any("no explanation" in f for f in findings)
+
+
+# --------------------------------------------------------- serving surfaces
+
+
+class TestServingSurfaces:
+    def test_debug_explain_route(self, cluster):
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        mgr = _platform(cluster)
+        cluster.create(_nb("huge", topo="8x8x8"))
+        cluster.settle(mgr)
+        app = App("probes", csrf_protect=False)
+        explain.install_explain_route(app, cluster)
+        client = Client(app)
+        body = json.loads(client.get(f"/debug/explain/{NS}/huge").data)
+        assert body["bound"] is False
+        assert body["explanation"]["reason"] == explain.REASON_SHAPE_NEVER_FITS
+        assert any(
+            c["type"] == sched.COND_UNSCHEDULABLE
+            for c in body["conditions"]
+        )
+        assert client.get(f"/debug/explain/{NS}/nope").status_code == 404
+
+    def test_spawner_status_shows_top_blocking_verdict(self, cluster):
+        make_pool(cluster, "v4", "2x2x4", "p0")
+        mgr = _platform(cluster)
+        cluster.create(_nb("huge", topo="8x8x8"))
+        cluster.settle(mgr)
+        nb = cluster.get("Notebook", "huge", NS)
+        st = notebook_status(nb, [])
+        assert st["phase"] == "warning"
+        # the verdict's substance, not the generic string
+        assert "no v4 node pools can hold" in st["message"]
+        assert "no fitting node pool" not in st["message"]
+
+    def test_spawner_queued_row_keeps_position_and_adds_verdict(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        mgr = _platform(cluster)
+        cluster.create(_nb("holder"))
+        cluster.settle(mgr)
+        cluster.create(_nb("waiter"))
+        cluster.settle(mgr)
+        nb = cluster.get("Notebook", "waiter", NS)
+        st = notebook_status(nb, [])
+        assert st["phase"] == "waiting"
+        assert "position 1 of 1" in st["message"]  # exactly as before
+        assert "Blocked:" in st["message"]
+        assert "capacity is exhausted" in st["message"]
+
+
+# ------------------------------------------------------------------- metrics
+
+
+class TestExplainMetrics:
+    def test_reason_counters_and_fragmentation_gauges(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        metrics = SchedulerMetrics()
+        mgr = _platform(cluster, metrics=metrics)
+        cluster.create(_nb("holder"))
+        cluster.settle(mgr)
+        cluster.create(_nb("waiter"))
+        cluster.settle(mgr)
+        text = metrics.registry.expose()
+        assert (
+            'scheduler_unschedulable_total{reason="InsufficientCapacity"} 1'
+            in text
+        )
+        assert 'scheduler_pool_fragmentation_index{pool="tiny"} 1' in text
+        assert 'scheduler_family_queue_depth{family="v4"} 1' in text
+        assert "scheduler_would_fit_after_defrag 0" in text
+        # the waiter binds: the verdict closes out into the time-in-reason
+        # histogram and the reason gauge-side state drains
+        cluster.patch("Notebook", "holder", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-08-03T00:00:00Z"}}})
+        cluster.settle(mgr)
+        text = metrics.registry.expose()
+        assert (
+            'scheduler_time_in_reason_seconds_count'
+            '{reason="InsufficientCapacity"} 1' in text
+        )
+
+    def test_pool_series_retired_when_pool_leaves_the_fleet(self, cluster):
+        nodes = make_pool(cluster, "v4", "2x2x2", "tiny")
+        metrics = SchedulerMetrics()
+        mgr = _platform(cluster, metrics=metrics)
+        cluster.create(_nb("holder"))
+        cluster.settle(mgr)
+        assert 'pool="tiny"' in metrics.registry.expose()
+        for n in nodes:
+            cluster.delete("Node", ko.name(n), "")
+        cluster.settle(mgr)
+        # a vanished pool must stop exposing its last fragmentation value —
+        # a stale gauge reads as live state
+        assert 'scheduler_pool_fragmentation_index{pool="tiny"}' not in (
+            metrics.registry.expose()
+        )
+
+    def test_dashboard_reader_helpers(self, cluster):
+        make_pool(cluster, "v4", "2x2x2", "tiny")
+        metrics = SchedulerMetrics()
+        mgr = _platform(cluster, metrics=metrics)
+        cluster.create(_nb("holder"))
+        cluster.create(_nb("waiter"))
+        cluster.settle(mgr)
+        assert metrics.total_queue_depth() == 1.0
+        assert metrics.fleet_fragmentation_index() == 1.0

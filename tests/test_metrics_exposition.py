@@ -199,6 +199,16 @@ def combined_registry() -> Registry:
                      tpu_topology="2x2x2")
     )
     cluster.settle(mgr, rounds=4)
+    # a second gang the now-held pool cannot take: the explainability
+    # families (scheduler/explain.py — reason counters, fragmentation
+    # gauges, per-family queue depth) get real observations, not vacuous
+    # zeros; the stop below frees the pool, so its verdict also closes out
+    # into the time-in-reason histogram
+    cluster.create(
+        api.notebook("nb-blocked", "team-metrics", tpu_accelerator="v4",
+                     tpu_topology="2x2x2")
+    )
+    cluster.settle(mgr, rounds=4)
     # data-plane telemetry on the same registry (telemetry/collector.py):
     # one scrape pass against a fake agent populates every family
     from kubeflow_tpu.culler.probe import ProbeResult
@@ -277,6 +287,37 @@ class TestExpositionFormat:
         assert families["apiserver_request_duration_seconds"]["type"] == (
             "histogram"
         )
+        # placement explainability (scheduler/explain.py): verdict-reason
+        # counter, time-in-reason histogram, and the fragmentation /
+        # queue-depth gauges all lint AND carry the blocked gang's data
+        assert families["scheduler_unschedulable_total"]["type"] == "counter"
+        assert any(
+            labels.get("reason") == "InsufficientCapacity" and v >= 1
+            for _, labels, v in families[
+                "scheduler_unschedulable_total"]["samples"]
+        )
+        assert families["scheduler_time_in_reason_seconds"]["type"] == (
+            "histogram"
+        )
+        # nb-blocked bound after the suspend freed the pool: its verdict
+        # closed out into the histogram
+        assert any(
+            v >= 1
+            for s, _, v in families[
+                "scheduler_time_in_reason_seconds"]["samples"]
+            if s.endswith("_count")
+        )
+        assert any(
+            labels == {"family": "v4"}
+            for _, labels, _ in families[
+                "scheduler_family_queue_depth"]["samples"]
+        )
+        assert families["scheduler_pool_fragmentation_index"]["type"] == (
+            "gauge"
+        )
+        assert families[
+            "scheduler_pool_largest_free_cuboid_chips"]["type"] == "gauge"
+        assert families["scheduler_would_fit_after_defrag"]["type"] == "gauge"
 
     def test_webapp_and_readcache_families_lint(self):
         """The BFF read-path families (utils/metrics.py WebAppMetrics +

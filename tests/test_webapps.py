@@ -750,6 +750,38 @@ class TestDashboardApp:
         values = get_json_body(r)["values"]
         assert values == [{"labels": {"namespace": "alice"}, "value": 1.0}]
 
+    def test_scheduler_metric_types_served_when_wired(self, platform):
+        """queue_depth + fragmentation (scheduler/explain.py) join the
+        dashboard's series store when a SchedulerMetrics handle is passed —
+        per-family/per-pool breakdowns as the labeled values, fleet scalars
+        as the series."""
+        from kubeflow_tpu.scheduler.fleet import Fleet
+        from kubeflow_tpu.utils.metrics import SchedulerMetrics
+
+        cluster, m = platform
+        sm = SchedulerMetrics()
+        sm.observe_cycle(
+            Fleet(), queue_depth=3, unschedulable=0,
+            family_depths={"v4": 3},
+            pool_stats={"pool-a": (0.5, 8)},
+        )
+        client = Client(dashboard.create_app(cluster, scheduler=sm))
+        r = client.get("/api/metrics/queue_depth", headers=ALICE)
+        body = get_json_body(r)
+        assert body["values"] == [{"labels": {"family": "v4"}, "value": 3.0}]
+        assert body["series"][-1]["value"] == 3.0
+        r = client.get("/api/metrics/fragmentation", headers=ALICE)
+        body = get_json_body(r)
+        assert body["values"] == [
+            {"labels": {"pool": "pool-a"}, "value": 0.5}
+        ]
+        assert body["series"][-1]["value"] == 0.5
+        # unwired (the default): the types are simply absent, not 500s
+        client = Client(dashboard.create_app(cluster))
+        assert client.get(
+            "/api/metrics/queue_depth", headers=ALICE
+        ).status_code == 400
+
     def test_dashboard_links(self, platform):
         cluster, _ = platform
         client = Client(dashboard.create_app(cluster))

@@ -57,6 +57,12 @@ def main(argv: list[str] | None = None) -> int:
                          "write's base resourceVersion judged at commit "
                          "time; a stale status overwrite fails the seed "
                          "(docs/chaos.md; on by default)")
+    ap.add_argument("--explain-audit", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="per-seed explanation audit: every placement "
+                         "explanation at the fixed point re-proven against "
+                         "the ground-truth fleet (docs/scheduler.md "
+                         "\"explainability\"; on by default)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="per-seed lines; on failure, a fixed-point diff")
     args = ap.parse_args(argv)
@@ -83,6 +89,7 @@ def main(argv: list[str] | None = None) -> int:
         result = run_seed(
             seed, cfg, telemetry=args.telemetry, shards=args.shards,
             lost_update_audit=args.lost_update_audit,
+            explain_audit=args.explain_audit,
         )
         total_faults += sum(result.fault_counts.values())
         total_restarts += result.restarts

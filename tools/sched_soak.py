@@ -53,6 +53,12 @@ def main(argv: list[str] | None = None) -> int:
                     default=True,
                     help="per-seed lost-update race audit on every cluster "
                          "write (docs/chaos.md; on by default)")
+    ap.add_argument("--explain-audit", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="per-seed explanation audit: every claim in every "
+                         "emitted placement explanation re-proven against "
+                         "the ground-truth fleet (docs/scheduler.md "
+                         "\"explainability\"; on by default)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print a line per seed, not just failures")
     args = ap.parse_args(argv)
@@ -81,6 +87,7 @@ def main(argv: list[str] | None = None) -> int:
         result = run_sched_seed(
             seed, cfg, shards=args.shards,
             lost_update_audit=args.lost_update_audit,
+            explain_audit=args.explain_audit,
         )
         binds += result.binds
         preemptions += result.preemptions

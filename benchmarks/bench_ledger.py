@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Efficiency-ledger benchmark: attribution throughput and control-plane
+overhead (docs/observability.md "efficiency ledger").
+
+Two phases:
+
+- **throughput** — the ledger alone over a large synthetic fleet (pools +
+  bound gangs in a mix of running/starting/suspending/draining states, a
+  fake telemetry source): gang-attributions/s and tick wall p50/p99. This
+  is the number that bounds how big a fleet one ledger tick can account at
+  a given cadence.
+- **overhead A/B** — the same scheduler-driven world driven twice, with and
+  without ledger ticks interleaved (the ``--no-ledger`` arm), at the drive
+  loop's own pace. The overhead fraction must stay inside SCHED_BENCH's
+  committed 20% tolerance: the ledger rides the nightly scheduler gate, so
+  this bench failing means the accounting layer started eating the budget
+  the bind path is gated on.
+
+Per-run the conservation audit runs over the throughput phase's journal —
+a perf run that mis-attributes is a failure, not a fast success.
+
+    python benchmarks/bench_ledger.py                # full (CI) shape
+    python benchmarks/bench_ledger.py --gangs 100 --ticks 20   # quick local
+
+Emits one LEDGER_BENCH JSON line (CI artifacts / perf tracking).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from kubeflow_tpu import scheduler as sched  # noqa: E402
+from kubeflow_tpu import sessions as sess  # noqa: E402
+from kubeflow_tpu.api import types as api  # noqa: E402
+from kubeflow_tpu.obs import timeline as tl  # noqa: E402
+from kubeflow_tpu.obs.ledger import FleetEfficiencyLedger  # noqa: E402
+from kubeflow_tpu.runtime.fake import FakeCluster  # noqa: E402
+from kubeflow_tpu.runtime.manager import Manager  # noqa: E402
+from kubeflow_tpu.scheduler.controller import (  # noqa: E402
+    SchedulerReconciler,
+)
+from kubeflow_tpu.scheduler.soak import make_pool  # noqa: E402
+from kubeflow_tpu.utils.metrics import LedgerMetrics  # noqa: E402
+
+NS = "bench"
+OVERHEAD_TOLERANCE = 0.20  # SCHED_BENCH's committed gate tolerance
+
+
+class _Clock:
+    def __init__(self, start: float = 1_000_000.0) -> None:
+        self.t = start
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+class _FakeTelemetry:
+    def __init__(self, duties: dict) -> None:
+        self.duties = duties
+
+    def activity(self, namespace: str, name: str):
+        duty = self.duties.get(name)
+        if duty is None:
+            return None
+
+        class _S:
+            duty_cycle = duty
+
+        return _S()
+
+
+def _quantile(xs: list[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def build_world(gangs: int, seed: int = 7):
+    """N pools of v4-4x4x4 (16 hosts each), gangs bound four-to-a-pool with
+    a seeded state mix — the steady-state fleet a production tick sees."""
+    rng = random.Random(seed)
+    cluster = FakeCluster()
+    pools = max(1, (gangs + 3) // 4)
+    for p in range(pools):
+        make_pool(cluster, "v4", "4x4x4", f"pool-{p:04d}")
+    duties: dict[str, float] = {}
+    offsets = [(0, 0, 0), (2, 0, 0), (0, 2, 0), (2, 2, 0)]  # 2x2x4 carves
+    for i in range(gangs):
+        name = f"g{i:05d}"
+        pool = f"pool-{i // 4:04d}"
+        cluster.create(api.notebook(
+            name, NS, tpu_accelerator="v4", tpu_topology="2x2x4"))
+        slices = [{
+            "pool": pool, "accelerator": "v4", "shape": [2, 2, 4],
+            "offset": list(offsets[i % 4]), "poolTopology": "4x4x4",
+            "nodes": [],
+        }]
+        anns = {
+            sched.PLACEMENT_ANNOTATION: sched.encode_placement(slices, 1.0),
+        }
+        draw = rng.random()
+        if draw < 0.70:  # running, mixed duty
+            anns[tl.TIMELINE_ANNOTATION] = tl.encode_marks(
+                {"requestedAt": 1.0, "runningAt": 2.0})
+            duties[name] = rng.random()
+        elif draw < 0.85:
+            pass  # bound, not yet running: starting
+        elif draw < 0.95:
+            anns[sess.SUSPEND_ANNOTATION] = sess.encode_suspend_request(
+                sess.REASON_PREEMPTION, 1_000_000.0, 3600.0)
+        else:
+            anns[api.STOP_ANNOTATION] = "2026-01-01T00:00:00Z"
+        cluster.patch("Notebook", name, NS, {
+            "metadata": {"annotations": anns}})
+    return cluster, duties
+
+
+def throughput_phase(gangs: int, ticks: int) -> dict:
+    cluster, duties = build_world(gangs)
+    clock = _Clock()
+    ledger = FleetEfficiencyLedger(
+        cluster, LedgerMetrics(), clock=clock, interval_s=1.0,
+        telemetry=_FakeTelemetry(duties),
+    )
+    ledger.tick(force=True)  # anchor outside the timed window
+    walls: list[float] = []
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        clock.advance(15.0)
+        w0 = time.perf_counter()
+        ledger.tick(force=True)
+        walls.append(time.perf_counter() - w0)
+    wall = time.perf_counter() - t0
+    violations = ledger.audit()
+    if violations:
+        for v in violations[:10]:
+            print("AUDIT VIOLATION:", v, file=sys.stderr)
+        raise SystemExit(1)
+    return {
+        "gangs": gangs,
+        "ticks": ticks,
+        "attributions_per_s": round(gangs * ticks / wall, 1),
+        "tick_p50_ms": round(_quantile(walls, 0.50) * 1e3, 3),
+        "tick_p99_ms": round(_quantile(walls, 0.99) * 1e3, 3),
+        "audit": "clean",
+    }
+
+
+LEDGER_INTERVAL_S = 15.0   # the shipped default cadence
+CYCLE_INTERVAL_S = 1.0     # SCHED_BENCH's drain granularity
+
+
+def _build_unbound_world(gangs: int, seed: int = 11):
+    """The SCHED_BENCH shape: pools + a cold queue of UNBOUND gangs the
+    real scheduler drains — every cycle does genuine pack work, which is
+    the denominator the 20% gate is committed against."""
+    rng = random.Random(seed)
+    cluster = FakeCluster()
+    for p in range(max(1, gangs // 8)):
+        make_pool(cluster, "v4", "4x4x4", f"pool-{p:04d}")
+    shapes = ["2x2x1", "2x2x2", "2x2x4"]
+    for i in range(gangs):
+        cluster.create(api.notebook(
+            f"g{i:05d}", NS, tpu_accelerator="v4",
+            tpu_topology=shapes[rng.randrange(len(shapes))]))
+    return cluster
+
+
+def _drive_arm(gangs: int, *, with_ledger: bool) -> tuple[float, int]:
+    """One SCHED_BENCH-shaped arm: the real scheduler drains a cold queue
+    (bound gangs are completed-and-deleted each round, bench_scheduler's
+    drain idiom, so every cycle does genuine pack work), the ledger (when
+    armed) ticking at its TRUE relative cadence — one attribution per
+    LEDGER_INTERVAL_S of virtual time against one scheduler pass per
+    CYCLE_INTERVAL_S, the shipped loop ratio. Forcing a ledger tick per
+    cycle would overstate its cadence ~15x and gate fiction. Returns
+    (wall seconds, placements completed)."""
+    from kubeflow_tpu.runtime.fake import NotFound
+
+    cluster = _build_unbound_world(gangs)
+    clock = _Clock()
+    mgr = Manager(cluster, clock=clock)
+    mgr.register(SchedulerReconciler(clock=clock, aging_interval_s=300.0))
+    ledger = (
+        FleetEfficiencyLedger(
+            cluster, LedgerMetrics(), clock=clock,
+            interval_s=LEDGER_INTERVAL_S,
+        )
+        if with_ledger
+        else None
+    )
+    placed = 0
+    t0 = time.perf_counter()
+    for _ in range(gangs * 4):  # bound: a wedged queue must not spin forever
+        if ledger is not None:
+            ledger.tick()  # interval-gated: fires every 15 virtual seconds
+        mgr.tick()
+        done = [
+            nb for nb in cluster.list("Notebook")
+            if sched.placement_of(nb) is not None
+        ]
+        for nb in done:
+            try:
+                cluster.delete(
+                    "Notebook", nb["metadata"]["name"],
+                    nb["metadata"]["namespace"],
+                )
+            except NotFound:
+                pass
+        placed += len(done)
+        if placed >= gangs:
+            break
+        clock.advance(CYCLE_INTERVAL_S)
+    return time.perf_counter() - t0, placed
+
+
+def overhead_phase(gangs: int, repeats: int) -> dict:
+    # interleave arms to cancel machine drift; ignore a warmup pair
+    _drive_arm(max(8, gangs // 4), with_ledger=True)
+    _drive_arm(max(8, gangs // 4), with_ledger=False)
+    with_l = without = 0.0
+    placed_with = placed_without = 0
+    for _ in range(repeats):
+        w, p = _drive_arm(gangs, with_ledger=True)
+        with_l += w
+        placed_with += p
+        w, p = _drive_arm(gangs, with_ledger=False)
+        without += w
+        placed_without += p
+    pps_with = placed_with / with_l if with_l > 0 else 0.0
+    pps_without = placed_without / without if without > 0 else 0.0
+    overhead = (
+        (pps_without - pps_with) / pps_without if pps_without > 0 else 0.0
+    )
+    return {
+        "gangs": gangs,
+        "repeats": repeats,
+        "ledger_interval_s": LEDGER_INTERVAL_S,
+        "placements_per_s_with_ledger": round(pps_with, 1),
+        "placements_per_s_no_ledger": round(pps_without, 1),
+        "overhead_fraction": round(overhead, 4),
+        "tolerance": OVERHEAD_TOLERANCE,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--gangs", type=int, default=400,
+                    help="bound gangs in the throughput world (default 400)")
+    ap.add_argument("--ticks", type=int, default=40,
+                    help="ledger ticks to time (default 40)")
+    ap.add_argument("--ab-gangs", type=int, default=200,
+                    help="gangs drained in each overhead A/B arm "
+                         "(default 200)")
+    ap.add_argument("--ab-repeats", type=int, default=3,
+                    help="interleaved A/B repetitions (default 3)")
+    ap.add_argument("--no-gate", action="store_true",
+                    help="report the overhead without failing on it")
+    args = ap.parse_args(argv)
+    logging.disable(logging.ERROR)
+
+    result = {
+        "bench": "LEDGER_BENCH",
+        "throughput": throughput_phase(args.gangs, args.ticks),
+        "overhead": overhead_phase(args.ab_gangs, args.ab_repeats),
+    }
+    print("LEDGER_BENCH " + json.dumps(result, sort_keys=True))
+    overhead = result["overhead"]["overhead_fraction"]
+    if not args.no_gate and overhead > OVERHEAD_TOLERANCE:
+        print(
+            f"LEDGER_BENCH gate: ledger overhead {overhead:.1%} exceeds the "
+            f"{OVERHEAD_TOLERANCE:.0%} tolerance SCHED_BENCH is gated on",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"LEDGER_BENCH gate: overhead {overhead:.1%} within "
+        f"{OVERHEAD_TOLERANCE:.0%} "
+        f"({result['throughput']['attributions_per_s']:.0f} "
+        f"gang-attributions/s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

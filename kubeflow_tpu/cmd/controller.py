@@ -160,6 +160,29 @@ def build_manager(
             )
         shared["telemetry"] = telemetry
     telemetry = shared["telemetry"]
+    if "ledger" not in shared:
+        ledger = None
+        # fleet efficiency ledger (obs/ledger.py): exactly-once chip-second
+        # accounting off the reconcile path — driven by its own loop in
+        # main(), like the telemetry collector. ONE ledger per FLEET, not
+        # per shard: its tick reads the whole cluster, so in the
+        # one-process-per-shard production layout every shard leader
+        # running one would export the fleet's chip-seconds N times over
+        # (and the conservation ratio would still read exactly 1, hiding
+        # it). Shard 0's process owns it; the all-in-one layout builds
+        # shard 0 first, so the shared singleton lands identically.
+        if cfg.ledger_enabled and (router is None or shard_id == 0):
+            from kubeflow_tpu.obs.ledger import FleetEfficiencyLedger
+            from kubeflow_tpu.utils.metrics import LedgerMetrics
+
+            ledger = FleetEfficiencyLedger(
+                cluster,
+                LedgerMetrics(metrics.registry),
+                interval_s=cfg.ledger_interval_s,
+                telemetry=telemetry,
+            )
+        shared["ledger"] = ledger
+    ledger = shared["ledger"]
     if "culler" not in shared:
         # one culler: its per-notebook state is keyed by (ns, name) and
         # namespaces are shard-disjoint, so shards never contend on it
@@ -194,6 +217,7 @@ def build_manager(
     # the ops listeners and main loop read it off the manager (build_manager
     # keeps its two-value return for every existing caller)
     manager.telemetry = telemetry
+    manager.ledger = ledger
     manager.slo = slo
     manager.timeline_builder = shared.setdefault(
         "timeline_builder", TimelineBuilder(cluster, telemetry=telemetry)
@@ -443,6 +467,14 @@ def serve_ops(
             from kubeflow_tpu.scheduler.explain import install_explain_route
 
             install_explain_route(probes, cluster)
+        # /debug/ledger (+ /<namespace> drilldown): the chip-second
+        # efficiency ledger; /debug/ itself indexes every debug endpoint
+        # wired above (install_probe_routes mounted it)
+        ledger = getattr(manager, "ledger", None) if manager else None
+        if ledger is not None:
+            from kubeflow_tpu.obs.ledger import install_ledger_routes
+
+            install_ledger_routes(probes, ledger)
         _spawn(probes, port)
     if metrics_port:
         if manager is not None:
@@ -569,6 +601,23 @@ def main() -> None:
 
         threading.Thread(
             target=telemetry_loop, daemon=True, name="telemetry-collector"
+        ).start()
+    ledger = getattr(manager, "ledger", None)
+    if ledger is not None:
+        # the ledger ticks on its own cadence, off the reconcile path like
+        # the collector; standbys skip it — a non-leader attributing the
+        # same fleet would double the fleet's chip-seconds across replicas
+        def ledger_loop() -> None:
+            while True:
+                if reconciling.is_set():
+                    try:
+                        ledger.tick()
+                    except Exception:
+                        log.exception("efficiency ledger tick failed")
+                time.sleep(cfg.ledger_interval_s)
+
+        threading.Thread(
+            target=ledger_loop, daemon=True, name="efficiency-ledger"
         ).start()
     probe_period = max(10.0, cfg.idleness_check_minutes * 60.0 / 2)
     while True:

@@ -81,6 +81,7 @@ def build_platform(
     # collector off the manager when a caller-supplied config enables
     # telemetry, and the webapps then serve its series
     telemetry = getattr(manager, "telemetry", None)
+    ledger = getattr(manager, "ledger", None)
     # ONE watch-backed read layer for every app (webapps/cache.py): each
     # create_app adds its kinds to the shared cache instead of building its
     # own, so one watch set feeds every serving surface
@@ -91,6 +92,7 @@ def build_platform(
             telemetry=telemetry,
             slo=getattr(manager, "slo", None),
             scheduler=getattr(manager, "scheduler_metrics", None),
+            ledger=ledger,
             cache=read_cache,
         ),
         {
@@ -100,6 +102,7 @@ def build_platform(
                 metrics=metrics,
                 telemetry=telemetry,
                 timeline=getattr(manager, "timeline_builder", None),
+                ledger=ledger,
                 cache=read_cache,
             ),
             "/volumes": volumes.create_app(
@@ -126,6 +129,11 @@ def build_platform(
     def tick() -> None:
         cluster.step_kubelet()
         manager.tick()
+        if ledger is not None:
+            # interval-gated, off the reconcile path (the controller
+            # process runs this on its own thread; the demo's single loop
+            # is the same cadence contract)
+            ledger.tick()
 
     return Platform(wsgi=gateway, cluster=cluster, manager=manager, tick=tick)
 

@@ -23,9 +23,22 @@ plane (docs/observability.md):
   phase-partitioned, fault-attributable).
 - ``slo.py`` — phase-attributed startup histograms plus click-to-ready SLO
   objectives with error-budget burn-rate gauges.
+- ``ledger.py`` — the fleet efficiency ledger: exactly-once chip-second
+  accounting (busy / idle_allocated / starting / suspending / draining /
+  free_usable / free_stranded / unavailable, plus parked and queued demand)
+  with an exact conservation invariant the soaks audit per seed, served at
+  ``/debug/ledger`` and rolled into JWA + dashboard surfaces.
 """
 from kubeflow_tpu.obs.events import EventRecorder
-from kubeflow_tpu.obs.health import HealthState, install_probe_routes
+from kubeflow_tpu.obs.health import (
+    HealthState,
+    install_debug_index,
+    install_probe_routes,
+)
+from kubeflow_tpu.obs.ledger import (
+    FleetEfficiencyLedger,
+    install_ledger_routes,
+)
 from kubeflow_tpu.obs.slo import SLOMetrics
 from kubeflow_tpu.obs.timeline import (
     TimelineBuilder,
@@ -37,7 +50,10 @@ from kubeflow_tpu.obs.tracing import Span, Tracer, TracingCluster
 
 __all__ = [
     "EventRecorder",
+    "FleetEfficiencyLedger",
     "HealthState",
+    "install_debug_index",
+    "install_ledger_routes",
     "SLOMetrics",
     "TimelineBuilder",
     "TimelineRecorder",

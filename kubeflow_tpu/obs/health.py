@@ -151,6 +151,8 @@ def install_probe_routes(app, health: HealthState, tracer=None) -> None:
     def readyz(request):
         return _respond(*health.readyz())
 
+    install_debug_index(app)
+
     if tracer is not None:
 
         @app.route("/debug/traces")
@@ -171,3 +173,36 @@ def install_probe_routes(app, health: HealthState, tracer=None) -> None:
                 ),
                 mimetype="application/json",
             )
+
+
+def install_debug_index(app) -> None:
+    """Mount ``/debug/``: an index of every debug endpoint registered on
+    this probe app — traces, telemetry, timeline, explain, ledger, whatever
+    lands next — so operators stop guessing URLs. The listing is computed
+    from the live url_map at request time, so a route wired after this call
+    (install order varies by deployment) still shows up; an endpoint that
+    is NOT listed is genuinely not served here. A bare ``/debug`` rides
+    werkzeug's trailing-slash redirect."""
+    import json as _json
+
+    from werkzeug.wrappers import Response
+
+    @app.route("/debug/")
+    def debug_index(request):
+        routes = sorted(
+            {
+                r.rule
+                for r in app.url_map.iter_rules()
+                if r.rule.startswith("/debug") and r.rule != "/debug/"
+            }
+        )
+        return Response(
+            _json.dumps(
+                {
+                    "endpoints": routes,
+                    "probes": ["/healthz", "/readyz"],
+                },
+                sort_keys=True,
+            ),
+            mimetype="application/json",
+        )

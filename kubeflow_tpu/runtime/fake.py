@@ -86,7 +86,7 @@ class FakeCluster:
         self._rv = itertools.count(1)
         self._watchers: list[tuple[str | None, WatchFn]] = []
         # kind-pattern -> mutator, the MutatingWebhookConfiguration analog
-        self._mutators: list[tuple[str, MutatorFn]] = []
+        self._mutators: list[tuple[str, MutatorFn, tuple[str, ...]]] = []
         # (namespace, pod) -> "[container] line" entries, the kubelet log store
         self._pod_logs: dict[tuple[str, str], list[str]] = {}
 
@@ -98,9 +98,7 @@ class FakeCluster:
             raise ValueError("object has no kind")
         with self._lock:
             if not skip_admission:
-                for pattern, fn in self._mutators:
-                    if fnmatch.fnmatch(obj["kind"], pattern):
-                        obj = fn(obj, self)
+                obj = self._admit(obj, "CREATE")
             k = _key(obj)
             if k in self._objects:
                 raise AlreadyExists(f"{k} already exists")
@@ -192,6 +190,18 @@ class FakeCluster:
                 and ko.matches_selector(self._objects[key], selector)
             }
 
+    def _admit(self, obj: dict, operation: str) -> dict:
+        """Run the registered mutating webhooks for one operation (caller
+        holds the lock). Real MutatingWebhookConfigurations name the
+        operations they intercept; mutators here default to CREATE-only and
+        opt into UPDATE explicitly (``register_mutator(operations=...)``)."""
+        for pattern, fn, operations in self._mutators:
+            if operation in operations and fnmatch.fnmatch(
+                obj["kind"], pattern
+            ):
+                obj = fn(obj, self)
+        return obj
+
     def update(self, obj: Mapping) -> dict:
         obj = ko.deep_copy(dict(obj))
         k = _key(obj)
@@ -203,6 +213,7 @@ class FakeCluster:
             cur_rv = ko.meta(current).get("resourceVersion")
             if sent_rv is not None and sent_rv != cur_rv:
                 raise Conflict(f"{k}: resourceVersion {sent_rv} != {cur_rv}")
+            obj = self._admit(obj, "UPDATE")
             ko.meta(obj)["uid"] = ko.meta(current).get("uid")
             ko.meta(obj)["resourceVersion"] = str(next(self._rv))
             self._objects[k] = obj
@@ -372,10 +383,21 @@ class FakeCluster:
 
     # ------------------------------------------------------------- admission
 
-    def register_mutator(self, kind_pattern: str, fn: MutatorFn) -> None:
+    def register_mutator(
+        self,
+        kind_pattern: str,
+        fn: MutatorFn,
+        operations: tuple[str, ...] = ("CREATE",),
+    ) -> None:
         """The MutatingWebhookConfiguration analog
-        (``admission-webhook/manifests/base/mutating-webhook-configuration.yaml``)."""
-        self._mutators.append((kind_pattern, fn))
+        (``admission-webhook/manifests/base/mutating-webhook-configuration.yaml``).
+        ``operations`` mirrors the webhook rule's operations list: mutators
+        default to CREATE-only (the historical behavior — per-pod env
+        injection happens once, at admission of the pod CREATE); a mutator
+        that must also heal drift on writes registers with
+        ``("CREATE", "UPDATE")`` (the family-label enforcement in
+        ``webhooks/tpu_env.py``)."""
+        self._mutators.append((kind_pattern, fn, tuple(operations)))
 
     # --------------------------------------------------- cluster fixtures
 

@@ -618,6 +618,7 @@ def run_sched_seed(
     max_restarts_per_tick: int = 6,
     lost_update_audit: bool = True,
     explain_audit: bool = True,
+    ledger_audit: bool = True,
 ) -> SchedSeedResult:
     """One seeded soak run: hostile timeline under chaos, heal, settle,
     quiesce, then the fixed-point audit. ``faults=None`` runs the same
@@ -680,6 +681,16 @@ def run_sched_seed(
     # one SLO ring across restarts (an observer, like the tracer); the
     # timeline recorder itself is stateless — marks live on the CRs
     slo = SLOMetrics(clock=clock)
+
+    # the efficiency ledger: an observer across restarts (like the tracer),
+    # ticked only by the harness driver, reading the unfaulted base. This
+    # soak's drains/flaps/preemptions are exactly the traffic the
+    # conservation invariant must survive — chips moving between gangs,
+    # blocked cells, and fragmentation strands, every chip-second still
+    # landing in exactly one bucket (docs/chaos.md "efficiency ledger").
+    from kubeflow_tpu.obs.ledger import FleetEfficiencyLedger
+
+    ledger = FleetEfficiencyLedger(base, clock=clock, interval_s=1.0)
 
     # Differential-audit sink shared across scheduler incarnations: every
     # cycle of every incarnation cross-checks the incremental fleet model
@@ -753,6 +764,7 @@ def run_sched_seed(
             cluster.step_kubelet()
             if chaos is not None:
                 chaos.tick_watches()
+            ledger.tick(force=True)
             tick()
             if chaos is not None:
                 lat = chaos.take_latency()
@@ -797,6 +809,7 @@ def run_sched_seed(
     quiesced = False
     for s in range(20):
         cluster.step_kubelet()
+        ledger.tick(force=True)
         tick()
         fp = fingerprint(base)
         if fp == prev:
@@ -825,6 +838,11 @@ def run_sched_seed(
         violations.extend(
             explain_mod.audit_explanations(base, router=router, where="final")
         )
+    if ledger_audit:
+        # conservation audit (docs/chaos.md "efficiency ledger"): per seed,
+        # Σ buckets == ∫ capacity dt exactly — across every drain, flap,
+        # preemption handoff, and crash-restart in the timeline
+        violations.extend(ledger.audit(where="final"))
     # incremental-vs-from-scratch model divergence anywhere in the run
     violations.extend(diff_failures)
     # causality + event-storm audits (obs/): every write attributable to a

@@ -454,6 +454,7 @@ def run_session_seed(
     *,
     max_restarts_per_tick: int = 6,
     lost_update_audit: bool = True,
+    ledger_audit: bool = True,
 ) -> SessionSeedResult:
     """One seeded soak run: hostile timeline under API + store chaos, heal,
     settle past every deadline, quiesce, then the fixed-point audits.
@@ -505,6 +506,16 @@ def run_session_seed(
     # one SLO ring across restarts (an observer, like the tracer); the
     # timeline recorder itself is stateless — marks live on the CRs
     slo = SLOMetrics(clock=clock)
+
+    # the efficiency ledger: an observer across restarts, ticked only by
+    # the harness. This soak is where the ledger's barrier-window buckets
+    # earn their keep — suspend handoffs (suspending), stop/cull teardowns
+    # (draining), resumes (starting), and parked sessions all cross
+    # controller crash-restarts here, and the conservation audit proves no
+    # interval is double-counted or leaked through any of them.
+    from kubeflow_tpu.obs.ledger import FleetEfficiencyLedger
+
+    ledger = FleetEfficiencyLedger(base, clock=clock, interval_s=1.0)
 
     # shared across scheduler incarnations (crash-restarts)
     sched_diff_failures: list[str] = []
@@ -569,6 +580,7 @@ def run_session_seed(
             agent.tick()  # user work advances on every live session
             if chaos is not None:
                 chaos.tick_watches()
+            ledger.tick(force=True)
             tick()
             if chaos is not None:
                 lat = chaos.take_latency()
@@ -607,6 +619,7 @@ def run_session_seed(
     for s in range(24):
         cluster.step_kubelet()
         agent.tick()
+        ledger.tick(force=True)
         tick()
         violations.extend(auditor.observe(base, clock(), f"quiesce {s}"))
         fp = fingerprint(base)
@@ -634,6 +647,11 @@ def run_session_seed(
     # chunk-level no-loss: nothing referenced missing, nothing orphaned,
     # no pin leaks — across every crash-restart and store fault in the run
     violations.extend(audit_chunk_store(store))
+    if ledger_audit:
+        # conservation audit (docs/chaos.md "efficiency ledger"): every
+        # chip-second of every pool in exactly one bucket through every
+        # suspend handoff, force-deadline release, and resume re-bind
+        violations.extend(ledger.audit(where="final"))
     # incremental-vs-from-scratch scheduler model divergence anywhere
     violations.extend(sched_diff_failures)
     violations.extend(tracer.audit())

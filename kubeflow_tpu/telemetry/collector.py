@@ -60,7 +60,7 @@ SIGNALS = ("duty_cycle", "hbm_used", "hbm_total", "steps")
 class _Session:
     __slots__ = (
         "store", "created_at", "last_ok", "last_attempt", "failures",
-        "pool", "latest",
+        "pool", "chips", "latest",
     )
 
     def __init__(self, history: int, now: float) -> None:
@@ -70,6 +70,9 @@ class _Session:
         self.last_attempt = float("-inf")
         self.failures = 0
         self.pool = ""
+        # allocated chips from the bound placement: the session's weight in
+        # the pool/fleet duty-cycle means (0 = unbound/unknown, weighted 1)
+        self.chips = 0
         self.latest: ActivitySample | None = None
 
     def anchor(self) -> float:
@@ -194,6 +197,13 @@ class FleetTelemetryCollector:
         placement = sched.placement_of(nb)
         if placement and placement.get("slices"):
             sess.pool = placement["slices"][0].get("pool", "") or ""
+            chips = 0
+            for s in placement["slices"]:
+                n = 1
+                for d in s.get("shape") or []:
+                    n *= int(d)
+                chips += n
+            sess.chips = chips
         # an agent that advertises its duty cycle as unknown (blind backend
         # + uninstrumented notebook) yields duty None: HBM stays usable,
         # but idleness consumers must fall back — unknown is not idle.
@@ -240,41 +250,55 @@ class FleetTelemetryCollector:
         m.pool_duty_cycle.clear()
         m.pool_hbm_utilization.clear()
         stale = 0
-        pools: dict[str, list[ActivitySample]] = {}
-        fresh: list[ActivitySample] = []
+        pools: dict[str, list[tuple[ActivitySample, int]]] = {}
+        fresh: list[tuple[ActivitySample, int]] = []
         for (ns, name), sess in self._sessions.items():
             if sess.latest is None or now - sess.last_ok > self.staleness_s:
                 stale += 1
                 continue
             s = sess.latest
-            fresh.append(s)
-            pools.setdefault(sess.pool, []).append(s)
+            # chip-weighted duty means: a 256-chip slice idling wastes 256x
+            # what a 1-chip session does, so the fleet/pool duty cycle is
+            # "what fraction of the allocated, reporting chips are busy" —
+            # the ledger's busy input (obs/ledger.py) — never a per-session
+            # headcount mean. Unbound sessions (no placement yet) weight 1.
+            weight = max(1, sess.chips)
+            fresh.append((s, weight))
+            pools.setdefault(sess.pool, []).append((s, weight))
             if s.duty_cycle is not None:
                 m.session_duty_cycle.set(
                     s.duty_cycle, namespace=ns, notebook=name
                 )
             m.session_hbm_used.set(s.hbm_used_bytes, namespace=ns, notebook=name)
             m.session_hbm_total.set(s.hbm_total_bytes, namespace=ns, notebook=name)
-        for pool, samples in pools.items():
+
+        def weighted_duty(entries) -> float | None:
+            num = den = 0.0
+            for s, w in entries:
+                if s.duty_cycle is not None:
+                    num += s.duty_cycle * w
+                    den += w
+            # unknown-duty sessions don't drag the mean to 0
+            return num / den if den else None
+
+        for pool, entries in pools.items():
             if not pool:
                 continue  # unbound gangs have no pool to attribute
-            duties = [
-                s.duty_cycle for s in samples if s.duty_cycle is not None
-            ]
-            if duties:  # unknown-duty sessions don't drag the mean to 0
-                m.pool_duty_cycle.set(sum(duties) / len(duties), pool=pool)
-            total = sum(s.hbm_total_bytes for s in samples)
-            used = sum(s.hbm_used_bytes for s in samples)
+            duty = weighted_duty(entries)
+            if duty is not None:
+                m.pool_duty_cycle.set(duty, pool=pool)
+            total = sum(s.hbm_total_bytes for s, _ in entries)
+            used = sum(s.hbm_used_bytes for s, _ in entries)
             m.pool_hbm_utilization.set(
                 used / total if total > 0 else 0.0, pool=pool
             )
         m.sessions.set(len(self._sessions))
         m.stale_sessions.set(stale)
-        duties = [s.duty_cycle for s in fresh if s.duty_cycle is not None]
-        m.fleet_duty_cycle.set(sum(duties) / len(duties) if duties else 0.0)
+        duty = weighted_duty(fresh)
+        m.fleet_duty_cycle.set(duty if duty is not None else 0.0)
         if fresh:
-            total = sum(s.hbm_total_bytes for s in fresh)
-            used = sum(s.hbm_used_bytes for s in fresh)
+            total = sum(s.hbm_total_bytes for s, _ in fresh)
+            used = sum(s.hbm_used_bytes for s, _ in fresh)
             m.fleet_hbm_utilization.set(used / total if total > 0 else 0.0)
         else:
             m.fleet_hbm_utilization.set(0.0)

@@ -966,6 +966,7 @@ def run_scenario(
     max_restarts_per_tick: int = 6,
     lost_update_audit: bool = True,
     explain_audit: bool = True,
+    ledger_audit: bool = True,
 ) -> ScenarioRun:
     """One full scenario run on the virtual clock. ``faults=None`` is the
     fault-free reference run whose final state is the fixed point.
@@ -1084,6 +1085,19 @@ def run_scenario(
             target_for=lambda nb: (ko.namespace(nb), 0, ko.name(nb)),
             tracer=tracer,
         )
+
+    # the efficiency ledger is an observer like the tracer and the
+    # collector: ONE instance across controller restarts, ticked only by
+    # the harness driver (never inside a reconcile), reading the unfaulted
+    # base — its subject is where chip-time went, and the ground truth of
+    # that is the store itself. The per-seed conservation audit
+    # (docs/chaos.md) proves Σ buckets == ∫ capacity dt exactly and every
+    # attribution re-derives from its captured evidence.
+    from kubeflow_tpu.obs.ledger import FleetEfficiencyLedger
+
+    ledger = FleetEfficiencyLedger(
+        base, clock=clock, interval_s=1.0, telemetry=collector
+    )
 
     # the culler outlives restarts (annotation state lives on the CRs); its
     # telemetry view is the collector's in-memory store — a pure read, so a
@@ -1276,6 +1290,7 @@ def run_scenario(
                 # the controller-manager's dedicated loop (cmd/controller):
                 # a scrape pass between ticks, interval-gated, never inside
                 collector.collect()
+            ledger.tick(force=True)
             tick(where)
             if chaos is not None:
                 lat = chaos.take_latency()
@@ -1320,6 +1335,7 @@ def run_scenario(
         cluster.step_kubelet()
         if collector is not None:
             collector.collect()
+        ledger.tick(force=True)
         tick(f"quiesce {s}")
         fp = fingerprint(base)
         if fp == prev:
@@ -1366,6 +1382,12 @@ def run_scenario(
         # bounded, and every duty-cycle cull explainable from the recorded
         # series (zero reconcile-path scrapes is asserted per tick above)
         violations.extend(collector.audit(where="final"))
+    if ledger_audit:
+        # conservation audit (docs/chaos.md "efficiency ledger"): per seed,
+        # Σ buckets == ∫ capacity dt exactly (integer equality, no
+        # epsilon), intervals contiguous and non-overlapping across every
+        # crash-restart, every attribution re-proven from its evidence
+        violations.extend(ledger.audit(where="final"))
     return ScenarioRun(
         fingerprint=prev or fingerprint(base),
         violations=violations,
@@ -1383,6 +1405,7 @@ def run_seed(
     shards: int = 1,
     lost_update_audit: bool = True,
     explain_audit: bool = True,
+    ledger_audit: bool = True,
 ) -> SeedResult:
     """The soak unit: fault-free fixed point vs faulted run, same seed.
     ``telemetry=True`` runs BOTH with the data-plane pipeline armed — the
@@ -1393,11 +1416,12 @@ def run_seed(
     convergence then proves the partition changes no outcomes."""
     reference = run_scenario(
         seed, None, telemetry=telemetry, shards=shards,
-        explain_audit=explain_audit,
+        explain_audit=explain_audit, ledger_audit=ledger_audit,
     )
     chaotic = run_scenario(
         seed, faults or ChaosConfig(), telemetry=telemetry, shards=shards,
         lost_update_audit=lost_update_audit, explain_audit=explain_audit,
+        ledger_audit=ledger_audit,
     )
     violations = list(chaotic.violations)
     if reference.violations:

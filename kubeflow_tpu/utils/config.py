@@ -78,6 +78,14 @@ class ControllerConfig:
     telemetry_staleness_s: float = 60.0
     telemetry_duty_cycle_idle: float = 0.05
     telemetry_port: int = 8890
+    # Fleet efficiency ledger (obs/ledger.py): exactly-once chip-second
+    # accounting with waste attribution — busy/idle/starting/suspending/
+    # draining/free/stranded per pool, family, and namespace, plus queued
+    # unmet demand. Off by default for programmatic construction (same
+    # rationale as telemetry_enabled); the shipped controller-manager
+    # enables it (LEDGER_ENABLED; --no-ledger A/B via LEDGER_ENABLED=0).
+    ledger_enabled: bool = False
+    ledger_interval_s: float = 15.0
     # Control-plane sharding (runtime/sharding.py): partition the manager
     # plane by namespace hash and the scheduler by accelerator family into
     # SHARDS independent shards, each behind its own leader lease. 1 (the
@@ -120,6 +128,8 @@ class ControllerConfig:
                 "TELEMETRY_DUTY_CYCLE_IDLE", 0.05
             ),
             telemetry_port=int(_env_float("TELEMETRY_PORT", 8890)),
+            ledger_enabled=_env_bool("LEDGER_ENABLED", True),
+            ledger_interval_s=_env_float("LEDGER_INTERVAL_S", 15.0),
             shards=max(1, int(_env_float("SHARDS", 1))),
             shard_id=(
                 int(_env_float("SHARD_ID", -1))

@@ -1026,3 +1026,77 @@ class TelemetryMetrics:
             "Culls decided on the duty-cycle signal (vs kernel fallback)",
             labelnames=("policy",),
         )
+
+
+class LedgerMetrics:
+    """Fleet efficiency ledger (obs/ledger.py, docs/observability.md
+    "efficiency ledger"): exactly-once chip-second accounting. The
+    ``*_chip_seconds_total`` counters are cumulative integrals maintained by
+    the ledger's integer accountant and SET to the monotone total each tick,
+    so the exposed value is exactly the audited one; conservation is
+    queryable straight off the scrape::
+
+        sum by (pool) (tpu_pool_chip_seconds_total)
+          == tpu_capacity_chip_seconds_total
+    """
+
+    # one ledger tick: a Node+Notebook list plus a from-scratch fleet build
+    TICK_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0)
+
+    def __init__(self, registry: Registry | None = None) -> None:
+        self.registry = registry or Registry()
+        self.chip_seconds = self.registry.counter(
+            "tpu_chip_seconds_total",
+            "Chip-seconds attributed per namespace and bucket (busy, "
+            "idle_allocated, starting, suspending, draining, parked)",
+            labelnames=("namespace", "bucket"),
+        )
+        self.pool_chip_seconds = self.registry.counter(
+            "tpu_pool_chip_seconds_total",
+            "Chip-seconds per pool and bucket; over the conservation "
+            "buckets this sums exactly to tpu_capacity_chip_seconds_total",
+            labelnames=("pool", "bucket"),
+        )
+        self.family_chip_seconds = self.registry.counter(
+            "tpu_family_chip_seconds_total",
+            "Chip-seconds per accelerator family and bucket (pool rollup)",
+            labelnames=("family", "bucket"),
+        )
+        self.capacity_chip_seconds = self.registry.counter(
+            "tpu_capacity_chip_seconds_total",
+            "Time-integral of pool capacity — the conservation invariant's "
+            "right-hand side",
+            labelnames=("pool",),
+        )
+        self.queued_chip_seconds = self.registry.counter(
+            "tpu_queued_chip_seconds_total",
+            "Requested chips x queue wait per accelerator family — unmet "
+            "demand, the elastic-capacity scale-up trigger",
+            labelnames=("family",),
+        )
+        self.fleet_efficiency = self.registry.gauge(
+            "tpu_fleet_efficiency",
+            "Cumulative busy / allocated chip-seconds across the fleet, 0..1",
+        )
+        self.fleet_waste_fraction = self.registry.gauge(
+            "tpu_fleet_waste_fraction",
+            "Cumulative wasted (idle/starting/suspending/draining/stranded) "
+            "chip-seconds / capacity chip-seconds, 0..1",
+        )
+        self.unmet_demand_chips = self.registry.gauge(
+            "tpu_unmet_demand_chips",
+            "Chips currently requested by queued (unbound, feasible) gangs",
+        )
+        self.parked_chips = self.registry.gauge(
+            "tpu_parked_chips",
+            "Chips whose sessions are suspended with chips released — "
+            "oversubscription headroom",
+        )
+        self.ticks_total = self.registry.counter(
+            "tpu_ledger_ticks_total", "Ledger attribution ticks taken"
+        )
+        self.tick_seconds = self.registry.histogram(
+            "tpu_ledger_tick_seconds",
+            "Wall time of one ledger attribution tick",
+            buckets=self.TICK_BUCKETS,
+        )

@@ -69,6 +69,7 @@ def create_app(
     telemetry=None,
     slo=None,
     scheduler=None,
+    ledger=None,
     cache: ReadCache | None = None,
     use_cache: bool = True,
 ) -> App:
@@ -103,6 +104,15 @@ def create_app(
         # gauge reads: the scheduler's own cycle keeps them current.
         readers["queue_depth"] = scheduler.total_queue_depth
         readers["fragmentation"] = scheduler.fleet_fragmentation_index
+    if ledger is not None:
+        # efficiency-ledger series (obs/ledger.py): the economics row —
+        # busy ÷ allocated, waste ÷ capacity, and live unmet demand in
+        # chips. Pure memory reads off the same registry families that
+        # /debug/ledger and the JWA efficiency field serve, so every
+        # surface tells one story.
+        readers["efficiency"] = ledger.fleet_efficiency
+        readers["waste"] = ledger.fleet_waste_fraction
+        readers["unmet_demand"] = ledger.unmet_demand_chips
     owned_source = None
     if metrics_source is None:
         if os.environ.get("METRICS_SOURCE"):
@@ -376,6 +386,15 @@ def create_app(
         elif scheduler is not None and metric_type == "fragmentation":
             # per-pool fragmentation indices as the labeled values
             values = scheduler.pool_fragmentation.samples()
+        elif ledger is not None and metric_type == "efficiency":
+            values = [{"labels": {}, "value": ledger.fleet_efficiency()}]
+        elif ledger is not None and metric_type == "waste":
+            # per-pool/bucket chip-second breakdown as the labeled values;
+            # the fleet waste fraction is the series
+            values = ledger.metrics.pool_chip_seconds.samples()
+        elif ledger is not None and metric_type == "unmet_demand":
+            # per-family queued chip-seconds as the labeled values
+            values = ledger.metrics.queued_chip_seconds.samples()
         else:
             raise ValueError(f"unknown metric type {metric_type!r}")
         try:

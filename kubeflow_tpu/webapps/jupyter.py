@@ -178,6 +178,7 @@ def create_app(
     metrics: NotebookMetrics | None = None,
     telemetry=None,
     timeline=None,
+    ledger=None,
     cache: ReadCache | None = None,
     use_cache: bool = True,
 ) -> App:
@@ -206,14 +207,18 @@ def create_app(
         return cache.etag(*scopes, principal=principal, extra=extra)
 
     def _tel_extra() -> str:
-        # telemetry/timeline payloads change without any CR rv moving; the
-        # collector's pass counter folds that freshness into the ETag
+        # telemetry/timeline/ledger payloads change without any CR rv
+        # moving; the collector's pass counter and the ledger's tick
+        # counter fold that freshness into the ETag
         tel = telemetry if telemetry is not None else getattr(
             timeline, "telemetry", None
         )
-        if tel is None:
-            return ""
-        return f"tel:{getattr(tel, 'scrape_passes', 0)}"
+        parts = []
+        if tel is not None:
+            parts.append(f"tel:{getattr(tel, 'scrape_passes', 0)}")
+        if ledger is not None:
+            parts.append(f"led:{getattr(ledger, 'ticks', 0)}")
+        return ",".join(parts)
 
     app.attach_frontend("jupyter")
     base.add_namespaces_route(app, cluster)
@@ -362,6 +367,14 @@ def create_app(
             # attribution of this session's startup — "which layer ate the
             # time" rendered right on the overview tab
             summary["timeline"] = timeline.build(namespace, name)
+        if ledger is not None:
+            # the efficiency ledger's per-notebook account (obs/ledger.py):
+            # where this session's chip-time went (busy vs idle vs barrier
+            # windows) and its busy/allocated ratio — the same registry
+            # families the dashboard's fleet series roll up. None for a
+            # session the ledger never attributed an interval to, so the
+            # UI can distinguish "new session" from "ledger off".
+            summary["efficiency"] = ledger.notebook_payload(namespace, name)
         return base.set_etag(success("notebook", summary, raw=nb), etag)
 
     @app.route("/api/namespaces/<namespace>/notebooks/<name>/pod")

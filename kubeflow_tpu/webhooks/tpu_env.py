@@ -114,5 +114,40 @@ def make_mutator(config: ControllerConfig | None = None):
     return mutate
 
 
+def family_label_mutator(nb: dict, cluster) -> dict:
+    """Enforce/heal the ``tpu.kubeflow.org/accelerator-family`` label on
+    Notebook CREATE **and UPDATE** (the ROADMAP sharding follow-on).
+
+    The label is what lets a sharded scheduler's list/watch select only its
+    own families server-side (``runtime/sharding.py``); before this it was
+    creation-stamped client-side (``api.notebook``) and healed only by the
+    owning shard's reconcile — a kubectl label-strip or spec drift left a
+    window where the filtered ingest could not see the gang. Admission
+    closes the window: a write that strips or mis-sets the label is
+    rewritten to the family ``spec.tpu.accelerator`` proves, and a non-TPU
+    notebook sheds a stale label (it is no gang; no shard owns it). The
+    label stays an optimization, never the authority — ownership still
+    re-derives from spec — but with admission enforcing it the hint can no
+    longer silently lie."""
+    from kubeflow_tpu.runtime.sharding import FAMILY_LABEL, notebook_family
+
+    fam = notebook_family(nb)
+    labels = (nb.get("metadata") or {}).get("labels") or {}
+    if labels.get(FAMILY_LABEL) == fam or (
+        fam is None and FAMILY_LABEL not in labels
+    ):
+        return nb
+    nb = ko.deep_copy(nb)
+    labels = nb.setdefault("metadata", {}).setdefault("labels", {})
+    if fam is None:
+        labels.pop(FAMILY_LABEL, None)
+    else:
+        labels[FAMILY_LABEL] = fam
+    return nb
+
+
 def install(cluster: FakeCluster, config: ControllerConfig | None = None) -> None:
     cluster.register_mutator("Pod", make_mutator(config))
+    cluster.register_mutator(
+        "Notebook", family_label_mutator, operations=("CREATE", "UPDATE")
+    )

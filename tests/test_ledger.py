@@ -1,0 +1,618 @@
+"""Fleet efficiency ledger (obs/ledger.py, docs/observability.md
+"efficiency ledger"): bucket taxonomy, exact conservation, exactly-once
+intervals across crash-restart windows, the audit's misattribution
+detection, the /debug/ledger routes, and the JWA/dashboard surfaces.
+
+The exactness claims here are deliberate ``==`` on integers and on the
+float projections the ledger itself exports — the conservation invariant is
+"no epsilon", so the tests must not soften it with approx."""
+from __future__ import annotations
+
+import json
+
+from werkzeug.test import Client
+
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu import sessions as sess
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.obs import timeline as tl
+from kubeflow_tpu.obs.ledger import (
+    BUCKET_BUSY,
+    BUCKET_DRAINING,
+    BUCKET_FREE_STRANDED,
+    BUCKET_FREE_USABLE,
+    BUCKET_IDLE,
+    BUCKET_PARKED,
+    BUCKET_STARTING,
+    BUCKET_SUSPENDING,
+    BUCKET_UNAVAILABLE,
+    CONSERVATION_BUCKETS,
+    FleetEfficiencyLedger,
+    classify_gang,
+    install_ledger_routes,
+)
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.scheduler.soak import make_pool
+from kubeflow_tpu.utils.metrics import LedgerMetrics
+from kubeflow_tpu.webapps.base import App
+
+NS = "team-a"
+
+
+class FakeClock:
+    def __init__(self, t: float = 1_000_000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+class FakeTelemetry:
+    """The collector surface the ledger reads: activity(ns, name)."""
+
+    def __init__(self, duties: dict | None = None) -> None:
+        self.duties = duties or {}
+
+    def activity(self, namespace: str, name: str):
+        duty = self.duties.get(name)
+        if duty is None:
+            return None
+
+        class _S:
+            duty_cycle = duty
+
+        return _S()
+
+
+def _world(pools=(("v4", "2x2x4", "pool-a"),)):
+    cluster = FakeCluster()
+    for accel, topo, name in pools:
+        make_pool(cluster, accel, topo, name)
+    return cluster
+
+
+def _bind(cluster, name, *, pool="pool-a", shape=(2, 2, 2), accel="v4",
+          queued_at=None, bound_at=1.0, ns=NS):
+    slices = [{
+        "pool": pool, "accelerator": accel, "shape": list(shape),
+        "offset": [0, 0, 0], "poolTopology": "2x2x4", "nodes": [],
+    }]
+    anns = {
+        sched.PLACEMENT_ANNOTATION: sched.encode_placement(slices, bound_at)
+    }
+    if queued_at is not None:
+        anns[sched.QUEUED_AT_ANNOTATION] = str(queued_at)
+    cluster.patch("Notebook", name, ns, {"metadata": {"annotations": anns}})
+
+
+def _running(cluster, name, ns=NS):
+    cluster.patch("Notebook", name, ns, {"metadata": {"annotations": {
+        tl.TIMELINE_ANNOTATION: tl.encode_marks(
+            {"requestedAt": 1.0, "runningAt": 2.0}
+        )}}})
+
+
+def _mk(cluster, clock, **kw):
+    kw.setdefault("interval_s", 1.0)
+    return FleetEfficiencyLedger(cluster, LedgerMetrics(), clock=clock, **kw)
+
+
+def _pool_ms(ledger, pool="pool-a"):
+    return ledger.pool_totals[pool]
+
+
+class TestClassification:
+    def test_ranking(self):
+        assert classify_gang(
+            {"suspendReason": sess.REASON_PREEMPTION, "stopped": True,
+             "state": None, "running": True}
+        ) == BUCKET_SUSPENDING
+        assert classify_gang(
+            {"suspendReason": sess.REASON_STOP, "stopped": False,
+             "state": None, "running": True}
+        ) == BUCKET_DRAINING
+        assert classify_gang(
+            {"suspendReason": None, "stopped": True,
+             "state": None, "running": True}
+        ) == BUCKET_DRAINING
+        assert classify_gang(
+            {"suspendReason": None, "stopped": False,
+             "state": sess.STATE_RESUMING, "running": True}
+        ) == BUCKET_STARTING
+        assert classify_gang(
+            {"suspendReason": None, "stopped": False,
+             "state": None, "running": False}
+        ) == BUCKET_STARTING
+        assert classify_gang(
+            {"suspendReason": None, "stopped": False,
+             "state": None, "running": True}
+        ) == "running"
+
+
+class TestAttribution:
+    def test_empty_pool_time_is_free_usable(self):
+        cluster = _world()
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        clock.advance(10)
+        led.tick(force=True)
+        b = _pool_ms(led)
+        # 2x2x4 = 16 chips, one contiguous hole
+        assert b[BUCKET_FREE_USABLE] == 16 * 10_000
+        assert sum(b.values()) == led.capacity_totals["pool-a"]
+        assert led.audit() == []
+
+    def test_first_tick_only_anchors(self):
+        cluster = _world()
+        led = _mk(cluster, FakeClock())
+        assert led.tick(force=True) == 0
+        assert led.pool_totals == {}
+
+    def test_bound_not_running_is_starting(self):
+        cluster = _world()
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        _bind(cluster, "nb")
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        clock.advance(5)
+        led.tick(force=True)
+        b = _pool_ms(led)
+        assert b[BUCKET_STARTING] == 8 * 5_000
+        assert b[BUCKET_FREE_USABLE] == 8 * 5_000
+        assert led.ns_totals[NS][BUCKET_STARTING] == 8 * 5_000
+        assert led.audit() == []
+
+    def test_running_without_telemetry_is_idle_allocated(self):
+        cluster = _world()
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        _bind(cluster, "nb")
+        _running(cluster, "nb")
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        clock.advance(7)
+        led.tick(force=True)
+        b = _pool_ms(led)
+        assert b[BUCKET_BUSY] == 0
+        assert b[BUCKET_IDLE] == 8 * 7_000
+        assert led.audit() == []
+
+    def test_duty_cycle_splits_busy_idle_exactly(self):
+        """An awkward duty (1/3) over many ticks: the residual construction
+        keeps busy + idle == chips·dt in integers at every step — the sum is
+        exactly the capacity integral, never epsilon-close to it."""
+        cluster = _world()
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        _bind(cluster, "nb")
+        _running(cluster, "nb")
+        clock = FakeClock()
+        led = _mk(cluster, clock, telemetry=FakeTelemetry({"nb": 1 / 3}))
+        led.tick(force=True)
+        for _ in range(37):
+            clock.advance(1.7)  # non-integral seconds: ms quantization
+            led.tick(force=True)
+        b = _pool_ms(led)
+        assert b[BUCKET_BUSY] > 0 and b[BUCKET_IDLE] > 0
+        assert sum(b.values()) == led.capacity_totals["pool-a"]
+        assert led.audit() == []
+        eff = led.fleet_efficiency()
+        assert 0.30 < eff < 0.37  # ≈ 1/3, quantized per tick
+
+    def test_suspend_reason_buckets(self):
+        cluster = _world()
+        for name, reason in (
+            ("nb-p", sess.REASON_PREEMPTION), ("nb-s", sess.REASON_STOP)
+        ):
+            cluster.create(api.notebook(
+                name, NS, tpu_accelerator="v4", tpu_topology="2x2x1"))
+            _bind(cluster, name, shape=(2, 2, 1),
+                  pool="pool-a")
+        # distinct offsets so both placements replay into the fleet
+        nb = cluster.get("Notebook", "nb-s", NS)
+        placement = sched.placement_of(nb)
+        placement["slices"][0]["offset"] = [0, 0, 1]
+        cluster.patch("Notebook", "nb-s", NS, {"metadata": {"annotations": {
+            sched.PLACEMENT_ANNOTATION: sched.encode_placement(
+                placement["slices"], 1.0)}}})
+        for name, reason in (
+            ("nb-p", sess.REASON_PREEMPTION), ("nb-s", sess.REASON_STOP)
+        ):
+            cluster.patch("Notebook", name, NS, {"metadata": {"annotations": {
+                sess.SUSPEND_ANNOTATION: sess.encode_suspend_request(
+                    reason, 1_000_000.0, 120.0)}}})
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        clock.advance(4)
+        led.tick(force=True)
+        b = _pool_ms(led)
+        # 2x2x1 requests 4 chips but reserve a whole v4 host block (2x2x1
+        # chips/host => 4 chips/host, 1 cell)
+        assert b[BUCKET_SUSPENDING] == 4 * 4_000
+        assert b[BUCKET_DRAINING] == 4 * 4_000
+        assert led.audit() == []
+
+    def test_parked_and_queued_are_demand_side(self):
+        cluster = _world()
+        cluster.create(api.notebook(
+            "nb-q", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        cluster.patch("Notebook", "nb-q", NS, {"metadata": {"annotations": {
+            sched.QUEUED_AT_ANNOTATION: "999.0"}}})
+        cluster.create(api.notebook(
+            "nb-park", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        cluster.patch("Notebook", "nb-park", NS, {"metadata": {"annotations": {
+            sess.STATE_ANNOTATION: sess.STATE_SUSPENDED,
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        clock.advance(10)
+        led.tick(force=True)
+        # demand-side series accrue...
+        assert led.queued_totals["v4"] == 8 * 10_000
+        assert led.ns_totals[NS][BUCKET_PARKED] == 8 * 10_000
+        # ...but hold no pool chips: the pool is entirely free
+        b = _pool_ms(led)
+        assert b[BUCKET_FREE_USABLE] == 16 * 10_000
+        assert led.unmet_demand_chips() == 8.0
+        assert led.audit() == []
+
+    def test_resuming_session_is_demand_not_headroom(self):
+        """A suspended session resuming into a full fleet: placement gone,
+        ack still present, queued-at re-stamped. Its chips are unmet DEMAND
+        — never simultaneously parked headroom, or the oversubscription
+        decision would lend out the chips the resume is about to reclaim."""
+        cluster = _world()
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            sched.QUEUED_AT_ANNOTATION: "999.0",
+            sess.SNAPSHOT_ANNOTATION: sess.encode_snapshot_record(
+                "sid1", "d" * 64, 999.5, queued_at=999.0),
+            sess.STATE_ANNOTATION: sess.STATE_RESUMING,
+        }}})
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        clock.advance(10)
+        led.tick(force=True)
+        assert led.queued_totals["v4"] == 8 * 10_000
+        assert led.ns_totals.get(NS) is None  # no parked chip-seconds
+        assert led.unmet_demand_chips() == 8.0
+        assert led._journal[-1]["parkedChips"] == 0
+        assert led.audit() == []
+
+    def test_drained_host_is_unavailable_and_conserves(self):
+        cluster = _world(pools=(("v4", "2x2x2", "pool-a"),))  # 2 hosts
+        cluster.patch("Node", "pool-a-1", "", {
+            "spec": {"unschedulable": True}})
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        clock.advance(6)
+        led.tick(force=True)
+        b = _pool_ms(led)
+        assert b[BUCKET_UNAVAILABLE] == 4 * 6_000  # one 4-chip host blocked
+        assert b[BUCKET_FREE_USABLE] == 4 * 6_000
+        assert sum(b.values()) == led.capacity_totals["pool-a"]
+        assert led.audit() == []
+
+    def test_fragmentation_strands_free_chips(self):
+        # 4x4x4 v4 pool = 16 hosts; occupy the middle so free space shatters
+        cluster = _world(pools=(("v4", "4x4x4", "pool-a"),))
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x4"))
+        slices = [{
+            "pool": "pool-a", "accelerator": "v4", "shape": [2, 2, 4],
+            "offset": [2, 0, 0], "poolTopology": "4x4x4", "nodes": [],
+        }]
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            sched.PLACEMENT_ANNOTATION: sched.encode_placement(slices, 1.0),
+        }}})
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        clock.advance(3)
+        led.tick(force=True)
+        b = _pool_ms(led)
+        free_ms = b[BUCKET_FREE_USABLE] + b[BUCKET_FREE_STRANDED]
+        assert free_ms == (64 - 16) * 3_000
+        assert b[BUCKET_FREE_STRANDED] > 0  # the carve split the torus
+        assert sum(b.values()) == led.capacity_totals["pool-a"]
+        assert led.audit() == []
+
+    def test_placement_into_vanished_pool_claims_nothing(self):
+        cluster = _world()
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        _bind(cluster, "nb", pool="pool-gone")
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        clock.advance(5)
+        led.tick(force=True)
+        b = _pool_ms(led)
+        assert b[BUCKET_FREE_USABLE] == 16 * 5_000
+        assert led.ns_totals.get(NS) is None
+        assert led.audit() == []
+
+
+class TestExactlyOnce:
+    def test_intervals_contiguous_across_ticks(self):
+        cluster = _world()
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        for dt in (1.0, 0.25, 13.37, 45.0):
+            clock.advance(dt)
+            led.tick(force=True)
+        spans = [(r["t0Ms"], r["t1Ms"]) for r in led._journal]
+        assert all(a[1] == b[0] for a, b in zip(spans, spans[1:]))
+        assert led.audit() == []
+
+    def test_zero_elapsed_tick_is_a_noop(self):
+        cluster = _world()
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        assert led.tick(force=True) == 0  # same instant: nothing to claim
+        clock.advance(2)
+        assert led.tick(force=True) == 2_000
+        assert led.audit() == []
+
+    def test_interval_gating(self):
+        cluster = _world()
+        clock = FakeClock()
+        led = _mk(cluster, clock, interval_s=10.0)
+        led.tick(force=True)
+        clock.advance(3)
+        assert led.tick() == 0        # inside the interval: gated
+        clock.advance(8)
+        assert led.tick() == 11_000   # one interval covers both advances
+        assert led.audit() == []
+
+
+class TestAuditCatchesPlants:
+    def _ledger_with_history(self):
+        cluster = _world()
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        _bind(cluster, "nb")
+        _running(cluster, "nb")
+        clock = FakeClock()
+        led = _mk(cluster, clock, telemetry=FakeTelemetry({"nb": 0.5}))
+        led.tick(force=True)
+        for _ in range(3):
+            clock.advance(5)
+            led.tick(force=True)
+        assert led.audit() == []
+        return led
+
+    def test_planted_class_flip_fails(self):
+        led = self._ledger_with_history()
+        led._journal[-1]["gangs"][0]["class"] = BUCKET_DRAINING
+        assert any("misattribution" in v for v in led.audit())
+
+    def test_planted_bucket_value_fails_conservation(self):
+        led = self._ledger_with_history()
+        led._journal[-1]["pools"]["pool-a"]["buckets"][BUCKET_BUSY] += 1
+        assert any("CONSERVATION" in v for v in led.audit())
+
+    def test_planted_chip_inflation_fails_geometry(self):
+        led = self._ledger_with_history()
+        led._journal[-1]["gangs"][0]["chipsByPool"]["pool-a"] += 8
+        assert any("slice geometry" in v for v in led.audit())
+
+    def test_planted_busy_skew_fails_duty_reproof(self):
+        led = self._ledger_with_history()
+        g = led._journal[-1]["gangs"][0]
+        g["busyMs"] += 1
+        assert any("duty-weighted" in v for v in led.audit())
+
+    def test_planted_interval_gap_fails_exactly_once(self):
+        led = self._ledger_with_history()
+        led._journal[-1]["t0Ms"] += 5
+        out = led.audit()
+        assert any("leaks" in v for v in out)
+
+    def test_planted_overlap_fails_exactly_once(self):
+        led = self._ledger_with_history()
+        led._journal[-1]["t0Ms"] -= 5
+        assert any("overlaps" in v for v in led.audit())
+
+    def test_cumulative_totals_cross_checked_against_journal(self):
+        led = self._ledger_with_history()
+        led.pool_totals["pool-a"][BUCKET_BUSY] += 10
+        led.capacity_totals["pool-a"] += 10  # keep conservation consistent
+        out = led.audit()
+        assert any("journal replay" in v for v in out)
+
+
+class TestExports:
+    def test_registry_families_equal_internal_ledger(self):
+        cluster = _world()
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        _bind(cluster, "nb")
+        _running(cluster, "nb")
+        clock = FakeClock()
+        led = _mk(cluster, clock, telemetry=FakeTelemetry({"nb": 0.25}))
+        led.tick(force=True)
+        clock.advance(9)
+        led.tick(force=True)
+        m = led.metrics
+        for bucket in CONSERVATION_BUCKETS:
+            assert m.pool_chip_seconds.get(
+                pool="pool-a", bucket=bucket
+            ) == led.pool_totals["pool-a"][bucket] / 1000.0
+        assert m.capacity_chip_seconds.get(
+            pool="pool-a"
+        ) == led.capacity_totals["pool-a"] / 1000.0
+        # the exposition parses (the dynamic half of metrics-lint)
+        text = m.registry.expose()
+        assert "tpu_pool_chip_seconds_total" in text
+        assert "tpu_capacity_chip_seconds_total" in text
+        assert "tpu_fleet_efficiency" in text
+
+    def test_notebook_payload_and_namespace_drilldown(self):
+        cluster = _world()
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        _bind(cluster, "nb")
+        _running(cluster, "nb")
+        clock = FakeClock()
+        led = _mk(cluster, clock, telemetry=FakeTelemetry({"nb": 0.5}))
+        led.tick(force=True)
+        clock.advance(10)
+        led.tick(force=True)
+        p = led.notebook_payload(NS, "nb")
+        assert p["busyChipSeconds"] == 40.0         # 8 chips × 10 s × 0.5
+        assert p["allocatedChipSeconds"] == 80.0
+        assert p["efficiency"] == 0.5
+        assert led.notebook_payload(NS, "ghost") is None
+        nsp = led.namespace_payload(NS)
+        assert nsp["efficiency"] == 0.5
+        assert "nb" in nsp["notebooks"]
+        assert led.namespace_payload("ghost-ns") is None
+
+    def test_departed_notebook_accumulator_evicted(self):
+        cluster = _world()
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        _bind(cluster, "nb")
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        clock.advance(5)
+        led.tick(force=True)
+        assert led.notebook_payload(NS, "nb") is not None
+        cluster.delete("Notebook", "nb", NS)
+        clock.advance(5)
+        led.tick(force=True)
+        assert led.notebook_payload(NS, "nb") is None
+
+    def test_debug_routes(self):
+        cluster = _world()
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        _bind(cluster, "nb")
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        clock.advance(5)
+        led.tick(force=True)
+        app = App("probes", csrf_protect=False)
+        install_ledger_routes(app, led)
+        client = Client(app)
+        payload = json.loads(client.get("/debug/ledger").data)
+        assert payload["pools"]["pool-a"]["capacityChipSeconds"] == 80.0
+        assert payload["fleet"]["wasteFraction"] >= 0.0
+        ns_payload = json.loads(client.get(f"/debug/ledger/{NS}").data)
+        assert ns_payload["namespace"] == NS
+        assert client.get("/debug/ledger/ghost-ns").status_code == 404
+
+
+class TestShardedWiring:
+    def test_only_shard_zero_runs_the_ledger(self):
+        """One ledger per FLEET: in the sharded control plane only shard
+        0's manager carries one — its tick reads the whole cluster, so a
+        ledger per shard leader would export every chip-second N times
+        while the conservation ratio still read exactly 1."""
+        from kubeflow_tpu.cmd.controller import build_manager
+        from kubeflow_tpu.runtime.sharding import ShardRouter
+        from kubeflow_tpu.utils.config import ControllerConfig
+
+        cluster = FakeCluster()
+        cfg = ControllerConfig(ledger_enabled=True)
+        router = ShardRouter(4)
+        shared: dict = {}
+        managers = [
+            build_manager(
+                cluster, cfg, fetch_kernels=lambda ns, n: [],
+                router=router, shard_id=i, shared=shared,
+            )[0]
+            for i in range(4)
+        ]
+        ledgers = [m.ledger for m in managers]
+        assert ledgers[0] is not None
+        assert all(led is ledgers[0] for led in ledgers)  # one singleton
+        # the one-process-per-shard layout: a non-zero shard alone builds NO
+        # ledger at all
+        solo, _ = build_manager(
+            cluster, cfg, fetch_kernels=lambda ns, n: [],
+            router=router, shard_id=2, shared={},
+        )
+        assert solo.ledger is None
+        zero, _ = build_manager(
+            cluster, cfg, fetch_kernels=lambda ns, n: [],
+            router=router, shard_id=0, shared={},
+        )
+        assert zero.ledger is not None
+
+
+class TestWebSurfaces:
+    def _ledgered_world(self):
+        cluster = _world()
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        _bind(cluster, "nb")
+        _running(cluster, "nb")
+        clock = FakeClock()
+        led = _mk(cluster, clock, telemetry=FakeTelemetry({"nb": 0.5}))
+        led.tick(force=True)
+        clock.advance(10)
+        led.tick(force=True)
+        return cluster, led
+
+    def test_jwa_detail_carries_efficiency(self):
+        from kubeflow_tpu.auth.rbac import Authorizer
+        from kubeflow_tpu.webapps import jupyter
+
+        cluster, led = self._ledgered_world()
+        app = jupyter.create_app(
+            cluster, ledger=led, use_cache=False,
+            authorizer=Authorizer(
+                cluster, cluster_admins={"admin@example.com"}
+            ),
+        )
+        client = Client(app)
+        r = client.get(
+            f"/api/namespaces/{NS}/notebooks/nb",
+            headers={"kubeflow-userid": "admin@example.com"},
+        )
+        body = json.loads(r.data)
+        eff = body["notebook"]["efficiency"]
+        assert eff["efficiency"] == 0.5
+        assert eff["busyChipSeconds"] == 40.0
+
+    def test_dashboard_serves_ledger_series(self):
+        from kubeflow_tpu.webapps import dashboard
+
+        cluster, led = self._ledgered_world()
+        app = dashboard.create_app(
+            cluster, ledger=led, cluster_admins={"admin@example.com"},
+            use_cache=False,
+        )
+        app.close()
+        client = Client(app)
+        for mtype in ("efficiency", "waste", "unmet_demand"):
+            r = client.get(
+                f"/api/metrics/{mtype}",
+                headers={"kubeflow-userid": "admin@example.com"},
+            )
+            assert r.status_code == 200, (mtype, r.data)
+            body = json.loads(r.data)
+            assert "series" in body
+        eff = json.loads(client.get(
+            "/api/metrics/efficiency",
+            headers={"kubeflow-userid": "admin@example.com"},
+        ).data)
+        assert eff["values"][0]["value"] == 0.5

@@ -231,6 +231,20 @@ def combined_registry() -> Registry:
         "team-metrics", "nb-lint", policy="duty-cycle",
         sample=telem.activity("team-metrics", "nb-lint"), threshold=0.6,
     )
+    # the efficiency ledger on the same registry (obs/ledger.py): two real
+    # ticks over a moving clock populate every bucket/capacity family
+    from kubeflow_tpu.obs.ledger import FleetEfficiencyLedger
+    from kubeflow_tpu.utils.metrics import LedgerMetrics
+
+    _t = [1_000_000.0]
+    ledger = FleetEfficiencyLedger(
+        cluster, LedgerMetrics(nm.registry), clock=lambda: _t[0],
+        telemetry=telem,
+    )
+    ledger.tick(force=True)
+    _t[0] += 30.0
+    ledger.tick(force=True)
+    assert ledger.audit() == []
     # one suspend through the barrier so the session histograms carry data
     cluster.patch("Notebook", "nb-lint", "team-metrics",
                   {"metadata": {"annotations": {
@@ -318,6 +332,30 @@ class TestExpositionFormat:
         assert families[
             "scheduler_pool_largest_free_cuboid_chips"]["type"] == "gauge"
         assert families["scheduler_would_fit_after_defrag"]["type"] == "gauge"
+        # efficiency-ledger families (obs/ledger.py): the chip-second
+        # counters lint and carry real attribution — and conservation is
+        # queryable straight off the exposition: Σ pool buckets == capacity
+        for name in (
+            "tpu_chip_seconds_total",
+            "tpu_pool_chip_seconds_total",
+            "tpu_family_chip_seconds_total",
+            "tpu_capacity_chip_seconds_total",
+            "tpu_queued_chip_seconds_total",
+            "tpu_ledger_ticks_total",
+        ):
+            assert families[name]["type"] == "counter", name
+        assert families["tpu_fleet_efficiency"]["type"] == "gauge"
+        assert families["tpu_fleet_waste_fraction"]["type"] == "gauge"
+        assert families["tpu_ledger_tick_seconds"]["type"] == "histogram"
+        by_pool: dict[str, float] = {}
+        for _, labels, v in families["tpu_pool_chip_seconds_total"]["samples"]:
+            by_pool[labels["pool"]] = by_pool.get(labels["pool"], 0.0) + v
+        caps = {
+            labels["pool"]: v
+            for _, labels, v in families[
+                "tpu_capacity_chip_seconds_total"]["samples"]
+        }
+        assert caps and by_pool == caps  # exact — the scrape-side proof
 
     def test_webapp_and_readcache_families_lint(self):
         """The BFF read-path families (utils/metrics.py WebAppMetrics +

@@ -518,3 +518,56 @@ class TestKubeClientInstrumentation:
         client.get("Pod", "p", "ns")
         assert client.metrics.api_latency.count(verb="get") == 1
         assert [s for s in client.tracer.export() if s["kind"] == "write"] == []
+
+
+class TestDebugIndex:
+    def test_every_wired_debug_endpoint_is_listed(self):
+        """The /debug/ index (obs/health.py): operators stop guessing URLs
+        — every debug route mounted on the probe app shows up, including
+        ones wired AFTER the index itself (it reads the live url_map)."""
+        from kubeflow_tpu.obs.ledger import (
+            FleetEfficiencyLedger,
+            install_ledger_routes,
+        )
+        from kubeflow_tpu.obs.timeline import (
+            TimelineBuilder,
+            install_timeline_route,
+        )
+        from kubeflow_tpu.runtime.fake import FakeCluster
+        from kubeflow_tpu.scheduler.explain import install_explain_route
+        from kubeflow_tpu.telemetry.collector import (
+            FleetTelemetryCollector,
+            install_telemetry_route,
+        )
+        from kubeflow_tpu.utils.metrics import TelemetryMetrics
+
+        cluster = FakeCluster()
+        tracer = Tracer()
+        app = App("probes", csrf_protect=False)
+        install_probe_routes(app, HealthState(), tracer=tracer)
+        collector = FleetTelemetryCollector(cluster, TelemetryMetrics())
+        install_telemetry_route(app, collector)
+        install_timeline_route(app, TimelineBuilder(cluster))
+        install_explain_route(app, cluster)
+        install_ledger_routes(
+            app, FleetEfficiencyLedger(cluster)
+        )
+        client = Client(app)
+        # the bare path redirects onto the canonical index
+        assert client.get("/debug").status_code in (301, 308)
+        for path in ("/debug/",):
+            r = client.get(path)
+            assert r.status_code == 200
+            payload = json.loads(r.data)
+            wired = {
+                rule.rule
+                for rule in app.url_map.iter_rules()
+                if rule.rule.startswith("/debug")
+                and rule.rule != "/debug/"
+            }
+            assert set(payload["endpoints"]) == wired
+            # the named planes are all there
+            for want in ("traces", "telemetry", "timeline", "explain",
+                         "ledger"):
+                assert any(want in e for e in payload["endpoints"]), want
+            assert payload["probes"] == ["/healthz", "/readyz"]

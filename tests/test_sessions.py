@@ -667,3 +667,174 @@ class TestSuspendResume:
             api.STOP_ANNOTATION: None}}})
         _drive(cluster, mgr, clock, rounds=4)
         assert _anns(cluster, "nb")[sched.QUEUED_AT_ANNOTATION] == repr(123456.0)
+
+
+# ------------------------------------------------- ledger edge windows
+
+
+class TestLedgerEdgeWindows:
+    """The efficiency ledger (obs/ledger.py) across the session barriers
+    this suite owns: a suspend handoff that crosses a controller
+    crash-restart, a force-deadline release, and a resume into a re-bind
+    must each produce gap-free, non-overlapping intervals with exact
+    conservation — the targeted twins of the soak's per-seed audit."""
+
+    def _sched_world(self, *, deadline=60.0):
+        from kubeflow_tpu.obs.ledger import FleetEfficiencyLedger
+        from kubeflow_tpu.scheduler.controller import SchedulerReconciler
+        from kubeflow_tpu.scheduler.soak import make_pool
+
+        cluster = FakeCluster()
+        make_pool(cluster, "v4", "2x2x2", "pool-a")  # 2 hosts / 8 chips
+        clock = _Clock()
+        cfg = ControllerConfig(
+            scheduler_enabled=True, sessions_enabled=True,
+            suspend_deadline_s=deadline,
+        )
+        objects = FakeObjectStore()
+        store = SnapshotStore(objects, clock=clock)
+        agent = FakeSessionAgent(cluster)
+        ledger = FleetEfficiencyLedger(cluster, clock=clock, interval_s=1.0)
+
+        def build() -> Manager:
+            m = Manager(cluster, clock=clock)
+            m.register(NotebookReconciler(cfg, clock=clock))
+            m.register(
+                SchedulerReconciler(
+                    clock=clock, suspend_deadline_s=deadline,
+                    aging_interval_s=300.0,
+                )
+            )
+            m.register(
+                SessionReconciler(store, agent, config=cfg, clock=clock)
+            )
+            return m
+
+        return cluster, build, clock, store, agent, ledger
+
+    @staticmethod
+    def _drive(cluster, mgr, clock, ledger, *, rounds=4, dt=5.0):
+        for _ in range(rounds):
+            cluster.step_kubelet()
+            ledger.tick(force=True)
+            mgr.tick()
+            clock.advance(dt)
+
+    @staticmethod
+    def _assert_exactly_once(ledger):
+        spans = [(r["t0Ms"], r["t1Ms"]) for r in ledger._journal]
+        assert spans, "ledger attributed nothing"
+        assert all(t1 > t0 for t0, t1 in spans)
+        assert all(a[1] == b[0] for a, b in zip(spans, spans[1:])), (
+            "intervals must be gap-free and non-overlapping"
+        )
+        assert ledger.audit() == []
+
+    def _buckets_seen(self, ledger, pool="pool-a"):
+        seen = set()
+        for rec in ledger._journal:
+            for bucket, ms in rec["pools"][pool]["buckets"].items():
+                if ms:
+                    seen.add(bucket)
+        return seen
+
+    def test_suspend_handoff_across_crash_restart(self):
+        """A preemption handoff whose barrier window spans a controller
+        crash-restart: the victim's chips account as `suspending` while
+        held, pass to the preemptor in ONE write, and no interval is
+        double-counted or leaked across the restart."""
+
+        class GatedAgent(FakeSessionAgent):
+            ready = False
+
+            def snapshot(self, ns, name):
+                return super().snapshot(ns, name) if self.ready else None
+
+        cluster, build, clock, store, _agent, ledger = self._sched_world()
+        agent = GatedAgent(cluster)
+        mgr = build()
+        mgr._reconcilers[2].agent = agent
+        cluster.create(api.notebook(
+            "victim", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        self._drive(cluster, mgr, clock, ledger, rounds=3)
+        assert sched.placement_of(cluster.get("Notebook", "victim", NS))
+        # a senior gang arrives; the pool is full — handoff begins
+        cluster.create(api.notebook(
+            "senior", NS, tpu_accelerator="v4", tpu_topology="2x2x2",
+            annotations={sched.PRIORITY_ANNOTATION: "10"}))
+        self._drive(cluster, mgr, clock, ledger, rounds=2)
+        nb = cluster.get("Notebook", "victim", NS)
+        req = sess.suspend_request(nb)
+        assert req is not None and req["reason"] == sess.REASON_PREEMPTION
+        # the controller dies mid-barrier; a cold one takes over
+        mgr.shutdown()
+        mgr = build()
+        mgr._reconcilers[2].agent = agent
+        self._drive(cluster, mgr, clock, ledger, rounds=2)
+        agent.ready = True
+        self._drive(cluster, mgr, clock, ledger, rounds=6)
+        # the handoff completed: senior holds the pool, victim released
+        assert sched.placement_of(cluster.get("Notebook", "senior", NS))
+        assert sched.placement_of(
+            cluster.get("Notebook", "victim", NS)) is None
+        seen = self._buckets_seen(ledger)
+        assert "suspending" in seen, seen
+        self._assert_exactly_once(ledger)
+
+    def test_force_deadline_release_stays_conserved(self):
+        """An agent that can never snapshot: the barrier holds (draining)
+        until the force deadline, then the teardown proceeds cold — the
+        held window and the release must both conserve exactly."""
+
+        class DeadAgent(FakeSessionAgent):
+            def snapshot(self, ns, name):
+                return None
+
+        cluster, build, clock, store, _agent, ledger = self._sched_world(
+            deadline=30.0
+        )
+        agent = DeadAgent(cluster)
+        mgr = build()
+        mgr._reconcilers[2].agent = agent
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        self._drive(cluster, mgr, clock, ledger, rounds=3)
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        self._drive(cluster, mgr, clock, ledger, rounds=3)  # held: draining
+        assert "draining" in self._buckets_seen(ledger)
+        clock.advance(60.0)  # past the force deadline
+        self._drive(cluster, mgr, clock, ledger, rounds=4)
+        nb = cluster.get("Notebook", "nb", NS)
+        assert sess.snapshot_record(nb) is None  # nothing was acked
+        assert cluster.get("StatefulSet", "nb", NS)["spec"]["replicas"] == 0
+        self._assert_exactly_once(ledger)
+
+    def test_resume_into_rebind_accounts_starting(self):
+        """Suspend → resume: the re-bound gang's restore window accounts as
+        `starting` (never busy — no work is happening), and the full cycle
+        keeps intervals contiguous and conserved."""
+        cluster, build, clock, store, agent, ledger = self._sched_world()
+        mgr = build()
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        self._drive(cluster, mgr, clock, ledger, rounds=3)
+        agent.work["team-a/nb"] = 7
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        self._drive(cluster, mgr, clock, ledger, rounds=5)
+        nb = cluster.get("Notebook", "nb", NS)
+        assert sess.snapshot_record(nb) is not None
+        assert sched.placement_of(nb) is None
+        # resume: the gang re-queues, re-binds, restores, runs
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: None}}})
+        self._drive(cluster, mgr, clock, ledger, rounds=6)
+        nb = cluster.get("Notebook", "nb", NS)
+        assert sched.placement_of(nb) is not None
+        assert not sess.session_engaged(nb)
+        assert agent.work["team-a/nb"] >= 7
+        seen = self._buckets_seen(ledger)
+        assert "starting" in seen, seen
+        assert "draining" in seen, seen
+        self._assert_exactly_once(ledger)

@@ -464,3 +464,74 @@ class TestSchedulerSharding:
         assert sess.suspend_request(victim) is None  # retired in one write
         assert sched.placement_of(urgent) is not None
         assert audit_shards(cluster, router) == []
+
+
+class TestFamilyLabelWebhook:
+    """The admission half of the family-label contract (the ROADMAP
+    sharding follow-on): ``webhooks/tpu_env.py`` enforces/heals
+    ``tpu.kubeflow.org/accelerator-family`` on UPDATE, not just CREATE — a
+    kubectl label strip or spec drift is rewritten at admission, so the
+    sharded scheduler's filtered ingest can never be blinded by a write."""
+
+    def _cluster(self):
+        from kubeflow_tpu.runtime.fake import FakeCluster
+        from kubeflow_tpu.webhooks import tpu_env
+
+        cluster = FakeCluster()
+        tpu_env.install(cluster)
+        return cluster
+
+    def test_create_stamps_even_without_client_label(self):
+        cluster = self._cluster()
+        nb = _nb("g")
+        del nb["metadata"]["labels"][sharding.FAMILY_LABEL]  # hostile client
+        stored = cluster.create(nb)
+        assert stored["metadata"]["labels"][sharding.FAMILY_LABEL] == "v4"
+
+    def test_label_strip_on_update_is_rewritten(self):
+        cluster = self._cluster()
+        cluster.create(_nb("g"))
+        g = cluster.get("Notebook", "g", NS)
+        del g["metadata"]["labels"][sharding.FAMILY_LABEL]
+        stored = cluster.update(g)
+        assert stored["metadata"]["labels"][sharding.FAMILY_LABEL] == "v4"
+        # and the label index answers for it (the filtered-ingest surface)
+        assert cluster.resource_versions(
+            "Notebook",
+            selector={"matchLabels": {sharding.FAMILY_LABEL: "v4"}},
+        )
+
+    def test_label_drift_on_update_is_rewritten(self):
+        cluster = self._cluster()
+        cluster.create(_nb("g"))
+        cluster.patch("Notebook", "g", NS, {"metadata": {"labels": {
+            sharding.FAMILY_LABEL: "v5e"}}})  # lies about the family
+        g = cluster.get("Notebook", "g", NS)
+        assert g["metadata"]["labels"][sharding.FAMILY_LABEL] == "v4"
+
+    def test_spec_family_edit_moves_the_label(self):
+        cluster = self._cluster()
+        cluster.create(_nb("g"))
+        cluster.patch("Notebook", "g", NS, {"spec": {"tpu": {
+            "accelerator": "v5e", "topology": "2x4"}}})
+        g = cluster.get("Notebook", "g", NS)
+        assert g["metadata"]["labels"][sharding.FAMILY_LABEL] == "v5e"
+
+    def test_non_tpu_notebook_sheds_stale_label(self):
+        cluster = self._cluster()
+        cluster.create(api.notebook("cpu-nb", NS))
+        cluster.patch("Notebook", "cpu-nb", NS, {"metadata": {"labels": {
+            sharding.FAMILY_LABEL: "v4"}}})  # stale/forged hint
+        g = cluster.get("Notebook", "cpu-nb", NS)
+        assert sharding.FAMILY_LABEL not in g["metadata"].get("labels", {})
+
+    def test_status_writes_bypass_admission(self):
+        """update_status persists only .status — no label surface, and the
+        mutator must not run there (real webhooks scope by subresource)."""
+        cluster = self._cluster()
+        cluster.create(_nb("g"))
+        g = cluster.get("Notebook", "g", NS)
+        g["status"] = {"conditions": []}
+        cluster.update_status(g)
+        g = cluster.get("Notebook", "g", NS)
+        assert g["metadata"]["labels"][sharding.FAMILY_LABEL] == "v4"

@@ -848,3 +848,58 @@ class TestRegistryIntegration:
         assert "telemetry_session_duty_cycle" in families
         assert "scheduler_fleet_duty_cycle" in families
         assert "telemetry_scrape_pass_seconds" in families
+
+
+class TestChipWeightedDuty:
+    def test_fleet_duty_cycle_weighted_by_allocated_chips(self):
+        """Mixed-size sessions: a big busy slice must dominate the fleet
+        mean — sum(duties)/len(duties) counted a 1-chip session the same as
+        a 64-chip slice, which is the regression this pins. The weighted
+        series is the efficiency ledger's busy input (obs/ledger.py)."""
+        import json as _json
+
+        from kubeflow_tpu import scheduler as sched
+
+        clock = FakeClock()
+        cluster = _tpu_world(())
+        # big: 64-chip slice at duty 1.0; small: 4-chip slice at duty 0.0
+        for name, topo, shape, duty in (
+            ("nb-big", "4x4x4", [4, 4, 4], 1.0),
+            ("nb-small", "2x2x1", [2, 2, 1], 0.0),
+        ):
+            cluster.create(api.notebook(
+                name, NS, tpu_accelerator="v4", tpu_topology=topo))
+            cluster.patch("Notebook", name, NS, {"metadata": {"annotations": {
+                sched.PLACEMENT_ANNOTATION: _json.dumps({
+                    "boundAt": 1.0,
+                    "slices": [{"pool": "pool-a", "accelerator": "v4",
+                                "shape": shape, "offset": [0, 0, 0]}],
+                }, sort_keys=True)}}})
+        agents = {
+            "nb-big": TelemetryAgent(
+                FakeDeviceBackend(duty_cycle=1.0), clock=clock),
+            "nb-small": TelemetryAgent(
+                FakeDeviceBackend(duty_cycle=0.0), clock=clock),
+        }
+        col = _mk_collector(cluster, agents, clock)
+        assert col.collect() == 2
+        m = col.metrics
+        # 64·1.0 + 4·0.0 over 68 chips — NOT the headcount mean 0.5
+        assert m.fleet_duty_cycle.get() == pytest.approx(64 / 68)
+        # both share pool-a: the pool gauge weights identically
+        assert m.pool_duty_cycle.get(pool="pool-a") == pytest.approx(64 / 68)
+
+    def test_unbound_sessions_fall_back_to_equal_weight(self):
+        """No placement yet: chips unknown, every session weights 1 — the
+        historical headcount mean, so pre-bind fleets read unchanged."""
+        clock = FakeClock()
+        cluster = _tpu_world(("nb-a", "nb-b"))
+        agents = {
+            "nb-a": TelemetryAgent(
+                FakeDeviceBackend(duty_cycle=0.8), clock=clock),
+            "nb-b": TelemetryAgent(
+                FakeDeviceBackend(duty_cycle=0.2), clock=clock),
+        }
+        col = _mk_collector(cluster, agents, clock)
+        assert col.collect() == 2
+        assert col.metrics.fleet_duty_cycle.get() == pytest.approx(0.5)

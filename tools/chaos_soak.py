@@ -63,6 +63,14 @@ def main(argv: list[str] | None = None) -> int:
                          "explanation at the fixed point re-proven against "
                          "the ground-truth fleet (docs/scheduler.md "
                          "\"explainability\"; on by default)")
+    ap.add_argument("--ledger-audit", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="per-seed chip-second conservation audit: the "
+                         "efficiency ledger's buckets must sum exactly to "
+                         "the capacity integral, intervals exactly-once, "
+                         "every attribution re-proven from its evidence "
+                         "(docs/chaos.md \"efficiency ledger\"; on by "
+                         "default)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="per-seed lines; on failure, a fixed-point diff")
     args = ap.parse_args(argv)
@@ -90,6 +98,7 @@ def main(argv: list[str] | None = None) -> int:
             seed, cfg, telemetry=args.telemetry, shards=args.shards,
             lost_update_audit=args.lost_update_audit,
             explain_audit=args.explain_audit,
+            ledger_audit=args.ledger_audit,
         )
         total_faults += sum(result.fault_counts.values())
         total_restarts += result.restarts

@@ -59,6 +59,13 @@ def main(argv: list[str] | None = None) -> int:
                          "emitted placement explanation re-proven against "
                          "the ground-truth fleet (docs/scheduler.md "
                          "\"explainability\"; on by default)")
+    ap.add_argument("--ledger-audit", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="per-seed chip-second conservation audit: Σ ledger "
+                         "buckets == ∫ pool capacity dt exactly, intervals "
+                         "exactly-once across crash-restarts, attribution "
+                         "re-proven from captured evidence (docs/chaos.md "
+                         "\"efficiency ledger\"; on by default)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print a line per seed, not just failures")
     args = ap.parse_args(argv)
@@ -88,6 +95,7 @@ def main(argv: list[str] | None = None) -> int:
             seed, cfg, shards=args.shards,
             lost_update_audit=args.lost_update_audit,
             explain_audit=args.explain_audit,
+            ledger_audit=args.ledger_audit,
         )
         binds += result.binds
         preemptions += result.preemptions

@@ -49,6 +49,12 @@ def main(argv: list[str] | None = None) -> int:
                     default=True,
                     help="per-seed lost-update race audit on every cluster "
                          "write (docs/chaos.md; on by default)")
+    ap.add_argument("--ledger-audit", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="per-seed chip-second conservation audit through "
+                         "every suspend handoff / force-deadline release / "
+                         "resume re-bind (docs/chaos.md \"efficiency "
+                         "ledger\"; on by default)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print a line per seed, not just failures")
     args = ap.parse_args(argv)
@@ -81,6 +87,7 @@ def main(argv: list[str] | None = None) -> int:
         result = run_session_seed(
             seed, cfg, store_cfg,
             lost_update_audit=args.lost_update_audit,
+            ledger_audit=args.ledger_audit,
         )
         suspends += result.suspends
         resumes += result.resumes

@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Elastic-capacity benchmark: time-to-first-chip and flap stability
+(docs/capacity.md).
+
+Two phases on the virtual clock (deterministic — the gates can be tight):
+
+- **first-chip** — the SLO scenario: an UNFITTABLE aged gang (its topology
+  fits no existing pool) is submitted into a tight fleet, ages past the
+  pending grace, the autoscaler buys a pool shaped for it, the fake
+  provider provisions after its configured delay, and the gang binds.
+  Measured per round off the real histograms: scale-up decision latency
+  (aged-threshold crossing → provider call — the autoscaler's own share of
+  the SLO) and time-to-first-chip (demand onset → first schedulable chip,
+  dominated by the provider delay). Each round then deletes the gang and
+  waits out the hysteresis dwell so scale-down runs too — the full
+  capacity loop, every round.
+- **flap** — demand that toggles faster than the hysteresis dwell, under
+  the capacity-flap chaos shape (provider 429/500s on every verb). The
+  hysteresis arm must hold scale direction changes to the dwell-rate bound
+  (the anti-oscillation proof); the no-hysteresis A/B arm shows the
+  oscillation the dwell prevents.
+
+    python benchmarks/bench_capacity.py
+    python benchmarks/bench_capacity.py --check-against \\
+        benchmarks/capacity_baseline.json   # CI gate
+
+Emits one CAPACITY_BENCH JSON line (CI artifacts / perf tracking).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from kubeflow_tpu import scheduler as sched  # noqa: E402
+from kubeflow_tpu.api import types as api  # noqa: E402
+from kubeflow_tpu.capacity.autoscaler import CapacityReconciler  # noqa: E402
+from kubeflow_tpu.capacity.provider import (  # noqa: E402
+    FakeCloudProvider,
+    ProviderChaos,
+)
+from kubeflow_tpu.runtime.fake import FakeCluster, NotFound  # noqa: E402
+from kubeflow_tpu.runtime.manager import Manager  # noqa: E402
+from kubeflow_tpu.scheduler.controller import SchedulerReconciler  # noqa: E402
+from kubeflow_tpu.scheduler.soak import make_pool  # noqa: E402
+from kubeflow_tpu.utils.metrics import CapacityMetrics  # noqa: E402
+from kubeflow_tpu.webhooks import tpu_env  # noqa: E402
+
+NS = "bench"
+GRACE_S = 20.0
+PROVISION_DELAY_S = 30.0
+HYSTERESIS_S = 120.0
+FLAP_HYSTERESIS_S = 300.0
+FLAP_TOGGLE_S = 40.0
+FLAP_WINDOW_S = 1500.0
+
+
+class _Clock:
+    def __init__(self, start: float = 1_000_000.0) -> None:
+        self.t = start
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+class _RecordingProvider:
+    """Wraps the fake provider, recording every SUCCESSFUL scale verb in
+    order — the direction-change count the flap gate judges."""
+
+    def __init__(self, inner: FakeCloudProvider) -> None:
+        self.inner = inner
+        self.events: list[str] = []
+
+    def scale_up(self, spec):
+        out = self.inner.scale_up(spec)
+        if out:
+            self.events.append("up")
+        return out
+
+    def scale_down(self, pool):
+        out = self.inner.scale_down(pool)
+        if out:
+            self.events.append("down")
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def direction_changes(self) -> int:
+        changes = 0
+        for prev, cur in zip(self.events, self.events[1:]):
+            if prev != cur:
+                changes += 1
+        return changes
+
+
+def _world(
+    *,
+    seed: int,
+    hysteresis_s: float,
+    chaos: ProviderChaos | None = None,
+    grace_s: float = GRACE_S,
+):
+    cluster = FakeCluster()
+    tpu_env.install(cluster)
+    clock = _Clock()
+    make_pool(cluster, "v4", "2x2x2", "pool-base")
+    provider = _RecordingProvider(FakeCloudProvider(
+        cluster, clock=clock, seed=seed, chaos=chaos,
+        provision_delay_s=PROVISION_DELAY_S,
+    ))
+    metrics = CapacityMetrics()
+    mgr = Manager(cluster, clock=clock)
+    mgr.register(SchedulerReconciler(clock=clock, aging_interval_s=60.0))
+    mgr.register(CapacityReconciler(
+        provider, metrics=metrics, clock=clock,
+        pending_grace_s=grace_s, hysteresis_s=hysteresis_s,
+    ))
+    return cluster, clock, provider, metrics, mgr
+
+
+def _drive(cluster, clock, provider, mgr, seconds: float, *, until=None):
+    steps = int(seconds)
+    for _ in range(steps):
+        cluster.step_kubelet()
+        provider.inner.step()
+        mgr.tick()
+        if until is not None and until():
+            return True
+        clock.advance(1.0)
+    return until() if until is not None else False
+
+
+def phase_first_chip(rounds: int) -> dict:
+    cluster, clock, provider, metrics, mgr = _world(
+        seed=0, hysteresis_s=HYSTERESIS_S
+    )
+    binds_after: list[float] = []
+    for r in range(rounds):
+        name = f"gang-{r}"
+        # unfittable by construction: 2x2x4 (16 chips) in a 2x2x2 fleet
+        cluster.create(api.notebook(
+            name, NS, tpu_accelerator="v4", tpu_topology="2x2x4",
+        ))
+        onset = clock()
+
+        def bound() -> bool:
+            nb = cluster.try_get("Notebook", name, NS)
+            return nb is not None and sched.placement_of(nb) is not None
+
+        ok = _drive(
+            cluster, clock, provider, mgr,
+            GRACE_S + PROVISION_DELAY_S + 120.0, until=bound,
+        )
+        if not ok:
+            raise SystemExit(
+                f"CAPACITY_BENCH: round {r}: unfittable gang never bound "
+                f"(autoscaler failed to deliver capacity)"
+            )
+        binds_after.append(clock() - onset)
+        try:
+            cluster.delete("Notebook", name, NS)
+        except NotFound:
+            pass
+
+        def reclaimed() -> bool:
+            return not cluster.list("Node", None, {"matchLabels": {
+                sched.AUTOSCALED_LABEL: "true"}})
+
+        if not _drive(
+            cluster, clock, provider, mgr,
+            HYSTERESIS_S + 90.0, until=reclaimed,
+        ):
+            raise SystemExit(
+                f"CAPACITY_BENCH: round {r}: idle autoscaled pool never "
+                f"reclaimed after the hysteresis dwell"
+            )
+    return {
+        "rounds": rounds,
+        "pending_grace_s": GRACE_S,
+        "provision_delay_s": PROVISION_DELAY_S,
+        "time_to_first_chip_p50_s": round(
+            metrics.time_to_first_chip.quantile(0.5), 2
+        ),
+        "time_to_first_chip_p99_s": round(
+            metrics.time_to_first_chip.quantile(0.99), 2
+        ),
+        "decision_p99_s": round(metrics.decision_latency.quantile(0.99), 2),
+        "time_to_bind_p50_s": round(
+            sorted(binds_after)[len(binds_after) // 2], 2
+        ),
+        "first_chips": metrics.time_to_first_chip.count(),
+    }
+
+
+def phase_flap(*, hysteresis_s: float) -> dict:
+    cluster, clock, provider, metrics, mgr = _world(
+        seed=1, hysteresis_s=hysteresis_s, chaos=ProviderChaos(
+            error_rate=0.3, stuck_rate=0.0, dishonor_grace_p=0.0,
+        ),
+    )
+    name = "flapper"
+    cluster.create(api.notebook(
+        name, NS, tpu_accelerator="v4", tpu_topology="2x2x4",
+    ))
+    elapsed = 0.0
+    stopped = False
+    while elapsed < FLAP_WINDOW_S:
+        _drive(cluster, clock, provider, mgr, FLAP_TOGGLE_S)
+        elapsed += FLAP_TOGGLE_S
+        stopped = not stopped
+        cluster.patch("Notebook", name, NS, {"metadata": {"annotations": {
+            api.STOP_ANNOTATION: (
+                "2026-01-01T00:00:00Z" if stopped else None
+            ),
+            api.LAST_ACTIVITY_ANNOTATION: None,
+        }}})
+    return {
+        "hysteresis_s": hysteresis_s,
+        "window_s": FLAP_WINDOW_S,
+        "toggle_s": FLAP_TOGGLE_S,
+        "scale_events": len(provider.events),
+        "direction_changes": provider.direction_changes(),
+    }
+
+
+def check_against(result: dict, baseline_path: str, tolerance: float) -> int:
+    """CI gate: time-to-first-chip and decision latency must stay within
+    tolerance of the committed baseline (virtual-clock deterministic, so
+    the tolerance mostly absorbs deliberate knob changes), and the
+    hysteresis arm's direction changes must never exceed the committed
+    bound — the flap-oscillation proof is a hard ceiling, not a trend."""
+    with open(baseline_path) as f:
+        base = json.load(f)
+    failures = []
+    fc, bfc = result["first_chip"], base["first_chip"]
+    for key in ("time_to_first_chip_p50_s", "decision_p99_s"):
+        ceiling = bfc[key] * (1.0 + tolerance)
+        if fc[key] > ceiling:
+            failures.append(
+                f"{key}: {fc[key]} > ceiling {ceiling:.2f} "
+                f"(baseline {bfc[key]} + {tolerance:.0%})"
+            )
+    flap, bflap = result["flap"], base["flap"]
+    if flap["direction_changes"] > bflap["max_direction_changes"]:
+        failures.append(
+            f"flap direction_changes: {flap['direction_changes']} > "
+            f"committed bound {bflap['max_direction_changes']} — the "
+            f"hysteresis dwell stopped preventing oscillation"
+        )
+    if failures:
+        print("CAPACITY_BENCH gate: FAIL")
+        for f_ in failures:
+            print(f"  {f_}")
+        return 1
+    print(
+        f"CAPACITY_BENCH gate: OK (ttfc p50 "
+        f"{fc['time_to_first_chip_p50_s']}s vs baseline "
+        f"{bfc['time_to_first_chip_p50_s']}s; flap direction changes "
+        f"{flap['direction_changes']} <= {bflap['max_direction_changes']})"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rounds", type=int, default=6,
+                    help="first-chip rounds (default 6)")
+    ap.add_argument("--check-against", metavar="BASELINE_JSON",
+                    help="compare against a committed baseline and exit 1 "
+                         "on regression beyond --tolerance")
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="relative ceiling for the latency gates "
+                         "(default 0.25)")
+    args = ap.parse_args(argv)
+    logging.disable(logging.ERROR)
+    result = {
+        "bench": "CAPACITY_BENCH",
+        "first_chip": phase_first_chip(args.rounds),
+        "flap": phase_flap(hysteresis_s=FLAP_HYSTERESIS_S),
+        "flap_no_hysteresis": phase_flap(hysteresis_s=0.0),
+    }
+    print("CAPACITY_BENCH " + json.dumps(result, sort_keys=True))
+    if args.check_against:
+        return check_against(result, args.check_against, args.tolerance)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

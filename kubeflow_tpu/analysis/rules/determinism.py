@@ -15,6 +15,10 @@ SCOPED_DIRS = (
     "kubeflow_tpu/sessions/",
     "kubeflow_tpu/runtime/",
     "kubeflow_tpu/testing/",
+    # the capacity soak promises the same seed-alone reproducibility: the
+    # autoscaler runs on the injected clock and the fake provider draws
+    # every fault from its own seeded stream
+    "kubeflow_tpu/capacity/",
 )
 
 WALL_CLOCK_CALLS = {

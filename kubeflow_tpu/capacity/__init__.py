@@ -1,0 +1,48 @@
+"""Elastic capacity: scheduler-driven node-pool autoscaling with a spot tier.
+
+The fleet used to be a fixed set of pools: aged gangs that could not fit
+just sat queued, and nothing ever exercised pools appearing, shrinking, or
+being yanked away. This package closes the loop from queue depth to
+capacity (ROADMAP "Elastic capacity"; NotebookOS grounds the on-demand
+economics, the Gemma-on-TPU paper the spot tier):
+
+- ``provider.py``   — the provider boundary: a small ``CloudProvider``
+  surface (scale a pool up, scale one down, report in-flight provisioning
+  and spot revocation notices) with typed errors on top of the package-wide
+  bounded-retry discipline (``cloud/``), plus the deterministic
+  :class:`~kubeflow_tpu.capacity.provider.FakeCloudProvider` the soaks and
+  standalone demo drive from a seed;
+- ``autoscaler.py`` — the :class:`CapacityReconciler`: one more reconciler
+  under ``runtime/manager.py`` that consumes the scheduler's unmet-demand
+  signals (aged ``queued-at`` claims plus the per-gang explanation verdicts
+  of ``scheduler/explain.py`` — "buy chips" is acted on, "defrag would
+  admit it" deliberately is not) and the efficiency ledger's demand series,
+  requests pool scale-up through the provider, and scales idle autoscaled
+  pools down on the culler-shaped idle signal with hysteresis so capacity
+  flaps cannot oscillate;
+- ``soak.py``       — the seeded capacity soak (``tools/capacity_soak.py``)
+  whose per-seed audit proves zero lost gangs through revocation storms and
+  exact ledger conservation across pool birth and death (docs/capacity.md).
+
+Spot pools are a cheaper tier whose revocation notice arrives as a
+deadline-bearing suspend (``sessions.REASON_REVOCATION``) riding the same
+handoff barrier preemption uses: a revocation storm becomes a wave of
+pre-copy suspends and re-queues, never data loss. The wire contract the
+other layers consume (``REVOKED_ANNOTATION``, ``TIER_LABEL``,
+``AUTOSCALED_LABEL``) lives in ``scheduler/__init__.py`` next to the pool
+labels the fleet model is built from, so importing it never drags in
+provider or reconciler internals.
+"""
+from __future__ import annotations
+
+from typing import Mapping
+
+from kubeflow_tpu.scheduler import TIER_LABEL, TIER_ON_DEMAND, TIER_SPOT
+
+
+def node_tier(node: Mapping) -> str:
+    """The capacity tier a node belongs to; absent label = on-demand (every
+    pre-autoscaler node an operator created by hand is durable capacity)."""
+    labels = (node.get("metadata") or {}).get("labels", {}) or {}
+    tier = labels.get(TIER_LABEL)
+    return TIER_SPOT if tier == TIER_SPOT else TIER_ON_DEMAND

@@ -1,0 +1,629 @@
+"""The capacity reconciler: queue depth in, node pools out.
+
+One more reconciler under ``runtime/manager.py``, same shape as the fleet
+scheduler: a pseudo-kind with every Notebook/Node event coalesced onto ONE
+workqueue key, a cycle that re-derives everything from the store, and no
+in-memory state a crash-restart cannot afford to lose (lost state only
+*delays* a decision — open provider requests re-derive from the demand that
+caused them, idle dwell timers restart conservatively).
+
+The loop, each cycle:
+
+1. **Revocations.** Every outstanding spot notice from the provider becomes
+   (a) the ``REVOKED_ANNOTATION`` on the pool's nodes — the fleet model then
+   refuses NEW binds into the dying pool while committed placements keep
+   replaying — and (b) a deadline-bearing suspend request
+   (``sessions.REASON_REVOCATION``) on each gang placed there, riding the
+   PR 4/10 pre-copy handoff: the sessions controller snapshots, the
+   scheduler's one-write release re-queues the gang with its seniority
+   intact. A revocation storm is a wave of suspends and re-queues, never
+   data loss.
+2. **Scale-up.** Unmet demand = active, unbound gangs whose claim has aged
+   past ``pending_grace_s`` — a queued-at annotation for feasible gangs, the
+   explanation's persisted ``since`` for infeasible ones. The explanation
+   verdicts (``scheduler/explain.py``) gate the decision: a gang whose only
+   blocker is fragmentation (``wouldFitAfterDefrag``) or an in-flight
+   preemption handoff gets NO chips bought for it — more capacity would not
+   help. One in-flight provider request per family at a time, bounded by
+   ``max_pools_per_family`` autoscaled pools; the new pool's torus is the
+   largest demanded slice shape (so the triggering gang fits by
+   construction) and its tier is spot when allowed.
+3. **First chip.** When a requested pool's first node is schedulable, the
+   time-to-first-chip SLO observes (demand onset → first chip), tracked
+   next to the startup SLO on the shared registry and gated by
+   CAPACITY_BENCH.
+4. **Scale-down.** Only pools the autoscaler itself created
+   (``AUTOSCALED_LABEL`` on their nodes) are ever reclaimed, and only after
+   a continuous idle dwell of ``hysteresis_s`` with zero bound gangs, zero
+   queued demand, and nothing provisioning in the family — the hysteresis
+   that provably prevents capacity-flap oscillation (each scale-down costs
+   a fresh full dwell, so direction changes are rate-limited by
+   construction; CAPACITY_BENCH measures it under the flap chaos shape).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable
+
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu import sessions as sess
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.capacity import node_tier
+from kubeflow_tpu.capacity.provider import CloudProvider, PoolSpec
+from kubeflow_tpu.cloud import CloudError, RetriesExhausted
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import Conflict, FakeCluster, NotFound
+from kubeflow_tpu.runtime.manager import Reconciler, Result
+from kubeflow_tpu.scheduler.explain import (
+    REASON_AWAITING_HANDOFF,
+)
+from kubeflow_tpu.scheduler.fleet import Fleet
+
+CAPACITY_KEY = "@capacity"  # the single coalesced reconcile key
+
+DEFAULT_PENDING_GRACE_S = 30.0
+DEFAULT_HYSTERESIS_S = 300.0
+DEFAULT_MAX_POOLS_PER_FAMILY = 2
+DEFAULT_FIRST_CHIP_TARGET_S = 600.0
+
+
+class CapacityReconciler(Reconciler):
+    """Scheduler-driven node-pool autoscaling with a spot tier."""
+
+    kind = "CapacityCycle"
+    watch_primary = False
+
+    def __init__(
+        self,
+        provider: CloudProvider,
+        *,
+        metrics=None,
+        recorder=None,
+        clock: Callable[[], float] = time.time,
+        pending_grace_s: float = DEFAULT_PENDING_GRACE_S,
+        hysteresis_s: float = DEFAULT_HYSTERESIS_S,
+        max_pools_per_family: int = DEFAULT_MAX_POOLS_PER_FAMILY,
+        spot: bool = True,
+        suspend_deadline_s: float = sess.DEFAULT_SUSPEND_DEADLINE_S,
+        resync_s: float = 15.0,
+    ) -> None:
+        self.provider = provider
+        self.metrics = metrics
+        self.recorder = recorder
+        self.clock = clock
+        self.pending_grace_s = pending_grace_s
+        self.hysteresis_s = hysteresis_s
+        self.max_pools_per_family = max_pools_per_family
+        self.spot = spot
+        self.suspend_deadline_s = suspend_deadline_s
+        self.resync_s = resync_s
+        # open scale-up requests: pool name -> record. In-memory only — a
+        # crash loses the in-flight time-to-first-chip observation (observer
+        # semantics, like the SLO ring) but never the request itself: the
+        # demand that caused it still stands in the store, and the
+        # one-in-flight-per-family check sees provider.pending().
+        self._open: dict[str, dict] = {}
+        # pool -> when it was first observed idle (scale-down dwell clock);
+        # restart resets the dwell — conservative: reclaim later, never
+        # earlier, so a crash can only widen the hysteresis window
+        self._idle_since: dict[str, float] = {}
+        # last scale event per family, for the debug payload
+        self._last_event: dict[str, tuple[str, float]] = {}
+        # families whose pending_chips series has been exposed: a family
+        # that leaves the union below must have its series retired, or a
+        # dropped request would report phantom chips forever
+        self._pending_fams: set[str] = set()
+        # notices already translated (pool -> deadline), to emit Events and
+        # count metrics once per notice rather than once per cycle
+        self._noticed: dict[str, float] = {}
+        # freshness generation for the read side (JWA ETag): bumped whenever
+        # the state pending_for() renders from — open requests, the
+        # provider's pending set, delivered first chips — changes, so a
+        # cached 304 can never outlive the "capacity pending" message
+        # (including across a restart, where _open starts empty but the
+        # provider still reports in-flight provisioning)
+        self.state_gen = 0
+        self._state_sig: tuple | None = None
+        # the last cycle's provider.pending() view, for the read side
+        self._pending_snapshot: dict[str, PoolSpec] = {}
+
+    def watches(self):
+        return [("Notebook", _map_to_capacity), ("Node", _map_to_capacity)]
+
+    def reconcile(
+        self, cluster: FakeCluster, namespace: str, name: str
+    ) -> Result | None:
+        outstanding = self._cycle(cluster)
+        if outstanding:
+            # provisioning completions and revocation deadlines have no
+            # cluster event until the nodes actually move; poll tightly
+            return Result(requeue_after=min(self.resync_s, 5.0))
+        return Result(requeue_after=self.resync_s)
+
+    # ----------------------------------------------------------- the cycle
+
+    def _cycle(self, cluster: FakeCluster) -> bool:
+        now = self.clock()
+        nodes = cluster.list("Node")
+        notebooks = cluster.list("Notebook")
+        fleet = Fleet.from_nodes(nodes)
+        # pool -> (tier, autoscaled) from the node labels the provider stamps
+        pool_marks: dict[str, tuple[str, bool]] = {}
+        for node in nodes:
+            labels = ko.labels(node)
+            pool = labels.get(sched.POOL_LABEL)
+            if pool:
+                pool_marks[pool] = (
+                    node_tier(node),
+                    labels.get(sched.AUTOSCALED_LABEL) == "true",
+                )
+
+        notices = self._handle_revocations(cluster, fleet, notebooks, now)
+        demand = self._demand(fleet, notebooks, now)
+        pending = self._provider_pending()
+        # snapshot for the read side (pending_for): the web path must never
+        # block on a live provider call — it serves this cycle's view, and
+        # state_gen below fingerprints it for the ETag
+        self._pending_snapshot = pending
+        self._scale_up(cluster, fleet, demand, pending, pool_marks, now)
+        self._observe_first_chips(fleet, pending, now)
+        self._scale_down(fleet, notebooks, demand, pending, pool_marks, now)
+        sig = (
+            tuple(sorted(self._open)),
+            tuple(sorted(pending)),
+            self.metrics.time_to_first_chip.count()
+            if self.metrics is not None else 0,
+        )
+        if sig != self._state_sig:
+            self._state_sig = sig
+            self.state_gen += 1
+
+        if self.metrics is not None:
+            self.metrics.open_requests.set(float(len(self._open)))
+            by_family: dict[str, int] = {}
+            for rec in self._open.values():
+                by_family[rec["family"]] = (
+                    by_family.get(rec["family"], 0) + rec["chips"]
+                )
+            for spec in pending.values():
+                if spec.name not in self._open:
+                    by_family[spec.accelerator] = (
+                        by_family.get(spec.accelerator, 0) + spec.chips
+                    )
+            # families with nothing pending read 0 (the series the JWA ETA
+            # and the dashboard chart; absence would read as staleness);
+            # families that LEFT the union retire their series outright —
+            # a last value held by no live family reads as live state
+            fams = set(by_family) | {
+                p.accel.name for p in fleet.pools.values()
+            } | set(demand)
+            for fam in fams:
+                self.metrics.pending_chips.set(
+                    float(by_family.get(fam, 0)), family=fam
+                )
+            for fam in self._pending_fams - fams:
+                self.metrics.pending_chips.remove(family=fam)
+            self._pending_fams = fams
+        return bool(notices or self._open or pending or demand)
+
+    # ------------------------------------------------------- revocation side
+
+    def _handle_revocations(
+        self,
+        cluster: FakeCluster,
+        fleet: Fleet,
+        notebooks: list[dict],
+        now: float,
+    ) -> list:
+        try:
+            notices = self.provider.revocations(now)
+        except (CloudError, RetriesExhausted):
+            if self.metrics is not None:
+                self.metrics.provider_errors.inc(op="revocations")
+            return []  # poll again next cycle
+        live = {n.pool for n in notices}
+        for pool in [p for p in self._noticed if p not in live]:
+            del self._noticed[pool]
+        for notice in notices:
+            pool = fleet.pools.get(notice.pool)
+            if pool is None:
+                continue  # already killed (or never materialized)
+            first_seen = notice.pool not in self._noticed
+            self._noticed[notice.pool] = notice.deadline
+            if first_seen and self.metrics is not None:
+                self.metrics.revocations.inc(family=pool.accel.name)
+            # (a) mark the pool: the fleet model stops NEW binds into it
+            for idx in sorted(pool.nodes):
+                node_name = pool.nodes[idx]
+                node = cluster.try_get("Node", node_name)
+                if node is None or sched.REVOKED_ANNOTATION in ko.annotations(
+                    node
+                ):
+                    continue
+                try:
+                    cluster.patch("Node", node_name, "", {"metadata": {
+                        "annotations": {
+                            sched.REVOKED_ANNOTATION: repr(notice.deadline),
+                        }}})
+                except (NotFound, Conflict):
+                    continue  # raced the kill or a drain; next cycle retries
+            # (b) every gang placed there suspends with the notice deadline
+            for nb in notebooks:
+                placement = sched.placement_of(nb)
+                if placement is None or not any(
+                    s.get("pool") == notice.pool for s in placement["slices"]
+                ):
+                    continue
+                if api.STOP_ANNOTATION in ko.annotations(nb):
+                    continue  # already tearing down via its own barrier
+                if sess.suspend_request(nb) is not None:
+                    continue  # already in a barrier; idempotent
+                deadline_s = max(
+                    0.0,
+                    min(self.suspend_deadline_s, notice.deadline - now),
+                )
+                try:
+                    cluster.patch(
+                        "Notebook", ko.name(nb), ko.namespace(nb),
+                        {"metadata": {"annotations": {
+                            sess.SUSPEND_ANNOTATION:
+                                sess.encode_suspend_request(
+                                    sess.REASON_REVOCATION, now, deadline_s
+                                ),
+                        }}},
+                    )
+                except (NotFound, Conflict):
+                    continue  # raced a delete/write; next cycle retries
+                self._emit(
+                    cluster, nb, "Revoked",
+                    f"spot pool {notice.pool} is being reclaimed; "
+                    f"suspending the session before the capacity is taken",
+                    type_="Warning",
+                )
+        return notices
+
+    # --------------------------------------------------------- scale-up side
+
+    def _demand(
+        self, fleet: Fleet, notebooks: list[dict], now: float
+    ) -> dict[str, list[dict]]:
+        """Aged unmet demand per family: gangs more capacity would actually
+        help, each with the topology it wants and when its claim started."""
+        out: dict[str, list[dict]] = {}
+        for nb in notebooks:
+            try:
+                topo = api.notebook_topology(nb)
+            except ValueError:
+                topo = None
+            if topo is None:
+                continue
+            anns = ko.annotations(nb)
+            if api.STOP_ANNOTATION in anns:
+                continue
+            if sched.placement_of(nb) is not None:
+                continue
+            exp = sched.explanation_of(nb)
+            if exp is not None:
+                if exp.get("wouldFitAfterDefrag"):
+                    continue  # defrag admits it; buying chips would not help
+                if exp.get("reason") == REASON_AWAITING_HANDOFF:
+                    continue  # chips are already on their way
+            since: float | None = None
+            raw = anns.get(sched.QUEUED_AT_ANNOTATION)
+            if raw is not None:
+                try:
+                    since = float(raw)
+                except ValueError:
+                    since = None
+            if since is None and exp is not None:
+                # unschedulable gangs never get a queued-at stamp; the
+                # explanation's persisted since-clock is their age
+                try:
+                    since = float(exp.get("since"))
+                except (TypeError, ValueError):
+                    since = None
+            if since is None or now - since < self.pending_grace_s:
+                continue
+            num_slices = api.notebook_num_slices(nb)
+            if num_slices > self.max_pools_per_family:
+                # un-buyable within the autoscaled budget (each bought pool
+                # holds one slice of the largest demanded shape): this gang
+                # must not drive purchases it can never use — nor pin the
+                # family "in demand" forever, which would block scale-down
+                # of pools bought for satisfiable gangs
+                continue
+            out.setdefault(topo.accelerator.name, []).append({
+                "key": f"{ko.namespace(nb)}/{ko.name(nb)}",
+                "nb": nb,
+                "topo": topo,
+                "chips": topo.num_chips * num_slices,
+                "numSlices": num_slices,
+                "since": since,
+            })
+        for fam in out:
+            out[fam].sort(key=lambda d: (d["since"], d["key"]))
+        return out
+
+    def _provider_pending(self) -> dict[str, PoolSpec]:
+        try:
+            return dict(self.provider.pending())
+        except (CloudError, RetriesExhausted):
+            if self.metrics is not None:
+                self.metrics.provider_errors.inc(op="pending")
+            # fall back to the open-request memory: over-reporting pending
+            # merely delays a buy; under-reporting would double-buy
+            return {
+                name: PoolSpec(
+                    name=name, accelerator=rec["family"],
+                    topology=rec["topology"], tier=rec["tier"],
+                )
+                for name, rec in self._open.items()
+            }
+
+    def _scale_up(
+        self,
+        cluster: FakeCluster,
+        fleet: Fleet,
+        demand: dict[str, list[dict]],
+        pending: dict[str, PoolSpec],
+        pool_marks: dict[str, tuple[str, bool]],
+        now: float,
+    ) -> None:
+        pending_count: dict[str, int] = {}
+        for spec in pending.values():
+            pending_count[spec.accelerator] = (
+                pending_count.get(spec.accelerator, 0) + 1
+            )
+        for fam in sorted(demand):
+            gangs = demand[fam]
+            # a multislice gang needs one slice-shaped pool PER slice
+            # (slices of one gang join over DCN, so they may land in
+            # different pools): keep buying — one request per cycle, still
+            # bounded churn — until enough pools are pending or built
+            needed = max(d["numSlices"] for d in gangs)
+            in_flight = pending_count.get(fam, 0)
+            if in_flight >= needed:
+                continue
+            auto_pools = [
+                name for name, p in fleet.pools.items()
+                if p.accel.name == fam and pool_marks.get(name, ("", False))[1]
+            ]
+            if len(auto_pools) + in_flight >= self.max_pools_per_family:
+                continue  # at the budget: demand waits for a release
+            # pool torus = the largest demanded slice shape, so the largest
+            # triggering gang fits the new pool by construction (smaller
+            # shapes pack into the same torus)
+            biggest = max(gangs, key=lambda d: (d["topo"].num_chips, d["key"]))
+            topology = "x".join(map(str, biggest["topo"].shape))
+            name = self._pool_name(fam, fleet, pending)
+            spec = PoolSpec(
+                name=name,
+                accelerator=fam,
+                topology=topology,
+                tier=sched.TIER_SPOT if self.spot else sched.TIER_ON_DEMAND,
+            )
+            try:
+                self.provider.scale_up(spec)
+            except (CloudError, RetriesExhausted):
+                if self.metrics is not None:
+                    self.metrics.provider_errors.inc(op="scale_up")
+                continue  # level-triggered: the demand re-derives next cycle
+            trigger = min(d["since"] for d in gangs)
+            self._open[name] = {
+                "family": fam,
+                "topology": topology,
+                "tier": spec.tier,
+                "chips": spec.chips,
+                "requestedAt": now,
+                "trigger": trigger,
+            }
+            self._last_event[fam] = ("scale_up", now)
+            if self.metrics is not None:
+                self.metrics.scale_ups.inc(family=fam, tier=spec.tier)
+                self.metrics.decision_latency.observe(
+                    max(0.0, now - (trigger + self.pending_grace_s))
+                )
+            self._emit(
+                cluster, gangs[0]["nb"], "CapacityRequested",
+                f"provisioning {spec.chips} {fam} chips (pool {name}, "
+                f"{spec.tier} tier) for this gang's capacity request",
+            )
+
+    def _pool_name(
+        self, fam: str, fleet: Fleet, pending: dict[str, PoolSpec]
+    ) -> str:
+        taken = set(fleet.pools) | set(pending) | set(self._open)
+        i = 0
+        while f"auto-{fam}-{i}" in taken:
+            i += 1
+        return f"auto-{fam}-{i}"
+
+    def _observe_first_chips(
+        self, fleet: Fleet, pending: dict[str, PoolSpec], now: float
+    ) -> None:
+        for name in sorted(self._open):
+            pool = fleet.pools.get(name)
+            if pool is not None and pool.free_cells() > 0:
+                rec = self._open.pop(name)
+                self._last_event[rec["family"]] = ("first_chip", now)
+                if self.metrics is not None:
+                    self.metrics.observe_first_chip(
+                        max(0.0, now - rec["trigger"])
+                    )
+                continue
+            rec = self._open[name]
+            if (
+                name not in pending
+                and pool is None
+                and now - rec["requestedAt"] > self.resync_s
+            ):
+                # the request died server-side (the cloud errored the pool:
+                # quota, zone exhaustion): it is neither provisioning nor
+                # materialized. Drop the record — keeping it would report
+                # phantom pending chips forever and pin the tight poll; if
+                # the demand still stands, the next cycle re-requests.
+                del self._open[name]
+                self._last_event[rec["family"]] = ("request_lost", now)
+                if self.metrics is not None:
+                    self.metrics.provider_errors.inc(op="request_lost")
+
+    # ------------------------------------------------------- scale-down side
+
+    def _scale_down(
+        self,
+        fleet: Fleet,
+        notebooks: list[dict],
+        demand: dict[str, list[dict]],
+        pending: dict[str, PoolSpec],
+        pool_marks: dict[str, tuple[str, bool]],
+        now: float,
+    ) -> None:
+        # pools holding ANY committed placement are busy, full stop
+        placed_pools: set[str] = set()
+        queued_fams: set[str] = set()
+        for nb in notebooks:
+            placement = sched.placement_of(nb)
+            if placement is not None:
+                for s in placement["slices"]:
+                    placed_pools.add(s.get("pool", ""))
+            elif (
+                api.STOP_ANNOTATION not in ko.annotations(nb)
+                and sched.QUEUED_AT_ANNOTATION in ko.annotations(nb)
+            ):
+                try:
+                    topo = api.notebook_topology(nb)
+                except ValueError:
+                    topo = None
+                if topo is not None:
+                    queued_fams.add(topo.accelerator.name)
+        pending_fams = {spec.accelerator for spec in pending.values()}
+        for name in sorted(fleet.pools):
+            pool = fleet.pools[name]
+            fam = pool.accel.name
+            _tier, autoscaled = pool_marks.get(name, ("", False))
+            idle = (
+                autoscaled
+                and not pool.revoked
+                and name not in placed_pools
+                and fam not in queued_fams
+                and fam not in demand
+                and fam not in pending_fams
+            )
+            if not idle:
+                self._idle_since.pop(name, None)
+                continue
+            started = self._idle_since.setdefault(name, now)
+            if now - started < self.hysteresis_s:
+                continue  # the dwell IS the anti-flap hysteresis
+            try:
+                self.provider.scale_down(name)
+            except (CloudError, RetriesExhausted):
+                if self.metrics is not None:
+                    self.metrics.provider_errors.inc(op="scale_down")
+                continue  # keep the dwell; retry next cycle
+            self._idle_since.pop(name, None)
+            self._last_event[fam] = ("scale_down", now)
+            if self.metrics is not None:
+                self.metrics.scale_downs.inc(family=fam)
+
+    # ------------------------------------------------------------- read side
+
+    def pending_for(self, family: str) -> dict | None:
+        """The JWA's "capacity pending" surface: the open scale-up request
+        covering this family, with the chips on their way and an ETA from
+        the time-to-first-chip p50 (None until one has been observed).
+        Served entirely from the last cycle's state — a request-serving
+        thread must never block on a live provider call (a real adapter's
+        pending() is a retried HTTP fan-out); ``state_gen`` folds this
+        view's freshness into the ETag."""
+        chips = 0
+        since: float | None = None
+        for rec in self._open.values():
+            if rec["family"] != family:
+                continue
+            chips += rec["chips"]
+            since = (
+                rec["requestedAt"] if since is None
+                else min(since, rec["requestedAt"])
+            )
+        if chips == 0:
+            # no in-memory record (restart window): the cycle's snapshot of
+            # the provider's in-flight set still knows chips are coming
+            for spec in self._pending_snapshot.values():
+                if spec.accelerator == family:
+                    chips += spec.chips
+        if chips == 0:
+            return None
+        eta = None
+        if self.metrics is not None:
+            p50 = self.metrics.time_to_first_chip.quantile(0.5)
+            if p50 > 0.0:
+                eta = p50
+        out: dict = {"chips": chips, "etaS": eta}
+        if since is not None:
+            out["sinceS"] = max(0.0, self.clock() - since)
+        return out
+
+    def debug_payload(self) -> dict:
+        now = self.clock()
+        return {
+            "openRequests": {
+                name: {
+                    "family": rec["family"],
+                    "topology": rec["topology"],
+                    "tier": rec["tier"],
+                    "chips": rec["chips"],
+                    "ageS": max(0.0, now - rec["requestedAt"]),
+                }
+                for name, rec in sorted(self._open.items())
+            },
+            "revocations": {
+                pool: {"deadlineInS": deadline - now}
+                for pool, deadline in sorted(self._noticed.items())
+            },
+            "idleDwell": {
+                pool: {"idleForS": max(0.0, now - since)}
+                for pool, since in sorted(self._idle_since.items())
+            },
+            "lastEvents": {
+                fam: {"event": ev, "agoS": max(0.0, now - at)}
+                for fam, (ev, at) in sorted(self._last_event.items())
+            },
+            "timeToFirstChipP50S": (
+                self.metrics.time_to_first_chip.quantile(0.5)
+                if self.metrics is not None else None
+            ),
+        }
+
+    # -------------------------------------------------------------- plumbing
+
+    def _emit(
+        self,
+        cluster: FakeCluster,
+        nb: dict,
+        reason: str,
+        message: str,
+        type_: str = "Normal",
+    ) -> None:
+        if self.recorder is not None:
+            self.recorder.emit(cluster, nb, reason, message, type_)
+
+
+def install_capacity_route(app, autoscaler: CapacityReconciler) -> None:
+    """Mount /debug/capacity on a web App (the probe port, next to
+    /debug/ledger — cluster-internal, never the gateway): the autoscaler's
+    open requests, outstanding revocations, and idle dwells."""
+    import json as _json
+
+    from werkzeug.wrappers import Response
+
+    @app.route("/debug/capacity")
+    def debug_capacity(request):
+        return Response(
+            _json.dumps(autoscaler.debug_payload(), sort_keys=True),
+            mimetype="application/json",
+        )
+
+
+def _map_to_capacity(obj: dict) -> Iterable[tuple[str, str]]:
+    yield ("", CAPACITY_KEY)

@@ -1,0 +1,301 @@
+"""The cloud-provider boundary of the elastic-capacity loop.
+
+``CloudProvider`` is the minimal surface the autoscaler needs — idempotent
+"make this pool exist", idempotent "delete this pool", what is still
+provisioning, and which pools carry a spot revocation notice. Real adapters
+(``cloud/gcp.py`` ``GkeNodePoolProvider``, ``cloud/aws.py``
+``EksNodeGroupProvider``) speak the documented REST surfaces through the
+package's bounded-retry discipline; the :class:`FakeCloudProvider` here is
+the deterministic in-memory cloud the soaks, benches, and the standalone
+demo drive — every fault it injects (429/500-shaped API errors, stuck
+provisioning, notice-then-kill with or without the grace window honored)
+flows from one seeded stream, so a failing capacity-soak seed reproduces
+exactly (docs/capacity.md).
+
+Provisioning materializes as Node objects shaped exactly like
+``scheduler/soak.make_pool`` builds them (the GKE labels ``Fleet.from_nodes``
+keys on) plus the capacity markers: ``TIER_LABEL`` and ``AUTOSCALED_LABEL``
+— the latter is what entitles scale-down to delete the pool later.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Protocol
+
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu.cloud import CloudError
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import AlreadyExists, NotFound
+from kubeflow_tpu.tpu.topology import ACCELERATORS, parse_topology
+
+
+class ProviderError(CloudError):
+    """A provider call failed after the adapter's own retry budget — the
+    autoscaler backs off and retries next cycle (level-triggered; a lost
+    request re-derives from the demand that caused it)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolSpec:
+    """What the autoscaler asks the cloud for: one whole TPU slice pool."""
+
+    name: str
+    accelerator: str   # family, e.g. "v4"
+    topology: str      # pool torus, e.g. "2x2x2"
+    tier: str = sched.TIER_ON_DEMAND
+
+    @property
+    def chips(self) -> int:
+        return parse_topology(self.accelerator, self.topology).num_chips
+
+
+@dataclasses.dataclass(frozen=True)
+class RevocationNotice:
+    """A spot pool's reclamation notice: the provider kills the nodes at
+    ``deadline`` (or earlier, when the cloud dishonors its own grace
+    window — a fault shape the soak arms on purpose)."""
+
+    pool: str
+    deadline: float
+
+
+class CloudProvider(Protocol):
+    def scale_up(self, spec: PoolSpec) -> bool:
+        """Ensure the pool exists or is provisioning; True if this call
+        newly requested it. Raises :class:`ProviderError` (or the cloud
+        package's ``RetriesExhausted``) on provider failure."""
+        ...
+
+    def scale_down(self, pool: str) -> bool:
+        """Request deletion of a pool; True if newly requested."""
+        ...
+
+    def pending(self) -> dict[str, PoolSpec]:
+        """Pools requested but not yet fully provisioned."""
+        ...
+
+    def revocations(self, now: float) -> list[RevocationNotice]:
+        """Outstanding spot revocation notices."""
+        ...
+
+
+@dataclasses.dataclass
+class ProviderChaos:
+    """Provider-side fault shapes (docs/capacity.md), drawn from the fake
+    provider's own seeded stream so (seed, schedule) reproduces exactly.
+
+    - ``error_rate``: a scale_up/scale_down call fails with a 429/500-shaped
+      :class:`ProviderError` (the adapter's retry budget already spent);
+    - ``stuck_rate``: an accepted scale-up wedges — the pool never becomes
+      Ready until ``heal()`` (quota stalls, zone exhaustion);
+    - ``dishonor_grace_p``: a revocation kill ignores its own grace window
+      and lands after only ``dishonored_fraction`` of it (notice-then-kill,
+      the fault that turns graceful suspends into cold re-queues).
+    """
+
+    error_rate: float = 0.15
+    stuck_rate: float = 0.15
+    dishonor_grace_p: float = 0.5
+    dishonored_fraction: float = 0.2
+
+    @classmethod
+    def quiet(cls) -> "ProviderChaos":
+        return cls(error_rate=0.0, stuck_rate=0.0, dishonor_grace_p=0.0)
+
+
+@dataclasses.dataclass
+class _Provisioning:
+    spec: PoolSpec
+    ready_at: float | None  # None = stuck until heal()
+
+
+@dataclasses.dataclass
+class _Revocation:
+    notice: RevocationNotice
+    kill_at: float  # when the nodes actually die (== deadline when honored)
+
+
+class FakeCloudProvider:
+    """Deterministic in-memory cloud for soaks, benches, and standalone.
+
+    The autoscaler calls the ``CloudProvider`` surface (those calls fault);
+    the harness drives :meth:`step` once per sub-tick, which is when
+    provisioning completes (nodes appear) and revocation kills land (nodes
+    vanish) — infrastructure acts on the *unfaulted* store, exactly like the
+    scenario ops in the other soaks."""
+
+    def __init__(
+        self,
+        cluster,
+        *,
+        clock: Callable[[], float],
+        seed: int = 0,
+        chaos: ProviderChaos | None = None,
+        provision_delay_s: float = 30.0,
+    ) -> None:
+        self.cluster = cluster
+        self.clock = clock
+        self.chaos = chaos
+        self.rng = random.Random(f"provider-{seed}")
+        self.provision_delay_s = provision_delay_s
+        self._provisioning: dict[str, _Provisioning] = {}
+        self._deleting: set[str] = set()
+        self._revocations: dict[str, _Revocation] = {}
+        self._healed = False
+        self.fault_counts: dict[str, int] = {}
+        # every pool this provider ever created/killed, for audits
+        self.created: list[str] = []
+        self.killed: list[str] = []
+
+    # ----------------------------------------------------------- fault core
+
+    def _maybe_fail(self, op: str) -> None:
+        if self._healed or self.chaos is None:
+            return
+        if self.rng.random() < self.chaos.error_rate:
+            status = 429 if self.rng.random() < 0.5 else 500
+            self.fault_counts[op] = self.fault_counts.get(op, 0) + 1
+            raise ProviderError(
+                f"fake cloud: injected {status} on {op}", status=status
+            )
+
+    def heal(self) -> None:
+        """Stop injecting faults and unstick wedged provisioning — the soak
+        asserts convergence AFTER heal, like every other chaos source."""
+        self._healed = True
+        now = self.clock()
+        for prov in self._provisioning.values():
+            if prov.ready_at is None:
+                prov.ready_at = now + self.provision_delay_s
+
+    # ------------------------------------------------------ provider surface
+
+    def scale_up(self, spec: PoolSpec) -> bool:
+        self._maybe_fail("scale_up")
+        if spec.name in self._provisioning:
+            return False  # idempotent: already provisioning
+        if self._pool_nodes(spec.name):
+            return False  # idempotent: already exists
+        self._deleting.discard(spec.name)
+        stuck = (
+            not self._healed
+            and self.chaos is not None
+            and self.rng.random() < self.chaos.stuck_rate
+        )
+        if stuck:
+            self.fault_counts["stuck"] = self.fault_counts.get("stuck", 0) + 1
+        self._provisioning[spec.name] = _Provisioning(
+            spec=spec,
+            ready_at=None if stuck else self.clock() + self.provision_delay_s,
+        )
+        return True
+
+    def scale_down(self, pool: str) -> bool:
+        self._maybe_fail("scale_down")
+        if self._provisioning.pop(pool, None) is not None:
+            return True  # cancel an in-flight request outright
+        if pool in self._deleting or not self._pool_nodes(pool):
+            return False
+        self._deleting.add(pool)
+        return True
+
+    def pending(self) -> dict[str, PoolSpec]:
+        # read verbs fault too: the autoscaler's fallback (answer from its
+        # own open-request memory, so a blind cycle never double-buys) is
+        # a real code path the soaks must exercise
+        self._maybe_fail("pending")
+        return {n: p.spec for n, p in self._provisioning.items()}
+
+    def revocations(self, now: float) -> list[RevocationNotice]:
+        self._maybe_fail("revocations")
+        return [
+            r.notice for r in self._revocations.values()
+            if r.notice.deadline > now or r.kill_at > now
+        ]
+
+    # ------------------------------------------------------- harness surface
+
+    def revoke(
+        self, pool: str, *, grace_s: float, honored: bool | None = None
+    ) -> RevocationNotice | None:
+        """Serve a spot revocation notice on a live pool (a scenario op).
+        ``honored=None`` draws from the seeded chaos stream: a dishonored
+        notice kills the nodes after only a fraction of the grace window —
+        the storm shape where the barrier loses the race and gangs re-queue
+        cold instead of suspending cleanly."""
+        if pool in self._revocations or not self._pool_nodes(pool):
+            return None
+        now = self.clock()
+        if honored is None:
+            honored = not (
+                self.chaos is not None
+                and self.rng.random() < self.chaos.dishonor_grace_p
+            )
+        deadline = now + grace_s
+        kill_at = deadline if honored else (
+            now + grace_s * (
+                self.chaos.dishonored_fraction if self.chaos else 0.2
+            )
+        )
+        notice = RevocationNotice(pool=pool, deadline=deadline)
+        self._revocations[pool] = _Revocation(notice=notice, kill_at=kill_at)
+        return notice
+
+    def step(self) -> None:
+        """One infrastructure tick: finish due provisioning, land due
+        revocation kills, and execute accepted deletions — all against the
+        unfaulted store (the cloud does not fail at moving its own metal)."""
+        now = self.clock()
+        for name in sorted(self._provisioning):
+            prov = self._provisioning[name]
+            if prov.ready_at is not None and now >= prov.ready_at:
+                self._create_pool(prov.spec)
+                del self._provisioning[name]
+        for pool in sorted(self._revocations):
+            if now >= self._revocations[pool].kill_at:
+                self._delete_pool(pool)
+                del self._revocations[pool]
+        for pool in sorted(self._deleting):
+            self._delete_pool(pool)
+        self._deleting.clear()
+
+    # -------------------------------------------------------------- plumbing
+
+    def _pool_nodes(self, pool: str) -> list[dict]:
+        return self.cluster.list(
+            "Node", None, {"matchLabels": {sched.POOL_LABEL: pool}}
+        )
+
+    def _create_pool(self, spec: PoolSpec) -> None:
+        topo = parse_topology(spec.accelerator, spec.topology)
+        accel = ACCELERATORS[spec.accelerator]
+        for i in range(topo.num_hosts):
+            try:
+                self.cluster.add_node(
+                    f"{spec.name}-{i}",
+                    labels={
+                        "cloud.google.com/gke-tpu-accelerator":
+                            accel.gke_accelerator,
+                        "cloud.google.com/gke-tpu-topology": spec.topology,
+                        sched.POOL_LABEL: spec.name,
+                        sched.HOST_INDEX_LABEL: str(i),
+                        sched.TIER_LABEL: spec.tier,
+                        sched.AUTOSCALED_LABEL: "true",
+                    },
+                    capacity={"google.com/tpu": str(topo.chips_per_host)},
+                )
+            except AlreadyExists:
+                pass  # idempotent replay (a re-requested pool half-created)
+        self.created.append(spec.name)
+
+    def _delete_pool(self, pool: str) -> None:
+        deleted = False
+        for node in self._pool_nodes(pool):
+            try:
+                self.cluster.delete("Node", ko.name(node))
+                deleted = True
+            except NotFound:
+                pass
+        if deleted:
+            self.killed.append(pool)

@@ -1,0 +1,837 @@
+"""Seeded chaos soak for elastic capacity (``tools/capacity_soak.py``).
+
+The capacity loop's safety argument extends the scheduler's: pools are no
+longer a fixed fleet — the autoscaler births them from queue depth and the
+spot tier's revocations kill them mid-flight — yet every invariant the
+other soaks prove must keep holding while capacity itself churns:
+
+- **zero lost gangs**: every spot revocation ends in a migration, a clean
+  suspend (snapshot acked before the kill), or a re-queue — at the healed
+  fixed point every active gang is bound, queued, or provably
+  unschedulable, and no acked snapshot ever evaporates into a cold restart
+  (the sessions no-loss rule, under pool death);
+- **the barrier holds under pool death**: chips release only on ack,
+  deadline, teardown — or because the pool's nodes are simply GONE (the
+  dishonored-grace kill; there is nothing left to hold);
+- **ledger conservation across pool birth/death**: Σ buckets == ∫ capacity
+  dt as exact integers in every seed, while pools appear and vanish
+  mid-window (docs/chaos.md "efficiency ledger");
+- **the autoscaler's own fixed point**: once faults heal and provisioning
+  drains, no family is left with aged unmet demand, autoscaled-pool
+  headroom, and no capacity on the way — an unfittable aged gang MUST have
+  bought its pool and bound.
+
+Fault shapes on top of the control-plane chaos layer: provider 429/500s,
+stuck provisioning, and revocation storms with the grace window honored or
+not (``capacity.provider.ProviderChaos``). Everything flows from the seed:
+``python tools/capacity_soak.py --seed N`` reproduces a failure exactly.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import random
+from typing import Callable
+
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu import sessions as sess
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.capacity.autoscaler import CapacityReconciler
+from kubeflow_tpu.capacity.provider import FakeCloudProvider, ProviderChaos
+from kubeflow_tpu.controllers.notebook_controller import NotebookReconciler
+from kubeflow_tpu.culler.culler import Culler
+from kubeflow_tpu.obs.events import EventRecorder, audit_events
+from kubeflow_tpu.obs.ledger import FleetEfficiencyLedger
+from kubeflow_tpu.obs.slo import SLOMetrics
+from kubeflow_tpu.obs.timeline import TimelineRecorder, audit_timeline
+from kubeflow_tpu.obs.tracing import Tracer
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import (
+    AlreadyExists,
+    Conflict,
+    FakeCluster,
+    NotFound,
+)
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.scheduler import explain as explain_mod
+from kubeflow_tpu.scheduler.controller import SchedulerReconciler
+from kubeflow_tpu.scheduler.soak import (
+    audit_fixed_point,
+    audit_placements,
+    make_pool,
+)
+from kubeflow_tpu.sessions.controller import SessionReconciler
+from kubeflow_tpu.sessions.soak import (
+    audit_chunk_store,
+    audit_sessions_fixed_point,
+)
+from kubeflow_tpu.sessions.store import SnapshotStore
+from kubeflow_tpu.testing.chaos import (
+    SOAK_MAX_REQUEUE_S,
+    ChaosCluster,
+    ChaosConfig,
+    check_invariants,
+    fingerprint,
+)
+from kubeflow_tpu.testing.sessionstore import (
+    FakeObjectStore,
+    FakeSessionAgent,
+    StoreChaosConfig,
+)
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import (
+    CapacityMetrics,
+    SchedulerMetrics,
+    SessionMetrics,
+)
+from kubeflow_tpu.webhooks import tpu_env
+
+SOAK_AGING_INTERVAL_S = 60.0
+SOAK_SUSPEND_DEADLINE_S = 60.0
+SOAK_PENDING_GRACE_S = 20.0
+SOAK_HYSTERESIS_S = 90.0
+SOAK_PROVISION_DELAY_S = 25.0
+
+# ------------------------------------------------------------------- audits
+
+
+def _nb_key(nb: dict) -> str:
+    return f"{ko.namespace(nb)}/{ko.name(nb)}"
+
+
+def _gang_scaled_down(base: FakeCluster, nb: dict) -> bool:
+    name, ns = ko.name(nb), ko.namespace(nb)
+    try:
+        num_slices = api.notebook_num_slices(nb)
+    except (TypeError, ValueError):
+        num_slices = 1
+    for j in range(max(1, num_slices)):
+        sts_name = name if num_slices <= 1 else f"{name}-s{j}"
+        sts = base.try_get("StatefulSet", sts_name, ns)
+        if sts is not None and (sts.get("spec") or {}).get("replicas", 0) > 0:
+            return False
+    return True
+
+
+def _live_pools(base: FakeCluster) -> set[str]:
+    out = set()
+    for node in base.list("Node"):
+        pool = ko.labels(node).get(sched.POOL_LABEL)
+        if pool:
+            out.add(pool)
+    return out
+
+
+@dataclasses.dataclass
+class _Obs:
+    uid: str
+    pools: tuple[str, ...]      # pools of the committed placement, if any
+    requested_reason: str | None
+    ack_id: str | None
+    complete: bool
+    scaled_down: bool
+    deadline: float | None
+
+
+class CapacityAuditor:
+    """Temporal audit fed one observation per sub-tick — the sessions
+    soak's barrier rule extended for a world where pools die: a release is
+    additionally legitimate when the placement's pool has no nodes left
+    (the dishonored-grace kill took the chips; there is nothing to hold).
+    Also keeps the revocation ledger the fixed-point audit consumes: which
+    gangs were serving on a revoked pool, and how each episode resolved."""
+
+    def __init__(self, store: SnapshotStore, agent: FakeSessionAgent) -> None:
+        self.store = store
+        self.agent = agent
+        self.last: dict[str, _Obs] = {}
+        # key -> resolution of the gang's LAST revocation episode:
+        # "suspended" (ack committed inside the barrier), "released"
+        # (the scheduler's one-write re-queue), "pool-died" (kill beat the
+        # barrier: a cold re-queue, lost work but no acked-state loss)
+        self.revoked: dict[str, str] = {}
+
+    def observe(self, base: FakeCluster, now: float, where: str) -> list[str]:
+        out: list[str] = []
+        restores = set(self.agent.restores)
+        live_pools = _live_pools(base)
+        seen: set[str] = set()
+        for nb in base.list("Notebook"):
+            key = _nb_key(nb)
+            seen.add(key)
+            uid = nb.get("metadata", {}).get("uid", "")
+            ack = sess.snapshot_record(nb)
+            req = sess.suspend_request(nb)
+            placement = sched.placement_of(nb)
+            obs = _Obs(
+                uid=uid,
+                pools=tuple(sorted(
+                    s.get("pool", "") for s in placement["slices"]
+                )) if placement else (),
+                requested_reason=req.get("reason") if req else None,
+                ack_id=ack.get("snapshotId") if ack else None,
+                complete=sess.suspend_complete(nb, now),
+                scaled_down=_gang_scaled_down(base, nb),
+                deadline=req.get("deadline") if req else None,
+            )
+            if obs.requested_reason == sess.REASON_REVOCATION:
+                self.revoked.setdefault(key, "pending")
+            prev = self.last.get(key)
+            if prev is not None and prev.uid != uid:
+                # delete + recreate between observations: the old life's
+                # revocation episode died with its object
+                self.revoked.pop(key, None)
+                if obs.requested_reason == sess.REASON_REVOCATION:
+                    self.revoked[key] = "pending"
+            if prev is not None and prev.uid == uid:
+                if prev.pools and not obs.pools:
+                    pool_died = any(p not in live_pools for p in prev.pools)
+                    allowed = (
+                        prev.complete
+                        or obs.complete
+                        or obs.ack_id is not None
+                        or prev.scaled_down
+                        or (prev.deadline is not None
+                            and now >= prev.deadline)
+                        or pool_died
+                    )
+                    if not allowed:
+                        out.append(
+                            f"{where}: {key}: chips released while the "
+                            f"suspend barrier held (no snapshot ack, "
+                            f"deadline not passed, pods up, pool alive)"
+                        )
+                    if (
+                        prev.requested_reason == sess.REASON_REVOCATION
+                        and key in self.revoked
+                    ):
+                        if obs.ack_id is not None:
+                            self.revoked[key] = "suspended"
+                        elif pool_died:
+                            self.revoked[key] = "pool-died"
+                        else:
+                            self.revoked[key] = "released"
+                if (
+                    prev.requested_reason == sess.REASON_REVOCATION
+                    and obs.requested_reason != sess.REASON_REVOCATION
+                    and self.revoked.get(key) == "pending"
+                ):
+                    # the request retired without a release transition this
+                    # auditor saw (e.g. the pool was killed first, the force
+                    # deadline suspended cold, and the resume cleared the
+                    # request): classify the episode from its endpoints
+                    if obs.ack_id is not None or prev.ack_id is not None:
+                        self.revoked[key] = "suspended"
+                    elif prev.pools and any(
+                        p not in live_pools for p in prev.pools
+                    ):
+                        self.revoked[key] = "pool-died"
+                    else:
+                        self.revoked[key] = "released"
+                if prev.ack_id is not None and obs.ack_id is None:
+                    if (key, prev.ack_id) not in restores:
+                        out.append(
+                            f"{where}: {key}: acked snapshot {prev.ack_id} "
+                            f"left the CR without its restore being "
+                            f"delivered (cold restart of preserved work)"
+                        )
+            if obs.ack_id is not None and (
+                prev is None or prev.ack_id != obs.ack_id
+            ):
+                if self.store.commit_record(key, obs.ack_id) is None:
+                    out.append(
+                        f"{where}: {key}: ack {obs.ack_id} has no "
+                        f"verifiable committed snapshot in the store"
+                    )
+                if self.revoked.get(key) == "pending":
+                    self.revoked[key] = "suspended"
+            self.last[key] = obs
+        for key in list(self.last):
+            if key not in seen:
+                del self.last[key]
+                self.revoked.pop(key, None)  # deleted: episode moot
+        return out
+
+
+def audit_capacity_fixed_point(
+    base: FakeCluster,
+    autoscaler: CapacityReconciler,
+    auditor: CapacityAuditor,
+    provider: FakeCloudProvider,
+    now: float,
+    *,
+    max_pools_per_family: int,
+    where: str = "final",
+) -> list[str]:
+    """The capacity-specific obligations at the healed, quiesced fixed
+    point (docs/capacity.md) — on top of the scheduler fixed-point audit,
+    the sessions no-loss audit, and the ledger conservation audit."""
+    out: list[str] = []
+    # (1) every revocation fully resolved: no notice annotation survives on
+    # a live node, no gang still carries a revocation suspend request
+    for node in base.list("Node"):
+        if sched.REVOKED_ANNOTATION in ko.annotations(node):
+            out.append(
+                f"{where}: node {ko.name(node)} still marked revoked after "
+                f"every notice resolved (stale bind-block would starve the "
+                f"pool forever)"
+            )
+    live_pools = _live_pools(base)
+    # autoscaled pools per family: the headroom check below
+    from kubeflow_tpu.tpu.topology import accelerator_for_gke_label
+
+    fam_pools: dict[str, set[str]] = {}
+    for node in base.list("Node"):
+        labels = ko.labels(node)
+        if labels.get(sched.AUTOSCALED_LABEL) != "true":
+            continue
+        accel = accelerator_for_gke_label(
+            labels.get("cloud.google.com/gke-tpu-accelerator", "")
+        )
+        pool = labels.get(sched.POOL_LABEL)
+        if accel is not None and pool:
+            fam_pools.setdefault(accel.name, set()).add(pool)
+    for nb in base.list("Notebook"):
+        try:
+            topo = api.notebook_topology(nb)
+        except ValueError:
+            continue
+        if topo is None:
+            continue
+        key = _nb_key(nb)
+        anns = ko.annotations(nb)
+        req = sess.suspend_request(nb)
+        if req is not None and req.get("reason") == sess.REASON_REVOCATION:
+            out.append(
+                f"{where}: {key}: revocation suspend request still "
+                f"outstanding at the fixed point"
+            )
+        placement = sched.placement_of(nb)
+        if placement is not None:
+            dead = [
+                s.get("pool") for s in placement["slices"]
+                if s.get("pool") not in live_pools
+            ]
+            if dead:
+                out.append(
+                    f"{where}: {key}: placement references dead pool(s) "
+                    f"{dead} (a lost gang: bound to chips that no longer "
+                    f"exist)"
+                )
+        active = api.STOP_ANNOTATION not in anns
+        if active and placement is None:
+            # zero lost gangs: an active gang is bound, queued, or provably
+            # unschedulable — never in limbo
+            queued = sched.QUEUED_AT_ANNOTATION in anns
+            unsched = sched.condition_is_true(nb, sched.COND_UNSCHEDULABLE)
+            if not queued and not unsched:
+                out.append(
+                    f"{where}: {key}: active gang neither bound, queued, "
+                    f"nor marked unschedulable (LOST)"
+                )
+            if unsched:
+                # mirror the autoscaler's own demand filter: gangs it is
+                # DESIGNED not to buy for (more slices than the budget can
+                # deliver; blocked only by fragmentation) are legitimately
+                # unschedulable at the fixed point
+                exp = sched.explanation_of(nb)
+                buyable = (
+                    api.notebook_num_slices(nb) <= max_pools_per_family
+                    and not (exp or {}).get("wouldFitAfterDefrag")
+                )
+                fam = topo.accelerator.name
+                if buyable and len(fam_pools.get(fam, ())) < max_pools_per_family:
+                    out.append(
+                        f"{where}: {key}: left unschedulable with "
+                        f"autoscaled-pool headroom in {fam} — the "
+                        f"autoscaler never bought the capacity it could"
+                    )
+    # (2) every revocation episode the auditor witnessed resolved into one
+    # of the three legal ends (a pending episode at the fixed point means a
+    # gang is wedged inside the barrier)
+    for key, resolution in sorted(auditor.revoked.items()):
+        if resolution == "pending":
+            out.append(
+                f"{where}: {key}: revocation episode never resolved "
+                f"(neither suspended, released, nor pool death)"
+            )
+    # (3) the provider has nothing in flight the autoscaler is blind to
+    for name in sorted(provider.pending()):
+        out.append(
+            f"{where}: provider still provisioning {name} at the fixed "
+            f"point (the autoscaler requested capacity nobody consumed)"
+        )
+    return out
+
+
+# ----------------------------------------------------------------- scenario
+
+# (family, pool topology) for the seed fleet — small on purpose: capacity
+# growth is the subject, so seeds start tight and buy their way out.
+_POOL_CHOICES = [
+    ("v4", "2x2x2"),   # 2 hosts / 8 chips
+    ("v4", "2x2x4"),   # 4 hosts / 16 chips
+    ("v5e", "4x4"),    # 2 hosts / 16 chips
+]
+# gang shapes per family; the largest entries do NOT fit the smaller pools,
+# so seeds regularly contain the "unfittable aged gang" the autoscaler (and
+# CAPACITY_BENCH) exists for
+_GANG_TOPOLOGIES = {
+    "v4": ["2x2x1", "2x2x2", "2x2x4"],
+    "v5e": ["2x4", "4x4", "4x8"],
+}
+_REVOKE_GRACE_CHOICES = (20.0, 45.0, 90.0)
+
+
+class CapacityScenario:
+    """A seeded tight fleet + gang workload + hostile op timeline.
+
+    Pools start scarce (often too small for some gangs), a spot pool may
+    pre-exist (as if a previous autoscaler incarnation bought it), and the
+    op timeline mixes demand churn (stop/start/delete/recreate, priority
+    bumps) with revocation ops: ``revoke`` serves notice on one live spot
+    pool, ``storm`` on every one of them at once. Whether each notice's
+    grace window is honored comes from the provider's own seeded chaos
+    stream. Node drains/flaps are deliberately absent — the scheduler soak
+    owns those; here every pool death flows through the revocation path so
+    the capacity audit's episode accounting stays exact."""
+
+    N_ROUNDS = 6
+    NAMESPACE = "team-a"
+
+    def __init__(self, seed: int) -> None:
+        rng = random.Random(f"capacity-scenario-{seed}")
+        self.seed = seed
+        self.culling = rng.random() < 0.3
+        n_pools = 1 + (rng.random() < 0.5)
+        picks = rng.sample(_POOL_CHOICES, k=n_pools)
+        self.pools = {
+            f"pool-{accel}-{i}": (accel, topo)
+            for i, (accel, topo) in enumerate(picks)
+        }
+        pool_accels = sorted({a for a, _ in self.pools.values()})
+        # a pre-existing spot pool: revocation storms have a target from
+        # round 0 instead of waiting for the autoscaler's first buy
+        self.spot_pools: dict[str, tuple[str, str]] = {}
+        if rng.random() < 0.6:
+            accel = pool_accels[rng.randrange(len(pool_accels))]
+            shapes = _GANG_TOPOLOGIES[accel]
+            self.spot_pools[f"auto-{accel}-seed"] = (
+                accel, shapes[rng.randrange(len(shapes) - 1)]
+            )
+        self.gangs: dict[str, dict] = {}
+        for i in range(rng.randint(4, 7)):
+            accel = pool_accels[rng.randrange(len(pool_accels))]
+            shapes = _GANG_TOPOLOGIES[accel]
+            gang = dict(
+                tpu_accelerator=accel,
+                tpu_topology=shapes[rng.randrange(len(shapes))],
+            )
+            prio = (0, 0, 0, 1, 5)[rng.randrange(5)]
+            if prio:
+                gang["annotations"] = {sched.PRIORITY_ANNOTATION: str(prio)}
+            self.gangs[f"c{i}"] = gang
+        self.busy = {g for g in sorted(self.gangs) if rng.random() < 0.6}
+        self.rounds = self._op_timeline(rng)
+
+    def _op_timeline(
+        self, rng: random.Random
+    ) -> list[list[tuple[str, str, float]]]:
+        alive, dead = set(self.gangs), set()
+        rounds: list[list[tuple[str, str, float]]] = []
+        for _ in range(self.N_ROUNDS):
+            ops: list[tuple[str, str, float]] = []
+            for _ in range(rng.randint(0, 2)):
+                choices: list[tuple[str, str]] = []
+                for nb in sorted(alive):
+                    choices += [
+                        ("stop", nb), ("start", nb),
+                        ("bump_priority", nb), ("delete_nb", nb),
+                    ]
+                choices += [("recreate_nb", nb) for nb in sorted(dead)]
+                # revocation ops are always on the menu: which pool they hit
+                # is resolved at apply time against the live spot set
+                choices += [("revoke", ""), ("storm", "")]
+                op = choices[rng.randrange(len(choices))]
+                verb, target = op
+                if verb == "delete_nb":
+                    alive.discard(target); dead.add(target)
+                elif verb == "recreate_nb":
+                    dead.discard(target); alive.add(target)
+                # one draw per op decides revocation targeting/grace later
+                ops.append((verb, target, rng.random()))
+            rounds.append(ops)
+        return rounds
+
+    # -- world construction (user / API-server side: never faulted) --------
+
+    def _nb(self, name: str) -> dict:
+        return api.notebook(name, self.NAMESPACE, **self.gangs[name])
+
+    def setup(self, base: FakeCluster) -> None:
+        for pool, (accel, topo) in sorted(self.pools.items()):
+            make_pool(base, accel, topo, pool)
+        for pool, (accel, topo) in sorted(self.spot_pools.items()):
+            for node in make_pool(base, accel, topo, pool):
+                base.patch("Node", ko.name(node), "", {"metadata": {
+                    "labels": {
+                        sched.TIER_LABEL: sched.TIER_SPOT,
+                        sched.AUTOSCALED_LABEL: "true",
+                    }}})
+        for name in sorted(self.gangs):
+            base.create(self._nb(name))
+
+    def apply(
+        self,
+        base: FakeCluster,
+        provider: FakeCloudProvider,
+        op: tuple[str, str, float],
+        round_no: int,
+    ) -> None:
+        verb, target, draw = op
+        ns = self.NAMESPACE
+        try:
+            if verb == "stop":
+                base.patch("Notebook", target, ns, {"metadata": {"annotations": {
+                    api.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+            elif verb == "start":
+                base.patch("Notebook", target, ns, {"metadata": {"annotations": {
+                    api.STOP_ANNOTATION: None,
+                    api.LAST_ACTIVITY_ANNOTATION: None}}})
+            elif verb == "bump_priority":
+                base.patch("Notebook", target, ns, {"metadata": {"annotations": {
+                    sched.PRIORITY_ANNOTATION: str((round_no % 3) * 5)}}})
+            elif verb == "delete_nb":
+                base.delete("Notebook", target, ns)
+            elif verb == "recreate_nb":
+                base.create(self._nb(target))
+            elif verb in ("revoke", "storm"):
+                spot = sorted(
+                    pool for pool in _live_pools(base)
+                    if any(
+                        ko.labels(n).get(sched.TIER_LABEL) == sched.TIER_SPOT
+                        for n in base.list("Node", None, {"matchLabels": {
+                            sched.POOL_LABEL: pool}})
+                    )
+                )
+                if not spot:
+                    return
+                grace = _REVOKE_GRACE_CHOICES[
+                    int(draw * len(_REVOKE_GRACE_CHOICES))
+                    % len(_REVOKE_GRACE_CHOICES)
+                ]
+                targets = (
+                    spot if verb == "storm"
+                    else [spot[int(draw * len(spot)) % len(spot)]]
+                )
+                for pool in targets:
+                    provider.revoke(pool, grace_s=grace)
+        except (NotFound, AlreadyExists, Conflict):
+            pass  # op raced a controller write; a later round retries
+
+    def make_fetcher(self) -> Callable:
+        busy = set(self.busy)
+
+        def fetch(namespace: str, name: str):
+            if name in busy:
+                return [{"execution_state": "busy"}]
+            return []
+
+        return fetch
+
+
+# -------------------------------------------------------------------- runner
+
+
+class _Clock:
+    def __init__(self, start: float) -> None:
+        self.t = start
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+@dataclasses.dataclass
+class CapacitySeedResult:
+    seed: int
+    violations: list[str]
+    quiesced: bool
+    restarts: int
+    scale_ups: int
+    scale_downs: int
+    revocations: int
+    first_chips: int
+    fault_counts: collections.Counter
+    provider_faults: dict
+
+    @property
+    def ok(self) -> bool:
+        return self.quiesced and not self.violations
+
+    def describe(self) -> str:
+        if self.ok:
+            faults = sum(self.fault_counts.values())
+            pfaults = sum(self.provider_faults.values())
+            return (
+                f"seed {self.seed}: converged ({self.scale_ups} scale-ups, "
+                f"{self.scale_downs} scale-downs, {self.revocations} "
+                f"revocations, {self.first_chips} first-chips, {faults} API "
+                f"faults, {pfaults} provider faults, {self.restarts} "
+                f"restarts)"
+            )
+        lines = [f"seed {self.seed}: FAILED "
+                 f"(repro: python tools/capacity_soak.py --seed {self.seed})"]
+        if not self.quiesced:
+            lines.append("  state never quiesced after faults healed")
+        lines += [f"  invariant: {v}" for v in self.violations[:10]]
+        if len(self.violations) > 10:
+            lines.append(f"  ... {len(self.violations) - 10} more")
+        return "\n".join(lines)
+
+
+def run_capacity_seed(
+    seed: int,
+    faults: ChaosConfig | None = None,
+    *,
+    max_restarts_per_tick: int = 6,
+    lost_update_audit: bool = True,
+    explain_audit: bool = True,
+    ledger_audit: bool = True,
+) -> CapacitySeedResult:
+    """One seeded soak run: hostile timeline under API + provider chaos,
+    heal, settle past every deadline and the hysteresis dwell, quiesce,
+    then the full audit stack. ``faults=None`` runs the same timeline with
+    both chaos sources quiet (targeted-test baseline)."""
+    scenario = CapacityScenario(seed)
+    base = FakeCluster()
+    tpu_env.install(base)
+    chaos = (
+        ChaosCluster(
+            base, seed=seed, config=faults, lost_update_audit=lost_update_audit
+        )
+        if faults is not None
+        else None
+    )
+    cluster = chaos if chaos is not None else base
+    clock = _Clock(1_000_000.0)
+    cfg = ControllerConfig(
+        scheduler_enabled=True,
+        sessions_enabled=True,
+        suspend_deadline_s=SOAK_SUSPEND_DEADLINE_S,
+    )
+    culler = Culler(
+        enabled=scenario.culling,
+        cull_idle_minutes=1.0,
+        check_period_minutes=0.5,
+        fetch_kernels=scenario.make_fetcher(),
+        clock=clock,
+    )
+    # the provider is infrastructure: its API surface faults toward the
+    # autoscaler (seeded ProviderChaos), its metal moves on the unfaulted
+    # base — the same split as scenario ops vs controller verbs
+    provider = FakeCloudProvider(
+        base,
+        clock=clock,
+        seed=seed,
+        chaos=ProviderChaos() if faults is not None else None,
+        provision_delay_s=SOAK_PROVISION_DELAY_S,
+    )
+    objects = FakeObjectStore(
+        seed=seed,
+        chaos=StoreChaosConfig() if faults is not None else None,
+    )
+    sched_metrics = SchedulerMetrics()
+    session_metrics = SessionMetrics(sched_metrics.registry)
+    cap_metrics = CapacityMetrics(sched_metrics.registry)
+    store = SnapshotStore(
+        objects, metrics=session_metrics, clock=clock,
+        pin_ttl_s=4 * SOAK_SUSPEND_DEADLINE_S,
+    )
+    agent = FakeSessionAgent(base)
+    tracer = Tracer(clock=clock)
+    slo = SLOMetrics(clock=clock)
+    ledger = FleetEfficiencyLedger(base, clock=clock, interval_s=1.0)
+    sched_diff_failures: list[str] = []
+    autoscaler_ref: list[CapacityReconciler] = []
+
+    def build() -> Manager:
+        m = Manager(cluster, clock=clock, tracer=tracer)
+        m.register(
+            NotebookReconciler(
+                cfg, culler=culler, recorder=EventRecorder(clock=clock),
+                timeline=TimelineRecorder(slo=slo, clock=clock),
+            )
+        )
+        sched_rec = SchedulerReconciler(
+            metrics=sched_metrics,
+            recorder=EventRecorder(clock=clock),
+            clock=clock,
+            aging_interval_s=SOAK_AGING_INTERVAL_S,
+            suspend_deadline_s=SOAK_SUSPEND_DEADLINE_S,
+            differential_audit=True,
+        )
+        sched_rec.audit_failures = sched_diff_failures
+        m.register(sched_rec)
+        m.register(
+            SessionReconciler(
+                store, agent,
+                config=cfg,
+                metrics=session_metrics,
+                recorder=EventRecorder(clock=clock),
+                clock=clock,
+            )
+        )
+        # a crash-restart loses the autoscaler's in-memory state (open
+        # requests, idle dwells) — a fresh instance models exactly that;
+        # metrics are the observer that outlives incarnations
+        autoscaler = CapacityReconciler(
+            provider,
+            metrics=cap_metrics,
+            recorder=EventRecorder(clock=clock),
+            clock=clock,
+            pending_grace_s=SOAK_PENDING_GRACE_S,
+            hysteresis_s=SOAK_HYSTERESIS_S,
+            suspend_deadline_s=SOAK_SUSPEND_DEADLINE_S,
+        )
+        autoscaler_ref[:] = [autoscaler]
+        m.register(autoscaler)
+        return m
+
+    scenario.setup(base)
+    mgr = build()
+    auditor = CapacityAuditor(store, agent)
+    violations: list[str] = []
+    restarts = 0
+
+    def tick() -> None:
+        nonlocal mgr, restarts
+        for _ in range(max_restarts_per_tick):
+            crashed = False
+            try:
+                mgr.tick()
+            except Exception:
+                crashed = True
+            if chaos is not None and chaos.take_crash():
+                crashed = True
+            if not crashed:
+                return
+            restarts += 1
+            mgr.shutdown()
+            mgr = build()
+
+    def drive(where: str, *, sub_ticks: int = 3, dt: float = 10.0) -> None:
+        for s in range(sub_ticks):
+            cluster.step_kubelet()
+            provider.step()  # the cloud moves its metal, unfaulted
+            agent.tick()
+            if chaos is not None:
+                chaos.tick_watches()
+            ledger.tick(force=True)
+            tick()
+            if chaos is not None:
+                lat = chaos.take_latency()
+                if lat:
+                    clock.advance(lat)
+            sub_where = f"{where}.{s}"
+            violations.extend(
+                audit_placements(base, strict=False, where=sub_where)
+            )
+            violations.extend(auditor.observe(base, clock(), sub_where))
+            violations.extend(
+                check_invariants(
+                    base, mgr,
+                    max_requeue_s=SOAK_MAX_REQUEUE_S,
+                    where=sub_where,
+                )
+            )
+        clock.advance(dt)
+
+    for r, ops in enumerate(scenario.rounds):
+        for op in ops:
+            scenario.apply(base, provider, op, r)
+        drive(f"round {r}")
+
+    if chaos is not None:
+        chaos.heal()
+    provider.heal()
+    objects.heal()
+
+    # settle past the suspend deadline (60 s), cull threshold (60 s),
+    # backoff cap (64 s), provisioning delay (25 s), and the scale-down
+    # hysteresis dwell (90 s) — twice over, so reclaimed pools are gone
+    for s in range(8):
+        drive(f"settle {s}", sub_ticks=2, dt=45.0)
+
+    prev = None
+    quiesced = False
+    for s in range(24):
+        cluster.step_kubelet()
+        provider.step()
+        agent.tick()
+        ledger.tick(force=True)
+        tick()
+        violations.extend(auditor.observe(base, clock(), f"quiesce {s}"))
+        fp = fingerprint(base)
+        if fp == prev:
+            quiesced = True
+            break
+        prev = fp
+        clock.advance(65.0)
+    violations.extend(
+        check_invariants(
+            base, mgr,
+            max_requeue_s=SOAK_MAX_REQUEUE_S,
+            where="final", final=True,
+        )
+    )
+    violations.extend(audit_placements(base, strict=True, where="final"))
+    violations.extend(
+        audit_fixed_point(
+            base, clock(), aging_interval_s=SOAK_AGING_INTERVAL_S
+        )
+    )
+    violations.extend(
+        audit_sessions_fixed_point(base, store, agent, clock())
+    )
+    violations.extend(audit_chunk_store(store))
+    violations.extend(
+        audit_capacity_fixed_point(
+            base, autoscaler_ref[0], auditor, provider, clock(),
+            max_pools_per_family=autoscaler_ref[0].max_pools_per_family,
+        )
+    )
+    if explain_audit:
+        violations.extend(explain_mod.audit_explanations(base, where="final"))
+    if ledger_audit:
+        # conservation across pool BIRTH and DEATH: the one soak where the
+        # capacity integral's right-hand side itself churns mid-window
+        violations.extend(ledger.audit(where="final"))
+    violations.extend(sched_diff_failures)
+    violations.extend(tracer.audit())
+    violations.extend(audit_events(base, where="final"))
+    violations.extend(audit_timeline(base, where="final"))
+    if chaos is not None:
+        violations.extend(chaos.lost_update_findings)
+    return CapacitySeedResult(
+        seed=seed,
+        violations=violations,
+        quiesced=quiesced,
+        restarts=restarts,
+        scale_ups=int(sum(
+            s["value"] for s in cap_metrics.scale_ups.samples()
+        )),
+        scale_downs=int(sum(
+            s["value"] for s in cap_metrics.scale_downs.samples()
+        )),
+        revocations=int(sum(
+            s["value"] for s in cap_metrics.revocations.samples()
+        )),
+        first_chips=cap_metrics.time_to_first_chip.count(),
+        fault_counts=(
+            chaos.fault_counts if chaos is not None else collections.Counter()
+        ),
+        provider_faults=dict(provider.fault_counts),
+    )

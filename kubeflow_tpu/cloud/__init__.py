@@ -1,1 +1,131 @@
-"""Cloud IAM clients (plain REST, no SDKs — matching the repo's stance)."""
+"""Cloud clients (plain REST, no SDKs — matching the repo's stance).
+
+Shared HTTP discipline for every adapter in this package (the IAM clients
+and the elastic-capacity node-pool providers): one logical request is a
+bounded transient-retry loop with jittered exponential backoff, Retry-After
+honored exactly on throttle statuses, and a typed :class:`RetriesExhausted`
+when the deadline elapses — the same contract ``runtime/kubeclient.py``
+speaks to the API server, so a reconciler can tell a flaky cloud API from a
+dead one without parsing messages. Semantic answers (404/409/412) and caller
+bugs (403/422) are never retried; the caller owns them.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable
+
+# transient statuses worth retrying inside one logical request; everything
+# else is either a semantic answer (404/409/412) or a caller bug (403/422)
+RETRYABLE_STATUSES = (429, 500, 502, 503, 504)
+
+
+class CloudError(Exception):
+    """Base for typed cloud-adapter failures (carries the HTTP status when
+    one was received; None for connection-level failures)."""
+
+    def __init__(self, message: str, status: int | None = None) -> None:
+        self.status = status
+        super().__init__(message)
+
+
+class RetriesExhausted(CloudError):
+    """A cloud request kept failing transiently past the retry deadline.
+
+    Carries ``attempts`` and ``last_status`` (None when the final failure
+    was a connection error) — the ``kubeclient.RetriesExhausted`` contract
+    at the cloud boundary.
+    """
+
+    def __init__(
+        self, what: str, attempts: int, last_status: int | None
+    ) -> None:
+        self.attempts = attempts
+        self.last_status = last_status
+        super().__init__(
+            f"{what}: {attempts} attempts failed, last status {last_status}",
+            status=last_status,
+        )
+
+
+def _pause(backoff: float) -> None:
+    """Full-jitter backoff sleep; module-level seam so tests can observe the
+    sequence of backoff values without real sleeping."""
+    time.sleep(random.uniform(0, backoff))
+
+
+def _sleep(seconds: float) -> None:
+    """Exact sleep (Retry-After honoring); separate seam from the jittered
+    ``_pause`` so tests can distinguish the two."""
+    time.sleep(seconds)
+
+
+def _retry_after_seconds(resp) -> float | None:
+    """Parse a Retry-After header (seconds form only; HTTP-date is rare
+    from cloud APIs and not worth a date parser here)."""
+    headers = getattr(resp, "headers", None) or {}
+    value = headers.get("Retry-After")
+    if value is None:
+        return None
+    try:
+        return max(0.0, float(value))
+    except (TypeError, ValueError):
+        return None
+
+
+def ensure_ok(resp, what: str):
+    """Adapter-boundary status check for the capacity providers: any
+    non-2xx that survived the retry loop (a semantic answer the caller did
+    not special-case — 403 quota, 401 expired token) surfaces as the typed
+    :class:`CloudError` the autoscaler catches, never a raw HTTP exception
+    that would abort its whole reconcile cycle. The IAM clients keep their
+    requests-native raise_for_status: their callers (profile plugins)
+    handle HTTPError and own the etag-conflict semantics."""
+    status = getattr(resp, "status_code", None)
+    if status is not None and status >= 400:
+        raise CloudError(f"{what}: HTTP {status}", status=status)
+    return resp
+
+
+def request_with_retries(
+    send: Callable[[], object],
+    *,
+    what: str,
+    deadline_s: float = 15.0,
+    backoff_base: float = 0.2,
+):
+    """One logical cloud request = bounded transient-retry loop.
+
+    ``send()`` performs one HTTP attempt and returns a requests-style
+    Response. 429/5xx and connection resets retry with jittered exponential
+    backoff (Retry-After honored exactly when present) until ``deadline_s``
+    of wall time has elapsed, then surface as :class:`RetriesExhausted`.
+    Any other response — success or a semantic status the caller handles
+    (404, the IAM etag 409/412 dance) — is returned as-is, exactly once.
+    """
+    deadline = time.monotonic() + deadline_s
+    backoff = backoff_base
+    attempts = 0
+    last_status: int | None = None
+    while True:
+        attempts += 1
+        try:
+            resp = send()
+        except OSError:
+            resp = None  # connection-level failure: transient by definition
+        if resp is not None:
+            status = getattr(resp, "status_code", None)
+            if status not in RETRYABLE_STATUSES:
+                return resp
+            last_status = status
+        if time.monotonic() >= deadline:
+            raise RetriesExhausted(what, attempts, last_status)
+        retry_after = (
+            _retry_after_seconds(resp) if resp is not None else None
+        )
+        if retry_after is not None:
+            # hostile/buggy Retry-After cannot stretch the budget
+            _sleep(min(retry_after, max(0.0, deadline - time.monotonic())))
+        else:
+            _pause(min(backoff, max(0.0, deadline - time.monotonic())))
+            backoff = min(backoff * 2, 5.0)

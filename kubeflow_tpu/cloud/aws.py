@@ -10,6 +10,14 @@ algorithm — HMAC chain over date/region/service).
 
 Credentials come from the standard env variables (or are injected for
 tests); region is irrelevant for IAM (global, us-east-1 signing scope).
+
+Every Query-API call runs through the package's shared bounded-retry
+discipline (``cloud.request_with_retries``): throttles (429, which the IAM
+API also spells as 503 ``Throttling``) and 5xx retry with jittered backoff
+and Retry-After honored, then surface as the typed ``cloud.RetriesExhausted``
+— the ``kubeclient.py`` contract. Each attempt is re-signed: SigV4 binds the
+signature to ``x-amz-date``, so replaying a stale signature past the clock
+skew window would be rejected anyway.
 """
 from __future__ import annotations
 
@@ -19,6 +27,9 @@ import hmac
 import json
 import os
 import urllib.parse
+
+from kubeflow_tpu.cloud import ensure_ok as _ensure_ok
+from kubeflow_tpu.cloud import request_with_retries
 
 try:
     import requests
@@ -44,6 +55,7 @@ def sign_v4(
     region: str = "us-east-1",
     service: str = "iam",
     now: datetime.datetime | None = None,
+    content_type: str = "application/x-www-form-urlencoded; charset=utf-8",
 ) -> dict:
     """AWS Signature Version 4 headers for a request (documented algorithm)."""
     now = now or datetime.datetime.now(datetime.timezone.utc)
@@ -56,7 +68,7 @@ def sign_v4(
     headers = {
         "host": host,
         "x-amz-date": amz_date,
-        "content-type": "application/x-www-form-urlencoded; charset=utf-8",
+        "content-type": content_type,
     }
     if session_token:
         headers["x-amz-security-token"] = session_token
@@ -116,7 +128,9 @@ class AwsIamClient:
         secret_key: str | None = None,
         session_token: str | None = None,
         endpoint: str = IAM_ENDPOINT,
+        retry_deadline_s: float = 15.0,
     ) -> None:
+        self.retry_deadline_s = retry_deadline_s
         self.oidc_provider_arn = oidc_provider_arn or os.environ.get(
             "AWS_OIDC_PROVIDER_ARN", ""
         )
@@ -136,17 +150,24 @@ class AwsIamClient:
         body = urllib.parse.urlencode(
             {"Action": action, "Version": API_VERSION, **params}
         )
-        headers = sign_v4(
-            method="POST",
-            url=self.endpoint,
-            body=body,
-            access_key=self.access_key,
-            secret_key=self.secret_key,
-            session_token=self.session_token,
-        )
-        headers["Accept"] = "application/json"
-        resp = self.session.post(
-            self.endpoint, data=body, headers=headers, timeout=30
+
+        def send():
+            # re-sign per attempt: SigV4 binds the signature to x-amz-date
+            headers = sign_v4(
+                method="POST",
+                url=self.endpoint,
+                body=body,
+                access_key=self.access_key,
+                secret_key=self.secret_key,
+                session_token=self.session_token,
+            )
+            headers["Accept"] = "application/json"
+            return self.session.post(
+                self.endpoint, data=body, headers=headers, timeout=30
+            )
+
+        resp = request_with_retries(
+            send, what=f"iam:{action}", deadline_s=self.retry_deadline_s
         )
         resp.raise_for_status()
         return resp.json() if resp.content else {}
@@ -213,3 +234,165 @@ class AwsIamClient:
             return  # idempotent
         policy["Statement"] = remaining
         self._update_trust_policy(name, policy)
+
+
+class EksNodeGroupProvider:
+    """``capacity.provider.CloudProvider`` over the EKS managed-node-group
+    REST API — the real adapter behind the elastic-capacity autoscaler on
+    EKS.
+
+    One pool spec maps to one managed node group whose labels carry the
+    platform's pool/tier/autoscaled markers (``Fleet.from_nodes`` keys on
+    them once the nodes join) and whose ``capacityType`` selects the SPOT
+    tier. Calls are SigV4-signed JSON requests through the package's
+    bounded-retry discipline; a budget spent surfaces as the typed
+    ``cloud.RetriesExhausted``. EKS interruption notices arrive per-instance
+    through the node termination handler, so :meth:`revocations` reports
+    nothing here — the notice-to-suspend translation belongs to the
+    capacity reconciler.
+    """
+
+    def __init__(
+        self,
+        cluster: str,
+        *,
+        region: str | None = None,
+        session=None,
+        access_key: str | None = None,
+        secret_key: str | None = None,
+        session_token: str | None = None,
+        endpoint: str | None = None,
+        retry_deadline_s: float = 15.0,
+        instance_type: str = "trn1.32xlarge",
+        node_role_arn: str = "",
+        subnets: tuple[str, ...] = (),
+    ) -> None:
+        self.cluster = cluster
+        self.region = region or os.environ.get("AWS_REGION", "us-east-1")
+        self.session = session or requests.Session()
+        self.access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
+        self.secret_key = secret_key or os.environ.get(
+            "AWS_SECRET_ACCESS_KEY", ""
+        )
+        self.session_token = session_token or os.environ.get(
+            "AWS_SESSION_TOKEN"
+        )
+        self.endpoint = (
+            endpoint or f"https://eks.{self.region}.amazonaws.com"
+        ).rstrip("/")
+        self.retry_deadline_s = retry_deadline_s
+        self.instance_type = instance_type
+        self.node_role_arn = node_role_arn
+        self.subnets = tuple(subnets)
+
+    # ------------------------------------------------------------------ http
+
+    def _request(self, method: str, path: str, body: dict | None = None):
+        payload = json.dumps(body) if body is not None else ""
+        url = f"{self.endpoint}{path}"
+
+        def send():
+            # re-sign per attempt: SigV4 binds the signature to x-amz-date
+            headers = sign_v4(
+                method=method,
+                url=url,
+                body=payload,
+                access_key=self.access_key,
+                secret_key=self.secret_key,
+                session_token=self.session_token,
+                region=self.region,
+                service="eks",
+                content_type="application/json",
+            )
+            headers["Accept"] = "application/json"
+            return self.session.request(
+                method, url, data=payload or None, headers=headers,
+                timeout=30,
+            )
+
+        return request_with_retries(
+            send, what=f"{method} {path}", deadline_s=self.retry_deadline_s
+        )
+
+    # ------------------------------------------------------------- provider
+
+    def scale_up(self, spec) -> bool:
+        from kubeflow_tpu import scheduler as sched
+        from kubeflow_tpu.tpu.topology import ACCELERATORS, parse_topology
+
+        topo = parse_topology(spec.accelerator, spec.topology)
+        accel = ACCELERATORS[spec.accelerator]
+        body = {
+            "nodegroupName": spec.name,
+            "capacityType": (
+                "SPOT" if spec.tier == sched.TIER_SPOT else "ON_DEMAND"
+            ),
+            "instanceTypes": [self.instance_type],
+            "scalingConfig": {
+                "minSize": topo.num_hosts,
+                "maxSize": topo.num_hosts,
+                "desiredSize": topo.num_hosts,
+            },
+            "labels": {
+                "cloud.google.com/gke-tpu-accelerator": accel.gke_accelerator,
+                "cloud.google.com/gke-tpu-topology": spec.topology,
+                sched.POOL_LABEL: spec.name,
+                sched.TIER_LABEL: spec.tier,
+                sched.AUTOSCALED_LABEL: "true",
+            },
+            "nodeRole": self.node_role_arn,
+            "subnets": list(self.subnets),
+        }
+        resp = self._request(
+            "POST", f"/clusters/{self.cluster}/node-groups", body
+        )
+        if resp.status_code == 409:
+            return False  # ResourceInUse: already exists — idempotent
+        _ensure_ok(resp, "CreateNodegroup")
+        return True
+
+    def scale_down(self, pool: str) -> bool:
+        resp = self._request(
+            "DELETE", f"/clusters/{self.cluster}/node-groups/{pool}"
+        )
+        if resp.status_code == 404:
+            return False  # already gone: idempotent
+        _ensure_ok(resp, "DeleteNodegroup")
+        return True
+
+    def pending(self) -> dict:
+        from kubeflow_tpu import scheduler as sched
+        from kubeflow_tpu.capacity.provider import PoolSpec
+        from kubeflow_tpu.tpu.topology import accelerator_for_gke_label
+
+        resp = self._request("GET", f"/clusters/{self.cluster}/node-groups")
+        _ensure_ok(resp, "ListNodegroups")
+        out: dict = {}
+        for name in resp.json().get("nodegroups", []) or []:
+            detail = self._request(
+                "GET", f"/clusters/{self.cluster}/node-groups/{name}"
+            )
+            if detail.status_code == 404:
+                continue  # deleted between the list and the get
+            _ensure_ok(detail, "DescribeNodegroup")
+            ng = detail.json().get("nodegroup") or {}
+            if ng.get("status") not in ("CREATING", "UPDATING"):
+                continue
+            labels = ng.get("labels") or {}
+            if labels.get(sched.AUTOSCALED_LABEL) != "true":
+                continue
+            gke_accel = labels.get("cloud.google.com/gke-tpu-accelerator")
+            accel = accelerator_for_gke_label(gke_accel or "")
+            topology = labels.get("cloud.google.com/gke-tpu-topology")
+            if accel is None or not topology:
+                continue
+            out[name] = PoolSpec(
+                name=name,
+                accelerator=accel.name,
+                topology=topology,
+                tier=labels.get(sched.TIER_LABEL, sched.TIER_ON_DEMAND),
+            )
+        return out
+
+    def revocations(self, now: float) -> list:
+        return []  # EKS notices are per-instance, via the node handler

@@ -12,6 +12,13 @@ setIamPolicy is guarded by the policy ``etag``: a concurrent modification
 makes the write fail (409/412), and the client re-reads and retries — the
 same optimistic-concurrency dance the controllers speak to the K8s API.
 
+Every HTTP call runs through the package's shared bounded-retry discipline
+(``cloud.request_with_retries``): 429/5xx and connection resets retry with
+jittered backoff and Retry-After honored, then surface as the typed
+``cloud.RetriesExhausted`` — the ``kubeclient.py`` contract, so a single
+Google-side brownout can neither wedge a reconcile on one raw request nor
+spin it unboundedly.
+
 Auth: a bearer token from the injectable ``token_provider``; the default
 asks the GCE/GKE metadata server (the in-cluster ambient identity — no key
 files, which is the entire point of Workload Identity).
@@ -21,12 +28,16 @@ from __future__ import annotations
 import time
 from typing import Callable
 
+from kubeflow_tpu.cloud import ensure_ok as _ensure_ok
+from kubeflow_tpu.cloud import request_with_retries
+
 try:
     import requests
 except ImportError:  # pragma: no cover
     requests = None
 
 IAM_BASE = "https://iam.googleapis.com/v1"
+GKE_BASE = "https://container.googleapis.com/v1"
 METADATA_TOKEN_URL = (
     "http://metadata.google.internal/computeMetadata/v1/"
     "instance/service-accounts/default/token"
@@ -68,20 +79,28 @@ class GcpIamClient:
         token_provider: Callable[[], str] | None = None,
         base_url: str = IAM_BASE,
         max_retries: int = 4,
+        retry_deadline_s: float = 15.0,
     ) -> None:
         self.session = session or requests.Session()
         self.token = token_provider or metadata_token_provider(self.session)
         self.base_url = base_url.rstrip("/")
+        # etag-conflict retries (the optimistic-concurrency dance), distinct
+        # from the transient-HTTP retry budget below
         self.max_retries = max_retries
+        self.retry_deadline_s = retry_deadline_s
 
     # ------------------------------------------------------------------ http
 
     def _post(self, path: str, body: dict) -> requests.Response:
-        return self.session.post(
-            f"{self.base_url}{path}",
-            json=body,
-            headers={"Authorization": f"Bearer {self.token()}"},
-            timeout=30,
+        return request_with_retries(
+            lambda: self.session.post(
+                f"{self.base_url}{path}",
+                json=body,
+                headers={"Authorization": f"Bearer {self.token()}"},
+                timeout=30,
+            ),
+            what=f"POST {path}",
+            deadline_s=self.retry_deadline_s,
         )
 
     def _get_policy(self, email: str) -> dict:
@@ -134,3 +153,130 @@ class GcpIamClient:
             f"setIamPolicy on {email} kept conflicting after "
             f"{self.max_retries} retries"
         )
+
+
+class GkeNodePoolProvider:
+    """``capacity.provider.CloudProvider`` over the GKE node-pools REST API
+    (container.googleapis.com v1) — the real adapter behind the elastic-
+    capacity autoscaler on GKE.
+
+    One pool spec maps to one TPU slice node pool: the documented
+    ``placementPolicy.tpuTopology`` carves the slice, ``config.labels``
+    carry the platform's pool/tier/autoscaled markers so the fleet model
+    and scale-down recognize the pool without any side store, and
+    ``spot: true`` requests the preemptible tier. Every call rides the
+    package's bounded-retry discipline; a budget spent surfaces as the
+    typed ``cloud.RetriesExhausted`` the autoscaler backs off on.
+
+    GKE serves spot reclamation per-VM (a 30 s ACPI notice), not per pool,
+    so :meth:`revocations` reports nothing here — on GKE the notice arrives
+    through the node object's taints and the in-cluster termination
+    handler; the notice-to-suspend translation is the capacity
+    reconciler's, not this adapter's.
+    """
+
+    def __init__(
+        self,
+        project: str,
+        location: str,
+        cluster: str,
+        *,
+        session=None,
+        token_provider: Callable[[], str] | None = None,
+        base_url: str = GKE_BASE,
+        retry_deadline_s: float = 15.0,
+        machine_type: str = "ct4p-hightpu-4t",
+    ) -> None:
+        self.session = session or requests.Session()
+        self.token = token_provider or metadata_token_provider(self.session)
+        self.base = (
+            f"{base_url.rstrip('/')}/projects/{project}/locations/{location}"
+            f"/clusters/{cluster}"
+        )
+        self.retry_deadline_s = retry_deadline_s
+        self.machine_type = machine_type
+
+    # ------------------------------------------------------------------ http
+
+    def _request(self, method: str, path: str, body: dict | None = None):
+        return request_with_retries(
+            lambda: self.session.request(
+                method,
+                f"{self.base}{path}",
+                json=body,
+                headers={"Authorization": f"Bearer {self.token()}"},
+                timeout=30,
+            ),
+            what=f"{method} {path}",
+            deadline_s=self.retry_deadline_s,
+        )
+
+    # ------------------------------------------------------------- provider
+
+    def scale_up(self, spec) -> bool:
+        from kubeflow_tpu import scheduler as sched
+        from kubeflow_tpu.tpu.topology import ACCELERATORS, parse_topology
+
+        topo = parse_topology(spec.accelerator, spec.topology)
+        accel = ACCELERATORS[spec.accelerator]
+        body = {
+            "nodePool": {
+                "name": spec.name,
+                "initialNodeCount": topo.num_hosts,
+                "config": {
+                    "machineType": self.machine_type,
+                    "spot": spec.tier == sched.TIER_SPOT,
+                    "labels": {
+                        "cloud.google.com/gke-tpu-accelerator":
+                            accel.gke_accelerator,
+                        "cloud.google.com/gke-tpu-topology": spec.topology,
+                        sched.TIER_LABEL: spec.tier,
+                        sched.AUTOSCALED_LABEL: "true",
+                    },
+                },
+                "placementPolicy": {"tpuTopology": spec.topology},
+            }
+        }
+        resp = self._request("POST", "/nodePools", body)
+        if resp.status_code == 409:
+            return False  # already exists / already provisioning: idempotent
+        _ensure_ok(resp, "POST /nodePools")
+        return True
+
+    def scale_down(self, pool: str) -> bool:
+        resp = self._request("DELETE", f"/nodePools/{pool}")
+        if resp.status_code == 404:
+            return False  # already gone: idempotent
+        _ensure_ok(resp, f"DELETE /nodePools/{pool}")
+        return True
+
+    def pending(self) -> dict:
+        from kubeflow_tpu import scheduler as sched
+        from kubeflow_tpu.capacity.provider import PoolSpec
+        from kubeflow_tpu.tpu.topology import accelerator_for_gke_label
+
+        resp = self._request("GET", "/nodePools")
+        _ensure_ok(resp, "GET /nodePools")
+        out: dict = {}
+        for pool in resp.json().get("nodePools", []) or []:
+            if pool.get("status") not in ("PROVISIONING", "RECONCILING"):
+                continue
+            cfg = pool.get("config") or {}
+            labels = cfg.get("labels") or {}
+            if labels.get(sched.AUTOSCALED_LABEL) != "true":
+                continue  # operator-made pools are not the autoscaler's
+            gke_accel = labels.get("cloud.google.com/gke-tpu-accelerator")
+            accel = accelerator_for_gke_label(gke_accel or "")
+            topology = labels.get("cloud.google.com/gke-tpu-topology")
+            if accel is None or not topology:
+                continue
+            out[pool["name"]] = PoolSpec(
+                name=pool["name"],
+                accelerator=accel.name,
+                topology=topology,
+                tier=labels.get(sched.TIER_LABEL, sched.TIER_ON_DEMAND),
+            )
+        return out
+
+    def revocations(self, now: float) -> list:
+        return []  # GKE notices are per-VM, surfaced via node taints

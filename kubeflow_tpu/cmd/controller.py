@@ -314,6 +314,42 @@ def build_manager(
                 recorder=EventRecorder(),
             )
         )
+    if "capacity" not in shared:
+        capacity = None
+        # elastic capacity (kubeflow_tpu/capacity/): ONE autoscaler per
+        # FLEET, like the ledger — its cycle reads the whole cluster and
+        # talks to one cloud account, so in the one-process-per-shard
+        # layout only shard 0's process runs it
+        if cfg.capacity_enabled and (router is None or shard_id == 0):
+            provider = _capacity_provider(cluster)
+            if provider is None:
+                log.warning(
+                    "CAPACITY_ENABLED with no usable provider "
+                    "(set CAPACITY_PROVIDER=fake|gke|eks); skipping"
+                )
+            else:
+                from kubeflow_tpu.capacity.autoscaler import CapacityReconciler
+                from kubeflow_tpu.utils.metrics import CapacityMetrics
+
+                capacity = CapacityReconciler(
+                    provider,
+                    metrics=CapacityMetrics(
+                        metrics.registry,
+                        first_chip_target_s=cfg.first_chip_target_s,
+                    ),
+                    recorder=EventRecorder(),
+                    pending_grace_s=cfg.capacity_pending_grace_s,
+                    hysteresis_s=cfg.capacity_hysteresis_s,
+                    max_pools_per_family=cfg.capacity_max_pools_per_family,
+                    spot=cfg.capacity_spot,
+                    suspend_deadline_s=cfg.suspend_deadline_s,
+                )
+                manager.register(capacity)
+        shared["capacity"] = capacity
+    else:
+        capacity = shared["capacity"]
+    # every shard's ops surface (and the webapps) reads the one autoscaler
+    manager.capacity = capacity
     if cfg.enable_oauth_controller:
         # OpenShift companion (ref odh-notebook-controller): the openshift
         # overlay's ENABLE_OAUTH_CONTROLLER env was dead until this wired it
@@ -321,6 +357,37 @@ def build_manager(
 
         manager.register(OAuthReconciler())
     return manager, metrics
+
+
+def _capacity_provider(cluster):
+    """Build the configured cloud provider. ``fake`` (the default against
+    an in-memory cluster) drives the deterministic FakeCloudProvider;
+    ``gke``/``eks`` build the hardened REST adapters from their env knobs.
+    None when nothing usable is configured — capacity then stays off."""
+    kind = os.environ.get("CAPACITY_PROVIDER", "").lower()
+    if not kind:
+        kind = "fake" if not hasattr(cluster, "session") else ""
+    if kind == "fake":
+        if hasattr(cluster, "session"):
+            return None  # the fake provider writes Nodes; in-memory only
+        from kubeflow_tpu.capacity.provider import FakeCloudProvider
+
+        return FakeCloudProvider(cluster, clock=time.time)
+    if kind == "gke":
+        from kubeflow_tpu.cloud.gcp import GkeNodePoolProvider
+
+        project = os.environ.get("GKE_PROJECT", "")
+        location = os.environ.get("GKE_LOCATION", "")
+        name = os.environ.get("GKE_CLUSTER", "")
+        if not (project and location and name):
+            return None
+        return GkeNodePoolProvider(project, location, name)
+    if kind == "eks":
+        from kubeflow_tpu.cloud.aws import EksNodeGroupProvider
+
+        name = os.environ.get("EKS_CLUSTER", "")
+        return EksNodeGroupProvider(name) if name else None
+    return None
 
 
 def build_managers(
@@ -475,6 +542,15 @@ def serve_ops(
             from kubeflow_tpu.obs.ledger import install_ledger_routes
 
             install_ledger_routes(probes, ledger)
+        # /debug/capacity: the autoscaler's open scale requests, revocation
+        # notices, and idle dwells — same cluster-internal surface
+        capacity = getattr(manager, "capacity", None) if manager else None
+        if capacity is not None:
+            from kubeflow_tpu.capacity.autoscaler import (
+                install_capacity_route,
+            )
+
+            install_capacity_route(probes, capacity)
         _spawn(probes, port)
     if metrics_port:
         if manager is not None:

@@ -82,6 +82,7 @@ def build_platform(
     # telemetry, and the webapps then serve its series
     telemetry = getattr(manager, "telemetry", None)
     ledger = getattr(manager, "ledger", None)
+    capacity = getattr(manager, "capacity", None)
     # ONE watch-backed read layer for every app (webapps/cache.py): each
     # create_app adds its kinds to the shared cache instead of building its
     # own, so one watch set feeds every serving surface
@@ -93,6 +94,7 @@ def build_platform(
             slo=getattr(manager, "slo", None),
             scheduler=getattr(manager, "scheduler_metrics", None),
             ledger=ledger,
+            capacity=capacity,
             cache=read_cache,
         ),
         {
@@ -103,6 +105,7 @@ def build_platform(
                 telemetry=telemetry,
                 timeline=getattr(manager, "timeline_builder", None),
                 ledger=ledger,
+                capacity=capacity,
                 cache=read_cache,
             ),
             "/volumes": volumes.create_app(
@@ -128,6 +131,10 @@ def build_platform(
 
     def tick() -> None:
         cluster.step_kubelet()
+        if capacity is not None and hasattr(capacity.provider, "step"):
+            # the demo's cloud: finish due provisioning / land revocation
+            # kills (infrastructure-side, like the fake kubelet above)
+            capacity.provider.step()
         manager.tick()
         if ledger is not None:
             # interval-gated, off the reconcile path (the controller

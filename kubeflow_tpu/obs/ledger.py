@@ -137,15 +137,16 @@ def classify_gang(evidence: Mapping) -> str:
     - ``running``       — the timeline's ``runningAt`` mark stamped for the
       current start generation.
 
-    Ranking (first match wins): a preemption handoff is ``suspending`` (the
-    PR 4 barrier window — chips held until the snapshot commits or the
-    force deadline); any other teardown in progress while chips are still
-    held (stop/cull suspend, a stopped gang awaiting scale-down, a barrier
-    already complete but not yet released) is ``draining``; a bound gang
-    that has not reached ``runningAt`` — first start or a resume restoring
-    its snapshot — is ``starting``; everything else is running and splits
-    busy/idle by duty cycle."""
-    if evidence.get("suspendReason") == sess.REASON_PREEMPTION:
+    Ranking (first match wins): a deadline-bearing handoff — a preemption
+    or a spot revocation (capacity/) — is ``suspending`` (the PR 4 barrier
+    window — chips held until the snapshot commits or the force deadline);
+    any other teardown in progress while chips are still held (stop/cull
+    suspend, a stopped gang awaiting scale-down, a barrier already complete
+    but not yet released) is ``draining``; a bound gang that has not
+    reached ``runningAt`` — first start or a resume restoring its snapshot
+    — is ``starting``; everything else is running and splits busy/idle by
+    duty cycle."""
+    if evidence.get("suspendReason") in sess.HANDOFF_REASONS:
         return BUCKET_SUSPENDING
     if (
         evidence.get("stopped")

@@ -110,10 +110,8 @@ def node_family(node: Mapping) -> str | None:
     gke = labels.get("cloud.google.com/gke-tpu-accelerator")
     if not gke:
         return None
-    for accel in ACCELERATORS.values():
-        if accel.gke_accelerator == gke:
-            return accel.name
-    return None
+    accel = accelerator_for_gke_label(gke)
+    return accel.name if accel is not None else None
 
 
 def notebook_family(nb: Mapping) -> str | None:

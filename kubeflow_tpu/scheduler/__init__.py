@@ -66,6 +66,23 @@ SCHEDULER_CONDITION_TYPES = (COND_QUEUED, COND_UNSCHEDULABLE, COND_PREEMPTED)
 POOL_LABEL = "cloud.google.com/gke-nodepool"
 HOST_INDEX_LABEL = "tpu.kubeflow.org/host-index"
 
+# Spot-revocation notice (written by the capacity reconciler when the cloud
+# provider serves notice on a pool; value = the kill deadline). A revoked
+# node is NOT cordoned — its pods must stay up through the suspend barrier —
+# but the fleet model refuses NEW binds into any pool carrying the mark, so
+# a revocation storm cannot keep re-binding fresh gangs into dying chips.
+REVOKED_ANNOTATION = "capacity.kubeflow.org/revoked"
+# Capacity tier of a node pool (capacity/): "spot" pools are the cheaper,
+# revocable tier the autoscaler prefers when allowed; absent or "on-demand"
+# is the durable tier. Stamped on Nodes by the provisioning provider.
+TIER_LABEL = "tpu.kubeflow.org/capacity-tier"
+TIER_SPOT = "spot"
+TIER_ON_DEMAND = "on-demand"
+# Nodes the autoscaler itself provisioned (stamped by the provider): the
+# only pools scale-down may ever delete — the platform never reclaims
+# capacity an operator created by hand.
+AUTOSCALED_LABEL = "tpu.kubeflow.org/autoscaled"
+
 
 def placement_of(nb: Mapping) -> dict | None:
     """Decode the bound placement from a Notebook CR, or None if unbound.

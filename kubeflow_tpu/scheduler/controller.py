@@ -309,6 +309,10 @@ class SchedulerReconciler(Reconciler):
         preempted_now: dict[str, str] = {}  # key -> human reason
         released: set[str] = set()  # suspend handoffs completed this cycle
         handoff_accels: set[str] = set()  # accels with a handoff in flight
+        # bound gangs whose deadline-bearing suspend (preemption handoff or
+        # spot revocation) is still in flight: preemption victim selection
+        # counts these STRICTLY first — their teardown is already paid for
+        suspending_bound: set[str] = set()
 
         # -- replay phase: placement diff against the persistent model ----
         # Desired-occupancy build runs in deterministic order (bind time
@@ -355,10 +359,8 @@ class SchedulerReconciler(Reconciler):
                 if self.suspend_deadline_s is not None
                 else None
             )
-            if (
-                request is not None
-                and request.get("reason") == sess.REASON_PREEMPTION
-            ):
+            req_reason = request.get("reason") if request is not None else None
+            if req_reason in sess.HANDOFF_REASONS:
                 if sess.suspend_complete(nb, now):
                     # the handoff's commit point: ONE write releases the
                     # placement and retires the spent request, so a crash on
@@ -368,20 +370,30 @@ class SchedulerReconciler(Reconciler):
                     # of the desired set, the diff releases its chips now.
                     self._release_suspended(cluster, nb)
                     if self.metrics is not None:
-                        # handoff hold time: how long the preemptor's chips
-                        # were gated on the victim's snapshot barrier
+                        # handoff hold time: how long the chips were gated
+                        # on the victim's snapshot barrier (preemptor-bound
+                        # chips, or a revoked pool's last grace seconds)
                         self.metrics.observe_handoff(
                             now - request["requestedAt"]
                         )
                     preempted_now[key] = (
                         "suspended for a higher-priority gang"
+                        if req_reason == sess.REASON_PREEMPTION
+                        else "suspended for a spot capacity revocation"
                     )
                     released.add(key)
                     continue
                 # barrier holds: the victim keeps its chips until the
                 # snapshot commits or the force deadline passes
                 barrier_pending = True
-                handoff_accels.add(topo.accelerator.name)
+                suspending_bound.add(key)
+                if req_reason == sess.REASON_PREEMPTION:
+                    # only a PREEMPTION handoff freezes backfill: a waiting
+                    # head is owed the victims' space. A revocation's space
+                    # is leaving the fleet (the capacity layer cordons it),
+                    # so freezing the family would stall unrelated binds
+                    # for chips nobody can inherit.
+                    handoff_accels.add(topo.accelerator.name)
             desired[key] = placement["slices"]
             replaying[key] = BoundGang(
                 key=key,
@@ -460,7 +472,7 @@ class SchedulerReconciler(Reconciler):
         # -- pack phase: the scheduling pass ------------------------------
         newly_bound, handoffs, pack_notes = self._schedule(
             cluster, fleet, queue, bound, preempted_now, now, nb_by_key,
-            deferred,
+            deferred, suspending_bound,
         )
         barrier_pending = barrier_pending or handoffs
         t_pack = self.clock()
@@ -821,6 +833,7 @@ class SchedulerReconciler(Reconciler):
         now: float,
         nb_by_key: dict[str, dict] | None = None,
         deferred: set[str] | None = None,
+        suspending: set[str] | None = None,
     ) -> tuple[set[str], bool, dict[str, dict]]:
         """Admission in effective-priority order; preemption for a blocked
         head, then hole-backfill of strictly smaller gangs behind it. Heads
@@ -984,7 +997,9 @@ class SchedulerReconciler(Reconciler):
             # reaches anyway. The trial runs on a clone with NO fit cache:
             # victim space is not free space, so cached "doesn't fit"
             # verdicts must never veto an eviction that would make it fit.
-            victims = preempt.select_victims(fleet, list(bound.values()), req)
+            victims = preempt.select_victims(
+                fleet, list(bound.values()), req, suspending=suspending
+            )
             if victims is not None:
                 if self.suspend_deadline_s is not None:
                     # suspend barrier: request a suspend on each victim

@@ -75,6 +75,10 @@ VERDICT_FRAGMENTED = "Fragmented"
 VERDICT_BLOCKED_HOSTS = "BlockedHosts"
 VERDICT_INSUFFICIENT_FREE = "InsufficientFree"
 VERDICT_SLICE_FITS = "SliceFits"
+# Spot revocation in flight (capacity/): the pool's chips are leaving, so
+# no free space there counts for anyone — ranked before every geometric
+# verdict, exactly as place_gang skips the pool before probing it.
+VERDICT_REVOKED = "PoolRevoked"
 
 # Preemption-trail phrasings (the `preemption.why` field).
 PREEMPT_NO_JUNIORS = "no strictly-junior victims"
@@ -158,6 +162,9 @@ def pool_verdict(pool: Pool, topo: SliceTopology) -> dict:
     fleet, so every field is a checkable claim, not prose.
 
     Verdict ranking (first match wins):
+      PoolRevoked      — a spot revocation notice stands on the pool: its
+                         free space is leaving and counts for nobody
+                         (mirrors place_gang skipping the pool outright);
       ShapeNeverFits   — no orientation fits the empty torus;
       SliceFits        — a slice fits right now (the gang failed elsewhere:
                          multislice spread, or this pool filled mid-trial);
@@ -175,6 +182,9 @@ def pool_verdict(pool: Pool, topo: SliceTopology) -> dict:
         * pool.chips_per_block,
         "fragmentationIndex": round(fragmentation_index(pool), 4),
     }
+    if pool.revoked:
+        out["verdict"] = VERDICT_REVOKED
+        return out
     need = min_block_cells(pool, topo)
     if need is None:
         out["verdict"] = VERDICT_SHAPE_NEVER_FITS
@@ -210,6 +220,9 @@ def would_fit_after_defrag(
     items branch on exactly this bit."""
     capacity = 0
     for pool in pools:
+        if pool.revoked:
+            # revoked free space cannot be defragged into: it is leaving
+            continue
         need = min_block_cells(pool, topo)
         if need is None:
             continue
@@ -263,9 +276,26 @@ def _gang_reason(
             REASON_SHAPE_NEVER_FITS,
             f"no {fam} node pool can hold {gang} in any orientation",
         )
-    free = sum(v["freeChips"] for v in pool_verdicts)
+    if all(
+        v["verdict"] in (VERDICT_SHAPE_NEVER_FITS, VERDICT_REVOKED)
+        for v in pool_verdicts
+    ):
+        return (
+            REASON_INSUFFICIENT,
+            f"every {fam} pool that could hold {gang} is under a spot "
+            f"revocation notice; waiting for replacement capacity",
+        )
+    # revoked pools' free chips are leaving the fleet: counting them in the
+    # exhausted/unusable arithmetic would contradict the verdicts above
+    free = sum(
+        v["freeChips"] for v in pool_verdicts
+        if v["verdict"] != VERDICT_REVOKED
+    )
     if wfad:
-        largest = max(v["largestFreeCuboidChips"] for v in pool_verdicts)
+        largest = max(
+            v["largestFreeCuboidChips"] for v in pool_verdicts
+            if v["verdict"] != VERDICT_REVOKED
+        )
         return (
             REASON_FRAGMENTED,
             f"{fam} capacity is fragmented: {free} chips are free (largest "

@@ -31,18 +31,34 @@ import math
 import re
 from typing import Iterable, Mapping, MutableMapping, Sequence
 
-from kubeflow_tpu.scheduler import HOST_INDEX_LABEL, POOL_LABEL
+from kubeflow_tpu.scheduler import (
+    HOST_INDEX_LABEL,
+    POOL_LABEL,
+    REVOKED_ANNOTATION,
+)
 from kubeflow_tpu.scheduler import binpack
 from kubeflow_tpu.scheduler.binpack import Cuboid, ceil_div_shape
 from kubeflow_tpu.tpu.topology import (
     ACCELERATORS,
     SliceTopology,
     TpuAccelerator,
+    accelerator_for_gke_label,
     parse_topology,
 )
 
 _TRAILING_ORDINAL = re.compile(r"-(\d+)$")
 _BLOCKED_PREFIX = "!node/"  # used-set keys for unavailable host cells
+
+
+def node_is_revoked(node: Mapping) -> bool:
+    """Spot revocation notice served on this node (capacity/): the node is
+    still Ready and its pods still run — cordoning it outright would evict
+    the gang mid-snapshot — but NEW gangs must not bind into a pool whose
+    chips are leaving. ``place_gang`` skips revoked pools; replay of
+    committed placements is untouched (existing gangs keep their chips
+    through the suspend barrier until release or the provider's kill)."""
+    anns = (node.get("metadata") or {}).get("annotations", {}) or {}
+    return REVOKED_ANNOTATION in anns
 
 
 def node_is_available(node: Mapping) -> bool:
@@ -84,6 +100,11 @@ class Pool:
         # label): the bind then must not be pinned via that label — no node
         # would match and the gang's pods would stay Pending forever.
         self.labeled = labeled
+        # Spot revocation in flight (any node carries REVOKED_ANNOTATION):
+        # NEW binds are refused (place_gang skips the pool) while committed
+        # placements keep replaying — pods stay up through the suspend
+        # barrier until release or the provider's kill.
+        self.revoked = False
         self.chip_shape = tuple(chip_shape)
         self.grid = ceil_div_shape(self.chip_shape, accel.host_block)
         self.num_hosts = math.prod(self.grid)
@@ -217,6 +238,7 @@ class Pool:
         out.name = self.name
         out.accel = self.accel
         out.labeled = self.labeled
+        out.revoked = self.revoked
         out.chip_shape = self.chip_shape
         out.grid = self.grid
         out.num_hosts = self.num_hosts
@@ -229,11 +251,12 @@ class Pool:
 
 
 # One TPU node flattened into the fields the pool model is a function of:
-# (accel name, topology label, labeled, host index, node name, available).
-# A pool's node-entry list IS its fingerprint — two node snapshots yielding
-# equal entry lists build equal pools, which is what lets FleetModel skip
-# rebuilding untouched pools.
-_NodeEntry = tuple[str, str, bool, int | None, str, bool]
+# (accel name, topology label, labeled, host index, node name, available,
+# revoked). A pool's node-entry list IS its fingerprint — two node snapshots
+# yielding equal entry lists build equal pools, which is what lets
+# FleetModel skip rebuilding untouched pools (and what makes a revocation
+# notice rebuild exactly the pool it marks).
+_NodeEntry = tuple[str, str, bool, int | None, str, bool, bool]
 
 
 def group_tpu_nodes(
@@ -249,11 +272,7 @@ def group_tpu_nodes(
         topology = labels.get("cloud.google.com/gke-tpu-topology")
         if not gke_accel or not topology:
             continue
-        accel = next(
-            (a for a in ACCELERATORS.values()
-             if a.gke_accelerator == gke_accel),
-            None,
-        )
+        accel = accelerator_for_gke_label(gke_accel)
         if accel is None:
             continue
         labeled = POOL_LABEL in labels
@@ -265,6 +284,7 @@ def group_tpu_nodes(
             _host_index(node),
             node.get("metadata", {}).get("name", ""),
             node_is_available(node),
+            node_is_revoked(node),
         ))
     return groups
 
@@ -274,7 +294,7 @@ def build_pool(name: str, entries: Sequence[_NodeEntry]) -> Pool | None:
     parses defines the torus (a mislabeled straggler cannot corrupt the
     whole pool); hosts without a backing node end up blocked."""
     pool: Pool | None = None
-    for accel_name, topology, labeled, idx, node_name, available in entries:
+    for accel_name, topology, labeled, idx, node_name, available, revoked in entries:
         if pool is None:
             try:
                 topo = parse_topology(accel_name, topology)
@@ -283,6 +303,10 @@ def build_pool(name: str, entries: Sequence[_NodeEntry]) -> Pool | None:
             pool = Pool(
                 name, ACCELERATORS[accel_name], topo.shape, labeled=labeled
             )
+        if revoked:
+            # one noticed node marks the whole pool: spot reclamation takes
+            # the slice, not a host (and a partial torus is useless anyway)
+            pool.revoked = True
         if idx is None:
             continue
         pool.add_host(idx, node_name, available)
@@ -344,6 +368,10 @@ class Fleet:
             best: tuple[tuple[int, str], Pool, Cuboid, tuple[int, ...]] | None = None
             for pool in pools:
                 if pool.accel.name != topo.accelerator.name:
+                    continue
+                if pool.revoked:
+                    # chips under a revocation notice are leaving: binding a
+                    # fresh gang into them schedules its own eviction
                     continue
                 if fit_cache is not None and fit_cache.hit(pool, topo):
                     continue
@@ -655,8 +683,12 @@ class FleetModel:
             )
         for name in sorted(set(live) & set(ref)):
             p, s = live[name], ref[name]
-            if (p.grid, p.chip_shape, p.accel.name, p.labeled, p.nodes) != (
-                s.grid, s.chip_shape, s.accel.name, s.labeled, s.nodes
+            if (
+                p.grid, p.chip_shape, p.accel.name, p.labeled, p.revoked,
+                p.nodes,
+            ) != (
+                s.grid, s.chip_shape, s.accel.name, s.labeled, s.revoked,
+                s.nodes,
             ):
                 out.append(f"differential: pool {name} geometry drifted")
                 continue

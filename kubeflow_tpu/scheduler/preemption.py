@@ -58,7 +58,11 @@ def eligible_victim(victim: BoundGang, head: GangRequest) -> bool:
 
 
 def select_victims(
-    fleet: Fleet, bound: list[BoundGang], head: GangRequest
+    fleet: Fleet,
+    bound: list[BoundGang],
+    head: GangRequest,
+    *,
+    suspending: frozenset | set | None = None,
 ) -> list[BoundGang] | None:
     """Minimal victim prefix whose eviction lets the head bind, or None.
 
@@ -72,14 +76,24 @@ def select_victims(
     head's accelerator: evicting a gang whose chips the head cannot use
     frees nothing for it (the greedy prefix would evict junior cross-accel
     gangs pointlessly before reaching a victim that matters).
+
+    ``suspending``: gang keys already inside a deadline-bearing suspend
+    handoff (a prior preemption, or a spot revocation — capacity/). Those
+    order STRICTLY before every priority-based victim: their teardown is
+    already paid for, so counting them first both avoids evicting a second
+    gang for space the barrier is about to free anyway and keeps repeat
+    victim selection stable across the cycles a handoff spans.
     """
     accel = head.topo.accelerator.name
+    in_flight = suspending or frozenset()
     candidates = sorted(
         (
             v for v in bound
             if v.topo.accelerator.name == accel and eligible_victim(v, head)
         ),
-        key=lambda v: (v.priority, -v.queued_at, v.chips, v.key),
+        key=lambda v: (
+            v.key not in in_flight, v.priority, -v.queued_at, v.chips, v.key,
+        ),
     )
     if not candidates:
         return None

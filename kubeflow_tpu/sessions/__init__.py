@@ -69,6 +69,18 @@ STATE_RESUMING = "resuming"
 
 REASON_PREEMPTION = "preemption"
 REASON_STOP = "stop"
+# Spot-capacity revocation (capacity/): the provider served notice that the
+# pool under this gang is being reclaimed. Semantically a deadline-bearing
+# preemption — the same suspend barrier holds the chips until the snapshot
+# commits or the (provider-bounded) deadline forces — except the freed space
+# is leaving the fleet, so nothing waits to inherit it.
+REASON_REVOCATION = "revocation"
+
+# Reasons whose release is the SCHEDULER's one-write commit (placement +
+# spent request retired together): the preemption handoff and the spot
+# revocation ride the identical barrier. REASON_STOP releases through the
+# notebook controller's teardown path instead.
+HANDOFF_REASONS = (REASON_PREEMPTION, REASON_REVOCATION)
 
 # Without a force deadline a gang whose snapshot can never commit (pods
 # crashlooping, store unreachable) would hold its chips forever — the

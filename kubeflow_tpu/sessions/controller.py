@@ -282,13 +282,13 @@ class SessionReconciler(Reconciler):
             return None  # parked; resume starts when the stop is removed
         if (
             req is not None
-            and req.get("reason") == sess.REASON_PREEMPTION
+            and req.get("reason") in sess.HANDOFF_REASONS
             and sched.placement_of(nb) is not None
         ):
-            # handoff pending: the snapshot is acked but the scheduler has
-            # not yet released the chips (it clears the request with the
-            # placement in one write). Starting a resume now would clear
-            # the ack underneath the barrier.
+            # handoff pending (preemption or spot revocation): the snapshot
+            # is acked but the scheduler has not yet released the chips (it
+            # clears the request with the placement in one write). Starting
+            # a resume now would clear the ack underneath the barrier.
             return Result(requeue_after=self.retry_s)
         if (
             ack is not None

@@ -98,6 +98,18 @@ ACCELERATORS: Mapping[str, TpuAccelerator] = {
     )
 }
 
+def accelerator_for_gke_label(gke_accelerator: str) -> TpuAccelerator | None:
+    """Reverse lookup from the GKE node label value
+    (``cloud.google.com/gke-tpu-accelerator``) to the accelerator, or None
+    for an unknown label — ONE implementation for every consumer (fleet
+    model, shard router, cloud adapters, audits), so an accelerator alias
+    is added in exactly one place."""
+    for accel in ACCELERATORS.values():
+        if accel.gke_accelerator == gke_accelerator:
+            return accel
+    return None
+
+
 _TOPOLOGY_RE = re.compile(r"^\d+(x\d+)*$")
 
 

@@ -86,6 +86,23 @@ class ControllerConfig:
     # enables it (LEDGER_ENABLED; --no-ledger A/B via LEDGER_ENABLED=0).
     ledger_enabled: bool = False
     ledger_interval_s: float = 15.0
+    # Elastic capacity (kubeflow_tpu/capacity/): scheduler-driven node-pool
+    # autoscaling with a spot tier. Off by default everywhere — the loop
+    # needs a cloud provider; the shipped controller-manager enables it with
+    # CAPACITY_ENABLED=true plus CAPACITY_PROVIDER (fake|gke|eks; STANDALONE
+    # always gets the deterministic fake). Revocations ride the sessions
+    # suspend barrier, so sessions_enabled should accompany it.
+    capacity_enabled: bool = False
+    # a gang must wait this long unhelped before its demand buys chips
+    capacity_pending_grace_s: float = 30.0
+    # continuous-idle dwell before an autoscaled pool is reclaimed — the
+    # anti-flap hysteresis (docs/capacity.md)
+    capacity_hysteresis_s: float = 300.0
+    capacity_max_pools_per_family: int = 2
+    # buy the cheaper revocable tier when the provider offers one
+    capacity_spot: bool = True
+    # the time-to-first-chip SLO target (demand onset -> first chip)
+    first_chip_target_s: float = 600.0
     # Control-plane sharding (runtime/sharding.py): partition the manager
     # plane by namespace hash and the scheduler by accelerator family into
     # SHARDS independent shards, each behind its own leader lease. 1 (the
@@ -130,6 +147,16 @@ class ControllerConfig:
             telemetry_port=int(_env_float("TELEMETRY_PORT", 8890)),
             ledger_enabled=_env_bool("LEDGER_ENABLED", True),
             ledger_interval_s=_env_float("LEDGER_INTERVAL_S", 15.0),
+            capacity_enabled=_env_bool("CAPACITY_ENABLED", False),
+            capacity_pending_grace_s=_env_float(
+                "CAPACITY_PENDING_GRACE_S", 30.0
+            ),
+            capacity_hysteresis_s=_env_float("CAPACITY_HYSTERESIS_S", 300.0),
+            capacity_max_pools_per_family=int(
+                _env_float("CAPACITY_MAX_POOLS_PER_FAMILY", 2)
+            ),
+            capacity_spot=_env_bool("CAPACITY_SPOT", True),
+            first_chip_target_s=_env_float("FIRST_CHIP_TARGET_S", 600.0),
             shards=max(1, int(_env_float("SHARDS", 1))),
             shard_id=(
                 int(_env_float("SHARD_ID", -1))

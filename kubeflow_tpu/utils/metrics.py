@@ -1100,3 +1100,91 @@ class LedgerMetrics:
             "Wall time of one ledger attribution tick",
             buckets=self.TICK_BUCKETS,
         )
+
+
+class CapacityMetrics:
+    """Elastic-capacity observability (capacity/, docs/capacity.md): the
+    autoscaler's decisions and the time-to-first-chip SLO, tracked next to
+    the startup SLO on the shared registry. ``slo_first_chip_total`` mirrors
+    ``slo_startup_total``'s within-target judgement so the two objectives
+    read off one scrape; CAPACITY_BENCH gates the decision latency and the
+    first-chip distribution."""
+
+    # demand onset -> first schedulable chip: dominated by cloud provisioning
+    # (minutes), with the decision itself sub-cycle
+    TTFC_BUCKETS = (5.0, 15.0, 60.0, 120.0, 300.0, 600.0, 1800.0, 3600.0)
+    DECISION_BUCKETS = (0.5, 1.0, 5.0, 15.0, 60.0, 300.0)
+
+    def __init__(
+        self,
+        registry: Registry | None = None,
+        *,
+        first_chip_target_s: float = 600.0,
+    ) -> None:
+        self.registry = registry or Registry()
+        self.first_chip_target_s = first_chip_target_s
+        self.scale_ups = self.registry.counter(
+            "capacity_scale_up_total",
+            "Node-pool scale-up requests issued to the cloud provider",
+            labelnames=("family", "tier"),
+        )
+        self.scale_downs = self.registry.counter(
+            "capacity_scale_down_total",
+            "Autoscaled node pools reclaimed after the idle hysteresis dwell",
+            labelnames=("family",),
+        )
+        self.revocations = self.registry.counter(
+            "capacity_revocation_total",
+            "Spot revocation notices translated into suspend handoffs",
+            labelnames=("family",),
+        )
+        self.provider_errors = self.registry.counter(
+            "capacity_provider_errors_total",
+            "Cloud-provider calls that failed past the adapter retry budget",
+            labelnames=("op",),
+        )
+        self.open_requests = self.registry.gauge(
+            "capacity_open_scale_requests",
+            "Scale-up requests awaiting their first schedulable chip",
+        )
+        self.pending_chips = self.registry.gauge(
+            "capacity_pending_chips",
+            "Chips currently being provisioned per accelerator family",
+            labelnames=("family",),
+        )
+        self.time_to_first_chip = self.registry.histogram(
+            "capacity_time_to_first_chip_seconds",
+            "Unmet-demand onset to the first schedulable chip of the "
+            "capacity bought for it — the elastic-capacity SLO",
+            buckets=self.TTFC_BUCKETS,
+        )
+        self.first_chip_max = self.registry.gauge(
+            "capacity_time_to_first_chip_seconds_max",
+            "Largest time-to-first-chip observed",
+        )
+        self.decision_latency = self.registry.histogram(
+            "capacity_scale_decision_seconds",
+            "Aged-demand threshold crossing to the provider scale-up call",
+            buckets=self.DECISION_BUCKETS,
+        )
+        self.first_chips = self.registry.counter(
+            "slo_first_chip_total",
+            "First-chip deliveries judged against the time-to-first-chip "
+            "target",
+            labelnames=("within_target",),
+        )
+
+    def observe_first_chip(self, seconds: float) -> None:
+        self.time_to_first_chip.observe(seconds)
+        if seconds > self.first_chip_max.get():
+            self.first_chip_max.set(seconds)
+        self.first_chips.inc(
+            within_target=(
+                "true" if seconds <= self.first_chip_target_s else "false"
+            )
+        )
+
+    def ttfc_p50(self) -> float:
+        """Time-to-first-chip p50 off the real histogram (dashboard series
+        and the JWA's provisioning ETA)."""
+        return self.time_to_first_chip.quantile(0.5)

@@ -70,6 +70,7 @@ def create_app(
     slo=None,
     scheduler=None,
     ledger=None,
+    capacity=None,
     cache: ReadCache | None = None,
     use_cache: bool = True,
 ) -> App:
@@ -113,6 +114,17 @@ def create_app(
         readers["efficiency"] = ledger.fleet_efficiency
         readers["waste"] = ledger.fleet_waste_fraction
         readers["unmet_demand"] = ledger.unmet_demand_chips
+    if capacity is not None:
+        # elastic-capacity series (capacity/): the time-to-first-chip SLO
+        # p50 next to the startup p99 above — the two latencies the
+        # platform's L1 contract is judged on — and the chips currently
+        # being provisioned (the autoscaler acting on unmet_demand)
+        cap_metrics = getattr(capacity, "metrics", None)
+        if cap_metrics is not None:
+            readers["first_chip_p50"] = cap_metrics.ttfc_p50
+            readers["pending_chips"] = _gauge_total(
+                cap_metrics.pending_chips
+            )
     owned_source = None
     if metrics_source is None:
         if os.environ.get("METRICS_SOURCE"):

@@ -59,7 +59,31 @@ def _blocking_detail(nb: dict) -> str | None:
     return exp.get("message") or exp.get("reason")
 
 
-def notebook_status(nb: dict, events: list[dict]) -> dict:
+def _capacity_pending_detail(nb: dict, capacity) -> str | None:
+    """The autoscaler's "chips are on their way" line for an unbound gang:
+    shown instead of a bare Unschedulable (and appended to a queued row)
+    when an open scale-up request covers the gang's family. The ETA is the
+    time-to-first-chip p50 — the SLO the dashboard charts — so the spawner
+    promises what the platform actually delivers."""
+    if capacity is None:
+        return None
+    topo = api.notebook_topology(nb)
+    if topo is None:
+        return None
+    try:
+        pending = capacity.pending_for(topo.accelerator.name)
+    except Exception:
+        return None  # a provider hiccup must never 500 the listing
+    if not pending:
+        return None
+    detail = f"capacity pending — provisioning {pending['chips']} chips"
+    eta = pending.get("etaS")
+    if eta:
+        detail += f", ETA ~{eta:.0f}s from time-to-first-chip p50"
+    return detail
+
+
+def notebook_status(nb: dict, events: list[dict], capacity=None) -> dict:
     """Derive UI status (ref status.py:9-99), extended with the fleet
     scheduler's conditions — a queued gang says WHERE it is in line instead
     of a generic "pending", an unschedulable one says why it never will be —
@@ -97,6 +121,11 @@ def notebook_status(nb: dict, events: list[dict]) -> dict:
         return {"phase": "ready", "message": "Running"}
     unsched = sched.condition(nb, sched.COND_UNSCHEDULABLE)
     if unsched is not None and unsched.get("status") == "True":
+        pending = _capacity_pending_detail(nb, capacity)
+        if pending is not None:
+            # the autoscaler already acted on this verdict: the honest
+            # status is "chips are coming", not a dead-end warning
+            return {"phase": "waiting", "message": f"{pending}."}
         # the top blocking verdict from the scheduler's explanation
         # annotation, not the generic string: "why not" is the product
         # surface here (a malformed/absent annotation falls back to the
@@ -109,6 +138,9 @@ def notebook_status(nb: dict, events: list[dict]) -> dict:
     if queued is not None and queued.get("status") == "True":
         detail = queued.get("message") or "waiting for capacity"
         message = f"Queued for TPU capacity ({detail})."
+        pending = _capacity_pending_detail(nb, capacity)
+        if pending:
+            message += f" {pending[0].upper()}{pending[1:]}."
         preempted = sched.condition(nb, sched.COND_PREEMPTED)
         if preempted is not None and preempted.get("status") == "True":
             message = (
@@ -141,7 +173,7 @@ def notebook_status(nb: dict, events: list[dict]) -> dict:
     return {"phase": "waiting", "message": "Starting Notebook Server."}
 
 
-def notebook_summary(nb: dict, events: list[dict]) -> dict:
+def notebook_summary(nb: dict, events: list[dict], capacity=None) -> dict:
     """Index-table row (ref utils.notebook_dict_from_k8s_obj)."""
     # guard: CRs created out-of-band (kubectl) may omit containers entirely;
     # one malformed CR must not 500 the whole namespace listing
@@ -159,7 +191,7 @@ def notebook_summary(nb: dict, events: list[dict]) -> dict:
         "cpu": container.get("resources", {}).get("requests", {}).get("cpu"),
         "memory": container.get("resources", {}).get("requests", {}).get("memory"),
         "tpu": tpu,
-        "status": notebook_status(nb, events),
+        "status": notebook_status(nb, events, capacity),
         "volumes": [v.get("name") for v in pod_spec.get("volumes", [])],
         "lastActivity": ko.annotations(nb).get(api.LAST_ACTIVITY_ANNOTATION, ""),
     }
@@ -179,6 +211,7 @@ def create_app(
     telemetry=None,
     timeline=None,
     ledger=None,
+    capacity=None,
     cache: ReadCache | None = None,
     use_cache: bool = True,
 ) -> App:
@@ -207,9 +240,10 @@ def create_app(
         return cache.etag(*scopes, principal=principal, extra=extra)
 
     def _tel_extra() -> str:
-        # telemetry/timeline/ledger payloads change without any CR rv
-        # moving; the collector's pass counter and the ledger's tick
-        # counter fold that freshness into the ETag
+        # telemetry/timeline/ledger/capacity payloads change without any CR
+        # rv moving; the collector's pass counter, the ledger's tick
+        # counter, and the autoscaler's open-request/first-chip state fold
+        # that freshness into the ETag
         tel = telemetry if telemetry is not None else getattr(
             timeline, "telemetry", None
         )
@@ -218,7 +252,22 @@ def create_app(
             parts.append(f"tel:{getattr(tel, 'scrape_passes', 0)}")
         if ledger is not None:
             parts.append(f"led:{getattr(ledger, 'ticks', 0)}")
+        cap = _cap_extra()
+        if cap:
+            parts.append(cap)
         return ",".join(parts)
+
+    def _cap_extra() -> str:
+        # the list row's "capacity pending" message moves with the
+        # autoscaler's state generation — bumped by its cycle whenever the
+        # open-request set, the provider's pending set, or a first-chip
+        # delivery changes (and nothing chattier: the ledger's every-tick
+        # counter would defeat the list route's 304s). The generation also
+        # covers the restart window where pending_for() answers from
+        # provider.pending() while the in-memory open set is empty.
+        if capacity is None:
+            return ""
+        return f"cap:{getattr(capacity, 'state_gen', 0)}"
 
     app.attach_frontend("jupyter")
     base.add_namespaces_route(app, cluster)
@@ -269,7 +318,7 @@ def create_app(
         # If-None-Match skips the whole join+serialize for a 304
         etag = _etag(
             ("Notebook", namespace), ("Event", namespace),
-            principal=user.name,
+            principal=user.name, extra=_cap_extra(),
         )
         hit = base.not_modified(request, etag)
         if hit is not None:
@@ -280,7 +329,8 @@ def create_app(
             # summary building only reads)
             out = [
                 notebook_summary(
-                    nb, cache.events_for(nb, principal=user.name, copy=False)
+                    nb, cache.events_for(nb, principal=user.name, copy=False),
+                    capacity,
                 )
                 for nb in cache.list(
                     "Notebook", namespace, principal=user.name, copy=False
@@ -295,7 +345,9 @@ def create_app(
                 if io.get("kind") == "Notebook":
                     events_by_name.setdefault(io.get("name", ""), []).append(ev)
             out = [
-                notebook_summary(nb, events_by_name.get(ko.name(nb), []))
+                notebook_summary(
+                    nb, events_by_name.get(ko.name(nb), []), capacity
+                )
                 for nb in cluster.list("Notebook", namespace)
             ]
         return base.set_etag(success("notebooks", out), etag)
@@ -318,7 +370,7 @@ def create_app(
         else:
             nb = cluster.get("Notebook", name, namespace)
             events = cluster.events_for(nb)
-        summary = notebook_summary(nb, events)
+        summary = notebook_summary(nb, events, capacity)
         summary["status"]["conditions"] = nb.get("status", {}).get(
             "conditions", []
         )

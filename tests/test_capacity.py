@@ -1,0 +1,766 @@
+"""Elastic capacity: the autoscaler loop, the spot tier's revocation
+handoff, the provider boundary, and the surfaces that ride them
+(docs/capacity.md)."""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kubeflow_tpu import cloud
+from kubeflow_tpu import scheduler as sched
+from kubeflow_tpu import sessions as sess
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.capacity import node_tier
+from kubeflow_tpu.capacity.autoscaler import CapacityReconciler
+from kubeflow_tpu.capacity.provider import (
+    FakeCloudProvider,
+    PoolSpec,
+    ProviderChaos,
+    ProviderError,
+)
+from kubeflow_tpu.obs.ledger import classify_gang
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.scheduler import explain as explain_mod
+from kubeflow_tpu.scheduler import preemption as preempt
+from kubeflow_tpu.scheduler.controller import SchedulerReconciler
+from kubeflow_tpu.scheduler.fleet import Fleet
+from kubeflow_tpu.scheduler.queue import GangRequest
+from kubeflow_tpu.scheduler.soak import make_pool
+from kubeflow_tpu.tpu.topology import parse_topology
+from kubeflow_tpu.utils.metrics import CapacityMetrics
+from kubeflow_tpu.webapps.jupyter import notebook_status
+from kubeflow_tpu.webhooks import tpu_env
+
+NS = "team-a"
+
+
+class Clock:
+    def __init__(self, t: float = 1_000_000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def build_world(
+    *,
+    pools=(("v4", "2x2x2", "pool-a"),),
+    chaos: ProviderChaos | None = None,
+    grace_s: float = 20.0,
+    hysteresis_s: float = 60.0,
+    max_pools: int = 2,
+    spot: bool = True,
+    provision_delay_s: float = 10.0,
+    suspend_deadline_s: float = 60.0,
+):
+    cluster = FakeCluster()
+    tpu_env.install(cluster)
+    clock = Clock()
+    for accel, topo, name in pools:
+        make_pool(cluster, accel, topo, name)
+    provider = FakeCloudProvider(
+        cluster, clock=clock, seed=7, chaos=chaos,
+        provision_delay_s=provision_delay_s,
+    )
+    metrics = CapacityMetrics()
+    autoscaler = CapacityReconciler(
+        provider, metrics=metrics, clock=clock,
+        pending_grace_s=grace_s, hysteresis_s=hysteresis_s,
+        max_pools_per_family=max_pools, spot=spot,
+        suspend_deadline_s=suspend_deadline_s,
+    )
+    scheduler = SchedulerReconciler(
+        clock=clock, aging_interval_s=60.0,
+        suspend_deadline_s=suspend_deadline_s,
+    )
+    mgr = Manager(cluster, clock=clock)
+    mgr.register(scheduler)
+    mgr.register(autoscaler)
+    return cluster, clock, provider, metrics, autoscaler, mgr
+
+
+def drive(cluster, clock, provider, mgr, seconds: float, step: float = 1.0):
+    t = 0.0
+    while t < seconds:
+        cluster.step_kubelet()
+        provider.step()
+        mgr.tick()
+        clock.advance(step)
+        t += step
+
+
+def gang(name: str, accel: str = "v4", topo: str = "2x2x4", **kw) -> dict:
+    return api.notebook(name, NS, tpu_accelerator=accel, tpu_topology=topo, **kw)
+
+
+# --------------------------------------------------------------- the provider
+
+
+class TestFakeCloudProvider:
+    def test_provisions_after_delay_with_capacity_markers(self):
+        cluster = FakeCluster()
+        clock = Clock()
+        p = FakeCloudProvider(cluster, clock=clock, provision_delay_s=10.0)
+        spec = PoolSpec("auto-v4-0", "v4", "2x2x2", tier=sched.TIER_SPOT)
+        assert p.scale_up(spec) is True
+        assert p.scale_up(spec) is False  # idempotent while provisioning
+        p.step()
+        assert not cluster.list("Node")
+        clock.advance(10.0)
+        p.step()
+        nodes = cluster.list("Node")
+        topo = parse_topology("v4", "2x2x2")
+        assert len(nodes) == topo.num_hosts
+        for node in nodes:
+            labels = ko.labels(node)
+            assert labels[sched.POOL_LABEL] == "auto-v4-0"
+            assert labels[sched.AUTOSCALED_LABEL] == "true"
+            assert node_tier(node) == sched.TIER_SPOT
+        assert p.scale_up(spec) is False  # idempotent once it exists
+        assert p.pending() == {}
+
+    def test_stuck_provisioning_resolves_on_heal(self):
+        cluster = FakeCluster()
+        clock = Clock()
+        p = FakeCloudProvider(
+            cluster, clock=clock, provision_delay_s=5.0,
+            chaos=ProviderChaos(error_rate=0.0, stuck_rate=1.0),
+        )
+        p.scale_up(PoolSpec("auto-v4-0", "v4", "2x2x2"))
+        clock.advance(500.0)
+        p.step()
+        assert not cluster.list("Node")  # wedged: never becomes ready
+        p.heal()
+        clock.advance(5.0)
+        p.step()
+        assert cluster.list("Node")
+
+    def test_injected_errors_are_typed(self):
+        p = FakeCloudProvider(
+            FakeCluster(), clock=Clock(),
+            chaos=ProviderChaos(error_rate=1.0),
+        )
+        with pytest.raises(ProviderError) as exc:
+            p.scale_up(PoolSpec("auto-v4-0", "v4", "2x2x2"))
+        assert exc.value.status in (429, 500)
+
+    def test_dishonored_grace_kills_before_the_deadline(self):
+        cluster = FakeCluster()
+        clock = Clock()
+        p = FakeCloudProvider(cluster, clock=clock)
+        make_pool(cluster, "v4", "2x2x2", "spot-0")
+        notice = p.revoke("spot-0", grace_s=100.0, honored=False)
+        assert notice is not None
+        assert notice.deadline == clock() + 100.0
+        clock.advance(30.0)  # past the dishonored fraction, not the grace
+        p.step()
+        assert not cluster.list("Node")
+        assert "spot-0" in p.killed
+
+    def test_honored_grace_keeps_nodes_until_deadline(self):
+        cluster = FakeCluster()
+        clock = Clock()
+        p = FakeCloudProvider(cluster, clock=clock)
+        make_pool(cluster, "v4", "2x2x2", "spot-0")
+        p.revoke("spot-0", grace_s=100.0, honored=True)
+        clock.advance(99.0)
+        p.step()
+        assert cluster.list("Node")
+        clock.advance(1.0)
+        p.step()
+        assert not cluster.list("Node")
+
+
+# ------------------------------------------------------------- the autoscaler
+
+
+class TestScaleUp:
+    def test_unfittable_aged_gang_buys_a_pool_and_binds(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world()
+        cluster.create(gang("big"))  # 2x2x4 cannot fit the 2x2x2 pool
+        drive(cluster, clock, provider, mgr, 10.0)
+        assert provider.pending() == {}  # grace not crossed: no buy yet
+        drive(cluster, clock, provider, mgr, 60.0)
+        nb = cluster.get("Notebook", "big", NS)
+        placement = sched.placement_of(nb)
+        assert placement is not None
+        pools = {s["pool"] for s in placement["slices"]}
+        assert pools == {"auto-v4-0"}
+        # the bought pool carries the spot tier + autoscaled markers
+        node = cluster.list("Node", None, {"matchLabels": {
+            sched.POOL_LABEL: "auto-v4-0"}})[0]
+        assert node_tier(node) == sched.TIER_SPOT
+        assert ko.labels(node)[sched.AUTOSCALED_LABEL] == "true"
+        # the SLO observed the delivery
+        assert metrics.time_to_first_chip.count() == 1
+        assert metrics.first_chips.get(within_target="true") == 1.0
+
+    def test_no_buy_before_the_grace_window(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            grace_s=300.0
+        )
+        cluster.create(gang("big"))
+        drive(cluster, clock, provider, mgr, 120.0)
+        assert provider.pending() == {}
+        assert metrics.scale_ups.samples() == []
+
+    def test_fragmented_verdict_blocks_the_buy(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world()
+        nb = gang("frag", topo="2x2x2")
+        nb["metadata"]["annotations"] = {
+            sched.QUEUED_AT_ANNOTATION: repr(1_000_000.0 - 500.0),
+            sched.EXPLANATION_ANNOTATION: json.dumps({
+                "reason": "Fragmented",
+                "wouldFitAfterDefrag": True,
+                "since": 1_000_000.0 - 500.0,
+            }),
+        }
+        cluster.create(nb, skip_admission=True)
+        # run the autoscaler cycle directly: the scheduler would re-judge
+        # (and clear) the hand-planted verdict
+        auto._cycle(cluster)
+        assert provider.pending() == {}  # defrag admits it: no chips bought
+
+    def test_one_in_flight_request_per_family(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            provision_delay_s=100.0
+        )
+        cluster.create(gang("big-a"))
+        cluster.create(gang("big-b", topo="2x2x4"))
+        drive(cluster, clock, provider, mgr, 40.0)
+        assert len(provider.pending()) == 1
+
+    def test_max_pools_per_family_caps_the_budget(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            max_pools=1, provision_delay_s=5.0, hysteresis_s=10_000.0
+        )
+        cluster.create(gang("big-a"))
+        drive(cluster, clock, provider, mgr, 60.0)
+        assert sched.placement_of(cluster.get("Notebook", "big-a", NS))
+        # second oversized gang: the family is at its autoscaled budget
+        # (big-a holds auto-v4-0), so no second pool is requested
+        cluster.create(gang("big-b"))
+        drive(cluster, clock, provider, mgr, 90.0)
+        assert provider.pending() == {}
+        assert len(cluster.list("Node", None, {"matchLabels": {
+            sched.AUTOSCALED_LABEL: "true"}})) == parse_topology(
+                "v4", "2x2x4").num_hosts
+
+
+class TestScaleDown:
+    def test_idle_autoscaled_pool_reclaimed_after_hysteresis_only(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            hysteresis_s=120.0, provision_delay_s=5.0
+        )
+        cluster.create(gang("big"))
+        drive(cluster, clock, provider, mgr, 60.0)
+        assert sched.placement_of(cluster.get("Notebook", "big", NS))
+        cluster.delete("Notebook", "big", NS)
+        drive(cluster, clock, provider, mgr, 60.0)
+        # idle, but inside the dwell: still there
+        assert cluster.list("Node", None, {"matchLabels": {
+            sched.POOL_LABEL: "auto-v4-0"}})
+        drive(cluster, clock, provider, mgr, 120.0)
+        assert not cluster.list("Node", None, {"matchLabels": {
+            sched.POOL_LABEL: "auto-v4-0"}})
+        assert sum(
+            s["value"] for s in metrics.scale_downs.samples()
+        ) == 1.0
+        # the hand-made base pool is NEVER reclaimed
+        assert cluster.list("Node", None, {"matchLabels": {
+            sched.POOL_LABEL: "pool-a"}})
+
+    def test_returning_demand_resets_the_dwell(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            hysteresis_s=120.0, provision_delay_s=5.0
+        )
+        cluster.create(gang("big"))
+        drive(cluster, clock, provider, mgr, 60.0)
+        cluster.delete("Notebook", "big", NS)
+        drive(cluster, clock, provider, mgr, 80.0)  # dwell running
+        cluster.create(gang("big2"))  # demand returns before the dwell ends
+        drive(cluster, clock, provider, mgr, 80.0)
+        # the pool was NOT reclaimed: the returning gang bound into it
+        assert sched.placement_of(cluster.get("Notebook", "big2", NS))
+        assert metrics.scale_downs.samples() == []
+
+
+class TestRevocation:
+    def _revoked_world(self, **kw):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            pools=(("v4", "2x2x2", "pool-a"), ("v4", "2x2x4", "spot-0")),
+            **kw,
+        )
+        for node in cluster.list("Node", None, {"matchLabels": {
+                sched.POOL_LABEL: "spot-0"}}):
+            cluster.patch("Node", ko.name(node), "", {"metadata": {"labels": {
+                sched.TIER_LABEL: sched.TIER_SPOT,
+                sched.AUTOSCALED_LABEL: "true",
+            }}})
+        return cluster, clock, provider, metrics, auto, mgr
+
+    def test_notice_marks_nodes_and_suspends_placed_gangs(self):
+        cluster, clock, provider, metrics, auto, mgr = self._revoked_world()
+        cluster.create(gang("victim", topo="2x2x4"))  # only fits spot-0
+        drive(cluster, clock, provider, mgr, 5.0)
+        assert sched.placement_of(cluster.get("Notebook", "victim", NS))
+        provider.revoke("spot-0", grace_s=100.0, honored=True)
+        # a provider notice has no cluster event: the translation happens on
+        # the autoscaler's resync poll
+        drive(cluster, clock, provider, mgr, 20.0)
+        nb = cluster.get("Notebook", "victim", NS)
+        req = sess.suspend_request(nb)
+        assert req is not None
+        assert req["reason"] == sess.REASON_REVOCATION
+        assert req["deadline"] <= clock() + 100.0
+        for node in cluster.list("Node", None, {"matchLabels": {
+                sched.POOL_LABEL: "spot-0"}}):
+            assert sched.REVOKED_ANNOTATION in ko.annotations(node)
+        # the ledger accounts the barrier window as suspending
+        assert classify_gang({
+            "suspendReason": sess.REASON_REVOCATION,
+            "state": None, "stopped": False, "running": True,
+        }) == "suspending"
+
+    def test_revoked_pool_refuses_new_binds_but_keeps_existing(self):
+        cluster, clock, provider, metrics, auto, mgr = self._revoked_world()
+        cluster.create(gang("victim", topo="2x2x4"))
+        drive(cluster, clock, provider, mgr, 5.0)
+        provider.revoke("spot-0", grace_s=200.0, honored=True)
+        drive(cluster, clock, provider, mgr, 20.0)
+        # existing placement survives the notice (the barrier holds it)
+        assert sched.placement_of(cluster.get("Notebook", "victim", NS))
+        # a NEW gang shaped only for the revoked pool must not bind into it
+        cluster.create(gang("fresh", topo="2x2x1"))
+        fleet = Fleet.from_nodes(cluster.list("Node"))
+        assert fleet.pools["spot-0"].revoked
+        assert fleet.clone().place_gang(
+            "probe", parse_topology("v4", "2x2x4"), 1
+        ) is None
+        # the per-pool verdict names the revocation
+        verdict = explain_mod.pool_verdict(
+            fleet.pools["spot-0"], parse_topology("v4", "2x2x4")
+        )
+        assert verdict["verdict"] == explain_mod.VERDICT_REVOKED
+
+    def test_completed_handoff_releases_and_requeues_with_seniority(self):
+        cluster, clock, provider, metrics, auto, mgr = self._revoked_world()
+        cluster.create(gang("victim", topo="2x2x4"))
+        drive(cluster, clock, provider, mgr, 5.0)
+        nb = cluster.get("Notebook", "victim", NS)
+        queued_at = ko.annotations(nb)[sched.QUEUED_AT_ANNOTATION]
+        provider.revoke("spot-0", grace_s=100.0, honored=True)
+        drive(cluster, clock, provider, mgr, 20.0)
+        # the sessions controller's ack, hand-delivered: state=suspended
+        cluster.patch("Notebook", "victim", NS, {"metadata": {"annotations": {
+            sess.STATE_ANNOTATION: sess.STATE_SUSPENDED}}})
+        drive(cluster, clock, provider, mgr, 10.0)
+        nb = cluster.get("Notebook", "victim", NS)
+        # one-write release: placement AND spent request gone, seniority kept
+        assert sched.placement_of(nb) is None
+        assert sess.suspend_request(nb) is None
+        assert ko.annotations(nb)[sched.QUEUED_AT_ANNOTATION] == queued_at
+        assert sched.condition_is_true(nb, sched.COND_PREEMPTED)
+
+    def test_storm_with_dishonored_grace_requeues_cold_without_limbo(self):
+        cluster, clock, provider, metrics, auto, mgr = self._revoked_world()
+        cluster.create(gang("victim", topo="2x2x4"))
+        drive(cluster, clock, provider, mgr, 5.0)
+        provider.revoke("spot-0", grace_s=100.0, honored=False)
+        # the kill lands at 20% of the grace; drive well past it
+        drive(cluster, clock, provider, mgr, 40.0)
+        assert not cluster.list("Node", None, {"matchLabels": {
+            sched.POOL_LABEL: "spot-0"}})
+        nb = cluster.get("Notebook", "victim", NS)
+        # never limbo: the gang either re-queued (seniority intact) or —
+        # the full loop — already re-bound into replacement capacity the
+        # autoscaler bought for its re-queued demand; a placement
+        # referencing the dead pool would be the lost-gang failure
+        placement = sched.placement_of(nb)
+        assert sched.QUEUED_AT_ANNOTATION in ko.annotations(nb)
+        if placement is not None:
+            live = {
+                ko.labels(n).get(sched.POOL_LABEL)
+                for n in cluster.list("Node")
+            }
+            assert all(s["pool"] in live for s in placement["slices"])
+            assert all(s["pool"] != "spot-0" for s in placement["slices"])
+
+
+# ---------------------------------------------- preemption ordering satellite
+
+
+def _bound(key, prio, queued_at, accel, topo, pool_hint=0):
+    t = parse_topology(accel, topo)
+    return preempt.BoundGang(
+        key=key, priority=prio, queued_at=queued_at,
+        chips=t.num_chips, topo=t, num_slices=1,
+    )
+
+
+class TestPreemptionEdges:
+    def _fleet_two_pools(self):
+        cluster = FakeCluster()
+        make_pool(cluster, "v4", "2x2x2", "p0")
+        make_pool(cluster, "v4", "2x2x2", "p1")
+        return Fleet.from_nodes(cluster.list("Node"))
+
+    def test_deadline_bearing_victims_order_before_priority_victims(self):
+        fleet = self._fleet_two_pools()
+        # two juniors each filling one pool; head needs one pool's worth
+        fleet.occupy_gang("team-a/old", [{
+            "pool": "p0", "accelerator": "v4", "poolTopology": "2x2x2",
+            "offset": [0, 0, 0], "shape": [2, 2, 2], "nodes": [],
+        }])
+        fleet.occupy_gang("team-a/susp", [{
+            "pool": "p1", "accelerator": "v4", "poolTopology": "2x2x2",
+            "offset": [0, 0, 0], "shape": [2, 2, 2], "nodes": [],
+        }])
+        bound = [
+            # "old" is MORE junior by policy order (queued later)...
+            _bound("team-a/old", 0, 2000.0, "v4", "2x2x2"),
+            _bound("team-a/susp", 0, 1000.0, "v4", "2x2x2"),
+        ]
+        head = GangRequest(
+            key="team-a/head", priority=5, queued_at=0.0,
+            topo=parse_topology("v4", "2x2x2"), num_slices=1,
+        )
+        victims = preempt.select_victims(fleet, bound, head)
+        assert [v.key for v in victims] == ["team-a/old"]
+        # ...but "susp" is already inside a deadline-bearing handoff: its
+        # teardown is paid for, so it orders STRICTLY first
+        victims = preempt.select_victims(
+            fleet, bound, head, suspending={"team-a/susp"}
+        )
+        assert [v.key for v in victims] == ["team-a/susp"]
+
+    def test_greedy_minimal_prefix_across_pools(self):
+        fleet = self._fleet_two_pools()
+        # four 2x2x1 juniors: two per pool (each pool is 2 host cells)
+        placements = [
+            ("team-a/j0", "p0", [0, 0, 0]),
+            ("team-a/j1", "p0", [0, 0, 1]),
+            ("team-a/j2", "p1", [0, 0, 0]),
+            ("team-a/j3", "p1", [0, 0, 1]),
+        ]
+        for key, pool, offset in placements:
+            assert fleet.occupy_gang(key, [{
+                "pool": pool, "accelerator": "v4", "poolTopology": "2x2x2",
+                "offset": offset, "shape": [2, 2, 1], "nodes": [],
+            }])
+        # juniors aged so eviction order is j3, j2, j1, j0 (youngest first)
+        bound = [
+            _bound("team-a/j0", 0, 10.0, "v4", "2x2x1"),
+            _bound("team-a/j1", 0, 20.0, "v4", "2x2x1"),
+            _bound("team-a/j2", 0, 30.0, "v4", "2x2x1"),
+            _bound("team-a/j3", 0, 40.0, "v4", "2x2x1"),
+        ]
+        head = GangRequest(
+            key="team-a/head", priority=5, queued_at=0.0,
+            topo=parse_topology("v4", "2x2x2"), num_slices=1,
+        )
+        victims = preempt.select_victims(fleet, bound, head)
+        # the junior set spans pools: the greedy prefix stops at the FIRST
+        # point the head fits — evicting j3+j2 clears all of p1; j1/j0 in
+        # p0 must not be touched
+        assert sorted(v.key for v in victims) == ["team-a/j2", "team-a/j3"]
+
+
+# ------------------------------------------------------------------- surfaces
+
+
+class TestSurfaces:
+    def test_jwa_renders_capacity_pending_instead_of_unschedulable(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            provision_delay_s=500.0
+        )
+        cluster.create(gang("big"))
+        drive(cluster, clock, provider, mgr, 40.0)  # bought, provisioning
+        nb = cluster.get("Notebook", "big", NS)
+        assert sched.condition_is_true(nb, sched.COND_UNSCHEDULABLE)
+        # without the capacity handle: the bare verdict (unchanged behavior)
+        assert notebook_status(nb, [])["phase"] == "warning"
+        # with it: the honest "chips are coming" line
+        metrics.observe_first_chip(120.0)  # a prior delivery seeds the p50
+        status = notebook_status(nb, [], auto)
+        assert status["phase"] == "waiting"
+        assert "capacity pending" in status["message"]
+        assert "provisioning 16 chips" in status["message"]
+        assert "time-to-first-chip p50" in status["message"]
+
+    def test_pending_for_reports_chips_and_eta(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            provision_delay_s=500.0
+        )
+        cluster.create(gang("big"))
+        drive(cluster, clock, provider, mgr, 40.0)
+        pending = auto.pending_for("v4")
+        assert pending["chips"] == 16
+        assert pending["etaS"] is None  # no first chip observed yet
+        assert auto.pending_for("v5e") is None
+
+    def test_debug_payload_lists_open_requests_and_dwells(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            provision_delay_s=500.0
+        )
+        cluster.create(gang("big"))
+        drive(cluster, clock, provider, mgr, 40.0)
+        payload = auto.debug_payload()
+        assert "auto-v4-0" in payload["openRequests"]
+        assert payload["openRequests"]["auto-v4-0"]["family"] == "v4"
+
+    def test_capacity_events_emitted(self):
+        from kubeflow_tpu.obs.events import EventRecorder
+
+        cluster, clock, provider, metrics, auto, mgr = build_world()
+        auto.recorder = EventRecorder(clock=clock)
+        cluster.create(gang("big"))
+        drive(cluster, clock, provider, mgr, 40.0)
+        nb = cluster.get("Notebook", "big", NS)
+        reasons = {e.get("reason") for e in cluster.events_for(nb)}
+        assert "CapacityRequested" in reasons
+
+
+# ------------------------------------------------------- the provider adapters
+
+
+class FakeResponse:
+    def __init__(self, status_code=200, body=None, headers=None):
+        self.status_code = status_code
+        self._body = body if body is not None else {}
+        self.headers = headers or {}
+        self.content = json.dumps(self._body).encode()
+
+    def json(self):
+        return self._body
+
+    def raise_for_status(self):
+        if self.status_code >= 400:
+            import requests
+
+            raise requests.HTTPError(response=self)
+
+
+class FakeHttp:
+    def __init__(self, responder):
+        self.calls = []
+        self.responder = responder
+
+    def request(self, method, url, **kw):
+        self.calls.append((method, url, kw))
+        return self.responder(method, url, kw)
+
+    def post(self, url, **kw):
+        return self.request("POST", url, **kw)
+
+    def get(self, url, **kw):
+        return self.request("GET", url, **kw)
+
+
+class TestGkeNodePoolProvider:
+    def make(self, responder):
+        from kubeflow_tpu.cloud.gcp import GkeNodePoolProvider
+
+        http = FakeHttp(responder)
+        return GkeNodePoolProvider(
+            "proj", "us-central2-b", "demo",
+            session=http, token_provider=lambda: "tok",
+            retry_deadline_s=0.2,
+        ), http
+
+    def test_scale_up_posts_documented_node_pool(self):
+        provider, http = self.make(
+            lambda m, u, kw: FakeResponse(200, {"name": "op"})
+        )
+        assert provider.scale_up(
+            PoolSpec("auto-v4-0", "v4", "2x2x4", tier=sched.TIER_SPOT)
+        ) is True
+        [(method, url, kw)] = http.calls
+        assert method == "POST"
+        assert url.endswith(
+            "/projects/proj/locations/us-central2-b/clusters/demo/nodePools"
+        )
+        body = kw["json"]["nodePool"]
+        assert body["name"] == "auto-v4-0"
+        assert body["initialNodeCount"] == parse_topology(
+            "v4", "2x2x4").num_hosts
+        assert body["config"]["spot"] is True
+        assert body["placementPolicy"]["tpuTopology"] == "2x2x4"
+        assert body["config"]["labels"][sched.AUTOSCALED_LABEL] == "true"
+
+    def test_conflict_is_idempotent_and_transients_retry(self, monkeypatch):
+        monkeypatch.setattr(cloud, "_pause", lambda s: None)
+        monkeypatch.setattr(cloud, "_sleep", lambda s: None)
+        responses = [FakeResponse(429, headers={"Retry-After": "0"}),
+                     FakeResponse(409)]
+        provider, http = self.make(lambda m, u, kw: responses.pop(0))
+        assert provider.scale_up(PoolSpec("auto-v4-0", "v4", "2x2x4")) is False
+        assert len(http.calls) == 2  # one 429 retried, then the 409 answer
+
+    def test_retries_exhausted_is_typed(self, monkeypatch):
+        monkeypatch.setattr(cloud, "_pause", lambda s: None)
+        monkeypatch.setattr(cloud, "_sleep", lambda s: None)
+        provider, http = self.make(lambda m, u, kw: FakeResponse(500))
+        with pytest.raises(cloud.RetriesExhausted) as exc:
+            provider.scale_down("auto-v4-0")
+        assert exc.value.last_status == 500
+        assert exc.value.attempts >= 1
+
+
+class TestEksNodeGroupProvider:
+    def make(self, responder):
+        from kubeflow_tpu.cloud.aws import EksNodeGroupProvider
+
+        http = FakeHttp(responder)
+        return EksNodeGroupProvider(
+            "demo", region="us-west-2", session=http,
+            access_key="ak", secret_key="sk", retry_deadline_s=0.2,
+        ), http
+
+    def test_scale_up_posts_spot_nodegroup(self):
+        provider, http = self.make(lambda m, u, kw: FakeResponse(200))
+        assert provider.scale_up(
+            PoolSpec("auto-v4-0", "v4", "2x2x2", tier=sched.TIER_SPOT)
+        ) is True
+        [(method, url, kw)] = http.calls
+        assert method == "POST"
+        assert url.endswith("/clusters/demo/node-groups")
+        body = json.loads(kw["data"])
+        assert body["capacityType"] == "SPOT"
+        assert body["scalingConfig"]["desiredSize"] == 2
+        assert kw["headers"]["content-type"] == "application/json"
+        assert kw["headers"]["authorization"].startswith("AWS4-HMAC-SHA256")
+
+    def test_delete_404_is_idempotent(self):
+        provider, http = self.make(lambda m, u, kw: FakeResponse(404))
+        assert provider.scale_down("gone") is False
+
+
+class TestReviewHardening:
+    """Regression coverage for the review findings: lost server-side
+    requests expire, multislice demand sizes its buys, and the read-side
+    freshness generation tracks provider state across restarts."""
+
+    def test_lost_server_side_request_expires_and_rebuys(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            provision_delay_s=30.0
+        )
+        cluster.create(gang("big"))
+        drive(cluster, clock, provider, mgr, 35.0)
+        assert "auto-v4-0" in auto._open
+        # the cloud errors the pool server-side: neither provisioning nor
+        # materialized (the GKE status=ERROR shape)
+        provider._provisioning.clear()
+        drive(cluster, clock, provider, mgr, 35.0)
+        # the stale record expired instead of reporting phantom chips
+        # forever — and the standing demand re-bought, so the gang binds
+        assert metrics.provider_errors.get(op="request_lost") >= 1.0
+        drive(cluster, clock, provider, mgr, 45.0)
+        assert sched.placement_of(cluster.get("Notebook", "big", NS))
+
+    def test_multislice_gang_buys_one_pool_per_slice(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            pools=(("v4", "2x2x1", "pool-a"),),
+            provision_delay_s=5.0, hysteresis_s=10_000.0,
+        )
+        # two 2x2x2 slices and a base pool too small for even one: the gang
+        # is infeasible until TWO slice-shaped pools exist — the buy must
+        # size to num_slices, not stop at the first pool
+        cluster.create(gang("ms", topo="2x2x2", tpu_num_slices=2))
+        drive(cluster, clock, provider, mgr, 90.0)
+        nb = cluster.get("Notebook", "ms", NS)
+        placement = sched.placement_of(nb)
+        assert placement is not None, "multislice gang never bound"
+        assert {s["pool"] for s in placement["slices"]} == {
+            "auto-v4-0", "auto-v4-1",
+        }
+        assert sum(
+            s["value"] for s in metrics.scale_ups.samples()
+        ) == 2.0  # one pool per slice, not an endless single-pool retry
+
+    def test_unbuyable_multislice_demand_never_pins_the_family(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            max_pools=2, provision_delay_s=5.0
+        )
+        # 3 slices > max_pools_per_family: un-buyable within the budget —
+        # it must neither drive purchases nor hold scale-down hostage
+        cluster.create(gang("huge", topo="2x2x2", tpu_num_slices=3))
+        drive(cluster, clock, provider, mgr, 60.0)
+        assert provider.pending() == {}
+        assert metrics.scale_ups.samples() == []
+
+    def test_state_gen_tracks_provider_pending_across_restart(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            provision_delay_s=500.0
+        )
+        cluster.create(gang("big"))
+        drive(cluster, clock, provider, mgr, 40.0)
+        assert auto._open and auto.state_gen >= 1
+        # a fresh incarnation (crash-restart) has no open-request memory,
+        # but its first cycle must still bump the generation past the
+        # cold default: the provider's pending set IS render-visible state
+        # (pending_for falls back to it), so a pre-crash 304 cannot
+        # survive into the fallback window
+        fresh = CapacityReconciler(
+            provider, metrics=metrics, clock=clock,
+            pending_grace_s=20.0, hysteresis_s=60.0,
+        )
+        assert fresh.state_gen == 0
+        fresh._cycle(cluster)
+        assert fresh.state_gen == 1
+        assert fresh.pending_for("v4")["chips"] == 16  # the fallback answer
+
+
+class TestAdapterTypedBoundary:
+    """Every provider-surface status the adapters don't special-case comes
+    back as the typed CloudError the autoscaler catches — a raw HTTPError
+    would abort the whole capacity cycle (quota 403, expired-token 401)."""
+
+    def test_gke_semantic_error_is_typed(self):
+        from kubeflow_tpu.cloud.gcp import GkeNodePoolProvider
+
+        http = FakeHttp(lambda m, u, kw: FakeResponse(403))
+        provider = GkeNodePoolProvider(
+            "proj", "us-central2-b", "demo",
+            session=http, token_provider=lambda: "tok",
+            retry_deadline_s=0.2,
+        )
+        with pytest.raises(cloud.CloudError) as exc:
+            provider.scale_up(PoolSpec("auto-v4-0", "v4", "2x2x2"))
+        assert exc.value.status == 403
+
+    def test_eks_semantic_error_is_typed(self):
+        from kubeflow_tpu.cloud.aws import EksNodeGroupProvider
+
+        http = FakeHttp(lambda m, u, kw: FakeResponse(401))
+        provider = EksNodeGroupProvider(
+            "demo", region="us-west-2", session=http,
+            access_key="ak", secret_key="sk", retry_deadline_s=0.2,
+        )
+        with pytest.raises(cloud.CloudError) as exc:
+            provider.pending()
+        assert exc.value.status == 401
+
+    def test_pending_for_never_calls_the_provider(self):
+        cluster, clock, provider, metrics, auto, mgr = build_world(
+            provision_delay_s=500.0
+        )
+        cluster.create(gang("big"))
+        drive(cluster, clock, provider, mgr, 40.0)
+
+        class _Exploding:
+            def __getattr__(self, name):
+                raise AssertionError(
+                    "pending_for must serve from the cycle snapshot, "
+                    "never a live provider call on the read path"
+                )
+
+        auto.provider = _Exploding()
+        pending = auto.pending_for("v4")
+        assert pending["chips"] == 16

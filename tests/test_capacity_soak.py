@@ -1,0 +1,51 @@
+"""Elastic-capacity chaos soak (docs/capacity.md).
+
+Mirrors the scheduler chaos suite's split (``test_sched_soak.py``): a
+deterministic-replay check, a short tier-1 seed sweep, and the slow-marked
+nightly sweep. Seed ranges are disjoint from the CI workflow's
+``tools/capacity_soak.py`` step (which starts at 26), so the two runs buy
+coverage instead of duplicating it.
+"""
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.capacity.soak import run_capacity_seed
+from kubeflow_tpu.testing.chaos import ChaosConfig
+
+CI_SEEDS = range(1, 26)
+NIGHTLY_SEEDS = range(1, 201)
+
+
+class TestDeterminism:
+    def test_same_seed_identical_run(self):
+        """Everything flows from the seed — fleet, gangs, revocations,
+        provider faults, API faults — so a printed failing seed is a
+        complete bug report."""
+        a = run_capacity_seed(17, ChaosConfig())
+        b = run_capacity_seed(17, ChaosConfig())
+        assert a.fault_counts == b.fault_counts
+        assert a.provider_faults == b.provider_faults
+        assert a.restarts == b.restarts
+        assert (a.scale_ups, a.scale_downs, a.revocations, a.first_chips) \
+            == (b.scale_ups, b.scale_downs, b.revocations, b.first_chips)
+        assert a.violations == b.violations
+
+    def test_fault_free_baseline_converges(self):
+        result = run_capacity_seed(3, None)
+        assert result.ok, result.describe()
+        assert sum(result.fault_counts.values()) == 0
+        assert sum(result.provider_faults.values()) == 0
+
+
+class TestSoak:
+    @pytest.mark.parametrize("seed", CI_SEEDS)
+    def test_seed_converges(self, seed):
+        result = run_capacity_seed(seed, ChaosConfig())
+        assert result.ok, result.describe()
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", NIGHTLY_SEEDS)
+    def test_seed_converges_nightly(self, seed):
+        result = run_capacity_seed(seed, ChaosConfig())
+        assert result.ok, result.describe()

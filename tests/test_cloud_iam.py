@@ -24,9 +24,10 @@ from kubeflow_tpu.runtime.manager import Manager
 
 
 class FakeResponse:
-    def __init__(self, status_code=200, body=None):
+    def __init__(self, status_code=200, body=None, headers=None):
         self.status_code = status_code
         self._body = body if body is not None else {}
+        self.headers = headers or {}
         self.content = json.dumps(self._body).encode()
 
     def json(self):
@@ -276,3 +277,105 @@ class TestPluginWiringEndToEnd:
             sa["metadata"]["annotations"]["iam.gke.io/gcp-service-account"]
             == GCP_SA
         )
+
+
+class TestBoundedRetryDiscipline:
+    """The kubeclient retry contract at the cloud boundary (cloud/__init__):
+    429/5xx and connection resets retry with backoff and Retry-After honored
+    exactly, exhaustion surfaces as the typed RetriesExhausted, and semantic
+    answers never retry. PR 1 gave the K8s client this discipline;
+    ``_post``/``_call`` used to be single-shot raw requests."""
+
+    def _patch_sleeps(self, monkeypatch):
+        from kubeflow_tpu import cloud
+
+        paused, slept = [], []
+        monkeypatch.setattr(cloud, "_pause", paused.append)
+        monkeypatch.setattr(cloud, "_sleep", slept.append)
+        return paused, slept
+
+    def test_gcp_retries_429_honoring_retry_after(self, monkeypatch):
+        paused, slept = self._patch_sleeps(monkeypatch)
+        responses = [
+            FakeResponse(429, headers={"Retry-After": "3"}),
+            FakeResponse(200, {"etag": "x", "bindings": []}),
+        ]
+        http = FakeHttp(lambda url, kw: responses.pop(0))
+        client = GcpIamClient(
+            session=http, token_provider=lambda: "tok",
+            retry_deadline_s=30.0,
+        )
+        policy = client._get_policy(GCP_SA)
+        assert policy == {"etag": "x", "bindings": []}
+        assert len(http.calls) == 2
+        assert slept == [3.0]   # Retry-After honored exactly, not jittered
+        assert paused == []
+
+    def test_gcp_exhaustion_is_typed(self, monkeypatch):
+        from kubeflow_tpu.cloud import RetriesExhausted
+
+        self._patch_sleeps(monkeypatch)
+        http = FakeHttp(lambda url, kw: FakeResponse(500))
+        client = GcpIamClient(
+            session=http, token_provider=lambda: "tok",
+            retry_deadline_s=0.0,  # budget already spent: one attempt
+        )
+        try:
+            client._get_policy(GCP_SA)
+        except RetriesExhausted as exc:
+            assert exc.last_status == 500
+            assert exc.attempts == 1
+        else:
+            raise AssertionError("expected RetriesExhausted")
+
+    def test_gcp_semantic_statuses_never_retry(self, monkeypatch):
+        self._patch_sleeps(monkeypatch)
+        http = FakeHttp(lambda url, kw: FakeResponse(403))
+        client = GcpIamClient(
+            session=http, token_provider=lambda: "tok",
+            retry_deadline_s=30.0,
+        )
+        import requests
+
+        try:
+            client._get_policy(GCP_SA)
+        except requests.HTTPError:
+            pass
+        assert len(http.calls) == 1  # a caller bug is not a transient
+
+    def test_aws_retries_throttle_then_succeeds(self, monkeypatch):
+        paused, slept = self._patch_sleeps(monkeypatch)
+        responses = [
+            FakeResponse(503),
+            FakeResponse(200, {"GetRoleResponse": {"GetRoleResult": {
+                "Role": {"AssumeRolePolicyDocument": ""}}}}),
+        ]
+        http = FakeHttp(lambda url, kw: responses.pop(0))
+        client = AwsIamClient(
+            session=http, access_key="ak", secret_key="sk",
+            oidc_provider_arn="arn:aws:iam::1:oidc-provider/oidc",
+            retry_deadline_s=30.0,
+        )
+        policy = client._get_trust_policy("role")
+        assert policy == {"Version": "2012-10-17", "Statement": []}
+        assert len(http.calls) == 2
+        assert len(paused) == 1  # jittered backoff (no Retry-After header)
+        # each attempt re-signed: SigV4 binds the signature to x-amz-date
+        sigs = [c[1]["headers"]["authorization"] for c in http.calls]
+        assert all(s.startswith("AWS4-HMAC-SHA256") for s in sigs)
+
+    def test_aws_exhaustion_is_typed(self, monkeypatch):
+        from kubeflow_tpu.cloud import RetriesExhausted
+
+        self._patch_sleeps(monkeypatch)
+        http = FakeHttp(lambda url, kw: FakeResponse(429))
+        client = AwsIamClient(
+            session=http, access_key="ak", secret_key="sk",
+            retry_deadline_s=0.0,
+        )
+        try:
+            client._call("GetRole", {"RoleName": "r"})
+        except RetriesExhausted as exc:
+            assert exc.last_status == 429
+        else:
+            raise AssertionError("expected RetriesExhausted")

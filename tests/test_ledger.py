@@ -616,3 +616,101 @@ class TestWebSurfaces:
             headers={"kubeflow-userid": "admin@example.com"},
         ).data)
         assert eff["values"][0]["value"] == 0.5
+
+
+class TestPoolDeathEdgeWindows:
+    """Pools that vanish mid-interval (a spot revocation kill, capacity/)
+    must close their buckets at the last observation before death — the
+    sampling contract: an interval is attributed to the fleet observed at
+    its right edge, so a dead pool accumulates nothing further, its
+    capacity integral freezes with its buckets, and conservation stays
+    exact-integer through death AND rebirth. One test per lifecycle state
+    the kill can interrupt (starting, the suspend barrier, free_usable)."""
+
+    def _kill_pool(self, cluster, pool="pool-a"):
+        for node in list(cluster.list("Node")):
+            name = node["metadata"]["name"]
+            if name.startswith(f"{pool}-"):
+                cluster.delete("Node", name)
+
+    def _frozen_after_death(self, cluster, clock, led, pool="pool-a"):
+        """Kill the pool mid-interval; prove its books freeze and stay
+        conserved."""
+        before = dict(_pool_ms(led, pool))
+        cap_before = led.capacity_totals[pool]
+        clock.advance(0.5)
+        self._kill_pool(cluster, pool)
+        clock.advance(0.5)
+        led.tick(force=True)
+        assert _pool_ms(led, pool) == before  # closed at the death edge
+        assert led.capacity_totals[pool] == cap_before
+        assert sum(before.values()) == cap_before  # conservation, frozen
+        clock.advance(5.0)
+        led.tick(force=True)
+        assert _pool_ms(led, pool) == before  # stays closed
+        assert led.audit() == []
+
+    def test_death_during_starting(self):
+        cluster = _world()
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        _bind(cluster, "nb")  # bound, no runningAt mark: starting
+        led.tick(force=True)
+        clock.advance(2.0)
+        led.tick(force=True)
+        assert _pool_ms(led)[BUCKET_STARTING] == 8 * 2000
+        self._frozen_after_death(cluster, clock, led)
+
+    def test_death_during_suspend_barrier(self):
+        cluster = _world()
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        cluster.create(api.notebook(
+            "nb", NS, tpu_accelerator="v4", tpu_topology="2x2x2"))
+        _bind(cluster, "nb")
+        _running(cluster, "nb")
+        cluster.patch("Notebook", "nb", NS, {"metadata": {"annotations": {
+            sess.SUSPEND_ANNOTATION: sess.encode_suspend_request(
+                sess.REASON_PREEMPTION, 1_000_000.0, 60.0
+            )}}})
+        led.tick(force=True)
+        clock.advance(2.0)
+        led.tick(force=True)
+        assert _pool_ms(led)[BUCKET_SUSPENDING] == 8 * 2000
+        self._frozen_after_death(cluster, clock, led)
+
+    def test_death_during_free_usable(self):
+        cluster = _world()
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        clock.advance(2.0)
+        led.tick(force=True)
+        assert _pool_ms(led)[BUCKET_FREE_USABLE] == 16 * 2000
+        self._frozen_after_death(cluster, clock, led)
+
+    def test_rebirth_resumes_without_double_counting(self):
+        cluster = _world()
+        clock = FakeClock()
+        led = _mk(cluster, clock)
+        led.tick(force=True)
+        clock.advance(2.0)
+        led.tick(force=True)
+        frozen = dict(_pool_ms(led))
+        self._kill_pool(cluster)
+        clock.advance(3.0)
+        led.tick(force=True)
+        assert _pool_ms(led) == frozen  # the dead window attributes nothing
+        # the pool returns (same name — a re-provisioned replacement)
+        make_pool(cluster, "v4", "2x2x4", "pool-a")
+        clock.advance(2.0)
+        led.tick(force=True)
+        after = _pool_ms(led)
+        # the 3s dead window stays unattributed; only the 2s since rebirth
+        # was observed at this tick's right edge accrues... the rebirth tick
+        # itself attributes its whole interval to the reborn fleet
+        assert after[BUCKET_FREE_USABLE] == frozen[BUCKET_FREE_USABLE] + 16 * 2000
+        assert sum(after.values()) == led.capacity_totals["pool-a"]
+        assert led.audit() == []

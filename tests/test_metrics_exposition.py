@@ -152,11 +152,23 @@ def combined_registry() -> Registry:
     from kubeflow_tpu.obs.slo import SLOMetrics
     from kubeflow_tpu.obs.timeline import TimelineRecorder
 
+    from kubeflow_tpu.utils.metrics import CapacityMetrics
+
     nm = NotebookMetrics()
     sm = SchedulerMetrics(nm.registry)
     cpm = ControlPlaneMetrics(nm.registry)
     sessm = SessionMetrics(nm.registry)
     slo = SLOMetrics(nm.registry)
+    capm = CapacityMetrics(nm.registry)
+    # every capacity family populated so the exposition lint sees samples
+    capm.scale_ups.inc(family="v4", tier="spot")
+    capm.scale_downs.inc(family="v4")
+    capm.revocations.inc(family="v4")
+    capm.provider_errors.inc(op="scale_up")
+    capm.open_requests.set(1.0)
+    capm.pending_chips.set(16.0, family="v4")
+    capm.decision_latency.observe(2.0)
+    capm.observe_first_chip(42.0)
     wq_gauge = nm.registry.gauge(
         "workqueue_stat", "Reconcile workqueue counters (native core)"
     )
@@ -269,6 +281,7 @@ class TestExpositionFormat:
             "session_resume_seconds",
             "session_startup_seconds",
             "session_startup_phase_seconds",
+            "capacity_time_to_first_chip_seconds",
         ):
             assert families[name]["type"] == "histogram", name
         # the SLO families (obs/slo.py) ride the same registry: the burn

@@ -184,4 +184,16 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    import sys
+
+    if "--mfu" in sys.argv:
+        # MFU_BENCH arm: the same ResNet cell under the placement-derived
+        # SPMD mesh (kubeflow_tpu/spmd/mesh.py derivation), gated against
+        # benchmarks/mfu_baseline.json. benchmarks/bench_mfu.py owns it;
+        # bench.py stays the driver's single entrypoint, so this arm just
+        # forwards the remaining argv (e.g. --topology, --check-against).
+        from benchmarks.bench_mfu import main as mfu_main
+
+        argv = [a for a in sys.argv[1:] if a != "--mfu"]
+        sys.exit(mfu_main(argv))
     main()

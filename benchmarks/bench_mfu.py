@@ -1,0 +1,292 @@
+"""MFU_BENCH: ResNet training throughput under the placement-derived mesh.
+
+The SPMD runtime's promise (docs/spmd.md) is that a gang's mesh derives
+deterministically from the placement cuboid alone. This bench closes the
+loop from derivation to throughput: it derives a
+:class:`kubeflow_tpu.spmd.mesh.DerivedMesh` from an (accelerator, topology,
+numSlices) triple — the exact inputs a pod reads from its injected env —
+builds the jax Mesh over that derivation's data-parallel projection
+(``to_data_plan``: the ResNet cell has no model axis to feed, so the
+intra-host block ZeRO-shards params instead), feeds it topology-aware
+per-host batches (``spmd.mesh.per_host_batch``), and times the same train
+step ``bench.py`` ships — then gates img/s/chip against the committed
+``benchmarks/mfu_baseline.json``.
+
+Multi-process is SIMULATED: every "host" of the gang lives in this one
+process via ``--xla_force_host_platform_device_count`` (set before the
+backend initializes), so the mesh spans num_hosts x chips_per_host forced
+host devices and the program's collective structure — batch over
+dcn x data x fsdp, per-layer param all-gathers over the intra-host block —
+is exactly the real gang's. On a real slice each pod runs the same
+derivation from its own env (``spmd.bootstrap.read_env``), calls
+``jax.distributed.initialize(ctx.coordinator, ctx.num_processes,
+ctx.process_id)`` first, and builds the identical mesh over the global
+device list; that path is documented in docs/spmd.md "running under the
+derived mesh" and exercised end-to-end by tests/test_distributed_e2e.py.
+
+Two arms, one gate:
+- ``single``: the same model on ONE device — the committed normalizer;
+- ``mesh``:   the derived mesh over all num_devices devices.
+The gate metric is the mesh arm's img/s/chip vs the committed baseline
+(floor = baseline * (1 - tolerance)). CPU "chips" share the runner's cores,
+so mesh-arm per-chip throughput sits well below the single arm — the
+baseline records the actuals and scaling_efficiency is reported for
+visibility, not gated. MFU itself is reported only when the device peak is
+known (TPU generations); on CPU it is null and img/s/chip carries the gate.
+
+Timing reuses the round-4 estimator (benchmarks/_timing.py: short/long
+windows ending in one readback, min over repeats, rate from the
+difference) without the phase-walk sleeps — this is a local backend, there
+is no shared tunnel to dodge.
+
+Prints ONE line: ``MFU_BENCH {json}``.
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+# bf16 peak FLOP/s per chip by TPU generation (mirror of bench.py's table —
+# bench.py stays single-file on purpose; change both)
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+}
+
+
+def chip_peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return None  # unknown (CPU sim): report null MFU, gate on img/s/chip
+
+
+def _force_devices(n: int) -> None:
+    """Ask XLA's host platform for n devices; must run before the backend
+    initializes (importing jax is fine — clients are created lazily)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _flops_per_step(step, state, batch) -> float | None:
+    """Compiler-reported FLOPs for one train step (the honest numerator for
+    MFU — no analytic model-shape bookkeeping to drift)."""
+    try:
+        cost = step.lower(state, batch).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def _measure_arm(mesh, *, per_arm_batch, image, k_inner, n_short, n_long,
+                 repeats, seed):
+    """Build the shipped ResNet train step on ``mesh`` and return
+    (imgs_per_sec, flops_per_step). CPU-scale cell: ResNet-18 depths at
+    width 16, 32px images — the conv/BN/optimizer structure of the headline
+    bench at a size CI can afford."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from benchmarks._timing import min_window_step_seconds
+    from kubeflow_tpu.models.resnet import ResNet18
+    from kubeflow_tpu.parallel import mesh as meshlib
+    from kubeflow_tpu.parallel.train import make_classifier_train_step
+
+    model = ResNet18(num_classes=100, width=16, dtype=jnp.float32)
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    bundle = make_classifier_train_step(model, tx, mesh)
+
+    rng = np.random.default_rng(seed)
+    batch = {
+        "image": jnp.asarray(
+            rng.standard_normal((per_arm_batch, image, image, 3)),
+            jnp.float32,
+        ),
+        "label": jnp.asarray(rng.integers(0, 100, per_arm_batch), jnp.int32),
+    }
+    sh = {k: meshlib.batch_sharding(mesh) for k in batch}
+    batch = jax.device_put(batch, sh)
+    state = bundle.init(jax.random.PRNGKey(seed), batch)
+    flops = _flops_per_step(bundle.step, state, batch)
+
+    # K steps per dispatch over the SAME jitted step (bench.py's amortizer);
+    # the scan body is unchanged HLO in a loop
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi_step(state, batch):
+        def body(s, _):
+            s2, metrics = bundle.step(s, batch)
+            return s2, metrics["loss"]
+
+        s, losses = jax.lax.scan(body, state, None, length=k_inner)
+        return s, losses[-1]
+
+    carry = {"state": state}
+
+    def window(n: int) -> float:
+        t = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            carry["state"], loss = multi_step(carry["state"], batch)
+        float(loss)  # one readback per window; the fixed cost cancels
+        return time.perf_counter() - t
+
+    window(n_short)  # compile + warm
+    window(n_long)
+    sec_per_dispatch, _, _ = min_window_step_seconds(
+        window, n_short, n_long, repeats
+    )
+    step_s = sec_per_dispatch / k_inner
+    return per_arm_batch / step_s, flops
+
+
+def run(args) -> dict:
+    from kubeflow_tpu.spmd import mesh as spmd_mesh
+
+    # derivation is pure python — do it before jax so the device count the
+    # topology implies can still be forced onto the host platform
+    dm = spmd_mesh.derive(args.accelerator, args.topology, args.num_slices)
+    if not args.native:
+        _force_devices(dm.num_devices)
+
+    import jax
+
+    from kubeflow_tpu.parallel import mesh as meshlib
+
+    devices = jax.devices()
+    if len(devices) < dm.num_devices:
+        raise SystemExit(
+            f"{args.accelerator}:{args.topology} x{args.num_slices} needs "
+            f"{dm.num_devices} devices, have {len(devices)} — set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={dm.num_devices} "
+            f"before the backend initializes (or pass a smaller --topology)"
+        )
+    devices = devices[: dm.num_devices]
+
+    global_batch = args.per_chip_batch * dm.num_devices
+    host_batch = spmd_mesh.per_host_batch(dm, global_batch)
+
+    timing = dict(
+        image=args.image, k_inner=args.k_inner, n_short=args.n_short,
+        n_long=args.n_long, repeats=args.repeats, seed=args.seed,
+    )
+    single_ips, flops = _measure_arm(
+        meshlib.create_mesh(meshlib.MeshPlan(data=1), devices[:1]),
+        per_arm_batch=args.per_chip_batch, **timing,
+    )
+    mesh = spmd_mesh.build_mesh(dm, devices, data_parallel=True)
+    mesh_ips, mesh_flops = _measure_arm(
+        mesh, per_arm_batch=global_batch, **timing,
+    )
+
+    per_chip = mesh_ips / dm.num_devices
+    peak = chip_peak_flops(devices[0])
+    mfu = None
+    if peak and mesh_flops:
+        mfu = (mesh_flops / global_batch) * per_chip / peak
+
+    return {
+        "bench": "MFU_BENCH",
+        "accelerator": dm.accelerator,
+        "topology": dm.topology,
+        "num_slices": dm.num_slices,
+        "axes": dm.axes(),
+        "n_devices": dm.num_devices,
+        "global_batch": global_batch,
+        "per_host_batch": host_batch,
+        "per_chip_batch": args.per_chip_batch,
+        "image": args.image,
+        "imgs_per_sec_per_chip": round(per_chip, 2),
+        "imgs_per_sec_per_chip_single": round(single_ips, 2),
+        "scaling_efficiency": round(per_chip / single_ips, 4),
+        "train_flops_per_image": round(mesh_flops / global_batch)
+        if mesh_flops else None,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "backend": jax.default_backend(),
+    }
+
+
+def check_against(result: dict, baseline_path: str, tolerance: float) -> int:
+    """CI perf gate: fail when the derived-mesh img/s/chip regressed beyond
+    tolerance against the committed baseline (benchmarks/mfu_baseline.json).
+    """
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    base = float(baseline["imgs_per_sec_per_chip"])
+    new = float(result["imgs_per_sec_per_chip"])
+    floor = base * (1.0 - tolerance)
+    verdict = "ok" if new >= floor else "REGRESSED"
+    print(
+        f"MFU_BENCH gate: {new:.1f} img/s/chip on the derived mesh vs "
+        f"baseline {base:.1f} (floor {floor:.1f} at {tolerance:.0%} "
+        f"tolerance) {verdict}",
+        file=sys.stderr,
+    )
+    if verdict == "REGRESSED":
+        print(
+            "PERF GATE FAILED: ResNet throughput under the placement-derived "
+            "mesh regressed — either fix the regression (mesh derivation, "
+            "device ordering, train-step sharding) or re-record "
+            "benchmarks/mfu_baseline.json with a justified new number",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--accelerator", default="v4",
+                    help="accelerator short name (default v4)")
+    ap.add_argument("--topology", default="2x2x2",
+                    help="slice chip cuboid, e.g. 2x2x2 (default: 8 chips "
+                         "= 2 hosts x 4 chips — fits CI's 8 forced devices)")
+    ap.add_argument("--num-slices", type=int, default=1)
+    ap.add_argument("--per-chip-batch", type=int, default=8)
+    ap.add_argument("--image", type=int, default=32,
+                    help="image side (default 32: CPU-affordable cell)")
+    ap.add_argument("--k-inner", type=int, default=4,
+                    help="train steps per dispatch (scan length)")
+    ap.add_argument("--n-short", type=int, default=1)
+    ap.add_argument("--n-long", type=int, default=3)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--native", action="store_true",
+                    help="don't force CPU host devices — run on whatever "
+                         "backend jax picks (real-TPU path)")
+    ap.add_argument("--check-against", metavar="BASELINE_JSON",
+                    help="compare img/s/chip against a committed baseline "
+                         "and exit 1 on regression beyond --tolerance")
+    ap.add_argument("--tolerance", type=float, default=0.50,
+                    help="allowed fractional regression for --check-against "
+                         "(default 0.50 — CPU-sim noise band, see "
+                         "benchmarks/mfu_baseline.json note)")
+    args = ap.parse_args(argv)
+    result = run(args)
+    print("MFU_BENCH " + json.dumps(result, sort_keys=True))
+    if args.check_against:
+        return check_against(result, args.check_against, args.tolerance)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

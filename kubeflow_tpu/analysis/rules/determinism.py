@@ -19,6 +19,10 @@ SCOPED_DIRS = (
     # autoscaler runs on the injected clock and the fake provider draws
     # every fault from its own seeded stream
     "kubeflow_tpu/capacity/",
+    # the SPMD runtime's whole contract is that every host derives the same
+    # mesh/identity from its env alone — any nondeterminism here desyncs a
+    # gang, and the soak audit (spmd/fanout.py) replays from the seed
+    "kubeflow_tpu/spmd/",
 )
 
 WALL_CLOCK_CALLS = {

@@ -40,6 +40,8 @@ from kubeflow_tpu.runtime.fake import (
     NotFound,
 )
 from kubeflow_tpu.runtime.manager import Reconciler, Result
+from kubeflow_tpu.spmd import fanout as spmd_fanout
+from kubeflow_tpu.spmd.fanout import SPMD_MESH_ANNOTATION
 from kubeflow_tpu.tpu import topology as tputopo
 from kubeflow_tpu.utils.config import ControllerConfig
 from kubeflow_tpu.webhooks.tpu_env import (
@@ -822,6 +824,13 @@ def _tpu_pod_annotations(
         if num_slices > 1:
             anns[SLICE_ANNOTATION] = str(slice_id or 0)
             anns[NUM_SLICES_ANNOTATION] = str(num_slices)
+        # the derived mesh every host of the gang will build
+        # (spmd/mesh.py rule); from the bound placement's cuboid when one
+        # exists, from the requested topology otherwise — so re-binds and
+        # resumes re-render it from the live placement automatically
+        anns[SPMD_MESH_ANNOTATION] = spmd_fanout.mesh_annotation_value(
+            topo, num_slices, placement_slice
+        )
         if placement_slice is not None and placement_slice.get("nodes"):
             import json
 

@@ -364,10 +364,7 @@ def _expert_compute_a2a(expert_in, wi, wo, mesh):
     B/(dp*fsdp*ep)); first a2a → [E/ep, b*ep, C, M] (local experts, the
     expert group's batch); local megatron-style FFN (wi column-, wo
     row-split over ``tensor``, psum); second a2a returns [E, b, C, M]."""
-    try:
-        from jax import shard_map  # jax >= 0.8
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from kubeflow_tpu.parallel import compat
 
     tp = mesh.shape.get("tensor", 1)
     batch_axes = tuple(
@@ -386,7 +383,8 @@ def _expert_compute_a2a(expert_in, wi, wo, mesh):
             out, "expert", split_axis=1, concat_axis=0, tiled=True
         )
 
-    specs = dict(
+    mapped = compat.shard_map(
+        body,
         mesh=mesh,
         in_specs=(
             P(None, batch_axes, None, None),
@@ -394,11 +392,8 @@ def _expert_compute_a2a(expert_in, wi, wo, mesh):
             P("expert", "tensor", None),
         ),
         out_specs=P(None, batch_axes, None, None),
+        check_vma=False,
     )
-    try:  # jax >= 0.8 renamed the replication-check flag
-        mapped = shard_map(body, check_vma=False, **specs)
-    except TypeError:  # pragma: no cover
-        mapped = shard_map(body, check_rep=False, **specs)
     return mapped(expert_in, wi, wo)
 
 

@@ -39,6 +39,7 @@ from kubeflow_tpu.models.transformer import (
     TransformerConfig,
     lm_loss,
 )
+from kubeflow_tpu.parallel import compat
 
 
 class PipelineStage(nn.Module):
@@ -174,7 +175,7 @@ def _pipelined(stage_fn, mesh: Mesh, n_stages: int, n_micro: int):
             jnp.where(idx == n_stages - 1, outputs, zeros), "stage"
         )
 
-    return jax.shard_map(
+    return compat.shard_map(
         body,
         mesh=mesh,
         in_specs=(P("stage"), P(None, batch_axes), P(None)),

@@ -38,6 +38,7 @@ from kubeflow_tpu.ops.pallas_attention import (
     _flash_backward,
     _flash_forward,
 )
+from kubeflow_tpu.parallel import compat
 
 
 def _merge(o, lse, o_r, lse_r):
@@ -67,8 +68,11 @@ def _chunk_fwd(q, k, v, causal, block, interpret):
 def _ring_fwd_local(q, k, v, *, axis_name, causal, block, interpret):
     """Forward ring (shard_map body, BHSD layout). Returns (o bf16, lse)."""
     B, H, S, D = q.shape
-    n = lax.axis_size(axis_name)
-    my_idx = lax.axis_index(axis_name)
+    n = compat.axis_size(axis_name)
+    # only the causal schedule needs the shard's ring position; emitting a
+    # dead axis_index in the non-causal program trips some builds' SPMD
+    # partitioner (PartitionId outside the manual region)
+    my_idx = lax.axis_index(axis_name) if causal else None
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def full_chunk(k_cur, v_cur):
@@ -85,8 +89,8 @@ def _ring_fwd_local(q, k, v, *, axis_name, causal, block, interpret):
 
     def step(carry, r):
         o, lse, k_cur, v_cur = carry
-        src = (my_idx - r) % n
         if causal:
+            src = (my_idx - r) % n
             branch = jnp.where(src < my_idx, 0, jnp.where(src == my_idx, 1, 2))
             o_r, lse_r = lax.switch(
                 branch, (full_chunk, diag_chunk, empty_chunk), k_cur, v_cur
@@ -111,8 +115,8 @@ def _ring_bwd_local(q, k, v, o, lse, do, *, axis_name, causal, block,
     probabilities are globally normalized); dk/dv f32 accumulators rotate
     with k/v and complete the circle back to each chunk's owner."""
     B, H, S, D = q.shape
-    n = lax.axis_size(axis_name)
-    my_idx = lax.axis_index(axis_name)
+    n = compat.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name) if causal else None  # as in forward
     perm = [(i, (i + 1) % n) for i in range(n)]
     # [B,H,S,1] -> the kernels' LSE_LANES-replicated layout; guard all-empty
     # rows (only possible non-causally with a fully-masked input, but cheap)
@@ -141,8 +145,8 @@ def _ring_bwd_local(q, k, v, o, lse, do, *, axis_name, causal, block,
 
     def step(carry, r):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
-        src = (my_idx - r) % n
         if causal:
+            src = (my_idx - r) % n
             branch = jnp.where(src < my_idx, 0, jnp.where(src == my_idx, 1, 2))
             dq_r, dk_r, dv_r = lax.switch(
                 branch, (full_chunk, diag_chunk, empty_chunk), k_cur, v_cur
@@ -228,7 +232,7 @@ def shard_map_attention(
     if interpret is None:
         interpret = _auto_interpret()
     body = _ring_local_factory(axis_name, causal, block, interpret)
-    return jax.shard_map(
+    return compat.shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec),

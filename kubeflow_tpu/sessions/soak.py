@@ -660,6 +660,13 @@ def run_session_seed(
     # startup timeline gap-free and phase-partitioned (restore time lands
     # in the sessions-owned 'restoring' phase)
     violations.extend(audit_timeline(base, where="final"))
+    # SPMD gang-identity audit (docs/spmd.md): with the scheduler live,
+    # additionally proves the placement side — a resumed gang's replicas and
+    # derived-mesh annotation come from the RE-BOUND placement's cuboid, and
+    # the suspend handoff never leaves two pods claiming one worker id
+    from kubeflow_tpu.spmd.fanout import audit_spmd
+
+    violations.extend(audit_spmd(base, where="final"))
     if chaos is not None:
         # lost-update audit (docs/chaos.md): the suspend/resume barrier's
         # one-write discipline checked at every commit's base rv

@@ -1,0 +1,32 @@
+"""Multi-host SPMD notebook runtime — the workload half of the L0/L3 contract.
+
+The scheduler half of the platform binds a gang to a torus cuboid
+(``scheduler/``); this package is the other half: the notebook that lands on
+that cuboid learns its own topology and turns it into a JAX mesh with zero
+user configuration.
+
+    placement cuboid ──(controller fan-out + admission env)──► pod env
+    pod env ──(spmd.bootstrap.read_env)──► SpmdContext
+    SpmdContext.mesh ──(spmd.mesh.build_mesh)──► jax.sharding.Mesh
+
+- ``spmd.mesh``      deterministic cuboid-shape → mesh-axes derivation
+- ``spmd.bootstrap`` in-pod env parsing with typed errors; resume re-read
+- ``spmd.fanout``    controller-side derived-mesh annotation + the per-seed
+                     soak audit (gap-free worker ids, coordinator agreement,
+                     headless-Service rendezvous)
+
+Everything here is deterministic and unit-testable without TPUs: mesh
+derivation is pure math on validated topologies, bootstrap takes the env as
+an injected mapping, and the audit reads the fake cluster's store.
+"""
+from kubeflow_tpu.spmd.bootstrap import SpmdContext, SpmdEnvError, read_env
+from kubeflow_tpu.spmd.mesh import DerivedMesh, derive, from_placement_slice
+
+__all__ = [
+    "DerivedMesh",
+    "SpmdContext",
+    "SpmdEnvError",
+    "derive",
+    "from_placement_slice",
+    "read_env",
+]

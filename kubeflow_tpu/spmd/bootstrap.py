@@ -1,0 +1,232 @@
+"""In-pod SPMD bootstrap: injected env → typed context → derived mesh.
+
+Extends ``parallel/bootstrap.py`` (which tolerantly parses the env and joins
+``jax.distributed``) with the strict, typed half the runtime contract needs:
+
+- ``read_env(env)`` takes the environment as an *injected mapping* — unit
+  tests exercise every malformed-env path without a TPU or a subprocess, and
+  **resume-after-suspend is literally a re-read**: the pod a resumed gang
+  gets was re-admitted against the re-bound placement, so calling
+  ``read_env`` again yields the new worker identity (same rule, possibly a
+  different pool's cuboid). Nothing is cached at module level.
+- malformed env raises :class:`SpmdEnvError` (a ValueError) naming the exact
+  variable, instead of an ``int()`` traceback five frames into user code;
+- the context carries the :class:`~kubeflow_tpu.spmd.mesh.DerivedMesh` every
+  host derives identically from (accelerator, topology, numSlices) alone —
+  no cross-host negotiation, so a restarted worker re-derives the same mesh
+  its peers already hold;
+- ``validate_gang`` checks a set of contexts for the gang-level invariants
+  (gap-free ids, no collisions, one coordinator) — the same predicate the
+  soak audit applies to live pods (``spmd/fanout.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+from kubeflow_tpu.spmd import mesh as spmd_mesh
+
+__all__ = [
+    "SpmdEnvError",
+    "SpmdContext",
+    "read_env",
+    "validate_gang",
+    "local_mesh",
+]
+
+
+class SpmdEnvError(ValueError):
+    """The injected worker-identity env violates the admission contract.
+
+    Raised (not returned) so a mis-injected pod fails loudly at bootstrap
+    with the variable named, rather than joining the gang under a wrong
+    identity and corrupting the collective.
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class SpmdContext:
+    """One host's validated SPMD identity, as admission injected it."""
+
+    worker_id: int                    # ordinal within THIS slice
+    hostnames: tuple[str, ...]        # this slice's stable DNS names
+    num_processes: int                # GLOBAL (hosts x slices)
+    process_id: int                   # GLOBAL (slice_id * hosts + worker_id)
+    coordinator: str | None           # host:port of slice 0's host 0
+    slice_id: int
+    num_slices: int
+    topology: str | None              # e.g. "2x2x2"
+    accelerator_type: str | None      # e.g. "v4-16" (slice name)
+    mesh: spmd_mesh.DerivedMesh | None   # None when topology env is absent
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_processes > 1
+
+
+def _int_env(env: Mapping[str, str], key: str, default: int | None = None) -> int:
+    raw = env.get(key)
+    if raw is None:
+        if default is None:
+            raise SpmdEnvError(f"{key} is required but missing")
+        return default
+    try:
+        value = int(raw)
+    except (TypeError, ValueError):
+        raise SpmdEnvError(f"{key}={raw!r} is not an integer") from None
+    return value
+
+
+def _accelerator_name(slice_name: str) -> str:
+    # TPU_ACCELERATOR_TYPE carries the marketing slice name ("v4-16"); the
+    # generation short name is everything before the core/chip count
+    return slice_name.rsplit("-", 1)[0] if "-" in slice_name else slice_name
+
+
+def read_env(env: Mapping[str, str] | None = None) -> SpmdContext | None:
+    """Parse + validate the injected env; None when not on a TPU slice.
+
+    ``env`` defaults to ``os.environ`` in the pod; tests (and the resume
+    path, which re-reads after the re-bound placement re-admitted the pod)
+    pass an explicit mapping.
+    """
+    if env is None:
+        import os
+
+        env = os.environ
+    if "TPU_WORKER_ID" not in env:
+        return None  # not a slice pod; nothing to bootstrap
+
+    worker_id = _int_env(env, "TPU_WORKER_ID")
+    if worker_id < 0:
+        raise SpmdEnvError(f"TPU_WORKER_ID={worker_id} is negative")
+    hostnames = tuple(
+        h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h
+    )
+    if hostnames and worker_id >= len(hostnames):
+        raise SpmdEnvError(
+            f"TPU_WORKER_ID={worker_id} out of range for "
+            f"{len(hostnames)} TPU_WORKER_HOSTNAMES"
+        )
+    num_slices = _int_env(env, "MEGASCALE_NUM_SLICES", 1)
+    slice_id = _int_env(env, "MEGASCALE_SLICE_ID", 0)
+    if num_slices < 1 or not (0 <= slice_id < num_slices):
+        raise SpmdEnvError(
+            f"MEGASCALE_SLICE_ID={slice_id} not in [0, "
+            f"{num_slices}=MEGASCALE_NUM_SLICES)"
+        )
+
+    topology = env.get("TPU_TOPOLOGY")
+    accel_type = env.get("TPU_ACCELERATOR_TYPE")
+    mesh = None
+    if topology and accel_type:
+        try:
+            mesh = spmd_mesh.derive(
+                _accelerator_name(accel_type), topology, num_slices
+            )
+        except ValueError as e:
+            raise SpmdEnvError(
+                f"TPU_ACCELERATOR_TYPE={accel_type!r} / "
+                f"TPU_TOPOLOGY={topology!r}: {e}"
+            ) from None
+
+    default_procs = mesh.num_processes if mesh else max(1, len(hostnames))
+    num_processes = _int_env(env, "JAX_NUM_PROCESSES", default_procs)
+    process_id = _int_env(
+        env, "JAX_PROCESS_ID",
+        (mesh.num_hosts if mesh else len(hostnames) or 1) * slice_id
+        + worker_id,
+    )
+
+    if mesh is not None:
+        if hostnames and len(hostnames) != mesh.num_hosts:
+            raise SpmdEnvError(
+                f"{len(hostnames)} TPU_WORKER_HOSTNAMES for a "
+                f"{mesh.num_hosts}-host {mesh.topology} slice"
+            )
+        if worker_id >= mesh.num_hosts:
+            raise SpmdEnvError(
+                f"TPU_WORKER_ID={worker_id} out of range for a "
+                f"{mesh.num_hosts}-host {mesh.topology} slice"
+            )
+        if num_processes != mesh.num_processes:
+            raise SpmdEnvError(
+                f"JAX_NUM_PROCESSES={num_processes} but the "
+                f"{mesh.topology} x{num_slices} placement has "
+                f"{mesh.num_processes} hosts"
+            )
+        expected_pid = slice_id * mesh.num_hosts + worker_id
+        if process_id != expected_pid:
+            raise SpmdEnvError(
+                f"JAX_PROCESS_ID={process_id} inconsistent with "
+                f"slice {slice_id} worker {worker_id} "
+                f"(expected {expected_pid})"
+            )
+    if not (0 <= process_id < num_processes):
+        raise SpmdEnvError(
+            f"JAX_PROCESS_ID={process_id} not in [0, {num_processes})"
+        )
+
+    coordinator = env.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes > 1 and not coordinator:
+        raise SpmdEnvError(
+            "multi-host slice without JAX_COORDINATOR_ADDRESS — the gang "
+            "cannot rendezvous"
+        )
+    return SpmdContext(
+        worker_id=worker_id,
+        hostnames=hostnames,
+        num_processes=num_processes,
+        process_id=process_id,
+        coordinator=coordinator,
+        slice_id=slice_id,
+        num_slices=num_slices,
+        topology=topology,
+        accelerator_type=accel_type,
+        mesh=mesh,
+    )
+
+
+def validate_gang(contexts: list[SpmdContext]) -> list[str]:
+    """Gang-level invariants over one slice-or-job's worth of contexts.
+
+    The collision/gap predicate shared by the restart test (a restarted pod
+    must come back as the SAME worker, never a duplicate of a peer) and the
+    soak audit's per-pod env checks. Returns violations, empty when clean.
+    """
+    out: list[str] = []
+    if not contexts:
+        return out
+    by_pid: dict[int, int] = {}
+    for ctx in contexts:
+        by_pid[ctx.process_id] = by_pid.get(ctx.process_id, 0) + 1
+    dupes = sorted(pid for pid, n in by_pid.items() if n > 1)
+    if dupes:
+        out.append(f"worker-id collision: process ids {dupes} held twice")
+    want = contexts[0].num_processes
+    if any(c.num_processes != want for c in contexts):
+        out.append(
+            "hosts disagree on JAX_NUM_PROCESSES: "
+            f"{sorted({c.num_processes for c in contexts})}"
+        )
+    elif len(contexts) == want:
+        missing = sorted(set(range(want)) - set(by_pid))
+        if missing:
+            out.append(f"worker-id assignment has gaps: missing {missing}")
+    coords = sorted({c.coordinator for c in contexts if c.coordinator})
+    if len(coords) > 1:
+        out.append(f"hosts disagree on the coordinator: {coords}")
+    return out
+
+
+def local_mesh(ctx: SpmdContext, devices=None):
+    """The jax Mesh this host should build — identical on every host.
+
+    Call after ``parallel.bootstrap.auto_initialize()`` (so ``jax.devices()``
+    spans the whole gang); tests pass forced-CPU devices directly.
+    """
+    if ctx.mesh is None:
+        raise SpmdEnvError(
+            "cannot build a mesh without TPU_TOPOLOGY/TPU_ACCELERATOR_TYPE"
+        )
+    return spmd_mesh.build_mesh(ctx.mesh, devices)

@@ -1,0 +1,258 @@
+"""Controller-side SPMD fan-out surface + the per-seed gang-identity audit.
+
+The notebook controller renders one StatefulSet per slice with
+``replicas == num_hosts`` and stamps each pod template with the derived-mesh
+annotation below; admission (``webhooks/tpu_env.py``) then gives each pod its
+worker identity. This module owns the pieces both sides and the soaks share:
+
+- :data:`SPMD_MESH_ANNOTATION` — the canonical derived-mesh JSON on every
+  slice pod, rendered from the *bound placement* when one exists (the
+  placement is the authority once bound; its cuboid may be a rotation of the
+  requested topology) and from the requested topology otherwise;
+- :func:`audit_spmd` — the per-seed soak invariant: every multi-host gang's
+  pods carry a consistent worker-id assignment (``TPU_WORKER_ID`` == pod
+  ordinal, global process ids gap-free when the gang is fully Running, one
+  coordinator, one mesh), and the headless rendezvous Service exists with
+  ``publishNotReadyAddresses`` wherever a gang has pods up. Runs against the
+  fake cluster's store alone, so it holds in the chaos soak (no scheduler —
+  env checks still bind) and the sessions soak (placements present —
+  placement agreement also binds).
+"""
+from __future__ import annotations
+
+import json
+
+from kubeflow_tpu.spmd import bootstrap as spmd_bootstrap
+from kubeflow_tpu.spmd import mesh as spmd_mesh
+
+# Canonical derived-mesh JSON (sort_keys) on every slice pod template.
+# Owned here; the controller stamps it, the JWA detail view and the soak
+# audit re-derive and compare (TPU004: the key lives in exactly one place).
+SPMD_MESH_ANNOTATION = "tpu.kubeflow.org/spmd-mesh"
+
+__all__ = ["SPMD_MESH_ANNOTATION", "mesh_annotation_value", "audit_spmd"]
+
+
+def mesh_annotation_value(
+    topo, num_slices: int = 1, placement_slice: dict | None = None
+) -> str:
+    """The annotation payload for one slice's pod template.
+
+    Prefers the bound placement's cuboid (what the gang actually sits on);
+    falls back to the requested topology for unscheduled/adopted gangs.
+    """
+    if placement_slice is not None:
+        try:
+            dm = spmd_mesh.from_placement_slice(placement_slice, num_slices)
+            return json.dumps(dm.to_dict(), sort_keys=True)
+        except ValueError:
+            pass  # malformed slice: fall back to the spec'd topology
+    dm = spmd_mesh.from_topology(topo, num_slices)
+    return json.dumps(dm.to_dict(), sort_keys=True)
+
+
+def _pod_env(pod: dict) -> dict[str, str]:
+    """First workload container's env as a dict (sidecars excluded)."""
+    for c in pod.get("spec", {}).get("containers", []):
+        if c.get("name") in ("istio-proxy",):
+            continue
+        return {
+            e["name"]: e.get("value", "")
+            for e in c.get("env", [])
+            if "name" in e
+        }
+    return {}
+
+
+def _ordinal(pod_name: str) -> int | None:
+    base, _, tail = pod_name.rpartition("-")
+    return int(tail) if base and tail.isdigit() else None
+
+
+def audit_spmd(cluster, *, where: str = "") -> list[str]:
+    """Per-seed invariant: gang worker identity is consistent and gap-free.
+
+    For every TPU notebook that fans out (multi-host or multislice):
+
+    1. every existing slice pod's injected env parses (``read_env``) and its
+       ``TPU_WORKER_ID`` equals its StatefulSet ordinal — a restarted pod
+       re-admitted under the same name MUST come back as the same worker;
+    2. slice/process arithmetic matches the CR: ``JAX_NUM_PROCESSES`` =
+       hosts x slices, ``JAX_PROCESS_ID`` = slice_id x hosts + ordinal;
+    3. all pods of the gang agree on one coordinator address;
+    4. when the gang is fully Running, global process ids are exactly
+       ``0..hosts*slices-1`` — no gaps, no collisions (churn mid-kill leaves
+       gaps legitimately; a *complete* Running gang may not);
+    5. a bound gang's replica count and mesh annotation derive from its
+       placement cuboid (hosts agreement — the placement is the authority);
+    6. any gang with pods up has its headless rendezvous Service, with
+       ``publishNotReadyAddresses`` (worker 0 must resolve before Ready).
+
+    Pure store read; deterministic; returns violations (empty = clean).
+    """
+    from kubeflow_tpu import scheduler as sched
+    from kubeflow_tpu.api import types as api
+    from kubeflow_tpu.runtime import objects as ko
+    from kubeflow_tpu.tpu import topology as tputopo
+
+    out: list[str] = []
+    for nb in cluster.list("Notebook"):
+        try:
+            topo = api.notebook_topology(nb)
+        except ValueError:
+            continue  # invalid spec is admission's problem, not fan-out's
+        if topo is None:
+            continue
+        num_slices = api.notebook_num_slices(nb)
+        if not topo.is_multi_host and num_slices <= 1:
+            continue  # single-host single-slice: localhost identity, no gang
+        name, ns = ko.name(nb), ko.namespace(nb)
+        key = f"{ns}/{name}"
+        placement = sched.placement_of(nb)
+        p_slices = (placement or {}).get("slices") or []
+        hosts = topo.num_hosts
+        total = hosts * num_slices
+
+        contexts: list[spmd_bootstrap.SpmdContext] = []
+        pods_seen = 0
+        running = 0
+        replicas_up = 0
+        for j in range(num_slices):
+            sts_name = name if num_slices == 1 else f"{name}-s{j}"
+            sts = cluster.try_get("StatefulSet", sts_name, ns)
+            if sts is None:
+                continue
+            replicas = (sts.get("spec") or {}).get("replicas", 0)
+            replicas_up += replicas
+            if replicas and j < len(p_slices):
+                try:
+                    dm = spmd_mesh.from_placement_slice(p_slices[j], num_slices)
+                except ValueError:
+                    dm = None
+                if dm is not None and replicas != dm.num_hosts:
+                    out.append(
+                        f"{where}: {key}/s{j}: {replicas} replicas but the "
+                        f"bound placement cuboid {dm.topology} has "
+                        f"{dm.num_hosts} hosts"
+                    )
+            template_anns = (
+                (sts.get("spec") or {})
+                .get("template", {})
+                .get("metadata", {})
+                .get("annotations", {})
+            )
+            mesh_ann = template_anns.get(SPMD_MESH_ANNOTATION)
+            if replicas and not mesh_ann:
+                out.append(
+                    f"{where}: {key}/s{j}: slice pod template lacks the "
+                    f"derived-mesh annotation {SPMD_MESH_ANNOTATION}"
+                )
+            elif mesh_ann:
+                try:
+                    got = json.loads(mesh_ann)
+                except ValueError:
+                    got = None
+                if not isinstance(got, dict) or (
+                    got.get("numHosts"),
+                    got.get("numSlices"),
+                    got.get("chipsPerHost"),
+                ) != (hosts, num_slices, topo.chips_per_host):
+                    out.append(
+                        f"{where}: {key}/s{j}: derived-mesh annotation "
+                        f"disagrees with the gang's shape "
+                        f"({hosts} hosts x {num_slices} slices)"
+                    )
+
+            for pod in sorted(
+                cluster.list(
+                    "Pod", ns, selector={"matchLabels": {"statefulset": sts_name}}
+                ),
+                key=ko.name,
+            ):
+                pods_seen += 1
+                pod_name = ko.name(pod)
+                if pod.get("status", {}).get("phase") == "Running":
+                    running += 1
+                ordinal = _ordinal(pod_name)
+                if ordinal is None:
+                    out.append(
+                        f"{where}: {key}: pod {pod_name} has no ordinal"
+                    )
+                    continue
+                env = _pod_env(pod)
+                try:
+                    ctx = spmd_bootstrap.read_env(env)
+                except spmd_bootstrap.SpmdEnvError as e:
+                    out.append(
+                        f"{where}: {key}: pod {pod_name} env violates the "
+                        f"SPMD contract: {e}"
+                    )
+                    continue
+                if ctx is None:
+                    out.append(
+                        f"{where}: {key}: pod {pod_name} of a multi-host "
+                        f"gang has no injected TPU_WORKER_ID"
+                    )
+                    continue
+                contexts.append(ctx)
+                if ctx.worker_id != ordinal:
+                    out.append(
+                        f"{where}: {key}: pod {pod_name} ordinal {ordinal} "
+                        f"but TPU_WORKER_ID={ctx.worker_id}"
+                    )
+                if num_slices > 1 and ctx.slice_id != j:
+                    out.append(
+                        f"{where}: {key}: pod {pod_name} in slice {j} but "
+                        f"MEGASCALE_SLICE_ID={ctx.slice_id}"
+                    )
+                if ctx.num_processes != total:
+                    out.append(
+                        f"{where}: {key}: pod {pod_name} has "
+                        f"JAX_NUM_PROCESSES={ctx.num_processes}, gang has "
+                        f"{total} hosts"
+                    )
+                expected_pid = j * hosts + ordinal
+                if ctx.process_id != expected_pid:
+                    out.append(
+                        f"{where}: {key}: pod {pod_name} has "
+                        f"JAX_PROCESS_ID={ctx.process_id}, expected "
+                        f"{expected_pid}"
+                    )
+
+        if contexts:
+            for v in spmd_bootstrap.validate_gang(contexts):
+                # gaps are legitimate mid-churn (a killed pod IS a gap);
+                # they only indict a gang whose every pod is up and Running
+                if v.startswith("worker-id assignment has gaps") and not (
+                    pods_seen == total == running
+                ):
+                    continue
+                out.append(f"{where}: {key}: {v}")
+        if pods_seen == total == running and len(contexts) == total:
+            pids = sorted(c.process_id for c in contexts)
+            if pids != list(range(total)):
+                out.append(
+                    f"{where}: {key}: Running gang's process ids {pids} are "
+                    f"not gap-free 0..{total - 1}"
+                )
+
+        if replicas_up or pods_seen:
+            svc = cluster.try_get(
+                "Service", tputopo.headless_service_name(name), ns
+            )
+            if svc is None:
+                out.append(
+                    f"{where}: {key}: multi-host gang has pods but no "
+                    f"headless rendezvous Service"
+                )
+            else:
+                spec = svc.get("spec") or {}
+                if spec.get("clusterIP") != "None" or not spec.get(
+                    "publishNotReadyAddresses"
+                ):
+                    out.append(
+                        f"{where}: {key}: rendezvous Service is not headless "
+                        f"+ publishNotReadyAddresses (coordinator DNS must "
+                        f"resolve before readiness)"
+                    )
+    return out

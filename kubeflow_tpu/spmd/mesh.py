@@ -1,0 +1,201 @@
+"""Placement cuboid → JAX mesh axes: the deterministic derivation rule.
+
+The scheduler binds a gang to a cuboid of the pool's torus (``fleet.place_gang``
+writes the slice's chip ``shape`` into the placement annotation); this module
+turns that shape into the mesh every host of the gang builds identically:
+
+    dcn    = numSlices          (cross-slice data parallelism over DCN)
+    data   = num_hosts          (the host grid: shape[i] // host_block[i] —
+                                 batch parallelism over per-host ICI blocks)
+    model  = chips_per_host     (the intra-host sub-torus — the tightest ICI
+                                 neighborhood, so model/tensor collectives
+                                 never leave a host's block)
+
+"model" here maps onto ``parallel/mesh.py``'s ``tensor`` axis (that module's
+vocabulary); :meth:`DerivedMesh.to_plan` does the translation, so everything
+downstream (param sharding rules, batch specs, the placement-aware device
+ordering in ``create_mesh``) is reused, not reimplemented.
+
+The rule is a *default*, not a straitjacket — a notebook can always build its
+own plan — but it is the one every pod of a gang derives from nothing but its
+injected env, so all hosts agree without coordination. Determinism is the
+contract: same (accelerator, topology, numSlices) → same mesh, on every host,
+every restart, every resume.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from kubeflow_tpu.tpu.topology import SliceTopology, parse_topology
+
+__all__ = [
+    "DerivedMesh",
+    "derive",
+    "from_topology",
+    "from_placement_slice",
+    "build_mesh",
+    "per_host_batch",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class DerivedMesh:
+    """The mesh every host of a gang derives from its placement, identically.
+
+    Frozen and fully determined by (accelerator, topology, num_slices) — the
+    three values admission injects — so it can be recomputed anywhere (pod,
+    controller, JWA detail view, soak audit) and compared for agreement.
+    """
+
+    accelerator: str              # short name, e.g. "v4"
+    topology: str                 # e.g. "4x4x4" (the slice's chip cuboid)
+    shape: tuple[int, ...]        # parsed topology dims
+    host_grid: tuple[int, ...]    # per-dim host counts (shape / host_block)
+    num_slices: int
+    num_hosts: int                # per slice
+    chips_per_host: int
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.shape)
+
+    @property
+    def num_processes(self) -> int:
+        """Global jax.distributed process count (hosts x slices)."""
+        return self.num_hosts * self.num_slices
+
+    @property
+    def num_devices(self) -> int:
+        """Global chip count the mesh spans."""
+        return self.num_chips * self.num_slices
+
+    def axes(self) -> dict[str, int]:
+        """The derived logical axes, issue vocabulary (data/model + dcn)."""
+        return {
+            "dcn": self.num_slices,
+            "data": self.num_hosts,
+            "model": self.chips_per_host,
+        }
+
+    def to_plan(self):
+        """Translate into ``parallel/mesh.py`` vocabulary (model → tensor)."""
+        from kubeflow_tpu.parallel import mesh as meshlib
+
+        return meshlib.MeshPlan(
+            dcn=self.num_slices, data=self.num_hosts,
+            tensor=self.chips_per_host,
+        )
+
+    def to_data_plan(self):
+        """The pure-data-parallel projection of the derivation.
+
+        Batch-parallel workloads (the ResNet cell, MFU_BENCH) have no model
+        axis to feed, so the intra-host block folds into ``fsdp`` instead:
+        the batch then shards over every chip (``batch_spec`` covers
+        dcn x data x fsdp) while params ZeRO-shard over the tightest ICI
+        neighborhood. Same device order, same host-major layout — only the
+        axis naming changes, so per-host batches stay contiguous per host.
+        """
+        from kubeflow_tpu.parallel import mesh as meshlib
+
+        return meshlib.MeshPlan(
+            dcn=self.num_slices, data=self.num_hosts,
+            fsdp=self.chips_per_host,
+        )
+
+    def to_dict(self) -> dict:
+        """Canonical JSON-able form — the pod annotation / JWA detail payload.
+
+        Key order is fixed by json.dumps(sort_keys=True) at the call sites;
+        equality of two dicts is the audit's mesh-agreement check.
+        """
+        return {
+            "accelerator": self.accelerator,
+            "topology": self.topology,
+            "numSlices": self.num_slices,
+            "numHosts": self.num_hosts,
+            "chipsPerHost": self.chips_per_host,
+            "axes": self.axes(),
+        }
+
+
+def from_topology(topo: SliceTopology, num_slices: int = 1) -> DerivedMesh:
+    """Derive from a validated SliceTopology (controller/JWA side)."""
+    if num_slices < 1:
+        raise ValueError(f"numSlices must be >= 1; got {num_slices}")
+    block = topo.accelerator.host_block
+    # sub-host single-host offerings (v5e 1x1/2x2) don't tile the block;
+    # their host grid is the identity
+    host_grid = tuple(
+        max(1, d // b) for d, b in zip(topo.shape, block)
+    )
+    if math.prod(host_grid) != topo.num_hosts:
+        host_grid = (1,) * len(topo.shape)
+    return DerivedMesh(
+        accelerator=topo.accelerator.name,
+        topology=topo.topology_str,
+        shape=topo.shape,
+        host_grid=host_grid,
+        num_slices=num_slices,
+        num_hosts=topo.num_hosts,
+        chips_per_host=topo.chips_per_host,
+    )
+
+
+def derive(accelerator: str, topology: str, num_slices: int = 1) -> DerivedMesh:
+    """Derive from raw CR/env strings; validation via ``parse_topology``
+    (raises ValueError with the admission-grade message on bad input)."""
+    return from_topology(parse_topology(accelerator, topology), num_slices)
+
+
+def from_placement_slice(placement_slice: dict, num_slices: int = 1) -> DerivedMesh:
+    """Derive from one bound placement slice (``fleet.place_gang`` wire form).
+
+    The slice dict carries the *chip* cuboid the scheduler committed
+    (``shape``) plus the accelerator — exactly the inputs the rule needs, so
+    the controller renders fan-out for what was actually bound, not what was
+    requested (they agree by construction, but the placement is the
+    authority once bound).
+    """
+    accel = placement_slice.get("accelerator")
+    shape = placement_slice.get("shape") or []
+    if not accel or not shape:
+        raise ValueError(
+            "placement slice lacks accelerator/shape; cannot derive mesh"
+        )
+    return derive(str(accel), "x".join(str(int(d)) for d in shape), num_slices)
+
+
+def build_mesh(dm: DerivedMesh, devices=None, *, data_parallel: bool = False):
+    """Build the jax Mesh for this derivation (workload side; lazy jax).
+
+    Orders devices by the slice's physical torus via ``create_mesh``'s
+    placement-aware path so the ``model`` axis rides the intra-host block.
+    Device count must equal ``dm.num_devices`` — on a real slice that is
+    ``jax.devices()`` after ``jax.distributed.initialize``; tests pass a
+    forced-CPU device list. ``data_parallel=True`` builds the
+    :meth:`DerivedMesh.to_data_plan` projection instead (batch-parallel
+    workloads with no model axis).
+    """
+    from kubeflow_tpu.parallel import mesh as meshlib
+
+    plan = dm.to_data_plan() if data_parallel else dm.to_plan()
+    physical = dm.shape if dm.num_slices == 1 else None
+    return meshlib.create_mesh(plan, devices, physical_topology=physical)
+
+
+def per_host_batch(dm: DerivedMesh, global_batch: int) -> int:
+    """Topology-aware per-host batch: the global batch splits over the
+    data-parallel axes (dcn x data = every host), never over model.
+
+    Divisibility is an error, not a silent round — a batch that doesn't
+    split evenly would give hosts different shapes and break SPMD.
+    """
+    hosts = dm.num_processes
+    if global_batch < 1 or global_batch % hosts:
+        raise ValueError(
+            f"global batch {global_batch} does not divide over "
+            f"{hosts} hosts ({dm.num_hosts} hosts x {dm.num_slices} slices)"
+        )
+    return global_batch // hosts

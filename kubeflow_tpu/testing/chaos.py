@@ -1362,6 +1362,14 @@ def run_scenario(
     # click-to-ready) — the convergence proof upgraded to a latency-
     # attribution proof, under the same fault schedules
     violations.extend(audit_timeline(base, where="final"))
+    # SPMD gang-identity audit (docs/spmd.md): every multi-host gang's pods
+    # carry consistent, gap-free worker identity (TPU_WORKER_ID == ordinal,
+    # one coordinator, process ids 0..N-1 when fully Running) and the
+    # headless rendezvous Service exists — through every pod kill and
+    # admission re-injection this scenario throws at them
+    from kubeflow_tpu.spmd.fanout import audit_spmd
+
+    violations.extend(audit_spmd(base, where="final"))
     if explain_audit:
         # explanation audit (docs/scheduler.md "explainability"): any
         # placement explanation surviving at the fixed point must be

@@ -152,6 +152,16 @@ def parse_topology(accelerator: str, topology: str) -> "SliceTopology":
             f"{'x'.join(map(str, accel.host_block))}; the slice cannot be "
             "mapped onto whole hosts"
         )
+    if tiles and math.prod(shape) % accel.chips_per_host:
+        # per-dim tiling implies divisibility for every accelerator in the
+        # current table, but the SPMD fan-out (replicas == num_hosts, worker
+        # ids 0..N-1) depends on it outright — guard it explicitly so a
+        # future accelerator entry can't reintroduce the runtime crash
+        raise ValueError(
+            f"topology {topology!r} spans {math.prod(shape)} chips, which do "
+            f"not divide onto whole {accelerator} hosts "
+            f"({accel.chips_per_host} chips/host)"
+        )
     return SliceTopology(accelerator=accel, shape=shape)
 
 

@@ -324,7 +324,10 @@ class App:
         except (AlreadyExists, Conflict) as e:
             response = error(409, str(e))
         except AdmissionDenied as e:
-            response = error(403, str(e))
+            # admission denials default to 403; a validator that rejected
+            # user INPUT (bad spec.tpu, webhooks/tpu_env.tpu_spec_validator)
+            # tags itself 400 so clients see a typed input error
+            response = error(getattr(e, "status", 403), str(e))
         except ValueError as e:
             response = error(400, str(e))
         except HTTPException as e:

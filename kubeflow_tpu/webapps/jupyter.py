@@ -173,6 +173,37 @@ def notebook_status(nb: dict, events: list[dict], capacity=None) -> dict:
     return {"phase": "waiting", "message": "Starting Notebook Server."}
 
 
+def _spmd_payload(nb: dict) -> dict | None:
+    """Derived-mesh detail for a TPU notebook; None for CPU / invalid specs.
+
+    Same derivation the controller stamps on pod templates and the pods
+    build at bootstrap (``spmd/mesh.py``): placement-first, spec fallback —
+    so the detail page shows what the gang will ACTUALLY build.
+    """
+    from kubeflow_tpu.spmd import mesh as spmd_mesh
+
+    try:
+        topo = api.notebook_topology(nb)
+    except ValueError:
+        return None
+    if topo is None:
+        return None
+    num_slices = api.notebook_num_slices(nb)
+    placement = sched.placement_of(nb)
+    slices = (placement or {}).get("slices") or []
+    dm = None
+    if slices:
+        try:
+            dm = spmd_mesh.from_placement_slice(slices[0], num_slices)
+        except ValueError:
+            dm = None
+    if dm is None:
+        dm = spmd_mesh.from_topology(topo, num_slices)
+    out = dm.to_dict()
+    out["bound"] = bool(slices)
+    return out
+
+
 def notebook_summary(nb: dict, events: list[dict], capacity=None) -> dict:
     """Index-table row (ref utils.notebook_dict_from_k8s_obj)."""
     # guard: CRs created out-of-band (kubectl) may omit containers entirely;
@@ -379,6 +410,11 @@ def create_app(
         # preemption trail — None for a bound/unexplained notebook, so the
         # UI can distinguish "placed" from "never judged"
         summary["explanation"] = sched.explanation_of(nb)
+        # the derived SPMD mesh (spmd/mesh.py rule) for TPU notebooks: the
+        # axes every host of the gang will build (dcn/data/model), from the
+        # bound placement's cuboid when one exists — the detail-page answer
+        # to "what mesh does my notebook get". None for CPU notebooks.
+        summary["spmd"] = _spmd_payload(nb)
         summary["age"] = nb["metadata"].get("creationTimestamp", "")
         # keep CR status fields reachable (status.tpu incl. numSlices)
         summary["status"].update(

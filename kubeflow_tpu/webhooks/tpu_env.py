@@ -30,7 +30,7 @@ cross-slice DCN contract:
 from __future__ import annotations
 
 from kubeflow_tpu.runtime import objects as ko
-from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.runtime.fake import AdmissionDenied, FakeCluster
 from kubeflow_tpu.tpu.topology import parse_topology
 from kubeflow_tpu.utils.config import ControllerConfig
 
@@ -146,8 +146,58 @@ def family_label_mutator(nb: dict, cluster) -> dict:
     return nb
 
 
+def tpu_spec_validator(nb: dict, cluster) -> dict:
+    """Admission-deny Notebooks whose ``spec.tpu`` cannot fan out.
+
+    Before this, only the spawner's POST path validated ``spec.tpu``
+    (``api.validate_notebook``); a direct create (kubectl, a controllerless
+    client) with a topology that doesn't map onto whole hosts sailed into
+    the store and surfaced as a reconcile-time ``parse_topology`` crash —
+    a runtime failure for an admission-shaped error. This validator is the
+    cluster-side guard: topology must parse (including host-divisibility,
+    ``tpu/topology.py``) and ``numSlices`` must be a positive integer.
+
+    Scope is ``spec.tpu`` ONLY — container-level validation stays in the
+    spawner (tests and internal tooling legitimately create minimal
+    Notebook objects with no containers).
+
+    Denials carry ``status = 400``: through the web apps' dispatcher this is
+    a typed user-input 400, not admission's generic 403 (the client sent a
+    bad spec; nothing about their permissions is wrong).
+    """
+    tpu = (nb.get("spec") or {}).get("tpu")
+    if not tpu:
+        return nb
+    errors: list[str] = []
+    try:
+        parse_topology(tpu.get("accelerator", ""), tpu.get("topology", ""))
+    except ValueError as e:
+        errors.append(f"spec.tpu: {e}")
+    raw = tpu.get("numSlices", 1)
+    ok = False
+    if isinstance(raw, int) and not isinstance(raw, bool):
+        ok = raw >= 1
+    elif isinstance(raw, str):
+        try:
+            ok = int(raw) >= 1
+        except ValueError:
+            ok = False
+    if not ok:
+        errors.append(
+            f"spec.tpu.numSlices: must be an integer >= 1; got {raw!r}"
+        )
+    if errors:
+        exc = AdmissionDenied("; ".join(errors))
+        exc.status = 400  # user-input error, not a permission denial
+        raise exc
+    return nb
+
+
 def install(cluster: FakeCluster, config: ControllerConfig | None = None) -> None:
     cluster.register_mutator("Pod", make_mutator(config))
     cluster.register_mutator(
         "Notebook", family_label_mutator, operations=("CREATE", "UPDATE")
+    )
+    cluster.register_mutator(
+        "Notebook", tpu_spec_validator, operations=("CREATE", "UPDATE")
     )

@@ -41,9 +41,13 @@ WORKER = textwrap.dedent(
         NamedSharding(mesh, P("data")),
         np.full((2, 3), float(ctx["process_id"] + 1), np.float32),
     )  # global [4, 3]: rows 1,1,2,2
-    total = jax.jit(jnp.sum)(sharded)
+    # compat.global_sum: jitted collective where the backend supports
+    # multi-process computations; coordinator KV-store allgather where it
+    # doesn't (this CPU build) — same contract either way
+    from kubeflow_tpu.parallel import compat
+    total = compat.global_sum(sharded)
     # 2 rows of 1s + 2 rows of 2s, 3 wide
-    assert float(total) == 18.0, float(total)
+    assert total == 18.0, total
     print("OK", ctx["process_id"], flush=True)
     """
 )

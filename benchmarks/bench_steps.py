@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""Gang step-telemetry benchmark: aggregation throughput + pass latency over
+a large multi-host fleet (docs/observability.md "gang step telemetry").
+
+Builds N multi-host gangs (v4 4x4x2 = 8 hosts each by default, so ~200
+gangs is ~1600 per-host step streams), each host backed by a fake in-pod
+agent with a seeded step schedule, then drives the gang aggregator through
+M full parallel passes on a virtual clock. Reports hosts/second of
+aggregation throughput and the pass p50/p99 read straight off the REAL
+``tpu_gang_pass_seconds`` histogram — the same numbers a
+``histogram_quantile`` query returns in production.
+
+A slice of the fleet carries planted culprits (slow / lagging / stalled
+hosts, one per planted gang); the run FAILS — regardless of speed — unless
+the aggregator names exactly the planted hosts and every claim re-proves
+from its own evidence, so a fast-but-wrong aggregation can never pass.
+
+    python benchmarks/bench_steps.py                  # 200 gangs x 8 hosts
+    python benchmarks/bench_steps.py --gangs 50 --passes 5
+    python benchmarks/bench_steps.py \\
+        --check-against benchmarks/steps_baseline.json   # CI gate
+
+Emits one STEP_BENCH JSON line (consumed by CI artifacts).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from kubeflow_tpu.api import types as api  # noqa: E402
+from kubeflow_tpu.culler.probe import ProbeResult  # noqa: E402
+from kubeflow_tpu.runtime import objects as ko  # noqa: E402
+from kubeflow_tpu.runtime.fake import FakeCluster  # noqa: E402
+from kubeflow_tpu.telemetry.agent import (  # noqa: E402
+    FakeDeviceBackend,
+    FakeStepSchedule,
+    TelemetryAgent,
+)
+from kubeflow_tpu.telemetry.gang import (  # noqa: E402
+    GangTelemetryAggregator,
+    audit_gang_attribution,
+    host_key,
+)
+from kubeflow_tpu.utils.metrics import GangMetrics  # noqa: E402
+from kubeflow_tpu.webhooks import tpu_env  # noqa: E402
+
+NS = "bench"
+# one planted culprit per PLANT_EVERY gangs, shapes rotating
+PLANT_EVERY = 20
+SHAPES = (
+    ("straggler", dict(slow_factor=2.0)),
+    ("desync", dict(behind_steps=15)),
+    ("stall", dict(stall_after=5)),
+)
+
+
+class _Clock:
+    """Virtual time drives the step schedules (deterministic streams);
+    wall time is only measured around the pass itself."""
+
+    def __init__(self, start: float = 1_000_000.0) -> None:
+        self.t = start
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+def run(gangs: int, passes: int, topology: str) -> dict:
+    clock = _Clock()
+    cluster = FakeCluster()
+    tpu_env.install(cluster)
+    agents: dict[str, TelemetryAgent] = {}
+    planted: dict[tuple[str, str], dict] = {}
+    num_hosts = 0
+    for i in range(gangs):
+        name = f"g-{i}"
+        nb = api.notebook(
+            name, NS, tpu_accelerator="v4", tpu_topology=topology
+        )
+        cluster.create(nb)
+        topo = api.notebook_topology(nb)
+        num_hosts = topo.num_hosts
+        plant_host = None
+        shape: dict = {}
+        if i % PLANT_EVERY == 0:
+            kind, shape = SHAPES[(i // PLANT_EVERY) % len(SHAPES)]
+            plant_host = (i // PLANT_EVERY) % topo.num_hosts
+            planted[(NS, name)] = {
+                "kind": kind,
+                "host": host_key(name, 0, plant_host, 1),
+            }
+        for o in range(topo.num_hosts):
+            agents[host_key(name, 0, o, 1)] = TelemetryAgent(
+                FakeDeviceBackend(
+                    duty_cycle=0.9,
+                    hbm_used_bytes=8e9,
+                    jitter=0.01,
+                    seed=i * 100 + o,
+                ),
+                clock=clock,
+                step_schedule=FakeStepSchedule(
+                    period_s=6.0,
+                    duration_s=2.5,
+                    start_at=clock() - 200.0,
+                    jitter_s=0.15,
+                    seed=i * 100 + o,
+                    **(shape if o == plant_host else {}),
+                ),
+            )
+
+    def probe(targets, timeout=5.0, max_concurrency=64):
+        # agents answer in-process: the number under test is the
+        # aggregator's own pass cost (parse + align + judge + aggregate),
+        # the same work it does behind the native prober in production
+        return [
+            ProbeResult(200, agents[host].exposition())
+            for host, _port, _path in targets
+        ]
+
+    agg = GangTelemetryAggregator(
+        cluster,
+        GangMetrics(),
+        min_steps=3,
+        desync_steps=10,
+        stall_after_s=45.0,
+        clock=clock,
+        probe_fn=probe,
+        target_for=lambda nb, j, o: (host_key(ko.name(nb), j, o, 1), 0, "/"),
+    )
+    t0 = time.perf_counter()
+    for _ in range(passes):
+        agg.collect(force=True)
+        # enough virtual time that every pass sees fresh completed steps
+        # (and the planted stalls accrue quiet time past the threshold)
+        clock.advance(15.0)
+    wall = time.perf_counter() - t0
+
+    # correctness arm: the attribution + evidence audits must come back
+    # clean — a fast-but-wrong aggregation fails here before any gate
+    audit = agg.audit(where="bench") + audit_gang_attribution(
+        agg, planted, where="bench"
+    )
+    named = {
+        (f["namespace"], f["notebook"]) for f in agg.findings()
+    } & set(planted)
+    h = agg.metrics.pass_duration
+    return {
+        "bench": "STEP_BENCH",
+        "gangs": gangs,
+        "hosts_per_gang": num_hosts,
+        "passes": passes,
+        "hosts_scraped": agg.hosts_scraped,
+        "host_throughput_per_s": round(
+            agg.hosts_scraped / max(wall, 1e-9), 1
+        ),
+        "pass_seconds": {
+            "p50": round(h.quantile(0.50), 5),
+            "p99": round(h.quantile(0.99), 5),
+            "mean": round(h.sum() / max(1, h.count()), 5),
+        },
+        "tracked_gangs": int(agg.metrics.gangs.get()),
+        "fleet_step_p99_s": round(agg.fleet_step_p99(), 3),
+        "planted": len(planted),
+        "planted_named": len(named),
+        "audit_violations": audit,
+    }
+
+
+def check_against(result: dict, baseline_path: str, tolerance: float) -> int:
+    """CI gate: aggregation throughput must not fall below the committed
+    floor and the pass p99 must not blow past its ceiling (tolerance
+    absorbs shared-runner wall noise; losing the single-pass aggregation
+    is an order-of-magnitude cliff that no tolerance covers). Correctness
+    — every planted culprit named, zero audit violations — is a hard
+    gate with no tolerance at all."""
+    with open(baseline_path) as f:
+        base = json.load(f)
+    failures = []
+    if result["audit_violations"]:
+        failures += [f"audit: {v}" for v in result["audit_violations"]]
+    if result["planted_named"] != result["planted"]:
+        failures.append(
+            f"planted culprits named: {result['planted_named']} of "
+            f"{result['planted']} — the judge lost real stragglers"
+        )
+    floor = base["host_throughput_per_s"] * (1.0 - tolerance)
+    if result["host_throughput_per_s"] < floor:
+        failures.append(
+            f"host_throughput_per_s: {result['host_throughput_per_s']} < "
+            f"floor {floor:.1f} (baseline "
+            f"{base['host_throughput_per_s']} - {tolerance:.0%})"
+        )
+    ceiling = base["pass_seconds"]["p99"] * (1.0 + tolerance)
+    if result["pass_seconds"]["p99"] > ceiling:
+        failures.append(
+            f"pass p99: {result['pass_seconds']['p99']}s > ceiling "
+            f"{ceiling:.5f}s (baseline {base['pass_seconds']['p99']}s "
+            f"+ {tolerance:.0%})"
+        )
+    if failures:
+        print("STEP_BENCH gate: FAIL")
+        for f_ in failures:
+            print(f"  {f_}")
+        return 1
+    print(
+        f"STEP_BENCH gate: OK ({result['host_throughput_per_s']} hosts/s "
+        f"vs baseline {base['host_throughput_per_s']}; pass p99 "
+        f"{result['pass_seconds']['p99']}s <= {ceiling:.5f}s; "
+        f"{result['planted_named']}/{result['planted']} culprits named)"
+    )
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--gangs", type=int, default=200)
+    ap.add_argument("--passes", type=int, default=10)
+    ap.add_argument("--topology", default="4x4x2",
+                    help="per-gang v4 topology (default 4x4x2 = 8 hosts)")
+    ap.add_argument("--check-against", metavar="BASELINE_JSON",
+                    help="compare against a committed baseline and exit 1 "
+                         "on regression beyond --tolerance (correctness "
+                         "failures gate unconditionally)")
+    ap.add_argument("--tolerance", type=float, default=0.50,
+                    help="relative band for the throughput floor and pass "
+                         "p99 ceiling (default 0.50)")
+    args = ap.parse_args(argv)
+    logging.disable(logging.ERROR)
+    result = run(args.gangs, args.passes, args.topology)
+    print("STEP_BENCH " + json.dumps(result, sort_keys=True))
+    if args.check_against:
+        return check_against(result, args.check_against, args.tolerance)
+    if result["audit_violations"] or result["planted_named"] != result["planted"]:
+        print("STEP_BENCH correctness: FAIL")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,14 @@ SCOPED_DIRS = (
     # mesh/identity from its env alone — any nondeterminism here desyncs a
     # gang, and the soak audit (spmd/fanout.py) replays from the seed
     "kubeflow_tpu/spmd/",
+    # the telemetry pipeline rides the soaks' seed-alone promise too: the
+    # collector, the gang aggregator, and the fake agents all run on the
+    # injected clock (wall time only through the clock/perf params), and
+    # the gang attribution audit replays plants from the seed
+    "kubeflow_tpu/telemetry/",
+    # same for the observability layer: events dedup, traces, timelines,
+    # the SLO ring, and the efficiency ledger are all audited per seed
+    "kubeflow_tpu/obs/",
 )
 
 WALL_CLOCK_CALLS = {

@@ -160,6 +160,27 @@ def build_manager(
             )
         shared["telemetry"] = telemetry
     telemetry = shared["telemetry"]
+    if "gang" not in shared:
+        gang = None
+        if telemetry is not None and cfg.gang_telemetry_enabled:
+            # gang-level step aggregator (telemetry/gang.py): scrapes every
+            # host of every multi-host gang — per-host step streams →
+            # straggler/desync verdicts — on the same off-reconcile loop as
+            # the fleet collector. ONE per process, like the collector.
+            from kubeflow_tpu.telemetry.gang import GangTelemetryAggregator
+            from kubeflow_tpu.utils.metrics import GangMetrics
+
+            gang = GangTelemetryAggregator(
+                cluster,
+                GangMetrics(metrics.registry),
+                interval_s=cfg.telemetry_interval_s,
+                staleness_s=cfg.telemetry_staleness_s,
+                recorder=recorder,
+                cluster_domain=cfg.cluster_domain,
+                port=cfg.telemetry_port,
+            )
+        shared["gang"] = gang
+    gang = shared["gang"]
     if "ledger" not in shared:
         ledger = None
         # fleet efficiency ledger (obs/ledger.py): exactly-once chip-second
@@ -217,6 +238,7 @@ def build_manager(
     # the ops listeners and main loop read it off the manager (build_manager
     # keeps its two-value return for every existing caller)
     manager.telemetry = telemetry
+    manager.gang = gang
     manager.ledger = ledger
     manager.slo = slo
     manager.timeline_builder = shared.setdefault(
@@ -521,6 +543,13 @@ def serve_ops(
             from kubeflow_tpu.telemetry.collector import install_telemetry_route
 
             install_telemetry_route(probes, telemetry)
+        # /debug/gang (+ /<ns>/<name> drilldown): per-host step timelines
+        # and the straggler/desync verdicts — same cluster-internal surface
+        gang = getattr(manager, "gang", None) if manager else None
+        if gang is not None:
+            from kubeflow_tpu.telemetry.gang import install_gang_route
+
+            install_gang_route(probes, gang)
         # /debug/timeline/<ns>/<name>: the assembled click-to-ready
         # timeline, same cluster-internal surface as /debug/traces
         builder = getattr(manager, "timeline_builder", None) if manager else None
@@ -660,12 +689,14 @@ def main() -> None:
         for mgr in managers:
             start_workers(mgr, getattr(mgr, "shard_id", None))
     telemetry = getattr(manager, "telemetry", None)
+    gang = getattr(manager, "gang", None)
     if telemetry is not None:
         # the fleet scrape runs on its OWN cadence, decoupled from both the
         # reconcile workers (never on that path) and the kernel-probe loop
         # below (whose period follows the culler's check period, not the
         # telemetry interval). Standbys skip it for the same reason they
-        # skip kernel probing.
+        # skip kernel probing. The gang aggregator rides the same loop: its
+        # per-host pass is interval-gated internally like the collector's.
         def telemetry_loop() -> None:
             while True:
                 if reconciling.is_set():
@@ -673,6 +704,11 @@ def main() -> None:
                         telemetry.collect()
                     except Exception:
                         log.exception("fleet telemetry scrape failed")
+                    if gang is not None:
+                        try:
+                            gang.collect()
+                        except Exception:
+                            log.exception("gang telemetry pass failed")
                 time.sleep(cfg.telemetry_interval_s)
 
         threading.Thread(

@@ -81,6 +81,7 @@ def build_platform(
     # collector off the manager when a caller-supplied config enables
     # telemetry, and the webapps then serve its series
     telemetry = getattr(manager, "telemetry", None)
+    gang = getattr(manager, "gang", None)
     ledger = getattr(manager, "ledger", None)
     capacity = getattr(manager, "capacity", None)
     # ONE watch-backed read layer for every app (webapps/cache.py): each
@@ -91,6 +92,7 @@ def build_platform(
         dashboard.create_app(
             cluster, cluster_admins=admins, metrics=metrics,
             telemetry=telemetry,
+            gang=gang,
             slo=getattr(manager, "slo", None),
             scheduler=getattr(manager, "scheduler_metrics", None),
             ledger=ledger,
@@ -103,6 +105,7 @@ def build_platform(
                 authorizer=Authorizer(cluster, cluster_admins=admins),
                 metrics=metrics,
                 telemetry=telemetry,
+                gang=gang,
                 timeline=getattr(manager, "timeline_builder", None),
                 ledger=ledger,
                 capacity=capacity,

@@ -192,6 +192,7 @@ class FleetEfficiencyLedger:
         *,
         interval_s: float = DEFAULT_INTERVAL_S,
         clock: Callable[[], float] = time.time,
+        perf: Callable[[], float] = time.perf_counter,
         telemetry=None,
     ) -> None:
         from kubeflow_tpu.utils.metrics import LedgerMetrics
@@ -200,6 +201,9 @@ class FleetEfficiencyLedger:
         self.metrics = metrics or LedgerMetrics()
         self.interval_s = interval_s
         self.clock = clock
+        # tick-duration wall timing only; injectable so the seeded soaks
+        # stay bit-deterministic end to end (TPU001)
+        self._perf = perf
         # the collector's in-memory store: duty-cycle per session (the
         # chip-weighted busy input). None → duty unknown → all running time
         # accounts as idle_allocated: the ledger never *claims* work
@@ -246,7 +250,7 @@ class FleetEfficiencyLedger:
                     return 0
                 if now_ms <= self._last_ms:
                     return 0  # clock did not move; nothing elapsed
-        t0 = time.perf_counter()
+        t0 = self._perf()
         fleet = self._build_fleet()
         notebooks = self.cluster.list("Notebook")
         with self._lock:
@@ -259,7 +263,7 @@ class FleetEfficiencyLedger:
                 dt = now_ms - last
                 self._attribute(last, now_ms, fleet, notebooks)
             self._export()
-        self.metrics.tick_seconds.observe(time.perf_counter() - t0)
+        self.metrics.tick_seconds.observe(self._perf() - t0)
         return dt
 
     def _build_fleet(self) -> Fleet:

@@ -455,10 +455,18 @@ def run_session_seed(
     max_restarts_per_tick: int = 6,
     lost_update_audit: bool = True,
     ledger_audit: bool = True,
+    gang_audit: bool = True,
 ) -> SessionSeedResult:
     """One seeded soak run: hostile timeline under API + store chaos, heal,
     settle past every deadline, quiesce, then the fixed-point audits.
-    ``faults=None`` runs fault-free (targeted-test baseline)."""
+    ``faults=None`` runs fault-free (targeted-test baseline).
+
+    ``gang_audit=True`` arms the gang step-telemetry arm (telemetry/gang.py)
+    over the scenario's multi-host gangs — per-host agents with seeded step
+    schedules, one seed-drawn planted culprit — and requires, at the fixed
+    point, that every claim re-proves from its evidence and the planted
+    culprit (and nothing else) was named, through every suspend/resume
+    handoff the timeline throws at the gangs."""
     scenario = SessionScenario(seed)
     base = FakeCluster()
     tpu_env.install(base)
@@ -517,6 +525,117 @@ def run_session_seed(
 
     ledger = FleetEfficiencyLedger(base, clock=clock, interval_s=1.0)
 
+    # gang step-telemetry arm (telemetry/gang.py): per-host agents with
+    # seeded step schedules over every multi-host gang, one seed-drawn
+    # planted culprit, ONE aggregator across controller restarts (an
+    # observer, like the ledger). This soak is where the gang pipeline
+    # meets suspend/resume churn: scrape targets vanish and return as the
+    # barrier tears gangs down and re-binds them, and the attribution
+    # audit must still name exactly the planted host.
+    gang_agg = None
+    gang_planted: dict[tuple[str, str], dict] = {}
+    if gang_audit:
+        from kubeflow_tpu.culler.probe import ProbeResult
+        from kubeflow_tpu.telemetry.agent import (
+            FakeDeviceBackend,
+            FakeStepSchedule,
+            TelemetryAgent,
+        )
+        from kubeflow_tpu.telemetry.gang import (
+            GangTelemetryAggregator,
+            audit_gang_attribution,
+            host_key as gang_host_key,
+        )
+        from kubeflow_tpu.utils.metrics import GangMetrics
+
+        multi: list[tuple[str, int]] = []
+        for name in sorted(scenario.gangs):
+            topo = api.notebook_topology(scenario._nb(name))
+            if topo is None or not topo.is_multi_host:
+                continue
+            multi.append((name, topo.num_hosts))
+        plant: tuple[str, str, int] | None = None
+        if multi:
+            plant_rng = random.Random(f"gang-plant-{seed}")
+            pname, phosts = multi[plant_rng.randrange(len(multi))]
+            pkind = ("slow", "lagging", "stalled")[plant_rng.randrange(3)]
+            po = plant_rng.randrange(phosts)
+            plant = (pname, pkind, po)
+            gang_planted[(scenario.NAMESPACE, pname)] = {
+                "kind": {"slow": "straggler", "lagging": "desync",
+                         "stalled": "stall"}[pkind],
+                "host": gang_host_key(pname, 0, po, 1),
+            }
+        shapes = {
+            "slow": dict(slow_factor=2.0),
+            "lagging": dict(behind_steps=15),
+            "stalled": dict(stall_after=5),
+        }
+        gang_agents: dict[str, TelemetryAgent] = {}
+        for name, num_hosts in multi:
+            duty = 0.9 if name in scenario.busy else 0.0
+            for o in range(num_hosts):
+                shape = (
+                    shapes[plant[1]]
+                    if plant is not None and (name, o) == (plant[0], plant[2])
+                    else {}
+                )
+                # backdated start: min_steps of history exists at the very
+                # first pass, so detection never races the op timeline
+                sched_ = FakeStepSchedule(
+                    period_s=6.0, duration_s=2.5,
+                    start_at=clock() - 200.0, jitter_s=0.15,
+                    seed=seed * 1000 + o, **shape,
+                )
+                gang_agents[gang_host_key(name, 0, o, 1)] = TelemetryAgent(
+                    FakeDeviceBackend(
+                        duty_cycle=duty,
+                        hbm_used_bytes=float(duty * (8 << 30)),
+                        jitter=0.005, seed=seed,
+                    ),
+                    clock=clock,
+                    step_schedule=sched_,
+                )
+        gang_rng = random.Random(f"gang-telemetry-{seed}")
+
+        def gang_probe(targets, timeout=5.0, max_concurrency=64):
+            out = []
+            for host, _port, _path in targets:
+                a = gang_agents.get(host)
+                if a is None:
+                    out.append(ProbeResult(-1, ""))
+                elif (
+                    chaos is not None
+                    and not chaos._healed
+                    and gang_rng.random() < 0.15
+                ):
+                    out.append(
+                        ProbeResult(-2 if gang_rng.random() < 0.5 else -1, "")
+                    )
+                else:
+                    out.append(ProbeResult(200, a.exposition()))
+            return out
+
+        # desync_steps > staleness_s/period_s and stall_after_s >
+        # staleness_s (see testing/chaos.py): a host whose scrapes merely
+        # failed goes stale (excluded) before its bounded-stale step id or
+        # quiet time can read as a claim
+        gang_agg = GangTelemetryAggregator(
+            base,
+            GangMetrics(),
+            interval_s=10.0,
+            staleness_s=30.0,
+            min_steps=3,
+            desync_steps=10,
+            stall_after_s=45.0,
+            clock=clock,
+            probe_fn=gang_probe,
+            target_for=lambda nb, j, o: (
+                gang_host_key(ko.name(nb), j, o, 1), 0, "/"
+            ),
+            recorder=EventRecorder(component="gang-telemetry", clock=clock),
+        )
+
     # shared across scheduler incarnations (crash-restarts)
     sched_diff_failures: list[str] = []
 
@@ -560,6 +679,9 @@ def run_session_seed(
 
     def tick() -> None:
         nonlocal mgr, restarts
+        # zero reconcile-path scrapes: gang aggregation lives on the
+        # harness-driven scrape pass only, never inside a reconcile
+        gang_before = gang_agg.scrape_passes if gang_agg is not None else 0
         for _ in range(max_restarts_per_tick):
             crashed = False
             try:
@@ -569,10 +691,16 @@ def run_session_seed(
             if chaos is not None and chaos.take_crash():
                 crashed = True
             if not crashed:
-                return
+                break
             restarts += 1
             mgr.shutdown()
             mgr = build()
+        if gang_agg is not None and gang_agg.scrape_passes != gang_before:
+            violations.append(
+                f"gang step scrape ran on the reconcile path "
+                f"({gang_agg.scrape_passes - gang_before} pass(es) "
+                f"during a manager tick)"
+            )
 
     def drive(where: str, *, sub_ticks: int = 3, dt: float = 10.0) -> None:
         for s in range(sub_ticks):
@@ -580,6 +708,10 @@ def run_session_seed(
             agent.tick()  # user work advances on every live session
             if chaos is not None:
                 chaos.tick_watches()
+            if gang_agg is not None:
+                # the controller-manager's telemetry loop: one gang pass
+                # between ticks, interval-gated, never inside a reconcile
+                gang_agg.collect()
             ledger.tick(force=True)
             tick()
             if chaos is not None:
@@ -609,6 +741,17 @@ def run_session_seed(
         chaos.heal()
     objects.heal()
 
+    if gang_agg is not None and gang_planted:
+        # the planted culprit needs a post-fault observation window: the op
+        # timeline may have left its gang stopped or deleted, so the
+        # harness deterministically brings it back for the settle phase
+        for ns, name in sorted(gang_planted):
+            try:
+                base.get("Notebook", name, ns)
+            except NotFound:
+                scenario.apply(base, ("recreate_nb", name), 0)
+            scenario.apply(base, ("start", name), 0)
+
     # settle past the cull threshold (60 s), the force deadline (60 s), and
     # the backoff cap (64 s)
     for s in range(7):
@@ -619,6 +762,8 @@ def run_session_seed(
     for s in range(24):
         cluster.step_kubelet()
         agent.tick()
+        if gang_agg is not None:
+            gang_agg.collect()
         ledger.tick(force=True)
         tick()
         violations.extend(auditor.observe(base, clock(), f"quiesce {s}"))
@@ -671,6 +816,16 @@ def run_session_seed(
         # lost-update audit (docs/chaos.md): the suspend/resume barrier's
         # one-write discipline checked at every commit's base rv
         violations.extend(chaos.lost_update_findings)
+    if gang_agg is not None:
+        # gang step-telemetry audit (docs/observability.md): bounded
+        # staleness, every straggler/desync/stall claim re-proven from its
+        # own frozen evidence, and the planted-truth attribution — the
+        # seeded culprit must be named, healthy gangs must never be
+        # flagged, through every suspend/resume handoff
+        violations.extend(gang_agg.audit(where="final"))
+        violations.extend(
+            audit_gang_attribution(gang_agg, gang_planted, where="final")
+        )
     return SessionSeedResult(
         seed=seed,
         violations=violations,

@@ -49,6 +49,17 @@ FAMILY_HBM_USED = "tpu_hbm_used_bytes"
 FAMILY_HBM_TOTAL = "tpu_hbm_total_bytes"
 FAMILY_DEVICE_COUNT = "tpu_device_count"
 FAMILY_STEP_TOTAL = "tpu_step_total"
+# per-step record stream: the agent republishes its recent StepRing window
+# as labeled gauges — one sample per step id, value = wall timestamp. The
+# currently-open step exposes a START sample only (no END), so a gang
+# aggregator scraping mid-step sees the host as "inside step N since t".
+# The fleet collector ignores these families entirely (its per-family parse
+# reads specific unlabeled names), so adding them is wire-compatible.
+FAMILY_STEP_START = "tpu_step_start_seconds"
+FAMILY_STEP_END = "tpu_step_end_seconds"
+# how many completed steps the agent republishes per scrape; the gang
+# aggregator only needs enough overlap to bridge one missed scrape pass
+STEP_WINDOW = 32
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,4 +96,7 @@ __all__ = [
     "FAMILY_HBM_TOTAL",
     "FAMILY_DEVICE_COUNT",
     "FAMILY_STEP_TOTAL",
+    "FAMILY_STEP_START",
+    "FAMILY_STEP_END",
+    "STEP_WINDOW",
 ]

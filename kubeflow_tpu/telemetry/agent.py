@@ -33,7 +33,10 @@ from kubeflow_tpu.telemetry import (
     FAMILY_DUTY_KNOWN,
     FAMILY_HBM_TOTAL,
     FAMILY_HBM_USED,
+    FAMILY_STEP_END,
+    FAMILY_STEP_START,
     FAMILY_STEP_TOTAL,
+    STEP_WINDOW,
 )
 from kubeflow_tpu.utils.metrics import Registry
 
@@ -137,6 +140,91 @@ class FakeDeviceBackend:
         return out
 
 
+class FakeStepSchedule:
+    """Deterministic per-host step schedule for soaks and benches.
+
+    Synthesizes the step stream a training loop would produce as a pure
+    function of the clock: step *i* (1-based) starts at
+    ``start_at + (behind_steps + i - 1) * period_s`` and runs for
+    ``duration_s * slow_factor`` (plus seeded per-step jitter, capped at the
+    period). The shapes the gang aggregator must catch:
+
+    - **slow host** — ``slow_factor > 1``: same step ids as its peers, every
+      step proportionally longer (the straggler-index signal);
+    - **lagging host** — ``behind_steps > 0``: same cadence, step ids
+      permanently behind the gang (the desync signal);
+    - **stalled host** — ``stall_after=N``: completes step N, then step N+1
+      opens and never ends while the device backend keeps reading busy (the
+      busy-but-no-progress signal).
+
+    Seeded and clock-driven only: two runs over the same seed replay the
+    identical stream, and a suspended gang simply has no agent to scrape —
+    on resume the schedule has moved on, which is exactly what a restarted
+    training loop looks like.
+    """
+
+    def __init__(
+        self,
+        *,
+        period_s: float = 10.0,
+        duration_s: float = 8.0,
+        start_at: float = 0.0,
+        slow_factor: float = 1.0,
+        behind_steps: int = 0,
+        stall_after: int | None = None,
+        jitter_s: float = 0.0,
+        seed: int = 0,
+    ) -> None:
+        if period_s <= 0:
+            raise ValueError("period_s must be positive")
+        self.period_s = period_s
+        self.duration_s = duration_s
+        self.start_at = start_at
+        self.slow_factor = slow_factor
+        self.behind_steps = max(0, behind_steps)
+        self.stall_after = stall_after
+        self.jitter_s = jitter_s
+        self.seed = seed
+
+    def _duration(self, step: int) -> float:
+        dur = self.duration_s * self.slow_factor
+        if self.jitter_s:
+            # cheap seeded per-step hash (Weyl/Knuth mix): deterministic
+            # without allocating a PRNG per step in the 200-gang bench
+            x = (step * 2654435761 + self.seed * 40503 + 12345) % (1 << 32)
+            dur += (x / float(1 << 32) - 0.5) * 2.0 * self.jitter_s
+        return max(0.001, min(self.period_s, dur))
+
+    def _start(self, step: int) -> float:
+        return self.start_at + (self.behind_steps + step - 1) * self.period_s
+
+    def window(
+        self, now: float, n: int
+    ) -> tuple[list[tuple[int, float, float]], tuple[int, float] | None, int]:
+        """(last ≤n completed records, open interval, total completed)."""
+        if now < self._start(1):
+            return [], None, 0
+        started = int((now - self._start(1)) // self.period_s) + 1
+        completed = started
+        end_last = self._start(started) + self._duration(started)
+        if end_last > now:
+            completed = started - 1
+        if self.stall_after is not None:
+            completed = min(completed, self.stall_after)
+        records = [
+            (i, self._start(i), self._start(i) + self._duration(i))
+            for i in range(max(1, completed - n + 1), completed + 1)
+        ]
+        open_: tuple[int, float] | None = None
+        nxt = completed + 1
+        if self._start(nxt) <= now:
+            # stalled hosts hold their next step open forever; healthy hosts
+            # expose the genuinely in-flight one
+            if self.stall_after is None or nxt == self.stall_after + 1:
+                open_ = (nxt, self._start(nxt))
+        return records, open_, completed
+
+
 class StepRing:
     """Bounded ring of (step, start, end) intervals; duty cycle is the
     fraction of a trailing window covered by them. Steps never overlap (one
@@ -190,6 +278,26 @@ class StepRing:
         with self._lock:
             return self._steps[-1] if self._steps else None
 
+    def recent(
+        self, n: int
+    ) -> tuple[list[tuple[int, float, float]], tuple[int, float] | None]:
+        """The last ``n`` completed (step, start, end) records plus the
+        currently-open (step, start) interval, if any — the exportable
+        per-step window the gang aggregator consumes."""
+        with self._lock:
+            return list(self._steps[-n:]), self._open
+
+    def replace(
+        self,
+        steps: Sequence[tuple[int, float, float]],
+        open_: tuple[int, float] | None,
+    ) -> None:
+        """Install a full window at once (schedule-driven fakes sync their
+        synthesized stream through here instead of begin/add pairs)."""
+        with self._lock:
+            self._steps = list(steps)[-self.maxlen:]
+            self._open = open_
+
 
 class TelemetryAgent:
     """Aggregates one host's device + step signals into a registry and
@@ -208,10 +316,14 @@ class TelemetryAgent:
         clock: Callable[[], float] = time.time,
         window_s: float = DEFAULT_WINDOW_S,
         ring_len: int = DEFAULT_RING_LEN,
+        step_schedule: FakeStepSchedule | None = None,
+        step_window: int = STEP_WINDOW,
     ) -> None:
         self.backend = backend or JaxDeviceBackend()
         self.clock = clock
         self.window_s = window_s
+        self.step_schedule = step_schedule
+        self.step_window = step_window
         self.ring = StepRing(ring_len)
         self.registry = registry or Registry()
         self.duty = self.registry.gauge(
@@ -240,7 +352,22 @@ class TelemetryAgent:
             "Wall time of one user step (agent step hook)",
             buckets=STEP_BUCKETS,
         )
+        # per-step record stream: one sample per recent step id, rebuilt on
+        # every scrape from the ring. The open step exposes start-only.
+        self.step_start = self.registry.gauge(
+            FAMILY_STEP_START,
+            "Wall start timestamp of a recent step (labeled by step id; the "
+            "currently-open step has a start but no end sample)",
+            labelnames=("step",),
+        )
+        self.step_end = self.registry.gauge(
+            FAMILY_STEP_END,
+            "Wall end timestamp of a recent completed step (labeled by id)",
+            labelnames=("step",),
+        )
         self._step_counter = 0
+        self._sched_total = 0       # schedule: completed steps already synced
+        self._sched_observed = 0    # schedule: highest step id histogrammed
         self._step_lock = threading.Lock()
         # scrapes sample live (the reference's custom-collector idiom)
         self.registry.pre_expose(self.sample)
@@ -274,9 +401,41 @@ class TelemetryAgent:
 
     # -------------------------------------------------------------- sampling
 
+    def _sync_schedule(self) -> None:
+        """Fold the fake schedule's synthesized stream into the ring and the
+        cumulative families (counters only move forward, so the sync incs by
+        the completed-step delta rather than setting)."""
+        steps, open_, total = self.step_schedule.window(
+            self.clock(), self.step_window
+        )
+        delta = total - self._sched_total
+        if delta > 0:
+            self.steps.inc(delta)
+            self._sched_total = total
+        for s, t0, t1 in steps:
+            if s > self._sched_observed:
+                self.step_duration.observe(max(0.0, t1 - t0))
+                self._sched_observed = s
+        self.ring.replace(steps, open_)
+        self._step_counter = max(self._step_counter, total)
+
+    def _export_steps(self) -> None:
+        """Republish the ring's recent window as the labeled step stream."""
+        steps, open_ = self.ring.recent(self.step_window)
+        self.step_start.clear()
+        self.step_end.clear()
+        for s, t0, t1 in steps:
+            self.step_start.set(t0, step=str(s))
+            self.step_end.set(t1, step=str(s))
+        if open_ is not None:
+            self.step_start.set(open_[1], step=str(open_[0]))
+
     def sample(self) -> None:
         """Refresh the gauges from the backend (and the step ring when the
         backend cannot measure duty cycle itself)."""
+        if self.step_schedule is not None:
+            self._sync_schedule()
+        self._export_steps()
         try:
             samples: Sequence[DeviceSample] = self.backend.samples()
         except Exception:

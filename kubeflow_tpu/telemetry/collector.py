@@ -108,6 +108,7 @@ class FleetTelemetryCollector:
         history: int = DEFAULT_HISTORY,
         timeout_s: float = DEFAULT_TIMEOUT_S,
         clock: Callable[[], float] = time.time,
+        perf: Callable[[], float] = time.perf_counter,
         target_for: Callable[[Mapping], tuple[str, int, str]] | None = None,
         probe_fn=probe.probe_many,
         tracer=None,
@@ -122,6 +123,9 @@ class FleetTelemetryCollector:
         self.history = history
         self.timeout_s = timeout_s
         self.clock = clock
+        # pass-duration wall timing only; injectable so the seeded soaks
+        # stay bit-deterministic end to end (TPU001)
+        self._perf = perf
         self.target_for = target_for or default_target_for(cluster_domain, port)
         self.probe_fn = probe_fn
         self.tracer = tracer
@@ -158,7 +162,7 @@ class FleetTelemetryCollector:
             return 0
         self._last_pass = now
         scrapees = self._scrape_targets()
-        t0 = time.perf_counter()
+        t0 = self._perf()
         results: Sequence[probe.ProbeResult] = []
         if scrapees:
             results = self.probe_fn(
@@ -171,7 +175,7 @@ class FleetTelemetryCollector:
             self._evict_and_aggregate(now, {key for key, _ in scrapees})
             self.scrape_passes += 1
             self.sessions_scraped += len(scrapees)
-        self.metrics.pass_duration.observe(time.perf_counter() - t0)
+        self.metrics.pass_duration.observe(self._perf() - t0)
         return len(scrapees)
 
     def _ingest(

@@ -1,0 +1,904 @@
+"""Gang-level step aggregator: per-host step streams → straggler verdicts.
+
+The fleet collector (``collector.py``) scrapes one endpoint per session —
+the coordinator's view — which is exactly right for duty cycle and HBM but
+blind to the data plane PR 17 created: N hosts lock-stepping one JAX
+program. A single slow host drags every peer's collectives and the fleet
+only sees "busy". This module scrapes *every host* of every multi-host gang
+(StatefulSet ordinals == ``TPU_WORKER_ID``, ``spmd/fanout.py``), aligns the
+per-step records the agents now export (``FAMILY_STEP_START/END``), and
+derives the gang-level signals:
+
+- **step-time histogram** — every host's completed steps, per gang;
+- **step skew** — slowest−fastest finish of the latest step id all hosts
+  completed (lockstep gangs read ~0);
+- **straggler index** — per-host median step time over the gang median,
+  with the culprit pod named;
+- **desync** — a host ≥K step ids behind the gang's max;
+- **stall** — no step progress while the host's devices read busy.
+
+Like the collector, ``collect()`` is the only method that performs I/O and
+runs off the reconcile path; reconcilers never wait on a gang pass. Every
+verdict is recorded as a *finding* with the evidence frozen at decision
+time, and ``audit()`` re-proves each claim from that evidence alone — the
+soaks additionally run :func:`audit_gang_attribution` against the planted
+fault map (planted culprits MUST be named, healthy gangs MUST NOT be
+flagged).
+"""
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Callable, Mapping, Sequence
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.culler import probe
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.telemetry import (
+    FAMILY_DUTY_CYCLE,
+    FAMILY_STEP_END,
+    FAMILY_STEP_START,
+    FAMILY_STEP_TOTAL,
+    TELEMETRY_PATH,
+    TELEMETRY_PORT,
+)
+from kubeflow_tpu.tpu import topology as tputopo
+from kubeflow_tpu.utils.metrics import GangMetrics
+from kubeflow_tpu.webapps.metrics_source import parse_prometheus_text
+
+DEFAULT_INTERVAL_S = 15.0
+DEFAULT_STALENESS_S = 60.0
+EVICT_FACTOR = 4.0
+DEFAULT_TIMEOUT_S = 3.0
+DEFAULT_WINDOW = 64            # per-host completed-step records kept
+DEFAULT_STRAGGLER_RATIO = 1.5  # host median / gang median alarm bound
+DEFAULT_MIN_STEPS = 5          # medians need evidence before they indict
+DEFAULT_DESYNC_STEPS = 5       # host this many step ids behind = desynced
+DEFAULT_STALL_AFTER_S = 120.0  # busy with no progress this long = stalled
+DEFAULT_BUSY_DUTY = 0.5        # "devices read busy" bound for stall claims
+MAX_FINDINGS = 256
+FLEET_DURATIONS = 4096         # bounded sample pool for the fleet p99
+
+REASON_STRAGGLER = "StragglerDetected"
+REASON_DESYNC = "GangDesynced"
+
+def gang_median(values: Sequence[float]) -> float:
+    """The gang's reference step time: the LOWER median across hosts. A
+    lock-stepped gang has near-identical host medians, so the convention
+    barely matters when healthy — but a single straggler in a small gang
+    must not drag the reference toward itself (with 2 hosts an interpolated
+    median averages the culprit in, halving its own ratio)."""
+    ordered = sorted(values)
+    return ordered[(len(ordered) - 1) // 2]
+
+
+# the agent's labeled step samples: tpu_step_start_seconds{step="7"} 123.0
+_STEP_SAMPLE = re.compile(
+    r'^(%s|%s)\{step="(\d+)"\}\s+(\S+)\s*$'
+    % (re.escape(FAMILY_STEP_START), re.escape(FAMILY_STEP_END))
+)
+
+
+def parse_step_records(
+    text: str,
+) -> dict[int, tuple[float, float | None]]:
+    """Per-step (start, end) records out of one agent exposition. The open
+    step has a start sample and no end — it parses to ``(start, None)``."""
+    starts: dict[int, float] = {}
+    ends: dict[int, float] = {}
+    for line in text.splitlines():
+        m = _STEP_SAMPLE.match(line)
+        if not m:
+            continue
+        try:
+            val = float(m.group(3))
+        except ValueError:
+            continue
+        (starts if m.group(1) == FAMILY_STEP_START else ends)[
+            int(m.group(2))
+        ] = val
+    return {s: (t0, ends.get(s)) for s, t0 in sorted(starts.items())}
+
+
+def default_gang_target_for(cluster_domain: str, port: int = TELEMETRY_PORT):
+    """(host, port, path) for one host of a gang: the pod's stable DNS name
+    under the headless rendezvous Service (``spmd`` addressing — ordinal N
+    of slice j is ``{sts}-{N}.{name}-tpu.{ns}.svc``)."""
+
+    def target(
+        nb: Mapping, slice_id: int, ordinal: int
+    ) -> tuple[str, int, str]:
+        ns, name = ko.namespace(nb), ko.name(nb)
+        sts = pod_statefulset_name(name, slice_id, api.notebook_num_slices(nb))
+        svc = tputopo.headless_service_name(name)
+        return (
+            f"{sts}-{ordinal}.{svc}.{ns}.svc.{cluster_domain}",
+            port,
+            TELEMETRY_PATH,
+        )
+
+    return target
+
+
+def pod_statefulset_name(name: str, slice_id: int, num_slices: int) -> str:
+    """The slice's StatefulSet name (fan-out convention, spmd/fanout.py)."""
+    return name if num_slices <= 1 else f"{name}-s{slice_id}"
+
+
+def host_key(name: str, slice_id: int, ordinal: int, num_slices: int) -> str:
+    """The host's pod name — the culprit identity every verdict carries."""
+    return f"{pod_statefulset_name(name, slice_id, num_slices)}-{ordinal}"
+
+
+class _Host:
+    """One host's step-stream state inside a tracked gang."""
+
+    __slots__ = (
+        "records", "open", "last_step", "prev_total", "progress_at",
+        "last_ok", "failures", "duty", "epoch_at", "suppress_below",
+        "observed_through",
+    )
+
+    def __init__(self, now: float) -> None:
+        self.records: dict[int, tuple[float, float]] = {}
+        self.open: tuple[int, float] | None = None
+        self.last_step = 0           # max completed step id, current epoch
+        self.prev_total = 0.0        # steps_total at the last good scrape
+        self.progress_at = now       # last time last_step moved forward
+        self.last_ok = float("-inf")
+        self.failures = 0
+        self.duty: float | None = None
+        self.epoch_at = now          # when the current counter epoch began
+        # a restarted pod's counter re-begins at 0: comparing its new ids
+        # against the gang max would read as a 10k-step desync. The host is
+        # suppressed from lag/straggler claims until it climbs back past
+        # the gang max recorded at reset time.
+        self.suppress_below = 0
+        self.observed_through = 0    # highest step id histogrammed
+
+    def fresh(self, now: float, staleness_s: float) -> bool:
+        return now - self.last_ok <= staleness_s
+
+    def aligned(self) -> bool:
+        return self.last_step >= self.suppress_below
+
+    def median_step_s(self) -> float | None:
+        durs = sorted(t1 - t0 for t0, t1 in self.records.values())
+        if not durs:
+            return None
+        mid = len(durs) // 2
+        if len(durs) % 2:
+            return durs[mid]
+        return (durs[mid - 1] + durs[mid]) / 2.0
+
+
+class _Gang:
+    __slots__ = ("hosts", "created_at", "last_ok", "max_step", "active")
+
+    def __init__(self, now: float) -> None:
+        self.hosts: dict[str, _Host] = {}
+        self.created_at = now
+        self.last_ok = float("-inf")
+        self.max_step = 0            # gang-wide max completed step id
+        self.active: set[tuple[str, str]] = set()  # live (kind, host) claims
+
+    def anchor(self) -> float:
+        return max(self.last_ok, self.created_at)
+
+
+class GangTelemetryAggregator:
+    """Scrapes every host of every multi-host gang in one parallel pass per
+    interval and derives the gang-level step signals. ``collect()`` is the
+    only method that performs I/O; reads serve from memory."""
+
+    def __init__(
+        self,
+        cluster,
+        metrics: GangMetrics | None = None,
+        *,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        staleness_s: float = DEFAULT_STALENESS_S,
+        window: int = DEFAULT_WINDOW,
+        straggler_ratio: float = DEFAULT_STRAGGLER_RATIO,
+        min_steps: int = DEFAULT_MIN_STEPS,
+        desync_steps: int = DEFAULT_DESYNC_STEPS,
+        stall_after_s: float = DEFAULT_STALL_AFTER_S,
+        busy_duty: float = DEFAULT_BUSY_DUTY,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+        clock: Callable[[], float] = time.time,
+        perf: Callable[[], float] = time.perf_counter,
+        target_for: Callable[[Mapping, int, int], tuple[str, int, str]]
+        | None = None,
+        probe_fn=probe.probe_many,
+        recorder=None,
+        cluster_domain: str = "cluster.local",
+        port: int = TELEMETRY_PORT,
+    ) -> None:
+        self.cluster = cluster
+        self.metrics = metrics or GangMetrics()
+        self.interval_s = interval_s
+        self.staleness_s = staleness_s
+        self.evict_after_s = staleness_s * EVICT_FACTOR
+        self.window = window
+        self.straggler_ratio = straggler_ratio
+        self.min_steps = min_steps
+        self.desync_steps = desync_steps
+        self.stall_after_s = stall_after_s
+        self.busy_duty = busy_duty
+        self.timeout_s = timeout_s
+        self.clock = clock
+        self._perf = perf
+        self.target_for = target_for or default_gang_target_for(
+            cluster_domain, port
+        )
+        self.probe_fn = probe_fn
+        self.recorder = recorder
+        self._gangs: dict[tuple[str, str], _Gang] = {}
+        self._findings: list[dict] = []
+        self._fleet_durations: list[float] = []
+        self._lock = threading.Lock()
+        self._last_pass = float("-inf")
+        # audit counters: the soaks assert these never move inside a
+        # reconcile tick (gang aggregation lives on the scrape pass only)
+        self.scrape_passes = 0
+        self.hosts_scraped = 0
+
+    # ------------------------------------------------------------- scraping
+
+    def _scrape_targets(
+        self,
+    ) -> list[tuple[tuple[str, str], Mapping, list[tuple[int, int, str]]]]:
+        """Multi-host gangs worth probing: (key, nb, [(slice, ordinal,
+        hostkey)]). Single-host single-slice sessions have no gang to skew;
+        stopped gangs' endpoints are going away by design."""
+        out = []
+        for nb in self.cluster.list("Notebook"):
+            try:
+                topo = api.notebook_topology(nb)
+            except ValueError:
+                continue
+            if topo is None:
+                continue
+            num_slices = api.notebook_num_slices(nb)
+            if not topo.is_multi_host and num_slices <= 1:
+                continue
+            if api.STOP_ANNOTATION in ko.annotations(nb):
+                continue
+            name = ko.name(nb)
+            hosts = [
+                (j, o, host_key(name, j, o, num_slices))
+                for j in range(num_slices)
+                for o in range(topo.num_hosts)
+            ]
+            out.append(((ko.namespace(nb), name), nb, hosts))
+        return out
+
+    def collect(self, force: bool = False) -> int:
+        """One whole-fleet parallel pass over every gang host; returns hosts
+        scraped. Interval-gated like the fleet collector's."""
+        now = self.clock()
+        if not force and now - self._last_pass < self.interval_s:
+            return 0
+        self._last_pass = now
+        gangs = self._scrape_targets()
+        t0 = self._perf()
+        flat: list[tuple[tuple[str, str], str]] = []
+        targets: list[tuple[str, int, str]] = []
+        for key, nb, hosts in gangs:
+            for j, o, hk in hosts:
+                flat.append((key, hk))
+                targets.append(self.target_for(nb, j, o))
+        results: Sequence[probe.ProbeResult] = (
+            self.probe_fn(targets, timeout=self.timeout_s) if targets else []
+        )
+        events: list[tuple[Mapping, str, str]] = []
+        with self._lock:
+            live = {key for key, _, _ in gangs}
+            nb_by_key = {key: nb for key, nb, _ in gangs}
+            for (key, hk), res in zip(flat, results):
+                self._ingest(key, hk, res, now)
+            self._evict(now, live)
+            # clear-and-set: evicted gangs must stop exposing last values
+            self.metrics.host_step_lag.clear()
+            self.metrics.step_skew.clear()
+            self.metrics.straggler_ratio.clear()
+            for key in sorted(live):
+                if key in self._gangs:
+                    events.extend(
+                        self._judge(key, nb_by_key[key], now)
+                    )
+            self._aggregate(now)
+            self.scrape_passes += 1
+            self.hosts_scraped += len(flat)
+        # events go out after the lock drops (recorder writes the store)
+        if self.recorder is not None:
+            for nb, reason, message in events:
+                self.recorder.emit(
+                    self.cluster, nb, reason, message, type_="Warning"
+                )
+        self.metrics.pass_duration.observe(self._perf() - t0)
+        return len(flat)
+
+    def _ingest(
+        self,
+        key: tuple[str, str],
+        hk: str,
+        res: probe.ProbeResult,
+        now: float,
+    ) -> None:
+        gang = self._gangs.get(key)
+        families = parse_prometheus_text(res.body) if res.ok else {}
+        if not res.ok or FAMILY_DUTY_CYCLE not in families:
+            # tracking starts at first data — dead endpoints cannot grow
+            # the store; a host missing from one pass keeps its history
+            if gang is not None and hk in gang.hosts:
+                gang.hosts[hk].failures += 1
+            self.metrics.scrapes.inc(outcome="failed")
+            return
+        self.metrics.scrapes.inc(outcome="ok")
+        if gang is None:
+            gang = self._gangs[key] = _Gang(now)
+        host = gang.hosts.get(hk)
+        if host is None:
+            host = gang.hosts[hk] = _Host(now)
+        records = parse_step_records(res.body)
+        total = families.get(FAMILY_STEP_TOTAL, 0.0)
+        completed = [s for s, (_, t1) in records.items() if t1 is not None]
+        max_completed = max(completed) if completed else 0
+        if total < host.prev_total or (
+            completed and max_completed < host.last_step
+        ):
+            # counter regression: the pod restarted and its step numbering
+            # re-begins — re-epoch rather than reading a 10k-step desync
+            host.records.clear()
+            host.last_step = 0
+            host.epoch_at = now
+            host.suppress_below = gang.max_step
+            host.observed_through = 0
+        for s in completed:
+            t0, t1 = records[s]
+            host.records[s] = (t0, t1)
+        if len(host.records) > self.window:
+            for s in sorted(host.records)[: len(host.records) - self.window]:
+                del host.records[s]
+        open_ = [
+            (s, t0) for s, (t0, t1) in records.items() if t1 is None
+        ]
+        host.open = open_[-1] if open_ else None
+        if max_completed > host.last_step:
+            host.last_step = max_completed
+            host.progress_at = now
+        host.prev_total = total
+        host.duty = families.get(FAMILY_DUTY_CYCLE)
+        host.last_ok = now
+        gang.last_ok = now
+        gang.max_step = max(
+            (
+                h.last_step
+                for h in gang.hosts.values()
+                if h.aligned() and h.fresh(now, self.staleness_s)
+            ),
+            default=0,
+        )
+
+    def _evict(self, now: float, live: set) -> None:
+        for key in [
+            k
+            for k, g in self._gangs.items()
+            if k not in live or now - g.anchor() > self.evict_after_s
+        ]:
+            del self._gangs[key]
+
+    # ------------------------------------------------------------ verdicts
+
+    def _judge(
+        self, key: tuple[str, str], nb: Mapping, now: float
+    ) -> list[tuple[Mapping, str, str]]:
+        """Derive this gang's claims from the ingested streams; record a
+        finding (with frozen evidence) and queue an event on each claim's
+        inactive→active edge. Returns events to emit after the lock drops."""
+        ns, name = key
+        gang = self._gangs[key]
+        events: list[tuple[Mapping, str, str]] = []
+        fresh = {
+            hk: h
+            for hk, h in gang.hosts.items()
+            if h.fresh(now, self.staleness_s)
+        }
+        active: set[tuple[str, str]] = set()
+
+        # straggler: per-host median step time vs the gang median
+        medians = {
+            hk: m
+            for hk, h in fresh.items()
+            if h.aligned()
+            and len(h.records) >= self.min_steps
+            and (m := h.median_step_s()) is not None
+        }
+        if len(medians) >= 2:
+            reference = gang_median(list(medians.values()))
+            if reference > 0:
+                culprit = max(sorted(medians), key=lambda k: medians[k])
+                ratio = medians[culprit] / reference
+                self.metrics.straggler_ratio.set(
+                    ratio, namespace=ns, notebook=name
+                )
+                if ratio >= self.straggler_ratio:
+                    active.add(("straggler", culprit))
+                    if ("straggler", culprit) not in gang.active:
+                        self._record(
+                            ns, name, "straggler", culprit, now,
+                            ratio=ratio,
+                            evidence={
+                                "hostMedians": dict(sorted(medians.items())),
+                                "gangMedian": reference,
+                                "threshold": self.straggler_ratio,
+                                "counts": {
+                                    hk: len(fresh[hk].records)
+                                    for hk in sorted(medians)
+                                },
+                                "minSteps": self.min_steps,
+                            },
+                        )
+                        events.append((
+                            nb, REASON_STRAGGLER,
+                            f"host {culprit} median step "
+                            f"{medians[culprit]:.3f}s is {ratio:.2f}x the "
+                            f"gang median {reference:.3f}s",
+                        ))
+
+        # desync: a host K+ step ids behind the gang's max
+        for hk in sorted(fresh):
+            h = fresh[hk]
+            if not h.aligned():
+                self.metrics.host_step_lag.set(
+                    0.0, namespace=ns, notebook=name, host=hk
+                )
+                continue
+            lag = max(0, gang.max_step - h.last_step)
+            self.metrics.host_step_lag.set(
+                float(lag), namespace=ns, notebook=name, host=hk
+            )
+            if lag >= self.desync_steps:
+                active.add(("desync", hk))
+                if ("desync", hk) not in gang.active:
+                    self._record(
+                        ns, name, "desync", hk, now,
+                        lag_steps=lag,
+                        evidence={
+                            "hostStep": h.last_step,
+                            "gangMaxStep": gang.max_step,
+                            "lagSteps": lag,
+                            "threshold": self.desync_steps,
+                        },
+                    )
+                    events.append((
+                        nb, REASON_DESYNC,
+                        f"host {hk} is {lag} steps behind the gang "
+                        f"(host at {h.last_step}, gang at {gang.max_step})",
+                    ))
+
+        # stall: step signal went quiet while the devices read busy
+        for hk in sorted(fresh):
+            h = fresh[hk]
+            if not h.records and h.open is None:
+                continue  # never instrumented: absence is not a stall
+            # quiet time counts from the last sign of forward motion: a
+            # completed step, a fresh epoch, or the open step's own start —
+            # a step that only just began is a long step, not yet a stall
+            anchor = max(h.progress_at, h.epoch_at)
+            if h.open is not None:
+                anchor = max(anchor, h.open[1])
+            quiet_s = now - anchor
+            if (
+                quiet_s >= self.stall_after_s
+                and h.duty is not None
+                and h.duty >= self.busy_duty
+            ):
+                active.add(("stall", hk))
+                if ("stall", hk) not in gang.active:
+                    self._record(
+                        ns, name, "stall", hk, now,
+                        stall_s=quiet_s,
+                        evidence={
+                            "lastStep": h.last_step,
+                            "stallS": quiet_s,
+                            "duty": h.duty,
+                            "threshold": self.stall_after_s,
+                            "busyDuty": self.busy_duty,
+                        },
+                    )
+                    events.append((
+                        nb, REASON_DESYNC,
+                        f"host {hk} busy (duty {h.duty:.2f}) but no step "
+                        f"progress for {quiet_s:.0f}s (last step "
+                        f"{h.last_step})",
+                    ))
+        gang.active = active
+
+        # skew: the latest step id every fresh aligned host completed
+        aligned = [h for h in fresh.values() if h.aligned() and h.records]
+        if len(aligned) >= 2 and len(aligned) == len(fresh):
+            common = set.intersection(
+                *(set(h.records) for h in aligned)
+            )
+            if common:
+                s = max(common)
+                ends = [h.records[s][1] for h in aligned]
+                self.metrics.step_skew.set(
+                    max(ends) - min(ends), namespace=ns, notebook=name
+                )
+
+        # per-gang histogram + fleet p99 pool: newly completed steps only
+        for hk in sorted(fresh):
+            h = fresh[hk]
+            for s in sorted(h.records):
+                if s <= h.observed_through:
+                    continue
+                t0, t1 = h.records[s]
+                dur = max(0.0, t1 - t0)
+                self.metrics.step_seconds.observe(
+                    dur, namespace=ns, notebook=name
+                )
+                self._fleet_durations.append(dur)
+                h.observed_through = s
+        return events
+
+    def _record(
+        self,
+        ns: str,
+        name: str,
+        kind: str,
+        hk: str,
+        now: float,
+        *,
+        evidence: dict,
+        **extra,
+    ) -> None:
+        self._findings.append({
+            "namespace": ns,
+            "notebook": name,
+            "kind": kind,
+            "host": hk,
+            "at": now,
+            "evidence": evidence,
+            **extra,
+        })
+        if len(self._findings) > MAX_FINDINGS:
+            del self._findings[: len(self._findings) - MAX_FINDINGS]
+        self.metrics.findings.inc(kind=kind)
+
+    def _aggregate(self, now: float) -> None:
+        m = self.metrics
+        m.gangs.set(len(self._gangs))
+        if len(self._fleet_durations) > FLEET_DURATIONS:
+            del self._fleet_durations[
+                : len(self._fleet_durations) - FLEET_DURATIONS
+            ]
+        if self._fleet_durations:
+            ordered = sorted(self._fleet_durations)
+            m.fleet_step_p99.set(
+                ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
+            )
+        worst = 0.0
+        for sample in m.straggler_ratio.samples():
+            worst = max(worst, sample["value"])
+        m.fleet_straggler_ratio.set(worst)
+
+    # ------------------------------------------------------------ read side
+
+    def findings(self) -> list[dict]:
+        with self._lock:
+            return [dict(f) for f in self._findings]
+
+    def fleet_step_p99(self) -> float:
+        return self.metrics.fleet_step_p99.get()
+
+    def fleet_straggler_ratio(self) -> float:
+        return self.metrics.fleet_straggler_ratio.get()
+
+    def first_step_at(
+        self, namespace: str, name: str, since: float | None = None
+    ) -> float | None:
+        """First completed-step end at/after ``since`` across the gang —
+        the collector's ``first_step_at(since=)`` semantics, so a resumed
+        gang measures its own post-resume steps, never the previous
+        incarnation's."""
+        cutoff = since if since is not None else float("-inf")
+        with self._lock:
+            gang = self._gangs.get((namespace, name))
+            if gang is None:
+                return None
+            ends = [
+                t1
+                for h in gang.hosts.values()
+                for _, t1 in h.records.values()
+                if t1 >= cutoff
+            ]
+            return min(ends) if ends else None
+
+    def verdict(self, namespace: str, name: str) -> dict | None:
+        """The gang's current health call: worst active claim + culprit."""
+        with self._lock:
+            gang = self._gangs.get((namespace, name))
+            if gang is None:
+                return None
+            for kind in ("stall", "desync", "straggler"):
+                for k, hk in sorted(gang.active):
+                    if k == kind:
+                        return {"verdict": kind, "culprit": hk}
+            return {"verdict": "healthy", "culprit": None}
+
+    def gang_payload(
+        self, namespace: str, name: str, recent: int = 16
+    ) -> dict | None:
+        """Detail payload for JWA + /debug/gang: per-host step timeline,
+        lag, medians, and the gang verdict."""
+        with self._lock:
+            gang = self._gangs.get((namespace, name))
+            if gang is None:
+                return None
+            now = self.clock()
+            hosts = {}
+            for hk in sorted(gang.hosts):
+                h = gang.hosts[hk]
+                hosts[hk] = {
+                    "lastStep": h.last_step,
+                    "lagSteps": (
+                        max(0, gang.max_step - h.last_step)
+                        if h.aligned()
+                        else 0
+                    ),
+                    "aligned": h.aligned(),
+                    "fresh": h.fresh(now, self.staleness_s),
+                    "failures": h.failures,
+                    "medianStepS": h.median_step_s(),
+                    "dutyCycle": h.duty,
+                    "openStep": (
+                        {"step": h.open[0], "sinceS": round(now - h.open[1], 1)}
+                        if h.open
+                        else None
+                    ),
+                    "recentSteps": [
+                        {
+                            "step": s,
+                            "start": h.records[s][0],
+                            "end": h.records[s][1],
+                            "durationS": round(
+                                h.records[s][1] - h.records[s][0], 4
+                            ),
+                        }
+                        for s in sorted(h.records)[-recent:]
+                    ],
+                }
+            skew = self.metrics.step_skew.get(namespace=namespace, notebook=name)
+            ratio = self.metrics.straggler_ratio.get(
+                namespace=namespace, notebook=name
+            )
+            for kind in ("stall", "desync", "straggler"):
+                claim = next(
+                    (hk for k, hk in sorted(gang.active) if k == kind), None
+                )
+                if claim is not None:
+                    verdict, culprit = kind, claim
+                    break
+            else:
+                verdict, culprit = "healthy", None
+            return {
+                "maxStep": gang.max_step,
+                "stepP50": self.metrics.step_seconds.quantile(
+                    0.5, namespace=namespace, notebook=name
+                ),
+                "stepP99": self.metrics.step_seconds.quantile(
+                    0.99, namespace=namespace, notebook=name
+                ),
+                "stepSkewS": skew,
+                "stragglerRatio": ratio,
+                "verdict": verdict,
+                "culprit": culprit,
+                "hosts": hosts,
+            }
+
+    def per_gang_p99_samples(self) -> list[dict]:
+        """[{labels, value}] of per-gang p99 step time (dashboard series)."""
+        out = []
+        for sample in self.metrics.step_seconds.samples():
+            labels = sample["labels"]
+            out.append({
+                "labels": dict(labels),
+                "value": self.metrics.step_seconds.quantile(0.99, **labels),
+            })
+        return out
+
+    def debug_payload(self) -> dict:
+        with self._lock:
+            keys = sorted(self._gangs)
+        return {
+            "intervalS": self.interval_s,
+            "stalenessS": self.staleness_s,
+            "scrapePasses": self.scrape_passes,
+            "hostsScraped": self.hosts_scraped,
+            "thresholds": {
+                "stragglerRatio": self.straggler_ratio,
+                "desyncSteps": self.desync_steps,
+                "stallAfterS": self.stall_after_s,
+                "minSteps": self.min_steps,
+            },
+            "gangs": [f"{ns}/{name}" for ns, name in keys],
+            "findings": self.findings(),
+        }
+
+    # ---------------------------------------------------------------- audit
+
+    def audit(self, where: str = "gang") -> list[str]:
+        """Soak invariants (docs/chaos.md):
+
+        - **bounded staleness** — no tracked gang outlives eviction;
+        - **evidence-backed claims** — every recorded finding must re-prove
+          from its own frozen evidence: straggler ratio recomputed from the
+          per-host medians it cites (and the culprit is their argmax),
+          desync lag recomputed from the step ids it cites, stall quiet
+          time/duty above the thresholds it cites.
+        """
+        out: list[str] = []
+        with self._lock:
+            now = self.clock()
+            for (ns, name), gang in self._gangs.items():
+                if now - gang.anchor() > self.evict_after_s + self.interval_s:
+                    out.append(
+                        f"{where}: gang {ns}/{name} outlived the eviction "
+                        f"bound ({now - gang.anchor():.0f}s > "
+                        f"{self.evict_after_s:.0f}s)"
+                    )
+            findings = [dict(f) for f in self._findings]
+        for f in findings:
+            key = f"{f['namespace']}/{f['notebook']}"
+            ev = f.get("evidence") or {}
+            if f["kind"] == "straggler":
+                medians = ev.get("hostMedians") or {}
+                counts = ev.get("counts") or {}
+                if f["host"] not in medians:
+                    out.append(
+                        f"{where}: straggler claim on {key} names "
+                        f"{f['host']} absent from its own evidence"
+                    )
+                    continue
+                if medians[f["host"]] != max(medians.values()):
+                    out.append(
+                        f"{where}: straggler claim on {key} names "
+                        f"{f['host']} but a slower host is in evidence"
+                    )
+                gm = gang_median(list(medians.values()))
+                if abs(gm - ev.get("gangMedian", -1)) > 1e-9:
+                    out.append(
+                        f"{where}: straggler claim on {key} cites gang "
+                        f"median {ev.get('gangMedian')} but its own host "
+                        f"medians give {gm}"
+                    )
+                elif gm <= 0 or medians[f["host"]] / gm < ev.get(
+                    "threshold", self.straggler_ratio
+                ):
+                    out.append(
+                        f"{where}: straggler claim on {key}/{f['host']} "
+                        f"below its own threshold"
+                    )
+                short = [
+                    hk
+                    for hk in medians
+                    if counts.get(hk, 0) < ev.get("minSteps", self.min_steps)
+                ]
+                if short:
+                    out.append(
+                        f"{where}: straggler claim on {key} used hosts with "
+                        f"too little evidence: {short}"
+                    )
+            elif f["kind"] == "desync":
+                lag = ev.get("gangMaxStep", 0) - ev.get("hostStep", 0)
+                if lag != ev.get("lagSteps"):
+                    out.append(
+                        f"{where}: desync claim on {key}/{f['host']} cites "
+                        f"lag {ev.get('lagSteps')} but its own step ids "
+                        f"give {lag}"
+                    )
+                elif lag < ev.get("threshold", self.desync_steps):
+                    out.append(
+                        f"{where}: desync claim on {key}/{f['host']} below "
+                        f"its own threshold ({lag} steps)"
+                    )
+            elif f["kind"] == "stall":
+                if ev.get("stallS", 0.0) < ev.get(
+                    "threshold", self.stall_after_s
+                ):
+                    out.append(
+                        f"{where}: stall claim on {key}/{f['host']} below "
+                        f"its own quiet-time threshold"
+                    )
+                elif (ev.get("duty") or 0.0) < ev.get(
+                    "busyDuty", self.busy_duty
+                ):
+                    out.append(
+                        f"{where}: stall claim on {key}/{f['host']} on a "
+                        f"host that was not busy (duty {ev.get('duty')})"
+                    )
+        return out
+
+
+def audit_gang_attribution(
+    aggregator: GangTelemetryAggregator,
+    planted: Mapping[tuple[str, str], Mapping],
+    *,
+    where: str = "gang-attribution",
+) -> list[str]:
+    """The planted-truth audit the soaks run: every planted culprit MUST be
+    detected and named, and no finding may indict anything else.
+
+    ``planted`` maps (namespace, name) → {"kind": straggler|desync|stall,
+    "host": <pod name>}. A stalled host legitimately also accrues desync
+    findings (its step id freezes while the gang advances), so stall plants
+    accept either kind — but always only the planted host.
+    """
+    out: list[str] = []
+    findings = aggregator.findings()
+    allowed = {"straggler": {"straggler"}, "desync": {"desync"},
+               "stall": {"stall", "desync"}}
+    for f in findings:
+        key = (f["namespace"], f["notebook"])
+        plant = planted.get(key)
+        if plant is None:
+            out.append(
+                f"{where}: false {f['kind']} claim on healthy gang "
+                f"{f['namespace']}/{f['notebook']} (host {f['host']})"
+            )
+        elif f["host"] != plant["host"] or f["kind"] not in allowed.get(
+            plant["kind"], set()
+        ):
+            out.append(
+                f"{where}: {f['namespace']}/{f['notebook']} planted "
+                f"{plant['kind']}@{plant['host']} but the aggregator "
+                f"claimed {f['kind']}@{f['host']}"
+            )
+    for (ns, name), plant in sorted(planted.items()):
+        hits = [
+            f
+            for f in findings
+            if (f["namespace"], f["notebook"]) == (ns, name)
+            and f["host"] == plant["host"]
+            and f["kind"] in allowed.get(plant["kind"], set())
+        ]
+        if not hits:
+            out.append(
+                f"{where}: planted {plant['kind']} on {ns}/{name} host "
+                f"{plant['host']} was never detected"
+            )
+    return out
+
+
+def install_gang_route(app, aggregator: GangTelemetryAggregator) -> None:
+    """Mount /debug/gang + /debug/gang/<ns>/<name> on a web App (rides the
+    probes port next to /debug/telemetry — cluster-internal)."""
+    import json
+
+    from werkzeug.wrappers import Response
+
+    @app.route("/debug/gang")
+    def debug_gang_index(request):
+        return Response(
+            json.dumps(aggregator.debug_payload(), sort_keys=True),
+            mimetype="application/json",
+        )
+
+    @app.route("/debug/gang/<namespace>/<name>")
+    def debug_gang(request, namespace, name):
+        payload = aggregator.gang_payload(namespace, name)
+        if payload is None:
+            return Response(
+                json.dumps({"error": f"no gang telemetry for "
+                            f"{namespace}/{name}"}),
+                status=404,
+                mimetype="application/json",
+            )
+        return Response(
+            json.dumps(payload, sort_keys=True),
+            mimetype="application/json",
+        )

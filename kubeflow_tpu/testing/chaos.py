@@ -962,6 +962,7 @@ def run_scenario(
     faults: ChaosConfig | None = None,
     *,
     telemetry: bool = False,
+    gang_audit: bool = True,
     shards: int = 1,
     max_restarts_per_tick: int = 6,
     lost_update_audit: bool = True,
@@ -977,6 +978,14 @@ def run_scenario(
     observer, like the tracer), scrapes run ONLY from the harness driver
     (never inside a reconcile tick — audited), and scrape failures are
     chaos faults. The telemetry audit rides the run's violations.
+
+    ``gang_audit=True`` (with ``telemetry``) additionally arms the gang
+    step-telemetry arm (telemetry/gang.py): every host of every multi-host
+    gang gets its own agent with a seeded step schedule, ONE seed-drawn
+    culprit shape is planted (a 2x-slow host, a lagging host, or a
+    mid-run stall), and the final attribution audit requires the planted
+    culprit to be named — and nothing else to be flagged — with every
+    claim re-proven from its frozen evidence.
 
     ``shards=N`` (docs/chaos.md "sharded soak") runs N managers over the
     same store, each enqueue-filtered to its namespace-hash slice
@@ -1084,6 +1093,146 @@ def run_scenario(
             probe_fn=fake_probe,
             target_for=lambda nb: (ko.namespace(nb), 0, ko.name(nb)),
             tracer=tracer,
+        )
+
+    gang_agg = None
+    gang_planted: dict[tuple[str, str], dict] = {}
+    if telemetry and gang_audit:
+        from kubeflow_tpu.culler.probe import ProbeResult
+        from kubeflow_tpu.telemetry.agent import (
+            FakeDeviceBackend,
+            FakeStepSchedule,
+            TelemetryAgent,
+        )
+        from kubeflow_tpu.telemetry.gang import (
+            GangTelemetryAggregator,
+            audit_gang_attribution,
+            host_key as gang_host_key,
+        )
+        from kubeflow_tpu.utils.metrics import GangMetrics
+
+        # every host of every multi-host gang gets its OWN agent: the gang
+        # aggregator's subject is per-host step streams, so the fakes live
+        # at pod granularity (the fleet collector above keeps scraping
+        # ordinal 0 only — separate pipelines, separate fault streams)
+        multi: list[tuple[str, int, int]] = []
+        for name in sorted(scenario.notebooks):
+            spec = scenario.notebooks[name]
+            if "tpu_accelerator" not in spec:
+                continue
+            nb_obj = api.notebook(name, scenario.nb_ns[name], **spec)
+            topo = api.notebook_topology(nb_obj)
+            num_slices = api.notebook_num_slices(nb_obj)
+            if topo is None or (not topo.is_multi_host and num_slices <= 1):
+                continue
+            multi.append((name, num_slices, topo.num_hosts))
+        # plant ONE seed-drawn culprit shape on one gang host. The shapes
+        # map to the claims they must produce: a 2x-slow host to a
+        # straggler verdict, a lagging host to desync, a stalled host to
+        # stall-or-desync (its frozen step id lags the gang more every
+        # pass, so either claim names it).
+        plant: tuple[str, str, int, int] | None = None
+        if multi:
+            plant_rng = random.Random(f"gang-plant-{seed}")
+            pname, pslices, phosts = multi[plant_rng.randrange(len(multi))]
+            pkind = ("slow", "lagging", "stalled")[plant_rng.randrange(3)]
+            pj = plant_rng.randrange(pslices)
+            po = plant_rng.randrange(phosts)
+            plant = (pname, pkind, pj, po)
+            gang_planted[(scenario.nb_ns[pname], pname)] = {
+                "kind": {"slow": "straggler", "lagging": "desync",
+                         "stalled": "stall"}[pkind],
+                "host": gang_host_key(pname, pj, po, pslices),
+            }
+        shapes = {
+            "slow": dict(slow_factor=2.0),
+            "lagging": dict(behind_steps=15),
+            "stalled": dict(stall_after=5),
+        }
+        gang_agents: dict[str, TelemetryAgent] = {}
+        for name, num_slices, num_hosts in multi:
+            if name in scenario.idle_spin:
+                duty = 0.01
+            elif name in scenario.active:
+                duty = 0.9
+            else:
+                duty = 0.0
+            for j in range(num_slices):
+                for o in range(num_hosts):
+                    shape = (
+                        shapes[plant[1]]
+                        if plant is not None
+                        and (name, j, o) == (plant[0], plant[2], plant[3])
+                        else {}
+                    )
+                    # backdated start: steps already exist at arm time, so
+                    # the first pass ingests a full window (min_steps met
+                    # immediately — detection never races the op timeline)
+                    sched = FakeStepSchedule(
+                        period_s=6.0, duration_s=2.5,
+                        start_at=clock() - 200.0, jitter_s=0.15,
+                        seed=seed * 1000 + j * 16 + o, **shape,
+                    )
+                    gang_agents[gang_host_key(name, j, o, num_slices)] = (
+                        TelemetryAgent(
+                            FakeDeviceBackend(
+                                duty_cycle=duty,
+                                hbm_used_bytes=float(duty * (8 << 30)),
+                                jitter=0.005, seed=seed,
+                            ),
+                            clock=clock,
+                            step_schedule=sched,
+                        )
+                    )
+        # gang scrapes draw failures from their OWN seeded stream, so the
+        # fleet collector's fault pattern is identical with or without the
+        # gang arm (repro flags stay composable)
+        gang_rng = random.Random(f"gang-telemetry-{seed}")
+
+        def gang_probe(targets, timeout=5.0, max_concurrency=64):
+            out = []
+            for host, _port, _path in targets:
+                agent = gang_agents.get(host)
+                if agent is None:
+                    out.append(ProbeResult(-1, ""))
+                elif (
+                    chaos is not None
+                    and not chaos._healed
+                    and gang_rng.random() < 0.15
+                ):
+                    out.append(
+                        ProbeResult(-2 if gang_rng.random() < 0.5 else -1, "")
+                    )
+                else:
+                    out.append(ProbeResult(200, agent.exposition()))
+            return out
+
+        # ONE aggregator across controller restarts (an observer, like the
+        # collector). desync_steps must exceed staleness_s/period_s (=5
+        # steps here): a host whose scrapes merely failed for a while is
+        # either still inside the freshness window (bounded stale step id)
+        # or excluded — only a genuinely lagging stream can show more lag.
+        # Same shape for the stall bound: stall_after_s > staleness_s, so
+        # a host that just stopped answering goes stale (excluded) before
+        # its quiet time can read as a stall.
+        gang_agg = GangTelemetryAggregator(
+            base,
+            GangMetrics(),
+            interval_s=10.0,
+            staleness_s=30.0,
+            min_steps=3,
+            desync_steps=10,
+            stall_after_s=45.0,
+            clock=clock,
+            probe_fn=gang_probe,
+            target_for=lambda nb, j, o: (
+                gang_host_key(
+                    ko.name(nb), j, o, api.notebook_num_slices(nb)
+                ),
+                0,
+                "/",
+            ),
+            recorder=EventRecorder(component="gang-telemetry", clock=clock),
         )
 
     # the efficiency ledger is an observer like the tracer and the
@@ -1254,6 +1403,7 @@ def run_scenario(
         # it never scrapes. A regression wiring collect() into a reconciler
         # (or the culler) trips this on every seed.
         passes_before = collector.scrape_passes if collector is not None else 0
+        gang_before = gang_agg.scrape_passes if gang_agg is not None else 0
         for idx in range(len(managers)):
             for _ in range(max_restarts_per_tick):
                 crashed = False
@@ -1280,6 +1430,12 @@ def run_scenario(
                 f"({collector.scrape_passes - passes_before} pass(es) "
                 f"during a manager tick)"
             )
+        if gang_agg is not None and gang_agg.scrape_passes != gang_before:
+            violations.append(
+                f"{where}: gang step scrape ran on the reconcile path "
+                f"({gang_agg.scrape_passes - gang_before} pass(es) "
+                f"during a manager tick)"
+            )
 
     def drive(where: str, *, sub_ticks: int = 3, dt: float = 10.0) -> None:
         for s in range(sub_ticks):
@@ -1290,6 +1446,10 @@ def run_scenario(
                 # the controller-manager's dedicated loop (cmd/controller):
                 # a scrape pass between ticks, interval-gated, never inside
                 collector.collect()
+            if gang_agg is not None:
+                # rides the same loop in cmd/controller: one gang pass per
+                # telemetry pass, interval-gated, never inside a reconcile
+                gang_agg.collect()
             ledger.tick(force=True)
             tick(where)
             if chaos is not None:
@@ -1322,6 +1482,20 @@ def run_scenario(
     if chaos is not None:
         chaos.heal()
 
+    if gang_agg is not None and gang_planted:
+        # the planted culprit needs a post-fault observation window: the op
+        # timeline may have left its gang stopped or deleted, so the
+        # harness deterministically brings it back for the settle phase.
+        # Both runs apply the identical op (store state at this point is
+        # op-timeline-driven and thus identical), so the fixed-point
+        # comparison is unaffected.
+        for ns, name in sorted(gang_planted):
+            try:
+                base.get("Notebook", name, ns)
+            except NotFound:
+                scenario.apply(base, ("recreate_nb", name), 0)
+            scenario.apply(base, ("start", name), 0)
+
     # settle: push the clock far past the cull-idle threshold (60 s) and the
     # error-backoff cap (64 s) so both runs reach the same steady state
     for s in range(8):
@@ -1335,6 +1509,8 @@ def run_scenario(
         cluster.step_kubelet()
         if collector is not None:
             collector.collect()
+        if gang_agg is not None:
+            gang_agg.collect()
         ledger.tick(force=True)
         tick(f"quiesce {s}")
         fp = fingerprint(base)
@@ -1390,6 +1566,15 @@ def run_scenario(
         # bounded, and every duty-cycle cull explainable from the recorded
         # series (zero reconcile-path scrapes is asserted per tick above)
         violations.extend(collector.audit(where="final"))
+    if gang_agg is not None:
+        # gang step-telemetry audit (docs/observability.md): bounded
+        # staleness, every straggler/desync/stall claim re-proven from its
+        # own frozen evidence, and the planted-truth attribution — the
+        # seeded culprit must be named, healthy gangs must never be flagged
+        violations.extend(gang_agg.audit(where="final"))
+        violations.extend(
+            audit_gang_attribution(gang_agg, gang_planted, where="final")
+        )
     if ledger_audit:
         # conservation audit (docs/chaos.md "efficiency ledger"): per seed,
         # Σ buckets == ∫ capacity dt exactly (integer equality, no
@@ -1410,6 +1595,7 @@ def run_seed(
     faults: ChaosConfig | None = None,
     *,
     telemetry: bool = False,
+    gang_audit: bool = True,
     shards: int = 1,
     lost_update_audit: bool = True,
     explain_audit: bool = True,
@@ -1419,15 +1605,19 @@ def run_seed(
     ``telemetry=True`` runs BOTH with the data-plane pipeline armed — the
     fixed point then includes duty-cycle culls of idle-spinners, so
     convergence proves the faulted run's telemetry decisions match the
-    fault-free run's. ``shards=N`` runs BOTH with the sharded control plane
+    fault-free run's. ``gang_audit=True`` (with ``telemetry``) arms the
+    gang step-telemetry arm and its planted-culprit attribution audit in
+    BOTH runs. ``shards=N`` runs BOTH with the sharded control plane
     (N namespace-filtered managers, one shard's leader killed per round) —
     convergence then proves the partition changes no outcomes."""
     reference = run_scenario(
-        seed, None, telemetry=telemetry, shards=shards,
+        seed, None, telemetry=telemetry, gang_audit=gang_audit,
+        shards=shards,
         explain_audit=explain_audit, ledger_audit=ledger_audit,
     )
     chaotic = run_scenario(
-        seed, faults or ChaosConfig(), telemetry=telemetry, shards=shards,
+        seed, faults or ChaosConfig(), telemetry=telemetry,
+        gang_audit=gang_audit, shards=shards,
         lost_update_audit=lost_update_audit, explain_audit=explain_audit,
         ledger_audit=ledger_audit,
     )

@@ -78,6 +78,10 @@ class ControllerConfig:
     telemetry_staleness_s: float = 60.0
     telemetry_duty_cycle_idle: float = 0.05
     telemetry_port: int = 8890
+    # Gang-level step aggregator (telemetry/gang.py): scrapes every host of
+    # every multi-host gang for per-step records and derives straggler/
+    # desync verdicts. Rides the collector's loop; needs telemetry_enabled.
+    gang_telemetry_enabled: bool = False
     # Fleet efficiency ledger (obs/ledger.py): exactly-once chip-second
     # accounting with waste attribution — busy/idle/starting/suspending/
     # draining/free/stranded per pool, family, and namespace, plus queued
@@ -145,6 +149,7 @@ class ControllerConfig:
                 "TELEMETRY_DUTY_CYCLE_IDLE", 0.05
             ),
             telemetry_port=int(_env_float("TELEMETRY_PORT", 8890)),
+            gang_telemetry_enabled=_env_bool("GANG_TELEMETRY_ENABLED", True),
             ledger_enabled=_env_bool("LEDGER_ENABLED", True),
             ledger_interval_s=_env_float("LEDGER_INTERVAL_S", 15.0),
             capacity_enabled=_env_bool("CAPACITY_ENABLED", False),

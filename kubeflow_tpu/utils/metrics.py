@@ -1028,6 +1028,77 @@ class TelemetryMetrics:
         )
 
 
+class GangMetrics:
+    """Gang-level data-plane observability (telemetry/gang.py,
+    docs/observability.md "gang step telemetry"): per-gang step-time
+    distributions and the straggler/desync signals the aggregator derives
+    from the per-host step streams. Sits next to ``TelemetryMetrics`` on the
+    shared registry: duty cycle says the gang is *busy*, these families say
+    whether its hosts are busy *in lockstep* — the gap is a straggling or
+    desynced host dragging every peer's collectives.
+    """
+
+    # one SPMD step: sub-second decode loops to multi-minute eval passes
+    STEP_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0)
+    # aggregation over ~200 gangs x 8 hosts must stay well under a scrape
+    # interval; bucket where the STEP_BENCH gate lives
+    PASS_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
+    def __init__(self, registry: Registry | None = None) -> None:
+        self.registry = registry or Registry()
+        self.step_seconds = self.registry.histogram(
+            "tpu_gang_step_seconds",
+            "Completed step durations across one gang's hosts (every host's "
+            "steps land in the gang's histogram)",
+            labelnames=("namespace", "notebook"),
+            buckets=self.STEP_BUCKETS,
+        )
+        self.step_skew = self.registry.gauge(
+            "tpu_gang_step_skew_seconds",
+            "Slowest-minus-fastest finish of the latest step id every host "
+            "completed (lockstep gangs read ~0)",
+            labelnames=("namespace", "notebook"),
+        )
+        self.straggler_ratio = self.registry.gauge(
+            "tpu_gang_straggler_ratio",
+            "Worst host's median step time over the gang median (1.0 = "
+            "balanced; the straggler alarm threshold is the aggregator's)",
+            labelnames=("namespace", "notebook"),
+        )
+        self.host_step_lag = self.registry.gauge(
+            "tpu_gang_host_step_lag",
+            "Steps a host's latest completed id trails the gang's max "
+            "(reset-suppressed hosts report 0 until they re-align)",
+            labelnames=("namespace", "notebook", "host"),
+        )
+        self.fleet_step_p99 = self.registry.gauge(
+            "tpu_gang_fleet_step_p99_seconds",
+            "p99 completed-step duration across all tracked gangs",
+        )
+        self.fleet_straggler_ratio = self.registry.gauge(
+            "tpu_gang_fleet_straggler_ratio",
+            "Worst straggler ratio across all tracked gangs",
+        )
+        self.gangs = self.registry.gauge(
+            "tpu_gang_sessions", "Multi-host gangs the aggregator tracks"
+        )
+        self.scrapes = self.registry.counter(
+            "tpu_gang_scrape_total",
+            "Per-host gang scrape outcomes (ok|failed)",
+            labelnames=("outcome",),
+        )
+        self.findings = self.registry.counter(
+            "tpu_gang_finding_total",
+            "Straggler/desync/stall findings the aggregator recorded",
+            labelnames=("kind",),
+        )
+        self.pass_duration = self.registry.histogram(
+            "tpu_gang_pass_seconds",
+            "Wall time of one whole-fleet gang aggregation pass",
+            buckets=self.PASS_BUCKETS,
+        )
+
+
 class LedgerMetrics:
     """Fleet efficiency ledger (obs/ledger.py, docs/observability.md
     "efficiency ledger"): exactly-once chip-second accounting. The

@@ -67,6 +67,7 @@ def create_app(
     metrics_source: MetricsSource | None = None,
     links: dict | None = None,
     telemetry=None,
+    gang=None,
     slo=None,
     scheduler=None,
     ledger=None,
@@ -91,6 +92,13 @@ def create_app(
         # collector's last pass, so the dashboard ticker never scrapes
         readers["duty_cycle"] = telemetry.fleet_duty_cycle
         readers["hbm"] = telemetry.fleet_hbm_utilization
+    if gang is not None:
+        # gang step series (telemetry/gang.py): fleet p99 step time and the
+        # worst straggler ratio — "is any gang being dragged" next to the
+        # duty cycle's "are the chips busy". Memory reads off the
+        # aggregator's last pass.
+        readers["step_p99"] = gang.fleet_step_p99
+        readers["straggler_ratio"] = gang.fleet_straggler_ratio
     if slo is not None:
         # startup SLO series (obs/slo.py): click-to-ready p99 off the real
         # histogram and the fast-window error-budget burn rate — the two
@@ -386,6 +394,14 @@ def create_app(
             values = telemetry.metrics.session_duty_cycle.samples()
         elif telemetry is not None and metric_type == "hbm":
             values = telemetry.metrics.session_hbm_used.samples()
+        elif gang is not None and metric_type == "step_p99":
+            # per-gang p99 step time as the labeled values; the fleet p99
+            # is the series
+            values = gang.per_gang_p99_samples()
+        elif gang is not None and metric_type == "straggler_ratio":
+            # per-gang straggler index as the labeled values; the worst
+            # gang's ratio is the series
+            values = gang.metrics.straggler_ratio.samples()
         elif slo is not None and metric_type == "startup_p99":
             values = [{"labels": {}, "value": slo.startup_p99()}]
         elif slo is not None and metric_type == "startup_burn_rate":

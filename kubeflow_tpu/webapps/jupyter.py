@@ -240,6 +240,7 @@ def create_app(
     config_path: str | None = None,
     metrics: NotebookMetrics | None = None,
     telemetry=None,
+    gang=None,
     timeline=None,
     ledger=None,
     capacity=None,
@@ -281,6 +282,8 @@ def create_app(
         parts = []
         if tel is not None:
             parts.append(f"tel:{getattr(tel, 'scrape_passes', 0)}")
+        if gang is not None:
+            parts.append(f"gang:{getattr(gang, 'scrape_passes', 0)}")
         if ledger is not None:
             parts.append(f"led:{getattr(ledger, 'ticks', 0)}")
         cap = _cap_extra()
@@ -450,6 +453,12 @@ def create_app(
             # None (vs absent) for a session the collector has never seen,
             # so the UI can distinguish "no agent" from "telemetry off".
             summary["telemetry"] = telemetry.session_payload(namespace, name)
+        if gang is not None:
+            # gang step telemetry (telemetry/gang.py): per-host step
+            # timeline, skew/straggler verdict, and the named culprit —
+            # the "which host is dragging my gang" answer. None for a
+            # single-host session or one the aggregator has never scraped.
+            summary["gang"] = gang.gang_payload(namespace, name)
         if timeline is not None:
             # the click-to-ready timeline (obs/timeline.py): per-phase
             # attribution of this session's startup — "which layer ate the

@@ -1,0 +1,416 @@
+"""Gang step telemetry: aggregator judgments, edge cases, and surfaces.
+
+Pins ``telemetry/gang.py`` (docs/observability.md "gang step telemetry"):
+the straggler/desync/stall judgments over per-host step streams, the edge
+cases the soaks exposed (a host missing one scrape pass, a restarted pod's
+counter reset, suspend→resume step anchoring), the evidence + attribution
+audits' teeth, and every consumer surface — Warning events, /debug/gang,
+the JWA detail payload, and the dashboard series.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+from werkzeug.test import Client
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.culler.probe import ProbeResult
+from kubeflow_tpu.obs.events import EventRecorder, audit_events
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import FakeCluster
+from kubeflow_tpu.telemetry.agent import (
+    FakeDeviceBackend,
+    FakeStepSchedule,
+    TelemetryAgent,
+)
+from kubeflow_tpu.telemetry.gang import (
+    GangTelemetryAggregator,
+    REASON_DESYNC,
+    REASON_STRAGGLER,
+    audit_gang_attribution,
+    host_key,
+    install_gang_route,
+)
+from kubeflow_tpu.utils.metrics import GangMetrics
+from kubeflow_tpu.webhooks import tpu_env
+
+NS = "team-a"
+
+
+class FakeClock:
+    def __init__(self, t: float = 1_000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def _world(names=("nb",), ns=NS):
+    cluster = FakeCluster()
+    tpu_env.install(cluster)
+    for name in names:
+        # v4 2x2x2 = 8 chips / 4 per host = a 2-host gang
+        cluster.create(
+            api.notebook(name, ns, tpu_accelerator="v4", tpu_topology="2x2x2")
+        )
+    return cluster
+
+
+def _agents(clock, names=("nb",), hosts=2, shapes=None, duty=0.9):
+    """One agent per gang host; ``shapes`` maps host keys to FakeStepSchedule
+    fault kwargs (slow_factor / behind_steps / stall_after). Schedules are
+    backdated so min_steps of history exists at the very first pass — the
+    soaks' convention."""
+    shapes = shapes or {}
+    agents = {}
+    for name in names:
+        for o in range(hosts):
+            hk = host_key(name, 0, o, 1)
+            agents[hk] = TelemetryAgent(
+                FakeDeviceBackend(duty_cycle=duty, seed=o),
+                clock=clock,
+                step_schedule=FakeStepSchedule(
+                    period_s=6.0,
+                    duration_s=2.5,
+                    start_at=clock() - 200.0,
+                    jitter_s=0.15,
+                    seed=o,
+                    **shapes.get(hk, {}),
+                ),
+            )
+    return agents
+
+
+def _mk(cluster, agents, clock, *, fail=None, recorder=None):
+    """Aggregator over in-process fake agents with the soak-robust
+    thresholds; ``fail`` is a mutable set of host keys whose scrape dies."""
+
+    def fake_probe(targets, timeout=5.0, max_concurrency=64):
+        out = []
+        for hk, _port, _path in targets:
+            if fail and hk in fail:
+                out.append(ProbeResult(-1, ""))
+            else:
+                out.append(ProbeResult(200, agents[hk].exposition()))
+        return out
+
+    return GangTelemetryAggregator(
+        cluster,
+        GangMetrics(),
+        interval_s=10.0,
+        staleness_s=30.0,
+        min_steps=3,
+        desync_steps=10,
+        stall_after_s=45.0,
+        clock=clock,
+        probe_fn=fake_probe,
+        target_for=lambda nb, j, o: (
+            host_key(ko.name(nb), j, o, api.notebook_num_slices(nb)), 0, "/"
+        ),
+        recorder=recorder,
+    )
+
+
+def _drive(agg, clock, passes=6, step_s=10.0):
+    for _ in range(passes):
+        agg.collect(force=True)
+        clock.advance(step_s)
+
+
+# ----------------------------------------------------------------- judgments
+
+
+class TestJudgments:
+    def test_straggler_named_and_audited(self):
+        clock = FakeClock()
+        cluster = _world()
+        culprit = host_key("nb", 0, 1, 1)
+        agents = _agents(clock, shapes={culprit: {"slow_factor": 2.0}})
+        agg = _mk(cluster, agents, clock)
+        _drive(agg, clock)
+        kinds = {(f["kind"], f["host"]) for f in agg.findings()}
+        assert ("straggler", culprit) in kinds
+        assert agg.verdict(NS, "nb") == {
+            "verdict": "straggler", "culprit": culprit,
+        }
+        ratio = agg.metrics.straggler_ratio.get(namespace=NS, notebook="nb")
+        assert ratio == pytest.approx(2.0, rel=0.25)
+        # every claim re-proves from its own frozen evidence, and the
+        # planted-truth audit accepts the attribution
+        assert agg.audit() == []
+        planted = {(NS, "nb"): {"kind": "straggler", "host": culprit}}
+        assert audit_gang_attribution(agg, planted) == []
+
+    def test_attribution_audit_flags_false_and_missed_claims(self):
+        """The audit's teeth: the same straggler run is a violation when the
+        plant map says the gang was healthy, or when it planted a culprit
+        the aggregator never named."""
+        clock = FakeClock()
+        cluster = _world()
+        culprit = host_key("nb", 0, 1, 1)
+        agents = _agents(clock, shapes={culprit: {"slow_factor": 2.0}})
+        agg = _mk(cluster, agents, clock)
+        _drive(agg, clock)
+        false_claims = audit_gang_attribution(agg, {})
+        assert false_claims and "false" in false_claims[0]
+        missed = audit_gang_attribution(
+            agg, {(NS, "nb-ghost"): {"kind": "stall", "host": "nb-ghost-0"}}
+        )
+        assert any("never detected" in v for v in missed)
+
+    def test_desync_lag_and_finding(self):
+        clock = FakeClock()
+        cluster = _world()
+        culprit = host_key("nb", 0, 0, 1)
+        agents = _agents(clock, shapes={culprit: {"behind_steps": 15}})
+        agg = _mk(cluster, agents, clock)
+        _drive(agg, clock)
+        kinds = {(f["kind"], f["host"]) for f in agg.findings()}
+        assert ("desync", culprit) in kinds
+        lag = agg.metrics.host_step_lag.get(
+            namespace=NS, notebook="nb", host=culprit
+        )
+        assert lag == pytest.approx(15, abs=1)
+        assert agg.audit() == []
+
+    def test_stall_requires_busy_devices(self):
+        """A stalled step stream only indicts a host whose devices read
+        busy; the same quiet stream on an idle host is a finished (or
+        suspended) workload, not a hang."""
+        clock = FakeClock()
+        cluster = _world(("nb-busy", "nb-idle"))
+        busy_culprit = host_key("nb-busy", 0, 1, 1)
+        idle_quiet = host_key("nb-idle", 0, 1, 1)
+        agents = {
+            **_agents(
+                clock, ("nb-busy",),
+                shapes={busy_culprit: {"stall_after": 5}}, duty=0.9,
+            ),
+            **_agents(
+                clock, ("nb-idle",),
+                shapes={idle_quiet: {"stall_after": 5}}, duty=0.2,
+            ),
+        }
+        agg = _mk(cluster, agents, clock)
+        _drive(agg, clock)
+        stalls = {
+            (f["notebook"], f["host"])
+            for f in agg.findings()
+            if f["kind"] == "stall"
+        }
+        assert ("nb-busy", busy_culprit) in stalls
+        assert all(name != "nb-idle" for name, _ in stalls)
+        assert agg.audit() == []
+
+    def test_healthy_gang_stays_clean(self):
+        clock = FakeClock()
+        cluster = _world()
+        agg = _mk(cluster, _agents(clock), clock)
+        _drive(agg, clock, passes=10)
+        assert agg.findings() == []
+        assert agg.verdict(NS, "nb") == {"verdict": "healthy", "culprit": None}
+        ratio = agg.metrics.straggler_ratio.get(namespace=NS, notebook="nb")
+        assert ratio == pytest.approx(1.0, rel=0.3)
+        assert audit_gang_attribution(agg, {}) == []
+
+
+# ---------------------------------------------------------------- edge cases
+
+
+class TestEdgeCases:
+    def test_host_missing_one_pass_is_not_desynced(self):
+        """Bounded staleness: a host that misses scrapes keeps its history
+        and stays fresh up to staleness_s — two failed passes (20s) must
+        not read as a 2-3 step 'lag', let alone a desync."""
+        clock = FakeClock()
+        cluster = _world()
+        flaky = host_key("nb", 0, 1, 1)
+        fail: set = set()
+        agents = _agents(clock)
+        agg = _mk(cluster, agents, clock, fail=fail)
+        _drive(agg, clock, passes=2)
+        fail.add(flaky)
+        _drive(agg, clock, passes=2)
+        fail.clear()
+        _drive(agg, clock, passes=2)
+        assert agg.findings() == []
+        payload = agg.gang_payload(NS, "nb")
+        assert payload["hosts"][flaky]["failures"] == 2
+        assert payload["hosts"][flaky]["fresh"] is True
+        assert payload["verdict"] == "healthy"
+
+    def test_counter_reset_reepochs_instead_of_desync(self):
+        """A restarted pod's step counter re-begins at 1 while the gang is
+        thousands of steps ahead — that is a re-epoch (lag suppressed to 0
+        until the host re-aligns), never a 10k-step desync claim."""
+        clock = FakeClock()
+        cluster = _world()
+        restarted = host_key("nb", 0, 1, 1)
+        agents = _agents(clock)
+        agg = _mk(cluster, agents, clock)
+        _drive(agg, clock, passes=3)
+        # the pod restarts: a brand-new agent whose schedule (and counter)
+        # starts now, ~35 step ids behind its own history
+        agents[restarted] = TelemetryAgent(
+            FakeDeviceBackend(duty_cycle=0.9, seed=1),
+            clock=clock,
+            step_schedule=FakeStepSchedule(
+                period_s=6.0, duration_s=2.5, start_at=clock(), seed=1
+            ),
+        )
+        _drive(agg, clock, passes=3)
+        assert [f for f in agg.findings() if f["kind"] == "desync"] == []
+        lag = agg.metrics.host_step_lag.get(
+            namespace=NS, notebook="nb", host=restarted
+        )
+        assert lag == 0.0
+        assert agg.gang_payload(NS, "nb")["hosts"][restarted]["aligned"] is False
+        assert agg.audit() == []
+
+    def test_first_step_at_since_anchors_resume(self):
+        """A resumed gang measures its own post-resume steps: first_step_at
+        with since= skips every step the previous incarnation completed."""
+        clock = FakeClock()
+        cluster = _world()
+        agg = _mk(cluster, _agents(clock), clock)
+        _drive(agg, clock, passes=2)
+        resume_at = clock()
+        _drive(agg, clock, passes=2)
+        first = agg.first_step_at(NS, "nb")
+        assert first is not None and first < resume_at
+        first_after = agg.first_step_at(NS, "nb", since=resume_at)
+        assert first_after is not None and first_after >= resume_at
+        # and bounded: the next completed step lands within ~2 periods
+        assert first_after <= resume_at + 12.0
+        assert agg.first_step_at(NS, "ghost") is None
+
+
+# ------------------------------------------------------------------ surfaces
+
+
+class TestSurfaces:
+    def test_events_are_warning_typed_and_edge_triggered(self):
+        """A persistent straggler raises ONE deduped Warning on the
+        inactive→active edge, not one per scrape pass."""
+        clock = FakeClock()
+        cluster = _world()
+        culprit = host_key("nb", 0, 1, 1)
+        agents = _agents(clock, shapes={culprit: {"slow_factor": 2.0}})
+        recorder = EventRecorder(component="gang-telemetry", clock=clock)
+        agg = _mk(cluster, agents, clock, recorder=recorder)
+        _drive(agg, clock, passes=8)
+        events = [
+            e for e in cluster.list("Event")
+            if e.get("reason") == REASON_STRAGGLER
+        ]
+        assert len(events) == 1
+        assert events[0]["type"] == "Warning"
+        assert culprit in events[0]["message"]
+        assert events[0]["count"] == 1  # edge-triggered, never re-emitted
+        assert audit_events(cluster) == []
+
+    def test_desync_event_reason(self):
+        clock = FakeClock()
+        cluster = _world()
+        culprit = host_key("nb", 0, 0, 1)
+        agents = _agents(clock, shapes={culprit: {"behind_steps": 15}})
+        recorder = EventRecorder(component="gang-telemetry", clock=clock)
+        agg = _mk(cluster, agents, clock, recorder=recorder)
+        _drive(agg, clock)
+        assert any(
+            e.get("reason") == REASON_DESYNC and e["type"] == "Warning"
+            for e in cluster.list("Event")
+        )
+
+    def test_debug_gang_routes(self):
+        from kubeflow_tpu.webapps.base import App
+
+        clock = FakeClock()
+        cluster = _world()
+        culprit = host_key("nb", 0, 1, 1)
+        agents = _agents(clock, shapes={culprit: {"slow_factor": 2.0}})
+        agg = _mk(cluster, agents, clock)
+        _drive(agg, clock)
+        app = App("probes", csrf_protect=False)
+        install_gang_route(app, agg)
+        client = Client(app)
+
+        index = json.loads(client.get("/debug/gang").get_data(as_text=True))
+        assert f"{NS}/nb" in index["gangs"]
+        assert index["thresholds"]["desyncSteps"] == 10
+        assert index["scrapePasses"] == 6
+
+        r = client.get(f"/debug/gang/{NS}/nb")
+        assert r.status_code == 200
+        detail = json.loads(r.get_data(as_text=True))
+        assert detail["verdict"] == "straggler"
+        assert detail["culprit"] == culprit
+        assert detail["hosts"][culprit]["medianStepS"] > 4.0
+        assert detail["hosts"][culprit]["recentSteps"]
+
+        r = client.get(f"/debug/gang/{NS}/ghost")
+        assert r.status_code == 404
+        assert "error" in json.loads(r.get_data(as_text=True))
+
+    def test_jwa_detail_carries_gang_payload(self):
+        from kubeflow_tpu.auth.rbac import Authorizer
+        from kubeflow_tpu.webapps import jupyter
+
+        clock = FakeClock()
+        cluster = _world()
+        culprit = host_key("nb", 0, 1, 1)
+        agents = _agents(clock, shapes={culprit: {"slow_factor": 2.0}})
+        agg = _mk(cluster, agents, clock)
+        _drive(agg, clock)
+        app = jupyter.create_app(
+            cluster, gang=agg, use_cache=False,
+            authorizer=Authorizer(
+                cluster, cluster_admins={"admin@example.com"}
+            ),
+        )
+        client = Client(app)
+        r = client.get(
+            f"/api/namespaces/{NS}/notebooks/nb",
+            headers={"kubeflow-userid": "admin@example.com"},
+        )
+        body = json.loads(r.data)
+        gang = body["notebook"]["gang"]
+        assert gang["verdict"] == "straggler"
+        assert gang["culprit"] == culprit
+        assert gang["hosts"][culprit]["lastStep"] > 0
+        assert gang["stepP99"] > 0
+
+    def test_dashboard_serves_gang_series(self):
+        from kubeflow_tpu.webapps import dashboard
+
+        clock = FakeClock()
+        cluster = _world()
+        culprit = host_key("nb", 0, 1, 1)
+        agents = _agents(clock, shapes={culprit: {"slow_factor": 2.0}})
+        agg = _mk(cluster, agents, clock)
+        _drive(agg, clock)
+        app = dashboard.create_app(
+            cluster, gang=agg, cluster_admins={"admin@example.com"},
+            use_cache=False,
+        )
+        app.close()
+        client = Client(app)
+        for mtype in ("step_p99", "straggler_ratio"):
+            r = client.get(
+                f"/api/metrics/{mtype}",
+                headers={"kubeflow-userid": "admin@example.com"},
+            )
+            assert r.status_code == 200, (mtype, r.data)
+            body = json.loads(r.data)
+            assert "series" in body
+            assert body["values"], mtype
+        ratios = json.loads(client.get(
+            "/api/metrics/straggler_ratio",
+            headers={"kubeflow-userid": "admin@example.com"},
+        ).data)
+        worst = max(v["value"] for v in ratios["values"])
+        assert worst == pytest.approx(2.0, rel=0.25)

@@ -243,6 +243,42 @@ def combined_registry() -> Registry:
         "team-metrics", "nb-lint", policy="duty-cycle",
         sample=telem.activity("team-metrics", "nb-lint"), threshold=0.6,
     )
+    # gang step telemetry on the same registry (telemetry/gang.py): two
+    # scrape passes over the 2-host gang with a planted slow host populate
+    # every gang family with real judgments (nb-blocked's hosts have no
+    # agent, so the failed-scrape outcome gets samples too)
+    from kubeflow_tpu.telemetry.agent import FakeStepSchedule
+    from kubeflow_tpu.telemetry.gang import GangTelemetryAggregator, host_key
+    from kubeflow_tpu.utils.metrics import GangMetrics
+
+    _g = [1_000_000.0]
+    gang_agents = {
+        host_key("nb-lint", 0, o, 1): TelemetryAgent(
+            FakeDeviceBackend(duty_cycle=0.9, seed=o),
+            clock=lambda: _g[0],
+            step_schedule=FakeStepSchedule(
+                period_s=6.0, duration_s=2.5, start_at=_g[0] - 200.0,
+                seed=o, slow_factor=2.0 if o == 1 else 1.0,
+            ),
+        )
+        for o in range(2)
+    }
+    gang = GangTelemetryAggregator(
+        cluster, GangMetrics(nm.registry), min_steps=3,
+        clock=lambda: _g[0],
+        probe_fn=lambda targets, **kw: [
+            ProbeResult(200, gang_agents[hk].exposition())
+            if hk in gang_agents else ProbeResult(-1, "")
+            for hk, _port, _path in targets
+        ],
+        target_for=lambda nb, j, o: (
+            host_key(ko.name(nb), j, o, api.notebook_num_slices(nb)), 0, "/"
+        ),
+    )
+    gang.collect(force=True)
+    _g[0] += 10.0
+    gang.collect(force=True)
+    assert gang.audit() == []
     # the efficiency ledger on the same registry (obs/ledger.py): two real
     # ticks over a moving clock populate every bucket/capacity family
     from kubeflow_tpu.obs.ledger import FleetEfficiencyLedger
@@ -369,6 +405,40 @@ class TestExpositionFormat:
                 "tpu_capacity_chip_seconds_total"]["samples"]
         }
         assert caps and by_pool == caps  # exact — the scrape-side proof
+        # gang step-telemetry families (telemetry/gang.py): the per-gang
+        # step histogram lints with real observations and the planted slow
+        # host's judgment reaches the exposition
+        assert families["tpu_gang_step_seconds"]["type"] == "histogram"
+        assert families["tpu_gang_pass_seconds"]["type"] == "histogram"
+        assert any(
+            v > 0
+            for s, _, v in families["tpu_gang_step_seconds"]["samples"]
+            if s.endswith("_count")
+        )
+        for name in (
+            "tpu_gang_step_skew_seconds",
+            "tpu_gang_straggler_ratio",
+            "tpu_gang_host_step_lag",
+            "tpu_gang_fleet_step_p99_seconds",
+            "tpu_gang_fleet_straggler_ratio",
+            "tpu_gang_sessions",
+        ):
+            assert families[name]["type"] == "gauge", name
+        assert families["tpu_gang_scrape_total"]["type"] == "counter"
+        assert families["tpu_gang_finding_total"]["type"] == "counter"
+        for outcome in ("ok", "failed"):
+            assert any(
+                labels == {"outcome": outcome} and v >= 1
+                for _, labels, v in families["tpu_gang_scrape_total"]["samples"]
+            ), outcome
+        assert any(
+            labels.get("kind") == "straggler" and v >= 1
+            for _, labels, v in families["tpu_gang_finding_total"]["samples"]
+        )
+        assert any(
+            labels.get("notebook") == "nb-lint" and v >= 1.5
+            for _, labels, v in families["tpu_gang_straggler_ratio"]["samples"]
+        )
 
     def test_webapp_and_readcache_families_lint(self):
         """The BFF read-path families (utils/metrics.py WebAppMetrics +

@@ -44,6 +44,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="arm the data-plane telemetry pipeline: fake "
                          "in-pod agents, fleet collector, duty-cycle "
                          "culling, and the telemetry audit (docs/chaos.md)")
+    ap.add_argument("--gang-audit", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="with --telemetry: arm the gang step-telemetry "
+                         "arm — per-host step agents on every multi-host "
+                         "gang, one seed-drawn planted culprit (slow/"
+                         "lagging/stalled host), and the attribution audit "
+                         "(the planted host must be named, healthy gangs "
+                         "never flagged; docs/observability.md; on by "
+                         "default)")
     ap.add_argument("--shards", type=int, default=1,
                     help="run the SHARDED control plane: N namespace-hash "
                          "manager shards over one store, notebooks spread "
@@ -95,7 +104,8 @@ def main(argv: list[str] | None = None) -> int:
     total_restarts = 0
     for seed in seeds:
         result = run_seed(
-            seed, cfg, telemetry=args.telemetry, shards=args.shards,
+            seed, cfg, telemetry=args.telemetry,
+            gang_audit=args.gang_audit, shards=args.shards,
             lost_update_audit=args.lost_update_audit,
             explain_audit=args.explain_audit,
             ledger_audit=args.ledger_audit,

@@ -55,6 +55,13 @@ def main(argv: list[str] | None = None) -> int:
                          "every suspend handoff / force-deadline release / "
                          "resume re-bind (docs/chaos.md \"efficiency "
                          "ledger\"; on by default)")
+    ap.add_argument("--gang-audit", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="per-seed gang step-telemetry audit: per-host "
+                         "step agents on every multi-host gang, one "
+                         "seed-drawn planted culprit, and the attribution "
+                         "audit through every suspend/resume handoff "
+                         "(docs/observability.md; on by default)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print a line per seed, not just failures")
     args = ap.parse_args(argv)
@@ -88,6 +95,7 @@ def main(argv: list[str] | None = None) -> int:
             seed, cfg, store_cfg,
             lost_update_audit=args.lost_update_audit,
             ledger_audit=args.ledger_audit,
+            gang_audit=args.gang_audit,
         )
         suspends += result.suspends
         resumes += result.resumes

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""Finding-triggered capture benchmark: capture-request throughput through
+the full bind→probe→store→ack pipeline, plus the agent-side exposition
+overhead of the compile families (docs/observability.md "capture on
+demand").
+
+Two arms:
+
+- **capture throughput** — N gangs each carrying one frozen finding; a
+  CaptureController with the rate limits opened drives every one through
+  the one-write bind annotation, a two-host capture probe (culprit +
+  reference answered in-process by real ``TelemetryAgent.capture`` over a
+  seeded ``FakeProfiler``), the content-addressed snapshot store, and the
+  ack. Reports captures/second. The run FAILS — regardless of speed —
+  unless the capture audit and the planted-truth attribution audit come
+  back clean, so a fast-but-wrong pipeline can never pass.
+- **exposition overhead** — one agent scraped M times with the compile
+  families armed (``FakeCompileSchedule``) vs the identical agent without
+  them: the per-scrape cost the compile telemetry adds to EVERY host's
+  scrape path, reported as µs/scrape for both and the A/B overhead ratio.
+
+    python benchmarks/bench_profiles.py                   # 64 gangs
+    python benchmarks/bench_profiles.py --gangs 16 --scrapes 500
+    python benchmarks/bench_profiles.py \\
+        --check-against benchmarks/profiles_baseline.json    # CI gate
+
+Emits one PROFILE_BENCH JSON line (consumed by CI artifacts).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from kubeflow_tpu.api import types as api  # noqa: E402
+from kubeflow_tpu.culler.probe import ProbeResult  # noqa: E402
+from kubeflow_tpu.obs.profiler import (  # noqa: E402
+    CaptureController,
+    audit_capture_attribution,
+)
+from kubeflow_tpu.runtime.fake import FakeCluster  # noqa: E402
+from kubeflow_tpu.sessions.store import SnapshotStore  # noqa: E402
+from kubeflow_tpu.telemetry.agent import (  # noqa: E402
+    FakeCompileSchedule,
+    FakeDeviceBackend,
+    FakeProfiler,
+    FakeStepSchedule,
+    TelemetryAgent,
+)
+from kubeflow_tpu.testing.sessionstore import FakeObjectStore  # noqa: E402
+
+NS = "bench"
+HOSTS = 4  # per gang: a culprit and three reference candidates
+
+
+class _Clock:
+    """Virtual time drives the schedules; wall time is only measured
+    around the work under test."""
+
+    def __init__(self, start: float = 1_000_000.0) -> None:
+        self.t = start
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+class _FindingSource:
+    """One pre-frozen finding per gang plus the host payload the
+    reference-median selection reads — the bench isolates the capture
+    pipeline; aggregation throughput is STEP_BENCH's number."""
+
+    def __init__(self) -> None:
+        self.items: list[dict] = []
+        self.hosts: dict[tuple[str, str], dict] = {}
+
+    def findings(self):
+        return [dict(f) for f in self.items]
+
+    def gang_payload(self, namespace, name):
+        hosts = self.hosts.get((namespace, name))
+        return None if hosts is None else {"hosts": dict(hosts)}
+
+
+def run_captures(gangs: int, steps: int) -> dict:
+    clock = _Clock()
+    cluster = FakeCluster()
+    agg = _FindingSource()
+    agents: dict[str, TelemetryAgent] = {}
+    planted: dict[tuple[str, str], dict] = {}
+    for i in range(gangs):
+        name = f"g-{i}"
+        cluster.create(
+            api.notebook(name, NS, tpu_accelerator="v4",
+                         tpu_topology="2x2x2")
+        )
+        for o in range(HOSTS):
+            hk = f"{name}-{o}"
+            agents[hk] = TelemetryAgent(
+                FakeDeviceBackend(duty_cycle=0.9, seed=i * 100 + o),
+                clock=clock,
+                step_schedule=FakeStepSchedule(
+                    period_s=6.0, duration_s=2.5,
+                    start_at=clock() - 200.0, seed=i * 100 + o,
+                ),
+                profiler=FakeProfiler(
+                    host=hk, seed=i * 100 + o, clock=clock
+                ),
+            )
+        agg.hosts[(NS, name)] = {
+            f"{name}-{o}": {
+                "medianStepS": 6.0 + 0.01 * o, "fresh": True,
+                "aligned": True,
+            }
+            for o in range(HOSTS)
+        }
+        culprit = f"{name}-{i % HOSTS}"
+        agg.items.append({
+            "namespace": NS, "notebook": name, "kind": "straggler",
+            "host": culprit, "at": clock() - 10.0,
+            "evidence": {"ratio": 1.9},
+        })
+        planted[(NS, name)] = {"kind": "straggler", "host": culprit}
+
+    def capture_fn(targets, timeout=5.0, max_concurrency=64):
+        out = []
+        for host, _port, path in targets:
+            n = int(path.rsplit("steps=", 1)[-1])
+            out.append(ProbeResult(200, agents[host].capture(n)))
+        return out
+
+    store = SnapshotStore(FakeObjectStore(), clock=clock)
+    ctl = CaptureController(
+        cluster, agg, store,
+        interval_s=0.0, cooldown_s=0.0, max_active=gangs, steps=steps,
+        clock=clock, capture_fn=capture_fn,
+        target_for=lambda nb, hk: (hk, 0, "/capture"),
+    )
+    t0 = time.perf_counter()
+    passes = 0
+    while passes < gangs + 2:
+        ctl.collect(force=True)
+        clock.advance(1.0)
+        passes += 1
+        if all(r["state"] == "stored" for r in ctl.captures()) and \
+                len(ctl.captures()) == gangs:
+            break
+    wall = time.perf_counter() - t0
+    stored = [r for r in ctl.captures() if r["state"] == "stored"]
+    audit = ctl.audit(where="bench") + audit_capture_attribution(
+        ctl, planted, where="bench"
+    )
+    return {
+        "gangs": gangs,
+        "steps": steps,
+        "stored": len(stored),
+        "traces": sum(len(r["targets"]) for r in stored),
+        "capture_throughput_per_s": round(
+            len(stored) / max(wall, 1e-9), 1
+        ),
+        "audit_violations": audit,
+    }
+
+
+def run_exposition(scrapes: int) -> dict:
+    def mk(compiles: bool) -> TelemetryAgent:
+        clock = _Clock()
+        return TelemetryAgent(
+            FakeDeviceBackend(duty_cycle=0.8, seed=1),
+            clock=clock,
+            step_schedule=FakeStepSchedule(
+                period_s=6.0, duration_s=2.5,
+                start_at=clock() - 200.0, seed=1,
+            ),
+            compile_schedule=FakeCompileSchedule(
+                start_at=clock() - 200.0, warmup_compiles=2,
+                recompile_every_s=40.0, seed=1,
+            ) if compiles else None,
+        )
+
+    def measure(agent: TelemetryAgent) -> float:
+        for _ in range(10):  # warm the registry + schedules
+            agent.exposition()
+            agent.clock.advance(1.0)
+        t0 = time.perf_counter()
+        for _ in range(scrapes):
+            agent.exposition()
+            agent.clock.advance(1.0)  # fresh schedule work every scrape
+        return (time.perf_counter() - t0) / scrapes * 1e6
+
+    off_us = measure(mk(False))
+    on_us = measure(mk(True))
+    return {
+        "scrapes": scrapes,
+        "exposition_us": {
+            "compile_families_off": round(off_us, 1),
+            "compile_families_on": round(on_us, 1),
+        },
+        "overhead_ratio": round(on_us / max(off_us, 1e-9), 3),
+    }
+
+
+def check_against(result: dict, baseline_path: str, tolerance: float) -> int:
+    """CI gate: capture throughput must not fall below the committed floor
+    and the compile-on exposition cost must not blow past its ceiling
+    (tolerance absorbs shared-runner wall noise). Correctness — every
+    planted gang stored, zero audit violations — gates with NO tolerance."""
+    with open(baseline_path) as f:
+        base = json.load(f)
+    failures = []
+    if result["audit_violations"]:
+        failures += [f"audit: {v}" for v in result["audit_violations"]]
+    if result["stored"] != result["gangs"]:
+        failures.append(
+            f"stored captures: {result['stored']} of {result['gangs']} "
+            f"planted gangs — the pipeline lost findings"
+        )
+    floor = base["capture_throughput_per_s"] * (1.0 - tolerance)
+    if result["capture_throughput_per_s"] < floor:
+        failures.append(
+            f"capture_throughput_per_s: "
+            f"{result['capture_throughput_per_s']} < floor {floor:.1f} "
+            f"(baseline {base['capture_throughput_per_s']} - "
+            f"{tolerance:.0%})"
+        )
+    ceiling = base["exposition_us"]["compile_families_on"] * (1.0 + tolerance)
+    if result["exposition_us"]["compile_families_on"] > ceiling:
+        failures.append(
+            f"exposition with compile families: "
+            f"{result['exposition_us']['compile_families_on']}us > ceiling "
+            f"{ceiling:.1f}us (baseline "
+            f"{base['exposition_us']['compile_families_on']}us + "
+            f"{tolerance:.0%})"
+        )
+    if failures:
+        print("PROFILE_BENCH gate: FAIL")
+        for f_ in failures:
+            print(f"  {f_}")
+        return 1
+    print(
+        f"PROFILE_BENCH gate: OK "
+        f"({result['capture_throughput_per_s']} captures/s vs baseline "
+        f"{base['capture_throughput_per_s']}; exposition "
+        f"{result['exposition_us']['compile_families_on']}us <= "
+        f"{ceiling:.1f}us; {result['stored']}/{result['gangs']} planted "
+        f"gangs stored)"
+    )
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--gangs", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=4,
+                    help="steps per capture request (default 4)")
+    ap.add_argument("--scrapes", type=int, default=2000,
+                    help="scrapes per exposition arm (default 2000)")
+    ap.add_argument("--check-against", metavar="BASELINE_JSON",
+                    help="compare against a committed baseline and exit 1 "
+                         "on regression beyond --tolerance (correctness "
+                         "failures gate unconditionally)")
+    ap.add_argument("--tolerance", type=float, default=0.50,
+                    help="relative band for the throughput floor and "
+                         "exposition ceiling (default 0.50)")
+    args = ap.parse_args(argv)
+    logging.disable(logging.ERROR)
+    result = {"bench": "PROFILE_BENCH"}
+    result.update(run_captures(args.gangs, args.steps))
+    result.update(run_exposition(args.scrapes))
+    print("PROFILE_BENCH " + json.dumps(result, sort_keys=True))
+    if args.check_against:
+        return check_against(result, args.check_against, args.tolerance)
+    if result["audit_violations"] or result["stored"] != result["gangs"]:
+        print("PROFILE_BENCH correctness: FAIL")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,19 +20,23 @@ BANNED_ROOTS = {
 BANNED_CALLS = {"open", "time.sleep", "input"}
 
 # the telemetry collector's verbs; scraping from a reconcile was PR 5's
-# founding prohibition
-SCRAPE_ATTRS = {"collect", "scrape", "probe"}
-SCRAPE_RECEIVER_HINTS = ("collector", "telemetry", "prober")
+# founding prohibition. "capture" joined when obs/profiler.py landed: a
+# trace capture probes N steps of a live gang — wiring the capture
+# controller (or an agent's capture endpoint) into a reconcile is the same
+# head-of-line block, only longer.
+SCRAPE_ATTRS = {"collect", "scrape", "probe", "capture"}
+SCRAPE_RECEIVER_HINTS = ("collector", "telemetry", "prober", "profiler")
 
 
 class ReconcileIORule(Rule):
     id = "TPU003"
     title = "reconcile bodies never block on I/O"
     invariant = (
-        "no socket/HTTP/file/subprocess I/O, sleeps, or telemetry scrapes "
-        "are reachable from a reconcile() body through same-module calls — "
-        "slow externals run in dedicated loops (the fleet collector, the "
-        "culler's prober) and reconcilers read their in-memory results"
+        "no socket/HTTP/file/subprocess I/O, sleeps, telemetry scrapes, or "
+        "profile captures are reachable from a reconcile() body through "
+        "same-module calls — slow externals run in dedicated loops (the "
+        "fleet collector, the culler's prober, the capture controller) and "
+        "reconcilers read their in-memory results"
     )
     rationale = (
         "a reconcile holds its workqueue key; one slow scrape inside it "

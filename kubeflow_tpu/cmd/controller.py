@@ -336,6 +336,37 @@ def build_manager(
                 recorder=EventRecorder(),
             )
         )
+    if "profiler" not in shared:
+        profiler = None
+        # finding-triggered profile capture (obs/profiler.py): turns the
+        # gang aggregator's frozen findings into bounded XLA trace captures
+        # stored through the snapshot store. ONE per process (it consumes
+        # the one aggregator's findings); rides the telemetry loop in
+        # main(), NEVER a reconcile. Without sessions (no snapshot store)
+        # captures still bind/ack and serve /debug/profiles, only the
+        # durable trace payload is skipped.
+        if gang is not None and cfg.profiler_enabled:
+            from kubeflow_tpu.obs.profiler import CaptureController
+            from kubeflow_tpu.utils.metrics import ProfilerMetrics
+
+            profiler = CaptureController(
+                cluster,
+                gang,
+                shared.get("snapshot_store"),
+                ProfilerMetrics(metrics.registry),
+                interval_s=cfg.telemetry_interval_s,
+                cooldown_s=cfg.profiler_cooldown_s,
+                max_active=cfg.profiler_max_active,
+                steps=cfg.profiler_steps,
+                recorder=recorder,
+                cluster_domain=cfg.cluster_domain,
+                port=cfg.telemetry_port,
+            )
+            # crash recovery: re-adopt bound-unacked captures from the CRs
+            profiler.resume()
+        shared["profiler"] = profiler
+    profiler = shared["profiler"]
+    manager.profiler = profiler
     if "capacity" not in shared:
         capacity = None
         # elastic capacity (kubeflow_tpu/capacity/): ONE autoscaler per
@@ -550,6 +581,13 @@ def serve_ops(
             from kubeflow_tpu.telemetry.gang import install_gang_route
 
             install_gang_route(probes, gang)
+        # /debug/profiles (+ /<ns>/<name> drilldown): finding-triggered
+        # capture requests, rate state, and the stored TensorBoard logdirs
+        profiler = getattr(manager, "profiler", None) if manager else None
+        if profiler is not None:
+            from kubeflow_tpu.obs.profiler import install_profiles_route
+
+            install_profiles_route(probes, profiler)
         # /debug/timeline/<ns>/<name>: the assembled click-to-ready
         # timeline, same cluster-internal surface as /debug/traces
         builder = getattr(manager, "timeline_builder", None) if manager else None
@@ -690,6 +728,7 @@ def main() -> None:
             start_workers(mgr, getattr(mgr, "shard_id", None))
     telemetry = getattr(manager, "telemetry", None)
     gang = getattr(manager, "gang", None)
+    profiler = getattr(manager, "profiler", None)
     if telemetry is not None:
         # the fleet scrape runs on its OWN cadence, decoupled from both the
         # reconcile workers (never on that path) and the kernel-probe loop
@@ -709,6 +748,13 @@ def main() -> None:
                             gang.collect()
                         except Exception:
                             log.exception("gang telemetry pass failed")
+                    if profiler is not None:
+                        # capture pass AFTER the gang pass: a finding frozen
+                        # this interval binds its capture the same interval
+                        try:
+                            profiler.collect()
+                        except Exception:
+                            log.exception("profile capture pass failed")
                 time.sleep(cfg.telemetry_interval_s)
 
         threading.Thread(

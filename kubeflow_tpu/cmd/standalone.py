@@ -82,6 +82,7 @@ def build_platform(
     # telemetry, and the webapps then serve its series
     telemetry = getattr(manager, "telemetry", None)
     gang = getattr(manager, "gang", None)
+    profiler = getattr(manager, "profiler", None)
     ledger = getattr(manager, "ledger", None)
     capacity = getattr(manager, "capacity", None)
     # ONE watch-backed read layer for every app (webapps/cache.py): each
@@ -93,6 +94,7 @@ def build_platform(
             cluster, cluster_admins=admins, metrics=metrics,
             telemetry=telemetry,
             gang=gang,
+            profiler=profiler,
             slo=getattr(manager, "slo", None),
             scheduler=getattr(manager, "scheduler_metrics", None),
             ledger=ledger,
@@ -106,6 +108,7 @@ def build_platform(
                 metrics=metrics,
                 telemetry=telemetry,
                 gang=gang,
+                profiler=profiler,
                 timeline=getattr(manager, "timeline_builder", None),
                 ledger=ledger,
                 capacity=capacity,

@@ -23,6 +23,14 @@ plane (docs/observability.md):
   phase-partitioned, fault-attributable).
 - ``slo.py`` — phase-attributed startup histograms plus click-to-ready SLO
   objectives with error-budget burn-rate gauges.
+- ``profiler.py`` — finding-triggered profile capture: the gang
+  aggregator's frozen findings (straggler/desync/stall/storm) trigger
+  bounded XLA trace captures of the culprit AND a reference-median host,
+  committed through the content-addressed snapshot store under the
+  TensorBoard ``plugins/profile/`` convention; bind/ack annotations make
+  requests crash-safe, fleet rate limits (per-gang cooldown + global cap)
+  are re-provable by the per-seed capture audit, served at
+  ``/debug/profiles``.
 - ``ledger.py`` — the fleet efficiency ledger: exactly-once chip-second
   accounting (busy / idle_allocated / starting / suspending / draining /
   free_usable / free_stranded / unavailable, plus parked and queued demand)
@@ -39,6 +47,11 @@ from kubeflow_tpu.obs.ledger import (
     FleetEfficiencyLedger,
     install_ledger_routes,
 )
+from kubeflow_tpu.obs.profiler import (
+    CaptureController,
+    audit_capture_attribution,
+    install_profiles_route,
+)
 from kubeflow_tpu.obs.slo import SLOMetrics
 from kubeflow_tpu.obs.timeline import (
     TimelineBuilder,
@@ -49,8 +62,11 @@ from kubeflow_tpu.obs.timeline import (
 from kubeflow_tpu.obs.tracing import Span, Tracer, TracingCluster
 
 __all__ = [
+    "CaptureController",
     "EventRecorder",
     "FleetEfficiencyLedger",
+    "audit_capture_attribution",
+    "install_profiles_route",
     "HealthState",
     "install_debug_index",
     "install_ledger_routes",

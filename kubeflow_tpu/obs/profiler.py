@@ -1,0 +1,712 @@
+"""Finding-triggered profile capture: straggler → trace, with zero setup.
+
+The gang aggregator (``telemetry/gang.py``) freezes *evidence* — host 3 ran
+1.5x slow — but the pipeline dead-ended at a Warning event: nobody could
+answer **why**, and answering it meant SSH + a hand-driven profiler session.
+This module closes the loop. A :class:`CaptureController` watches the
+aggregator's findings and turns each new one into a **bounded capture
+request**: the culprit host *and* a reference host near the gang median
+each trace ``steps`` steps through the agent's capture endpoint
+(``telemetry/agent.py`` ``/capture``), and the payloads are committed
+through the content-addressed snapshot store (``sessions/store.py`` chunks
++ manifest + verified commit) under the ``plugins/profile/`` logdir
+convention ``utils/profiling.py`` documents — so the capture renders in the
+platform's TensorBoard with zero setup.
+
+Discipline (the same rules every other observer lives by):
+
+- **never on the reconcile path** — ``collect()`` is the only method that
+  performs I/O; it runs from the controller-manager's telemetry loop (or
+  the soak harness driver), and the soaks assert per tick that
+  ``capture_passes`` never moves inside a reconcile;
+- **one-write crash-safe annotation** (the bind/ack idiom) — intent lands
+  on the Notebook CR in ONE annotation write before any capture I/O, the
+  ack overwrites it in one more; the capture id, the snapshot ids, and the
+  stored bytes are all deterministic functions of the triggering finding,
+  so a crash-restarted controller re-driving a bound request converges on
+  the same objects instead of leaking new ones (``resume()`` re-adopts
+  bound-unacked requests from the CRs alone);
+- **fleet rate limits** — a per-gang cooldown (a storming gang cannot
+  monopolize the profiler) and a global concurrent-capture cap, both
+  re-provable by :meth:`audit` from the capture records' own timestamps;
+- **frozen attribution** — every capture embeds a frozen copy of the
+  finding that triggered it at bind time; the per-seed capture audit
+  (chaos + sessions soaks) proves every stored capture traces back to
+  exactly one finding and healthy gangs are never captured.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from typing import Callable, Mapping, Sequence
+
+from kubeflow_tpu.culler import probe
+from kubeflow_tpu.runtime import objects as ko
+from kubeflow_tpu.runtime.fake import NotFound
+from kubeflow_tpu.telemetry import (
+    CAPTURE_DEFAULT_STEPS,
+    CAPTURE_PATH,
+    TELEMETRY_PORT,
+)
+from kubeflow_tpu.tpu import topology as tputopo
+from kubeflow_tpu.utils.metrics import ProfilerMetrics
+
+# the bind/ack annotation: ONE key, ONE write per transition. Stripped from
+# the soak fingerprint (run history, not converged state) — the capture
+# audit judges it instead.
+CAPTURE_ANNOTATION = "notebooks.kubeflow.org/profile-capture"
+
+DEFAULT_INTERVAL_S = 15.0
+# a gang gets at most one capture per cooldown window: findings tend to
+# arrive in bursts (stall + desync on the same host) and the first trace
+# answers all of them
+DEFAULT_COOLDOWN_S = 600.0
+DEFAULT_MAX_ACTIVE = 2         # global concurrent-capture cap
+DEFAULT_TIMEOUT_S = 10.0       # capture probes trace N steps: slower than
+                               # a scrape, still bounded
+MAX_CAPTURES = 256             # bounded record ring, like MAX_FINDINGS
+MAX_SEEN = 4096                # bounded processed-finding set
+
+REASON_CAPTURED = "ProfileCaptured"
+
+
+def capture_session(namespace: str, name: str) -> str:
+    """The snapshot-store session key one gang's captures live under. Rides
+    the store's own retention (``keep``): a new capture's culprit+reference
+    pair prunes the previous pair, so capture storage per gang is bounded
+    by construction."""
+    return f"profiles/{namespace}/{name}"
+
+
+def capture_logdir(namespace: str, name: str, capture_id: str,
+                   host: str) -> str:
+    """The TensorBoard logdir path a stored trace renders under — the
+    ``<run>/plugins/profile/<ts>/<host>`` convention utils/profiling.py
+    documents, with the capture id as the profile run timestamp."""
+    return (
+        f"{capture_session(namespace, name)}/plugins/profile/"
+        f"{capture_id}/{host}.trace"
+    )
+
+
+def capture_id_for(namespace: str, name: str, kind: str, host: str,
+                   at: float) -> str:
+    """Deterministic capture identity for one finding: a crash-restarted
+    controller retrying the same finding converges on the same annotation
+    value, snapshot ids, and chunks."""
+    raw = f"{namespace}|{name}|{kind}|{host}|{at!r}"
+    return hashlib.sha1(raw.encode()).hexdigest()[:12]
+
+
+def default_capture_target_for(
+    cluster_domain: str = "cluster.local", port: int = TELEMETRY_PORT
+):
+    """(host, port, path) for one gang host's capture endpoint: the pod's
+    stable DNS name under the headless rendezvous Service (the gang
+    aggregator's addressing), path ``/capture``."""
+
+    def target(nb: Mapping, host: str) -> tuple[str, int, str]:
+        ns, name = ko.namespace(nb), ko.name(nb)
+        svc = tputopo.headless_service_name(name)
+        return (f"{host}.{svc}.{ns}.svc.{cluster_domain}", port, CAPTURE_PATH)
+
+    return target
+
+
+class CaptureController:
+    """Turns frozen gang findings into bounded, rate-limited trace captures.
+    ``collect()`` is the only method that performs I/O and runs off the
+    reconcile path; reads serve from memory."""
+
+    def __init__(
+        self,
+        cluster,
+        aggregator,
+        store=None,
+        metrics: ProfilerMetrics | None = None,
+        *,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        cooldown_s: float = DEFAULT_COOLDOWN_S,
+        max_active: int = DEFAULT_MAX_ACTIVE,
+        steps: int = CAPTURE_DEFAULT_STEPS,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+        clock: Callable[[], float] = time.time,
+        capture_fn=probe.probe_many,
+        target_for: Callable[[Mapping, str], tuple[str, int, str]]
+        | None = None,
+        recorder=None,
+        cluster_domain: str = "cluster.local",
+        port: int = TELEMETRY_PORT,
+    ) -> None:
+        self.cluster = cluster
+        self.aggregator = aggregator
+        self.store = store
+        self.metrics = metrics or ProfilerMetrics()
+        self.interval_s = interval_s
+        self.cooldown_s = cooldown_s
+        self.max_active = max(1, int(max_active))
+        self.steps = steps
+        self.timeout_s = timeout_s
+        self.clock = clock
+        self.capture_fn = capture_fn
+        self.target_for = target_for or default_capture_target_for(
+            cluster_domain, port
+        )
+        self.recorder = recorder
+        self._captures: list[dict] = []
+        self._seen: set[tuple] = set()
+        self._last_bound: dict[tuple[str, str], float] = {}
+        self._lock = threading.Lock()
+        self._last_pass = float("-inf")
+        # audit counter: the soaks assert this never moves inside a
+        # reconcile tick (capture I/O lives on the telemetry loop only)
+        self.capture_passes = 0
+
+    # ------------------------------------------------------------- the pass
+
+    def collect(self, force: bool = False) -> int:
+        """One capture pass: adopt new findings under the rate bounds, then
+        drive every bound request toward stored (probe hosts, write the
+        store, ack). Interval-gated; returns captures progressed."""
+        now = self.clock()
+        if not force and now - self._last_pass < self.interval_s:
+            return 0
+        self._last_pass = now
+        with self._lock:
+            self.capture_passes += 1
+        self.metrics.passes.inc()
+        self._bind_new(now)
+        progressed = self._drive_bound(now)
+        with self._lock:
+            self.metrics.active.set(
+                sum(1 for r in self._captures if r["state"] == "bound")
+            )
+        return progressed
+
+    def _bind_new(self, now: float) -> None:
+        """Edge-detect new findings and bind a capture request for each,
+        under the per-gang cooldown and the global in-flight cap. Binding
+        is ONE annotation write carrying the full request."""
+        for f in self.aggregator.findings():
+            fid = (f["namespace"], f["notebook"], f["kind"], f["host"],
+                   f["at"])
+            with self._lock:
+                if fid in self._seen:
+                    continue
+                in_flight = sum(
+                    1 for r in self._captures if r["state"] == "bound"
+                )
+                if in_flight >= self.max_active:
+                    # cap full: leave the finding unconsumed — a later pass
+                    # adopts it once a slot frees (the cap bounds concurrent
+                    # captures, it does not drop findings)
+                    continue
+                gang = (f["namespace"], f["notebook"])
+                last = self._last_bound.get(gang)
+                if last is not None and now - last < self.cooldown_s:
+                    # cooldown: this gang was captured recently; the trace
+                    # on disk already answers this burst of findings
+                    self._remember(fid)
+                    self.metrics.captures.inc(outcome="rate_limited")
+                    continue
+                self._remember(fid)
+                self._last_bound[gang] = now
+            cid = capture_id_for(*fid)
+            rec = {
+                "id": cid,
+                "namespace": f["namespace"],
+                "notebook": f["notebook"],
+                "kind": f["kind"],
+                "host": f["host"],
+                "refHost": self._reference_host(
+                    f["namespace"], f["notebook"], f["host"]
+                ),
+                "findingAt": f["at"],
+                "finding": json.loads(json.dumps(f, sort_keys=True)),
+                "boundAt": now,
+                "state": "bound",
+                "failures": 0,
+                "steps": self.steps,
+                "targets": {},
+                "storedAt": None,
+            }
+            if not self._write_annotation(rec, "bound"):
+                # the bind write itself failed: nothing durable happened, so
+                # un-consume the finding — a later pass retries the bind
+                # (same finding → same capture id → idempotent)
+                with self._lock:
+                    self._seen.discard(fid)
+                    gang = (rec["namespace"], rec["notebook"])
+                    if self._last_bound.get(gang) == now:
+                        del self._last_bound[gang]
+                continue
+            with self._lock:
+                self._captures.append(rec)
+                if len(self._captures) > MAX_CAPTURES:
+                    del self._captures[: len(self._captures) - MAX_CAPTURES]
+            self.metrics.capture_findings.inc(kind=f["kind"])
+
+    def _remember(self, fid: tuple) -> None:
+        self._seen.add(fid)
+        if len(self._seen) > MAX_SEEN:
+            # bounded: drop the oldest by finding time (deterministic order)
+            for old in sorted(self._seen, key=lambda t: (t[4], t))[
+                : len(self._seen) - MAX_SEEN
+            ]:
+                self._seen.discard(old)
+
+    def _reference_host(
+        self, namespace: str, name: str, culprit: str
+    ) -> str | None:
+        """The reference-median host: among the gang's fresh aligned peers,
+        the one whose median step time sits at the gang median — the
+        healthy baseline the culprit's trace is diffed against."""
+        payload = self.aggregator.gang_payload(namespace, name)
+        if payload is None:
+            return None
+        candidates = [
+            (hk, h.get("medianStepS"))
+            for hk, h in sorted(payload.get("hosts", {}).items())
+            if hk != culprit and h.get("fresh") and h.get("aligned")
+        ]
+        with_median = [(hk, m) for hk, m in candidates if m is not None]
+        if with_median:
+            ordered = sorted(with_median, key=lambda t: (t[1], t[0]))
+            return ordered[(len(ordered) - 1) // 2][0]
+        return candidates[0][0] if candidates else None
+
+    def _drive_bound(self, now: float) -> int:
+        """Advance every bound request: probe the culprit (and reference)
+        capture endpoints, commit the payloads through the snapshot store,
+        ack. Any failure leaves the request bound — the next pass retries
+        with the same deterministic identity."""
+        with self._lock:
+            pending = [r for r in self._captures if r["state"] == "bound"]
+        progressed = 0
+        for rec in pending:
+            ns, name = rec["namespace"], rec["notebook"]
+            try:
+                nb = self.cluster.get("Notebook", name, ns)
+            except NotFound:
+                # the gang is gone: nothing to trace, nothing to ack — the
+                # request is abandoned (a revived gang re-fires its findings
+                # and gets a fresh capture)
+                self._finish(rec, "failed", now)
+                continue
+            except Exception:
+                rec["failures"] += 1  # read faulted: retry next pass
+                continue
+            hosts = [rec["host"]]
+            if rec["refHost"] and rec["refHost"] != rec["host"]:
+                hosts.append(rec["refHost"])
+            targets = []
+            for hk in hosts:
+                host, port, path = self.target_for(nb, hk)
+                targets.append((host, port, f"{path}?steps={rec['steps']}"))
+            try:
+                results: Sequence[probe.ProbeResult] = self.capture_fn(
+                    targets, timeout=self.timeout_s
+                )
+            except Exception:
+                rec["failures"] += 1
+                continue
+            traces = {}
+            ok = True
+            for hk, res in zip(hosts, results):
+                if not getattr(res, "ok", False) or not res.body:
+                    ok = False
+                    break
+                traces[hk] = res.body
+            if not ok:
+                rec["failures"] += 1
+                continue
+            try:
+                self._store(rec, traces, now)
+            except Exception:
+                rec["failures"] += 1  # store faulted: retry, same ids
+                continue
+            if not self._write_annotation(rec, "stored"):
+                rec["failures"] += 1  # ack write faulted: retry the ack
+                continue
+            self._finish(rec, "stored", now)
+            self.metrics.capture_seconds.observe(
+                max(0.0, now - rec["boundAt"])
+            )
+            if self.recorder is not None:
+                self.recorder.emit(
+                    self.cluster, nb, REASON_CAPTURED,
+                    f"profile capture {rec['id']} stored for {rec['kind']}@"
+                    f"{rec['host']} ({len(traces)} host(s), "
+                    f"{rec['steps']} steps)",
+                )
+            progressed += 1
+        return progressed
+
+    def _store(self, rec: dict, traces: dict[str, str], now: float) -> None:
+        """Commit each host's trace through the snapshot store under the
+        gang's capture session. Snapshot ids derive from the capture id —
+        a retry overwrites its own half-finished objects."""
+        ns, name = rec["namespace"], rec["notebook"]
+        for hk in sorted(traces):
+            role = "culprit" if hk == rec["host"] else "reference"
+            logdir = capture_logdir(ns, name, rec["id"], hk)
+            payload = json.dumps(
+                {
+                    "captureId": rec["id"],
+                    "namespace": ns,
+                    "notebook": name,
+                    "host": hk,
+                    "role": role,
+                    "steps": rec["steps"],
+                    "logdir": logdir,
+                    "finding": rec["finding"],
+                    "trace": traces[hk],
+                },
+                sort_keys=True,
+            ).encode()
+            sid = hashlib.sha1(f"{rec['id']}|{hk}".encode()).hexdigest()[:12]
+            if self.store is not None:
+                self.store.save(
+                    capture_session(ns, name), payload,
+                    snapshot_id=sid, now=now,
+                )
+            rec["targets"][hk] = {
+                "role": role,
+                "snapshotId": sid,
+                "logdir": logdir,
+                "bytes": len(payload),
+            }
+            self.metrics.stored_bytes.inc(len(payload))
+
+    def _finish(self, rec: dict, state: str, now: float) -> None:
+        rec["state"] = state
+        rec["storedAt"] = now if state == "stored" else None
+        rec["finishedAt"] = now
+        self.metrics.captures.inc(outcome=state)
+
+    # -------------------------------------------------- bind/ack annotation
+
+    def _annotation_value(self, rec: dict, state: str) -> str:
+        return json.dumps(
+            {
+                "id": rec["id"],
+                "kind": rec["kind"],
+                "host": rec["host"],
+                "refHost": rec["refHost"],
+                "findingAt": rec["findingAt"],
+                "steps": rec["steps"],
+                "boundAt": rec["boundAt"],
+                "state": state,
+                "snapshots": sorted(
+                    t["snapshotId"] for t in rec["targets"].values()
+                ),
+            },
+            sort_keys=True,
+        )
+
+    def _write_annotation(self, rec: dict, state: str) -> bool:
+        """ONE annotation write per transition. False means the write
+        (visibly) failed; an invisibly-applied write is absorbed by the
+        deterministic capture id — the retry overwrites the same value."""
+        try:
+            self.cluster.patch(
+                "Notebook", rec["notebook"], rec["namespace"],
+                {"metadata": {"annotations": {
+                    CAPTURE_ANNOTATION: self._annotation_value(rec, state)
+                }}},
+            )
+            return True
+        except Exception:
+            return False
+
+    def resume(self) -> int:
+        """Crash recovery: re-adopt bound-but-unacked capture requests from
+        the CRs alone, and rebuild the per-gang cooldown state from every
+        capture annotation — durable intent lives on the CR, never only in
+        this process. Returns requests re-adopted."""
+        adopted = 0
+        try:
+            notebooks = self.cluster.list("Notebook")
+        except Exception:
+            return 0
+        for nb in notebooks:
+            raw = ko.annotations(nb).get(CAPTURE_ANNOTATION)
+            if not raw:
+                continue
+            try:
+                req = json.loads(raw)
+            except ValueError:
+                continue
+            ns, name = ko.namespace(nb), ko.name(nb)
+            with self._lock:
+                gang = (ns, name)
+                bound_at = float(req.get("boundAt", 0.0))
+                if bound_at > self._last_bound.get(gang, float("-inf")):
+                    self._last_bound[gang] = bound_at
+                fid = (ns, name, req.get("kind"), req.get("host"),
+                       req.get("findingAt"))
+                self._remember(fid)
+                if req.get("state") != "bound":
+                    continue
+                if any(r["id"] == req.get("id") for r in self._captures):
+                    continue
+                self._captures.append({
+                    "id": req.get("id"),
+                    "namespace": ns,
+                    "notebook": name,
+                    "kind": req.get("kind"),
+                    "host": req.get("host"),
+                    "refHost": req.get("refHost"),
+                    "findingAt": req.get("findingAt"),
+                    "finding": {
+                        "namespace": ns, "notebook": name,
+                        "kind": req.get("kind"), "host": req.get("host"),
+                        "at": req.get("findingAt"),
+                        "evidence": {"resumed": True},
+                    },
+                    "boundAt": bound_at,
+                    "state": "bound",
+                    "failures": 0,
+                    "steps": int(req.get("steps", self.steps)),
+                    "targets": {},
+                    "storedAt": None,
+                })
+                adopted += 1
+        return adopted
+
+    # ------------------------------------------------------------ read side
+
+    def captures(self) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in self._captures]
+
+    def profiles_payload(self, namespace: str, name: str,
+                         recent: int = 8) -> dict | None:
+        """One gang's capture history for JWA + /debug/profiles drilldown:
+        status, trigger, and the TensorBoard logdir links."""
+        with self._lock:
+            recs = [
+                r for r in self._captures
+                if (r["namespace"], r["notebook"]) == (namespace, name)
+            ]
+            if not recs:
+                return None
+            last = self._last_bound.get((namespace, name))
+            now = self.clock()
+            return {
+                "cooldownS": self.cooldown_s,
+                "cooldownRemainingS": (
+                    max(0.0, round(self.cooldown_s - (now - last), 1))
+                    if last is not None
+                    else 0.0
+                ),
+                "captures": [
+                    {
+                        "id": r["id"],
+                        "state": r["state"],
+                        "kind": r["kind"],
+                        "culprit": r["host"],
+                        "reference": r["refHost"],
+                        "steps": r["steps"],
+                        "boundAt": r["boundAt"],
+                        "storedAt": r["storedAt"],
+                        "failures": r["failures"],
+                        "traces": [
+                            {
+                                "host": hk,
+                                "role": t["role"],
+                                "logdir": t["logdir"],
+                                "bytes": t["bytes"],
+                            }
+                            for hk, t in sorted(r["targets"].items())
+                        ],
+                    }
+                    for r in recs[-recent:]
+                ],
+            }
+
+    def debug_payload(self) -> dict:
+        with self._lock:
+            recs = [dict(r) for r in self._captures]
+            gangs = sorted({(r["namespace"], r["notebook"]) for r in recs})
+        by_state: dict[str, int] = {}
+        for r in recs:
+            by_state[r["state"]] = by_state.get(r["state"], 0) + 1
+        return {
+            "intervalS": self.interval_s,
+            "cooldownS": self.cooldown_s,
+            "maxActive": self.max_active,
+            "steps": self.steps,
+            "capturePasses": self.capture_passes,
+            "captures": by_state,
+            "gangs": [f"{ns}/{name}" for ns, name in gangs],
+        }
+
+    # ---------------------------------------------------------------- audit
+
+    def audit(self, where: str = "capture") -> list[str]:
+        """The per-seed capture audit (docs/chaos.md "capture audit"):
+
+        - **attribution** — every capture embeds a frozen finding whose
+          identity matches the capture's own (one finding → one capture id,
+          no two captures share one);
+        - **rate bounds** — per gang, consecutive bind times are at least
+          ``cooldown_s`` apart; replaying the bound→finished intervals,
+          never more than ``max_active`` in flight at once;
+        - **storage** — the newest stored capture per gang has a verified
+          commit record in the snapshot store for every trace it claims
+          (older captures are legitimately pruned by the store's retention).
+        """
+        out: list[str] = []
+        with self._lock:
+            recs = [dict(r) for r in self._captures]
+            now = self.clock()
+        seen_ids: dict[str, tuple] = {}
+        for r in recs:
+            fid = (r["namespace"], r["notebook"], r["kind"], r["host"],
+                   r["findingAt"])
+            key = f"{r['namespace']}/{r['notebook']}"
+            if r["id"] in seen_ids and seen_ids[r["id"]] != fid:
+                out.append(
+                    f"{where}: capture id {r['id']} bound to two different "
+                    f"findings"
+                )
+            seen_ids[r["id"]] = fid
+            f = r.get("finding") or {}
+            frozen = (f.get("namespace"), f.get("notebook"), f.get("kind"),
+                      f.get("host"), f.get("at"))
+            if frozen != fid:
+                out.append(
+                    f"{where}: capture {r['id']} on {key} does not match "
+                    f"its own frozen finding ({frozen} != {fid})"
+                )
+            if r["state"] == "stored":
+                if r["host"] not in r["targets"]:
+                    out.append(
+                        f"{where}: stored capture {r['id']} on {key} has no "
+                        f"trace for its culprit {r['host']}"
+                    )
+                for hk, t in sorted(r["targets"].items()):
+                    if t.get("bytes", 0) <= 0:
+                        out.append(
+                            f"{where}: stored capture {r['id']} trace for "
+                            f"{hk} is empty"
+                        )
+        # rate bounds, re-proven from the records' own timestamps
+        by_gang: dict[tuple[str, str], list[dict]] = {}
+        for r in recs:
+            by_gang.setdefault((r["namespace"], r["notebook"]), []).append(r)
+        for gang in sorted(by_gang):
+            bounds = sorted(r["boundAt"] for r in by_gang[gang])
+            for a, b in zip(bounds, bounds[1:]):
+                if b - a < self.cooldown_s - 1e-6:
+                    out.append(
+                        f"{where}: gang {gang[0]}/{gang[1]} bound captures "
+                        f"{b - a:.0f}s apart (cooldown {self.cooldown_s:.0f}s)"
+                    )
+        intervals = sorted(
+            (r["boundAt"], r.get("finishedAt") or now) for r in recs
+        )
+        for i, (start, _end) in enumerate(intervals):
+            active = sum(
+                1 for s, e in intervals if s <= start and e > start
+            )
+            if active > self.max_active:
+                out.append(
+                    f"{where}: {active} captures in flight at "
+                    f"t={start:.0f} (cap {self.max_active})"
+                )
+        # storage: the newest stored capture per gang must verify
+        if self.store is not None:
+            for gang in sorted(by_gang):
+                stored = [r for r in by_gang[gang] if r["state"] == "stored"]
+                if not stored:
+                    continue
+                newest = max(stored, key=lambda r: (r["storedAt"], r["id"]))
+                session = capture_session(*gang)
+                for hk, t in sorted(newest["targets"].items()):
+                    if self.store.commit_record(
+                        session, t["snapshotId"]
+                    ) is None:
+                        out.append(
+                            f"{where}: newest stored capture {newest['id']} "
+                            f"on {gang[0]}/{gang[1]} trace {hk} has no "
+                            f"verifiable commit in the store"
+                        )
+        return out
+
+
+def audit_capture_attribution(
+    controller: CaptureController,
+    planted: Mapping[tuple[str, str], Mapping],
+    *,
+    where: str = "capture-attribution",
+    require_stored: bool = True,
+) -> list[str]:
+    """The planted-truth capture audit the soaks run next to
+    :func:`telemetry.gang.audit_gang_attribution`: captures may only exist
+    for gangs with a planted culprit (healthy gangs are never captured),
+    every capture names the planted host, and each planted gang ends the
+    run with at least one *stored* capture."""
+    out: list[str] = []
+    allowed = {"straggler": {"straggler"}, "desync": {"desync"},
+               "stall": {"stall", "desync"}, "storm": {"storm"}}
+    captures = controller.captures()
+    for r in captures:
+        key = (r["namespace"], r["notebook"])
+        plant = planted.get(key)
+        if plant is None:
+            out.append(
+                f"{where}: capture {r['id']} on healthy gang "
+                f"{key[0]}/{key[1]} ({r['kind']}@{r['host']})"
+            )
+        elif r["host"] != plant["host"] or r["kind"] not in allowed.get(
+            plant["kind"], set()
+        ):
+            out.append(
+                f"{where}: {key[0]}/{key[1]} planted "
+                f"{plant['kind']}@{plant['host']} but capture {r['id']} "
+                f"traced {r['kind']}@{r['host']}"
+            )
+    if require_stored:
+        for (ns, name), plant in sorted(planted.items()):
+            hits = [
+                r for r in captures
+                if (r["namespace"], r["notebook"]) == (ns, name)
+                and r["state"] == "stored"
+            ]
+            if not hits:
+                out.append(
+                    f"{where}: planted {plant['kind']} on {ns}/{name} never "
+                    f"produced a stored capture"
+                )
+    return out
+
+
+def install_profiles_route(app, controller: CaptureController) -> None:
+    """Mount /debug/profiles + /debug/profiles/<ns>/<name> on a web App
+    (rides the probes port next to /debug/gang — cluster-internal)."""
+    from werkzeug.wrappers import Response
+
+    @app.route("/debug/profiles")
+    def debug_profiles_index(request):
+        return Response(
+            json.dumps(controller.debug_payload(), sort_keys=True),
+            mimetype="application/json",
+        )
+
+    @app.route("/debug/profiles/<namespace>/<name>")
+    def debug_profiles(request, namespace, name):
+        payload = controller.profiles_payload(namespace, name)
+        if payload is None:
+            return Response(
+                json.dumps({"error": f"no captures for {namespace}/{name}"}),
+                status=404,
+                mimetype="application/json",
+            )
+        return Response(
+            json.dumps(payload, sort_keys=True),
+            mimetype="application/json",
+        )

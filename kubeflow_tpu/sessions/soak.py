@@ -456,6 +456,7 @@ def run_session_seed(
     lost_update_audit: bool = True,
     ledger_audit: bool = True,
     gang_audit: bool = True,
+    capture_audit: bool = True,
 ) -> SessionSeedResult:
     """One seeded soak run: hostile timeline under API + store chaos, heal,
     settle past every deadline, quiesce, then the fixed-point audits.
@@ -466,7 +467,14 @@ def run_session_seed(
     schedules, one seed-drawn planted culprit — and requires, at the fixed
     point, that every claim re-proves from its evidence and the planted
     culprit (and nothing else) was named, through every suspend/resume
-    handoff the timeline throws at the gangs."""
+    handoff the timeline throws at the gangs.
+
+    ``capture_audit=True`` (with the gang arm) additionally arms the
+    finding-triggered capture loop (obs/profiler.py) over THIS soak's
+    faulted snapshot store — capture saves face the same StoreError
+    schedule as session snapshots and must retry to stored — with the same
+    per-seed capture audit as the chaos soak: one finding per capture,
+    rate bounds exact, planted gang stored, healthy gangs untouched."""
     scenario = SessionScenario(seed)
     base = FakeCluster()
     tpu_env.install(base)
@@ -533,11 +541,14 @@ def run_session_seed(
     # barrier tears gangs down and re-binds them, and the attribution
     # audit must still name exactly the planted host.
     gang_agg = None
+    capture_ctl = None
     gang_planted: dict[tuple[str, str], dict] = {}
     if gang_audit:
         from kubeflow_tpu.culler.probe import ProbeResult
         from kubeflow_tpu.telemetry.agent import (
+            FakeCompileSchedule,
             FakeDeviceBackend,
+            FakeProfiler,
             FakeStepSchedule,
             TelemetryAgent,
         )
@@ -558,18 +569,21 @@ def run_session_seed(
         if multi:
             plant_rng = random.Random(f"gang-plant-{seed}")
             pname, phosts = multi[plant_rng.randrange(len(multi))]
-            pkind = ("slow", "lagging", "stalled")[plant_rng.randrange(3)]
+            pkind = ("slow", "lagging", "stalled", "storm")[
+                plant_rng.randrange(4)
+            ]
             po = plant_rng.randrange(phosts)
             plant = (pname, pkind, po)
             gang_planted[(scenario.NAMESPACE, pname)] = {
                 "kind": {"slow": "straggler", "lagging": "desync",
-                         "stalled": "stall"}[pkind],
+                         "stalled": "stall", "storm": "storm"}[pkind],
                 "host": gang_host_key(pname, 0, po, 1),
             }
         shapes = {
             "slow": dict(slow_factor=2.0),
             "lagging": dict(behind_steps=15),
             "stalled": dict(stall_after=5),
+            "storm": {},  # a compile-schedule shape, not a step one
         }
         gang_agents: dict[str, TelemetryAgent] = {}
         for name, num_hosts in multi:
@@ -587,7 +601,17 @@ def run_session_seed(
                     start_at=clock() - 200.0, jitter_s=0.15,
                     seed=seed * 1000 + o, **shape,
                 )
-                gang_agents[gang_host_key(name, 0, o, 1)] = TelemetryAgent(
+                hk = gang_host_key(name, 0, o, 1)
+                is_storm = (
+                    plant is not None
+                    and plant[1] == "storm"
+                    and (name, o) == (plant[0], plant[2])
+                )
+                # compile counters on every host (healthy: two warm-up
+                # compiles, inside the detector's allowance; the storm
+                # plant recompiles forever) and a deterministic capture
+                # backend for the capture arm
+                gang_agents[hk] = TelemetryAgent(
                     FakeDeviceBackend(
                         duty_cycle=duty,
                         hbm_used_bytes=float(duty * (8 << 30)),
@@ -595,6 +619,16 @@ def run_session_seed(
                     ),
                     clock=clock,
                     step_schedule=sched_,
+                    compile_schedule=FakeCompileSchedule(
+                        start_at=clock() - 200.0,
+                        warmup_compiles=2,
+                        recompile_every_s=25.0 if is_storm else None,
+                        seed=seed * 1000 + o,
+                    ),
+                    profiler=FakeProfiler(
+                        host=hk, seed=seed * 1000 + o,
+                        clock=clock, step_schedule=sched_,
+                    ),
                 )
         gang_rng = random.Random(f"gang-telemetry-{seed}")
 
@@ -635,6 +669,55 @@ def run_session_seed(
             ),
             recorder=EventRecorder(component="gang-telemetry", clock=clock),
         )
+
+        if capture_audit:
+            # capture arm (obs/profiler.py): same loop as the chaos soak,
+            # but over THIS soak's FAULTED snapshot store — a capture save
+            # faces the same StoreError schedule as a session snapshot and
+            # must retry (same deterministic ids) until stored. Captures
+            # land under sessions/profiles/<ns>/<name>/ and so ride the
+            # chunk store's mark-sweep and audit_chunk_store for free.
+            from kubeflow_tpu.obs.profiler import CaptureController
+
+            capture_rng = random.Random(f"capture-telemetry-{seed}")
+
+            def capture_probe(targets, timeout=5.0, max_concurrency=64):
+                out = []
+                for host, _port, path in targets:
+                    a = gang_agents.get(host)
+                    if a is None:
+                        out.append(ProbeResult(-1, ""))
+                    elif (
+                        chaos is not None
+                        and not chaos._healed
+                        and capture_rng.random() < 0.15
+                    ):
+                        out.append(
+                            ProbeResult(
+                                -2 if capture_rng.random() < 0.5 else -1, ""
+                            )
+                        )
+                    else:
+                        steps = int(path.rsplit("steps=", 1)[-1])
+                        try:
+                            out.append(ProbeResult(200, a.capture(steps)))
+                        except Exception:
+                            out.append(ProbeResult(-3, ""))
+                return out
+
+            capture_ctl = CaptureController(
+                cluster,
+                gang_agg,
+                store,
+                interval_s=10.0,
+                cooldown_s=120.0,
+                max_active=2,
+                steps=4,
+                clock=clock,
+                capture_fn=capture_probe,
+                target_for=lambda nb, hk: (hk, 0, "/capture"),
+                recorder=EventRecorder(component="profiler", clock=clock),
+            )
 
     # shared across scheduler incarnations (crash-restarts)
     sched_diff_failures: list[str] = []
@@ -682,6 +765,9 @@ def run_session_seed(
         # zero reconcile-path scrapes: gang aggregation lives on the
         # harness-driven scrape pass only, never inside a reconcile
         gang_before = gang_agg.scrape_passes if gang_agg is not None else 0
+        cap_before = (
+            capture_ctl.capture_passes if capture_ctl is not None else 0
+        )
         for _ in range(max_restarts_per_tick):
             crashed = False
             try:
@@ -701,6 +787,12 @@ def run_session_seed(
                 f"({gang_agg.scrape_passes - gang_before} pass(es) "
                 f"during a manager tick)"
             )
+        if capture_ctl is not None and capture_ctl.capture_passes != cap_before:
+            violations.append(
+                f"profile capture ran on the reconcile path "
+                f"({capture_ctl.capture_passes - cap_before} pass(es) "
+                f"during a manager tick)"
+            )
 
     def drive(where: str, *, sub_ticks: int = 3, dt: float = 10.0) -> None:
         for s in range(sub_ticks):
@@ -712,6 +804,9 @@ def run_session_seed(
                 # the controller-manager's telemetry loop: one gang pass
                 # between ticks, interval-gated, never inside a reconcile
                 gang_agg.collect()
+            if capture_ctl is not None:
+                # capture pass AFTER the gang pass, same loop
+                capture_ctl.collect()
             ledger.tick(force=True)
             tick()
             if chaos is not None:
@@ -764,6 +859,8 @@ def run_session_seed(
         agent.tick()
         if gang_agg is not None:
             gang_agg.collect()
+        if capture_ctl is not None:
+            capture_ctl.collect()
         ledger.tick(force=True)
         tick()
         violations.extend(auditor.observe(base, clock(), f"quiesce {s}"))
@@ -825,6 +922,21 @@ def run_session_seed(
         violations.extend(gang_agg.audit(where="final"))
         violations.extend(
             audit_gang_attribution(gang_agg, gang_planted, where="final")
+        )
+    if capture_ctl is not None:
+        # capture audit (docs/chaos.md "capture audit"): every stored
+        # capture traces to exactly one frozen finding, rate bounds
+        # re-prove from the records' own timestamps, the newest stored
+        # capture per gang is restorable from the (faulted) chunk store,
+        # the planted gang ends the run with a stored capture, and
+        # healthy gangs are never captured
+        from kubeflow_tpu.obs.profiler import audit_capture_attribution
+
+        violations.extend(capture_ctl.audit(where="final"))
+        violations.extend(
+            audit_capture_attribution(
+                capture_ctl, gang_planted, where="final"
+            )
         )
     return SessionSeedResult(
         seed=seed,

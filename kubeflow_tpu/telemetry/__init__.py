@@ -61,6 +61,21 @@ FAMILY_STEP_END = "tpu_step_end_seconds"
 # aggregator only needs enough overlap to bridge one missed scrape pass
 STEP_WINDOW = 32
 
+# compile observability (docs/observability.md "compile telemetry"): the
+# agent samples jax.monitoring / compilation-cache counters into cumulative
+# families. Counters only — the gang aggregator diffs them per scrape pass,
+# so a missed pass merges into the next delta instead of losing events.
+FAMILY_COMPILE_TOTAL = "tpu_compile_total"
+FAMILY_COMPILE_SECONDS = "tpu_compile_seconds_total"
+FAMILY_COMPILE_CACHE_HITS = "tpu_compile_cache_hits_total"
+
+# on-demand profile capture (obs/profiler.py): the agent's second endpoint
+# next to the scrape path — GET /capture?steps=N runs a bounded trace
+# through the configured profiler backend and returns the trace payload
+CAPTURE_PATH = "/capture"
+CAPTURE_DEFAULT_STEPS = 5
+CAPTURE_MAX_STEPS = 64
+
 
 @dataclasses.dataclass(frozen=True)
 class ActivitySample:
@@ -99,4 +114,10 @@ __all__ = [
     "FAMILY_STEP_START",
     "FAMILY_STEP_END",
     "STEP_WINDOW",
+    "FAMILY_COMPILE_TOTAL",
+    "FAMILY_COMPILE_SECONDS",
+    "FAMILY_COMPILE_CACHE_HITS",
+    "CAPTURE_PATH",
+    "CAPTURE_DEFAULT_STEPS",
+    "CAPTURE_MAX_STEPS",
 ]

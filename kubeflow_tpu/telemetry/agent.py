@@ -28,6 +28,12 @@ import time
 from typing import Callable, Iterator, Sequence
 
 from kubeflow_tpu.telemetry import (
+    CAPTURE_DEFAULT_STEPS,
+    CAPTURE_MAX_STEPS,
+    CAPTURE_PATH,
+    FAMILY_COMPILE_CACHE_HITS,
+    FAMILY_COMPILE_SECONDS,
+    FAMILY_COMPILE_TOTAL,
     FAMILY_DEVICE_COUNT,
     FAMILY_DUTY_CYCLE,
     FAMILY_DUTY_KNOWN,
@@ -225,6 +231,187 @@ class FakeStepSchedule:
         return records, open_, completed
 
 
+class JaxCompileMonitor:
+    """Samples compile activity from ``jax.monitoring`` listeners into
+    cumulative totals. Defensively gated: a JAX build without the listener
+    APIs (or no JAX at all) leaves the totals at zero rather than failing —
+    compile telemetry degrades to absent, never breaks the scrape."""
+
+    def __init__(self) -> None:
+        self._count = 0
+        self._seconds = 0.0
+        self._cache_hits = 0
+        self._lock = threading.Lock()
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(self._on_duration)
+        except Exception:
+            pass
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_listener(self._on_event)
+        except Exception:
+            pass
+
+    def _on_duration(self, event: str, duration: float, **kw) -> None:
+        # "/jax/core/compile/backend_compile_duration" and friends: one
+        # duration event per compilation is the canonical compile signal
+        if "compil" in event:
+            with self._lock:
+                self._count += 1
+                self._seconds += max(0.0, float(duration))
+
+    def _on_event(self, event: str, **kw) -> None:
+        if "cache_hit" in event:
+            with self._lock:
+                self._cache_hits += 1
+
+    def totals(self) -> tuple[int, float, int]:
+        with self._lock:
+            return self._count, self._seconds, self._cache_hits
+
+
+class FakeCompileSchedule:
+    """Deterministic compile-event stream for soaks and benches: a pure
+    function of the clock, like :class:`FakeStepSchedule`. A healthy host
+    performs ``warmup_compiles`` at ``start_at`` (the jit warm-up) and then
+    only cache hits; a **storm host** (``recompile_every_s`` set) keeps
+    recompiling after warm-up — the shape-drifting-input signature the gang
+    aggregator's recompilation-storm detector must attribute."""
+
+    def __init__(
+        self,
+        *,
+        start_at: float = 0.0,
+        warmup_compiles: int = 2,
+        compile_s: float = 3.0,
+        recompile_every_s: float | None = None,
+        hit_every_s: float | None = 30.0,
+        seed: int = 0,
+    ) -> None:
+        self.start_at = start_at
+        self.warmup_compiles = max(0, warmup_compiles)
+        self.compile_s = compile_s
+        self.recompile_every_s = recompile_every_s
+        self.hit_every_s = hit_every_s
+        self.seed = seed
+
+    def _duration(self, i: int) -> float:
+        # seeded per-event hash (the FakeStepSchedule Weyl-mix idiom):
+        # deterministic without a PRNG allocation per event
+        x = (i * 2654435761 + self.seed * 40503 + 97531) % (1 << 32)
+        return self.compile_s * (0.75 + 0.5 * (x / float(1 << 32)))
+
+    def totals(self, now: float) -> tuple[int, float, int]:
+        """(compile count, cumulative compile seconds, cache hits) at
+        ``now`` — cumulative, so consumers diff like any counter."""
+        if now < self.start_at:
+            return 0, 0.0, 0
+        count = self.warmup_compiles
+        if self.recompile_every_s:
+            count += int((now - self.start_at) // self.recompile_every_s)
+        seconds = sum(self._duration(i) for i in range(count))
+        hits = (
+            int((now - self.start_at) // self.hit_every_s)
+            if self.hit_every_s
+            else 0
+        )
+        return count, seconds, hits
+
+
+class FakeProfiler:
+    """Deterministic capture backend for soaks and benches.
+
+    Synthesizes a trace payload from the host identity, the requested step
+    count, the step schedule's window at capture time, and the seed — the
+    same request replayed against the same clock state yields byte-identical
+    text, so a crash-restarted capture controller re-requesting a capture
+    converges on the same content-addressed chunks instead of leaking new
+    ones."""
+
+    def __init__(
+        self,
+        *,
+        host: str = "host",
+        seed: int = 0,
+        clock: Callable[[], float] = time.time,
+        step_schedule: FakeStepSchedule | None = None,
+        fail_every: int | None = None,
+    ) -> None:
+        self.host = host
+        self.seed = seed
+        self.clock = clock
+        self.step_schedule = step_schedule
+        self.fail_every = fail_every
+        self.captures = 0
+
+    def capture(self, steps: int) -> str:
+        self.captures += 1
+        if self.fail_every and self.captures % self.fail_every == 0:
+            raise RuntimeError(f"fake profiler fault on {self.host}")
+        base = 0
+        if self.step_schedule is not None:
+            _, _, base = self.step_schedule.window(self.clock(), 1)
+        lines = [
+            f"# fake-xla-trace host={self.host} steps={steps} "
+            f"seed={self.seed} from_step={base + 1}"
+        ]
+        for i in range(steps):
+            x = (
+                (base + i) * 2654435761 + self.seed * 40503 + 777
+            ) % (1 << 32)
+            lines.append(
+                f"step={base + 1 + i} device_us={x % 100000} "
+                f"op=fusion.{x % 97}"
+            )
+        return "\n".join(lines) + "\n"
+
+
+class JaxTraceProfiler:
+    """Real capture backend: traces the live process for a bounded window
+    sized to ``steps`` recent step durations through ``jax.profiler`` and
+    returns the trace files it produced, concatenated. Gated the same way
+    as every other real backend — any failure raises and the capture
+    endpoint reports it; nothing here can take the scrape path down."""
+
+    def __init__(
+        self,
+        *,
+        logdir_base: str = "/tmp/tpu-profiles",
+        step_hint_s: float = 1.0,
+        max_window_s: float = 30.0,
+    ) -> None:
+        self.logdir_base = logdir_base
+        self.step_hint_s = step_hint_s
+        self.max_window_s = max_window_s
+        self._captures = 0
+
+    def capture(self, steps: int) -> str:
+        import os
+
+        import jax
+
+        self._captures += 1
+        logdir = os.path.join(self.logdir_base, f"capture-{self._captures}")
+        window = min(self.max_window_s, max(0.1, steps * self.step_hint_s))
+        jax.profiler.start_trace(logdir)
+        try:
+            time.sleep(window)
+        finally:
+            jax.profiler.stop_trace()
+        parts = []
+        for root, _dirs, files in os.walk(logdir):
+            for f in sorted(files):
+                path = os.path.join(root, f)
+                with open(path, "rb") as fh:
+                    data = fh.read()
+                parts.append(f"# file={os.path.relpath(path, logdir)} "
+                             f"bytes={len(data)}")
+        return "\n".join(parts) + "\n"
+
+
 class StepRing:
     """Bounded ring of (step, start, end) intervals; duty cycle is the
     fraction of a trailing window covered by them. Steps never overlap (one
@@ -318,12 +505,18 @@ class TelemetryAgent:
         ring_len: int = DEFAULT_RING_LEN,
         step_schedule: FakeStepSchedule | None = None,
         step_window: int = STEP_WINDOW,
+        compile_monitor=None,
+        compile_schedule: FakeCompileSchedule | None = None,
+        profiler=None,
     ) -> None:
         self.backend = backend or JaxDeviceBackend()
         self.clock = clock
         self.window_s = window_s
         self.step_schedule = step_schedule
         self.step_window = step_window
+        self.compile_monitor = compile_monitor
+        self.compile_schedule = compile_schedule
+        self.profiler = profiler
         self.ring = StepRing(ring_len)
         self.registry = registry or Registry()
         self.duty = self.registry.gauge(
@@ -365,6 +558,22 @@ class TelemetryAgent:
             "Wall end timestamp of a recent completed step (labeled by id)",
             labelnames=("step",),
         )
+        # compile observability: cumulative families, fed by delta from the
+        # monitor/schedule totals at sample time (counters only move
+        # forward; a totals regression means the source restarted → re-base)
+        self.compiles = self.registry.counter(
+            FAMILY_COMPILE_TOTAL,
+            "XLA compilations observed on this host (jax.monitoring)",
+        )
+        self.compile_seconds = self.registry.counter(
+            FAMILY_COMPILE_SECONDS,
+            "Cumulative seconds this host spent in XLA compilation",
+        )
+        self.compile_cache_hits = self.registry.counter(
+            FAMILY_COMPILE_CACHE_HITS,
+            "Compilation-cache hits observed on this host",
+        )
+        self._compile_synced = (0, 0.0, 0)
         self._step_counter = 0
         self._sched_total = 0       # schedule: completed steps already synced
         self._sched_observed = 0    # schedule: highest step id histogrammed
@@ -430,12 +639,37 @@ class TelemetryAgent:
         if open_ is not None:
             self.step_start.set(open_[1], step=str(open_[0]))
 
+    def _sync_compiles(self) -> None:
+        """Fold the compile source's cumulative totals into the families by
+        delta; a regressed total (restarted source) re-bases at zero."""
+        if self.compile_schedule is not None:
+            totals = self.compile_schedule.totals(self.clock())
+        elif self.compile_monitor is not None:
+            try:
+                totals = self.compile_monitor.totals()
+            except Exception:
+                return  # monitor hiccup: keep the families where they are
+        else:
+            return
+        count, seconds, hits = totals
+        pc, ps, ph = self._compile_synced
+        if count < pc or seconds < ps or hits < ph:
+            pc, ps, ph = 0, 0.0, 0
+        if count > pc:
+            self.compiles.inc(count - pc)
+        if seconds > ps:
+            self.compile_seconds.inc(seconds - ps)
+        if hits > ph:
+            self.compile_cache_hits.inc(hits - ph)
+        self._compile_synced = (count, seconds, hits)
+
     def sample(self) -> None:
         """Refresh the gauges from the backend (and the step ring when the
         backend cannot measure duty cycle itself)."""
         if self.step_schedule is not None:
             self._sync_schedule()
         self._export_steps()
+        self._sync_compiles()
         try:
             samples: Sequence[DeviceSample] = self.backend.samples()
         except Exception:
@@ -464,10 +698,62 @@ class TelemetryAgent:
     def exposition(self) -> str:
         return self.registry.expose()  # pre_expose hook runs sample()
 
+    # ------------------------------------------------------------- capturing
+
+    def capture(self, steps: int = CAPTURE_DEFAULT_STEPS) -> str:
+        """Run one bounded trace capture through the configured profiler
+        backend and return the trace payload. The capture controller
+        (obs/profiler.py) drives this through :data:`CAPTURE_PATH`."""
+        if steps <= 0 or steps > CAPTURE_MAX_STEPS:
+            raise ValueError(
+                f"steps must be in 1..{CAPTURE_MAX_STEPS}, got {steps}"
+            )
+        if self.profiler is None:
+            raise RuntimeError("no profiler backend configured")
+        return self.profiler.capture(steps)
+
     # --------------------------------------------------------------- serving
 
+    def _capture_wsgi(self, environ, start_response):
+        import urllib.parse
+
+        qs = urllib.parse.parse_qs(environ.get("QUERY_STRING", "") or "")
+        try:
+            steps = int(qs.get("steps", [str(CAPTURE_DEFAULT_STEPS)])[0])
+        except ValueError:
+            steps = -1
+        try:
+            body = self.capture(steps).encode()
+        except ValueError as e:
+            err = str(e).encode()
+            start_response(
+                "400 Bad Request",
+                [("Content-Type", "text/plain"),
+                 ("Content-Length", str(len(err)))],
+            )
+            return [err]
+        except Exception as e:
+            # no backend, or the profiler itself failed mid-capture: the
+            # controller retries under its own rate bounds
+            err = str(e).encode()
+            start_response(
+                "503 Service Unavailable",
+                [("Content-Type", "text/plain"),
+                 ("Content-Length", str(len(err)))],
+            )
+            return [err]
+        start_response(
+            "200 OK",
+            [("Content-Type", "text/plain"),
+             ("Content-Length", str(len(body)))],
+        )
+        return [body]
+
     def wsgi(self, environ, start_response):
-        """Minimal WSGI app: the scrape endpoint only (GET <any path>)."""
+        """Minimal WSGI app: the scrape endpoint (GET <any path>) plus the
+        on-demand capture endpoint (GET /capture?steps=N)."""
+        if (environ.get("PATH_INFO", "") or "/") == CAPTURE_PATH:
+            return self._capture_wsgi(environ, start_response)
         body = self.exposition().encode()
         start_response(
             "200 OK",

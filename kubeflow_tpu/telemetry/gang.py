@@ -15,7 +15,10 @@ derives the gang-level signals:
 - **straggler index** — per-host median step time over the gang median,
   with the culprit pod named;
 - **desync** — a host ≥K step ids behind the gang's max;
-- **stall** — no step progress while the host's devices read busy.
+- **stall** — no step progress while the host's devices read busy;
+- **recompilation storm** — compile events recurring across scrape passes
+  after warm-up (the agents' ``FAMILY_COMPILE_*`` counters, per host): a
+  shape-drifting input signature re-jitting forever names itself.
 
 Like the collector, ``collect()`` is the only method that performs I/O and
 runs off the reconcile path; reconcilers never wait on a gang pass. Every
@@ -36,6 +39,8 @@ from kubeflow_tpu.api import types as api
 from kubeflow_tpu.culler import probe
 from kubeflow_tpu.runtime import objects as ko
 from kubeflow_tpu.telemetry import (
+    FAMILY_COMPILE_SECONDS,
+    FAMILY_COMPILE_TOTAL,
     FAMILY_DUTY_CYCLE,
     FAMILY_STEP_END,
     FAMILY_STEP_START,
@@ -57,11 +62,18 @@ DEFAULT_MIN_STEPS = 5          # medians need evidence before they indict
 DEFAULT_DESYNC_STEPS = 5       # host this many step ids behind = desynced
 DEFAULT_STALL_AFTER_S = 120.0  # busy with no progress this long = stalled
 DEFAULT_BUSY_DUTY = 0.5        # "devices read busy" bound for stall claims
+# recompilation storms: the first STORM_WARMUP compiles are jit warm-up;
+# STORM_EVENTS scrape passes with compiles beyond that indict the host (a
+# missed scrape merges its delta into the next pass — faults can only
+# UNDER-count events, never fake a storm)
+DEFAULT_STORM_WARMUP = 3
+DEFAULT_STORM_EVENTS = 3
 MAX_FINDINGS = 256
 FLEET_DURATIONS = 4096         # bounded sample pool for the fleet p99
 
 REASON_STRAGGLER = "StragglerDetected"
 REASON_DESYNC = "GangDesynced"
+REASON_STORM = "RecompilationStorm"
 
 def gang_median(values: Sequence[float]) -> float:
     """The gang's reference step time: the LOWER median across hosts. A
@@ -137,7 +149,8 @@ class _Host:
     __slots__ = (
         "records", "open", "last_step", "prev_total", "progress_at",
         "last_ok", "failures", "duty", "epoch_at", "suppress_below",
-        "observed_through",
+        "observed_through", "compile_total", "compile_seconds",
+        "recompile_events",
     )
 
     def __init__(self, now: float) -> None:
@@ -156,6 +169,9 @@ class _Host:
         # the gang max recorded at reset time.
         self.suppress_below = 0
         self.observed_through = 0    # highest step id histogrammed
+        self.compile_total = 0.0     # cumulative compiles at last scrape
+        self.compile_seconds = 0.0
+        self.recompile_events = 0    # passes with compiles past warm-up
 
     def fresh(self, now: float, staleness_s: float) -> bool:
         return now - self.last_ok <= staleness_s
@@ -205,6 +221,8 @@ class GangTelemetryAggregator:
         desync_steps: int = DEFAULT_DESYNC_STEPS,
         stall_after_s: float = DEFAULT_STALL_AFTER_S,
         busy_duty: float = DEFAULT_BUSY_DUTY,
+        storm_warmup: int = DEFAULT_STORM_WARMUP,
+        storm_events: int = DEFAULT_STORM_EVENTS,
         timeout_s: float = DEFAULT_TIMEOUT_S,
         clock: Callable[[], float] = time.time,
         perf: Callable[[], float] = time.perf_counter,
@@ -226,6 +244,8 @@ class GangTelemetryAggregator:
         self.desync_steps = desync_steps
         self.stall_after_s = stall_after_s
         self.busy_duty = busy_duty
+        self.storm_warmup = storm_warmup
+        self.storm_events = storm_events
         self.timeout_s = timeout_s
         self.clock = clock
         self._perf = perf
@@ -303,6 +323,8 @@ class GangTelemetryAggregator:
             self.metrics.host_step_lag.clear()
             self.metrics.step_skew.clear()
             self.metrics.straggler_ratio.clear()
+            self.metrics.compile_total.clear()
+            self.metrics.compile_seconds.clear()
             for key in sorted(live):
                 if key in self._gangs:
                     events.extend(
@@ -370,6 +392,24 @@ class GangTelemetryAggregator:
             host.last_step = max_completed
             host.progress_at = now
         host.prev_total = total
+        # compile stream: cumulative counters diffed per pass. A regression
+        # means the agent restarted — re-epoch the compile tracking the same
+        # way the step counter does. Warm-up compiles (the first
+        # storm_warmup) never count; each pass that ingests compiles BEYOND
+        # them is one recompile event.
+        ctotal = families.get(FAMILY_COMPILE_TOTAL, 0.0)
+        csecs = families.get(FAMILY_COMPILE_SECONDS, 0.0)
+        if ctotal < host.compile_total:
+            host.compile_total = 0.0
+            host.compile_seconds = 0.0
+            host.recompile_events = 0
+        past_warmup = max(0.0, ctotal - self.storm_warmup) - max(
+            0.0, host.compile_total - self.storm_warmup
+        )
+        if past_warmup > 0:
+            host.recompile_events += 1
+        host.compile_total = ctotal
+        host.compile_seconds = max(host.compile_seconds, csecs)
         host.duty = families.get(FAMILY_DUTY_CYCLE)
         host.last_ok = now
         gang.last_ok = now
@@ -515,7 +555,47 @@ class GangTelemetryAggregator:
                         f"progress for {quiet_s:.0f}s (last step "
                         f"{h.last_step})",
                     ))
+
+        # recompilation storm: compile events keep recurring after warm-up
+        # while the host steps — a shape-drifting input signature re-jitting
+        # forever names itself (compile telemetry is per-host)
+        for hk in sorted(fresh):
+            h = fresh[hk]
+            if not h.records and h.open is None:
+                continue  # never instrumented: no step stream to storm over
+            if h.recompile_events >= self.storm_events:
+                active.add(("storm", hk))
+                if ("storm", hk) not in gang.active:
+                    self._record(
+                        ns, name, "storm", hk, now,
+                        recompile_events=h.recompile_events,
+                        evidence={
+                            "compileTotal": h.compile_total,
+                            "compileSeconds": h.compile_seconds,
+                            "recompileEvents": h.recompile_events,
+                            "threshold": self.storm_events,
+                            "warmupCompiles": self.storm_warmup,
+                            "lastStep": h.last_step,
+                        },
+                    )
+                    events.append((
+                        nb, REASON_STORM,
+                        f"host {hk} recompiled in {h.recompile_events} "
+                        f"scrape passes after warm-up "
+                        f"({h.compile_total:.0f} compiles, "
+                        f"{h.compile_seconds:.0f}s compiling)",
+                    ))
         gang.active = active
+
+        # per-gang compile rollup (dashboard compile_seconds series)
+        self.metrics.compile_total.set(
+            sum(h.compile_total for h in fresh.values()),
+            namespace=ns, notebook=name,
+        )
+        self.metrics.compile_seconds.set(
+            sum(h.compile_seconds for h in fresh.values()),
+            namespace=ns, notebook=name,
+        )
 
         # skew: the latest step id every fresh aligned host completed
         aligned = [h for h in fresh.values() if h.aligned() and h.records]
@@ -624,7 +704,7 @@ class GangTelemetryAggregator:
             gang = self._gangs.get((namespace, name))
             if gang is None:
                 return None
-            for kind in ("stall", "desync", "straggler"):
+            for kind in ("stall", "desync", "straggler", "storm"):
                 for k, hk in sorted(gang.active):
                     if k == kind:
                         return {"verdict": kind, "culprit": hk}
@@ -655,6 +735,9 @@ class GangTelemetryAggregator:
                     "failures": h.failures,
                     "medianStepS": h.median_step_s(),
                     "dutyCycle": h.duty,
+                    "compileTotal": h.compile_total,
+                    "compileSeconds": h.compile_seconds,
+                    "recompileEvents": h.recompile_events,
                     "openStep": (
                         {"step": h.open[0], "sinceS": round(now - h.open[1], 1)}
                         if h.open
@@ -676,7 +759,7 @@ class GangTelemetryAggregator:
             ratio = self.metrics.straggler_ratio.get(
                 namespace=namespace, notebook=name
             )
-            for kind in ("stall", "desync", "straggler"):
+            for kind in ("stall", "desync", "straggler", "storm"):
                 claim = next(
                     (hk for k, hk in sorted(gang.active) if k == kind), None
                 )
@@ -724,6 +807,8 @@ class GangTelemetryAggregator:
                 "desyncSteps": self.desync_steps,
                 "stallAfterS": self.stall_after_s,
                 "minSteps": self.min_steps,
+                "stormWarmup": self.storm_warmup,
+                "stormEvents": self.storm_events,
             },
             "gangs": [f"{ns}/{name}" for ns, name in keys],
             "findings": self.findings(),
@@ -821,6 +906,22 @@ class GangTelemetryAggregator:
                         f"{where}: stall claim on {key}/{f['host']} on a "
                         f"host that was not busy (duty {ev.get('duty')})"
                     )
+            elif f["kind"] == "storm":
+                if ev.get("recompileEvents", 0) < ev.get(
+                    "threshold", self.storm_events
+                ):
+                    out.append(
+                        f"{where}: storm claim on {key}/{f['host']} below "
+                        f"its own recompile-event threshold"
+                    )
+                elif ev.get("compileTotal", 0.0) <= ev.get(
+                    "warmupCompiles", self.storm_warmup
+                ):
+                    out.append(
+                        f"{where}: storm claim on {key}/{f['host']} cites "
+                        f"{ev.get('compileTotal')} compiles — within its "
+                        f"own warm-up allowance"
+                    )
         return out
 
 
@@ -833,15 +934,16 @@ def audit_gang_attribution(
     """The planted-truth audit the soaks run: every planted culprit MUST be
     detected and named, and no finding may indict anything else.
 
-    ``planted`` maps (namespace, name) → {"kind": straggler|desync|stall,
-    "host": <pod name>}. A stalled host legitimately also accrues desync
-    findings (its step id freezes while the gang advances), so stall plants
-    accept either kind — but always only the planted host.
+    ``planted`` maps (namespace, name) → {"kind": straggler|desync|stall|
+    storm, "host": <pod name>}. A stalled host legitimately also accrues
+    desync findings (its step id freezes while the gang advances), so stall
+    plants accept either kind — but always only the planted host. A storm
+    plant keeps a healthy step schedule, so only storm claims may name it.
     """
     out: list[str] = []
     findings = aggregator.findings()
     allowed = {"straggler": {"straggler"}, "desync": {"desync"},
-               "stall": {"stall", "desync"}}
+               "stall": {"stall", "desync"}, "storm": {"storm"}}
     for f in findings:
         key = (f["namespace"], f["notebook"])
         plant = planted.get(key)

@@ -50,6 +50,7 @@ from kubeflow_tpu.controllers.profile_controller import ProfileReconciler
 from kubeflow_tpu.controllers.tensorboard_controller import TensorboardReconciler
 from kubeflow_tpu.culler.culler import Culler
 from kubeflow_tpu.obs.events import EventRecorder, audit_events
+from kubeflow_tpu.obs.profiler import CAPTURE_ANNOTATION
 from kubeflow_tpu.obs.slo import SLOMetrics
 from kubeflow_tpu.obs.timeline import (
     REQUEST_ID_ANNOTATION,
@@ -710,6 +711,10 @@ def _normalize(obj: dict) -> dict:
         # the read-path audit's RYW probe marker: harness bookkeeping whose
         # success depends on the fault schedule, not converged state
         anns.pop(READ_PROBE_ANNOTATION, None)
+        # capture bind/ack state is run history (finding timestamps and
+        # capture ids are fault-schedule-dependent); the per-run capture
+        # AUDIT judges it, the fixed point must not
+        anns.pop(CAPTURE_ANNOTATION, None)
     if o.get("kind") == "Secret":
         for field in ("data", "stringData"):
             if field in o:
@@ -963,6 +968,7 @@ def run_scenario(
     *,
     telemetry: bool = False,
     gang_audit: bool = True,
+    capture_audit: bool = True,
     shards: int = 1,
     max_restarts_per_tick: int = 6,
     lost_update_audit: bool = True,
@@ -986,6 +992,14 @@ def run_scenario(
     mid-run stall), and the final attribution audit requires the planted
     culprit to be named — and nothing else to be flagged — with every
     claim re-proven from its frozen evidence.
+
+    ``capture_audit=True`` (with the gang arm) additionally arms the
+    finding-triggered capture loop (obs/profiler.py): every frozen finding
+    binds a bounded trace capture (culprit + reference host) through the
+    snapshot store, over the faulted client, and the final capture audit
+    requires every stored capture to trace back to exactly one finding,
+    the rate bounds to hold, and the planted gang to end the run with a
+    stored capture — healthy gangs never captured.
 
     ``shards=N`` (docs/chaos.md "sharded soak") runs N managers over the
     same store, each enqueue-filtered to its namespace-hash slice
@@ -1096,11 +1110,14 @@ def run_scenario(
         )
 
     gang_agg = None
+    capture_ctl = None
     gang_planted: dict[tuple[str, str], dict] = {}
     if telemetry and gang_audit:
         from kubeflow_tpu.culler.probe import ProbeResult
         from kubeflow_tpu.telemetry.agent import (
+            FakeCompileSchedule,
             FakeDeviceBackend,
+            FakeProfiler,
             FakeStepSchedule,
             TelemetryAgent,
         )
@@ -1130,24 +1147,28 @@ def run_scenario(
         # map to the claims they must produce: a 2x-slow host to a
         # straggler verdict, a lagging host to desync, a stalled host to
         # stall-or-desync (its frozen step id lags the gang more every
-        # pass, so either claim names it).
+        # pass, so either claim names it), a storming host — healthy steps,
+        # recompiling forever — to a recompilation-storm verdict.
         plant: tuple[str, str, int, int] | None = None
         if multi:
             plant_rng = random.Random(f"gang-plant-{seed}")
             pname, pslices, phosts = multi[plant_rng.randrange(len(multi))]
-            pkind = ("slow", "lagging", "stalled")[plant_rng.randrange(3)]
+            pkind = ("slow", "lagging", "stalled", "storm")[
+                plant_rng.randrange(4)
+            ]
             pj = plant_rng.randrange(pslices)
             po = plant_rng.randrange(phosts)
             plant = (pname, pkind, pj, po)
             gang_planted[(scenario.nb_ns[pname], pname)] = {
                 "kind": {"slow": "straggler", "lagging": "desync",
-                         "stalled": "stall"}[pkind],
+                         "stalled": "stall", "storm": "storm"}[pkind],
                 "host": gang_host_key(pname, pj, po, pslices),
             }
         shapes = {
             "slow": dict(slow_factor=2.0),
             "lagging": dict(behind_steps=15),
             "stalled": dict(stall_after=5),
+            "storm": {},  # the storm is a compile-schedule shape, not a step one
         }
         gang_agents: dict[str, TelemetryAgent] = {}
         for name, num_slices, num_hosts in multi:
@@ -1173,16 +1194,40 @@ def run_scenario(
                         start_at=clock() - 200.0, jitter_s=0.15,
                         seed=seed * 1000 + j * 16 + o, **shape,
                     )
-                    gang_agents[gang_host_key(name, j, o, num_slices)] = (
-                        TelemetryAgent(
-                            FakeDeviceBackend(
-                                duty_cycle=duty,
-                                hbm_used_bytes=float(duty * (8 << 30)),
-                                jitter=0.005, seed=seed,
-                            ),
-                            clock=clock,
-                            step_schedule=sched,
-                        )
+                    hk = gang_host_key(name, j, o, num_slices)
+                    is_storm = (
+                        plant is not None
+                        and plant[1] == "storm"
+                        and (name, j, o) == (plant[0], plant[2], plant[3])
+                    )
+                    # every host reports compile counters: healthy hosts
+                    # compiled twice at startup (inside the detector's
+                    # warm-up allowance, zero events forever); the storm
+                    # plant keeps recompiling — the per-host attribution
+                    # under test
+                    compiles = FakeCompileSchedule(
+                        start_at=clock() - 200.0,
+                        warmup_compiles=2,
+                        recompile_every_s=25.0 if is_storm else None,
+                        seed=seed * 1000 + j * 16 + o,
+                    )
+                    gang_agents[hk] = TelemetryAgent(
+                        FakeDeviceBackend(
+                            duty_cycle=duty,
+                            hbm_used_bytes=float(duty * (8 << 30)),
+                            jitter=0.005, seed=seed,
+                        ),
+                        clock=clock,
+                        step_schedule=sched,
+                        compile_schedule=compiles,
+                        # the capture arm's backend: deterministic trace
+                        # text derived from (host, seed, step window) — a
+                        # crash-restarted re-capture converges on identical
+                        # content-addressed chunks
+                        profiler=FakeProfiler(
+                            host=hk, seed=seed * 1000 + j * 16 + o,
+                            clock=clock, step_schedule=sched,
+                        ),
                     )
         # gang scrapes draw failures from their OWN seeded stream, so the
         # fleet collector's fault pattern is identical with or without the
@@ -1234,6 +1279,60 @@ def run_scenario(
             ),
             recorder=EventRecorder(component="gang-telemetry", clock=clock),
         )
+
+        if capture_audit:
+            # capture arm (obs/profiler.py): the aggregator's frozen
+            # findings trigger bounded trace captures through the
+            # content-addressed snapshot store. ONE controller across
+            # controller restarts (an observer); its annotation writes go
+            # through the FAULTED client — bind/ack crash-safety is under
+            # test — while the store itself is unfaulted here (the sessions
+            # soak runs the same arm over its faulted store). Capture
+            # probes draw failures from their OWN seeded stream, like the
+            # gang scrapes.
+            from kubeflow_tpu.obs.profiler import CaptureController
+            from kubeflow_tpu.sessions.store import SnapshotStore
+            from kubeflow_tpu.testing.sessionstore import FakeObjectStore
+
+            capture_rng = random.Random(f"capture-telemetry-{seed}")
+
+            def capture_probe(targets, timeout=5.0, max_concurrency=64):
+                out = []
+                for host, _port, path in targets:
+                    agent = gang_agents.get(host)
+                    if agent is None:
+                        out.append(ProbeResult(-1, ""))
+                    elif (
+                        chaos is not None
+                        and not chaos._healed
+                        and capture_rng.random() < 0.15
+                    ):
+                        out.append(
+                            ProbeResult(
+                                -2 if capture_rng.random() < 0.5 else -1, ""
+                            )
+                        )
+                    else:
+                        steps = int(path.rsplit("steps=", 1)[-1])
+                        try:
+                            out.append(ProbeResult(200, agent.capture(steps)))
+                        except Exception:
+                            out.append(ProbeResult(-3, ""))
+                return out
+
+            capture_ctl = CaptureController(
+                cluster,
+                gang_agg,
+                SnapshotStore(FakeObjectStore(seed=seed), clock=clock),
+                interval_s=10.0,
+                cooldown_s=120.0,
+                max_active=2,
+                steps=4,
+                clock=clock,
+                capture_fn=capture_probe,
+                target_for=lambda nb, hk: (hk, 0, "/capture"),
+                recorder=EventRecorder(component="profiler", clock=clock),
+            )
 
     # the efficiency ledger is an observer like the tracer and the
     # collector: ONE instance across controller restarts, ticked only by
@@ -1404,6 +1503,9 @@ def run_scenario(
         # (or the culler) trips this on every seed.
         passes_before = collector.scrape_passes if collector is not None else 0
         gang_before = gang_agg.scrape_passes if gang_agg is not None else 0
+        cap_before = (
+            capture_ctl.capture_passes if capture_ctl is not None else 0
+        )
         for idx in range(len(managers)):
             for _ in range(max_restarts_per_tick):
                 crashed = False
@@ -1436,6 +1538,12 @@ def run_scenario(
                 f"({gang_agg.scrape_passes - gang_before} pass(es) "
                 f"during a manager tick)"
             )
+        if capture_ctl is not None and capture_ctl.capture_passes != cap_before:
+            violations.append(
+                f"{where}: profile capture ran on the reconcile path "
+                f"({capture_ctl.capture_passes - cap_before} pass(es) "
+                f"during a manager tick)"
+            )
 
     def drive(where: str, *, sub_ticks: int = 3, dt: float = 10.0) -> None:
         for s in range(sub_ticks):
@@ -1450,6 +1558,10 @@ def run_scenario(
                 # rides the same loop in cmd/controller: one gang pass per
                 # telemetry pass, interval-gated, never inside a reconcile
                 gang_agg.collect()
+            if capture_ctl is not None:
+                # capture pass AFTER the gang pass, same loop: a finding
+                # frozen this interval binds its capture the same interval
+                capture_ctl.collect()
             ledger.tick(force=True)
             tick(where)
             if chaos is not None:
@@ -1511,6 +1623,8 @@ def run_scenario(
             collector.collect()
         if gang_agg is not None:
             gang_agg.collect()
+        if capture_ctl is not None:
+            capture_ctl.collect()
         ledger.tick(force=True)
         tick(f"quiesce {s}")
         fp = fingerprint(base)
@@ -1575,6 +1689,21 @@ def run_scenario(
         violations.extend(
             audit_gang_attribution(gang_agg, gang_planted, where="final")
         )
+    if capture_ctl is not None:
+        # capture audit (docs/chaos.md "capture audit"): every stored
+        # capture traces back to exactly one frozen finding, the per-gang
+        # cooldown and global cap re-prove from the records' own
+        # timestamps, the newest stored capture per gang is restorable
+        # from the chunk store, and the planted gang ends the run with a
+        # stored capture — healthy gangs never captured
+        from kubeflow_tpu.obs.profiler import audit_capture_attribution
+
+        violations.extend(capture_ctl.audit(where="final"))
+        violations.extend(
+            audit_capture_attribution(
+                capture_ctl, gang_planted, where="final"
+            )
+        )
     if ledger_audit:
         # conservation audit (docs/chaos.md "efficiency ledger"): per seed,
         # Σ buckets == ∫ capacity dt exactly (integer equality, no
@@ -1596,6 +1725,7 @@ def run_seed(
     *,
     telemetry: bool = False,
     gang_audit: bool = True,
+    capture_audit: bool = True,
     shards: int = 1,
     lost_update_audit: bool = True,
     explain_audit: bool = True,
@@ -1612,12 +1742,12 @@ def run_seed(
     convergence then proves the partition changes no outcomes."""
     reference = run_scenario(
         seed, None, telemetry=telemetry, gang_audit=gang_audit,
-        shards=shards,
+        capture_audit=capture_audit, shards=shards,
         explain_audit=explain_audit, ledger_audit=ledger_audit,
     )
     chaotic = run_scenario(
         seed, faults or ChaosConfig(), telemetry=telemetry,
-        gang_audit=gang_audit, shards=shards,
+        gang_audit=gang_audit, capture_audit=capture_audit, shards=shards,
         lost_update_audit=lost_update_audit, explain_audit=explain_audit,
         ledger_audit=ledger_audit,
     )

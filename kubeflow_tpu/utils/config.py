@@ -82,6 +82,17 @@ class ControllerConfig:
     # every multi-host gang for per-step records and derives straggler/
     # desync verdicts. Rides the collector's loop; needs telemetry_enabled.
     gang_telemetry_enabled: bool = False
+    # Finding-triggered profile capture (obs/profiler.py): the gang
+    # aggregator's frozen findings trigger bounded XLA trace captures
+    # (culprit + reference host) committed through the snapshot store under
+    # the TensorBoard plugins/profile/ convention. Needs
+    # gang_telemetry_enabled; rides the telemetry loop, never the reconcile
+    # path. Rate limits: one capture per gang per cooldown, a global
+    # concurrent-capture cap.
+    profiler_enabled: bool = False
+    profiler_cooldown_s: float = 600.0
+    profiler_max_active: int = 2
+    profiler_steps: int = 5
     # Fleet efficiency ledger (obs/ledger.py): exactly-once chip-second
     # accounting with waste attribution — busy/idle/starting/suspending/
     # draining/free/stranded per pool, family, and namespace, plus queued
@@ -150,6 +161,10 @@ class ControllerConfig:
             ),
             telemetry_port=int(_env_float("TELEMETRY_PORT", 8890)),
             gang_telemetry_enabled=_env_bool("GANG_TELEMETRY_ENABLED", True),
+            profiler_enabled=_env_bool("PROFILER_ENABLED", True),
+            profiler_cooldown_s=_env_float("PROFILER_COOLDOWN_S", 600.0),
+            profiler_max_active=int(_env_float("PROFILER_MAX_ACTIVE", 2)),
+            profiler_steps=int(_env_float("PROFILER_STEPS", 5)),
             ledger_enabled=_env_bool("LEDGER_ENABLED", True),
             ledger_interval_s=_env_float("LEDGER_INTERVAL_S", 15.0),
             capacity_enabled=_env_bool("CAPACITY_ENABLED", False),

@@ -1097,6 +1097,17 @@ class GangMetrics:
             "Wall time of one whole-fleet gang aggregation pass",
             buckets=self.PASS_BUCKETS,
         )
+        self.compile_total = self.registry.gauge(
+            "tpu_gang_compile_total",
+            "XLA compilations summed across one gang's hosts (from the "
+            "agents' cumulative compile counters)",
+            labelnames=("namespace", "notebook"),
+        )
+        self.compile_seconds = self.registry.gauge(
+            "tpu_gang_compile_seconds",
+            "Cumulative XLA compile seconds summed across one gang's hosts",
+            labelnames=("namespace", "notebook"),
+        )
 
 
 class LedgerMetrics:
@@ -1259,3 +1270,45 @@ class CapacityMetrics:
         """Time-to-first-chip p50 off the real histogram (dashboard series
         and the JWA's provisioning ETA)."""
         return self.time_to_first_chip.quantile(0.5)
+
+
+class ProfilerMetrics:
+    """Finding-triggered profiling (obs/profiler.py, docs/observability.md
+    "capture on demand"): the capture controller's request/outcome families.
+    Lives next to ``GangMetrics`` on the shared registry — a finding there
+    becomes a capture here, and the per-seed capture audit proves the two
+    stay 1:1 under chaos."""
+
+    # one capture: two host probes + chunked store writes
+    CAPTURE_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0)
+
+    def __init__(self, registry: Registry | None = None) -> None:
+        self.registry = registry or Registry()
+        self.captures = self.registry.counter(
+            "tpu_profile_capture_total",
+            "Capture requests by outcome (stored|failed|rate_limited|"
+            "suppressed)",
+            labelnames=("outcome",),
+        )
+        self.capture_findings = self.registry.counter(
+            "tpu_profile_capture_finding_total",
+            "Captures bound per triggering finding kind",
+            labelnames=("kind",),
+        )
+        self.active = self.registry.gauge(
+            "tpu_profile_captures_active",
+            "Captures currently in flight (bounded by the global cap)",
+        )
+        self.stored_bytes = self.registry.counter(
+            "tpu_profile_capture_bytes_total",
+            "Trace payload bytes committed through the snapshot store",
+        )
+        self.capture_seconds = self.registry.histogram(
+            "tpu_profile_capture_seconds",
+            "Wall time of one finding-to-stored capture",
+            buckets=self.CAPTURE_BUCKETS,
+        )
+        self.passes = self.registry.counter(
+            "tpu_profile_pass_total",
+            "Capture-controller passes taken (never on the reconcile path)",
+        )

@@ -20,7 +20,14 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 from typing import Iterator
+
+
+class ProfilerServerError(RuntimeError):
+    """The live profiler server is in the wrong state for the request
+    (double start, stop without start). Raised by :func:`server` /
+    :func:`stop` instead of letting jax's own C++-level error surface."""
 
 
 @contextlib.contextmanager
@@ -42,6 +49,8 @@ def trace(logdir: str, *, host_only_on_coordinator: bool = False) -> Iterator[No
 def trace_n_steps(logdir: str, step_fn, state, batch, *, steps: int = 3):
     """Convenience: warm up one step (compile outside the trace), then capture
     ``steps`` steps — the standard recipe for a clean device timeline."""
+    if steps <= 0:
+        raise ValueError(f"steps must be positive, got {steps}")
     state, metrics = step_fn(state, batch)  # compile + warm outside trace
     _block(metrics)
     with trace(logdir):
@@ -79,9 +88,47 @@ def _block(tree) -> None:
         float(leaves[0].sum() if hasattr(leaves[0], "sum") else leaves[0])
 
 
-def server(port: int = 9012) -> None:
+# the live-server singleton: jax.profiler.start_server raises from deep
+# inside the C++ layer on a double start, so the module tracks the one
+# allowed server itself and fails with a typed error instead
+_server_lock = threading.Lock()
+_server = None
+_server_port: int | None = None
+
+
+def server(port: int = 9012):
     """Start the live profiler server (attach from TensorBoard's profile tab;
-    the capture-on-demand path for a running mesh)."""
+    the capture-on-demand path for a running mesh). Idempotent per port: a
+    repeat call for the SAME port returns the running server; a second
+    start on a different port raises :class:`ProfilerServerError` (jax
+    allows one server per process). Returns the server handle."""
+    global _server, _server_port
     import jax
 
-    jax.profiler.start_server(port)
+    with _server_lock:
+        if _server is not None:
+            if _server_port == port:
+                return _server
+            raise ProfilerServerError(
+                f"profiler server already running on port {_server_port}; "
+                f"stop() it before starting on port {port}"
+            )
+        _server = jax.profiler.start_server(port)
+        _server_port = port
+        return _server
+
+
+def stop() -> None:
+    """Stop the live profiler server started by :func:`server`. Raises
+    :class:`ProfilerServerError` when no server is running."""
+    global _server, _server_port
+    with _server_lock:
+        if _server is None:
+            raise ProfilerServerError("no profiler server is running")
+        stopper = getattr(_server, "stop", None)
+        try:
+            if stopper is not None:
+                stopper()
+        finally:
+            _server = None
+            _server_port = None

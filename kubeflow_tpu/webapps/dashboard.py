@@ -68,6 +68,7 @@ def create_app(
     links: dict | None = None,
     telemetry=None,
     gang=None,
+    profiler=None,
     slo=None,
     scheduler=None,
     ledger=None,
@@ -99,6 +100,16 @@ def create_app(
         # aggregator's last pass.
         readers["step_p99"] = gang.fleet_step_p99
         readers["straggler_ratio"] = gang.fleet_straggler_ratio
+        # compile telemetry (telemetry/agent.py compile families rolled up
+        # per gang): cumulative XLA compile seconds across the fleet — a
+        # rising slope after warm-up is the recompilation-storm signature
+        # the aggregator's detector names per host
+        readers["compile_seconds"] = _gauge_total(gang.metrics.compile_seconds)
+    if profiler is not None:
+        # finding-triggered captures (obs/profiler.py): how many traces the
+        # platform captured, by outcome (stored/failed/rate_limited) — the
+        # proof the capture loop is alive and its rate bounds are biting
+        readers["capture_count"] = _gauge_total(profiler.metrics.captures)
     if slo is not None:
         # startup SLO series (obs/slo.py): click-to-ready p99 off the real
         # histogram and the fast-window error-budget burn rate — the two
@@ -402,6 +413,14 @@ def create_app(
             # per-gang straggler index as the labeled values; the worst
             # gang's ratio is the series
             values = gang.metrics.straggler_ratio.samples()
+        elif gang is not None and metric_type == "compile_seconds":
+            # per-gang cumulative compile seconds as the labeled values;
+            # the fleet total is the series
+            values = gang.metrics.compile_seconds.samples()
+        elif profiler is not None and metric_type == "capture_count":
+            # per-outcome capture counts as the labeled values; the total
+            # is the series
+            values = profiler.metrics.captures.samples()
         elif slo is not None and metric_type == "startup_p99":
             values = [{"labels": {}, "value": slo.startup_p99()}]
         elif slo is not None and metric_type == "startup_burn_rate":

@@ -241,6 +241,7 @@ def create_app(
     metrics: NotebookMetrics | None = None,
     telemetry=None,
     gang=None,
+    profiler=None,
     timeline=None,
     ledger=None,
     capacity=None,
@@ -284,6 +285,8 @@ def create_app(
             parts.append(f"tel:{getattr(tel, 'scrape_passes', 0)}")
         if gang is not None:
             parts.append(f"gang:{getattr(gang, 'scrape_passes', 0)}")
+        if profiler is not None:
+            parts.append(f"prof:{getattr(profiler, 'capture_passes', 0)}")
         if ledger is not None:
             parts.append(f"led:{getattr(ledger, 'ticks', 0)}")
         cap = _cap_extra()
@@ -459,6 +462,14 @@ def create_app(
             # the "which host is dragging my gang" answer. None for a
             # single-host session or one the aggregator has never scraped.
             summary["gang"] = gang.gang_payload(namespace, name)
+        if profiler is not None:
+            # finding-triggered captures (obs/profiler.py): what the
+            # platform traced when this gang's findings froze — capture
+            # status, the culprit + reference hosts, and the TensorBoard
+            # logdirs the traces render under. None for a gang never
+            # captured, so the UI can distinguish "healthy" from
+            # "profiler off".
+            summary["profiles"] = profiler.profiles_payload(namespace, name)
         if timeline is not None:
             # the click-to-ready timeline (obs/timeline.py): per-phase
             # attribution of this session's startup — "which layer ate the

@@ -205,6 +205,24 @@ class TestReconcileIORule:
         )
         assert lint("kubeflow_tpu/controllers/planted.py", src, "TPU003") == []
 
+    def test_profile_capture_on_reconcile_path_caught(self):
+        """The obs/profiler.py extension: driving a capture pass (or an
+        agent's capture endpoint) from a reconcile is the same head-of-line
+        block as a scrape, only longer — a capture traces N live steps."""
+        src = (
+            "class ThingReconciler:\n"
+            "    def __init__(self, profiler):\n"
+            "        self.profiler = profiler\n"
+            "    def reconcile(self, cluster, namespace, name):\n"
+            "        self.profiler.collect()\n"
+            "        self.profiler.capture(5)\n"
+            "        latest = self.profiler.captures()\n"
+        )
+        findings = lint("kubeflow_tpu/controllers/planted.py", src, "TPU003")
+        # collect() and capture() flagged; the in-memory read passes
+        assert len(findings) == 2
+        assert all("scrape" in f.message for f in findings)
+
 
 # ---------------------------------------------------------------- TPU004
 

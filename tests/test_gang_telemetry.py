@@ -414,3 +414,105 @@ class TestSurfaces:
         ).data)
         worst = max(v["value"] for v in ratios["values"])
         assert worst == pytest.approx(2.0, rel=0.25)
+
+
+# -------------------------------------------------------- recompilation storm
+
+
+class TestRecompilationStorm:
+    """The compile-stream detector: a host that keeps recompiling past
+    warm-up is named with frozen compile evidence; warm-up compiles and
+    restarted compile sources never fake a storm."""
+
+    def _with_compiles(self, clock, agents, *, storm=None, warmup=2):
+        from kubeflow_tpu.telemetry.agent import FakeCompileSchedule
+
+        for i, hk in enumerate(sorted(agents)):
+            agents[hk].compile_schedule = FakeCompileSchedule(
+                start_at=clock() - 200.0,
+                warmup_compiles=warmup,
+                recompile_every_s=25.0 if hk == storm else None,
+                seed=i,
+            )
+        return agents
+
+    def test_storm_host_named_with_frozen_compile_evidence(self):
+        from kubeflow_tpu.telemetry.gang import REASON_STORM
+
+        clock = FakeClock()
+        cluster = _world()
+        culprit = host_key("nb", 0, 1, 1)
+        recorder = EventRecorder(component="gang-telemetry", clock=clock)
+        agents = self._with_compiles(clock, _agents(clock), storm=culprit)
+        agg = _mk(cluster, agents, clock, recorder=recorder)
+        _drive(agg, clock)
+        storms = [f for f in agg.findings() if f["kind"] == "storm"]
+        assert [f["host"] for f in storms] == [culprit]
+        ev = storms[0]["evidence"]
+        assert ev["recompileEvents"] >= ev["threshold"]
+        assert ev["compileTotal"] > ev["warmupCompiles"]
+        assert ev["compileSeconds"] > 0
+        assert agg.audit() == []
+        planted = {(NS, "nb"): {"kind": "storm", "host": culprit}}
+        assert audit_gang_attribution(agg, planted) == []
+        # the Warning event names the host and the recurrence
+        events = cluster.list("Event", NS)
+        assert any(
+            e["reason"] == REASON_STORM and culprit in e["message"]
+            for e in events
+        )
+        # the per-gang compile rollup feeds the dashboard series
+        assert agg.metrics.compile_seconds.get(
+            namespace=NS, notebook="nb"
+        ) > 0
+
+    def test_warmup_compiles_never_flag(self):
+        clock = FakeClock()
+        cluster = _world()
+        agents = self._with_compiles(clock, _agents(clock))
+        agg = _mk(cluster, agents, clock)
+        _drive(agg, clock, passes=10)
+        assert agg.findings() == []
+        assert audit_gang_attribution(agg, {}) == []
+
+    def test_restarted_compile_source_rebases_not_storms(self):
+        """An agent restart regresses the cumulative compile counter; the
+        detector must re-epoch (like the step counter) — the warm-up
+        compiles of the NEW epoch are warm-up again, not recompiles."""
+        from kubeflow_tpu.telemetry.agent import FakeCompileSchedule
+
+        clock = FakeClock()
+        cluster = _world()
+        agents = self._with_compiles(clock, _agents(clock))
+        agg = _mk(cluster, agents, clock)
+        _drive(agg, clock, passes=3)
+        # restart every host's compile source: totals start from zero
+        for i, hk in enumerate(sorted(agents)):
+            agents[hk].compile_schedule = FakeCompileSchedule(
+                start_at=clock(), warmup_compiles=2, seed=100 + i
+            )
+            agents[hk]._compile_synced = (0, 0.0, 0)
+        _drive(agg, clock, passes=6)
+        assert [f for f in agg.findings() if f["kind"] == "storm"] == []
+        assert agg.audit() == []
+
+    def test_missed_scrapes_undercount_never_fake(self):
+        """Faulted scrape passes merge compile deltas into one event — a
+        storm host's event count only ever UNDER-counts, and a healthy
+        host that missed passes stays clean."""
+        clock = FakeClock()
+        cluster = _world()
+        culprit = host_key("nb", 0, 1, 1)
+        fail = set()
+        agents = self._with_compiles(clock, _agents(clock), storm=culprit)
+        agg = _mk(cluster, agents, clock, fail=fail)
+        # alternate failing the storm host's scrape every other pass
+        for i in range(12):
+            fail.clear()
+            if i % 2:
+                fail.add(culprit)
+            agg.collect(force=True)
+            clock.advance(10.0)
+        storms = [f for f in agg.findings() if f["kind"] == "storm"]
+        assert [f["host"] for f in storms] == [culprit]
+        assert agg.audit() == []

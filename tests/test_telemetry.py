@@ -262,6 +262,287 @@ class TestStepAnnotationSharing:
         assert events == [("start", "gs://bucket/run1"), ("body",), ("stop",)]
 
 
+# ------------------------------------------------------------ profiler server
+
+
+class TestProfilerServer:
+    """utils/profiling.server()/stop(): the one-per-process live profiler
+    server with typed errors instead of jax's C++-level failure."""
+
+    @pytest.fixture(autouse=True)
+    def _reset_server_state(self):
+        import kubeflow_tpu.utils.profiling as prof
+
+        prof._server = None
+        prof._server_port = None
+        yield
+        prof._server = None
+        prof._server_port = None
+
+    def _fake_jax(self, starts):
+        import types
+
+        class _Handle:
+            def __init__(self, port):
+                self.port = port
+                self.stopped = False
+
+            def stop(self):
+                self.stopped = True
+
+        def start_server(port):
+            starts.append(port)
+            return _Handle(port)
+
+        return types.SimpleNamespace(
+            profiler=types.SimpleNamespace(start_server=start_server)
+        )
+
+    def test_server_idempotent_per_port(self, monkeypatch):
+        import sys
+
+        import kubeflow_tpu.utils.profiling as prof
+
+        starts = []
+        monkeypatch.setitem(sys.modules, "jax", self._fake_jax(starts))
+        a = prof.server(9012)
+        b = prof.server(9012)  # repeat: the running server, no second start
+        assert a is b
+        assert starts == [9012]
+
+    def test_second_port_raises_typed_error(self, monkeypatch):
+        import sys
+
+        import kubeflow_tpu.utils.profiling as prof
+
+        starts = []
+        monkeypatch.setitem(sys.modules, "jax", self._fake_jax(starts))
+        prof.server(9012)
+        with pytest.raises(prof.ProfilerServerError) as err:
+            prof.server(9999)
+        assert "9012" in str(err.value)
+        assert starts == [9012]
+
+    def test_stop_then_restart_on_new_port(self, monkeypatch):
+        import sys
+
+        import kubeflow_tpu.utils.profiling as prof
+
+        starts = []
+        monkeypatch.setitem(sys.modules, "jax", self._fake_jax(starts))
+        handle = prof.server(9012)
+        prof.stop()
+        assert handle.stopped
+        prof.server(9999)
+        assert starts == [9012, 9999]
+
+    def test_stop_without_server_raises(self):
+        import kubeflow_tpu.utils.profiling as prof
+
+        with pytest.raises(prof.ProfilerServerError):
+            prof.stop()
+
+
+class TestTraceNSteps:
+    def _fake_jax(self, events, leaves=None):
+        import types
+
+        return types.SimpleNamespace(
+            profiler=types.SimpleNamespace(
+                start_trace=lambda d: events.append("start"),
+                stop_trace=lambda: events.append("stop"),
+            ),
+            tree_util=types.SimpleNamespace(
+                tree_leaves=leaves
+                or (lambda tree: [] if tree in (None, {}) else [tree])
+            ),
+        )
+
+    def test_rejects_non_positive_steps(self):
+        import kubeflow_tpu.utils.profiling as prof
+
+        for bad in (0, -3):
+            with pytest.raises(ValueError, match="positive"):
+                prof.trace_n_steps("gs://b/run", lambda s, b: (s, b),
+                                   None, None, steps=bad)
+
+    def test_warmup_step_runs_outside_the_trace(self, monkeypatch):
+        """The contract: one warm-up step (compile) BEFORE start_trace,
+        then exactly ``steps`` steps inside the trace window."""
+        import sys
+
+        import kubeflow_tpu.utils.profiling as prof
+
+        events = []
+        monkeypatch.setitem(sys.modules, "jax", self._fake_jax(events))
+
+        def step_fn(state, batch):
+            events.append("step")
+            return state + 1, 0.5  # metrics: a plain float leaf
+
+        state, metrics = prof.trace_n_steps(
+            "gs://b/run", step_fn, 0, None, steps=3
+        )
+        assert state == 4  # warm-up + 3 traced steps
+        assert events == ["step", "start", "step", "step", "step", "stop"]
+
+    def test_block_falls_back_on_non_array_leaves(self, monkeypatch):
+        """_block's hard host sync fetches a leaf; a leaf without .sum()
+        (plain python scalar metrics) must still work."""
+        import sys
+
+        import kubeflow_tpu.utils.profiling as prof
+
+        monkeypatch.setitem(sys.modules, "jax", self._fake_jax([]))
+        prof._block(0.25)  # float leaf: no .sum(), float() path
+        prof._block({})  # no leaves at all: a no-op
+
+        class _Arr:
+            def sum(self):
+                return 6.0
+
+        prof._block(_Arr())  # array-ish leaf: .sum() path
+
+
+# ----------------------------------------------------------- compile families
+
+
+class TestCompileTelemetry:
+    def test_fake_compile_schedule_is_deterministic_and_cumulative(self):
+        from kubeflow_tpu.telemetry.agent import FakeCompileSchedule
+
+        mk = lambda: FakeCompileSchedule(
+            start_at=100.0, warmup_compiles=2, recompile_every_s=25.0,
+            seed=7,
+        )
+        assert mk().totals(400.0) == mk().totals(400.0)
+        count0, secs0, hits0 = mk().totals(200.0)
+        count1, secs1, hits1 = mk().totals(400.0)
+        assert count1 > count0 and secs1 > secs0 and hits1 >= hits0
+        # healthy shape: warm-up compiles only, then cache hits
+        healthy = FakeCompileSchedule(start_at=100.0, warmup_compiles=2)
+        assert healthy.totals(90.0) == (0, 0.0, 0)
+        c_early, _, _ = healthy.totals(200.0)
+        c_late, _, _ = healthy.totals(4_000.0)
+        assert c_early == c_late == 2
+
+    def test_agent_exposes_compile_families(self):
+        from kubeflow_tpu.telemetry.agent import FakeCompileSchedule
+
+        clock = FakeClock(1_000.0)
+        agent = TelemetryAgent(
+            FakeDeviceBackend(duty_cycle=0.5),
+            clock=clock,
+            compile_schedule=FakeCompileSchedule(
+                start_at=clock() - 100.0, warmup_compiles=2,
+            ),
+        )
+        families = parse_prometheus_text(agent.exposition())
+        assert families["tpu_compile_total"] == 2
+        assert families["tpu_compile_seconds_total"] > 0
+        # counters, not gauges: a later scrape never goes backwards
+        clock.advance(60.0)
+        again = parse_prometheus_text(agent.exposition())
+        assert again["tpu_compile_total"] == 2
+        assert again["tpu_compile_seconds_total"] == pytest.approx(
+            families["tpu_compile_seconds_total"]
+        )
+
+    def test_compile_source_regression_rebases_without_negative_deltas(self):
+        """A restarted compile source reports totals from zero again; the
+        families must re-base, never decrement and never double-count."""
+
+        class _Monitor:
+            def __init__(self):
+                self.t = (5, 40.0, 3)
+
+            def totals(self):
+                return self.t
+
+        mon = _Monitor()
+        clock = FakeClock(1_000.0)
+        agent = TelemetryAgent(
+            FakeDeviceBackend(duty_cycle=0.5), clock=clock,
+            compile_monitor=mon,
+        )
+        first = parse_prometheus_text(agent.exposition())
+        assert first["tpu_compile_total"] == 5
+        mon.t = (1, 6.0, 0)  # restart: cumulative totals regressed
+        second = parse_prometheus_text(agent.exposition())
+        assert second["tpu_compile_total"] == 6  # 5 + 1 past the re-base
+        assert second["tpu_compile_seconds_total"] == pytest.approx(46.0)
+
+
+# ------------------------------------------------------------ capture backend
+
+
+class TestCaptureEndpoint:
+    def _agent(self, clock, profiler="fake"):
+        from kubeflow_tpu.telemetry.agent import FakeProfiler
+
+        prof = (
+            FakeProfiler(host="h0", seed=3, clock=clock)
+            if profiler == "fake"
+            else profiler
+        )
+        return TelemetryAgent(
+            FakeDeviceBackend(duty_cycle=0.5), clock=clock, profiler=prof
+        )
+
+    def test_capture_validates_bounds_and_backend(self):
+        from kubeflow_tpu.telemetry import CAPTURE_MAX_STEPS
+
+        clock = FakeClock()
+        agent = self._agent(clock)
+        for bad in (0, -1, CAPTURE_MAX_STEPS + 1):
+            with pytest.raises(ValueError):
+                agent.capture(bad)
+        bare = TelemetryAgent(FakeDeviceBackend(), clock=clock)
+        with pytest.raises(RuntimeError, match="no profiler backend"):
+            bare.capture(3)
+
+    def test_fake_profiler_is_deterministic(self):
+        from kubeflow_tpu.telemetry.agent import FakeProfiler
+
+        clock = FakeClock()
+        mk = lambda: FakeProfiler(host="h0", seed=3, clock=clock)
+        assert mk().capture(4) == mk().capture(4)
+        assert mk().capture(4) != FakeProfiler(
+            host="h1", seed=3, clock=clock
+        ).capture(4)
+        assert len(mk().capture(4).splitlines()) == 5  # header + 4 steps
+
+    def test_capture_wsgi_statuses(self):
+        clock = FakeClock()
+        client = Client(self._agent(clock).wsgi)
+        ok = client.get("/capture?steps=4")
+        assert ok.status_code == 200
+        body = ok.get_data(as_text=True)
+        assert "fake-xla-trace" in body and "steps=4" in body
+        # the same request replayed is byte-identical (the capture
+        # controller's crash-retry convergence depends on this)
+        assert client.get("/capture?steps=4").get_data(as_text=True) == body
+        assert client.get("/capture?steps=0").status_code == 400
+        assert client.get("/capture?steps=junk").status_code == 400
+        # no backend configured: unavailable, not a scrape-path crash
+        bare = Client(
+            TelemetryAgent(FakeDeviceBackend(), clock=clock).wsgi
+        )
+        assert bare.get("/capture").status_code == 503
+        # the scrape path itself is untouched by capture wiring
+        assert client.get("/metrics").status_code == 200
+
+    def test_capture_wsgi_backend_fault_is_503(self):
+        from kubeflow_tpu.telemetry.agent import FakeProfiler
+
+        clock = FakeClock()
+        prof = FakeProfiler(host="h0", seed=3, clock=clock, fail_every=1)
+        client = Client(self._agent(clock, profiler=prof).wsgi)
+        resp = client.get("/capture?steps=4")
+        assert resp.status_code == 503
+        assert "fault" in resp.get_data(as_text=True)
+
+
 # ----------------------------------------------------------------- collector
 
 

@@ -53,6 +53,14 @@ def main(argv: list[str] | None = None) -> int:
                          "(the planted host must be named, healthy gangs "
                          "never flagged; docs/observability.md; on by "
                          "default)")
+    ap.add_argument("--capture-audit", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="with the gang arm: arm the finding-triggered "
+                         "capture loop (obs/profiler.py) and its per-seed "
+                         "audit — every stored capture traces to exactly "
+                         "one frozen finding, rate bounds hold, the "
+                         "planted gang ends with a stored capture "
+                         "(docs/chaos.md \"capture audit\"; on by default)")
     ap.add_argument("--shards", type=int, default=1,
                     help="run the SHARDED control plane: N namespace-hash "
                          "manager shards over one store, notebooks spread "
@@ -105,7 +113,8 @@ def main(argv: list[str] | None = None) -> int:
     for seed in seeds:
         result = run_seed(
             seed, cfg, telemetry=args.telemetry,
-            gang_audit=args.gang_audit, shards=args.shards,
+            gang_audit=args.gang_audit,
+            capture_audit=args.capture_audit, shards=args.shards,
             lost_update_audit=args.lost_update_audit,
             explain_audit=args.explain_audit,
             ledger_audit=args.ledger_audit,

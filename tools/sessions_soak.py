@@ -62,6 +62,14 @@ def main(argv: list[str] | None = None) -> int:
                          "seed-drawn planted culprit, and the attribution "
                          "audit through every suspend/resume handoff "
                          "(docs/observability.md; on by default)")
+    ap.add_argument("--capture-audit", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="with the gang arm: arm the finding-triggered "
+                         "capture loop (obs/profiler.py) over the soak's "
+                         "faulted snapshot store and its per-seed audit — "
+                         "one frozen finding per stored capture, rate "
+                         "bounds exact, planted gang stored (docs/chaos.md "
+                         "\"capture audit\"; on by default)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print a line per seed, not just failures")
     args = ap.parse_args(argv)
@@ -96,6 +104,7 @@ def main(argv: list[str] | None = None) -> int:
             lost_update_audit=args.lost_update_audit,
             ledger_audit=args.ledger_audit,
             gang_audit=args.gang_audit,
+            capture_audit=args.capture_audit,
         )
         suspends += result.suspends
         resumes += result.resumes
